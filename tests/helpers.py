@@ -1,0 +1,106 @@
+"""Shared builders for scheduler tests (the FakeBinder/BuildNode/BuildPod
+pattern of reference KB/pkg/scheduler/util/test_utils.go)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from volcano_tpu.api import (
+    POD_GROUP_KEY,
+    PodGroup,
+    Queue,
+    Resource,
+)
+from volcano_tpu.api.objects import Metadata, Node, Pod, PodSpec
+from volcano_tpu.api.types import PodPhase
+from volcano_tpu.store import Store
+
+
+def build_node(name: str, cpu="4", memory="8Gi", pods: int = 110, labels=None, **scalars) -> Node:
+    rl = {"cpu": cpu, "memory": memory, "pods": pods, **scalars}
+    return Node(
+        meta=Metadata(name=name, namespace=""),
+        allocatable=Resource.from_resource_list(rl),
+        labels=dict(labels or {}),
+    )
+
+
+def build_pod(
+    name: str,
+    group: str = "",
+    cpu="1",
+    memory="1Gi",
+    namespace: str = "default",
+    node_name: str = "",
+    phase: PodPhase = PodPhase.PENDING,
+    priority: int = 0,
+    labels=None,
+    **scalars,
+) -> Pod:
+    rl = {"cpu": cpu, "memory": memory, **scalars}
+    annotations = {POD_GROUP_KEY: group} if group else {}
+    return Pod(
+        meta=Metadata(name=name, namespace=namespace, annotations=annotations,
+                      labels=dict(labels or {})),
+        spec=PodSpec(resources=Resource.from_resource_list(rl), priority=priority),
+        phase=phase,
+        node_name=node_name,
+    )
+
+
+def build_podgroup(
+    name: str,
+    min_member: int = 1,
+    queue: str = "default",
+    namespace: str = "default",
+    phase=None,
+) -> PodGroup:
+    from volcano_tpu.api.types import PodGroupPhase
+
+    pg = PodGroup(
+        meta=Metadata(name=name, namespace=namespace),
+        min_member=min_member,
+        queue=queue,
+    )
+    pg.status.phase = phase or PodGroupPhase.INQUEUE
+    return pg
+
+
+def build_queue(name: str, weight: int = 1) -> Queue:
+    return Queue(meta=Metadata(name=name, namespace=""), weight=weight)
+
+
+def make_store(
+    nodes: List[Node],
+    queues: Optional[List[Queue]] = None,
+    podgroups: Optional[List[PodGroup]] = None,
+    pods: Optional[List[Pod]] = None,
+) -> Store:
+    store = Store()
+    for q in queues if queues is not None else [build_queue("default")]:
+        store.create("Queue", q)
+    for n in nodes:
+        store.create("Node", n)
+    for pg in podgroups or []:
+        store.create("PodGroup", pg)
+    for p in pods or []:
+        store.create("Pod", p)
+    return store
+
+
+class FakeBinder:
+    """Records binds instead of writing the store (test_utils.go:96-113)."""
+
+    def __init__(self):
+        self.binds: Dict[str, str] = {}
+
+    def bind(self, task, hostname: str) -> None:
+        self.binds[task.key] = hostname
+
+
+class FakeEvictor:
+    def __init__(self):
+        self.evicts: List[str] = []
+
+    def evict(self, task, reason: str) -> None:
+        self.evicts.append(task.key)

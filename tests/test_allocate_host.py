@@ -1,0 +1,112 @@
+"""Host-path allocate action behavior (BASELINE config 1 semantics).
+
+Scenario sources: reference test/e2e/job_scheduling.go ("Schedule Job" :27,
+"Gang scheduling" :82, "Gang Full-Occupied" :118) reduced to the hermetic
+fake-binder pattern of KB/pkg/scheduler/util/test_utils.go.
+"""
+
+from volcano_tpu.scheduler.conf import default_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from helpers import FakeBinder, build_node, build_pod, build_podgroup, make_store
+
+
+def run_cycle(store, backend="host"):
+    sched = Scheduler(store, conf=default_conf(backend=backend))
+    binder = FakeBinder()
+    sched.cache.binder = binder
+    sched.run_once()
+    return sched, binder
+
+
+def test_simple_job_binds_all_tasks():
+    store = make_store(
+        nodes=[build_node("n1"), build_node("n2")],
+        podgroups=[build_podgroup("pg1", min_member=3)],
+        pods=[build_pod(f"p{i}", group="pg1") for i in range(3)],
+    )
+    _, binder = run_cycle(store)
+    assert len(binder.binds) == 3
+    assert set(binder.binds) == {"default/p0", "default/p1", "default/p2"}
+
+
+def test_gang_insufficient_capacity_binds_nothing():
+    # 3-task gang, cluster fits only 2 -> nothing binds (all-or-nothing)
+    store = make_store(
+        nodes=[build_node("n1", cpu="2", memory="4Gi")],
+        podgroups=[build_podgroup("pg1", min_member=3)],
+        pods=[build_pod(f"p{i}", group="pg1", cpu="1") for i in range(3)],
+    )
+    _, binder = run_cycle(store)
+    assert binder.binds == {}
+
+
+def test_gang_partial_min_available_binds():
+    # 3 tasks, min_available=2, capacity 2 -> the 2 that fit all bind
+    store = make_store(
+        nodes=[build_node("n1", cpu="2", memory="4Gi")],
+        podgroups=[build_podgroup("pg1", min_member=2)],
+        pods=[build_pod(f"p{i}", group="pg1", cpu="1") for i in range(3)],
+    )
+    _, binder = run_cycle(store)
+    assert len(binder.binds) == 2
+
+
+def test_unschedulable_gang_gets_podgroup_condition():
+    store = make_store(
+        nodes=[build_node("n1", cpu="1", memory="2Gi")],
+        podgroups=[build_podgroup("pg1", min_member=3)],
+        pods=[build_pod(f"p{i}", group="pg1", cpu="1") for i in range(3)],
+    )
+    sched, binder = run_cycle(store)
+    assert binder.binds == {}
+    pg = store.get("PodGroup", "default/pg1")
+    assert any(c.kind == "Unschedulable" for c in pg.status.conditions)
+
+
+def test_higher_priority_job_wins_scarce_capacity():
+    from volcano_tpu.api.objects import Metadata, PriorityClass
+
+    pg_low = build_podgroup("pg-low", min_member=2)
+    pg_high = build_podgroup("pg-high", min_member=2)
+    pg_low.priority_class_name = "low-pri"
+    pg_high.priority_class_name = "high-pri"
+    store = make_store(
+        nodes=[build_node("n1", cpu="2", memory="4Gi")],
+        podgroups=[pg_low, pg_high],
+        pods=[
+            *[build_pod(f"low{i}", group="pg-low", cpu="1", priority=1) for i in range(2)],
+            *[build_pod(f"high{i}", group="pg-high", cpu="1", priority=10) for i in range(2)],
+        ],
+    )
+    store.create("PriorityClass", PriorityClass(Metadata(name="low-pri", namespace=""), value=1))
+    store.create("PriorityClass", PriorityClass(Metadata(name="high-pri", namespace=""), value=10))
+    _, binder = run_cycle(store)
+    assert set(binder.binds) == {"default/high0", "default/high1"}
+
+
+def test_invalid_gang_dropped_from_session():
+    # fewer valid tasks than min_available -> JobValid gate drops the job
+    store = make_store(
+        nodes=[build_node("n1")],
+        podgroups=[build_podgroup("pg1", min_member=5)],
+        pods=[build_pod("p0", group="pg1")],
+    )
+    _, binder = run_cycle(store)
+    assert binder.binds == {}
+    pg = store.get("PodGroup", "default/pg1")
+    assert any(
+        c.kind == "Unschedulable" and c.reason == "NotEnoughPods"
+        for c in pg.status.conditions
+    )
+
+
+def test_best_effort_skipped_by_allocate_handled_by_backfill():
+    store = make_store(
+        nodes=[build_node("n1")],
+        podgroups=[build_podgroup("pg1", min_member=1)],
+        pods=[build_pod("p0", group="pg1", cpu=0, memory=0)],
+    )
+    _, binder = run_cycle(store)
+    # default actions = allocate, backfill: backfill places the BestEffort pod
+    assert binder.binds == {"default/p0": "n1"}

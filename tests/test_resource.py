@@ -1,0 +1,100 @@
+"""Resource arithmetic + epsilon-comparison parity tests.
+
+Scenario sources: reference resource_info.go semantics (LessEqual tolerance
+minMilliCPU=10/minMemory=10Mi/minScalar=10, Sub guard, FitDelta epsilon).
+"""
+
+import pytest
+
+from volcano_tpu.api import Resource
+from volcano_tpu.api.resource import MIN_MEMORY, MIN_MILLI_CPU
+
+
+def res(cpu=0.0, mem=0.0, **scalars):
+    return Resource(cpu, mem, scalars)
+
+
+class TestComparisons:
+    def test_less_equal_exact(self):
+        assert res(1000, 2**30).less_equal(res(1000, 2**30))
+
+    def test_less_equal_within_epsilon(self):
+        # 9 millicores / <10Mi over still counts as <=
+        assert res(1009, 2**30 + MIN_MEMORY - 1).less_equal(res(1000, 2**30))
+
+    def test_less_equal_beyond_epsilon(self):
+        assert not res(1011, 0).less_equal(res(1000, 0))
+        assert not res(0, 2**30 + MIN_MEMORY).less_equal(res(0, 2**30))
+
+    def test_less_equal_scalar_dims(self):
+        assert res(0, 0, accelerator=4000).less_equal(res(0, 0, accelerator=4000))
+        assert not res(0, 0, accelerator=4000).less_equal(res(0, 0))
+
+    def test_less_strict(self):
+        # Reference quirk (resource_info.go Less): when NEITHER side has
+        # scalar resources, Less returns false even for strictly-smaller
+        # cpu/mem; it returns true only if the right side has scalars.
+        assert not res(999, 2**30 - 1).less(res(1000, 2**30))
+        assert res(999, 2**30 - 1).less(res(1000, 2**30, accelerator=1))
+        assert not res(1000, 2**30).less(res(1000, 2**30))
+
+    def test_empty(self):
+        assert Resource().is_empty()
+        assert res(MIN_MILLI_CPU - 1, MIN_MEMORY - 1).is_empty()
+        assert not res(MIN_MILLI_CPU, 0).is_empty()
+
+
+class TestArithmetic:
+    def test_add_sub_roundtrip(self):
+        a = res(2000, 4 * 2**30, accelerator=1000)
+        b = res(500, 2**30, accelerator=1000)
+        a.add(b)
+        assert a.get("cpu") == 2500
+        a.sub(b)
+        assert a.get("cpu") == 2000 and a.get("accelerator") == 1000
+
+    def test_sub_insufficient_raises(self):
+        with pytest.raises(ValueError):
+            res(100, 0).sub(res(200, 0))
+
+    def test_multi(self):
+        a = res(1000, 1000, accelerator=10).multi(1.5)
+        assert a.get("cpu") == 1500 and a.get("accelerator") == 15
+
+    def test_set_max(self):
+        a = res(100, 500)
+        a.set_max(res(200, 100, accelerator=7))
+        assert (a.get("cpu"), a.get("memory"), a.get("accelerator")) == (200, 500, 7)
+
+    def test_fit_delta_negative_means_insufficient(self):
+        idle = res(1000, 0)
+        idle.fit_delta(res(1000, 0))
+        assert idle.get("cpu") < 0  # exact fit is "insufficient" under FitDelta
+
+    def test_share(self):
+        assert Resource.share(0, 0) == 0
+        assert Resource.share(5, 0) == 1
+        assert Resource.share(1, 4) == 0.25
+
+    def test_dominant_share(self):
+        total = res(10000, 100 * 2**30)
+        alloc = res(1000, 50 * 2**30)
+        assert alloc.dominant_share(total) == 0.5
+
+
+class TestParsing:
+    def test_from_resource_list(self):
+        r = Resource.from_resource_list(
+            {"cpu": "2", "memory": "4Gi", "accelerator": 1, "pods": "110"}
+        )
+        assert r.get("cpu") == 2000
+        assert r.get("memory") == 4 * 2**30
+        assert r.get("accelerator") == 1000  # scalars stored in milli-units
+        assert r.max_task_num == 110
+
+    def test_cpu_millis(self):
+        assert Resource.from_resource_list({"cpu": "250m"}).get("cpu") == 250
+
+    def test_memory_units(self):
+        assert Resource.from_resource_list({"memory": "1G"}).get("memory") == 1e9
+        assert Resource.from_resource_list({"memory": "512Mi"}).get("memory") == 512 * 2**20

@@ -1,0 +1,20 @@
+"""volcano-tpu: a TPU-native batch-scheduling framework.
+
+A ground-up rebuild of the capabilities of Volcano (sivanzcw/volcano):
+gang/co-scheduling of multi-task jobs, weighted fair-share queues (DRF +
+proportion), preemption and cross-queue reclaim, backfill, lifecycle-policy
+driven error handling, admission validation and a CLI — with the scheduler's
+hot task x node inner loops (predicate filtering, node scoring, fair-share
+math, victim selection) implemented as jitted JAX/XLA solves over a
+device-resident tensor snapshot of the cluster.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  api/          object model (Job, PodGroup, Queue, Command, Pod, Node, Resource)
+  store/        in-memory watchable object store (the "API server" bus analog)
+  scheduler/    tensor snapshot, session, actions, plugins, JAX kernels
+  controllers/  job reconciler + state machine + lifecycle policies
+  admission/    validating + mutating webhook logic (pure functions)
+  cli/          vtctl-style command line
+"""
+
+__version__ = "0.1.0"

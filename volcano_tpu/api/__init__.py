@@ -1,0 +1,34 @@
+from volcano_tpu.api.resource import Resource, MIN_MILLI_CPU, MIN_MEMORY, MIN_SCALAR
+from volcano_tpu.api.types import (
+    TaskStatus,
+    JobPhase,
+    JobEvent,
+    JobAction,
+    PodGroupPhase,
+    PodPhase,
+    allocated_status,
+)
+from volcano_tpu.api.job import (
+    Job,
+    JobSpec,
+    JobStatus,
+    TaskSpec,
+    LifecyclePolicy,
+    VolumeSpec,
+    TASK_SPEC_KEY,
+    JOB_NAME_KEY,
+    JOB_VERSION_KEY,
+    POD_GROUP_KEY,
+)
+from volcano_tpu.api.objects import (
+    Command,
+    Node,
+    Pod,
+    PodGroup,
+    PodGroupStatus,
+    Queue,
+    Toleration,
+    Taint,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

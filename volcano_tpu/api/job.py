@@ -1,0 +1,125 @@
+"""The Job object: multi-task gang jobs with lifecycle policies.
+
+Parity source: reference pkg/apis/batch/v1alpha1/job.go:26-274 and
+labels.go:19-25. A Job owns a set of task groups (TaskSpec), each stamping
+out ``replicas`` pods from a template; ``min_available`` is the gang size;
+``policies`` map (event, exit_code) -> action for the error-handling state
+machine; ``plugins`` inject distributed-training plumbing (ssh/svc/env).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from volcano_tpu.api.objects import Metadata, PodSpec
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobAction, JobEvent, JobPhase
+
+# Annotation/label keys linking pods back to jobs
+# (parity: reference pkg/apis/batch/v1alpha1/labels.go:19-25).
+TASK_SPEC_KEY = "volcano.tpu/task-spec"
+JOB_NAME_KEY = "volcano.tpu/job-name"
+JOB_VERSION_KEY = "volcano.tpu/job-version"
+POD_GROUP_KEY = "scheduling.volcano.tpu/group-name"
+
+DEFAULT_MAX_RETRY = 3
+
+
+@dataclass
+class LifecyclePolicy:
+    """(event | exit_code) -> action, with optional timeout.
+
+    Admission enforces event XOR exit_code (admit_job.go policy checks).
+    """
+
+    action: JobAction
+    event: Optional[JobEvent] = None
+    exit_code: Optional[int] = None
+    timeout_seconds: Optional[float] = None
+
+
+@dataclass
+class VolumeSpec:
+    mount_path: str
+    volume_claim_name: str = ""   # existing claim; empty => generated/emptyDir
+    size: str = ""                # claim template shorthand
+
+
+@dataclass
+class TaskSpec:
+    name: str = ""
+    replicas: int = 0
+    template: PodSpec = field(default_factory=PodSpec)
+    policies: List[LifecyclePolicy] = field(default_factory=list)
+
+
+@dataclass
+class JobSpec:
+    scheduler_name: str = "volcano-tpu"
+    min_available: int = 0
+    volumes: List[VolumeSpec] = field(default_factory=list)
+    tasks: List[TaskSpec] = field(default_factory=list)
+    policies: List[LifecyclePolicy] = field(default_factory=list)
+    plugins: Dict[str, List[str]] = field(default_factory=dict)
+    queue: str = ""
+    max_retry: int = DEFAULT_MAX_RETRY
+    priority_class: str = ""
+
+    def total_replicas(self) -> int:
+        return sum(t.replicas for t in self.tasks)
+
+
+@dataclass
+class JobState:
+    phase: JobPhase = JobPhase.PENDING
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class JobStatus:
+    state: JobState = field(default_factory=JobState)
+    pending: int = 0
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    terminating: int = 0
+    min_available: int = 0
+    version: int = 0
+    retry_count: int = 0
+    controlled_resources: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Job:
+    meta: Metadata
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    @property
+    def key(self) -> str:
+        return self.meta.key
+
+    def task(self, name: str) -> Optional[TaskSpec]:
+        for t in self.spec.tasks:
+            if t.name == name:
+                return t
+        return None
+
+
+def calc_pg_min_resources(job: Job) -> Resource:
+    """MinResources for the PodGroup: sum requests of the top-``min_available``
+    tasks ordered by pod priority (parity: job_controller_actions.go:467-496).
+    """
+    res = Resource()
+    tasks = sorted(job.spec.tasks, key=lambda t: -t.template.priority)
+    remaining = job.spec.min_available
+    for t in tasks:
+        take = min(t.replicas, remaining)
+        for _ in range(take):
+            res.add(t.template.resreq())
+        remaining -= take
+        if remaining <= 0:
+            break
+    return res

@@ -1,0 +1,244 @@
+"""Core cluster objects: Pod, Node, PodGroup, Queue, Command.
+
+These are the analogs of the reference's CRD + k8s core types, reduced to
+the fields the scheduler/controller/admission paths actually consume:
+
+  * PodGroup/Queue — reference KB/pkg/apis/scheduling/v1alpha1/types.go:90-222
+  * Command       — reference pkg/apis/bus/v1alpha1/types.go:7-27
+  * Pod/Node      — the subset of k8s core/v1 used by the predicates and cache
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import PodGroupPhase, PodPhase
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid(prefix: str = "obj") -> str:
+    return f"{prefix}-{next(_uid_counter):08d}"
+
+
+@dataclass
+class Metadata:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+    owner: Optional[Tuple[str, str]] = None  # (kind, name) of controlling object
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = new_uid(self.name or "obj")
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists; empty key + Exists tolerates all
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if not self.key:
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+@dataclass
+class Affinity:
+    """Node + pod (anti)affinity, reduced to label-match terms.
+
+    node_terms: OR-of-AND label requirements, each a list of
+    (key, op, values) with op in {In, NotIn, Exists, DoesNotExist, Gt, Lt}.
+    preferred_node_terms: (weight, term) pairs for scoring.
+    pod_affinity/pod_anti_affinity: label selectors matched against other
+    pods on the node (topology = node, the only topology in the simulator).
+    """
+
+    node_terms: List[List[Tuple[str, str, Tuple[str, ...]]]] = field(default_factory=list)
+    preferred_node_terms: List[Tuple[int, List[Tuple[str, str, Tuple[str, ...]]]]] = field(
+        default_factory=list
+    )
+    pod_affinity: List[Dict[str, str]] = field(default_factory=list)
+    pod_anti_affinity: List[Dict[str, str]] = field(default_factory=list)
+
+
+def match_expressions(labels: Dict[str, str], term) -> bool:
+    """Evaluate one AND-term of (key, op, values) against a label map."""
+    for key, op, values in term:
+        v = labels.get(key)
+        if op == "In":
+            if v is None or v not in values:
+                return False
+        elif op == "NotIn":
+            if v is not None and v in values:
+                return False
+        elif op == "Exists":
+            if v is None:
+                return False
+        elif op == "DoesNotExist":
+            if v is not None:
+                return False
+        elif op == "Gt":
+            if v is None or not v.lstrip("-").isdigit() or int(v) <= int(values[0]):
+                return False
+        elif op == "Lt":
+            if v is None or not v.lstrip("-").isdigit() or int(v) >= int(values[0]):
+                return False
+        else:
+            return False
+    return True
+
+
+@dataclass
+class PodSpec:
+    resources: Resource = field(default_factory=Resource)       # sum of containers
+    init_resources: Resource = field(default_factory=Resource)  # max of init containers
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    host_ports: List[int] = field(default_factory=list)
+    priority_class: str = ""
+    priority: int = 0
+    restart_policy: str = "OnFailure"
+    scheduler_name: str = "volcano-tpu"
+    best_effort: bool = False  # derived: empty resreq
+
+    def resreq(self) -> Resource:
+        return self.resources.clone()
+
+    def init_resreq(self) -> Resource:
+        r = self.resources.clone()
+        r.set_max(self.init_resources)
+        return r
+
+
+@dataclass
+class Pod:
+    meta: Metadata
+    spec: PodSpec = field(default_factory=PodSpec)
+    phase: PodPhase = PodPhase.PENDING
+    node_name: str = ""
+    deleting: bool = False
+    exit_code: int = 0          # of first failed container, for policy matching
+    subdomain: str = ""
+    hostname: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    volumes: List[str] = field(default_factory=list)  # mounted claim/config names
+
+    @property
+    def key(self) -> str:
+        return self.meta.key
+
+
+@dataclass
+class NodeCondition:
+    kind: str  # Ready | OutOfDisk | MemoryPressure | DiskPressure | PIDPressure
+    status: str = "True"
+
+
+@dataclass
+class Node:
+    meta: Metadata
+    allocatable: Resource = field(default_factory=Resource)
+    capacity: Resource = field(default_factory=Resource)
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+    conditions: List[NodeCondition] = field(default_factory=lambda: [NodeCondition("Ready")])
+
+    def __post_init__(self):
+        if self.capacity.is_empty() and not self.allocatable.is_empty():
+            self.capacity = self.allocatable.clone()
+        # node name is both metadata and a label (kubernetes.io/hostname)
+        self.labels.setdefault("kubernetes.io/hostname", self.meta.name)
+
+    def ready(self) -> bool:
+        for c in self.conditions:
+            if c.kind == "Ready":
+                return c.status == "True"
+        return False
+
+
+@dataclass
+class PodGroupCondition:
+    kind: str
+    status: str
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class PodGroupStatus:
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+    conditions: List[PodGroupCondition] = field(default_factory=list)
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class PodGroup:
+    meta: Metadata
+    min_member: int = 1
+    queue: str = "default"
+    priority_class_name: str = ""
+    min_resources: Resource = field(default_factory=Resource)
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)
+
+
+@dataclass
+class QueueStatus:
+    unknown: int = 0
+    pending: int = 0
+    running: int = 0
+
+
+@dataclass
+class Queue:
+    meta: Metadata
+    weight: int = 1
+    status: QueueStatus = field(default_factory=QueueStatus)
+
+
+@dataclass
+class PriorityClass:
+    meta: Metadata
+    value: int = 0
+    global_default: bool = False
+
+
+@dataclass
+class Command:
+    """Async operation channel from the CLI to the controller."""
+
+    meta: Metadata
+    action: str = ""
+    target: Optional[Tuple[str, str]] = None  # (kind, name)
+    reason: str = ""
+    message: str = ""

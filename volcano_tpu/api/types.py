@@ -1,0 +1,110 @@
+"""Shared enums: task status, job phases, lifecycle events and actions.
+
+Parity sources:
+  * TaskStatus           — reference KB/pkg/scheduler/api/types.go:20-53
+  * JobPhase             — reference pkg/apis/batch/v1alpha1/job.go:180-214
+  * JobEvent / JobAction — reference pkg/apis/batch/v1alpha1/job.go:92-146
+  * PodGroupPhase        — reference KB/pkg/apis/scheduling/v1alpha1/types.go:27-44
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class TaskStatus(enum.IntFlag):
+    """Scheduler-side view of a task/pod. Bitmask so status sets are cheap."""
+
+    PENDING = 1 << 0      # pending in the store, no node assigned
+    ALLOCATED = 1 << 1    # scheduler assigned a host (session-local)
+    PIPELINED = 1 << 2    # assigned a host, waiting on releasing resources
+    BINDING = 1 << 3      # bind request in flight
+    BOUND = 1 << 4        # bound to a host
+    RUNNING = 1 << 5      # running on the host
+    RELEASING = 1 << 6    # being deleted
+    SUCCEEDED = 1 << 7
+    FAILED = 1 << 8
+    UNKNOWN = 1 << 9
+
+
+#: statuses whose resources are charged against the node (helpers.go:66-73)
+ALLOCATED_STATUSES = (
+    TaskStatus.BOUND | TaskStatus.BINDING | TaskStatus.RUNNING | TaskStatus.ALLOCATED
+)
+
+
+def allocated_status(status: TaskStatus) -> bool:
+    return bool(status & ALLOCATED_STATUSES)
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+def task_status_of_pod(pod) -> TaskStatus:
+    """Map a pod's phase + deletion mark + node assignment to a TaskStatus.
+
+    Parity: reference KB/pkg/scheduler/api/helpers.go:38-63.
+    """
+    phase = pod.phase
+    if phase == PodPhase.RUNNING:
+        return TaskStatus.RELEASING if pod.deleting else TaskStatus.RUNNING
+    if phase == PodPhase.PENDING:
+        if pod.deleting:
+            return TaskStatus.RELEASING
+        return TaskStatus.BOUND if pod.node_name else TaskStatus.PENDING
+    if phase == PodPhase.SUCCEEDED:
+        return TaskStatus.SUCCEEDED
+    if phase == PodPhase.FAILED:
+        return TaskStatus.FAILED
+    return TaskStatus.UNKNOWN
+
+
+class JobPhase(str, enum.Enum):
+    PENDING = "Pending"
+    INQUEUE = "Inqueue"
+    ABORTING = "Aborting"
+    ABORTED = "Aborted"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    COMPLETING = "Completing"
+    COMPLETED = "Completed"
+    TERMINATING = "Terminating"
+    TERMINATED = "Terminated"
+    FAILED = "Failed"
+
+
+class JobEvent(str, enum.Enum):
+    ANY = "*"
+    POD_FAILED = "PodFailed"
+    POD_EVICTED = "PodEvicted"
+    JOB_UNKNOWN = "Unknown"
+    TASK_COMPLETED = "TaskCompleted"
+    OUT_OF_SYNC = "OutOfSync"          # internal: object changed
+    COMMAND_ISSUED = "CommandIssued"   # internal: Command CR received
+
+
+class JobAction(str, enum.Enum):
+    ABORT_JOB = "AbortJob"
+    RESTART_JOB = "RestartJob"
+    RESTART_TASK = "RestartTask"
+    TERMINATE_JOB = "TerminateJob"
+    COMPLETE_JOB = "CompleteJob"
+    RESUME_JOB = "ResumeJob"
+    SYNC_JOB = "SyncJob"
+    ENQUEUE_JOB = "EnqueueJob"
+
+
+class PodGroupPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    UNKNOWN = "Unknown"
+    INQUEUE = "Inqueue"
+
+
+class PodGroupConditionType(str, enum.Enum):
+    UNSCHEDULABLE = "Unschedulable"

@@ -1,0 +1,10 @@
+"""Action registry bootstrap: importing this package registers all built-ins."""
+
+from volcano_tpu.scheduler.framework import register_action
+from volcano_tpu.scheduler.actions import allocate, backfill, enqueue, preempt, reclaim
+
+register_action(enqueue.EnqueueAction())
+register_action(allocate.AllocateAction())
+register_action(backfill.BackfillAction())
+register_action(preempt.PreemptAction())
+register_action(reclaim.ReclaimAction())

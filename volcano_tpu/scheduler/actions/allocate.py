@@ -1,0 +1,115 @@
+"""Allocate action: the per-cycle hot loop assigning pending tasks to nodes.
+
+Parity: reference KB/pkg/scheduler/actions/allocate/allocate.go:44-193.
+Loop shape (faithfully reproduced):
+  * queues in a priority queue by QueueOrderFn; each outer iteration pops the
+    best queue, skips it if Overused, and processes ONE job from it;
+  * a job's pending non-BestEffort tasks drain in TaskOrderFn order until the
+    head task has no feasible node (drop job this cycle) or the job becomes
+    JobReady (push it back so remaining tasks continue next pop);
+  * per task: resource-fit + plugin predicates filter nodes, NodeOrderFn
+    scores them, the best node takes the task — Allocate on idle fit,
+    Pipeline on releasing fit;
+  * the queue is pushed back every iteration.
+
+When the session carries a tensor backend ("backend: tpu"), the entire loop
+above is computed by a jitted JAX solve over the device-resident snapshot
+(scheduler/kernels.py) and the resulting decisions are replayed through the
+same Session.allocate/pipeline seams, preserving all side effects.
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.scheduler import util
+from volcano_tpu.scheduler.framework import Action
+from volcano_tpu.scheduler.pqueue import PriorityQueue
+from volcano_tpu.scheduler.session import Session
+
+
+class AllocateAction(Action):
+    name = "allocate"
+
+    def execute(self, ssn: Session) -> None:
+        if getattr(ssn, "tensor_backend", None) is not None:
+            from volcano_tpu.scheduler import tensor_actions
+
+            tensor_actions.allocate(ssn)
+            return
+        self._execute_host(ssn)
+
+    def _execute_host(self, ssn: Session) -> None:
+        queues = PriorityQueue(ssn.queue_order_fn)
+        jobs_map = {}
+
+        for job in ssn.jobs.values():
+            if (
+                job.pod_group is not None
+                and job.pod_group.status.phase == PodGroupPhase.PENDING
+            ):
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            queues.push(queue)
+            if job.queue not in jobs_map:
+                jobs_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+            jobs_map[job.queue].push(job)
+
+        pending_tasks = {}
+        all_nodes = util.get_node_list(ssn.nodes)
+
+        def predicate_fn(task, node):
+            # resource fit first (allocate.go:78-93): idle OR releasing
+            if not (
+                task.init_resreq.less_equal(node.idle)
+                or task.init_resreq.less_equal(node.releasing)
+            ):
+                return f"task {task.key} resource fit failed on {node.name}"
+            return ssn.predicate_fn(task, node)
+
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                continue
+            jobs = jobs_map.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+
+            job = jobs.pop()
+            if job.uid not in pending_tasks:
+                tasks = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+                    if task.resreq.is_empty():
+                        continue  # BestEffort handled by backfill
+                    tasks.push(task)
+                pending_tasks[job.uid] = tasks
+            tasks = pending_tasks[job.uid]
+
+            while not tasks.empty():
+                task = tasks.pop()
+
+                if job.nodes_fit_delta:
+                    job.nodes_fit_delta = {}
+
+                feasible = util.predicate_nodes(task, all_nodes, predicate_fn)
+                if not feasible:
+                    break
+
+                scores = util.prioritize_nodes(task, feasible, ssn.node_order_fn)
+                node = util.select_best_node(scores)
+
+                if task.init_resreq.less_equal(node.idle):
+                    ssn.allocate(task, node.name)
+                else:
+                    delta = node.idle.clone()
+                    delta.fit_delta(task.init_resreq)
+                    job.nodes_fit_delta[node.name] = delta
+                    if task.init_resreq.less_equal(node.releasing):
+                        ssn.pipeline(task, node.name)
+
+                if ssn.job_ready(job):
+                    jobs.push(job)
+                    break
+
+            queues.push(queue)

@@ -1,0 +1,33 @@
+"""Backfill action: place BestEffort (empty-request) tasks on any node
+passing predicates — no scoring.
+
+Parity: reference KB/pkg/scheduler/actions/backfill/backfill.go:41-78.
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.scheduler.framework import Action
+from volcano_tpu.scheduler.session import Session
+
+
+class BackfillAction(Action):
+    name = "backfill"
+
+    def execute(self, ssn: Session) -> None:
+        for job in list(ssn.jobs.values()):
+            if (
+                job.pod_group is not None
+                and job.pod_group.status.phase == PodGroupPhase.PENDING
+            ):
+                continue
+            for task in list(
+                job.task_status_index.get(TaskStatus.PENDING, {}).values()
+            ):
+                if not task.init_resreq.is_empty():
+                    continue
+                for node in ssn.nodes.values():
+                    if ssn.predicate_fn(task, node) is not None:
+                        continue
+                    ssn.allocate(task, node.name)
+                    break

@@ -1,0 +1,176 @@
+"""Scheduler cache: store-fed cluster mirror with pluggable side-effect seams.
+
+Parity sources:
+  * Cache interface + default Binder/Evictor/StatusUpdater —
+    reference KB/pkg/scheduler/cache/{interface.go:30-89,cache.go:112-185}
+  * Snapshot deep clone — cache.go:537-589
+  * shadow PodGroups for plain pods — cache/util.go:36-60
+
+The Binder/Evictor/StatusUpdater seams are the hermetic-test boundary: unit
+tests swap in fakes that record decisions instead of writing the store
+(reference KB/pkg/scheduler/util/test_utils.go pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from volcano_tpu.api.job import POD_GROUP_KEY
+from volcano_tpu.api.objects import Pod, PodGroup, Metadata
+from volcano_tpu.api.types import PodGroupPhase
+from volcano_tpu.scheduler.model import ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo
+from volcano_tpu.store import Store
+
+
+class Binder:
+    """Default binder: writes the placement to the store ("API server")."""
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        pod = self.store.get("Pod", task.key)
+        if pod is None:
+            raise KeyError(f"pod {task.key} vanished before bind")
+        pod.node_name = hostname
+        self.store.update("Pod", pod)
+
+
+class Evictor:
+    """Default evictor: marks the pod for deletion (the sim kubelet reaps it)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        pod = self.store.get("Pod", task.key)
+        if pod is None:
+            return
+        pod.deleting = True
+        self.store.update("Pod", pod)
+
+
+class StatusUpdater:
+    def __init__(self, store: Store):
+        self.store = store
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        if self.store.get("PodGroup", pg.meta.key) is not None:
+            self.store.update("PodGroup", pg)
+
+
+class SchedulerCache:
+    def __init__(
+        self,
+        store: Store,
+        scheduler_name: str = "volcano-tpu",
+        default_queue: str = "default",
+    ):
+        self.store = store
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+        self.binder = Binder(store)
+        self.evictor = Evictor(store)
+        self.status_updater = StatusUpdater(store)
+        # (task_key, hostname) bind log and (task_key, reason) evict log for
+        # observability/tests; cleared by callers.
+        self.bind_log: List[Tuple[str, str]] = []
+        self.evict_log: List[Tuple[str, str]] = []
+
+    # -- snapshot ------------------------------------------------------------
+
+    def snapshot(self) -> ClusterInfo:
+        cluster = ClusterInfo()
+
+        for queue in self.store.items("Queue"):
+            qi = QueueInfo(queue)
+            cluster.queues[qi.uid] = qi
+
+        for node in self.store.items("Node"):
+            cluster.nodes[node.meta.name] = NodeInfo(node)
+
+        # priority classes (cache.go:569-579)
+        default_priority = 0
+        priority_classes: Dict[str, int] = {}
+        for pc in self.store.items("PriorityClass"):
+            priority_classes[pc.meta.name] = pc.value
+            if pc.global_default:
+                default_priority = pc.value
+
+        # JobInfo per PodGroup; jobs whose queue is missing are dropped from
+        # the snapshot (cache.go:563-567)
+        order = 0
+        pg_by_key: Dict[str, str] = {}
+        dropped_pg_uids = set()
+        for pg in sorted(self.store.items("PodGroup"), key=lambda p: p.meta.resource_version):
+            pg_by_key[pg.meta.key] = pg.meta.uid
+            ji = JobInfo(pg.meta.uid, pg)
+            ji.creation_order = order
+            order += 1
+            if not pg.queue:
+                ji.queue = self.default_queue
+            if ji.queue not in cluster.queues:
+                dropped_pg_uids.add(pg.meta.uid)
+                continue
+            ji.priority = priority_classes.get(
+                pg.priority_class_name, default_priority
+            )
+            cluster.jobs[ji.uid] = ji
+
+        for pod in self.store.items("Pod"):
+            if pod.spec.scheduler_name != self.scheduler_name:
+                continue
+            task = TaskInfo(pod)
+            if task.priority == 0 and task.priority_class:
+                task.priority = priority_classes.get(task.priority_class, default_priority)
+            job_uid = self._job_uid_for(pod, pg_by_key)
+            if job_uid in dropped_pg_uids:
+                continue  # its PodGroup's queue is missing; job left unscheduled
+            if job_uid not in cluster.jobs:
+                # shadow PodGroup for plain pods (cache/util.go:36-60;
+                # MinMember=1 per createShadowPodGroup)
+                shadow = JobInfo(job_uid, None)
+                shadow.namespace = pod.meta.namespace
+                shadow.name = job_uid
+                shadow.queue = self.default_queue
+                shadow.min_available = 1
+                shadow.creation_order = order
+                order += 1
+                cluster.jobs[job_uid] = shadow
+            cluster.jobs[job_uid].add_task(task)
+            if pod.node_name and pod.node_name in cluster.nodes:
+                cluster.nodes[pod.node_name].add_task(task)
+
+        return cluster
+
+    def _job_uid_for(self, pod: Pod, pg_by_key: Dict[str, str]) -> str:
+        group = pod.meta.annotations.get(POD_GROUP_KEY, "")
+        if group:
+            key = f"{pod.meta.namespace}/{group}"
+            if key in pg_by_key:
+                return pg_by_key[key]
+            return f"shadow/{key}"
+        owner = pod.meta.owner
+        if owner:
+            return f"shadow/{pod.meta.namespace}/{owner[1]}"
+        return f"shadow/{pod.meta.namespace}/{pod.meta.name}"
+
+    # -- side effects --------------------------------------------------------
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        self.bind_log.append((task.key, hostname))
+        self.binder.bind(task, hostname)
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        self.evict_log.append((task.key, reason))
+        self.evictor.evict(task, reason)
+
+    def update_job_status(self, job: JobInfo) -> None:
+        if job.pod_group is not None:
+            self.status_updater.update_pod_group(job.pod_group)
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        pass  # volume binding is a no-op in the simulator
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        pass

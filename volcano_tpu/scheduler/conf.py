@@ -1,0 +1,108 @@
+"""Scheduler configuration: actions string + tiered plugin options.
+
+YAML format is compatible with the reference scheduler-conf
+(KB/pkg/scheduler/conf/scheduler_conf.go:20-56, defaults util.go:31-41),
+with one extension: a top-level ``backend: tpu | host`` selecting whether
+action inner loops run as JAX solves or as the object-based host path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+#: All per-callback enable flags default to true (reference plugins/defaults.go).
+_FLAG_NAMES = (
+    "enabled_job_order", "enabled_job_ready", "enabled_job_pipelined",
+    "enabled_task_order", "enabled_preemptable", "enabled_reclaimable",
+    "enabled_queue_order", "enabled_predicate", "enabled_node_order",
+)
+
+
+@dataclass
+class PluginOption:
+    name: str
+    arguments: Dict[str, str] = field(default_factory=dict)
+    enabled_job_order: bool = True
+    enabled_job_ready: bool = True
+    enabled_job_pipelined: bool = True
+    enabled_task_order: bool = True
+    enabled_preemptable: bool = True
+    enabled_reclaimable: bool = True
+    enabled_queue_order: bool = True
+    enabled_predicate: bool = True
+    enabled_node_order: bool = True
+
+
+@dataclass
+class Tier:
+    plugins: List[PluginOption] = field(default_factory=list)
+
+
+@dataclass
+class SchedulerConf:
+    actions: List[str] = field(default_factory=lambda: ["allocate", "backfill"])
+    tiers: List[Tier] = field(default_factory=list)
+    backend: str = "host"  # "tpu" (tensor kernels) | "host" (object oracle path)
+    schedule_period: float = 1.0
+
+
+def default_conf(backend: str = "host") -> SchedulerConf:
+    """Parity with defaultSchedulerConf (KB/pkg/scheduler/util.go:31-41)."""
+    return SchedulerConf(
+        actions=["allocate", "backfill"],
+        tiers=[
+            Tier(plugins=[PluginOption("priority"), PluginOption("gang")]),
+            Tier(
+                plugins=[
+                    PluginOption("drf"),
+                    PluginOption("predicates"),
+                    PluginOption("proportion"),
+                    PluginOption("nodeorder"),
+                ]
+            ),
+        ],
+        backend=backend,
+    )
+
+
+def load_conf(text: str) -> SchedulerConf:
+    """Parse a scheduler-conf YAML string (same shape as the reference's)."""
+    import yaml
+
+    data = yaml.safe_load(text) or {}
+    conf = SchedulerConf()
+    actions = data.get("actions")
+    if actions:
+        conf.actions = [a.strip() for a in str(actions).split(",") if a.strip()]
+    tiers = []
+    for tier_data in data.get("tiers") or []:
+        tier = Tier()
+        for p in tier_data.get("plugins") or []:
+            opt = PluginOption(name=p["name"])
+            opt.arguments = {str(k): str(v) for k, v in (p.get("arguments") or {}).items()}
+            for flag in _FLAG_NAMES:
+                yaml_key = flag.replace("enabled_", "")
+                camel = "enable" + "".join(w.capitalize() for w in yaml_key.split("_"))
+                if camel in p:
+                    setattr(opt, flag, bool(p[camel]))
+            tier.plugins.append(opt)
+        tiers.append(tier)
+    if tiers:
+        conf.tiers = tiers
+    else:
+        conf.tiers = default_conf().tiers
+    conf.backend = str(data.get("backend", conf.backend))
+    if "schedulePeriod" in data:
+        conf.schedule_period = float(data["schedulePeriod"])
+    return conf
+
+
+def get_plugin_arg(args: Dict[str, str], key: str, default: Optional[float] = None) -> Optional[float]:
+    """Numeric plugin argument lookup (reference framework/arguments.go:28-46)."""
+    if key in args:
+        try:
+            return float(args[key])
+        except ValueError:
+            return default
+    return default

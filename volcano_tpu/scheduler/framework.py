@@ -1,0 +1,141 @@
+"""Plugin/action registries + session lifecycle.
+
+Parity sources:
+  * registries      — reference KB/pkg/scheduler/framework/plugins.go:30-72
+  * Open/CloseSession, jobStatus — reference framework.go:29-63, session.go:63-190
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from volcano_tpu.api.objects import PodGroupCondition
+from volcano_tpu.api.types import (
+    PodGroupPhase,
+    TaskStatus,
+    allocated_status,
+)
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.conf import PluginOption, Tier
+from volcano_tpu.scheduler.session import Session
+
+_action_registry: Dict[str, object] = {}
+_plugin_builders: Dict[str, Callable[[Dict[str, str]], object]] = {}
+
+
+class Action:
+    """One scheduling pass per cycle (enqueue/allocate/backfill/preempt/reclaim)."""
+
+    name = "action"
+
+    def execute(self, ssn: Session) -> None:
+        raise NotImplementedError
+
+
+class Plugin:
+    """A policy: registers callbacks into the Session at open time."""
+
+    name = "plugin"
+
+    def __init__(self, arguments: Optional[Dict[str, str]] = None):
+        self.arguments = arguments or {}
+
+    def on_session_open(self, ssn: Session) -> None:
+        raise NotImplementedError
+
+    def on_session_close(self, ssn: Session) -> None:
+        pass
+
+
+def register_action(action: Action) -> None:
+    _action_registry[action.name] = action
+
+
+def get_action(name: str) -> Optional[Action]:
+    return _action_registry.get(name)
+
+
+def register_plugin_builder(name: str, builder) -> None:
+    _plugin_builders[name] = builder
+
+
+def get_plugin_builder(name: str):
+    return _plugin_builders.get(name)
+
+
+def open_session(cache, tiers: List[Tier]) -> Session:
+    """Snapshot the cluster, gate invalid jobs, run plugin OnSessionOpen."""
+    cluster = cache.snapshot()
+    ssn = Session(cache, tiers, cluster)
+
+    for tier in tiers:
+        for opt in tier.plugins:
+            builder = get_plugin_builder(opt.name)
+            if builder is None:
+                continue
+            if opt.name not in ssn.plugins:
+                ssn.plugins[opt.name] = builder(opt.arguments)
+
+    for plugin in ssn.plugins.values():
+        start = time.perf_counter()
+        plugin.on_session_open(ssn)
+        metrics.update_plugin_duration(plugin.name, "OnSessionOpen", start)
+
+    # JobValid gate (session.go:89-108): invalid jobs get an Unschedulable
+    # condition written and are dropped from the session.
+    for uid, job in list(ssn.jobs.items()):
+        vr = ssn.job_valid(job)
+        if vr is not None and not vr.passed:
+            if job.pod_group is not None:
+                cond = PodGroupCondition(
+                    kind="Unschedulable",
+                    status="True",
+                    reason=vr.reason,
+                    message=vr.message,
+                )
+                job.pod_group.status.conditions = [
+                    c for c in job.pod_group.status.conditions if c.kind != "Unschedulable"
+                ] + [cond]
+                cache.update_job_status(job)
+            del ssn.jobs[uid]
+
+    return ssn
+
+
+def close_session(ssn: Session) -> None:
+    for plugin in ssn.plugins.values():
+        start = time.perf_counter()
+        plugin.on_session_close(ssn)
+        metrics.update_plugin_duration(plugin.name, "OnSessionClose", start)
+
+    for job in ssn.jobs.values():
+        if job.pod_group is None:
+            continue
+        _update_pod_group_status(ssn, job)
+        ssn.cache.update_job_status(job)
+
+
+def _update_pod_group_status(ssn: Session, job) -> None:
+    """Parity with jobStatus (session.go:146-190), including the strict
+    ``allocated > min_member`` comparison for the Running phase."""
+    pg = job.pod_group
+    unschedulable = any(
+        c.kind == "Unschedulable" and c.status == "True" for c in pg.status.conditions
+    )
+    running = len(job.task_status_index.get(TaskStatus.RUNNING, {}))
+    if running and unschedulable:
+        pg.status.phase = PodGroupPhase.UNKNOWN
+    else:
+        allocated = sum(
+            len(tasks)
+            for status, tasks in job.task_status_index.items()
+            if allocated_status(status)
+        )
+        if allocated > pg.min_member:
+            pg.status.phase = PodGroupPhase.RUNNING
+        elif pg.status.phase != PodGroupPhase.INQUEUE:
+            pg.status.phase = PodGroupPhase.PENDING
+    pg.status.running = running
+    pg.status.failed = len(job.task_status_index.get(TaskStatus.FAILED, {}))
+    pg.status.succeeded = len(job.task_status_index.get(TaskStatus.SUCCEEDED, {}))

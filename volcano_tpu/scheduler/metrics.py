@@ -1,0 +1,118 @@
+"""Scheduler metrics with reference-compatible names.
+
+Collector names/semantics mirror KB/pkg/scheduler/metrics/metrics.go:38-121
+(namespace ``volcano``). Backed by simple in-process counters/histograms with
+a Prometheus-text exposition, so tests and operators can scrape the same
+series names the reference exports.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+_histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List[float]] = defaultdict(list)
+_counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
+_gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
+
+
+def _key(name: str, labels: Dict[str, str]):
+    return (name, tuple(sorted(labels.items())))
+
+
+def observe(name: str, value: float, **labels) -> None:
+    _histograms[_key(name, labels)].append(value)
+
+
+def inc(name: str, value: float = 1.0, **labels) -> None:
+    _counters[_key(name, labels)] += value
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    _gauges[_key(name, labels)] = value
+
+
+def reset() -> None:
+    _histograms.clear()
+    _counters.clear()
+    _gauges.clear()
+
+
+# -- recording helpers mirroring the reference call sites --------------------
+
+def update_e2e_duration(start: float) -> None:
+    observe("volcano_e2e_scheduling_latency_milliseconds", (time.perf_counter() - start) * 1e3)
+
+
+def update_action_duration(action: str, start: float) -> None:
+    observe(
+        "volcano_action_scheduling_latency_microseconds",
+        (time.perf_counter() - start) * 1e6,
+        action=action,
+    )
+
+
+def update_plugin_duration(plugin: str, on_session: str, start: float) -> None:
+    observe(
+        "volcano_plugin_scheduling_latency_microseconds",
+        (time.perf_counter() - start) * 1e6,
+        plugin=plugin,
+        OnSession=on_session,
+    )
+
+
+def update_task_schedule_duration(duration_s: float) -> None:
+    observe("volcano_task_scheduling_latency_microseconds", duration_s * 1e6)
+
+
+def register_schedule_attempt(succeeded: bool) -> None:
+    inc("volcano_schedule_attempts_total", result="scheduled" if succeeded else "unschedulable")
+
+
+def register_preemption_attempt() -> None:
+    inc("volcano_total_preemption_attempts")
+
+
+def update_preemption_victims(count: int) -> None:
+    set_gauge("volcano_pod_preemption_victims", count)
+
+
+def update_unschedule_task_count(job: str, count: int) -> None:
+    set_gauge("volcano_unschedule_task_count", count, job_id=job)
+
+
+def update_unschedule_job_count(count: int) -> None:
+    set_gauge("volcano_unschedule_job_count", count)
+
+
+def register_job_retry(job: str) -> None:
+    inc("volcano_job_retry_counts", job_id=job)
+
+
+def expose_text() -> str:
+    """Prometheus text exposition of all recorded series."""
+    lines = []
+    for (name, labels), value in sorted(_counters.items()):
+        lines.append(f"{name}{_fmt(labels)} {value}")
+    for (name, labels), value in sorted(_gauges.items()):
+        lines.append(f"{name}{_fmt(labels)} {value}")
+    for (name, labels), values in sorted(_histograms.items()):
+        lines.append(f"{name}_count{_fmt(labels)} {len(values)}")
+        lines.append(f"{name}_sum{_fmt(labels)} {sum(values)}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt(labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def get_histogram(name: str, **labels) -> List[float]:
+    return _histograms.get(_key(name, labels), [])
+
+
+def get_counter(name: str, **labels) -> float:
+    return _counters.get(_key(name, labels), 0.0)

@@ -1,0 +1,238 @@
+"""Scheduler data model: TaskInfo / JobInfo / NodeInfo / QueueInfo / ClusterInfo.
+
+This is the host-side object view of a cluster snapshot. It exists for two
+reasons: (1) the control plane (cache, session bookkeeping, event handlers)
+operates on objects; (2) it is the *oracle* the tensor snapshot is built
+from and validated against.
+
+Parity sources (behavior, not code):
+  * TaskInfo        — reference KB/pkg/scheduler/api/pod_info.go:30-73
+  * JobInfo         — reference KB/pkg/scheduler/api/job_info.go:127-426
+  * NodeInfo        — reference KB/pkg/scheduler/api/node_info.go:26-195
+  * Queue/Cluster   — reference KB/pkg/scheduler/api/{queue_info,cluster_info}.go
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from volcano_tpu.api.objects import Node, Pod, PodGroup, Queue
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import TaskStatus, allocated_status, task_status_of_pod
+
+
+class TaskInfo:
+    __slots__ = (
+        "uid", "job_uid", "name", "namespace", "resreq", "init_resreq",
+        "node_name", "status", "priority", "best_effort", "pod", "task_spec",
+        "priority_class",
+    )
+
+    def __init__(self, pod: Pod, job_uid: str = ""):
+        from volcano_tpu.api.job import TASK_SPEC_KEY
+
+        self.uid = pod.meta.uid
+        self.job_uid = job_uid
+        self.name = pod.meta.name
+        self.namespace = pod.meta.namespace
+        self.resreq = pod.spec.resreq()
+        self.init_resreq = pod.spec.init_resreq()
+        self.node_name = pod.node_name
+        self.status = task_status_of_pod(pod)
+        self.priority = pod.spec.priority
+        self.priority_class = pod.spec.priority_class
+        self.best_effort = self.resreq.is_empty()
+        self.pod = pod
+        self.task_spec = pod.meta.annotations.get(TASK_SPEC_KEY, "")
+
+    def clone(self) -> "TaskInfo":
+        t = TaskInfo.__new__(TaskInfo)
+        for s in TaskInfo.__slots__:
+            v = getattr(self, s)
+            setattr(t, s, v.clone() if isinstance(v, Resource) else v)
+        return t
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def __repr__(self):
+        return (
+            f"Task({self.key} job={self.job_uid} status={self.status.name} "
+            f"node={self.node_name or '-'} req={self.resreq})"
+        )
+
+
+class JobInfo:
+    """A PodGroup + its member tasks, with per-status indexing."""
+
+    def __init__(self, uid: str, pod_group: Optional[PodGroup] = None):
+        self.uid = uid
+        self.pod_group = pod_group
+        self.name = pod_group.meta.name if pod_group else uid
+        self.namespace = pod_group.meta.namespace if pod_group else "default"
+        self.queue = pod_group.queue if pod_group else "default"
+        self.min_available = pod_group.min_member if pod_group else 0
+        self.priority = 0
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        self.total_request = Resource()
+        self.allocated = Resource()
+        self.nodes_fit_delta: Dict[str, Resource] = {}
+        self.fit_errors: List[str] = []
+        self.creation_order = 0
+
+    # -- membership ---------------------------------------------------------
+
+    def add_task(self, task: TaskInfo) -> None:
+        task.job_uid = self.uid
+        self.tasks[task.uid] = task
+        self.task_status_index.setdefault(task.status, {})[task.uid] = task
+        self.total_request.add(task.resreq)
+        if allocated_status(task.status):
+            self.allocated.add(task.resreq)
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        idx = self.task_status_index.get(task.status)
+        if idx and task.uid in idx:
+            del idx[task.uid]
+            if not idx:
+                del self.task_status_index[task.status]
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        task.status = status
+        # victims arrive as clones (preempt/reclaim); keep the canonical
+        # task map pointing at the object whose status we just set
+        self.tasks[task.uid] = task
+        self.task_status_index.setdefault(status, {})[task.uid] = task
+        if allocated_status(status):
+            self.allocated.add(task.resreq)
+
+    def tasks_with_status(self, *statuses: TaskStatus) -> List[TaskInfo]:
+        out: List[TaskInfo] = []
+        for s in statuses:
+            out.extend(self.task_status_index.get(s, {}).values())
+        return out
+
+    # -- gang readiness (job_info.go:375-426) -------------------------------
+
+    def ready_task_num(self) -> int:
+        return sum(
+            len(tasks)
+            for status, tasks in self.task_status_index.items()
+            if allocated_status(status) or status == TaskStatus.SUCCEEDED
+        )
+
+    def waiting_task_num(self) -> int:
+        return len(self.task_status_index.get(TaskStatus.PIPELINED, {}))
+
+    def valid_task_num(self) -> int:
+        return sum(
+            len(tasks)
+            for status, tasks in self.task_status_index.items()
+            if allocated_status(status)
+            or status
+            in (TaskStatus.SUCCEEDED, TaskStatus.PIPELINED, TaskStatus.PENDING)
+        )
+
+    def ready(self) -> bool:
+        return self.ready_task_num() >= self.min_available
+
+    def pipelined(self) -> bool:
+        return self.ready_task_num() + self.waiting_task_num() >= self.min_available
+
+    def clone(self) -> "JobInfo":
+        j = JobInfo(self.uid, self.pod_group)
+        j.queue, j.min_available, j.priority = self.queue, self.min_available, self.priority
+        j.name, j.namespace = self.name, self.namespace
+        j.creation_order = self.creation_order
+        for t in self.tasks.values():
+            j.add_task(t.clone())
+        return j
+
+    def __repr__(self):
+        return (
+            f"Job({self.namespace}/{self.name} queue={self.queue} "
+            f"min={self.min_available} tasks={len(self.tasks)})"
+        )
+
+
+class NodeInfo:
+    """Node + resource invariants: Idle/Used/Releasing vs Allocatable.
+
+    Invariant (node_info.go): for every resident task,
+      Releasing task: charged to Releasing, removed from Idle;
+      Pipelined task: *refunds* Releasing (it will consume freed space);
+      otherwise: removed from Idle.  Used accumulates all residents.
+    """
+
+    def __init__(self, node: Node):
+        self.node = node
+        self.name = node.meta.name
+        self.allocatable = node.allocatable.clone()
+        self.capability = node.capacity.clone()
+        self.idle = node.allocatable.clone()
+        self.used = Resource()
+        self.releasing = Resource()
+        self.tasks: Dict[str, TaskInfo] = {}
+
+    def add_task(self, task: TaskInfo) -> None:
+        if task.uid in self.tasks:
+            raise ValueError(f"task {task.key} already on node {self.name}")
+        t = task.clone()
+        if t.status == TaskStatus.RELEASING:
+            self.releasing.add(t.resreq)
+            self.idle.sub(t.resreq)
+        elif t.status == TaskStatus.PIPELINED:
+            self.releasing.sub(t.resreq)
+        else:
+            self.idle.sub(t.resreq)
+        self.used.add(t.resreq)
+        self.tasks[t.uid] = t
+
+    def remove_task(self, task: TaskInfo) -> None:
+        t = self.tasks.pop(task.uid, None)
+        if t is None:
+            raise ValueError(f"task {task.key} not on node {self.name}")
+        if t.status == TaskStatus.RELEASING:
+            self.releasing.sub(t.resreq)
+            self.idle.add(t.resreq)
+        elif t.status == TaskStatus.PIPELINED:
+            self.releasing.add(t.resreq)
+        else:
+            self.idle.add(t.resreq)
+        self.used.sub(t.resreq)
+
+    def update_task(self, task: TaskInfo) -> None:
+        self.remove_task(task)
+        self.add_task(task)
+
+    def clone(self) -> "NodeInfo":
+        n = NodeInfo(self.node)
+        for t in self.tasks.values():
+            n.add_task(t)
+        return n
+
+    def __repr__(self):
+        return f"Node({self.name} idle={self.idle} used={self.used})"
+
+
+class QueueInfo:
+    def __init__(self, queue: Queue):
+        self.uid = queue.meta.name
+        self.name = queue.meta.name
+        self.weight = queue.weight
+        self.queue = queue
+
+    def clone(self) -> "QueueInfo":
+        return QueueInfo(self.queue)
+
+
+@dataclass
+class ClusterInfo:
+    """One scheduling cycle's immutable view of the world."""
+
+    jobs: Dict[str, JobInfo] = field(default_factory=dict)
+    nodes: Dict[str, NodeInfo] = field(default_factory=dict)
+    queues: Dict[str, QueueInfo] = field(default_factory=dict)

@@ -1,0 +1,21 @@
+"""Plugin registry bootstrap: importing this package registers all built-ins
+(parity: reference KB/pkg/scheduler/plugins/factory.go:31-42)."""
+
+from volcano_tpu.scheduler.framework import register_plugin_builder
+from volcano_tpu.scheduler.plugins import (
+    conformance,
+    drf,
+    gang,
+    nodeorder,
+    predicates,
+    priority,
+    proportion,
+)
+
+register_plugin_builder("gang", gang.GangPlugin)
+register_plugin_builder("priority", priority.PriorityPlugin)
+register_plugin_builder("drf", drf.DRFPlugin)
+register_plugin_builder("proportion", proportion.ProportionPlugin)
+register_plugin_builder("predicates", predicates.PredicatesPlugin)
+register_plugin_builder("nodeorder", nodeorder.NodeOrderPlugin)
+register_plugin_builder("conformance", conformance.ConformancePlugin)

@@ -1,0 +1,106 @@
+"""Predicates plugin: node filtering checks.
+
+Parity: reference KB/pkg/scheduler/plugins/predicates/predicates.go:57-205,
+which chains the upstream k8s predicates. Checks, in order:
+max task num, node condition, node unschedulable, node selector + required
+node affinity, host ports, taints/tolerations, memory/disk/pid pressure,
+pod (anti)affinity against pods resident on the node.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from volcano_tpu.api.objects import match_expressions
+from volcano_tpu.scheduler.framework import Plugin
+from volcano_tpu.scheduler.model import NodeInfo, TaskInfo
+from volcano_tpu.scheduler.session import Session
+
+
+def node_selector_fits(task: TaskInfo, node: NodeInfo) -> bool:
+    """PodMatchNodeSelector: node_selector labels AND required node affinity."""
+    spec = task.pod.spec
+    labels = node.node.labels
+    for k, v in spec.node_selector.items():
+        if labels.get(k) != v:
+            return False
+    aff = spec.affinity
+    if aff and aff.node_terms:
+        # OR across terms, AND within a term
+        if not any(match_expressions(labels, term) for term in aff.node_terms):
+            return False
+    return True
+
+
+def taints_tolerated(task: TaskInfo, node: NodeInfo) -> bool:
+    """PodToleratesNodeTaints: NoSchedule/NoExecute taints must be tolerated."""
+    tolerations = task.pod.spec.tolerations
+    for taint in node.node.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            return False
+    return True
+
+
+def host_ports_free(task: TaskInfo, node: NodeInfo) -> bool:
+    wanted = set(task.pod.spec.host_ports)
+    if not wanted:
+        return True
+    for resident in node.tasks.values():
+        if wanted.intersection(resident.pod.spec.host_ports):
+            return False
+    return True
+
+
+def _match_selector(labels, selector) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def pod_affinity_fits(task: TaskInfo, node: NodeInfo) -> bool:
+    """Required pod (anti)affinity with node-level topology."""
+    aff = task.pod.spec.affinity
+    if aff is None:
+        return True
+    resident = [t.pod for t in node.tasks.values()]
+    for selector in aff.pod_affinity:
+        if not any(_match_selector(p.meta.labels, selector) for p in resident):
+            return False
+    for selector in aff.pod_anti_affinity:
+        if any(_match_selector(p.meta.labels, selector) for p in resident):
+            return False
+        # self-anti-affinity: a pod that anti-matches itself conflicts with
+        # like-labeled pods already placed (standard k8s semantics)
+    return True
+
+
+PRESSURE_CONDITIONS = ("MemoryPressure", "DiskPressure", "PIDPressure")
+
+
+class PredicatesPlugin(Plugin):
+    name = "predicates"
+
+    def on_session_open(self, ssn: Session) -> None:
+        def predicate_fn(task: TaskInfo, node: NodeInfo) -> Optional[str]:
+            n = node.node
+            max_tasks = node.allocatable.max_task_num
+            if max_tasks is not None and len(node.tasks) + 1 > max_tasks:
+                return f"node {node.name} task number exceeded"
+            if not n.ready():
+                return f"node {node.name} not ready"
+            if n.unschedulable:
+                return f"node {node.name} unschedulable"
+            if not node_selector_fits(task, node):
+                return f"node(s) didn't match node selector on {node.name}"
+            if not host_ports_free(task, node):
+                return f"host port conflict on {node.name}"
+            if not taints_tolerated(task, node):
+                return f"taints not tolerated on {node.name}"
+            for cond in n.conditions:
+                if cond.kind in PRESSURE_CONDITIONS and cond.status == "True":
+                    return f"node {node.name} under {cond.kind}"
+            if not pod_affinity_fits(task, node):
+                return f"pod affinity/anti-affinity mismatch on {node.name}"
+            return None
+
+        ssn.add_predicate_fn(self.name, predicate_fn)

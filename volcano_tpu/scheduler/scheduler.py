@@ -1,0 +1,58 @@
+"""The scheduler: periodic cycle of snapshot -> open session -> actions -> close.
+
+Parity: reference KB/pkg/scheduler/scheduler.go:63-102 (runOnce) and
+cmd/kube-batch/app/server.go (loop @ schedule-period).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import volcano_tpu.scheduler.actions  # noqa: F401  (registers actions)
+import volcano_tpu.scheduler.plugins  # noqa: F401  (registers plugins)
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.cache import SchedulerCache
+from volcano_tpu.scheduler.conf import SchedulerConf, default_conf, load_conf
+from volcano_tpu.scheduler.framework import close_session, get_action, open_session
+from volcano_tpu.store import Store
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store: Store,
+        conf: Optional[SchedulerConf] = None,
+        scheduler_name: str = "volcano-tpu",
+        default_queue: str = "default",
+    ):
+        self.conf = conf or default_conf()
+        self.cache = SchedulerCache(
+            store, scheduler_name=scheduler_name, default_queue=default_queue
+        )
+
+    @classmethod
+    def from_conf_yaml(cls, store: Store, text: str, **kw) -> "Scheduler":
+        return cls(store, conf=load_conf(text), **kw)
+
+    def run_once(self) -> None:
+        start = time.perf_counter()
+        ssn = open_session(self.cache, self.conf.tiers)
+
+        if self.conf.backend == "tpu":
+            from volcano_tpu.scheduler.tensor_backend import TensorBackend
+
+            ssn.tensor_backend = TensorBackend(ssn)
+        else:
+            ssn.tensor_backend = None
+
+        for name in self.conf.actions:
+            action = get_action(name)
+            if action is None:
+                continue
+            action_start = time.perf_counter()
+            action.execute(ssn)
+            metrics.update_action_duration(name, action_start)
+
+        close_session(ssn)
+        metrics.update_e2e_duration(start)

@@ -1,0 +1,49 @@
+"""Node predicate/prioritize/select helpers.
+
+Parity: reference KB/pkg/scheduler/util/scheduler_helper.go:32-106. The
+reference fans these loops over 16 goroutines and randomizes tie-breaking in
+SelectBestNode; here the host path is a straight loop (the TPU backend
+replaces it wholesale, SURVEY.md section 2.3) and ties break deterministically
+on the first best node in iteration order, so decisions are reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from volcano_tpu.scheduler.model import NodeInfo, TaskInfo
+
+
+def predicate_nodes(
+    task: TaskInfo, nodes: List[NodeInfo], fn: Callable[[TaskInfo, NodeInfo], Optional[str]]
+) -> List[NodeInfo]:
+    return [n for n in nodes if fn(task, n) is None]
+
+
+def prioritize_nodes(
+    task: TaskInfo, nodes: List[NodeInfo], fn: Callable[[TaskInfo, NodeInfo], float]
+) -> Dict[str, Tuple[float, NodeInfo]]:
+    return {n.name: (fn(task, n), n) for n in nodes}
+
+
+def select_best_node(scores: Dict[str, Tuple[float, NodeInfo]]) -> Optional[NodeInfo]:
+    best: Optional[NodeInfo] = None
+    best_score = float("-inf")
+    for _, (score, node) in scores.items():
+        if score > best_score:
+            best, best_score = node, score
+    return best
+
+
+def sort_nodes(scores: Dict[str, Tuple[float, NodeInfo]]) -> List[NodeInfo]:
+    """Nodes by descending score (stable on name for determinism)."""
+    return [
+        node
+        for _, node in sorted(
+            scores.values(), key=lambda sn: (-sn[0], sn[1].name)
+        )
+    ]
+
+
+def get_node_list(nodes: Dict[str, NodeInfo]) -> List[NodeInfo]:
+    return list(nodes.values())

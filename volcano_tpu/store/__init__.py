@@ -1,0 +1,3 @@
+from volcano_tpu.store.store import Store, Event, EventType
+
+__all__ = ["Store", "Event", "EventType"]

@@ -1,0 +1,112 @@
+"""In-memory watchable object store — the "API server" bus of the framework.
+
+The reference's components never talk to each other directly; they watch and
+write CRDs through the Kubernetes API server (SURVEY.md section 1). This
+store plays that role for the TPU framework: typed buckets keyed by
+namespace/name, monotonically increasing resource versions, and watch
+subscriptions that deliver add/update/delete events.
+
+Unlike informers+goroutines, delivery is deterministic: events queue up and
+subscribers drain them when pumped (tests and the simulator control the
+interleaving explicitly; `Cluster.run_until_idle` is the scheduler's
+equivalent of "wait for informer sync").
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import defaultdict, deque
+from dataclasses import dataclass
+from typing import Any, Callable, Deque, Dict, Iterator, List, Optional
+
+
+class EventType(str, enum.Enum):
+    ADDED = "Added"
+    UPDATED = "Updated"
+    DELETED = "Deleted"
+
+
+@dataclass
+class Event:
+    kind: str
+    type: EventType
+    obj: Any
+    old: Any = None
+
+
+class Store:
+    """Typed object buckets + watch queues.
+
+    Kinds used by the framework: "Job", "Pod", "PodGroup", "Queue", "Node",
+    "Command", "ConfigMap", "Service", "PriorityClass", "PVC".
+    """
+
+    def __init__(self):
+        self._objects: Dict[str, Dict[str, Any]] = defaultdict(dict)
+        # deep-copied last-notified state per object, so Event.old reflects
+        # the pre-update object even though callers mutate in place (the
+        # informer local-cache pattern); populated only for watched kinds.
+        self._shadow: Dict[str, Dict[str, Any]] = defaultdict(dict)
+        self._watchers: Dict[str, List[Deque[Event]]] = defaultdict(list)
+        self._rv = 0
+
+    def _watched(self, kind: str) -> bool:
+        return bool(self._watchers[kind])
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, kind: str, obj: Any) -> Any:
+        key = obj.meta.key
+        if key in self._objects[kind]:
+            raise KeyError(f"{kind} {key} already exists")
+        self._rv += 1
+        obj.meta.resource_version = self._rv
+        self._objects[kind][key] = obj
+        self._notify(Event(kind, EventType.ADDED, obj))
+        return obj
+
+    def update(self, kind: str, obj: Any) -> Any:
+        key = obj.meta.key
+        if key not in self._objects[kind]:
+            raise KeyError(f"{kind} {key} not found")
+        old = self._shadow[kind].get(key)
+        self._rv += 1
+        obj.meta.resource_version = self._rv
+        self._objects[kind][key] = obj
+        self._notify(Event(kind, EventType.UPDATED, obj, old))
+        return obj
+
+    def delete(self, kind: str, key: str) -> Optional[Any]:
+        obj = self._objects[kind].pop(key, None)
+        self._shadow[kind].pop(key, None)
+        if obj is not None:
+            self._notify(Event(kind, EventType.DELETED, obj))
+        return obj
+
+    def get(self, kind: str, key: str) -> Optional[Any]:
+        return self._objects[kind].get(key)
+
+    def list(self, kind: str) -> List[Any]:
+        return list(self._objects[kind].values())
+
+    def items(self, kind: str) -> Iterator[Any]:
+        return iter(list(self._objects[kind].values()))
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: str) -> Deque[Event]:
+        """Subscribe to a kind; returns the event queue to drain."""
+        q: Deque[Event] = deque()
+        self._watchers[kind].append(q)
+        return q
+
+    def _notify(self, ev: Event) -> None:
+        if self._watched(ev.kind):
+            import copy
+
+            for q in self._watchers[ev.kind]:
+                q.append(ev)
+            self._shadow[ev.kind][ev.obj.meta.key] = copy.deepcopy(ev.obj)
+
+    def pending_events(self) -> bool:
+        return any(q for qs in self._watchers.values() for q in qs)
