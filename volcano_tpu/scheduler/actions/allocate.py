@@ -39,10 +39,15 @@ class AllocateAction(Action):
         self._execute_host(ssn)
 
     def _execute_host(self, ssn: Session) -> None:
-        queues = PriorityQueue(ssn.queue_order_fn)
-        jobs_map = {}
+        # Ordering note: the reference holds queues/jobs in lazy binary heaps
+        # whose comparisons see mutating DRF/proportion shares only at sift
+        # time, so its pop order is a stale approximation of the share
+        # ordering. Both backends here re-select the exact best queue/job
+        # each iteration instead — same loop, exact ordering (first-minimum
+        # on ties, matching the kernel's argmin).
+        jobs_by_queue = {}
 
-        for job in ssn.jobs.values():
+        for job in sorted(ssn.jobs.values(), key=lambda j: j.creation_order):
             if (
                 job.pod_group is not None
                 and job.pod_group.status.phase == PodGroupPhase.PENDING
@@ -51,13 +56,12 @@ class AllocateAction(Action):
             queue = ssn.queues.get(job.queue)
             if queue is None:
                 continue
-            queues.push(queue)
-            if job.queue not in jobs_map:
-                jobs_map[job.queue] = PriorityQueue(ssn.job_order_fn)
-            jobs_map[job.queue].push(job)
+            jobs_by_queue.setdefault(queue.uid, []).append(job)
 
         pending_tasks = {}
         all_nodes = util.get_node_list(ssn.nodes)
+        dropped_queues = set()
+        queue_order = sorted(ssn.queues.values(), key=lambda q: q.uid)
 
         def predicate_fn(task, node):
             # resource fit first (allocate.go:78-93): idle OR releasing
@@ -68,15 +72,7 @@ class AllocateAction(Action):
                 return f"task {task.key} resource fit failed on {node.name}"
             return ssn.predicate_fn(task, node)
 
-        while not queues.empty():
-            queue = queues.pop()
-            if ssn.overused(queue):
-                continue
-            jobs = jobs_map.get(queue.uid)
-            if jobs is None or jobs.empty():
-                continue
-
-            job = jobs.pop()
+        def job_tasks(job):
             if job.uid not in pending_tasks:
                 tasks = PriorityQueue(ssn.task_order_fn)
                 for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
@@ -84,32 +80,69 @@ class AllocateAction(Action):
                         continue  # BestEffort handled by backfill
                     tasks.push(task)
                 pending_tasks[job.uid] = tasks
-            tasks = pending_tasks[job.uid]
+            return pending_tasks[job.uid]
 
-            while not tasks.empty():
-                task = tasks.pop()
+        def first_min(items, less):
+            best = None
+            for x in items:
+                if best is None or less(x, best):
+                    best = x
+            return best
 
-                if job.nodes_fit_delta:
-                    job.nodes_fit_delta = {}
-
-                feasible = util.predicate_nodes(task, all_nodes, predicate_fn)
-                if not feasible:
+        # drained jobs are pruned from jobs_by_queue as they're discovered so
+        # re-selection cost shrinks as the cycle progresses
+        cur_job = None
+        while True:
+            if cur_job is None:
+                for q_uid, jobs in list(jobs_by_queue.items()):
+                    live = [j for j in jobs if not job_tasks(j).empty()]
+                    if live:
+                        jobs_by_queue[q_uid] = live
+                    else:
+                        del jobs_by_queue[q_uid]
+                candidates = [
+                    q
+                    for q in queue_order
+                    if q.uid not in dropped_queues and jobs_by_queue.get(q.uid)
+                ]
+                if not candidates:
                     break
+                queue = first_min(candidates, ssn.queue_order_fn)
+                if ssn.overused(queue):
+                    dropped_queues.add(queue.uid)
+                    continue
+                cur_job = first_min(jobs_by_queue[queue.uid], ssn.job_order_fn)
+                continue
 
-                scores = util.prioritize_nodes(task, feasible, ssn.node_order_fn)
-                node = util.select_best_node(scores)
+            job = cur_job
+            tasks = job_tasks(job)
+            task = tasks.pop()
 
-                if task.init_resreq.less_equal(node.idle):
-                    ssn.allocate(task, node.name)
-                else:
-                    delta = node.idle.clone()
-                    delta.fit_delta(task.init_resreq)
-                    job.nodes_fit_delta[node.name] = delta
-                    if task.init_resreq.less_equal(node.releasing):
-                        ssn.pipeline(task, node.name)
+            if job.nodes_fit_delta:
+                job.nodes_fit_delta = {}
 
-                if ssn.job_ready(job):
-                    jobs.push(job)
-                    break
+            feasible = util.predicate_nodes(task, all_nodes, predicate_fn)
+            if not feasible:
+                # head task unschedulable: drop the job for this cycle
+                jobs_by_queue[job.queue] = [
+                    j for j in jobs_by_queue.get(job.queue, ()) if j.uid != job.uid
+                ]
+                if not jobs_by_queue[job.queue]:
+                    del jobs_by_queue[job.queue]
+                cur_job = None
+                continue
 
-            queues.push(queue)
+            scores = util.prioritize_nodes(task, feasible, ssn.node_order_fn)
+            node = util.select_best_node(scores)
+
+            if task.init_resreq.less_equal(node.idle):
+                ssn.allocate(task, node.name)
+            else:
+                delta = node.idle.clone()
+                delta.fit_delta(task.init_resreq)
+                job.nodes_fit_delta[node.name] = delta
+                if task.init_resreq.less_equal(node.releasing):
+                    ssn.pipeline(task, node.name)
+
+            if ssn.job_ready(job) or tasks.empty():
+                cur_job = None

@@ -1,0 +1,348 @@
+"""JAX kernels: the scheduler's hot loops as jitted device programs.
+
+This is the TPU-native replacement for the reference's 16-goroutine
+task x node loops (KB/pkg/scheduler/util/scheduler_helper.go:53,74) and the
+DRF/proportion share math (SURVEY.md section 2.3). Three design rules:
+
+1. **No [T, N] materialization.** The greedy loop touches one head task per
+   step, so per-step work is O(N*R + J + Q) vectors — HBM holds only node
+   state, task rows, and per-class predicate masks.
+2. **Sequential semantics on device.** The reference allocates task-by-task
+   with mutating node state; a vmap over tasks would race. The solve is a
+   single `lax.while_loop` whose body replicates one outer iteration of the
+   reference's allocate loop: queue selection (proportion share argmin),
+   job selection (lexicographic priority/gang/DRF key), head-task placement
+   (epsilon-tolerant resource fit + predicate-class mask + node scoring +
+   masked argmax), state scatter-update.
+3. **Epsilon semantics in f32.** LessEqual(a, b) == all(a < b + eps) with
+   eps = [10 millicores, 10 MiB, 10 milli-scalar] — exactly the reference's
+   tolerance (resource_info.go:70-72), which dwarfs f32 rounding at cluster
+   magnitudes.
+
+Tie-breaking divergence (documented, cf. SURVEY.md section 7 hard parts):
+node score ties take the first max index; the reference randomizes among
+ties (scheduler_helper.go:100-106). The host path uses first-max too, so
+host and tensor backends agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-jnp.inf)
+POS_INF = jnp.float32(jnp.inf)
+
+
+# --------------------------------------------------------------------------
+# epsilon-tolerant resource comparisons on dense [.., R] vectors
+# --------------------------------------------------------------------------
+
+def less_equal(a, b, eps):
+    """all_r(a < b + eps) — reference Resource.LessEqual on dense dims."""
+    return jnp.all(a < b + eps, axis=-1)
+
+
+def is_empty(a, eps):
+    """all dims below their epsilon — reference Resource.IsEmpty."""
+    return jnp.all(a < eps, axis=-1)
+
+
+def safe_share(alloc, denom):
+    """elementwise l/r with 0/0 = 0 and x/0 = 1 (reference helpers.Share)."""
+    zero_denom = denom == 0
+    return jnp.where(
+        zero_denom,
+        jnp.where(alloc == 0, 0.0, 1.0),
+        alloc / jnp.where(zero_denom, 1.0, denom),
+    )
+
+
+def dominant_share(alloc, denom):
+    """max over resource dims of safe_share — DRF/proportion share."""
+    return jnp.max(safe_share(alloc, denom), axis=-1)
+
+
+# --------------------------------------------------------------------------
+# proportion water-filling (proportion.go:101-144)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=())
+def water_fill(weight, request, total, eps, participates):
+    """Iterative weighted fair share: returns deserved [Q, R].
+
+    Each round, unmet participating queues add remaining * w/W to their
+    deserved; queues whose deserved is no longer LessEqual(request) are
+    capped at min(deserved, request) and marked met.
+    """
+    Q, R = request.shape
+
+    def body(state):
+        deserved, met, remaining, _ = state
+        live = participates & ~met
+        total_weight = jnp.sum(jnp.where(live, weight, 0.0))
+        frac = jnp.where(total_weight > 0, weight / jnp.maximum(total_weight, 1e-30), 0.0)
+        grant = jnp.where(live[:, None], remaining[None, :] * frac[:, None], 0.0)
+        new_deserved = deserved + grant
+        # "not deserved.LessEqual(request)" -> cap and mark met
+        exceeded = ~less_equal(new_deserved, request, eps) & live
+        capped = jnp.where(
+            exceeded[:, None], jnp.minimum(new_deserved, request), new_deserved
+        )
+        new_met = met | exceeded
+        delta = jnp.sum(capped - deserved, axis=0)
+        new_remaining = remaining - delta
+        go = (total_weight > 0) & ~is_empty(new_remaining, eps)
+        return capped, new_met, new_remaining, go
+
+    def cond(state):
+        return state[3]
+
+    deserved0 = jnp.zeros_like(request)
+    met0 = jnp.zeros((Q,), bool)
+    out = jax.lax.while_loop(
+        cond, body, (deserved0, met0, total, jnp.array(True))
+    )
+    return out[0]
+
+
+# --------------------------------------------------------------------------
+# allocate solve
+# --------------------------------------------------------------------------
+
+class AllocState(NamedTuple):
+    idle: jnp.ndarray          # [N, R]
+    releasing: jnp.ndarray     # [N, R]
+    used: jnp.ndarray          # [N, R]
+    task_count: jnp.ndarray    # [N]
+    job_alloc: jnp.ndarray     # [J, R]
+    ready: jnp.ndarray         # [J]
+    cursor: jnp.ndarray        # [J]
+    dropped: jnp.ndarray       # [J] bool
+    queue_alloc: jnp.ndarray   # [Q, R]
+    queue_dropped: jnp.ndarray  # [Q] bool
+    cur_job: jnp.ndarray       # scalar i32, -1 = selecting
+    task_node: jnp.ndarray     # [T] i32, -1 = unplaced
+    task_kind: jnp.ndarray     # [T] i32: 0 none, 1 allocated, 2 pipelined
+    task_seq: jnp.ndarray      # [T] i32 placement order
+    counter: jnp.ndarray       # scalar i32
+
+
+def _lex_argmin(mask, keys, index):
+    """First index minimizing (keys...) lexicographically within mask."""
+    m = mask
+    for k in keys:
+        kmin = jnp.min(jnp.where(m, k, POS_INF))
+        m = m & (k == kmin)
+    return jnp.argmax(m), jnp.any(mask)  # argmax of bool = first True
+
+
+def _score_nodes(req, used, cap, class_score_row, w_least, w_balanced):
+    """NodeOrderFn as [N] vector math (nodeorder.go formulas)."""
+    used_after = used + req[None, :]
+    cap_cpu, cap_mem = cap[:, 0], cap[:, 1]
+    free_cpu = jnp.maximum(cap_cpu - used_after[:, 0], 0.0)
+    free_mem = jnp.maximum(cap_mem - used_after[:, 1], 0.0)
+    least = (
+        jnp.where(cap_cpu > 0, free_cpu * 10.0 / jnp.maximum(cap_cpu, 1e-30), 0.0)
+        + jnp.where(cap_mem > 0, free_mem * 10.0 / jnp.maximum(cap_mem, 1e-30), 0.0)
+    ) * 0.5
+    cpu_frac = safe_share(used_after[:, 0], cap_cpu)
+    mem_frac = safe_share(used_after[:, 1], cap_mem)
+    balanced = jnp.where(
+        (cap_cpu > 0) & (cap_mem > 0) & (cpu_frac < 1.0) & (mem_frac < 1.0),
+        10.0 - jnp.abs(cpu_frac - mem_frac) * 10.0,
+        0.0,
+    )
+    return w_least * least + w_balanced * balanced + class_score_row
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("job_key_order", "use_gang_ready", "use_proportion"),
+)
+def allocate_solve(
+    # node state
+    idle, releasing, used, node_alloc, node_max_tasks, task_count, node_valid,
+    # tasks (sorted per job)
+    task_req, task_job, task_class, task_valid,
+    # jobs
+    job_queue, job_min, job_prio, job_ready_init, job_alloc_init,
+    job_schedulable, job_start, job_ntasks,
+    # queues
+    queue_alloc_init, queue_deserved,
+    # predicate classes
+    class_mask, class_score,
+    # misc
+    total, eps,
+    # score weights (runtime scalars)
+    w_least, w_balanced,
+    # plugin config (static): job_key_order is the tier-ordered tuple of
+    # job-order contributors, e.g. ("priority", "gang", "drf") — mirrors
+    # Session.job_order_fn's tier traversal with enable flags applied
+    job_key_order=("priority", "gang", "drf"),
+    use_gang_ready=True, use_proportion=True,
+):
+    """Run the reference allocate loop to fixed point on device.
+
+    Returns (task_node, task_kind, task_seq, ready, job_alloc, queue_alloc,
+    idle, releasing, used, dropped).
+    """
+    N, R = idle.shape
+    T = task_req.shape[0]
+    J = job_queue.shape[0]
+    Q = queue_alloc_init.shape[0]
+    jidx = jnp.arange(J, dtype=jnp.int32)
+
+    def job_active(s: AllocState):
+        q_ok = ~s.queue_dropped[jnp.clip(job_queue, 0, Q - 1)] & (job_queue >= 0)
+        return (
+            job_schedulable
+            & ~s.dropped
+            & (s.cursor < job_ntasks)
+            & q_ok
+        )
+
+    def cond(s: AllocState):
+        return (s.cur_job >= 0) | jnp.any(job_active(s))
+
+    def select_step(s: AllocState):
+        active = job_active(s)
+        # queue selection: argmin (proportion share, index) over queues with
+        # active jobs (allocate.go:103 pops the best queue)
+        q_has = (
+            jax.ops.segment_sum(
+                active.astype(jnp.int32), jnp.clip(job_queue, 0, Q - 1),
+                num_segments=Q,
+            )
+            > 0
+        )
+        if use_proportion:
+            q_share = dominant_share(s.queue_alloc, queue_deserved)
+        else:
+            q_share = jnp.zeros((Q,), jnp.float32)
+        qstar = jnp.argmax(
+            (q_share == jnp.min(jnp.where(q_has, q_share, POS_INF))) & q_has
+        )
+        if use_proportion:
+            overused = less_equal(queue_deserved[qstar], s.queue_alloc[qstar], eps)
+        else:
+            overused = jnp.array(False)
+
+        def drop_queue(s):
+            return s._replace(queue_dropped=s.queue_dropped.at[qstar].set(True))
+
+        def pick_job(s):
+            jobs_of_q = active & (job_queue == qstar)
+            keys = []
+            for name in job_key_order:
+                if name == "priority":
+                    keys.append(-job_prio.astype(jnp.float32))
+                elif name == "gang":
+                    keys.append((s.ready >= job_min).astype(jnp.float32))
+                elif name == "drf":
+                    keys.append(dominant_share(s.job_alloc, total[None, :]))
+            keys.append(jidx.astype(jnp.float32))  # creation order fallback
+            j, _ = _lex_argmin(jobs_of_q, keys, jidx)
+            return s._replace(cur_job=j.astype(jnp.int32))
+
+        return jax.lax.cond(overused, drop_queue, pick_job, s)
+
+    def place_step(s: AllocState):
+        j = s.cur_job
+        t = job_start[j] + s.cursor[j]
+        req = task_req[t]
+        cls = task_class[t]
+
+        fit_idle = less_equal(req[None, :], s.idle, eps) & node_valid
+        fit_rel = less_equal(req[None, :], s.releasing, eps) & node_valid
+        pred = class_mask[cls] & (s.task_count < node_max_tasks)
+        feasible = (fit_idle | fit_rel) & pred
+        any_feasible = jnp.any(feasible)
+
+        def drop_job(s):
+            # head task unschedulable -> job dropped this cycle (allocate.go:151)
+            return s._replace(
+                dropped=s.dropped.at[j].set(True),
+                cur_job=jnp.int32(-1),
+            )
+
+        def place(s):
+            score = _score_nodes(
+                req, s.used, node_alloc, class_score[cls], w_least, w_balanced
+            )
+            masked = jnp.where(feasible, score, NEG_INF)
+            n = jnp.argmax(masked).astype(jnp.int32)
+            use_idle = fit_idle[n]
+
+            idle2 = jnp.where(
+                use_idle, s.idle[n] - req, s.idle[n]
+            )
+            rel2 = jnp.where(use_idle, s.releasing[n], s.releasing[n] - req)
+            new_ready = s.ready[j] + jnp.where(use_idle, 1, 0)
+            # JobReady after each placement (session.go:284): gang checks
+            # min_available; without gang every placement re-selects
+            if use_gang_ready:
+                now_ready = new_ready >= job_min[j]
+            else:
+                now_ready = jnp.array(True)
+            # tasks exhausted -> the job leaves the current slot even if not
+            # gang-ready (host: "or tasks.empty()"); without this the cursor
+            # would run past job_ntasks into other jobs' rows
+            exhausted = s.cursor[j] + 1 >= job_ntasks[j]
+            next_cur = jnp.where(now_ready | exhausted, jnp.int32(-1), j)
+
+            return s._replace(
+                idle=s.idle.at[n].set(idle2),
+                releasing=s.releasing.at[n].set(rel2),
+                used=s.used.at[n].add(req),
+                task_count=s.task_count.at[n].add(1),
+                job_alloc=s.job_alloc.at[j].add(req),
+                ready=s.ready.at[j].set(new_ready),
+                cursor=s.cursor.at[j].add(1),
+                queue_alloc=s.queue_alloc.at[job_queue[j]].add(req),
+                cur_job=next_cur,
+                task_node=s.task_node.at[t].set(n),
+                task_kind=s.task_kind.at[t].set(jnp.where(use_idle, 1, 2)),
+                task_seq=s.task_seq.at[t].set(s.counter),
+                counter=s.counter + 1,
+            )
+
+        return jax.lax.cond(any_feasible, place, drop_job, s)
+
+    def body(s: AllocState):
+        return jax.lax.cond(s.cur_job < 0, select_step, place_step, s)
+
+    init = AllocState(
+        idle=idle,
+        releasing=releasing,
+        used=used,
+        task_count=task_count,
+        job_alloc=job_alloc_init,
+        ready=job_ready_init,
+        cursor=jnp.zeros((J,), jnp.int32),
+        dropped=jnp.zeros((J,), bool),
+        queue_alloc=queue_alloc_init,
+        queue_dropped=jnp.zeros((Q,), bool),
+        cur_job=jnp.int32(-1),
+        task_node=jnp.full((T,), -1, jnp.int32),
+        task_kind=jnp.zeros((T,), jnp.int32),
+        task_seq=jnp.full((T,), -1, jnp.int32),
+        counter=jnp.int32(0),
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    return (
+        final.task_node,
+        final.task_kind,
+        final.task_seq,
+        final.ready,
+        final.job_alloc,
+        final.queue_alloc,
+        final.idle,
+        final.releasing,
+        final.used,
+        final.dropped,
+    )

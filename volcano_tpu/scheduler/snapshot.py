@@ -1,0 +1,347 @@
+"""Tensorized cluster snapshot: the device-resident view of the session.
+
+This is the TPU-first replacement for the reference's object snapshot
+(SURVEY.md section 2.3): node Idle/Used/Releasing/Allocatable as [N, R] f32,
+task requests as [T, R], job/queue attributes as dense index arrays, and
+predicate results factorized into *task classes* — tasks sharing a
+(selector, affinity, tolerations) template share one [N] predicate row, so
+the full [T, N] mask never materializes in HBM.
+
+Everything string-shaped is interned host-side; shapes are padded to bucket
+sizes so XLA compilations are reused across cycles.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu.api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_SCALAR
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus, allocated_status
+from volcano_tpu.scheduler.model import NodeInfo, TaskInfo
+from volcano_tpu.scheduler.plugins.predicates import (
+    host_ports_free,
+    node_selector_fits,
+    taints_tolerated,
+)
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (>= minimum) for shape reuse."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+@dataclass
+class TensorSnapshot:
+    """Dense arrays describing one scheduling cycle. All numpy host-side;
+    the kernels move them to device. Shapes use padded sizes N/T/J/Q with
+    validity masks; R = 2 + interned scalar resources."""
+
+    dims: List[str]                    # resource dim names, ["cpu","memory",...]
+    eps: np.ndarray                    # [R] epsilon per dim
+
+    # nodes
+    node_names: List[str]
+    node_idle: np.ndarray              # [N, R]
+    node_releasing: np.ndarray         # [N, R]
+    node_used: np.ndarray              # [N, R]
+    node_alloc: np.ndarray             # [N, R] allocatable
+    node_max_tasks: np.ndarray         # [N] i32 (INT32_MAX if unset)
+    node_task_count: np.ndarray        # [N] i32
+    node_valid: np.ndarray             # [N] bool
+
+    # pending tasks, sorted by (job, task-order-key)
+    task_uids: List[str]               # index -> TaskInfo uid
+    task_req: np.ndarray               # [T, R] init_resreq
+    task_job: np.ndarray               # [T] i32
+    task_class: np.ndarray             # [T] i32 predicate class
+    task_valid: np.ndarray             # [T] bool
+
+    # jobs
+    job_uids: List[str]
+    job_queue: np.ndarray              # [J] i32
+    job_min_available: np.ndarray      # [J] i32
+    job_priority: np.ndarray           # [J] i32
+    job_creation: np.ndarray           # [J] i32
+    job_ready_init: np.ndarray        # [J] i32 tasks already in ready statuses
+    job_alloc_init: np.ndarray         # [J, R] drf allocated at session open
+    job_schedulable: np.ndarray        # [J] bool (podgroup phase != Pending)
+    job_start: np.ndarray              # [J] i32 offset into task arrays
+    job_ntasks: np.ndarray             # [J] i32 pending task count
+
+    # queues
+    queue_names: List[str]
+    queue_weight: np.ndarray           # [Q] f32
+    queue_alloc_init: np.ndarray       # [Q, R]
+    queue_request: np.ndarray          # [Q, R] alloc + pending (water-fill input)
+    queue_valid: np.ndarray            # [Q] bool
+    queue_participates: np.ndarray     # [Q] bool — has >=1 session job
+
+    # predicate classes
+    class_node_mask: np.ndarray        # [C, N] bool
+    class_node_score: np.ndarray       # [C, N] f32 static score (node affinity)
+
+    total: np.ndarray = field(default=None)  # [R] cluster allocatable total
+    # true when a pending task uses resident-pod-dependent predicates
+    # (host ports, pod affinity) that per-class masks cannot express;
+    # the tensor backend falls back to the host path in that case
+    has_dynamic_predicates: bool = False
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int, int]:
+        return (
+            len(self.node_valid),
+            len(self.task_valid),
+            len(self.job_queue),
+            len(self.queue_weight),
+            len(self.class_node_mask),
+        )
+
+
+def _resource_vec(res, dims: List[str], out: np.ndarray) -> None:
+    out[0] = res.milli_cpu
+    out[1] = res.memory
+    for i, name in enumerate(dims[2:], start=2):
+        out[i] = res.scalars.get(name, 0.0)
+
+
+def _task_class_key(task: TaskInfo):
+    spec = task.pod.spec
+    aff = spec.affinity
+    return (
+        tuple(sorted(spec.node_selector.items())),
+        tuple(tuple(term) for term in (aff.node_terms if aff else ())),
+        tuple((w, tuple(term)) for w, term in (aff.preferred_node_terms if aff else ())),
+        tuple((t.key, t.operator, t.value, t.effect) for t in spec.tolerations),
+        tuple(spec.host_ports),
+    )
+
+
+def _static_predicate(task: TaskInfo, node: NodeInfo) -> bool:
+    """The node-template-dependent part of the predicate chain: everything
+    except resource fit, max-task-count and resident-pod-dependent checks
+    (parity: predicates.go chain minus the dynamic members)."""
+    n = node.node
+    if not n.ready() or n.unschedulable:
+        return False
+    for cond in n.conditions:
+        if cond.kind in ("MemoryPressure", "DiskPressure", "PIDPressure") and cond.status == "True":
+            return False
+    if not node_selector_fits(task, node):
+        return False
+    if not taints_tolerated(task, node):
+        return False
+    return True
+
+
+def build_tensor_snapshot(
+    ssn, nodeaffinity_weight: float = 1.0, task_order_by_priority: bool = True
+) -> TensorSnapshot:
+    """Build the dense snapshot from a Session's object state."""
+    from volcano_tpu.scheduler.plugins.nodeorder import node_affinity_score
+
+    # -- resource dims -------------------------------------------------------
+    scalar_names: List[str] = []
+    seen = set()
+
+    def note_scalars(res):
+        for name in res.scalars:
+            if name not in seen:
+                seen.add(name)
+                scalar_names.append(name)
+
+    for node in ssn.nodes.values():
+        note_scalars(node.allocatable)
+    for job in ssn.jobs.values():
+        for t in job.tasks.values():
+            note_scalars(t.resreq)
+    dims = ["cpu", "memory", *sorted(scalar_names)]
+    R = len(dims)
+    eps = np.array(
+        [MIN_MILLI_CPU, MIN_MEMORY] + [MIN_SCALAR] * (R - 2), dtype=np.float32
+    )
+
+    # -- nodes ---------------------------------------------------------------
+    nodes = list(ssn.nodes.values())
+    N = _bucket(max(len(nodes), 1))
+    node_idle = np.zeros((N, R), np.float32)
+    node_rel = np.zeros((N, R), np.float32)
+    node_used = np.zeros((N, R), np.float32)
+    node_allocatable = np.zeros((N, R), np.float32)
+    node_max_tasks = np.full((N,), np.iinfo(np.int32).max, np.int32)
+    node_tc = np.zeros((N,), np.int32)
+    node_valid = np.zeros((N,), bool)
+    for i, ni in enumerate(nodes):
+        _resource_vec(ni.idle, dims, node_idle[i])
+        _resource_vec(ni.releasing, dims, node_rel[i])
+        _resource_vec(ni.used, dims, node_used[i])
+        _resource_vec(ni.allocatable, dims, node_allocatable[i])
+        if ni.allocatable.max_task_num is not None:
+            node_max_tasks[i] = ni.allocatable.max_task_num
+        node_tc[i] = len(ni.tasks)
+        node_valid[i] = True
+
+    # -- queues --------------------------------------------------------------
+    # sorted by uid so index-order tie-breaking matches the host fallback
+    # (session_plugins.go QueueOrderFn compares UIDs on ties)
+    queues = sorted(ssn.queues.values(), key=lambda q: q.uid)
+    queue_index = {q.uid: i for i, q in enumerate(queues)}
+    Q = _bucket(max(len(queues), 1), minimum=4)
+    queue_weight = np.zeros((Q,), np.float32)
+    queue_alloc = np.zeros((Q, R), np.float32)
+    queue_request = np.zeros((Q, R), np.float32)
+    queue_valid = np.zeros((Q,), bool)
+    queue_participates = np.zeros((Q,), bool)
+    for i, q in enumerate(queues):
+        queue_weight[i] = q.weight
+        queue_valid[i] = True
+
+    # -- jobs + pending tasks ------------------------------------------------
+    jobs = sorted(ssn.jobs.values(), key=lambda j: j.creation_order)
+    J = _bucket(max(len(jobs), 1), minimum=4)
+    job_queue = np.zeros((J,), np.int32)
+    job_min = np.zeros((J,), np.int32)
+    job_prio = np.zeros((J,), np.int32)
+    job_creation = np.arange(J, dtype=np.int32)
+    job_ready_init = np.zeros((J,), np.int32)
+    job_alloc_init = np.zeros((J, R), np.float32)
+    job_schedulable = np.zeros((J,), bool)
+    job_start = np.zeros((J,), np.int32)
+    job_ntasks = np.zeros((J,), np.int32)
+
+    task_rows: List[TaskInfo] = []
+    classes: Dict[object, int] = {}
+    class_examples: List[TaskInfo] = []
+    task_job_list: List[int] = []
+    task_class_list: List[int] = []
+    dynamic_predicates = False
+
+    tmp = np.zeros((R,), np.float32)
+    for j, job in enumerate(jobs):
+        qi = queue_index.get(job.queue)
+        job_queue[j] = -1 if qi is None else qi
+        if qi is not None:
+            queue_participates[qi] = True
+        job_min[j] = job.min_available
+        job_prio[j] = job.priority
+        job_schedulable[j] = not (
+            job.pod_group is not None
+            and job.pod_group.status.phase == PodGroupPhase.PENDING
+        )
+
+        for status, tasks in job.task_status_index.items():
+            charge = allocated_status(status)
+            ready = charge or status == TaskStatus.SUCCEEDED
+            for t in tasks.values():
+                if charge:
+                    _resource_vec(t.resreq, dims, tmp)
+                    job_alloc_init[j] += tmp
+                    if qi is not None:
+                        queue_alloc[qi] += tmp
+                        queue_request[qi] += tmp
+                elif status == TaskStatus.PENDING and qi is not None:
+                    _resource_vec(t.resreq, dims, tmp)
+                    queue_request[qi] += tmp
+            if ready:
+                job_ready_init[j] += len(tasks)
+
+        # pending non-BestEffort tasks in task-order: (priority desc, uid)
+        # when the priority plugin's task order is enabled, else uid only
+        # (Session.task_order_fn fallback)
+        pend = [
+            t
+            for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+            if not t.resreq.is_empty()
+        ]
+        if task_order_by_priority:
+            pend.sort(key=lambda t: (-t.priority, t.uid))
+        else:
+            pend.sort(key=lambda t: t.uid)
+        job_start[j] = len(task_rows)
+        job_ntasks[j] = len(pend)
+        for t in pend:
+            key = _task_class_key(t)
+            if key not in classes:
+                classes[key] = len(classes)
+                class_examples.append(t)
+            task_rows.append(t)
+            task_job_list.append(j)
+            task_class_list.append(classes[key])
+            aff = t.pod.spec.affinity
+            if t.pod.spec.host_ports or (
+                aff and (aff.pod_affinity or aff.pod_anti_affinity)
+            ):
+                dynamic_predicates = True
+
+    T = _bucket(max(len(task_rows), 1))
+    task_req = np.zeros((T, R), np.float32)
+    task_job = np.zeros((T,), np.int32)
+    task_class_arr = np.zeros((T,), np.int32)
+    task_valid = np.zeros((T,), bool)
+    task_uids = []
+    for i, t in enumerate(task_rows):
+        _resource_vec(t.init_resreq, dims, task_req[i])
+        task_job[i] = task_job_list[i]
+        task_class_arr[i] = task_class_list[i]
+        task_valid[i] = True
+        task_uids.append(t.uid)
+
+    # -- predicate classes ---------------------------------------------------
+    C = max(len(classes), 1)
+    class_mask = np.zeros((C, N), bool)
+    class_score = np.zeros((C, N), np.float32)
+    for c, example in enumerate(class_examples):
+        for i, ni in enumerate(nodes):
+            ok = _static_predicate(example, ni)
+            class_mask[c, i] = ok
+            if ok:
+                class_score[c, i] = nodeaffinity_weight * node_affinity_score(
+                    example, ni
+                )
+    if not class_examples:
+        class_mask[:, : len(nodes)] = True
+
+    total = node_allocatable[node_valid].sum(axis=0).astype(np.float32)
+
+    return TensorSnapshot(
+        dims=dims,
+        eps=eps,
+        node_names=[n.name for n in nodes],
+        node_idle=node_idle,
+        node_releasing=node_rel,
+        node_used=node_used,
+        node_alloc=node_allocatable,
+        node_max_tasks=node_max_tasks,
+        node_task_count=node_tc,
+        node_valid=node_valid,
+        task_uids=task_uids,
+        task_req=task_req,
+        task_job=task_job,
+        task_class=task_class_arr,
+        task_valid=task_valid,
+        job_uids=[j.uid for j in jobs],
+        job_queue=job_queue,
+        job_min_available=job_min,
+        job_priority=job_prio,
+        job_creation=job_creation,
+        job_ready_init=job_ready_init,
+        job_alloc_init=job_alloc_init,
+        job_schedulable=job_schedulable,
+        job_start=job_start,
+        job_ntasks=job_ntasks,
+        queue_names=[q.name for q in queues],
+        queue_weight=queue_weight,
+        queue_alloc_init=queue_alloc,
+        queue_request=queue_request,
+        queue_valid=queue_valid,
+        queue_participates=queue_participates,
+        class_node_mask=class_mask,
+        class_node_score=class_score,
+        total=total,
+        has_dynamic_predicates=dynamic_predicates,
+    )

@@ -1,0 +1,142 @@
+"""Tensor-backed action implementations.
+
+Each action tensorizes the session, runs the jitted kernel, then replays
+the decisions through the Session seams (exact mode) or applies them in
+batch (bulk mode at bench scale). Falls back to the host path whenever the
+tier configuration contains a plugin the kernels don't model.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from volcano_tpu.api.types import TaskStatus
+
+
+def _host_allocate(ssn) -> None:
+    from volcano_tpu.scheduler.actions.allocate import AllocateAction
+
+    AllocateAction()._execute_host(ssn)
+
+
+def allocate(ssn) -> None:
+    backend = ssn.tensor_backend
+    if backend is None or not backend.supported:
+        _host_allocate(ssn)
+        return
+
+    snap = backend.snapshot()
+    if snap.has_dynamic_predicates:
+        _host_allocate(ssn)
+        return
+
+    import jax.numpy as jnp
+
+    from volcano_tpu.scheduler.kernels import allocate_solve
+    w_least, w_balanced = backend.score_weights()
+    deserved = backend.deserved()
+
+    (
+        task_node, task_kind, task_seq, ready, _job_alloc, _queue_alloc,
+        _idle, _rel, _used, _dropped,
+    ) = allocate_solve(
+        jnp.asarray(snap.node_idle),
+        jnp.asarray(snap.node_releasing),
+        jnp.asarray(snap.node_used),
+        jnp.asarray(snap.node_alloc),
+        jnp.asarray(snap.node_max_tasks),
+        jnp.asarray(snap.node_task_count),
+        jnp.asarray(snap.node_valid),
+        jnp.asarray(snap.task_req),
+        jnp.asarray(snap.task_job),
+        jnp.asarray(snap.task_class),
+        jnp.asarray(snap.task_valid),
+        jnp.asarray(snap.job_queue),
+        jnp.asarray(snap.job_min_available),
+        jnp.asarray(snap.job_priority),
+        jnp.asarray(snap.job_ready_init),
+        jnp.asarray(snap.job_alloc_init),
+        jnp.asarray(snap.job_schedulable),
+        jnp.asarray(snap.job_start),
+        jnp.asarray(snap.job_ntasks),
+        jnp.asarray(snap.queue_alloc_init),
+        deserved,
+        jnp.asarray(snap.class_node_mask),
+        jnp.asarray(snap.class_node_score),
+        jnp.asarray(snap.total),
+        jnp.asarray(snap.eps),
+        jnp.float32(w_least),
+        jnp.float32(w_balanced),
+        job_key_order=backend.job_key_order,
+        use_gang_ready=backend.gang_job_ready,
+        use_proportion=backend.proportion_queue_order,
+    )
+
+    task_node = np.asarray(task_node)
+    task_kind = np.asarray(task_kind)
+    task_seq = np.asarray(task_seq)
+    ready = np.asarray(ready)
+
+    placed = np.nonzero(task_kind > 0)[0]
+    if placed.size == 0:
+        return
+    order = placed[np.argsort(task_seq[placed])]
+
+    if placed.size <= backend.bulk_threshold:
+        _replay_exact(ssn, snap, order, task_node, task_kind)
+    else:
+        _apply_bulk(
+            ssn, snap, order, task_node, task_kind, ready,
+            use_gang=backend.gang_job_ready,
+        )
+    backend.invalidate()
+
+
+def _replay_exact(ssn, snap, order, task_node, task_kind) -> None:
+    """Feed each decision through Session.allocate/pipeline in solve order —
+    identical side effects (events, dispatch, cache binds) to the host path."""
+    for t in order:
+        job = ssn.jobs.get(snap.job_uids[snap.task_job[t]])
+        if job is None:
+            continue
+        task = job.tasks[snap.task_uids[t]]
+        node_name = snap.node_names[task_node[t]]
+        if task_kind[t] == 1:
+            ssn.allocate(task, node_name)
+        else:
+            ssn.pipeline(task, node_name)
+
+
+def _apply_bulk(ssn, snap, order, task_node, task_kind, ready, use_gang=True) -> None:
+    """Batch application for bench-scale decision sets.
+
+    Binds flow to the cache for all allocated tasks of gang-ready jobs
+    (every job counts as ready when gang's JobReady is not in the tiers);
+    session object state is updated with O(1) python per task (status +
+    node) so close_session writes correct PodGroup statuses. Plugin event
+    handlers are NOT fired (shares were already accounted on device).
+    """
+    if use_gang:
+        ready_jobs = {
+            snap.job_uids[j]
+            for j in range(len(snap.job_uids))
+            if ready[j] >= snap.job_min_available[j]
+        }
+    else:
+        ready_jobs = set(snap.job_uids)
+    for t in order:
+        job_uid = snap.job_uids[snap.task_job[t]]
+        job = ssn.jobs.get(job_uid)
+        if job is None:
+            continue
+        task = job.tasks[snap.task_uids[t]]
+        node_name = snap.node_names[task_node[t]]
+        task.node_name = node_name
+        if task_kind[t] == 1:
+            if job_uid in ready_jobs:
+                ssn.cache.bind(task, node_name)
+                job.update_task_status(task, TaskStatus.BINDING)
+            else:
+                job.update_task_status(task, TaskStatus.ALLOCATED)
+        else:
+            job.update_task_status(task, TaskStatus.PIPELINED)

@@ -1,0 +1,115 @@
+"""TensorBackend: runs scheduler actions as JAX solves over the session.
+
+The control plane stays object-based; this backend is the "JAX sidecar" of
+the BASELINE north star — it tensorizes the session snapshot, runs the
+jitted solve, and feeds the decisions back through the same
+Session.allocate/pipeline seams so all plugin events and cache side effects
+happen exactly as on the host path.
+
+Two replay modes:
+  * exact   — every decision replayed through Session.allocate/pipeline
+              (plugin event handlers fire; host state ends identical).
+              Default below ``BULK_THRESHOLD`` decisions.
+  * bulk    — at bench scale the per-object replay dominates, so decisions
+              are applied in batch: binds go straight to the cache, job
+              readiness comes from the kernel outputs. Host JobInfo state
+              is only updated where close_session reads it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from volcano_tpu.scheduler.conf import get_plugin_arg
+from volcano_tpu.scheduler.snapshot import TensorSnapshot, build_tensor_snapshot
+
+BULK_THRESHOLD = 5000
+
+#: plugins the tensor kernels understand; anything else in the tiers makes
+#: the backend decline (actions then fall back to the host path).
+TENSORIZABLE = {
+    "gang", "priority", "drf", "proportion", "predicates", "nodeorder",
+    "conformance",
+}
+
+
+class TensorBackend:
+    def __init__(self, ssn, bulk_threshold: int = BULK_THRESHOLD):
+        self.ssn = ssn
+        self.bulk_threshold = bulk_threshold
+        self.enabled: Dict[str, bool] = {}
+        self.nodeorder_args: Dict[str, str] = {}
+        self.supported = True
+        # tier-ordered job-order key contributors, mirroring
+        # Session.job_order_fn's traversal with enable flags applied
+        job_key_order = []
+        self.task_order_by_priority = False
+        self.gang_job_ready = False
+        self.proportion_queue_order = False
+        names = set()
+        for tier in ssn.tiers:
+            for opt in tier.plugins:
+                names.add(opt.name)
+                if opt.name == "nodeorder":
+                    self.nodeorder_args = opt.arguments
+                if opt.name not in TENSORIZABLE:
+                    self.supported = False
+                if opt.name in ("priority", "gang", "drf") and opt.enabled_job_order:
+                    if opt.name not in job_key_order:
+                        job_key_order.append(opt.name)
+                if opt.name == "priority" and opt.enabled_task_order:
+                    self.task_order_by_priority = True
+                if opt.name == "gang" and opt.enabled_job_ready:
+                    self.gang_job_ready = True
+                if opt.name == "proportion" and opt.enabled_queue_order:
+                    self.proportion_queue_order = True
+        self.job_key_order = tuple(job_key_order)
+        self.enabled = {n: (n in names) for n in TENSORIZABLE}
+        self._snapshot: Optional[TensorSnapshot] = None
+        self._deserved = None
+
+    # -- snapshot lifecycle --------------------------------------------------
+
+    def snapshot(self) -> TensorSnapshot:
+        if self._snapshot is None:
+            w_nodeaff = get_plugin_arg(self.nodeorder_args, "nodeaffinity.weight", 1.0)
+            self._snapshot = build_tensor_snapshot(
+                self.ssn,
+                nodeaffinity_weight=w_nodeaff if self.enabled["nodeorder"] else 0.0,
+                task_order_by_priority=self.task_order_by_priority,
+            )
+        return self._snapshot
+
+    def invalidate(self) -> None:
+        """Host state changed outside the tensor path (e.g. a host action
+        ran between tensor actions) — rebuild on next use."""
+        self._snapshot = None
+        self._deserved = None
+
+    def deserved(self):
+        """Proportion water-filling deserved shares [Q, R] (device)."""
+        if self._deserved is None:
+            import jax.numpy as jnp
+
+            from volcano_tpu.scheduler.kernels import water_fill
+
+            snap = self.snapshot()
+            self._deserved = water_fill(
+                jnp.asarray(snap.queue_weight),
+                jnp.asarray(snap.queue_request),
+                jnp.asarray(snap.total),
+                jnp.asarray(snap.eps),
+                jnp.asarray(snap.queue_participates),
+            )
+        return self._deserved
+
+    # -- score weights -------------------------------------------------------
+
+    def score_weights(self):
+        if not self.enabled["nodeorder"]:
+            return 0.0, 0.0
+        w_least = get_plugin_arg(self.nodeorder_args, "leastrequested.weight", 1.0)
+        w_bal = get_plugin_arg(self.nodeorder_args, "balancedresource.weight", 1.0)
+        return float(w_least), float(w_bal)
