@@ -44,6 +44,7 @@ class SchedulerConf:
     actions: List[str] = field(default_factory=lambda: ["allocate", "backfill"])
     tiers: List[Tier] = field(default_factory=list)
     backend: str = "host"  # "tpu" (tensor kernels) | "host" (object oracle path)
+    solve_mode: str = "auto"  # tpu backend: "auto" | "exact" | "batch"
     schedule_period: float = 1.0
 
 
@@ -93,6 +94,7 @@ def load_conf(text: str) -> SchedulerConf:
     else:
         conf.tiers = default_conf().tiers
     conf.backend = str(data.get("backend", conf.backend))
+    conf.solve_mode = str(data.get("solveMode", conf.solve_mode))
     if "schedulePeriod" in data:
         conf.schedule_period = float(data["schedulePeriod"])
     return conf

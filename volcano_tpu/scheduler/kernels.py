@@ -141,17 +141,21 @@ def _lex_argmin(mask, keys, index):
 
 
 def _score_nodes(req, used, cap, class_score_row, w_least, w_balanced):
-    """NodeOrderFn as [N] vector math (nodeorder.go formulas)."""
-    used_after = used + req[None, :]
+    """NodeOrderFn as vector math (nodeorder.go formulas).
+
+    ``req`` may carry leading batch dims: [R] -> [N] scores,
+    [M, R] -> [M, N] scores. ``used``/``cap`` are [N, R].
+    """
+    used_after = used + req[..., None, :]
     cap_cpu, cap_mem = cap[:, 0], cap[:, 1]
-    free_cpu = jnp.maximum(cap_cpu - used_after[:, 0], 0.0)
-    free_mem = jnp.maximum(cap_mem - used_after[:, 1], 0.0)
+    free_cpu = jnp.maximum(cap_cpu - used_after[..., 0], 0.0)
+    free_mem = jnp.maximum(cap_mem - used_after[..., 1], 0.0)
     least = (
         jnp.where(cap_cpu > 0, free_cpu * 10.0 / jnp.maximum(cap_cpu, 1e-30), 0.0)
         + jnp.where(cap_mem > 0, free_mem * 10.0 / jnp.maximum(cap_mem, 1e-30), 0.0)
     ) * 0.5
-    cpu_frac = safe_share(used_after[:, 0], cap_cpu)
-    mem_frac = safe_share(used_after[:, 1], cap_mem)
+    cpu_frac = safe_share(used_after[..., 0], cap_cpu)
+    mem_frac = safe_share(used_after[..., 1], cap_mem)
     balanced = jnp.where(
         (cap_cpu > 0) & (cap_mem > 0) & (cpu_frac < 1.0) & (mem_frac < 1.0),
         10.0 - jnp.abs(cpu_frac - mem_frac) * 10.0,
@@ -189,7 +193,8 @@ def allocate_solve(
     """Run the reference allocate loop to fixed point on device.
 
     Returns (task_node, task_kind, task_seq, ready, job_alloc, queue_alloc,
-    idle, releasing, used, dropped).
+    idle, releasing, used, dropped, steps) — ``steps`` is the placement
+    counter, useful for diagnostics.
     """
     N, R = idle.shape
     T = task_req.shape[0]
@@ -345,4 +350,339 @@ def allocate_solve(
         final.releasing,
         final.used,
         final.dropped,
+        final.counter,
+    )
+
+
+# --------------------------------------------------------------------------
+# batched-rounds allocate solve (throughput mode)
+# --------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "job_key_order", "use_gang_ready", "use_proportion", "m_chunk", "p_chunk",
+    ),
+)
+def allocate_solve_batch(
+    idle, releasing, used, node_alloc, node_max_tasks, task_count, node_valid,
+    task_req, task_job, task_class, task_valid,
+    job_queue, job_min, job_prio, job_ready_init, job_alloc_init,
+    job_schedulable, job_start, job_ntasks,
+    queue_alloc_init, queue_deserved,
+    class_mask, class_score,
+    total, eps,
+    w_least, w_balanced,
+    job_key_order=("priority", "gang", "drf"),
+    use_gang_ready=True, use_proportion=True,
+    m_chunk=1024, p_chunk=16,
+):
+    """Throughput-mode allocate: rounds of parallel block placement.
+
+    Each round the top-``m_chunk`` active jobs (ranked by the same
+    tier-ordered key as the sequential solve) propose their next
+    ``p_chunk`` tasks, all targeting the job's best-scoring feasible node.
+    Proposals sort by (node, rank); within a node the rank-ordered request
+    prefix-sum is compared against idle, and the accepted set is the
+    longest fitting prefix (monotone, so no scan). Rejected proposals
+    retry next round against updated state — a gang spills to its next
+    best node round by round, like sequential binpacking. Shares, overuse,
+    readiness and drops refresh between rounds.
+
+    Semantics vs the exact solve (documented divergence, bench scale only):
+    scores and fair shares are frozen *within* a round and a job's block
+    is scored by its head task, so task interleaving differs from the
+    reference's strict greedy order. All policies (gang readiness,
+    predicates, epsilon resource fits, proportion overuse, DRF/priority
+    ordering, node scoring) still hold round-by-round; capacity is never
+    oversubscribed because acceptance is prefix-sum-checked per node.
+    """
+    N, R = idle.shape
+    T = task_req.shape[0]
+    J = job_queue.shape[0]
+    Q = queue_alloc_init.shape[0]
+    M = min(m_chunk, J)
+    jidx = jnp.arange(J, dtype=jnp.int32)
+
+    class S(NamedTuple):
+        idle: jnp.ndarray
+        releasing: jnp.ndarray
+        used: jnp.ndarray
+        task_count: jnp.ndarray
+        job_alloc: jnp.ndarray
+        ready: jnp.ndarray
+        cursor: jnp.ndarray
+        dropped: jnp.ndarray
+        queue_alloc: jnp.ndarray
+        task_node: jnp.ndarray
+        task_kind: jnp.ndarray
+        task_seq: jnp.ndarray
+        round_: jnp.ndarray
+        progressed: jnp.ndarray
+
+    def active_mask(s):
+        if use_proportion:
+            overused = less_equal(queue_deserved, s.queue_alloc, eps)  # [Q]
+            q_ok = ~overused[jnp.clip(job_queue, 0, Q - 1)]
+        else:
+            q_ok = jnp.ones((J,), bool)
+        return (
+            job_schedulable
+            & ~s.dropped
+            & (s.cursor < job_ntasks)
+            & (job_queue >= 0)
+            & q_ok
+        )
+
+    def cond(s):
+        return s.progressed & jnp.any(active_mask(s))
+
+    def body(s):
+        active = active_mask(s)
+        # rank all jobs by (queue share, tier job keys, creation); inactive
+        # jobs sort to the end via the primary key
+        keys = [jidx.astype(jnp.float32)]  # lexsort: first key = least significant
+        for name in reversed(job_key_order):
+            if name == "priority":
+                keys.append(-job_prio.astype(jnp.float32))
+            elif name == "gang":
+                keys.append((s.ready >= job_min).astype(jnp.float32))
+            elif name == "drf":
+                keys.append(dominant_share(s.job_alloc, total[None, :]))
+        if use_proportion:
+            q_share = dominant_share(s.queue_alloc, queue_deserved)
+            keys.append(q_share[jnp.clip(job_queue, 0, Q - 1)])
+        keys.append(~active)  # most significant: active jobs first
+        order = jnp.lexsort(tuple(keys))          # [J] job indices by rank
+        sel = order[:M]                           # top-M jobs
+        sel_active = active[sel]                  # [M]
+
+        head_t = jnp.clip(job_start[sel] + s.cursor[sel], 0, T - 1)  # [M]
+        head_req = task_req[head_t]               # [M, R]
+        head_cls = task_class[head_t]             # [M]
+
+        fit_i = jnp.all(head_req[:, None, :] < s.idle[None, :, :] + eps, axis=-1)
+        fit_r = jnp.all(head_req[:, None, :] < s.releasing[None, :, :] + eps, axis=-1)
+        pred = class_mask[head_cls] & (s.task_count < node_max_tasks)[None, :] & node_valid[None, :]
+        feasible = (fit_i | fit_r) & pred & sel_active[:, None]
+
+        # node scores [M, N] from the head task's request
+        score = _score_nodes(
+            head_req, s.used, node_alloc, class_score[head_cls], w_least, w_balanced
+        )
+        # deterministic per-(job, node) tie-break jitter. The reference
+        # randomizes among equal-score nodes (scheduler_helper.go:100-106);
+        # without it, homogeneous clusters make every job propose the same
+        # argmax node and rounds degenerate to one-node-at-a-time.
+        jh = (sel.astype(jnp.uint32) * jnp.uint32(2654435761))[:, None]
+        nh = (jnp.arange(N, dtype=jnp.uint32) * jnp.uint32(40503))[None, :]
+        h = (jh ^ nh) * jnp.uint32(2246822519)
+        h = h ^ (h >> 15)
+        jitter = (h & jnp.uint32(0xFFFF)).astype(jnp.float32) * (1e-4 / 65535.0)
+        masked = jnp.where(feasible, score + jitter, NEG_INF)
+
+        job_ok = jnp.any(feasible, axis=1)                         # [M]
+        # jobs with an infeasible head skip this round but stay active —
+        # capacity freed by later rollbacks may make them feasible again
+
+        # ---- proposals: each selected job offers its next P tasks, spread
+        # over its top-K nodes by score with per-node capacity counts —
+        # the in-round equivalent of sequential within-job spill. The
+        # rejected tail retries next round.
+        P = p_chunk
+        K = min(p_chunk, N)  # top-K spill targets per job
+        F = M * P
+        offs = jnp.arange(P, dtype=jnp.int32)
+        t_prop = job_start[sel][:, None] + s.cursor[sel][:, None] + offs[None, :]
+        prop_valid = (
+            sel_active[:, None]
+            & job_ok[:, None]
+            & (s.cursor[sel][:, None] + offs[None, :] < job_ntasks[sel][:, None])
+        )
+        t_prop_c = jnp.clip(t_prop, 0, T - 1)
+        preq = task_req[t_prop_c]                                  # [M, P, R]
+
+        _, topk_nodes = jax.lax.top_k(masked, K)                   # [M, K]
+        topk_nodes = topk_nodes.astype(jnp.int32)
+        topk_feasible = jnp.take_along_axis(feasible, topk_nodes, axis=1)
+        topk_is_idle = jnp.take_along_axis(fit_i, topk_nodes, axis=1) & topk_feasible
+        # how many of this job's (head-sized) tasks fit each target node
+        idle_k = s.idle[topk_nodes]                                # [M, K, R]
+        req_safe = jnp.maximum(head_req, 1e-30)[:, None, :]
+        cnt = jnp.floor((idle_k + eps) / req_safe)
+        cnt = jnp.where(head_req[:, None, :] > 0, cnt, jnp.inf).min(axis=-1)  # [M, K]
+        cnt = jnp.where(topk_is_idle, jnp.maximum(cnt, 0.0), 0.0)
+        # releasing-fit targets can host exactly one pipelined task
+        cnt = jnp.where(topk_feasible & ~topk_is_idle, 1.0, cnt)
+        cum_cnt = jnp.cumsum(cnt, axis=1)                          # [M, K]
+        # task offset p goes to the first target whose cumulative count
+        # exceeds p; overflow offsets are invalid this round
+        slot = jnp.sum(offs[None, :, None] >= cum_cnt[:, None, :], axis=-1)  # [M, P]
+        in_range = slot < K
+        slot_c = jnp.clip(slot, 0, K - 1)
+        prop_node_mp = jnp.take_along_axis(topk_nodes, slot_c, axis=1)  # [M, P]
+        prop_idle_mp = jnp.take_along_axis(topk_is_idle, slot_c, axis=1)
+        prop_valid = prop_valid & in_range
+
+        # flatten row-major: rank order == (job rank, task offset)
+        fr = lambda x: x.reshape((F,) + x.shape[2:])
+        p_valid = fr(prop_valid)
+        p_req = fr(preq)
+        p_node = fr(prop_node_mp)
+        p_is_idle = fr(prop_idle_mp) & p_valid
+        p_is_pipe = p_valid & ~p_is_idle
+        p_job = fr(jnp.broadcast_to(sel[:, None], (M, P)))
+        p_t = fr(t_prop_c)
+        rank = jnp.arange(F, dtype=jnp.int32)
+
+        # conflict resolution, capacity-aware: proposals sort by (node,
+        # rank); within a node the rank-ordered request prefix-sum must fit
+        # idle. The sum is monotone so the fit test is prefix-closed.
+        key_node = jnp.where(p_is_idle, p_node, N)                 # N = dump slot
+        order2 = jnp.lexsort((rank, key_node))
+        sn = key_node[order2]
+        sreq = p_req[order2]
+        seg_start = jnp.concatenate([jnp.array([True]), sn[1:] != sn[:-1]])
+        cum = jnp.cumsum(sreq, axis=0)
+        start_pos = jax.lax.cummax(jnp.where(seg_start, jnp.arange(F), 0))
+        relcum = cum - (cum[start_pos] - sreq[start_pos])
+        idle_rows = jnp.concatenate([s.idle, jnp.zeros((1, R), s.idle.dtype)], 0)[sn]
+        # node_max_tasks also prefix-gates: resident count + position within
+        # the node's accepted run must stay under the pod-count cap (the
+        # sequential solve re-checks this per placement). A node taking
+        # both an idle run and a pipe win the same round can exceed the cap
+        # by one; acceptable slack, corrected next cycle.
+        tc_rows = jnp.concatenate([s.task_count, jnp.zeros((1,), jnp.int32)], 0)[sn]
+        cap_rows = jnp.concatenate(
+            [node_max_tasks, jnp.full((1,), 2**31 - 1, jnp.int32)], 0
+        )[sn]
+        pos_in_seg = jnp.arange(F) - start_pos
+        accept_sorted = (
+            jnp.all(relcum < idle_rows + eps, axis=-1)
+            & (tc_rows + pos_in_seg < cap_rows)
+            & (sn < N)
+        )
+        accept_idle = jnp.zeros((F,), bool).at[order2].set(accept_sorted)
+
+        # pipeline proposals: best rank per node, gated on the proposal's
+        # ACTUAL request fitting node releasing (the head-task fit that put
+        # the node in top-K may not hold for a larger non-head task) and on
+        # the pod-count cap
+        p_node_c = jnp.clip(p_node, 0, N - 1)
+        pipe_fits = (
+            jnp.all(p_req < s.releasing[p_node_c] + eps, axis=-1)
+            & (s.task_count[p_node_c] < node_max_tasks[p_node_c])
+        )
+        pipe_node = jnp.where(p_is_pipe & pipe_fits, p_node, N)
+        best_rank_pipe = jnp.full((N + 1,), F, jnp.int32).at[pipe_node].min(rank)
+        win_pipe = (best_rank_pipe[pipe_node] == rank) & p_is_pipe & pipe_fits
+
+        # acceptance must be an offset-prefix per job: the cursor advances by
+        # the win count, so a hole (offset p rejected, p+1 accepted) would
+        # re-propose already-placed tasks next round. Cancel wins after the
+        # first rejection; cancelled tasks simply retry.
+        win_raw = accept_idle | win_pipe
+        win_mp = win_raw.reshape(M, P)
+        prefix_ok = jnp.cumsum((~win_mp).astype(jnp.int32), axis=1) == 0
+        win = (win_mp & prefix_ok).reshape(F)
+        use_idle = accept_idle & win
+
+        # scatter updates; duplicate node/job targets accumulate via .add
+        delta = jnp.where(win[:, None], p_req, 0.0)
+        node_tgt = jnp.where(win, p_node, N)  # dump row N
+        idle2 = jnp.concatenate([s.idle, jnp.zeros((1, R), s.idle.dtype)], 0)
+        rel2 = jnp.concatenate([s.releasing, jnp.zeros((1, R), s.releasing.dtype)], 0)
+        used2 = jnp.concatenate([s.used, jnp.zeros((1, R), s.used.dtype)], 0)
+        tc2 = jnp.concatenate([s.task_count, jnp.zeros((1,), s.task_count.dtype)], 0)
+        idle2 = idle2.at[jnp.where(use_idle, node_tgt, N)].add(-delta)
+        rel2 = rel2.at[jnp.where(win & ~use_idle, node_tgt, N)].add(-delta)
+        used2 = used2.at[node_tgt].add(delta)
+        tc2 = tc2.at[node_tgt].add(jnp.where(win, 1, 0))
+
+        job_tgt = jnp.where(win, p_job, J)
+        ja2 = jnp.concatenate([s.job_alloc, jnp.zeros((1, R), s.job_alloc.dtype)], 0)
+        ja2 = ja2.at[job_tgt].add(delta)
+        ready2 = (
+            jnp.concatenate([s.ready, jnp.zeros((1,), s.ready.dtype)], 0)
+            .at[job_tgt].add(jnp.where(use_idle, 1, 0))
+        )
+        cursor2 = (
+            jnp.concatenate([s.cursor, jnp.zeros((1,), s.cursor.dtype)], 0)
+            .at[job_tgt].add(jnp.where(win, 1, 0))
+        )
+        q_tgt = jnp.where(win, jnp.clip(job_queue[p_job], 0, Q - 1), Q)
+        qa2 = jnp.concatenate([s.queue_alloc, jnp.zeros((1, R), s.queue_alloc.dtype)], 0)
+        qa2 = qa2.at[q_tgt].add(delta)
+
+        t_tgt = jnp.where(win, p_t, T)
+        tn2 = jnp.concatenate([s.task_node, jnp.zeros((1,), jnp.int32)], 0)
+        tn2 = tn2.at[t_tgt].set(jnp.where(win, p_node, 0))
+        tk2 = jnp.concatenate([s.task_kind, jnp.zeros((1,), jnp.int32)], 0)
+        tk2 = tk2.at[t_tgt].set(jnp.where(use_idle, 1, 2))
+        seq_val = s.round_ * F + rank
+        ts2 = jnp.concatenate([s.task_seq, jnp.zeros((1,), jnp.int32)], 0)
+        ts2 = ts2.at[t_tgt].set(seq_val)
+
+        # ---- fixpoint eviction + gang rollback: when no proposal won this
+        # round, the lowest-ranked active job is dropped; if it never
+        # reached gang readiness its session placements return to the pool.
+        # (The reference leaves such allocations stranded for the rest of
+        # the cycle; rolling back frees real capacity for stronger gangs
+        # and only improves packing.) Guarantees progress: every round has
+        # a win or a drop, so rounds <= placements + jobs.
+        any_win = jnp.any(win)
+        pos = jnp.where(active[order], jnp.arange(J), -1)
+        last_pos = jnp.max(pos)
+        victim = order[jnp.maximum(last_pos, 0)]
+        do_evict = ~any_win & (last_pos >= 0)
+        drop_job_mask = jnp.zeros((J,), bool).at[victim].set(do_evict)
+        new_dropped = s.dropped | drop_job_mask
+        if use_gang_ready:
+            rb_job = drop_job_mask & (s.ready < job_min)
+        else:
+            # without gang's JobReady, every placement binds — never unwind
+            rb_job = jnp.zeros((J,), bool)
+        tk_cur = tk2[:T]
+        rb_task = rb_job[task_job] & (tk_cur > 0) & task_valid
+        rb_req = jnp.where(rb_task[:, None], task_req, 0.0)
+        t_node = jnp.clip(tn2[:T], 0, N - 1)
+        rb_tgt = jnp.where(rb_task, t_node, N)
+        idle3 = idle2.at[jnp.where(rb_task & (tk_cur == 1), rb_tgt, N)].add(rb_req)
+        rel3 = rel2.at[jnp.where(rb_task & (tk_cur == 2), rb_tgt, N)].add(rb_req)
+        used3 = used2.at[rb_tgt].add(-rb_req)
+        tc3 = tc2.at[rb_tgt].add(-rb_task.astype(jnp.int32))
+        q_of_task = jnp.clip(job_queue[task_job], 0, Q - 1)
+        q_rb = jax.ops.segment_sum(rb_req, jnp.where(rb_task, q_of_task, Q), num_segments=Q + 1)
+        qa3 = qa2[:Q] - q_rb[:Q]
+        ja3 = jnp.where(rb_job[:, None], job_alloc_init, ja2[:J])
+        ready3 = jnp.where(rb_job, job_ready_init, ready2[:J])
+        cursor3 = jnp.where(rb_job, 0, cursor2[:J])
+        tn3 = jnp.where(rb_task, -1, tn2[:T])
+        tk3 = jnp.where(rb_task, 0, tk_cur)
+        ts3 = jnp.where(rb_task, -1, ts2[:T])
+
+        progressed = any_win | do_evict
+        return S(
+            idle=idle3[:N], releasing=rel3[:N], used=used3[:N], task_count=tc3[:N],
+            job_alloc=ja3, ready=ready3, cursor=cursor3,
+            dropped=new_dropped, queue_alloc=qa3,
+            task_node=tn3, task_kind=tk3, task_seq=ts3,
+            round_=s.round_ + 1, progressed=progressed,
+        )
+
+    init = S(
+        idle=idle, releasing=releasing, used=used, task_count=task_count,
+        job_alloc=job_alloc_init, ready=job_ready_init,
+        cursor=jnp.zeros((J,), jnp.int32), dropped=jnp.zeros((J,), bool),
+        queue_alloc=queue_alloc_init,
+        task_node=jnp.full((T,), -1, jnp.int32),
+        task_kind=jnp.zeros((T,), jnp.int32),
+        task_seq=jnp.full((T,), -1, jnp.int32),
+        round_=jnp.int32(0), progressed=jnp.array(True),
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    return (
+        final.task_node, final.task_kind, final.task_seq, final.ready,
+        final.job_alloc, final.queue_alloc, final.idle, final.releasing,
+        final.used, final.dropped, final.round_,
     )

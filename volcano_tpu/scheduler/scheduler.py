@@ -42,7 +42,7 @@ class Scheduler:
         if self.conf.backend == "tpu":
             from volcano_tpu.scheduler.tensor_backend import TensorBackend
 
-            ssn.tensor_backend = TensorBackend(ssn)
+            ssn.tensor_backend = TensorBackend(ssn, solve_mode=self.conf.solve_mode)
         else:
             ssn.tensor_backend = None
 

@@ -32,14 +32,18 @@ def allocate(ssn) -> None:
 
     import jax.numpy as jnp
 
-    from volcano_tpu.scheduler.kernels import allocate_solve
+    from volcano_tpu.scheduler.kernels import allocate_solve, allocate_solve_batch
+
     w_least, w_balanced = backend.score_weights()
     deserved = backend.deserved()
 
-    (
-        task_node, task_kind, task_seq, ready, _job_alloc, _queue_alloc,
-        _idle, _rel, _used, _dropped,
-    ) = allocate_solve(
+    n_pending = int(snap.task_valid.sum())
+    use_batch = backend.solve_mode == "batch" or (
+        backend.solve_mode == "auto" and n_pending > backend.batch_threshold
+    )
+    solve = allocate_solve_batch if use_batch else allocate_solve
+
+    out = solve(
         jnp.asarray(snap.node_idle),
         jnp.asarray(snap.node_releasing),
         jnp.asarray(snap.node_used),
@@ -72,10 +76,10 @@ def allocate(ssn) -> None:
         use_proportion=backend.proportion_queue_order,
     )
 
-    task_node = np.asarray(task_node)
-    task_kind = np.asarray(task_kind)
-    task_seq = np.asarray(task_seq)
-    ready = np.asarray(ready)
+    task_node = np.asarray(out[0])
+    task_kind = np.asarray(out[1])
+    task_seq = np.asarray(out[2])
+    ready = np.asarray(out[3])
 
     placed = np.nonzero(task_kind > 0)[0]
     if placed.size == 0:

@@ -26,6 +26,9 @@ from volcano_tpu.scheduler.conf import get_plugin_arg
 from volcano_tpu.scheduler.snapshot import TensorSnapshot, build_tensor_snapshot
 
 BULK_THRESHOLD = 5000
+#: above this many pending tasks the batched-rounds solve replaces the
+#: exact sequential solve (throughput mode; see kernels.allocate_solve_batch)
+BATCH_THRESHOLD = 4096
 
 #: plugins the tensor kernels understand; anything else in the tiers makes
 #: the backend decline (actions then fall back to the host path).
@@ -36,9 +39,17 @@ TENSORIZABLE = {
 
 
 class TensorBackend:
-    def __init__(self, ssn, bulk_threshold: int = BULK_THRESHOLD):
+    def __init__(
+        self,
+        ssn,
+        bulk_threshold: int = BULK_THRESHOLD,
+        solve_mode: str = "auto",  # auto | exact | batch
+        batch_threshold: int = BATCH_THRESHOLD,
+    ):
         self.ssn = ssn
         self.bulk_threshold = bulk_threshold
+        self.solve_mode = solve_mode
+        self.batch_threshold = batch_threshold
         self.enabled: Dict[str, bool] = {}
         self.nodeorder_args: Dict[str, str] = {}
         self.supported = True
