@@ -26,112 +26,29 @@ BASELINE_SECONDS = 60.0  # reference Go CPU path at this scale (BASELINE.md)
 
 
 def build_sim_snapshot(seed=0):
-    from volcano_tpu.scheduler.snapshot import _bucket
+    from volcano_tpu.scheduler.simargs import build_sim_args
 
-    rng = np.random.default_rng(seed)
-    R = 2
-    N, T, J, Q = (_bucket(N_NODES), _bucket(N_TASKS), _bucket(N_JOBS), _bucket(N_QUEUES, 4))
-
-    node_alloc = np.zeros((N, R), np.float32)
-    node_alloc[:N_NODES, 0] = rng.choice([8000, 16000, 32000], N_NODES)
-    node_alloc[:N_NODES, 1] = rng.choice([16, 32, 64], N_NODES) * (1 << 30)
-    node_valid = np.zeros(N, bool)
-    node_valid[:N_NODES] = True
-
-    tasks_per_job = N_TASKS // N_JOBS
-    task_req = np.zeros((T, R), np.float32)
-    task_req[:N_TASKS, 0] = rng.choice([250, 500, 1000, 2000], N_TASKS)
-    task_req[:N_TASKS, 1] = rng.choice([256, 512, 1024, 2048], N_TASKS) * (1 << 20)
-    task_valid = np.zeros(T, bool)
-    task_valid[:N_TASKS] = True
-    task_job = np.zeros(T, np.int32)
-    task_job[:N_TASKS] = np.repeat(np.arange(N_JOBS, dtype=np.int32), tasks_per_job)
-
-    job_start = np.zeros(J, np.int32)
-    job_ntasks = np.zeros(J, np.int32)
-    job_start[:N_JOBS] = np.arange(N_JOBS, dtype=np.int32) * tasks_per_job
-    job_ntasks[:N_JOBS] = tasks_per_job
-    job_min = np.zeros(J, np.int32)
-    job_min[:N_JOBS] = rng.integers(1, tasks_per_job + 1, N_JOBS)
-    job_queue = np.full(J, -1, np.int32)
-    job_queue[:N_JOBS] = rng.integers(0, N_QUEUES, N_JOBS)
-    job_prio = np.zeros(J, np.int32)
-    job_prio[:N_JOBS] = rng.choice([0, 0, 5, 10], N_JOBS)
-    job_schedulable = np.zeros(J, bool)
-    job_schedulable[:N_JOBS] = True
-
-    queue_weight = np.zeros(Q, np.float32)
-    queue_weight[:N_QUEUES] = [2.0, 1.0]
-    queue_request = np.zeros((Q, R), np.float32)
-    for q in range(N_QUEUES):
-        mask = task_job[:N_TASKS][job_queue[task_job[:N_TASKS]] == q]
-        sel = job_queue[task_job[:N_TASKS]] == q
-        queue_request[q] = task_req[:N_TASKS][sel].sum(0)
-    queue_participates = np.zeros(Q, bool)
-    queue_participates[:N_QUEUES] = True
-
-    eps = np.array([10.0, 10 * 1024 * 1024], np.float32)
-    total = node_alloc[node_valid].sum(0)
-
-    return dict(
-        idle=node_alloc.copy(), releasing=np.zeros((N, R), np.float32),
-        used=np.zeros((N, R), np.float32), node_alloc=node_alloc,
-        node_max_tasks=np.full(N, 2**31 - 1, np.int32),
-        task_count=np.zeros(N, np.int32), node_valid=node_valid,
-        task_req=task_req, task_job=task_job,
-        task_class=np.zeros(T, np.int32), task_valid=task_valid,
-        job_queue=job_queue, job_min=job_min, job_prio=job_prio,
-        job_ready_init=np.zeros(J, np.int32),
-        job_alloc_init=np.zeros((J, R), np.float32),
-        job_schedulable=job_schedulable, job_start=job_start,
-        job_ntasks=job_ntasks,
-        queue_alloc_init=np.zeros((Q, R), np.float32),
-        class_mask=np.ones((1, N), bool),
-        class_score=np.zeros((1, N), np.float32),
-        total=total, eps=eps,
-        queue_weight=queue_weight, queue_request=queue_request,
-        queue_participates=queue_participates,
-    )
-
-
-def run_cycle(args, jnp, water_fill, allocate_solve_batch):
-    """One full decision cycle on device: water-fill + allocate solve."""
-    deserved = water_fill(
-        args["queue_weight"], args["queue_request"], args["total"],
-        args["eps"], args["queue_participates"],
-    )
-    out = allocate_solve_batch(
-        args["idle"], args["releasing"], args["used"], args["node_alloc"],
-        args["node_max_tasks"], args["task_count"], args["node_valid"],
-        args["task_req"], args["task_job"], args["task_class"], args["task_valid"],
-        args["job_queue"], args["job_min"], args["job_prio"],
-        args["job_ready_init"], args["job_alloc_init"], args["job_schedulable"],
-        args["job_start"], args["job_ntasks"],
-        args["queue_alloc_init"], deserved,
-        args["class_mask"], args["class_score"],
-        args["total"], args["eps"],
-        jnp.float32(1.0), jnp.float32(1.0),
-    )
-    return out
+    return build_sim_args(N_NODES, N_TASKS, N_JOBS, N_QUEUES, seed=seed)
 
 
 def main():
     import jax
     import jax.numpy as jnp
 
-    from volcano_tpu.scheduler.kernels import allocate_solve_batch, water_fill
+    from volcano_tpu.parallel.sharded import run_cycle_reference
 
     host_args = build_sim_snapshot()
+    # device-resident once; run_cycle_reference's jnp.asarray is then a no-op
     args = {k: jnp.asarray(v) for k, v in host_args.items()}
 
     # warm-up / compile
-    out = run_cycle(args, jnp, water_fill, allocate_solve_batch)
+    out = run_cycle_reference(args)
     jax.block_until_ready(out)
 
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        out = run_cycle(args, jnp, water_fill, allocate_solve_batch)
+        out = run_cycle_reference(args)
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
 

@@ -12,3 +12,12 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# a sitecustomize may have pre-registered a TPU backend plugin, in which
+# case the env var alone is ignored — pin the platform via jax.config too
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # backend already initialized before conftest ran

@@ -1,0 +1,22 @@
+"""Multi-chip execution of the scheduler solve over a jax.sharding.Mesh.
+
+The reference scales its hot loop with 16 worker goroutines on one host
+(KB/pkg/scheduler/util/scheduler_helper.go:53,74); the TPU-native analogue
+is SPMD over a device mesh: node state is sharded across chips, XLA
+inserts the collectives (all-gather for the global node argmax/top-k,
+psum-style scatter reductions) over ICI. See parallel/sharded.py.
+"""
+
+from volcano_tpu.parallel.sharded import (
+    cycle_shardings,
+    make_mesh,
+    make_sharded_cycle,
+    run_cycle_reference,
+)
+
+__all__ = [
+    "cycle_shardings",
+    "make_mesh",
+    "make_sharded_cycle",
+    "run_cycle_reference",
+]

@@ -1,0 +1,107 @@
+"""Simulated tensor-snapshot builder for benches, the graft entry, and tests.
+
+Generates the dense argument set of ``kernels.allocate_solve_batch`` /
+``kernels.allocate_solve`` for a synthetic cluster: N nodes with mixed
+cpu/mem capacity, T pending tasks grouped into J gang jobs across Q
+weighted queues (the BASELINE.md "10k-node / 100k-task simulated snapshot"
+at bench scale; tiny shapes for compile checks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from volcano_tpu.scheduler.snapshot import _bucket
+
+
+def build_sim_args(
+    n_nodes: int,
+    n_tasks: int,
+    n_jobs: int,
+    n_queues: int = 2,
+    seed: int = 0,
+):
+    """Return the host-side (numpy) kwargs dict for one allocate cycle.
+
+    Keys match the parameter names of ``allocate_solve_batch`` plus the
+    ``water_fill`` inputs (queue_weight/queue_request/queue_participates).
+    """
+    assert n_tasks % n_jobs == 0, "tasks must divide evenly into jobs"
+    rng = np.random.default_rng(seed)
+    R = 2
+    N, T, J, Q = (
+        _bucket(n_nodes),
+        _bucket(n_tasks),
+        _bucket(n_jobs),
+        _bucket(n_queues, 4),
+    )
+
+    node_alloc = np.zeros((N, R), np.float32)
+    node_alloc[:n_nodes, 0] = rng.choice([8000, 16000, 32000], n_nodes)
+    node_alloc[:n_nodes, 1] = rng.choice([16, 32, 64], n_nodes) * (1 << 30)
+    node_valid = np.zeros(N, bool)
+    node_valid[:n_nodes] = True
+
+    tasks_per_job = n_tasks // n_jobs
+    task_req = np.zeros((T, R), np.float32)
+    task_req[:n_tasks, 0] = rng.choice([250, 500, 1000, 2000], n_tasks)
+    task_req[:n_tasks, 1] = rng.choice([256, 512, 1024, 2048], n_tasks) * (1 << 20)
+    task_valid = np.zeros(T, bool)
+    task_valid[:n_tasks] = True
+    task_job = np.zeros(T, np.int32)
+    task_job[:n_tasks] = np.repeat(np.arange(n_jobs, dtype=np.int32), tasks_per_job)
+
+    job_start = np.zeros(J, np.int32)
+    job_ntasks = np.zeros(J, np.int32)
+    job_start[:n_jobs] = np.arange(n_jobs, dtype=np.int32) * tasks_per_job
+    job_ntasks[:n_jobs] = tasks_per_job
+    job_min = np.zeros(J, np.int32)
+    job_min[:n_jobs] = rng.integers(1, tasks_per_job + 1, n_jobs)
+    job_queue = np.full(J, -1, np.int32)
+    job_queue[:n_jobs] = rng.integers(0, n_queues, n_jobs)
+    job_prio = np.zeros(J, np.int32)
+    job_prio[:n_jobs] = rng.choice([0, 0, 5, 10], n_jobs)
+    job_schedulable = np.zeros(J, bool)
+    job_schedulable[:n_jobs] = True
+
+    queue_weight = np.zeros(Q, np.float32)
+    queue_weight[:n_queues] = np.arange(n_queues, 0, -1, dtype=np.float32)
+    queue_request = np.zeros((Q, R), np.float32)
+    q_of_task = job_queue[task_job[:n_tasks]]
+    for q in range(n_queues):
+        queue_request[q] = task_req[:n_tasks][q_of_task == q].sum(0)
+    queue_participates = np.zeros(Q, bool)
+    queue_participates[:n_queues] = True
+
+    eps = np.array([10.0, 10 * 1024 * 1024], np.float32)
+    total = node_alloc[node_valid].sum(0)
+
+    return dict(
+        idle=node_alloc.copy(),
+        releasing=np.zeros((N, R), np.float32),
+        used=np.zeros((N, R), np.float32),
+        node_alloc=node_alloc,
+        node_max_tasks=np.full(N, 2**31 - 1, np.int32),
+        task_count=np.zeros(N, np.int32),
+        node_valid=node_valid,
+        task_req=task_req,
+        task_job=task_job,
+        task_class=np.zeros(T, np.int32),
+        task_valid=task_valid,
+        job_queue=job_queue,
+        job_min=job_min,
+        job_prio=job_prio,
+        job_ready_init=np.zeros(J, np.int32),
+        job_alloc_init=np.zeros((J, R), np.float32),
+        job_schedulable=job_schedulable,
+        job_start=job_start,
+        job_ntasks=job_ntasks,
+        queue_alloc_init=np.zeros((Q, R), np.float32),
+        class_mask=np.ones((1, N), bool),
+        class_score=np.zeros((1, N), np.float32),
+        total=total,
+        eps=eps,
+        queue_weight=queue_weight,
+        queue_request=queue_request,
+        queue_participates=queue_participates,
+    )
