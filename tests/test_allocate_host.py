@@ -85,8 +85,11 @@ def test_higher_priority_job_wins_scarce_capacity():
     assert set(binder.binds) == {"default/high0", "default/high1"}
 
 
-def test_invalid_gang_dropped_from_session():
-    # fewer valid tasks than min_available -> JobValid gate drops the job
+def test_invalid_gang_never_binds():
+    """Fewer valid tasks than min_available: the job survives session open
+    (reference ordering — the JobValid registry is empty at gate time,
+    framework.go:30-50) but never reaches JobReady, so nothing dispatches
+    and gang's OnSessionClose records the Unschedulable condition."""
     store = make_store(
         nodes=[build_node("n1")],
         podgroups=[build_podgroup("pg1", min_member=5)],
@@ -96,7 +99,7 @@ def test_invalid_gang_dropped_from_session():
     assert binder.binds == {}
     pg = store.get("PodGroup", "default/pg1")
     assert any(
-        c.kind == "Unschedulable" and c.reason == "NotEnoughPods"
+        c.kind == "Unschedulable" and c.reason == "NotEnoughResources"
         for c in pg.status.conditions
     )
 

@@ -1,0 +1,308 @@
+"""Job controller lifecycle through the simulated cluster.
+
+Mirrors the reference controller path (SURVEY.md §3.3/3.4): Job created ->
+PodGroup -> enqueue -> pods -> gang bind -> Running; plus failure policies
+(RestartJob with MaxRetry), abort/resume commands, and TaskCompleted.
+"""
+
+import pytest
+
+from volcano_tpu.api.job import (
+    Job,
+    JobSpec,
+    LifecyclePolicy,
+    TaskSpec,
+)
+from volcano_tpu.api.objects import Command, Metadata, PodSpec
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobAction, JobEvent, JobPhase, PodPhase
+from volcano_tpu.sim import Cluster
+
+
+def mk_job(name, tasks, min_available=None, policies=None, plugins=None,
+           max_retry=3, queue="default"):
+    specs = [
+        TaskSpec(
+            name=tname,
+            replicas=replicas,
+            template=PodSpec(resources=Resource.from_resource_list(req)),
+            policies=tpolicies or [],
+        )
+        for tname, replicas, req, tpolicies in tasks
+    ]
+    total = sum(t.replicas for t in specs)
+    return Job(
+        meta=Metadata(name=name, namespace="test"),
+        spec=JobSpec(
+            min_available=min_available if min_available is not None else total,
+            tasks=specs,
+            policies=policies or [],
+            plugins=plugins or {},
+            queue=queue,
+            max_retry=max_retry,
+        ),
+    )
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    c.add_queue("default", weight=1)
+    for i in range(2):
+        c.add_node(f"n{i}", {"cpu": "4", "memory": "8Gi", "pods": 110})
+    return c
+
+
+def test_job_reaches_running(cluster):
+    job = mk_job("j1", [("main", 3, {"cpu": "1", "memory": "1Gi"}, None)])
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    assert job.status.state.phase == JobPhase.RUNNING
+    assert job.status.running == 3
+    pods = cluster.store.list("Pod")
+    assert len(pods) == 3
+    assert all(p.phase == PodPhase.RUNNING and p.node_name for p in pods)
+    # PodGroup created by the controller with gang minMember
+    pg = cluster.store.get("PodGroup", "test/j1")
+    assert pg is not None and pg.min_member == 3
+
+
+def test_gang_insufficient_stays_pending(cluster):
+    # 2 nodes x 4 cpu; gang of 5 x 2cpu can never fully fit
+    job = mk_job("big", [("w", 5, {"cpu": "2", "memory": "1Gi"}, None)])
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    assert job.status.state.phase in (JobPhase.INQUEUE, JobPhase.PENDING)
+    pods = cluster.store.list("Pod")
+    # no partial gang binding
+    assert all(not p.node_name for p in pods)
+
+
+def test_pod_failure_restart_policy(cluster):
+    job = mk_job(
+        "r1",
+        [("main", 2, {"cpu": "1", "memory": "1Gi"}, None)],
+        policies=[LifecyclePolicy(action=JobAction.RESTART_JOB,
+                                  event=JobEvent.POD_FAILED)],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.RUNNING
+    version_before = job.status.version
+
+    cluster.fail_pod("test/r1-main-0", exit_code=137)
+    cluster.run_until_idle()
+
+    # job was killed (version bump) and came back to Running with fresh pods
+    assert job.status.version > version_before
+    assert job.status.state.phase == JobPhase.RUNNING
+    assert job.status.retry_count >= 1
+    pods = cluster.store.list("Pod")
+    assert len(pods) == 2
+    assert all(p.phase == PodPhase.RUNNING for p in pods)
+
+
+def test_max_retry_leads_to_failed(cluster):
+    job = mk_job(
+        "r2",
+        [("main", 1, {"cpu": "1", "memory": "1Gi"}, None)],
+        policies=[LifecyclePolicy(action=JobAction.RESTART_JOB,
+                                  event=JobEvent.POD_FAILED)],
+        max_retry=2,
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    for _ in range(4):
+        pods = cluster.store.list("Pod")
+        if not pods or job.status.state.phase == JobPhase.FAILED:
+            break
+        cluster.fail_pod(pods[0].meta.key)
+        cluster.run_until_idle()
+
+    assert job.status.state.phase == JobPhase.FAILED
+    assert job.status.retry_count >= 2
+
+
+def test_terminate_policy(cluster):
+    job = mk_job(
+        "t1",
+        [("main", 2, {"cpu": "1", "memory": "1Gi"}, None)],
+        policies=[LifecyclePolicy(action=JobAction.TERMINATE_JOB,
+                                  event=JobEvent.POD_FAILED)],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+    cluster.fail_pod("test/t1-main-1")
+    cluster.run_until_idle()
+
+    assert job.status.state.phase == JobPhase.TERMINATED
+    assert cluster.store.list("Pod") == []
+    assert cluster.store.get("PodGroup", "test/t1") is None
+
+
+def test_task_completed_completes_job(cluster):
+    job = mk_job(
+        "c1",
+        [("main", 2, {"cpu": "1", "memory": "1Gi"}, None)],
+        policies=[LifecyclePolicy(action=JobAction.COMPLETE_JOB,
+                                  event=JobEvent.TASK_COMPLETED)],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    cluster.complete_pod("test/c1-main-0")
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.RUNNING  # task not yet complete
+
+    cluster.complete_pod("test/c1-main-1")
+    cluster.run_until_idle()
+    assert job.status.state.phase in (JobPhase.COMPLETING, JobPhase.COMPLETED)
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.COMPLETED
+
+
+def test_abort_resume_via_command(cluster):
+    job = mk_job("a1", [("main", 2, {"cpu": "1", "memory": "1Gi"}, None)])
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.RUNNING
+
+    cluster.store.create(
+        "Command",
+        Command(
+            meta=Metadata(name="abort-a1", namespace="test"),
+            action=JobAction.ABORT_JOB.value,
+            target=("Job", "a1"),
+        ),
+    )
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.ABORTED
+    assert cluster.store.list("Pod") == []
+    # command executes at most once: it is deleted on receipt
+    assert cluster.store.list("Command") == []
+
+    cluster.store.create(
+        "Command",
+        Command(
+            meta=Metadata(name="resume-a1", namespace="test"),
+            action=JobAction.RESUME_JOB.value,
+            target=("Job", "a1"),
+        ),
+    )
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.RUNNING
+    assert len(cluster.store.list("Pod")) == 2
+
+
+def test_version_fencing_drops_stale_pod_events(cluster):
+    """Events carrying an old job version must map to SyncJob, not their
+    policy action (job_controller_util.go:145-148)."""
+    job = mk_job(
+        "v1",
+        [("main", 1, {"cpu": "1", "memory": "1Gi"}, None)],
+        policies=[LifecyclePolicy(action=JobAction.ABORT_JOB,
+                                  event=JobEvent.POD_EVICTED)],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    from volcano_tpu.controller.cache import Request
+    from volcano_tpu.controller.controller import apply_policies
+
+    stale = Request("test", "v1", task_name="main",
+                    event=JobEvent.POD_EVICTED, job_version=job.status.version - 1)
+    live = Request("test", "v1", task_name="main",
+                   event=JobEvent.POD_EVICTED, job_version=job.status.version)
+    assert apply_policies(job, stale) == JobAction.SYNC_JOB
+    assert apply_policies(job, live) == JobAction.ABORT_JOB
+
+
+def test_volume_claims_stable_across_restarts(cluster):
+    from volcano_tpu.api.job import VolumeSpec
+
+    job = mk_job(
+        "vol1",
+        [("main", 1, {"cpu": "1", "memory": "1Gi"}, None)],
+        policies=[LifecyclePolicy(action=JobAction.RESTART_JOB,
+                                  event=JobEvent.POD_FAILED)],
+    )
+    job.spec.volumes = [VolumeSpec(mount_path="/data", size="10Gi")]
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    pvcs = cluster.store.list("PVC")
+    assert len(pvcs) == 1
+    claim = pvcs[0].meta.name
+    pod = cluster.store.list("Pod")[0]
+    assert claim in pod.volumes
+
+    cluster.fail_pod("test/vol1-main-0")
+    cluster.run_until_idle()
+    # restart must reuse the same claim, not mint orphans
+    assert [p.meta.name for p in cluster.store.list("PVC")] == [claim]
+
+
+def test_quiesces_without_controller():
+    # no watcher on PodGroup: no-op status writes must still be suppressed
+    c = Cluster(with_controller=False)
+    c.add_queue("default")
+    c.add_node("n0", {"cpu": "4", "memory": "8Gi"})
+    from volcano_tpu.api.objects import Metadata, PodGroup
+
+    c.store.create("PodGroup", PodGroup(meta=Metadata(name="pg", namespace="test")))
+    c.run_until_idle()
+
+
+def test_unknown_command_action_ignored(cluster):
+    job = mk_job("u1", [("main", 1, {"cpu": "1", "memory": "1Gi"}, None)])
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    cluster.store.create(
+        "Command",
+        Command(meta=Metadata(name="bogus", namespace="test"),
+                action="NotAnAction", target=("Job", "u1")),
+    )
+    cluster.run_until_idle()  # must not raise
+    assert job.status.state.phase == JobPhase.RUNNING
+
+
+def test_svc_ssh_env_plugins(cluster):
+    job = mk_job(
+        "p1",
+        [("ps", 1, {"cpu": "1", "memory": "1Gi"}, None),
+         ("worker", 2, {"cpu": "1", "memory": "1Gi"}, None)],
+        plugins={"svc": [], "ssh": [], "env": []},
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    hostfile = cluster.store.get("ConfigMap", "test/p1-svc")
+    assert hostfile is not None
+    assert hostfile.data["ps.host"] == "p1-ps-0.p1"
+    assert hostfile.data["worker.host"] == "p1-worker-0.p1\np1-worker-1.p1"
+    assert cluster.store.get("Service", "test/p1") is not None
+
+    sshcm = cluster.store.get("ConfigMap", "test/p1-ssh")
+    assert sshcm is not None
+    assert {"id_rsa", "id_rsa.pub", "authorized_keys", "config"} <= set(sshcm.data)
+
+    pods = {p.meta.name: p for p in cluster.store.list("Pod")}
+    assert pods["p1-worker-1"].env["VT_TASK_INDEX"] == "1"
+    assert pods["p1-worker-1"].hostname == "p1-worker-1"
+    assert pods["p1-worker-1"].subdomain == "p1"
+    assert "p1-svc" in pods["p1-ps-0"].volumes
+    assert "p1-ssh" in pods["p1-ps-0"].volumes
+
+    # teardown removes plugin resources
+    cluster.store.create(
+        "Command",
+        Command(meta=Metadata(name="kill-p1", namespace="test"),
+                action=JobAction.TERMINATE_JOB.value, target=("Job", "p1")),
+    )
+    cluster.run_until_idle()
+    assert cluster.store.get("ConfigMap", "test/p1-svc") is None
+    assert cluster.store.get("Service", "test/p1") is None

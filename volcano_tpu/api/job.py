@@ -108,6 +108,12 @@ class Job:
         return None
 
 
+def make_pod_name(job_name: str, task_name: str, index: int) -> str:
+    """Pod naming contract ``<job>-<task>-<idx>`` (reference
+    pkg/controllers/job/helpers PodNameFmt)."""
+    return f"{job_name}-{task_name}-{index}"
+
+
 def calc_pg_min_resources(job: Job) -> Resource:
     """MinResources for the PodGroup: sum requests of the top-``min_available``
     tasks ordered by pod priority (parity: job_controller_actions.go:467-496).

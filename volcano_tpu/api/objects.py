@@ -242,3 +242,29 @@ class Command:
     target: Optional[Tuple[str, str]] = None  # (kind, name)
     reason: str = ""
     message: str = ""
+
+
+@dataclass
+class ConfigMap:
+    """Key/value payload attached to jobs by controller plugins (hostfiles,
+    ssh keys)."""
+
+    meta: Metadata
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Service:
+    """Headless service the svc plugin creates per job for task DNS."""
+
+    meta: Metadata
+    cluster_ip: str = "None"
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """Volume claim created for Job.spec.volumes entries."""
+
+    meta: Metadata
+    size: str = ""

@@ -1,0 +1,14 @@
+"""Job controller: reconciles Job objects into Pods + PodGroups.
+
+The TPU framework's control plane mirrors the reference's vk-controllers
+binary (pkg/controllers/job/): a store-watch driven reconciler with an
+explicit state machine per job phase, lifecycle policies mapping
+(event, exit_code) -> action, version fencing against stale pod events,
+and controller-side plugins that inject distributed-training plumbing
+(env/svc/ssh) into pods at creation.
+"""
+
+from volcano_tpu.controller.cache import JobCache, Request
+from volcano_tpu.controller.controller import JobController
+
+__all__ = ["JobCache", "JobController", "Request"]

@@ -1,0 +1,121 @@
+"""Controller-side job cache + work requests.
+
+Parity sources:
+  * JobInfo/Request — reference pkg/controllers/apis/job_info.go:27-160
+  * jobCache        — reference pkg/controllers/cache/cache.go:33-308
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from volcano_tpu.api.job import JOB_NAME_KEY, TASK_SPEC_KEY, Job
+from volcano_tpu.api.objects import Pod
+from volcano_tpu.api.types import JobAction, JobEvent, PodPhase
+
+
+@dataclass
+class Request:
+    """One unit of reconcile work (reference apis.Request)."""
+
+    namespace: str
+    job_name: str
+    task_name: str = ""
+    event: Optional[JobEvent] = None
+    exit_code: int = 0
+    action: Optional[JobAction] = None
+    job_version: int = 0
+
+    @property
+    def job_key(self) -> str:
+        return f"{self.namespace}/{self.job_name}"
+
+
+@dataclass
+class CtrlJobInfo:
+    """Cached Job + its live pods grouped by task name."""
+
+    namespace: str
+    name: str
+    job: Optional[Job] = None
+    pods: Dict[str, Dict[str, Pod]] = field(default_factory=dict)
+
+    def add_pod(self, task_name: str, pod: Pod) -> None:
+        self.pods.setdefault(task_name, {})[pod.meta.name] = pod
+
+    def delete_pod(self, task_name: str, pod: Pod) -> None:
+        task_pods = self.pods.get(task_name)
+        if task_pods:
+            task_pods.pop(pod.meta.name, None)
+            if not task_pods:
+                del self.pods[task_name]
+
+
+def _pod_task_and_job(pod: Pod):
+    task = pod.meta.annotations.get(TASK_SPEC_KEY)
+    job = pod.meta.annotations.get(JOB_NAME_KEY)
+    return task, job
+
+
+class JobCache:
+    """map[ns/name] -> CtrlJobInfo, fed by Job/Pod store events."""
+
+    def __init__(self):
+        self.jobs: Dict[str, CtrlJobInfo] = {}
+
+    def get(self, key: str) -> Optional[CtrlJobInfo]:
+        return self.jobs.get(key)
+
+    def _ensure(self, namespace: str, name: str) -> CtrlJobInfo:
+        key = f"{namespace}/{name}"
+        if key not in self.jobs:
+            self.jobs[key] = CtrlJobInfo(namespace=namespace, name=name)
+        return self.jobs[key]
+
+    # -- jobs ----------------------------------------------------------------
+
+    def add_job(self, job: Job) -> None:
+        info = self._ensure(job.meta.namespace, job.meta.name)
+        info.job = job
+
+    update_job = add_job
+
+    def delete_job(self, job: Job) -> None:
+        self.jobs.pop(job.meta.key, None)
+
+    # -- pods (keyed by the volcano annotations) -----------------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        task, job_name = _pod_task_and_job(pod)
+        if not task or not job_name:
+            return
+        self._ensure(pod.meta.namespace, job_name).add_pod(task, pod)
+
+    update_pod = add_pod
+
+    def delete_pod(self, pod: Pod) -> None:
+        task, job_name = _pod_task_and_job(pod)
+        if not task or not job_name:
+            return
+        info = self.jobs.get(f"{pod.meta.namespace}/{job_name}")
+        if info:
+            info.delete_pod(task, pod)
+
+    # -- queries -------------------------------------------------------------
+
+    def task_completed(self, job_key: str, task_name: str) -> bool:
+        """All replicas of the task succeeded (cache.go:228-260)."""
+        info = self.jobs.get(job_key)
+        if info is None or info.job is None:
+            return False
+        task_pods = info.pods.get(task_name)
+        if not task_pods:
+            return False
+        spec = info.job.task(task_name)
+        if spec is None:
+            return False
+        completed = sum(
+            1 for p in task_pods.values() if p.phase == PodPhase.SUCCEEDED
+        )
+        return completed >= spec.replicas

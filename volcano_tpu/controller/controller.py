@@ -1,0 +1,411 @@
+"""The Job controller: store-watch driven reconciler.
+
+Parity sources:
+  * controller/workers — reference pkg/controllers/job/job_controller.go:106-255
+  * event handlers     — reference pkg/controllers/job/job_controller_handler.go:38-429
+  * create/sync/kill   — reference pkg/controllers/job/job_controller_actions.go
+  * applyPolicies      — reference pkg/controllers/job/job_controller_util.go:136-185
+
+Delivery model: instead of informer goroutines, ``pump()`` drains the
+store's watch queues into the request queue and then processes every
+request — callers (the simulator, tests) interleave pumps with scheduler
+cycles and kubelet steps deterministically.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from volcano_tpu.api.job import (
+    JOB_NAME_KEY,
+    JOB_VERSION_KEY,
+    POD_GROUP_KEY,
+    TASK_SPEC_KEY,
+    Job,
+    calc_pg_min_resources,
+    make_pod_name,
+)
+from volcano_tpu.api.objects import (
+    Metadata,
+    PersistentVolumeClaim,
+    Pod,
+    PodGroup,
+)
+from volcano_tpu.api.types import (
+    JobAction,
+    JobEvent,
+    JobPhase,
+    PodGroupPhase,
+    PodPhase,
+)
+from volcano_tpu.controller.cache import CtrlJobInfo, JobCache, Request
+from volcano_tpu.controller.plugins import get_job_plugin
+from volcano_tpu.controller.state import new_state
+from volcano_tpu.store import EventType, Store
+
+
+def apply_policies(job: Job, req: Request) -> JobAction:
+    """(explicit action) > OutOfSync > stale version > task policies >
+    job policies > Sync (job_controller_util.go:136-185)."""
+    if req.action:
+        return req.action
+    if req.event == JobEvent.OUT_OF_SYNC:
+        return JobAction.SYNC_JOB
+    if req.job_version < job.status.version:
+        return JobAction.SYNC_JOB
+
+    if req.task_name:
+        task = job.task(req.task_name)
+        if task is not None:
+            for policy in task.policies:
+                if policy.event is not None and policy.event in (
+                    req.event,
+                    JobEvent.ANY,
+                ):
+                    return policy.action
+                # exit code 0 is rejected at admission, so 0 never matches
+                if policy.exit_code is not None and policy.exit_code == req.exit_code:
+                    return policy.action
+
+    for policy in job.spec.policies:
+        if policy.event is not None and policy.event in (req.event, JobEvent.ANY):
+            return policy.action
+        if policy.exit_code is not None and policy.exit_code == req.exit_code:
+            return policy.action
+
+    return JobAction.SYNC_JOB
+
+
+class JobController:
+    def __init__(self, store: Store, scheduler_name: str = "volcano-tpu"):
+        self.store = store
+        self.scheduler_name = scheduler_name
+        self.cache = JobCache()
+        self.queue: Deque[Request] = deque()
+        self.events: List[str] = []  # human-readable event log (k8s Events)
+
+        self._job_w = store.watch("Job")
+        self._pod_w = store.watch("Pod")
+        self._pg_w = store.watch("PodGroup")
+        self._cmd_w = store.watch("Command")
+
+    # -- event intake ---------------------------------------------------------
+
+    def pump(self) -> bool:
+        """Drain watches into requests, then process all requests. Returns
+        whether any work happened."""
+        worked = False
+        while self._drain_watches():
+            worked = True
+        while self.queue:
+            req = self.queue.popleft()
+            self._process(req)
+            worked = True
+        return worked
+
+    def _drain_watches(self) -> bool:
+        drained = False
+        while self._job_w:
+            self._on_job_event(self._job_w.popleft())
+            drained = True
+        while self._pod_w:
+            self._on_pod_event(self._pod_w.popleft())
+            drained = True
+        while self._pg_w:
+            self._on_pg_event(self._pg_w.popleft())
+            drained = True
+        while self._cmd_w:
+            self._on_command_event(self._cmd_w.popleft())
+            drained = True
+        return drained
+
+    def _on_job_event(self, ev) -> None:
+        job: Job = ev.obj
+        if ev.type == EventType.ADDED:
+            self.cache.add_job(job)
+            self.queue.append(
+                Request(job.meta.namespace, job.meta.name, event=JobEvent.OUT_OF_SYNC)
+            )
+        elif ev.type == EventType.UPDATED:
+            self.cache.update_job(job)
+            # reconcile on spec changes only; status churn is our own writes
+            # (job_controller_handler.go:90-96)
+            if ev.old is not None and ev.old.spec == job.spec:
+                return
+            self.queue.append(
+                Request(job.meta.namespace, job.meta.name, event=JobEvent.OUT_OF_SYNC)
+            )
+        else:
+            self.cache.delete_job(job)
+
+    def _pod_req_fields(self, pod: Pod):
+        task = pod.meta.annotations.get(TASK_SPEC_KEY)
+        job_name = pod.meta.annotations.get(JOB_NAME_KEY)
+        version = pod.meta.annotations.get(JOB_VERSION_KEY)
+        if not task or not job_name or version is None:
+            return None
+        return task, job_name, int(version)
+
+    def _on_pod_event(self, ev) -> None:
+        pod: Pod = ev.obj
+        fields = self._pod_req_fields(pod)
+        if fields is None:
+            return
+        task, job_name, version = fields
+
+        if ev.type == EventType.ADDED:
+            self.cache.add_pod(pod)
+            self.queue.append(
+                Request(
+                    pod.meta.namespace, job_name, task_name=task,
+                    event=JobEvent.OUT_OF_SYNC, job_version=version,
+                )
+            )
+        elif ev.type == EventType.UPDATED:
+            self.cache.update_pod(pod)
+            old_phase = ev.old.phase if ev.old is not None else None
+            event = JobEvent.OUT_OF_SYNC
+            exit_code = 0
+            if old_phase != PodPhase.FAILED and pod.phase == PodPhase.FAILED:
+                event = JobEvent.POD_FAILED
+                exit_code = pod.exit_code
+            elif old_phase != PodPhase.SUCCEEDED and pod.phase == PodPhase.SUCCEEDED:
+                if self.cache.task_completed(
+                    f"{pod.meta.namespace}/{job_name}", task
+                ):
+                    event = JobEvent.TASK_COMPLETED
+            self.queue.append(
+                Request(
+                    pod.meta.namespace, job_name, task_name=task,
+                    event=event, exit_code=exit_code, job_version=version,
+                )
+            )
+        else:  # DELETED -> the pod was evicted/reaped
+            self.cache.delete_pod(pod)
+            self.queue.append(
+                Request(
+                    pod.meta.namespace, job_name, task_name=task,
+                    event=JobEvent.POD_EVICTED, job_version=version,
+                )
+            )
+
+    def _on_pg_event(self, ev) -> None:
+        if ev.type != EventType.UPDATED:
+            return
+        pg: PodGroup = ev.obj
+        old_phase = ev.old.status.phase if ev.old is not None else None
+        if pg.status.phase == old_phase:
+            return
+        if pg.status.phase == PodGroupPhase.UNKNOWN:
+            self.queue.append(
+                Request(pg.meta.namespace, pg.meta.name, event=JobEvent.JOB_UNKNOWN)
+            )
+        elif pg.status.phase == PodGroupPhase.INQUEUE:
+            self.queue.append(
+                Request(pg.meta.namespace, pg.meta.name, action=JobAction.ENQUEUE_JOB)
+            )
+
+    def _on_command_event(self, ev) -> None:
+        if ev.type != EventType.ADDED:
+            return
+        cmd = ev.obj
+        # delete-first so a command executes at most once (handler.go:332)
+        self.store.delete("Command", cmd.meta.key)
+        if not cmd.target:
+            return
+        _, job_name = cmd.target
+        try:
+            action = JobAction(cmd.action)
+        except ValueError:
+            self.events.append(
+                f"UnknownCommandAction {cmd.action} {cmd.meta.namespace}/{job_name}"
+            )
+            return
+        self.events.append(f"CommandIssued {cmd.action} {cmd.meta.namespace}/{job_name}")
+        self.queue.append(
+            Request(
+                cmd.meta.namespace, job_name,
+                event=JobEvent.COMMAND_ISSUED, action=action,
+            )
+        )
+
+    # -- reconcile ------------------------------------------------------------
+
+    def _process(self, req: Request) -> None:
+        info = self.cache.get(req.job_key)
+        if info is None or info.job is None:
+            return
+        action = apply_policies(info.job, req)
+        new_state(self, info).execute(action)
+
+    # -- primitives (create/sync/kill) ----------------------------------------
+
+    def _job_plugins(self, job: Job):
+        out = []
+        for name, args in job.spec.plugins.items():
+            p = get_job_plugin(name, args)
+            if p is not None:
+                out.append(p)
+        return out
+
+    def create_job(self, info: CtrlJobInfo, update_status) -> None:
+        """Prepare a job: plugins, PodGroup, volume claims
+        (job_controller_actions.go:137-171). Pods come from the later
+        EnqueueAction-driven sync."""
+        job = info.job
+
+        for plugin in self._job_plugins(job):
+            plugin.on_job_add(job, self.store)
+
+        if self.store.get("PodGroup", job.meta.key) is None:
+            pg = PodGroup(
+                meta=Metadata(
+                    name=job.meta.name,
+                    namespace=job.meta.namespace,
+                    owner=("Job", job.meta.name),
+                ),
+                min_member=job.spec.min_available,
+                queue=job.spec.queue,
+                priority_class_name=job.spec.priority_class,
+                min_resources=calc_pg_min_resources(job),
+            )
+            self.store.create("PodGroup", pg)
+
+        for i, vol in enumerate(job.spec.volumes):
+            # generated claim names are written back into the spec so later
+            # reconciles (and the pods) find the same claim (the reference's
+            # needUpdateForVolumeClaim round-trip, actions.go:143-155)
+            if not vol.volume_claim_name:
+                vol.volume_claim_name = f"{job.meta.name}-pvc-{i}"
+            name = vol.volume_claim_name
+            key = f"{job.meta.namespace}/{name}"
+            if self.store.get("PVC", key) is None:
+                self.store.create(
+                    "PVC",
+                    PersistentVolumeClaim(
+                        meta=Metadata(
+                            name=name,
+                            namespace=job.meta.namespace,
+                            owner=("Job", job.meta.name),
+                        ),
+                        size=vol.size,
+                    ),
+                )
+                job.status.controlled_resources[f"volume-{name}"] = name
+
+        if update_status is not None:
+            update_status(job.status)
+        self._write_status(job)
+
+    def _create_job_pod(self, job: Job, task, index: int) -> Pod:
+        """Pod from template: owner ref, linking annotations, scheduler name
+        (job_controller_util.go:49-134)."""
+        import copy
+
+        spec = copy.deepcopy(task.template)
+        spec.scheduler_name = job.spec.scheduler_name
+        pod = Pod(
+            meta=Metadata(
+                name=make_pod_name(job.meta.name, task.name, index),
+                namespace=job.meta.namespace,
+                owner=("Job", job.meta.name),
+                annotations={
+                    TASK_SPEC_KEY: task.name,
+                    JOB_NAME_KEY: job.meta.name,
+                    JOB_VERSION_KEY: str(job.status.version),
+                    POD_GROUP_KEY: job.meta.name,
+                },
+                labels={
+                    TASK_SPEC_KEY: task.name,
+                    JOB_NAME_KEY: job.meta.name,
+                },
+            ),
+            spec=spec,
+        )
+        pod.volumes.extend(
+            v.volume_claim_name for v in job.spec.volumes if v.volume_claim_name
+        )
+        for plugin in self._job_plugins(job):
+            plugin.on_pod_create(pod, job, index)
+        return pod
+
+    def sync_job(self, info: CtrlJobInfo, update_status) -> None:
+        """Diff desired pods vs cached pods; create/delete; recount statuses
+        (job_controller_actions.go:174-320)."""
+        job = info.job
+        pending = running = terminating = succeeded = failed = 0
+
+        to_create = []
+        to_delete = []
+        for task in job.spec.tasks:
+            have = dict(info.pods.get(task.name, {}))
+            for i in range(task.replicas):
+                pod_name = make_pod_name(job.meta.name, task.name, i)
+                pod = have.pop(pod_name, None)
+                if pod is None:
+                    to_create.append((task, i))
+                elif pod.deleting:
+                    terminating += 1
+                elif pod.phase == PodPhase.PENDING:
+                    pending += 1
+                elif pod.phase == PodPhase.RUNNING:
+                    running += 1
+                elif pod.phase == PodPhase.SUCCEEDED:
+                    succeeded += 1
+                elif pod.phase == PodPhase.FAILED:
+                    failed += 1
+            to_delete.extend(have.values())  # replicas scaled down
+
+        for task, i in to_create:
+            pod = self._create_job_pod(job, task, i)
+            if self.store.get("Pod", pod.meta.key) is None:
+                self.store.create("Pod", pod)
+            pending += 1
+        for pod in to_delete:
+            if not pod.deleting:
+                pod.deleting = True
+                self.store.update("Pod", pod)
+            terminating += 1
+
+        self._replace_counts(job, pending, running, succeeded, failed, terminating)
+        if update_status is not None:
+            update_status(job.status)
+        self._write_status(job)
+
+    def kill_job(self, info: CtrlJobInfo, update_status) -> None:
+        """Delete all pods, bump version, drop PodGroup, plugin teardown
+        (job_controller_actions.go:39-137)."""
+        job = info.job
+        job.status.version += 1
+
+        pending = running = terminating = succeeded = failed = 0
+        for task_pods in info.pods.values():
+            for pod in list(task_pods.values()):
+                if not pod.deleting:
+                    pod.deleting = True
+                    self.store.update("Pod", pod)
+                terminating += 1
+
+        self._replace_counts(job, pending, running, succeeded, failed, terminating)
+        if update_status is not None:
+            update_status(job.status)
+        self._write_status(job)
+
+        if self.store.get("PodGroup", job.meta.key) is not None:
+            self.store.delete("PodGroup", job.meta.key)
+        for plugin in self._job_plugins(job):
+            plugin.on_job_delete(job, self.store)
+
+    # -- helpers --------------------------------------------------------------
+
+    def _replace_counts(self, job, pending, running, succeeded, failed, terminating):
+        st = job.status
+        st.pending, st.running = pending, running
+        st.succeeded, st.failed = succeeded, failed
+        st.terminating = terminating
+        st.min_available = job.spec.min_available
+
+    def _write_status(self, job: Job) -> None:
+        if self.store.get("Job", job.meta.key) is not None:
+            self.store.update("Job", job)

@@ -1,0 +1,186 @@
+"""Controller job plugins: inject distributed-training plumbing into pods.
+
+Parity sources:
+  * interface/registry — reference pkg/controllers/job/plugins/{interface/interface.go:26-42,factory.go:27-54}
+  * env — reference .../plugins/env/env.go:45-56 (VK_TASK_INDEX)
+  * svc — reference .../plugins/svc/svc.go:53-197 (headless Service +
+    hostfile ConfigMap with ``<task>.host`` rows, hostname/subdomain)
+  * ssh — reference .../plugins/ssh/ssh.go:62-220 (keypair ConfigMap
+    mounted into ~/.ssh)
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+from typing import Callable, Dict, List, Optional
+
+from volcano_tpu.api.job import JOB_NAME_KEY, Job, make_pod_name
+from volcano_tpu.api.objects import ConfigMap, Metadata, Pod, Service
+
+TASK_INDEX_ENV = "VT_TASK_INDEX"
+CONFIGMAP_MOUNT = "/etc/volcano"
+SSH_MOUNT = "/root/.ssh"
+
+
+class JobPlugin:
+    name = "plugin"
+
+    def __init__(self, arguments: Optional[List[str]] = None):
+        self.arguments = arguments or []
+
+    def on_pod_create(self, pod: Pod, job: Job, index: int) -> None:
+        pass
+
+    def on_job_add(self, job: Job, store) -> None:
+        pass
+
+    def on_job_delete(self, job: Job, store) -> None:
+        pass
+
+    def _controlled(self, job: Job) -> bool:
+        return job.status.controlled_resources.get(f"plugin-{self.name}") == self.name
+
+    def _mark(self, job: Job) -> None:
+        job.status.controlled_resources[f"plugin-{self.name}"] = self.name
+
+
+class EnvPlugin(JobPlugin):
+    """Exposes the task replica index to each pod (env/env.go:45-56)."""
+
+    name = "env"
+
+    def on_pod_create(self, pod: Pod, job: Job, index: int) -> None:
+        pod.env[TASK_INDEX_ENV] = str(index)
+
+    def on_job_add(self, job: Job, store) -> None:
+        self._mark(job)
+
+
+class SvcPlugin(JobPlugin):
+    """Headless service + hostfile ConfigMap for task DNS discovery."""
+
+    name = "svc"
+
+    def _cm_name(self, job: Job) -> str:
+        return f"{job.meta.name}-{self.name}"
+
+    def on_pod_create(self, pod: Pod, job: Job, index: int) -> None:
+        if not pod.hostname:
+            pod.hostname = pod.meta.name
+        if not pod.subdomain:
+            pod.subdomain = job.meta.name
+        pod.volumes.append(self._cm_name(job))
+
+    def on_job_add(self, job: Job, store) -> None:
+        if self._controlled(job):
+            return
+        data = {}
+        for ts in job.spec.tasks:
+            hosts = [
+                f"{make_pod_name(job.meta.name, ts.name, i)}.{job.meta.name}"
+                for i in range(ts.replicas)
+            ]
+            data[f"{ts.name}.host"] = "\n".join(hosts)
+        cm_name = self._cm_name(job)
+        if store.get("ConfigMap", f"{job.meta.namespace}/{cm_name}") is None:
+            store.create(
+                "ConfigMap",
+                ConfigMap(
+                    meta=Metadata(
+                        name=cm_name,
+                        namespace=job.meta.namespace,
+                        owner=("Job", job.meta.name),
+                    ),
+                    data=data,
+                ),
+            )
+        if store.get("Service", job.meta.key) is None:
+            store.create(
+                "Service",
+                Service(
+                    meta=Metadata(
+                        name=job.meta.name,
+                        namespace=job.meta.namespace,
+                        owner=("Job", job.meta.name),
+                    ),
+                    cluster_ip="None",
+                    selector={JOB_NAME_KEY: job.meta.name},
+                ),
+            )
+        self._mark(job)
+
+    def on_job_delete(self, job: Job, store) -> None:
+        store.delete("ConfigMap", f"{job.meta.namespace}/{self._cm_name(job)}")
+        store.delete("Service", job.meta.key)
+
+
+class SshPlugin(JobPlugin):
+    """Shared keypair ConfigMap so tasks can rsh each other.
+
+    The simulator has no real sshd; the keypair is a deterministic opaque
+    token per job (the reference generates RSA-1024 — ssh.go:120-152).
+    What matters for parity is the ConfigMap contract: id_rsa,
+    id_rsa.pub, authorized_keys, config keys mounted at ~/.ssh.
+    """
+
+    name = "ssh"
+
+    def _cm_name(self, job: Job) -> str:
+        return f"{job.meta.name}-{self.name}"
+
+    def _keypair(self, job: Job):
+        seed = hashlib.sha256(f"{job.meta.uid}-ssh".encode()).digest()
+        priv = base64.b64encode(seed * 8).decode()
+        pub = "ssh-rsa " + base64.b64encode(seed).decode() + " volcano-tpu"
+        return priv, pub
+
+    def on_pod_create(self, pod: Pod, job: Job, index: int) -> None:
+        pod.volumes.append(self._cm_name(job))
+
+    def on_job_add(self, job: Job, store) -> None:
+        if self._controlled(job):
+            return
+        priv, pub = self._keypair(job)
+        cm_name = self._cm_name(job)
+        if store.get("ConfigMap", f"{job.meta.namespace}/{cm_name}") is None:
+            store.create(
+                "ConfigMap",
+                ConfigMap(
+                    meta=Metadata(
+                        name=cm_name,
+                        namespace=job.meta.namespace,
+                        owner=("Job", job.meta.name),
+                    ),
+                    data={
+                        "id_rsa": priv,
+                        "id_rsa.pub": pub,
+                        "authorized_keys": pub,
+                        "config": "StrictHostKeyChecking no\nUserKnownHostsFile /dev/null\n",
+                    },
+                ),
+            )
+        self._mark(job)
+
+    def on_job_delete(self, job: Job, store) -> None:
+        store.delete("ConfigMap", f"{job.meta.namespace}/{self._cm_name(job)}")
+
+
+_PLUGIN_BUILDERS: Dict[str, Callable[[List[str]], JobPlugin]] = {
+    "env": EnvPlugin,
+    "svc": SvcPlugin,
+    "ssh": SshPlugin,
+}
+
+
+def get_job_plugin(name: str, arguments: List[str]) -> Optional[JobPlugin]:
+    builder = _PLUGIN_BUILDERS.get(name)
+    return builder(arguments) if builder else None
+
+
+def known_job_plugins() -> List[str]:
+    return sorted(_PLUGIN_BUILDERS)
+
+
+def register_job_plugin(name: str, builder) -> None:
+    _PLUGIN_BUILDERS[name] = builder

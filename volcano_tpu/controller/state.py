@@ -1,0 +1,210 @@
+"""Job phase state machine: (phase, action) -> kill/sync/create + transition.
+
+Parity source: reference pkg/controllers/job/state/*.go (11 states). Each
+state maps the incoming action to one of the controller's three primitives
+(kill_job / sync_job / create_job) plus a status-transition closure that
+runs AFTER the primitive recounts pod statuses — e.g. "Restarting if any
+pod is still terminating, else Pending".
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.job import DEFAULT_MAX_RETRY, Job
+from volcano_tpu.api.types import JobAction, JobPhase
+
+
+def _total_tasks(job: Job) -> int:
+    return job.spec.total_replicas()
+
+
+def _alive(status) -> bool:
+    return status.terminating != 0 or status.pending != 0 or status.running != 0
+
+
+class State:
+    def __init__(self, ctl, info):
+        self.ctl = ctl
+        self.info = info
+
+    def execute(self, action: JobAction) -> None:
+        raise NotImplementedError
+
+    # transition helpers shared by several states -----------------------------
+
+    def _kill_to(self, settled: JobPhase, busy: JobPhase, bump_retry: bool = False):
+        """Kill; phase becomes ``busy`` while pods are terminating, else
+        ``settled`` (the pending/inqueue/running Restart/Abort/Complete
+        pattern)."""
+
+        def update(status):
+            if status.terminating != 0:
+                status.state.phase = busy
+                if bump_retry:
+                    status.retry_count += 1
+            else:
+                status.state.phase = settled
+
+        self.ctl.kill_job(self.info, update)
+
+
+class PendingState(State):
+    def execute(self, action: JobAction) -> None:
+        job = self.info.job
+        if action == JobAction.RESTART_JOB:
+            self._kill_to(JobPhase.PENDING, JobPhase.RESTARTING, bump_retry=True)
+        elif action == JobAction.ABORT_JOB:
+            self._kill_to(JobPhase.PENDING, JobPhase.ABORTING)
+        elif action == JobAction.COMPLETE_JOB:
+            self._kill_to(JobPhase.COMPLETED, JobPhase.COMPLETING)
+        elif action == JobAction.ENQUEUE_JOB:
+            def update(status):
+                done = status.running + status.succeeded + status.failed
+                status.state.phase = (
+                    JobPhase.RUNNING
+                    if job.spec.min_available <= done
+                    else JobPhase.INQUEUE
+                )
+
+            self.ctl.sync_job(self.info, update)
+        else:
+            self.ctl.create_job(self.info, None)
+
+
+class InqueueState(State):
+    def execute(self, action: JobAction) -> None:
+        job = self.info.job
+        if action == JobAction.RESTART_JOB:
+            self._kill_to(JobPhase.PENDING, JobPhase.RESTARTING, bump_retry=True)
+        elif action == JobAction.ABORT_JOB:
+            self._kill_to(JobPhase.PENDING, JobPhase.ABORTING)
+        elif action == JobAction.COMPLETE_JOB:
+            self._kill_to(JobPhase.COMPLETED, JobPhase.COMPLETING)
+        else:
+            def update(status):
+                done = status.running + status.succeeded + status.failed
+                status.state.phase = (
+                    JobPhase.RUNNING
+                    if job.spec.min_available <= done
+                    else JobPhase.INQUEUE
+                )
+
+            self.ctl.sync_job(self.info, update)
+
+
+class RunningState(State):
+    def execute(self, action: JobAction) -> None:
+        job = self.info.job
+        if action == JobAction.RESTART_JOB:
+            self._kill_to(JobPhase.RUNNING, JobPhase.RESTARTING, bump_retry=True)
+        elif action == JobAction.ABORT_JOB:
+            self._kill_to(JobPhase.RUNNING, JobPhase.ABORTING)
+        elif action == JobAction.TERMINATE_JOB:
+            self._kill_to(JobPhase.RUNNING, JobPhase.TERMINATING)
+        elif action == JobAction.COMPLETE_JOB:
+            self._kill_to(JobPhase.COMPLETED, JobPhase.COMPLETING)
+        else:
+            def update(status):
+                status.state.phase = (
+                    JobPhase.COMPLETED
+                    if status.succeeded + status.failed == _total_tasks(job)
+                    and _total_tasks(job) > 0
+                    else JobPhase.RUNNING
+                )
+
+            self.ctl.sync_job(self.info, update)
+
+
+class RestartingState(State):
+    def execute(self, action: JobAction) -> None:
+        job = self.info.job
+
+        def update(status):
+            max_retry = job.spec.max_retry or DEFAULT_MAX_RETRY
+            if status.retry_count >= max_retry:
+                status.state.phase = JobPhase.FAILED
+            elif status.terminating == 0:
+                status.state.phase = (
+                    JobPhase.RUNNING
+                    if status.running >= job.spec.min_available
+                    else JobPhase.PENDING
+                )
+            else:
+                status.state.phase = JobPhase.RESTARTING
+
+        self.ctl.sync_job(self.info, update)
+
+
+class AbortingState(State):
+    def execute(self, action: JobAction) -> None:
+        if action == JobAction.RESUME_JOB:
+            def update(status):
+                status.state.phase = JobPhase.RESTARTING
+                status.retry_count += 1
+
+            self.ctl.sync_job(self.info, update)
+        else:
+            def update(status):
+                status.state.phase = (
+                    JobPhase.ABORTING if _alive(status) else JobPhase.ABORTED
+                )
+
+            self.ctl.kill_job(self.info, update)
+
+
+class AbortedState(State):
+    def execute(self, action: JobAction) -> None:
+        if action == JobAction.RESUME_JOB:
+            def update(status):
+                status.state.phase = JobPhase.RESTARTING
+                status.retry_count += 1
+
+            self.ctl.sync_job(self.info, update)
+        else:
+            self.ctl.kill_job(self.info, None)
+
+
+class CompletingState(State):
+    def execute(self, action: JobAction) -> None:
+        def update(status):
+            status.state.phase = (
+                JobPhase.COMPLETING if _alive(status) else JobPhase.COMPLETED
+            )
+
+        self.ctl.kill_job(self.info, update)
+
+
+class TerminatingState(State):
+    def execute(self, action: JobAction) -> None:
+        def update(status):
+            status.state.phase = (
+                JobPhase.TERMINATING if _alive(status) else JobPhase.TERMINATED
+            )
+
+        self.ctl.kill_job(self.info, update)
+
+
+class FinishedState(State):
+    """Terminated/Completed/Failed: always ensure everything is killed."""
+
+    def execute(self, action: JobAction) -> None:
+        self.ctl.kill_job(self.info, None)
+
+
+_STATES = {
+    JobPhase.PENDING: PendingState,
+    JobPhase.INQUEUE: InqueueState,
+    JobPhase.RUNNING: RunningState,
+    JobPhase.RESTARTING: RestartingState,
+    JobPhase.ABORTING: AbortingState,
+    JobPhase.ABORTED: AbortedState,
+    JobPhase.COMPLETING: CompletingState,
+    JobPhase.TERMINATING: TerminatingState,
+    JobPhase.TERMINATED: FinishedState,
+    JobPhase.COMPLETED: FinishedState,
+    JobPhase.FAILED: FinishedState,
+}
+
+
+def new_state(ctl, info) -> State:
+    phase = info.job.status.state.phase if info.job else JobPhase.PENDING
+    return _STATES.get(phase, PendingState)(ctl, info)

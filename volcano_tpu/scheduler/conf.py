@@ -67,6 +67,18 @@ def default_conf(backend: str = "host") -> SchedulerConf:
     )
 
 
+def full_conf(backend: str = "host") -> SchedulerConf:
+    """All five actions + all seven plugins — the reference's fully-loaded
+    deployment config (example/kube-batch-conf.yaml)."""
+    conf = default_conf(backend)
+    # exact action order of the deployed config (installer chart
+    # config/kube-batch.conf): reclaim before allocate so freed capacity
+    # is claimable within the same cycle
+    conf.actions = ["enqueue", "reclaim", "allocate", "backfill", "preempt"]
+    conf.tiers[0].plugins.append(PluginOption("conformance"))
+    return conf
+
+
 def load_conf(text: str) -> SchedulerConf:
     """Parse a scheduler-conf YAML string (same shape as the reference's)."""
     import yaml

@@ -65,25 +65,22 @@ def get_plugin_builder(name: str):
 
 
 def open_session(cache, tiers: List[Tier]) -> Session:
-    """Snapshot the cluster, gate invalid jobs, run plugin OnSessionOpen."""
+    """Snapshot the cluster, gate invalid jobs, run plugin OnSessionOpen.
+
+    Ordering parity matters: the reference runs the JobValid gate inside
+    openSession BEFORE any plugin's OnSessionOpen registers callbacks
+    (framework.go:30-50 calls openSession first), so at gate time the
+    job_valid registry is empty and no job is ever dropped — pod-less
+    PodGroups must survive into the session for the enqueue action to
+    admit them (the controller only creates pods after Inqueue).
+    """
     cluster = cache.snapshot()
     ssn = Session(cache, tiers, cluster)
 
-    for tier in tiers:
-        for opt in tier.plugins:
-            builder = get_plugin_builder(opt.name)
-            if builder is None:
-                continue
-            if opt.name not in ssn.plugins:
-                ssn.plugins[opt.name] = builder(opt.arguments)
-
-    for plugin in ssn.plugins.values():
-        start = time.perf_counter()
-        plugin.on_session_open(ssn)
-        metrics.update_plugin_duration(plugin.name, "OnSessionOpen", start)
-
     # JobValid gate (session.go:89-108): invalid jobs get an Unschedulable
-    # condition written and are dropped from the session.
+    # condition written and are dropped from the session. With the
+    # reference's ordering the registry is empty here, so this never
+    # fires; it is kept for plugins registered out-of-band.
     for uid, job in list(ssn.jobs.items()):
         vr = ssn.job_valid(job)
         if vr is not None and not vr.passed:
@@ -99,6 +96,19 @@ def open_session(cache, tiers: List[Tier]) -> Session:
                 ] + [cond]
                 cache.update_job_status(job)
             del ssn.jobs[uid]
+
+    for tier in tiers:
+        for opt in tier.plugins:
+            builder = get_plugin_builder(opt.name)
+            if builder is None:
+                continue
+            if opt.name not in ssn.plugins:
+                ssn.plugins[opt.name] = builder(opt.arguments)
+
+    for plugin in ssn.plugins.values():
+        start = time.perf_counter()
+        plugin.on_session_open(ssn)
+        metrics.update_plugin_duration(plugin.name, "OnSessionOpen", start)
 
     return ssn
 

@@ -1,0 +1,11 @@
+"""Simulated cluster: the e2e substrate replacing the reference's kind rig.
+
+The reference tests multi-node behavior with Docker-in-docker kind clusters
+(hack/run-e2e-kind.sh). Here a ``Cluster`` wires the store, scheduler,
+controller, and a simulated kubelet together with deterministic stepping —
+fault injection is just mutating pods.
+"""
+
+from volcano_tpu.sim.cluster import Cluster
+
+__all__ = ["Cluster"]

@@ -1,0 +1,130 @@
+"""Cluster: store + scheduler + controller + simulated kubelet.
+
+The kubelet model: a bound pod (node_name set) starts Running on the next
+kubelet step; a pod marked ``deleting`` is reaped (deleted from the store)
+on the next step — the window in between is exactly the reference's
+Releasing state that pipelined tasks wait on (SURVEY.md §3.5).
+
+Fault injection mirrors the reference e2e suite's "kill pods via API"
+approach (job_error_handling.go:142+): ``fail_pod`` / ``complete_pod`` /
+``evict_pod`` mutate pod phase through the store so every watcher sees the
+same event stream a real kubelet would produce.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from volcano_tpu.api.objects import Metadata, Node, PriorityClass, Queue
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import PodPhase
+from volcano_tpu.controller import JobController
+from volcano_tpu.scheduler.conf import full_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.store import Store
+
+
+class Cluster:
+    def __init__(
+        self,
+        scheduler_conf=None,
+        with_controller: bool = True,
+        with_scheduler: bool = True,
+    ):
+        self.store = Store()
+        self.controller: Optional[JobController] = (
+            JobController(self.store) if with_controller else None
+        )
+        self.scheduler: Optional[Scheduler] = None
+        if with_scheduler:
+            self.scheduler = Scheduler(self.store, conf=scheduler_conf or full_conf())
+
+    # -- topology -------------------------------------------------------------
+
+    def add_queue(self, name: str, weight: int = 1) -> Queue:
+        return self.store.create(
+            "Queue", Queue(meta=Metadata(name=name, namespace=""), weight=weight)
+        )
+
+    def add_node(self, name: str, resources=None, **node_kw) -> Node:
+        alloc = (
+            resources
+            if isinstance(resources, Resource)
+            else Resource.from_resource_list(resources or {"cpu": "4", "memory": "8Gi"})
+        )
+        return self.store.create(
+            "Node",
+            Node(meta=Metadata(name=name, namespace=""), allocatable=alloc, **node_kw),
+        )
+
+    def add_priority_class(self, name: str, value: int, global_default=False):
+        return self.store.create(
+            "PriorityClass",
+            PriorityClass(
+                meta=Metadata(name=name, namespace=""),
+                value=value,
+                global_default=global_default,
+            ),
+        )
+
+    # -- kubelet --------------------------------------------------------------
+
+    def kubelet_step(self) -> bool:
+        """One pass of the simulated kubelets over all pods."""
+        changed = False
+        for pod in self.store.items("Pod"):
+            if pod.deleting:
+                self.store.delete("Pod", pod.meta.key)
+                changed = True
+            elif pod.node_name and pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                self.store.update("Pod", pod)
+                changed = True
+        return changed
+
+    # -- fault injection ------------------------------------------------------
+
+    def fail_pod(self, key: str, exit_code: int = 1) -> None:
+        pod = self.store.get("Pod", key)
+        pod.phase = PodPhase.FAILED
+        pod.exit_code = exit_code
+        self.store.update("Pod", pod)
+
+    def complete_pod(self, key: str) -> None:
+        pod = self.store.get("Pod", key)
+        pod.phase = PodPhase.SUCCEEDED
+        self.store.update("Pod", pod)
+
+    def evict_pod(self, key: str) -> None:
+        pod = self.store.get("Pod", key)
+        pod.deleting = True
+        self.store.update("Pod", pod)
+
+    # -- stepping -------------------------------------------------------------
+
+    def pump_controller(self) -> bool:
+        return self.controller.pump() if self.controller else False
+
+    def schedule_once(self) -> bool:
+        if self.scheduler is None:
+            return False
+        rv = self.store.resource_version
+        self.scheduler.run_once()
+        return self.store.resource_version != rv
+
+    def step(self) -> bool:
+        """controller pump -> scheduler cycle -> kubelet; True if anything
+        moved."""
+        moved = self.pump_controller()
+        moved |= self.schedule_once()
+        moved |= self.kubelet_step()
+        moved |= self.pump_controller()
+        return moved
+
+    def run_until_idle(self, max_steps: int = 64) -> int:
+        """Step until quiescent; returns steps taken. The equivalent of the
+        reference e2e's phase-waiter polling loops."""
+        for i in range(max_steps):
+            if not self.step():
+                return i
+        raise RuntimeError(f"cluster did not quiesce in {max_steps} steps")

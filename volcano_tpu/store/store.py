@@ -53,6 +53,11 @@ class Store:
     def _watched(self, kind: str) -> bool:
         return bool(self._watchers[kind])
 
+    @property
+    def resource_version(self) -> int:
+        """Monotonic global version; bumps on every create/update."""
+        return self._rv
+
     # -- CRUD ---------------------------------------------------------------
 
     def create(self, kind: str, obj: Any) -> Any:
@@ -70,6 +75,11 @@ class Store:
         if key not in self._objects[kind]:
             raise KeyError(f"{kind} {key} not found")
         old = self._shadow[kind].get(key)
+        # no-op writes don't bump the version or fan out events — callers
+        # (scheduler close_session, controller status writers) write
+        # unconditionally each cycle and rely on this for quiescence
+        if old is not None and old == obj:
+            return obj
         self._rv += 1
         obj.meta.resource_version = self._rv
         self._objects[kind][key] = obj
@@ -101,12 +111,13 @@ class Store:
         return q
 
     def _notify(self, ev: Event) -> None:
-        if self._watched(ev.kind):
-            import copy
+        import copy
 
-            for q in self._watchers[ev.kind]:
-                q.append(ev)
-            self._shadow[ev.kind][ev.obj.meta.key] = copy.deepcopy(ev.obj)
+        for q in self._watchers[ev.kind]:
+            q.append(ev)
+        # shadow every kind (not just watched ones): update() compares
+        # against it to suppress no-op writes, which quiescence relies on
+        self._shadow[ev.kind][ev.obj.meta.key] = copy.deepcopy(ev.obj)
 
     def pending_events(self) -> bool:
         return any(q for qs in self._watchers.values() for q in qs)
