@@ -1,0 +1,160 @@
+"""Admission validation/mutation, mirroring reference test/e2e/admission.go
+scenarios plus the policy matrix from admit_job.go."""
+
+import pytest
+
+from volcano_tpu.admission import (
+    AdmissionError,
+    mutate_job,
+    validate_job,
+    validate_job_update,
+)
+from volcano_tpu.api.job import Job, JobSpec, LifecyclePolicy, TaskSpec, VolumeSpec
+from volcano_tpu.api.objects import Metadata, PodSpec
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobAction, JobEvent
+from volcano_tpu.sim import Cluster
+
+
+def mk_task(name="main", replicas=1, policies=None):
+    return TaskSpec(
+        name=name,
+        replicas=replicas,
+        template=PodSpec(resources=Resource.from_resource_list({"cpu": "1"})),
+        policies=policies or [],
+    )
+
+
+def mk_job(**spec_kw):
+    spec_kw.setdefault("tasks", [mk_task()])
+    spec_kw.setdefault("min_available", 1)
+    return Job(meta=Metadata(name="j", namespace="test"), spec=JobSpec(**spec_kw))
+
+
+def test_valid_job_passes():
+    ok, msg = validate_job(mk_job())
+    assert ok, msg
+
+
+def test_negative_min_available_rejected():
+    ok, msg = validate_job(mk_job(min_available=-1))
+    assert not ok and "minAvailable" in msg
+
+
+def test_no_tasks_rejected():
+    ok, msg = validate_job(mk_job(tasks=[]))
+    assert not ok and "No task" in msg
+
+
+def test_nonpositive_replicas_rejected():
+    ok, msg = validate_job(mk_job(tasks=[mk_task(replicas=0)]))
+    assert not ok and "replicas" in msg
+
+
+def test_bad_task_name_rejected():
+    ok, msg = validate_job(mk_job(tasks=[mk_task(name="Bad_Name")]))
+    assert not ok and "DNS-1123" in msg
+
+
+def test_duplicate_task_name_rejected():
+    ok, msg = validate_job(
+        mk_job(tasks=[mk_task(name="a"), mk_task(name="a")], min_available=2)
+    )
+    assert not ok and "duplicated task name" in msg
+
+
+def test_min_available_exceeds_replicas_rejected():
+    ok, msg = validate_job(mk_job(min_available=5))
+    assert not ok and "minAvailable" in msg
+
+
+def test_policy_event_and_exit_code_rejected():
+    pol = LifecyclePolicy(
+        action=JobAction.RESTART_JOB, event=JobEvent.POD_FAILED, exit_code=3
+    )
+    ok, msg = validate_job(mk_job(policies=[pol]))
+    assert not ok and "simultaneously" in msg
+
+
+def test_policy_neither_event_nor_exit_code_rejected():
+    pol = LifecyclePolicy(action=JobAction.RESTART_JOB)
+    ok, msg = validate_job(mk_job(policies=[pol]))
+    assert not ok
+
+
+def test_exit_code_zero_rejected():
+    pol = LifecyclePolicy(action=JobAction.RESTART_JOB, exit_code=0)
+    ok, msg = validate_job(mk_job(policies=[pol]))
+    assert not ok and "0 is not a valid error code" in msg
+
+
+def test_duplicate_policy_event_rejected():
+    pols = [
+        LifecyclePolicy(action=JobAction.RESTART_JOB, event=JobEvent.POD_FAILED),
+        LifecyclePolicy(action=JobAction.ABORT_JOB, event=JobEvent.POD_FAILED),
+    ]
+    ok, msg = validate_job(mk_job(policies=pols))
+    assert not ok and "duplicated job event policies" in msg
+
+
+def test_any_event_exclusive():
+    pols = [
+        LifecyclePolicy(action=JobAction.RESTART_JOB, event=JobEvent.ANY),
+        LifecyclePolicy(action=JobAction.ABORT_JOB, event=JobEvent.POD_FAILED),
+    ]
+    ok, msg = validate_job(mk_job(policies=pols))
+    assert not ok and "*" in msg
+
+
+def test_internal_event_action_rejected():
+    ok, msg = validate_job(
+        mk_job(policies=[LifecyclePolicy(action=JobAction.SYNC_JOB,
+                                         event=JobEvent.POD_FAILED)])
+    )
+    assert not ok and "invalid policy action" in msg
+
+
+def test_unknown_plugin_rejected():
+    ok, msg = validate_job(mk_job(plugins={"nope": []}))
+    assert not ok and "job plugin" in msg
+
+
+def test_volume_validation():
+    ok, msg = validate_job(mk_job(volumes=[VolumeSpec(mount_path="")]))
+    assert not ok and "mountPath is required" in msg
+    ok, msg = validate_job(
+        mk_job(volumes=[VolumeSpec(mount_path="/d"), VolumeSpec(mount_path="/d")])
+    )
+    assert not ok and "duplicated mountPath" in msg
+
+
+def test_update_spec_frozen():
+    import copy
+
+    old = mk_job()
+    new = copy.deepcopy(old)
+    ok, _ = validate_job_update(new, old)
+    assert ok
+    new.spec.min_available = 0
+    ok, msg = validate_job_update(new, old)
+    assert not ok and "not allowed to modify" in msg
+
+
+def test_mutate_defaults_queue_and_task_names():
+    job = mk_job(tasks=[TaskSpec(name="", replicas=1), TaskSpec(name="", replicas=1)])
+    job.spec.queue = ""
+    mutate_job(job)
+    assert job.spec.queue == "default"
+    assert [t.name for t in job.spec.tasks] == ["default0", "default1"]
+
+
+def test_cluster_submit_path_enforces_admission():
+    c = Cluster(with_scheduler=False)
+    with pytest.raises(AdmissionError):
+        c.submit_job(mk_job(min_available=9))
+
+    job = mk_job()
+    job.spec.queue = ""
+    c.submit_job(job)
+    assert job.spec.queue == "default"
+    assert c.store.get("Job", "test/j") is not None

@@ -1,0 +1,88 @@
+"""vtctl command surface, mirroring reference test/e2e/command.go."""
+
+import pytest
+
+from volcano_tpu.api.types import JobPhase
+from volcano_tpu.cli import cmd_list, cmd_resume, cmd_run, cmd_suspend
+from volcano_tpu.sim import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    c.add_queue("default")
+    c.add_node("n0", {"cpu": "8", "memory": "16Gi", "pods": 110})
+    return c
+
+
+def test_run_and_list(cluster):
+    cmd_run(cluster.store, name="cli-job", replicas=2, min_available=2)
+    cluster.run_until_idle()
+    job = cluster.store.get("Job", "default/cli-job")
+    assert job.status.state.phase == JobPhase.RUNNING
+
+    text = cmd_list(cluster.store)
+    lines = text.splitlines()
+    assert lines[0].split()[:3] == ["Name", "Creation", "Phase"]
+    row = [ln for ln in lines if ln.startswith("cli-job")][0].split()
+    assert row[2] == "Running"
+    assert row[3] == "2"  # replicas
+
+
+def test_list_empty(cluster):
+    assert "No resources found" in cmd_list(cluster.store)
+
+
+def test_suspend_resume_roundtrip(cluster):
+    cmd_run(cluster.store, name="sr", replicas=2, min_available=2)
+    cluster.run_until_idle()
+    job = cluster.store.get("Job", "default/sr")
+    assert job.status.state.phase == JobPhase.RUNNING
+
+    cmd_suspend(cluster.store, "default", "sr")
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.ABORTED
+    assert cluster.store.list("Pod") == []
+
+    cmd_resume(cluster.store, "default", "sr")
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.RUNNING
+    assert len(cluster.store.list("Pod")) == 2
+
+
+def test_suspend_pending_job(cluster):
+    # job too big to schedule stays pending; suspend still aborts it
+    cmd_run(cluster.store, name="pend", replicas=4, min_available=4,
+            requests="cpu=4000m,memory=1Gi")
+    cluster.run_until_idle()
+    job = cluster.store.get("Job", "default/pend")
+    assert job.status.state.phase in (JobPhase.PENDING, JobPhase.INQUEUE)
+
+    cmd_suspend(cluster.store, "default", "pend")
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.ABORTED
+
+
+def test_run_rejected_by_admission(cluster):
+    from volcano_tpu.admission import AdmissionError
+
+    with pytest.raises(AdmissionError):
+        cmd_run(cluster.store, name="bad", replicas=1, min_available=5)
+
+
+def test_suspend_unknown_job(cluster):
+    with pytest.raises(KeyError):
+        cmd_suspend(cluster.store, "default", "ghost")
+
+
+def test_main_entry_roundtrip(tmp_path):
+    from volcano_tpu.cli.vtctl import main
+
+    state = str(tmp_path / "state.pkl")
+    assert main(["--state", state, "cluster", "init", "--nodes", "2"]) == 0
+    assert main(["--state", state, "job", "run", "--name", "m1",
+                 "--replicas", "2", "--min", "2"]) == 0
+    assert main(["--state", state, "job", "list"]) == 0
+    assert main(["--state", state, "job", "suspend", "--name", "m1"]) == 0
+    assert main(["--state", state, "job", "resume", "--name", "m1"]) == 0
+    assert main(["--state", state, "job", "suspend", "--name", "ghost"]) == 1

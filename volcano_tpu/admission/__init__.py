@@ -1,0 +1,23 @@
+"""Admission: validating + mutating checks applied before a Job persists.
+
+The reference runs these as TLS webhooks registered with the API server
+(cmd/admission, pkg/admission); here they are pure functions invoked by
+the store-facing submit path (sim.Cluster.submit_job, the CLI) — same
+contract, no HTTP in the loop.
+"""
+
+from volcano_tpu.admission.admit import (
+    AdmissionError,
+    admit_and_create,
+    mutate_job,
+    validate_job,
+    validate_job_update,
+)
+
+__all__ = [
+    "AdmissionError",
+    "admit_and_create",
+    "mutate_job",
+    "validate_job",
+    "validate_job_update",
+]

@@ -1,0 +1,189 @@
+"""Job validation and mutation rules.
+
+Parity sources:
+  * validateJob / specDeepEqual — reference pkg/admission/admit_job.go:40-193
+  * policy event/action allowlists, CheckPolicyDuplicate, ValidatePolicies,
+    ValidateIO — reference pkg/admission/admission_controller.go:49-262
+  * MutateJobs createPatch — reference pkg/admission/mutate_job.go:42-101
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from volcano_tpu.api.job import Job, LifecyclePolicy
+from volcano_tpu.api.types import JobAction, JobEvent
+from volcano_tpu.controller.plugins import known_job_plugins
+
+DEFAULT_QUEUE = "default"
+DEFAULT_TASK_SPEC = "default"
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+#: events permitted in user-supplied policies (admission_controller.go:49-58)
+VALID_POLICY_EVENTS = (
+    JobEvent.ANY,
+    JobEvent.POD_FAILED,
+    JobEvent.POD_EVICTED,
+    JobEvent.JOB_UNKNOWN,
+    JobEvent.TASK_COMPLETED,
+)
+
+#: actions permitted in user-supplied policies (admission_controller.go:60-67)
+VALID_POLICY_ACTIONS = (
+    JobAction.ABORT_JOB,
+    JobAction.RESTART_JOB,
+    JobAction.TERMINATE_JOB,
+    JobAction.COMPLETE_JOB,
+    JobAction.RESUME_JOB,
+)
+
+
+class AdmissionError(ValueError):
+    """Raised by the submit path when a job fails validation."""
+
+
+def is_dns1123_label(name: str) -> bool:
+    return bool(name) and len(name) <= 63 and _DNS1123.match(name) is not None
+
+
+def check_policy_duplicate(policies: List[LifecyclePolicy]) -> Optional[str]:
+    """Duplicate events, and '*' must be exclusive
+    (admission_controller.go:87-110)."""
+    seen = set()
+    for policy in policies:
+        if policy.event in seen:
+            return f"duplicated policy event {policy.event.value}"
+        if policy.event is not None:
+            seen.add(policy.event)
+    if JobEvent.ANY in seen and len(seen) > 1:
+        return "if there's * here, no other policy should be here"
+    return None
+
+
+def validate_policies(policies: List[LifecyclePolicy]) -> List[str]:
+    """Event XOR exit code; exit code 0 invalid; no duplicates; allowlisted
+    events/actions (admission_controller.go:112-160)."""
+    errs: List[str] = []
+    seen_events = set()
+    seen_codes = set()
+    for policy in policies:
+        if policy.event is not None and policy.exit_code is not None:
+            errs.append("must not specify event and exitCode simultaneously")
+            break
+        if policy.event is None and policy.exit_code is None:
+            errs.append("either event or exitCode should be specified")
+            break
+        if policy.event is not None:
+            if policy.event not in VALID_POLICY_EVENTS:
+                errs.append(f"invalid policy event {policy.event.value}")
+                break
+            if policy.event in seen_events:
+                errs.append(f"duplicate event {policy.event.value}")
+                break
+            seen_events.add(policy.event)
+        else:
+            if policy.exit_code == 0:
+                errs.append("0 is not a valid error code")
+                break
+            if policy.exit_code in seen_codes:
+                errs.append(f"duplicate exitCode {policy.exit_code}")
+                break
+            seen_codes.add(policy.exit_code)
+        if policy.action not in VALID_POLICY_ACTIONS:
+            errs.append(f"invalid policy action {policy.action.value}")
+            break
+    return errs
+
+
+def validate_io(volumes) -> Optional[str]:
+    seen = set()
+    for volume in volumes:
+        if not volume.mount_path:
+            return "mountPath is required"
+        if volume.mount_path in seen:
+            return f"duplicated mountPath: {volume.mount_path}"
+        seen.add(volume.mount_path)
+    return None
+
+
+def validate_job(job: Job) -> Tuple[bool, str]:
+    """Create-time validation (admit_job.go:74-150). Returns
+    (allowed, message)."""
+    msgs: List[str] = []
+
+    if job.spec.min_available < 0:
+        return False, "'minAvailable' cannot be less than zero."
+    if not job.spec.tasks:
+        return False, "No task specified in job spec"
+
+    total_replicas = 0
+    task_names = set()
+    for task in job.spec.tasks:
+        if task.replicas <= 0:
+            msgs.append(f"'replicas' is not set positive in task: {task.name}")
+        total_replicas += max(task.replicas, 0)
+        if not is_dns1123_label(task.name):
+            msgs.append(
+                f"task name {task.name!r} must be a lowercase DNS-1123 label"
+            )
+        if task.name in task_names:
+            msgs.append(f"duplicated task name {task.name}")
+            break
+        task_names.add(task.name)
+        dup = check_policy_duplicate(task.policies)
+        if dup:
+            msgs.append(f"duplicated task event policies: {dup}")
+        msgs.extend(validate_policies(task.policies))
+
+    if total_replicas < job.spec.min_available:
+        msgs.append(
+            "'minAvailable' should not be greater than total replicas in tasks"
+        )
+
+    dup = check_policy_duplicate(job.spec.policies)
+    if dup:
+        msgs.append(f"duplicated job event policies: {dup}")
+    msgs.extend(validate_policies(job.spec.policies))
+
+    known = set(known_job_plugins())
+    for name in job.spec.plugins:
+        if name not in known:
+            msgs.append(f"unable to find job plugin: {name}")
+
+    io_msg = validate_io(job.spec.volumes)
+    if io_msg:
+        msgs.append(io_msg)
+
+    if msgs:
+        return False, "; ".join(msgs)
+    return True, ""
+
+
+def validate_job_update(new: Job, old: Job) -> Tuple[bool, str]:
+    """Updates must not modify the spec (admit_job.go:160-170)."""
+    if new.spec != old.spec:
+        return False, "job.spec is not allowed to modify when update jobs"
+    return True, ""
+
+
+def mutate_job(job: Job) -> Job:
+    """Create-time defaults, applied in place: queue and task names
+    (mutate_job.go:76-101)."""
+    if not job.spec.queue:
+        job.spec.queue = DEFAULT_QUEUE
+    for index, task in enumerate(job.spec.tasks):
+        if not task.name:
+            task.name = f"{DEFAULT_TASK_SPEC}{index}"
+    return job
+
+
+def admit_and_create(store, job: Job) -> Job:
+    """The webhook-gated create path: mutate, validate, persist. The single
+    entry used by the CLI and the simulator's submit_job."""
+    mutate_job(job)
+    allowed, msg = validate_job(job)
+    if not allowed:
+        raise AdmissionError(msg)
+    return store.create("Job", job)

@@ -119,6 +119,7 @@ def match_expressions(labels: Dict[str, str], term) -> bool:
 class PodSpec:
     resources: Resource = field(default_factory=Resource)       # sum of containers
     init_resources: Resource = field(default_factory=Resource)  # max of init containers
+    image: str = ""                                             # container image
     node_selector: Dict[str, str] = field(default_factory=dict)
     affinity: Optional[Affinity] = None
     tolerations: List[Toleration] = field(default_factory=list)

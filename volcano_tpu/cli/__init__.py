@@ -1,0 +1,23 @@
+"""vtctl: the user-facing CLI (reference vkctl, cmd/cli + pkg/cli/job).
+
+``python -m volcano_tpu.cli`` drives a persisted simulated cluster; the
+command functions also operate on any live Store for embedding.
+"""
+
+from volcano_tpu.cli.vtctl import (
+    build_job_from_flags,
+    cmd_list,
+    cmd_resume,
+    cmd_run,
+    cmd_suspend,
+    main,
+)
+
+__all__ = [
+    "build_job_from_flags",
+    "cmd_list",
+    "cmd_resume",
+    "cmd_run",
+    "cmd_suspend",
+    "main",
+]

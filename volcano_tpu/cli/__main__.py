@@ -1,0 +1,5 @@
+import sys
+
+from volcano_tpu.cli.vtctl import main
+
+sys.exit(main())

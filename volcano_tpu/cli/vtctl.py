@@ -1,0 +1,240 @@
+"""vtctl command implementations + argparse entry.
+
+Parity sources:
+  * job run     — reference pkg/cli/job/run.go:30-108 (flags image/
+    namespace/name/min/replicas/requests; single-task job)
+  * job list    — reference pkg/cli/job/list.go:60-112 (column layout)
+  * suspend     — reference pkg/cli/job/suspend.go:38-49 -> Command CR
+    with AbortJob action (util.go:72-99)
+  * resume      — reference pkg/cli/job/resume.go -> ResumeJob Command
+
+The reference CLI talks to the API server; here commands target a Store.
+The ``__main__`` entry persists a simulated Cluster between invocations
+(``--state`` pickle), so run/list/suspend/resume round-trips work from a
+shell the way the reference e2e drives the real binary (cli_util.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import pickle
+import sys
+from typing import Optional
+
+from volcano_tpu.api.job import Job, JobSpec, TaskSpec
+from volcano_tpu.api.objects import Command, Metadata, PodSpec
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobAction
+
+
+def parse_resource_list(spec: str) -> Resource:
+    """cpu=1000m,memory=100Mi -> Resource (run.go populateResourceListV1)."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        if not value:
+            raise ValueError(f"bad resource entry {part!r}, want key=value")
+        out[key.strip()] = value.strip()
+    return Resource.from_resource_list(out)
+
+
+def build_job_from_flags(
+    name: str = "test",
+    namespace: str = "default",
+    image: str = "busybox",
+    min_available: int = 1,
+    replicas: int = 1,
+    requests: str = "cpu=1000m,memory=100Mi",
+    scheduler: str = "volcano-tpu",
+    queue: str = "",
+) -> Job:
+    template = PodSpec(
+        resources=parse_resource_list(requests),
+        image=image,
+        scheduler_name=scheduler,
+        restart_policy="Never",
+    )
+    return Job(
+        meta=Metadata(name=name, namespace=namespace),
+        spec=JobSpec(
+            scheduler_name=scheduler,
+            min_available=min_available,
+            queue=queue,
+            tasks=[TaskSpec(name=name, replicas=replicas, template=template)],
+        ),
+    )
+
+
+def cmd_run(store, **flags) -> Job:
+    """Create a job from flags, through the shared admission gate."""
+    from volcano_tpu.admission import admit_and_create
+
+    return admit_and_create(store, build_job_from_flags(**flags))
+
+
+_COLUMNS = (
+    "Name", "Creation", "Phase", "Replicas", "Min",
+    "Pending", "Running", "Succeeded", "Failed", "RetryCount",
+)
+
+
+def cmd_list(store, namespace: str = "default", out: Optional[io.TextIOBase] = None) -> str:
+    """Table of jobs in the namespace (list.go:79-100 column layout)."""
+    jobs = [j for j in store.list("Job") if j.meta.namespace == namespace]
+    buf = io.StringIO()
+    if not jobs:
+        buf.write("No resources found\n")
+    else:
+        name_w = max([len("Name")] + [len(j.meta.name) for j in jobs]) + 3
+        widths = (name_w, 12, 12, 10, 6, 9, 9, 11, 8, 12)
+        row = "".join(f"%-{w}s" for w in widths) + "\n"
+        buf.write(row % _COLUMNS)
+        for job in jobs:
+            st = job.status
+            buf.write(
+                row
+                % (
+                    job.meta.name,
+                    f"rv{job.meta.resource_version}",
+                    st.state.phase.value,
+                    job.spec.total_replicas(),
+                    st.min_available,
+                    st.pending,
+                    st.running,
+                    st.succeeded,
+                    st.failed,
+                    st.retry_count,
+                )
+            )
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def _issue_command(store, namespace: str, name: str, action: JobAction) -> Command:
+    if store.get("Job", f"{namespace}/{name}") is None:
+        raise KeyError(f"job {namespace}/{name} not found")
+    cmd = Command(
+        meta=Metadata(name=f"{action.value.lower()}-{name}", namespace=namespace),
+        action=action.value,
+        target=("Job", name),
+    )
+    return store.create("Command", cmd)
+
+
+def cmd_suspend(store, namespace: str, name: str) -> Command:
+    """AbortJob via Command CR (suspend.go:38-49)."""
+    return _issue_command(store, namespace, name, JobAction.ABORT_JOB)
+
+
+def cmd_resume(store, namespace: str, name: str) -> Command:
+    """ResumeJob via Command CR."""
+    return _issue_command(store, namespace, name, JobAction.RESUME_JOB)
+
+
+# -- standalone entry over a pickled simulated cluster ------------------------
+
+
+def _load_cluster(path: str):
+    from volcano_tpu.sim import Cluster
+
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except (FileNotFoundError, EOFError):
+        return Cluster()
+
+
+def _save_cluster(cluster, path: str) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(cluster, f)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vtctl")
+    parser.add_argument("--state", default=".vtctl-state.pkl",
+                        help="cluster state file (simulated cluster)")
+    sub = parser.add_subparsers(dest="group", required=True)
+
+    job_p = sub.add_parser("job", help="job operations")
+    job_sub = job_p.add_subparsers(dest="cmd", required=True)
+
+    run_p = job_sub.add_parser("run")
+    run_p.add_argument("--name", "-n", default="test")
+    run_p.add_argument("--namespace", "-N", default="default")
+    run_p.add_argument("--image", "-i", default="busybox")
+    run_p.add_argument("--min", "-m", dest="min_available", type=int, default=1)
+    run_p.add_argument("--replicas", "-r", type=int, default=1)
+    run_p.add_argument("--requests", "-R", default="cpu=1000m,memory=100Mi")
+    run_p.add_argument("--queue", "-q", default="")
+
+    list_p = job_sub.add_parser("list")
+    list_p.add_argument("--namespace", "-N", default="default")
+
+    for verb in ("suspend", "resume"):
+        p = job_sub.add_parser(verb)
+        p.add_argument("--name", "-n", required=True)
+        p.add_argument("--namespace", "-N", default="default")
+
+    cl_p = sub.add_parser("cluster", help="simulated cluster management")
+    cl_sub = cl_p.add_subparsers(dest="cmd", required=True)
+    init_p = cl_sub.add_parser("init")
+    init_p.add_argument("--nodes", type=int, default=2)
+    init_p.add_argument("--cpu", default="8")
+    init_p.add_argument("--memory", default="16Gi")
+    init_p.add_argument("--queues", default="default=1")
+    cl_sub.add_parser("step")
+
+    args = parser.parse_args(argv)
+    cluster = _load_cluster(args.state)
+
+    try:
+        if args.group == "cluster" and args.cmd == "init":
+            from volcano_tpu.sim import Cluster
+
+            cluster = Cluster()
+            for entry in args.queues.split(","):
+                qname, _, weight = entry.partition("=")
+                cluster.add_queue(qname.strip(), int(weight or 1))
+            for i in range(args.nodes):
+                cluster.add_node(
+                    f"node-{i}", {"cpu": args.cpu, "memory": args.memory, "pods": 110}
+                )
+            print(f"initialized cluster: {args.nodes} nodes")
+        elif args.group == "cluster" and args.cmd == "step":
+            steps = cluster.run_until_idle()
+            print(f"quiesced in {steps} steps")
+        elif args.cmd == "run":
+            cmd_run(
+                cluster.store,
+                name=args.name, namespace=args.namespace, image=args.image,
+                min_available=args.min_available, replicas=args.replicas,
+                requests=args.requests, queue=args.queue,
+            )
+            cluster.run_until_idle()
+            print(f"job {args.namespace}/{args.name} created")
+        elif args.cmd == "list":
+            cmd_list(cluster.store, namespace=args.namespace, out=sys.stdout)
+        elif args.cmd == "suspend":
+            cmd_suspend(cluster.store, args.namespace, args.name)
+            cluster.run_until_idle()
+            print(f"job {args.namespace}/{args.name} suspended")
+        elif args.cmd == "resume":
+            cmd_resume(cluster.store, args.namespace, args.name)
+            cluster.run_until_idle()
+            print(f"job {args.namespace}/{args.name} resumed")
+    except Exception as e:  # surface as CLI error, not traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    _save_cluster(cluster, args.state)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

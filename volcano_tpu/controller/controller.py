@@ -214,7 +214,9 @@ class JobController:
         self.store.delete("Command", cmd.meta.key)
         if not cmd.target:
             return
-        _, job_name = cmd.target
+        kind, job_name = cmd.target
+        if kind != "Job":
+            return
         try:
             action = JobAction(cmd.action)
         except ValueError:

@@ -53,7 +53,11 @@ class PendingState(State):
         if action == JobAction.RESTART_JOB:
             self._kill_to(JobPhase.PENDING, JobPhase.RESTARTING, bump_retry=True)
         elif action == JobAction.ABORT_JOB:
-            self._kill_to(JobPhase.PENDING, JobPhase.ABORTING)
+            # reference state code would settle back to Pending when no pod
+            # is terminating (state/pending.go:46-53), but its own e2e
+            # contract expects a suspended pod-less pending job to reach
+            # Aborted (test/e2e/command.go:115-154) — follow the e2e
+            self._kill_to(JobPhase.ABORTED, JobPhase.ABORTING)
         elif action == JobAction.COMPLETE_JOB:
             self._kill_to(JobPhase.COMPLETED, JobPhase.COMPLETING)
         elif action == JobAction.ENQUEUE_JOB:
@@ -76,7 +80,8 @@ class InqueueState(State):
         if action == JobAction.RESTART_JOB:
             self._kill_to(JobPhase.PENDING, JobPhase.RESTARTING, bump_retry=True)
         elif action == JobAction.ABORT_JOB:
-            self._kill_to(JobPhase.PENDING, JobPhase.ABORTING)
+            # see PendingState: follow the e2e contract, not state/inqueue.go
+            self._kill_to(JobPhase.ABORTED, JobPhase.ABORTING)
         elif action == JobAction.COMPLETE_JOB:
             self._kill_to(JobPhase.COMPLETED, JobPhase.COMPLETING)
         else:

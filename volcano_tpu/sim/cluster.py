@@ -67,6 +67,15 @@ class Cluster:
             ),
         )
 
+    # -- job submission (through admission, like the API server path) --------
+
+    def submit_job(self, job):
+        """Mutate + validate + persist, the webhook-gated create path.
+        Raises AdmissionError on rejection."""
+        from volcano_tpu.admission import admit_and_create
+
+        return admit_and_create(self.store, job)
+
     # -- kubelet --------------------------------------------------------------
 
     def kubelet_step(self) -> bool:

@@ -116,8 +116,12 @@ class Store:
         for q in self._watchers[ev.kind]:
             q.append(ev)
         # shadow every kind (not just watched ones): update() compares
-        # against it to suppress no-op writes, which quiescence relies on
-        self._shadow[ev.kind][ev.obj.meta.key] = copy.deepcopy(ev.obj)
+        # against it to suppress no-op writes, which quiescence relies on;
+        # deletions must drop the shadow or deleted objects leak forever
+        if ev.type == EventType.DELETED:
+            self._shadow[ev.kind].pop(ev.obj.meta.key, None)
+        else:
+            self._shadow[ev.kind][ev.obj.meta.key] = copy.deepcopy(ev.obj)
 
     def pending_events(self) -> bool:
         return any(q for qs in self._watchers.values() for q in qs)
