@@ -1,0 +1,137 @@
+"""DRF + proportion fair-share behavior in the allocate cycle (BASELINE
+config 2: multi-queue weighted shares, drf job ordering).
+
+Parity sources: KB/pkg/scheduler/plugins/drf/drf.go:60-177 (dominant share
+job order), proportion/proportion.go:58-243 (water-filling, queue order,
+overused gate).
+"""
+
+from volcano_tpu.api.types import PodPhase
+from volcano_tpu.scheduler.conf import PluginOption, SchedulerConf, Tier
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from helpers import FakeBinder, build_node, build_pod, build_podgroup, build_queue, make_store
+
+
+def run_cycle(store, tiers, actions=("allocate",)):
+    conf = SchedulerConf(actions=list(actions), tiers=tiers)
+    sched = Scheduler(store, conf=conf)
+    binder = FakeBinder()
+    sched.cache.binder = binder
+    sched.run_once()
+    return sched, binder
+
+
+def test_drf_prefers_lower_dominant_share():
+    # Job A already holds 2/3 of cluster cpu; job B holds nothing. With one
+    # free cpu, drf's job order gives it to B.
+    store = make_store(
+        nodes=[build_node("n0", cpu="3", memory="6Gi")],
+        podgroups=[
+            build_podgroup("pg-a", min_member=1),
+            build_podgroup("pg-b", min_member=1),
+        ],
+        pods=[
+            build_pod("a-run-0", group="pg-a", cpu="1", phase=PodPhase.RUNNING, node_name="n0"),
+            build_pod("a-run-1", group="pg-a", cpu="1", phase=PodPhase.RUNNING, node_name="n0"),
+            build_pod("a-pend", group="pg-a", cpu="1"),
+            build_pod("b-pend", group="pg-b", cpu="1"),
+        ],
+    )
+    _, binder = run_cycle(store, tiers=[Tier(plugins=[PluginOption("drf")])])
+    assert "default/b-pend" in binder.binds
+    assert "default/a-pend" not in binder.binds
+
+
+def test_drf_share_updates_as_allocation_progresses():
+    # Two fresh jobs, 4 one-cpu tasks each, 4 cpus total: drf's event
+    # handlers update shares after every bind, so capacity splits 2/2
+    # instead of first-job-takes-all.
+    store = make_store(
+        nodes=[build_node("n0", cpu="4", memory="8Gi")],
+        podgroups=[
+            build_podgroup("pg-a", min_member=1),
+            build_podgroup("pg-b", min_member=1),
+        ],
+        pods=[
+            *[build_pod(f"a-{i}", group="pg-a", cpu="1") for i in range(4)],
+            *[build_pod(f"b-{i}", group="pg-b", cpu="1") for i in range(4)],
+        ],
+    )
+    _, binder = run_cycle(store, tiers=[Tier(plugins=[PluginOption("drf")])])
+    a_bound = sum(1 for k in binder.binds if k.startswith("default/a-"))
+    b_bound = sum(1 for k in binder.binds if k.startswith("default/b-"))
+    assert (a_bound, b_bound) == (2, 2)
+
+
+def test_proportion_overused_gate_splits_capacity_by_weight():
+    # Equal-weight queues both demanding the whole 4-cpu cluster end up
+    # with 2 cpus each: once a queue reaches its deserved share the
+    # overused gate drops it from the cycle.
+    store = make_store(
+        nodes=[build_node("n0", cpu="4", memory="8Gi")],
+        queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+        podgroups=[
+            build_podgroup("pg-1", min_member=1, queue="q1"),
+            build_podgroup("pg-2", min_member=1, queue="q2"),
+        ],
+        pods=[
+            *[build_pod(f"q1-{i}", group="pg-1", cpu="1") for i in range(4)],
+            *[build_pod(f"q2-{i}", group="pg-2", cpu="1") for i in range(4)],
+        ],
+    )
+    _, binder = run_cycle(
+        store,
+        tiers=[Tier(plugins=[PluginOption("gang"), PluginOption("proportion")])],
+    )
+    q1_bound = sum(1 for k in binder.binds if k.startswith("default/q1-"))
+    q2_bound = sum(1 for k in binder.binds if k.startswith("default/q2-"))
+    assert (q1_bound, q2_bound) == (2, 2)
+
+
+def test_proportion_weighted_split():
+    # weight 3 : 1 over 4 cpus -> 3 and 1.
+    store = make_store(
+        nodes=[build_node("n0", cpu="4", memory="8Gi")],
+        queues=[build_queue("q1", weight=3), build_queue("q2", weight=1)],
+        podgroups=[
+            build_podgroup("pg-1", min_member=1, queue="q1"),
+            build_podgroup("pg-2", min_member=1, queue="q2"),
+        ],
+        pods=[
+            *[build_pod(f"q1-{i}", group="pg-1", cpu="1", memory="2Gi") for i in range(4)],
+            *[build_pod(f"q2-{i}", group="pg-2", cpu="1", memory="2Gi") for i in range(4)],
+        ],
+    )
+    _, binder = run_cycle(
+        store,
+        tiers=[Tier(plugins=[PluginOption("gang"), PluginOption("proportion")])],
+    )
+    q1_bound = sum(1 for k in binder.binds if k.startswith("default/q1-"))
+    q2_bound = sum(1 for k in binder.binds if k.startswith("default/q2-"))
+    assert (q1_bound, q2_bound) == (3, 1)
+
+
+def test_proportion_deserved_capped_at_request():
+    # q1 asks for only 1 cpu; its unused entitlement flows to q2
+    # (water-filling cap + re-spread, proportion.go:101-144).
+    store = make_store(
+        nodes=[build_node("n0", cpu="4", memory="8Gi")],
+        queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+        podgroups=[
+            build_podgroup("pg-1", min_member=1, queue="q1"),
+            build_podgroup("pg-2", min_member=1, queue="q2"),
+        ],
+        pods=[
+            build_pod("q1-0", group="pg-1", cpu="1"),
+            *[build_pod(f"q2-{i}", group="pg-2", cpu="1") for i in range(4)],
+        ],
+    )
+    _, binder = run_cycle(
+        store,
+        tiers=[Tier(plugins=[PluginOption("gang"), PluginOption("proportion")])],
+    )
+    q1_bound = sum(1 for k in binder.binds if k.startswith("default/q1-"))
+    q2_bound = sum(1 for k in binder.binds if k.startswith("default/q2-"))
+    assert q1_bound == 1
+    assert q2_bound == 3

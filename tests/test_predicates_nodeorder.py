@@ -1,0 +1,244 @@
+"""Predicate filtering + node scoring (BASELINE config 3).
+
+Scenario sources: reference test/e2e/predicates.go — NodeAffinity :29,
+HostPort :78, Pod Affinity :106, Taints :155 — plus the nodeorder scoring
+formulas (KB/pkg/scheduler/plugins/nodeorder/nodeorder.go:99-226).
+"""
+
+from volcano_tpu.api.objects import Affinity, Taint, Toleration
+from volcano_tpu.api.types import PodPhase
+from volcano_tpu.scheduler.conf import default_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from helpers import FakeBinder, build_node, build_pod, build_podgroup, make_store
+
+
+def run_cycle(store, conf=None):
+    sched = Scheduler(store, conf=conf or default_conf())
+    binder = FakeBinder()
+    sched.cache.binder = binder
+    sched.run_once()
+    return sched, binder
+
+
+def test_node_selector_restricts_placement():
+    store = make_store(
+        nodes=[
+            build_node("plain"),
+            build_node("gpu-node", labels={"accelerator": "tpu"}),
+        ],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg")],
+    )
+    pod = store.get("Pod", "default/p0")
+    pod.spec.node_selector = {"accelerator": "tpu"}
+    _, binder = run_cycle(store)
+    assert binder.binds == {"default/p0": "gpu-node"}
+
+
+def test_required_node_affinity():
+    # predicates.go:29 — In-operator requiredDuringScheduling term
+    store = make_store(
+        nodes=[
+            build_node("n-east", labels={"zone": "east"}),
+            build_node("n-west", labels={"zone": "west"}),
+        ],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg")],
+    )
+    pod = store.get("Pod", "default/p0")
+    pod.spec.affinity = Affinity(node_terms=[[("zone", "In", ("west",))]])
+    _, binder = run_cycle(store)
+    assert binder.binds == {"default/p0": "n-west"}
+
+
+def test_node_affinity_unsatisfiable_binds_nothing():
+    store = make_store(
+        nodes=[build_node("n0", labels={"zone": "east"})],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg")],
+    )
+    pod = store.get("Pod", "default/p0")
+    pod.spec.affinity = Affinity(node_terms=[[("zone", "In", ("mars",))]])
+    _, binder = run_cycle(store)
+    assert binder.binds == {}
+
+
+def test_host_port_conflict_spreads_pods():
+    # predicates.go:78 — two pods wanting the same host port land on
+    # different nodes; a third finds no port-free node and stays pending.
+    store = make_store(
+        nodes=[build_node("n0"), build_node("n1")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod(f"p{i}", group="pg") for i in range(3)],
+    )
+    for i in range(3):
+        store.get("Pod", f"default/p{i}").spec.host_ports = [8080]
+    _, binder = run_cycle(store)
+    bound_nodes = sorted(binder.binds.values())
+    assert len(binder.binds) == 2
+    assert bound_nodes == ["n0", "n1"]
+
+
+def test_taints_require_toleration():
+    # predicates.go:155 — NoSchedule taint repels pods without a toleration
+    tainted = build_node("tainted")
+    tainted.taints = [Taint(key="dedicated", value="batch", effect="NoSchedule")]
+    # separate jobs: an unschedulable head task drops its whole job for the
+    # cycle (allocate.go:148), which would mask the tolerant pod
+    store = make_store(
+        nodes=[tainted],
+        podgroups=[
+            build_podgroup("pg-plain", min_member=1),
+            build_podgroup("pg-tol", min_member=1),
+        ],
+        pods=[build_pod("plain", group="pg-plain"), build_pod("tolerant", group="pg-tol")],
+    )
+    store.get("Pod", "default/tolerant").spec.tolerations = [
+        Toleration(key="dedicated", operator="Equal", value="batch")
+    ]
+    _, binder = run_cycle(store)
+    assert binder.binds == {"default/tolerant": "tainted"}
+
+
+def test_pod_affinity_colocates():
+    # predicates.go:106 — required pod affinity pulls the follower onto the
+    # node already running the matching pod.
+    store = make_store(
+        nodes=[build_node("n0"), build_node("n1")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[
+            build_pod(
+                "leader", group="pg", phase=PodPhase.RUNNING, node_name="n1",
+                labels={"role": "leader"},
+            ),
+            build_pod("follower", group="pg"),
+        ],
+    )
+    store.get("Pod", "default/follower").spec.affinity = Affinity(
+        pod_affinity=[{"role": "leader"}]
+    )
+    _, binder = run_cycle(store)
+    assert binder.binds == {"default/follower": "n1"}
+
+
+def test_pod_anti_affinity_separates():
+    store = make_store(
+        nodes=[build_node("n0"), build_node("n1")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[
+            build_pod(
+                "a", group="pg", phase=PodPhase.RUNNING, node_name="n0",
+                labels={"app": "db"},
+            ),
+            build_pod("b", group="pg", labels={"app": "db"}),
+        ],
+    )
+    store.get("Pod", "default/b").spec.affinity = Affinity(
+        pod_anti_affinity=[{"app": "db"}]
+    )
+    _, binder = run_cycle(store)
+    assert binder.binds == {"default/b": "n1"}
+
+
+def test_unschedulable_and_notready_nodes_filtered():
+    cordoned = build_node("cordoned")
+    cordoned.unschedulable = True
+    notready = build_node("notready")
+    notready.conditions[0].status = "False"
+    store = make_store(
+        nodes=[cordoned, notready, build_node("good")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg")],
+    )
+    _, binder = run_cycle(store)
+    assert binder.binds == {"default/p0": "good"}
+
+
+def test_max_task_num_per_node():
+    # MaxTaskNum predicate (predicates.go:70): the node's "pods" resource
+    # bounds resident task count.
+    store = make_store(
+        nodes=[build_node("n0", pods=2)],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod(f"p{i}", group="pg") for i in range(3)],
+    )
+    _, binder = run_cycle(store)
+    assert len(binder.binds) == 2
+
+
+def test_least_requested_spreads_load():
+    # nodeorder.go LeastRequested: the emptier node scores higher, so two
+    # sequential pods spread across the two nodes.
+    store = make_store(
+        nodes=[build_node("n0", cpu="4", memory="8Gi"), build_node("n1", cpu="4", memory="8Gi")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg", cpu="2"), build_pod("p1", group="pg", cpu="2")],
+    )
+    _, binder = run_cycle(store)
+    assert sorted(binder.binds.values()) == ["n0", "n1"]
+
+
+def test_preferred_node_affinity_scores():
+    # preferred (soft) node affinity steers toward the matching node
+    # without filtering the other.
+    store = make_store(
+        nodes=[
+            build_node("n-east", labels={"zone": "east"}),
+            build_node("n-west", labels={"zone": "west"}),
+        ],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg")],
+    )
+    pod = store.get("Pod", "default/p0")
+    pod.spec.affinity = Affinity(
+        preferred_node_terms=[(50, [("zone", "In", ("east",))])]
+    )
+    _, binder = run_cycle(store)
+    assert binder.binds == {"default/p0": "n-east"}
+
+
+def test_nodeorder_weight_arguments():
+    # nodeorder.go:99-152 — weights come from plugin arguments. Crank
+    # leastrequested.weight and verify the emptier node still wins even
+    # against a preferred-affinity pull to the fuller node.
+    import yaml
+
+    conf_text = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+    arguments:
+      leastrequested.weight: 100
+      nodeaffinity.weight: 1
+"""
+    store = make_store(
+        nodes=[
+            build_node("busy", cpu="4", memory="8Gi", labels={"zone": "east"}),
+            build_node("idle", cpu="4", memory="8Gi"),
+        ],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[
+            build_pod(
+                "resident", group="pg", cpu="3",
+                phase=PodPhase.RUNNING, node_name="busy",
+            ),
+            build_pod("p0", group="pg", cpu="1"),
+        ],
+    )
+    pod = store.get("Pod", "default/p0")
+    pod.spec.affinity = Affinity(
+        preferred_node_terms=[(5, [("zone", "In", ("east",))])]
+    )
+    sched = Scheduler.from_conf_yaml(store, conf_text)
+    binder = FakeBinder()
+    sched.cache.binder = binder
+    sched.run_once()
+    assert binder.binds["default/p0"] == "idle"

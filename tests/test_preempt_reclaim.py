@@ -1,0 +1,268 @@
+"""Preempt/reclaim actions + Statement transactional semantics.
+
+Scenario sources (reference e2e suite, reduced to the hermetic fake-seam
+pattern): test/e2e/job_scheduling.go "Preemption" :149, "Multiple
+Preemption" :181, "Statement" :252; test/e2e/queue.go "Reclaim" :27.
+"""
+
+from volcano_tpu.api.objects import Metadata, PriorityClass
+from volcano_tpu.api.types import PodPhase, TaskStatus
+from volcano_tpu.scheduler.conf import PluginOption, SchedulerConf, Tier, default_conf
+from volcano_tpu.scheduler.framework import open_session
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.scheduler.statement import Statement
+
+from helpers import (
+    FakeBinder,
+    FakeEvictor,
+    build_node,
+    build_pod,
+    build_podgroup,
+    build_queue,
+    make_store,
+)
+
+
+def make_conf(*actions):
+    conf = default_conf()
+    conf.actions = list(actions)
+    return conf
+
+
+def run_cycle(store, conf):
+    sched = Scheduler(store, conf=conf)
+    binder, evictor = FakeBinder(), FakeEvictor()
+    sched.cache.binder = binder
+    sched.cache.evictor = evictor
+    sched.run_once()
+    return sched, binder, evictor
+
+
+def occupied_cluster(n_nodes=1, pods_per_node=2, priority=1):
+    """n nodes of 2 cpu, each fully occupied by running 1-cpu pods of the
+    low-priority job pg-low."""
+    nodes = [build_node(f"n{i}", cpu="2", memory="4Gi") for i in range(n_nodes)]
+    pods = []
+    for i in range(n_nodes):
+        for j in range(pods_per_node):
+            pods.append(
+                build_pod(
+                    f"low-{i}-{j}",
+                    group="pg-low",
+                    cpu="1",
+                    phase=PodPhase.RUNNING,
+                    node_name=f"n{i}",
+                    priority=priority,
+                )
+            )
+    return nodes, pods
+
+
+def with_priority_classes(store):
+    store.create("PriorityClass", PriorityClass(Metadata(name="low-pri", namespace=""), value=1))
+    store.create("PriorityClass", PriorityClass(Metadata(name="high-pri", namespace=""), value=100))
+    return store
+
+
+def test_preemption_evicts_lower_priority_within_queue():
+    # job_scheduling.go:149 — cluster full of low-pri pods; a high-pri job
+    # preempts enough of them to pipeline its own task.
+    nodes, low_pods = occupied_cluster(n_nodes=1, pods_per_node=2)
+    pg_low = build_podgroup("pg-low", min_member=1)
+    pg_low.priority_class_name = "low-pri"
+    pg_high = build_podgroup("pg-high", min_member=1)
+    pg_high.priority_class_name = "high-pri"
+    store = make_store(
+        nodes=nodes,
+        podgroups=[pg_low, pg_high],
+        pods=low_pods + [build_pod("high-0", group="pg-high", cpu="1", priority=100)],
+    )
+    with_priority_classes(store)
+
+    _, _, evictor = run_cycle(store, make_conf("preempt"))
+    # exactly one victim covers the 1-cpu preemptor request
+    assert len(evictor.evicts) == 1
+    assert evictor.evicts[0].startswith("default/low-")
+
+
+def test_multiple_preemption_across_nodes():
+    # job_scheduling.go:181 — a 2-task high-pri gang preempts on two nodes.
+    nodes, low_pods = occupied_cluster(n_nodes=2, pods_per_node=2)
+    pg_low = build_podgroup("pg-low", min_member=1)
+    pg_high = build_podgroup("pg-high", min_member=2)
+    pg_high.priority_class_name = "high-pri"
+    store = make_store(
+        nodes=nodes,
+        podgroups=[pg_low, pg_high],
+        pods=low_pods
+        + [build_pod(f"high-{i}", group="pg-high", cpu="2", priority=100) for i in range(2)],
+    )
+    with_priority_classes(store)
+
+    _, _, evictor = run_cycle(store, make_conf("preempt"))
+    # each 2-cpu preemptor needs a whole node -> two victims per node
+    assert len(evictor.evicts) == 4
+    assert all(v.startswith("default/low-") for v in evictor.evicts)
+
+
+def test_preemption_blocked_by_victim_gang_discards_statement():
+    # Statement atomicity (job_scheduling.go:252): the victim job's gang
+    # (min_member == its running count) refuses every victim, so the
+    # preemptor's Statement is discarded — zero evictions reach the cache
+    # and session state rolls back to Running.
+    nodes, low_pods = occupied_cluster(n_nodes=1, pods_per_node=2)
+    pg_low = build_podgroup("pg-low", min_member=2)  # gang needs both pods
+    pg_high = build_podgroup("pg-high", min_member=1)
+    pg_high.priority_class_name = "high-pri"
+    store = make_store(
+        nodes=nodes,
+        podgroups=[pg_low, pg_high],
+        pods=low_pods + [build_pod("high-0", group="pg-high", cpu="1", priority=100)],
+    )
+    with_priority_classes(store)
+
+    _, _, evictor = run_cycle(store, make_conf("preempt"))
+    assert evictor.evicts == []
+    assert not any(p.deleting for p in store.items("Pod"))
+
+
+def test_statement_discard_restores_session_state():
+    # Direct Statement unit semantics (framework/statement.go:198-222).
+    nodes, low_pods = occupied_cluster(n_nodes=1, pods_per_node=2)
+    pg_low = build_podgroup("pg-low", min_member=1)
+    pg_high = build_podgroup("pg-high", min_member=1)
+    store = make_store(
+        nodes=nodes,
+        podgroups=[pg_low, pg_high],
+        pods=low_pods + [build_pod("high-0", group="pg-high", cpu="1")],
+    )
+    sched = Scheduler(store, conf=default_conf())
+    evictor = FakeEvictor()
+    sched.cache.evictor = evictor
+    ssn = open_session(sched.cache, sched.conf.tiers)
+
+    node = ssn.nodes["n0"]
+    idle_before = node.idle.clone()
+    victim = next(
+        t for j in ssn.jobs.values() for t in j.tasks.values()
+        if t.status == TaskStatus.RUNNING
+    )
+    preemptor = next(
+        t for j in ssn.jobs.values() for t in j.tasks.values()
+        if t.status == TaskStatus.PENDING
+    )
+
+    stmt = Statement(ssn)
+    stmt.evict(victim, "preempt")
+    assert victim.status == TaskStatus.RELEASING
+    stmt.pipeline(preemptor, "n0")
+    assert preemptor.status == TaskStatus.PIPELINED
+
+    stmt.discard()
+    assert victim.status == TaskStatus.RUNNING
+    assert preemptor.status == TaskStatus.PENDING
+    assert preemptor.node_name == ""
+    assert node.idle.less_equal(idle_before) and idle_before.less_equal(node.idle)
+    assert evictor.evicts == []  # nothing committed
+
+
+def test_statement_commit_replays_evictions():
+    nodes, low_pods = occupied_cluster(n_nodes=1, pods_per_node=2)
+    pg_low = build_podgroup("pg-low", min_member=1)
+    store = make_store(nodes=nodes, podgroups=[pg_low], pods=low_pods)
+    sched = Scheduler(store, conf=default_conf())
+    evictor = FakeEvictor()
+    sched.cache.evictor = evictor
+    ssn = open_session(sched.cache, sched.conf.tiers)
+
+    victim = next(
+        t for j in ssn.jobs.values() for t in j.tasks.values()
+        if t.status == TaskStatus.RUNNING
+    )
+    stmt = Statement(ssn)
+    stmt.evict(victim, "preempt")
+    stmt.commit()
+    assert evictor.evicts == [victim.key]
+
+
+def test_reclaim_cross_queue_restores_fair_share():
+    # queue.go:27 — q1 occupies the whole cluster; q2's pending job reclaims
+    # capacity up to its deserved share.
+    nodes = [build_node(f"n{i}", cpu="2", memory="4Gi") for i in range(2)]
+    q1_pods = []
+    for i in range(2):
+        for j in range(2):
+            q1_pods.append(
+                build_pod(
+                    f"q1-{i}-{j}", group="pg-q1", cpu="1",
+                    phase=PodPhase.RUNNING, node_name=f"n{i}",
+                )
+            )
+    pg_q1 = build_podgroup("pg-q1", min_member=1, queue="q1")
+    pg_q2 = build_podgroup("pg-q2", min_member=1, queue="q2")
+    store = make_store(
+        nodes=nodes,
+        queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+        podgroups=[pg_q1, pg_q2],
+        pods=q1_pods + [build_pod("q2-0", group="pg-q2", cpu="1")],
+    )
+
+    _, _, evictor = run_cycle(store, make_conf("reclaim"))
+    assert len(evictor.evicts) == 1
+    assert evictor.evicts[0].startswith("default/q1-")
+
+
+def test_reclaim_refuses_when_victim_queue_at_deserved():
+    # proportion's reclaimableFn keeps queues at/above deserved
+    # (proportion.go:161-186): q1 sits exactly at its deserved share, so
+    # nothing may be reclaimed from it. Proportion must share a tier with
+    # gang for its veto to intersect (first tier returning non-None victims
+    # wins, session_plugins.go Reclaimable) — same as putting proportion in
+    # the reference conf's first tier.
+    nodes = [build_node("n0", cpu="4", memory="8Gi")]
+    q1_pods = [
+        build_pod(
+            f"q1-{j}", group="pg-q1", cpu="1",
+            phase=PodPhase.RUNNING, node_name="n0",
+        )
+        for j in range(2)
+    ]
+    pg_q1 = build_podgroup("pg-q1", min_member=1, queue="q1")
+    pg_q2 = build_podgroup("pg-q2", min_member=1, queue="q2")
+    store = make_store(
+        nodes=nodes,
+        queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+        podgroups=[pg_q1, pg_q2],
+        pods=q1_pods + [build_pod("q2-0", group="pg-q2", cpu="1")],
+    )
+
+    conf = SchedulerConf(
+        actions=["reclaim"],
+        tiers=[Tier(plugins=[PluginOption("gang"), PluginOption("proportion")])],
+    )
+    _, _, evictor = run_cycle(store, conf)
+    assert evictor.evicts == []
+
+
+def test_reclaim_protects_victim_gang():
+    # gang's reclaimableFn refuses victims whose job would fall below
+    # min_available (gang.go:71-94).
+    nodes = [build_node("n0", cpu="2", memory="4Gi")]
+    q1_pods = [
+        build_pod(
+            f"q1-{j}", group="pg-q1", cpu="1",
+            phase=PodPhase.RUNNING, node_name="n0",
+        )
+        for j in range(2)
+    ]
+    pg_q1 = build_podgroup("pg-q1", min_member=2, queue="q1")  # needs both
+    pg_q2 = build_podgroup("pg-q2", min_member=1, queue="q2")
+    store = make_store(
+        nodes=nodes,
+        queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+        podgroups=[pg_q1, pg_q2],
+        pods=q1_pods + [build_pod("q2-0", group="pg-q2", cpu="1")],
+    )
+
+    _, _, evictor = run_cycle(store, make_conf("reclaim"))
+    assert evictor.evicts == []

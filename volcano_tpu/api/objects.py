@@ -32,6 +32,7 @@ class Metadata:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     resource_version: int = 0
+    creation_timestamp: float = 0.0  # epoch seconds, stamped by Store.create
     owner: Optional[Tuple[str, str]] = None  # (kind, name) of controlling object
 
     def __post_init__(self):

@@ -93,13 +93,20 @@ def cmd_list(store, namespace: str = "default", out: Optional[io.TextIOBase] = N
         widths = (name_w, 12, 12, 10, 6, 9, 9, 11, 8, 12)
         row = "".join(f"%-{w}s" for w in widths) + "\n"
         buf.write(row % _COLUMNS)
+        import time
+
         for job in jobs:
             st = job.status
+            created = (
+                time.strftime("%H:%M:%S", time.localtime(job.meta.creation_timestamp))
+                if job.meta.creation_timestamp
+                else "<none>"
+            )
             buf.write(
                 row
                 % (
                     job.meta.name,
-                    f"rv{job.meta.resource_version}",
+                    created,
                     st.state.phase.value,
                     job.spec.total_replicas(),
                     st.min_available,
@@ -117,10 +124,14 @@ def cmd_list(store, namespace: str = "default", out: Optional[io.TextIOBase] = N
 
 
 def _issue_command(store, namespace: str, name: str, action: JobAction) -> Command:
+    from volcano_tpu.api.objects import new_uid
+
     if store.get("Job", f"{namespace}/{name}") is None:
         raise KeyError(f"job {namespace}/{name} not found")
+    # generated suffix keeps repeated suspend/resume idempotent-safe: the
+    # controller consumes commands by target, not name
     cmd = Command(
-        meta=Metadata(name=f"{action.value.lower()}-{name}", namespace=namespace),
+        meta=Metadata(name=new_uid(f"{action.value.lower()}-{name}"), namespace=namespace),
         action=action.value,
         target=("Job", name),
     )
@@ -151,8 +162,12 @@ def _load_cluster(path: str):
 
 
 def _save_cluster(cluster, path: str) -> None:
-    with open(path, "wb") as f:
+    import os
+
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         pickle.dump(cluster, f)
+    os.replace(tmp, path)  # never leave a truncated state file behind
 
 
 def main(argv=None) -> int:
@@ -191,9 +206,9 @@ def main(argv=None) -> int:
     cl_sub.add_parser("step")
 
     args = parser.parse_args(argv)
-    cluster = _load_cluster(args.state)
 
     try:
+        cluster = _load_cluster(args.state)
         if args.group == "cluster" and args.cmd == "init":
             from volcano_tpu.sim import Cluster
 

@@ -51,6 +51,7 @@ class Statement:
         ssn = self.ssn
         ssn.jobs[task.job_uid].update_task_status(task, TaskStatus.PENDING)
         ssn.nodes[task.node_name].remove_task(task)
+        task.node_name = ""
         for eh in ssn.event_handlers:
             if eh.deallocate_func:
                 eh.deallocate_func(Event(task))

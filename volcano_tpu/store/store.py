@@ -66,6 +66,10 @@ class Store:
             raise KeyError(f"{kind} {key} already exists")
         self._rv += 1
         obj.meta.resource_version = self._rv
+        if not obj.meta.creation_timestamp:
+            import time
+
+            obj.meta.creation_timestamp = time.time()
         self._objects[kind][key] = obj
         self._notify(Event(kind, EventType.ADDED, obj))
         return obj
@@ -88,9 +92,8 @@ class Store:
 
     def delete(self, kind: str, key: str) -> Optional[Any]:
         obj = self._objects[kind].pop(key, None)
-        self._shadow[kind].pop(key, None)
         if obj is not None:
-            self._notify(Event(kind, EventType.DELETED, obj))
+            self._notify(Event(kind, EventType.DELETED, obj))  # drops the shadow too
         return obj
 
     def get(self, kind: str, key: str) -> Optional[Any]:
