@@ -1,0 +1,253 @@
+"""Host-vs-tensor parity for preempt/reclaim victim selection.
+
+The tensor path (victim_kernels.victim_step driven by tensor_actions)
+must produce the same evictions/pipelines as the host object path for
+identical snapshots (BASELINE config 4 semantics).
+"""
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api.objects import Metadata, PriorityClass
+from volcano_tpu.api.types import PodPhase
+from volcano_tpu.scheduler.conf import default_conf, full_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from helpers import (
+    FakeBinder,
+    FakeEvictor,
+    build_node,
+    build_pod,
+    build_podgroup,
+    build_queue,
+    make_store,
+)
+
+
+def run_both(make_store_fn, actions):
+    logs = {}
+    for backend in ("host", "tpu"):
+        store = make_store_fn()
+        conf = default_conf(backend=backend)
+        conf.actions = list(actions)
+        sched = Scheduler(store, conf=conf)
+        binder, evictor = FakeBinder(), FakeEvictor()
+        sched.cache.binder = binder
+        sched.cache.evictor = evictor
+        sched.run_once()
+        logs[backend] = (dict(binder.binds), sorted(evictor.evicts))
+    return logs["host"], logs["tpu"]
+
+
+def _priority_classes(store):
+    store.create("PriorityClass", PriorityClass(Metadata(name="low", namespace=""), value=1))
+    store.create("PriorityClass", PriorityClass(Metadata(name="high", namespace=""), value=100))
+
+
+def test_preempt_parity_simple():
+    def build():
+        pg_low = build_podgroup("pg-low", min_member=1)
+        pg_high = build_podgroup("pg-high", min_member=1)
+        pg_high.priority_class_name = "high"
+        store = make_store(
+            nodes=[build_node("n0", cpu="2", memory="4Gi")],
+            podgroups=[pg_low, pg_high],
+            pods=[
+                build_pod("low-0", group="pg-low", cpu="1", phase=PodPhase.RUNNING,
+                          node_name="n0", priority=1),
+                build_pod("low-1", group="pg-low", cpu="1", phase=PodPhase.RUNNING,
+                          node_name="n0", priority=1),
+                build_pod("high-0", group="pg-high", cpu="1", priority=100),
+            ],
+        )
+        _priority_classes(store)
+        return store
+
+    host, tpu = run_both(build, ["preempt"])
+    assert host == tpu
+    assert len(tpu[1]) == 1
+
+
+def test_preempt_parity_gang_blocked():
+    # victim job's gang protects both pods -> statement discard on both paths
+    def build():
+        pg_low = build_podgroup("pg-low", min_member=2)
+        pg_high = build_podgroup("pg-high", min_member=1)
+        pg_high.priority_class_name = "high"
+        store = make_store(
+            nodes=[build_node("n0", cpu="2", memory="4Gi")],
+            podgroups=[pg_low, pg_high],
+            pods=[
+                build_pod("low-0", group="pg-low", cpu="1", phase=PodPhase.RUNNING,
+                          node_name="n0", priority=1),
+                build_pod("low-1", group="pg-low", cpu="1", phase=PodPhase.RUNNING,
+                          node_name="n0", priority=1),
+                build_pod("high-0", group="pg-high", cpu="1", priority=100),
+            ],
+        )
+        _priority_classes(store)
+        return store
+
+    host, tpu = run_both(build, ["preempt"])
+    assert host == tpu
+    assert tpu[1] == []
+
+
+def test_preempt_parity_multi_node_gang():
+    def build():
+        pg_low = build_podgroup("pg-low", min_member=1)
+        pg_high = build_podgroup("pg-high", min_member=2)
+        pg_high.priority_class_name = "high"
+        pods = []
+        for i in range(2):
+            for j in range(2):
+                pods.append(
+                    build_pod(f"low-{i}-{j}", group="pg-low", cpu="1",
+                              phase=PodPhase.RUNNING, node_name=f"n{i}", priority=1)
+                )
+        pods += [build_pod(f"high-{k}", group="pg-high", cpu="2", priority=100)
+                 for k in range(2)]
+        store = make_store(
+            nodes=[build_node(f"n{i}", cpu="2", memory="4Gi") for i in range(2)],
+            podgroups=[pg_low, pg_high],
+            pods=pods,
+        )
+        _priority_classes(store)
+        return store
+
+    host, tpu = run_both(build, ["preempt"])
+    assert host == tpu
+    assert len(tpu[1]) == 4
+
+
+def test_reclaim_parity():
+    def build():
+        pods = []
+        for i in range(2):
+            for j in range(2):
+                pods.append(
+                    build_pod(f"q1-{i}-{j}", group="pg-q1", cpu="1",
+                              phase=PodPhase.RUNNING, node_name=f"n{i}")
+                )
+        pods.append(build_pod("q2-0", group="pg-q2", cpu="1"))
+        return make_store(
+            nodes=[build_node(f"n{i}", cpu="2", memory="4Gi") for i in range(2)],
+            queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+            podgroups=[
+                build_podgroup("pg-q1", min_member=1, queue="q1"),
+                build_podgroup("pg-q2", min_member=1, queue="q2"),
+            ],
+            pods=pods,
+        )
+
+    host, tpu = run_both(build, ["reclaim"])
+    assert host == tpu
+    assert len(tpu[1]) == 1
+
+
+def test_preempt_parity_conformance_protects_critical():
+    def build():
+        pg_low = build_podgroup("pg-low", min_member=1)
+        pg_high = build_podgroup("pg-high", min_member=1)
+        pg_high.priority_class_name = "high"
+        critical = build_pod("crit-0", group="pg-low", cpu="1",
+                             phase=PodPhase.RUNNING, node_name="n0", priority=1)
+        critical.spec.priority_class = "system-cluster-critical"
+        store = make_store(
+            nodes=[build_node("n0", cpu="2", memory="4Gi")],
+            podgroups=[pg_low, pg_high],
+            pods=[
+                critical,
+                build_pod("low-1", group="pg-low", cpu="1", phase=PodPhase.RUNNING,
+                          node_name="n0", priority=1),
+                build_pod("high-0", group="pg-high", cpu="2", priority=100),
+            ],
+        )
+        _priority_classes(store)
+        return store
+
+    def run(backend):
+        store = build()
+        conf = full_conf(backend=backend)  # includes conformance
+        conf.actions = ["preempt"]
+        sched = Scheduler(store, conf=conf)
+        evictor = FakeEvictor()
+        sched.cache.evictor = evictor
+        sched.run_once()
+        return sorted(evictor.evicts)
+
+    host, tpu = run("host"), run("tpu")
+    assert host == tpu
+    # the 2-cpu preemptor needs both pods; the critical one is protected,
+    # so the single admissible victim cannot cover -> nothing evicts
+    assert tpu == []
+
+
+@pytest.mark.parametrize("seed", list(range(8)))
+def test_victim_parity_random_clusters(seed):
+    rng = np.random.default_rng(seed)
+
+    def build():
+        n_nodes = int(rng.integers(2, 5))
+        n_queues = int(rng.integers(1, 3))
+        queues = [build_queue(f"q{q}", weight=int(rng.integers(1, 4)))
+                  for q in range(n_queues)]
+        nodes = [build_node(f"n{i}", cpu="4", memory="8Gi") for i in range(n_nodes)]
+        pods, pgs = [], []
+        # running jobs occupying the cluster (capacity-aware: a node may
+        # never be oversubscribed — NodeInfo.add_task faults on that, the
+        # reference's Resource.Sub panic)
+        free = {f"n{i}": 4 for i in range(n_nodes)}
+        for j in range(int(rng.integers(1, 4))):
+            q = f"q{int(rng.integers(0, n_queues))}"
+            pgs.append(build_podgroup(f"pg-run-{j}", min_member=1, queue=q))
+            for k in range(int(rng.integers(1, 4))):
+                node = f"n{int(rng.integers(0, n_nodes))}"
+                cpu = int(rng.integers(1, 3))
+                if free[node] < cpu:
+                    continue
+                free[node] -= cpu
+                pods.append(
+                    build_pod(f"run-{j}-{k}", group=f"pg-run-{j}",
+                              cpu=str(cpu),
+                              phase=PodPhase.RUNNING, node_name=node,
+                              priority=int(rng.integers(0, 3)))
+                )
+        # pending high-priority jobs
+        for j in range(int(rng.integers(1, 3))):
+            q = f"q{int(rng.integers(0, n_queues))}"
+            pg = build_podgroup(f"pg-pend-{j}", min_member=int(rng.integers(1, 3)),
+                                queue=q)
+            pg.priority_class_name = "high"
+            pgs.append(pg)
+            for k in range(int(rng.integers(1, 4))):
+                pods.append(
+                    build_pod(f"pend-{j}-{k}", group=f"pg-pend-{j}",
+                              cpu=str(int(rng.integers(1, 3))), priority=100)
+                )
+        store = make_store(nodes=nodes, queues=queues, podgroups=pgs, pods=pods)
+        _priority_classes(store)
+        return store
+
+    # odd seeds run the full five-action pipeline so victim selection is
+    # exercised against allocate/backfill interleaving too
+    actions = (
+        ["enqueue", "reclaim", "allocate", "backfill", "preempt"]
+        if seed % 2
+        else ["reclaim", "preempt"]
+    )
+    # freeze the generated cluster: build once, snapshot the RNG state by
+    # rebuilding from the same seed for each backend
+    states = []
+    for backend in ("host", "tpu"):
+        rng = np.random.default_rng(seed)
+        store = build()
+        conf = default_conf(backend=backend)
+        conf.actions = actions
+        sched = Scheduler(store, conf=conf)
+        binder, evictor = FakeBinder(), FakeEvictor()
+        sched.cache.binder = binder
+        sched.cache.evictor = evictor
+        sched.run_once()
+        states.append((dict(binder.binds), sorted(evictor.evicts)))
+    assert states[0] == states[1]

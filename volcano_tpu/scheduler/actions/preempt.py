@@ -23,6 +23,14 @@ class PreemptAction(Action):
     name = "preempt"
 
     def execute(self, ssn: Session) -> None:
+        if getattr(ssn, "tensor_backend", None) is not None:
+            from volcano_tpu.scheduler import tensor_actions
+
+            tensor_actions.preempt(ssn)
+            return
+        self._execute_host(ssn)
+
+    def _execute_host(self, ssn: Session) -> None:
         preemptors_map = {}
         preemptor_tasks = {}
         under_request = []

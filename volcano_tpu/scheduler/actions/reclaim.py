@@ -20,6 +20,14 @@ class ReclaimAction(Action):
     name = "reclaim"
 
     def execute(self, ssn: Session) -> None:
+        if getattr(ssn, "tensor_backend", None) is not None:
+            from volcano_tpu.scheduler import tensor_actions
+
+            tensor_actions.reclaim(ssn)
+            return
+        self._execute_host(ssn)
+
+    def _execute_host(self, ssn: Session) -> None:
         queues = PriorityQueue(ssn.queue_order_fn)
         seen_queues = set()
         preemptors_map = {}
@@ -62,42 +70,47 @@ class ReclaimAction(Action):
                 continue
             task = tasks.pop()
 
-            assigned = False
-            for node in ssn.nodes.values():
-                if ssn.predicate_fn(task, node) is not None:
-                    continue
-
-                reclaimees = []
-                for resident in node.tasks.values():
-                    if resident.status != TaskStatus.RUNNING:
-                        continue
-                    j = ssn.jobs.get(resident.job_uid)
-                    if j is None or j.queue == job.queue:
-                        continue
-                    reclaimees.append(resident.clone())
-
-                victims = ssn.reclaimable(task, reclaimees)
-                if not victims:
-                    continue
-
-                all_res = Resource()
-                for v in victims:
-                    all_res.add(v.resreq)
-                if all_res.less(task.init_resreq):
-                    continue
-
-                reclaimed = Resource()
-                resreq = task.init_resreq.clone()
-                for reclaimee in victims:
-                    ssn.evict(reclaimee, "reclaim")
-                    reclaimed.add(reclaimee.resreq)
-                    if resreq.less_equal(reclaimed):
-                        break
-
-                if task.init_resreq.less_equal(reclaimed):
-                    ssn.pipeline(task, node.name)
-                    assigned = True
-                    break
-
-            if assigned:
+            if reclaim_task(ssn, job, task):
                 queues.push(queue)
+
+
+def reclaim_task(ssn: Session, job, task) -> bool:
+    """Walk nodes in snapshot order reclaiming other-queue residents for
+    one pending task (the inner loop of reclaim.go:115-180). Shared by the
+    host action and the tensor driver's rare-path fallback."""
+    for node in ssn.nodes.values():
+        if ssn.predicate_fn(task, node) is not None:
+            continue
+
+        reclaimees = []
+        for resident in node.tasks.values():
+            if resident.status != TaskStatus.RUNNING:
+                continue
+            j = ssn.jobs.get(resident.job_uid)
+            if j is None or j.queue == job.queue:
+                continue
+            reclaimees.append(resident.clone())
+
+        victims = ssn.reclaimable(task, reclaimees)
+        if not victims:
+            continue
+
+        all_res = Resource()
+        for v in victims:
+            all_res.add(v.resreq)
+        if all_res.less(task.init_resreq):
+            continue
+
+        reclaimed = Resource()
+        resreq = task.init_resreq.clone()
+        for reclaimee in victims:
+            ssn.evict(reclaimee, "reclaim")
+            reclaimed.add(reclaimee.resreq)
+            if resreq.less_equal(reclaimed):
+                break
+
+        if task.init_resreq.less_equal(reclaimed):
+            ssn.pipeline(task, node.name)
+            return True
+
+    return False

@@ -92,6 +92,17 @@ class TensorSnapshot:
     # the tensor backend falls back to the host path in that case
     has_dynamic_predicates: bool = False
 
+    # running tasks — the victim pool for preempt/reclaim, in node-resident
+    # insertion order (the order the host's node.tasks iteration sees)
+    run_uids: List[str] = field(default_factory=list)
+    run_req: np.ndarray = field(default=None)        # [V, R] resreq
+    run_node: np.ndarray = field(default=None)       # [V] i32
+    run_job: np.ndarray = field(default=None)        # [V] i32
+    run_prio: np.ndarray = field(default=None)       # [V] i32
+    run_rank: np.ndarray = field(default=None)       # [V] i32 uid rank
+    run_evictable: np.ndarray = field(default=None)  # [V] bool (conformance)
+    run_valid: np.ndarray = field(default=None)      # [V] bool
+
     @property
     def shape(self) -> Tuple[int, int, int, int, int]:
         return (
@@ -235,8 +246,12 @@ def build_tensor_snapshot(
         )
 
         for status, tasks in job.task_status_index.items():
-            charge = allocated_status(status)
-            ready = charge or status == TaskStatus.SUCCEEDED
+            # PIPELINED counts toward drf/proportion shares: the host plugin
+            # attrs start from allocated statuses at session open and track
+            # pipelines via allocate events, so a rebuilt snapshot must fold
+            # them in to land on the same running totals
+            charge = allocated_status(status) or status == TaskStatus.PIPELINED
+            ready = allocated_status(status) or status == TaskStatus.SUCCEEDED
             for t in tasks.values():
                 if charge:
                     _resource_vec(t.resreq, dims, tmp)
@@ -308,6 +323,42 @@ def build_tensor_snapshot(
 
     total = node_allocatable[node_valid].sum(axis=0).astype(np.float32)
 
+    # -- running tasks (victim pool) -----------------------------------------
+    job_row = {job.uid: j for j, job in enumerate(jobs)}
+    run_rows: List[Tuple[TaskInfo, int, int]] = []
+    for i, ni in enumerate(nodes):
+        for t in ni.tasks.values():
+            if t.status != TaskStatus.RUNNING:
+                continue
+            j = job_row.get(t.job_uid)
+            if j is not None:
+                run_rows.append((t, i, j))
+    V = _bucket(max(len(run_rows), 1))
+    run_req = np.zeros((V, R), np.float32)
+    run_node = np.zeros((V,), np.int32)
+    run_job = np.zeros((V,), np.int32)
+    run_prio = np.zeros((V,), np.int32)
+    run_rank = np.zeros((V,), np.int32)
+    run_evictable = np.zeros((V,), bool)
+    run_valid = np.zeros((V,), bool)
+    run_uids: List[str] = []
+    uid_rank = {
+        uid: r for r, uid in enumerate(sorted(t.uid for t, _, _ in run_rows))
+    }
+    for i, (t, n_idx, j_idx) in enumerate(run_rows):
+        _resource_vec(t.resreq, dims, run_req[i])
+        run_node[i] = n_idx
+        run_job[i] = j_idx
+        run_prio[i] = t.priority
+        run_rank[i] = uid_rank[t.uid]
+        run_evictable[i] = not (
+            t.priority_class
+            in ("system-cluster-critical", "system-node-critical")
+            or t.namespace == "kube-system"
+        )
+        run_valid[i] = True
+        run_uids.append(t.uid)
+
     return TensorSnapshot(
         dims=dims,
         eps=eps,
@@ -344,4 +395,12 @@ def build_tensor_snapshot(
         class_node_score=class_score,
         total=total,
         has_dynamic_predicates=dynamic_predicates,
+        run_uids=run_uids,
+        run_req=run_req,
+        run_node=run_node,
+        run_job=run_job,
+        run_prio=run_prio,
+        run_rank=run_rank,
+        run_evictable=run_evictable,
+        run_valid=run_valid,
     )

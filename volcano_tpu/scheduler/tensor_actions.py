@@ -19,6 +19,311 @@ def _host_allocate(ssn) -> None:
     AllocateAction()._execute_host(ssn)
 
 
+def _victim_path_usable(ssn, backend):
+    """Whether the victim kernel can serve this session: tensorizable tiers,
+    class-expressible predicates, and no best-effort pending preemptors
+    (empty-request preemptors take the one-victim-then-stop host path that
+    the prefix-cover rule cannot express). Only jobs the preempt/reclaim
+    loops actually visit (schedulable pod group, known queue) matter."""
+    from volcano_tpu.api.types import PodGroupPhase
+
+    if backend is None or not backend.supported:
+        return False
+    snap = backend.snapshot()
+    if snap.has_dynamic_predicates:
+        return False
+    for job in ssn.jobs.values():
+        if (
+            job.pod_group is not None
+            and job.pod_group.status.phase == PodGroupPhase.PENDING
+        ):
+            continue
+        if job.queue not in ssn.queues:
+            continue
+        for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+            if t.resreq.is_empty():
+                return False
+    return True
+
+
+class _VictimDriver:
+    """Host-side loop control around victim_step: replays every device
+    decision through the Statement/Session seams so plugin event handlers
+    and cache effects match the host path exactly, while the O(V x N)
+    victim math runs on device."""
+
+    def __init__(self, ssn, backend, veto_set, use_drf, use_prop):
+        import jax.numpy as jnp
+
+        self.ssn = ssn
+        self.backend = backend
+        self.jnp = jnp
+        self.kw = dict(
+            use_gang="gang" in veto_set,
+            use_drf=use_drf and "drf" in veto_set,
+            use_prop=use_prop and "proportion" in veto_set,
+            use_conformance="conformance" in veto_set,
+            order_by_priority=backend.task_order_by_priority,
+        )
+        self._load()
+
+    def _load(self):
+        self.snap = self.backend.snapshot()
+        self.consts, self.state = self.backend.victim_arrays()
+        self.task_row = {uid: i for i, uid in enumerate(self.snap.task_uids)}
+        self.job_row = {uid: i for i, uid in enumerate(self.snap.job_uids)}
+        self.queue_row = {name: i for i, name in enumerate(self.snap.queue_names)}
+
+    def resync(self):
+        """Rebuild device state from the session after a host-path detour
+        (deserved shares stay frozen — the backend caches them per cycle)."""
+        self.backend.invalidate()
+        self._load()
+
+    def checkpoint(self):
+        return (self.snap, self.consts, self.state, self.task_row,
+                self.job_row, self.queue_row)
+
+    def restore(self, ckpt):
+        (self.snap, self.consts, self.state, self.task_row,
+         self.job_row, self.queue_row) = ckpt
+
+    def attempt(self, task, mode):
+        """Solve one preemptor. Returns (assigned, node_name, victims,
+        clean); on clean assignment the device state advances and the host
+        replay is the caller's job. ``clean=False`` means the host walk
+        would strand evictions on non-covering nodes — state is untouched
+        and the caller must take the host fallback, then resync."""
+        from volcano_tpu.scheduler.victim_kernels import victim_step
+
+        t = self.task_row[task.uid]
+        snap = self.snap
+        out_state, assigned, nstar, vmask, clean = victim_step(
+            self.consts,
+            self.state,
+            self.jnp.asarray(snap.task_req[t]),
+            int(snap.task_class[t]),
+            self.job_row[task.job_uid],
+            self.queue_row.get(self.ssn.jobs[task.job_uid].queue, -1),
+            mode=mode,
+            **self.kw,
+        )
+        if not bool(clean):
+            return False, "", [], False
+        if not bool(assigned):
+            return False, "", [], True
+        self.state = out_state
+        vidx = np.nonzero(np.asarray(vmask))[0]
+        if mode == "reclaim":
+            # reclaim evicts in candidate (insertion) order — reclaim.go:154
+            vidx = sorted(vidx)
+        else:
+            # preempt drains the reversed task-order queue: (prio asc, uid desc)
+            vidx = sorted(vidx, key=lambda i: (snap.run_prio[i], -snap.run_rank[i]))
+        victims = []
+        for i in vidx:
+            job_uid = snap.job_uids[snap.run_job[i]]
+            victims.append(self.ssn.jobs[job_uid].tasks[snap.run_uids[i]].clone())
+        return True, snap.node_names[int(nstar)], victims, True
+
+
+def preempt(ssn) -> None:
+    """Tensor-path preempt: host loop structure of preempt.go:45-273 with
+    the per-node victim collection replaced by one victim_step per
+    preemptor."""
+    backend = ssn.tensor_backend
+    if not _victim_path_usable(ssn, backend):
+        from volcano_tpu.scheduler.actions.preempt import PreemptAction
+
+        PreemptAction()._execute_host(ssn)
+        if backend is not None:
+            backend.invalidate()  # host path mutated state behind the cache
+        return
+
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.scheduler import metrics
+    from volcano_tpu.scheduler.actions.preempt import _preempt
+    from volcano_tpu.scheduler.pqueue import PriorityQueue
+    from volcano_tpu.scheduler.statement import Statement
+
+    veto_p, _ = backend.victim_vetoes()
+    driver = _VictimDriver(ssn, backend, veto_p, use_drf=True, use_prop=False)
+
+    def host_attempt(stmt, preemptor, task_filter):
+        """Rare-path fallback: the host walk strands evictions on
+        non-covering nodes; replay it exactly, then resync the device."""
+        ok = _preempt(ssn, stmt, preemptor, task_filter)
+        driver.resync()
+        return ok
+
+    preemptors_map = {}
+    preemptor_tasks = {}
+    under_request = []
+    queues = {}
+    for job in ssn.jobs.values():
+        if (
+            job.pod_group is not None
+            and job.pod_group.status.phase == PodGroupPhase.PENDING
+        ):
+            continue
+        queue = ssn.queues.get(job.queue)
+        if queue is None:
+            continue
+        queues.setdefault(queue.uid, queue)
+        if job.task_status_index.get(TaskStatus.PENDING):
+            if job.queue not in preemptors_map:
+                preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+            preemptors_map[job.queue].push(job)
+            under_request.append(job)
+            tasks = PriorityQueue(ssn.task_order_fn)
+            for task in job.task_status_index[TaskStatus.PENDING].values():
+                tasks.push(task)
+            preemptor_tasks[job.uid] = tasks
+
+    for queue in queues.values():
+        while True:
+            preemptors = preemptors_map.get(queue.uid)
+            if preemptors is None or preemptors.empty():
+                break
+            preemptor_job = preemptors.pop()
+
+            stmt = Statement(ssn)
+            ckpt = driver.checkpoint()
+            assigned = False
+            while True:
+                if preemptor_tasks[preemptor_job.uid].empty():
+                    break
+                preemptor = preemptor_tasks[preemptor_job.uid].pop()
+                ok, node_name, victims, clean = driver.attempt(preemptor, "queue")
+                if not clean:
+                    def job_filter(task, _job=preemptor_job, _p=preemptor):
+                        if task.status != TaskStatus.RUNNING:
+                            return False
+                        j = ssn.jobs.get(task.job_uid)
+                        return (
+                            j is not None
+                            and j.queue == _job.queue
+                            and _p.job_uid != task.job_uid
+                        )
+
+                    ok = host_attempt(stmt, preemptor, job_filter)
+                elif ok:
+                    for v in victims:
+                        stmt.evict(v, "preempt")
+                    stmt.pipeline(preemptor, node_name)
+                    metrics.update_preemption_victims(len(victims))
+                    metrics.register_preemption_attempt()
+                if ok:
+                    assigned = True
+                if ssn.job_pipelined(preemptor_job):
+                    stmt.commit()
+                    break
+            if not ssn.job_pipelined(preemptor_job):
+                stmt.discard()
+                driver.restore(ckpt)
+                continue
+            if assigned:
+                preemptors.push(preemptor_job)
+
+        # phase 2: task-level preemption within each job
+        for job in under_request:
+            while True:
+                tasks = preemptor_tasks.get(job.uid)
+                if tasks is None or tasks.empty():
+                    break
+                preemptor = tasks.pop()
+                stmt = Statement(ssn)
+                ok, node_name, victims, clean = driver.attempt(preemptor, "job")
+                if not clean:
+                    def task_filter(task, _p=preemptor):
+                        return (
+                            task.status == TaskStatus.RUNNING
+                            and _p.job_uid == task.job_uid
+                        )
+
+                    ok = host_attempt(stmt, preemptor, task_filter)
+                elif ok:
+                    for v in victims:
+                        stmt.evict(v, "preempt")
+                    stmt.pipeline(preemptor, node_name)
+                    metrics.register_preemption_attempt()
+                stmt.commit()
+                if not ok:
+                    break
+    backend.invalidate()
+
+
+def reclaim(ssn) -> None:
+    """Tensor-path reclaim: host loop structure of reclaim.go:42-201 with
+    per-node victim collection replaced by victim_step."""
+    backend = ssn.tensor_backend
+    if not _victim_path_usable(ssn, backend):
+        from volcano_tpu.scheduler.actions.reclaim import ReclaimAction
+
+        ReclaimAction()._execute_host(ssn)
+        if backend is not None:
+            backend.invalidate()  # host path mutated state behind the cache
+        return
+
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.scheduler.pqueue import PriorityQueue
+
+    _, veto_r = backend.victim_vetoes()
+    driver = _VictimDriver(ssn, backend, veto_r, use_drf=False, use_prop=True)
+
+    queues = PriorityQueue(ssn.queue_order_fn)
+    seen_queues = set()
+    preemptors_map = {}
+    preemptor_tasks = {}
+    for job in ssn.jobs.values():
+        if (
+            job.pod_group is not None
+            and job.pod_group.status.phase == PodGroupPhase.PENDING
+        ):
+            continue
+        queue = ssn.queues.get(job.queue)
+        if queue is None:
+            continue
+        if queue.uid not in seen_queues:
+            seen_queues.add(queue.uid)
+            queues.push(queue)
+        if job.task_status_index.get(TaskStatus.PENDING):
+            if job.queue not in preemptors_map:
+                preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+            preemptors_map[job.queue].push(job)
+            tasks = PriorityQueue(ssn.task_order_fn)
+            for task in job.task_status_index[TaskStatus.PENDING].values():
+                tasks.push(task)
+            preemptor_tasks[job.uid] = tasks
+
+    while not queues.empty():
+        queue = queues.pop()
+        if ssn.overused(queue):
+            continue
+        jobs = preemptors_map.get(queue.uid)
+        if jobs is None or jobs.empty():
+            continue
+        job = jobs.pop()
+        tasks = preemptor_tasks.get(job.uid)
+        if tasks is None or tasks.empty():
+            continue
+        task = tasks.pop()
+
+        ok, node_name, victims, clean = driver.attempt(task, "reclaim")
+        if not clean:
+            from volcano_tpu.scheduler.actions.reclaim import reclaim_task
+
+            ok = reclaim_task(ssn, job, task)
+            driver.resync()
+        elif ok:
+            for v in victims:
+                ssn.evict(v, "reclaim")
+            ssn.pipeline(task, node_name)
+        if ok:
+            queues.push(queue)
+    backend.invalidate()
+
+
 def allocate(ssn) -> None:
     backend = ssn.tensor_backend
     if backend is None or not backend.supported:
@@ -28,6 +333,7 @@ def allocate(ssn) -> None:
     snap = backend.snapshot()
     if snap.has_dynamic_predicates:
         _host_allocate(ssn)
+        backend.invalidate()  # host path mutated state behind the cache
         return
 
     import jax.numpy as jnp

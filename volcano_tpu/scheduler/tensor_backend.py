@@ -95,9 +95,12 @@ class TensorBackend:
 
     def invalidate(self) -> None:
         """Host state changed outside the tensor path (e.g. a host action
-        ran between tensor actions) — rebuild on next use."""
+        ran between tensor actions) — rebuild on next use.
+
+        ``_deserved`` survives: proportion computes deserved shares once at
+        session open (proportion.go OnSessionOpen) and they stay frozen for
+        the cycle, so the water-fill must not rerun on rebuilt snapshots."""
         self._snapshot = None
-        self._deserved = None
 
     def deserved(self):
         """Proportion water-filling deserved shares [Q, R] (device)."""
@@ -115,6 +118,73 @@ class TensorBackend:
                 jnp.asarray(snap.queue_participates),
             )
         return self._deserved
+
+    # -- victim selection (preempt/reclaim) ----------------------------------
+
+    def victim_vetoes(self):
+        """Active veto plugin sets for preempt and reclaim, per the session's
+        first-tier-wins victim dispatch (session_plugins.go Preemptable/
+        Reclaimable): the first tier containing any enabled plugin that
+        registers the callback decides; plugins within it intersect."""
+        preempt_set = None
+        reclaim_set = None
+        for tier in self.ssn.tiers:
+            p = {
+                o.name
+                for o in tier.plugins
+                if o.name in ("gang", "drf", "conformance") and o.enabled_preemptable
+            }
+            if preempt_set is None and p:
+                preempt_set = p
+            r = {
+                o.name
+                for o in tier.plugins
+                if o.name in ("gang", "proportion", "conformance")
+                and o.enabled_reclaimable
+            }
+            if reclaim_set is None and r:
+                reclaim_set = r
+        return preempt_set or set(), reclaim_set or set()
+
+    def victim_arrays(self):
+        """(VictimConsts, VictimState) device tuples for victim_step."""
+        import jax.numpy as jnp
+
+        from volcano_tpu.scheduler.victim_kernels import VictimConsts, VictimState
+
+        snap = self.snapshot()
+        w_least, w_bal = self.score_weights()
+        consts = VictimConsts(
+            run_req=jnp.asarray(snap.run_req),
+            run_node=jnp.asarray(snap.run_node),
+            run_job=jnp.asarray(snap.run_job),
+            run_prio=jnp.asarray(snap.run_prio),
+            run_rank=jnp.asarray(snap.run_rank),
+            run_evictable=jnp.asarray(snap.run_evictable),
+            job_queue=jnp.asarray(snap.job_queue),
+            job_min=jnp.asarray(snap.job_min_available),
+            node_alloc=jnp.asarray(snap.node_alloc),
+            node_max_tasks=jnp.asarray(snap.node_max_tasks),
+            node_valid=jnp.asarray(snap.node_valid),
+            class_mask=jnp.asarray(snap.class_node_mask),
+            class_score=jnp.asarray(snap.class_node_score),
+            queue_deserved=self.deserved(),
+            total=jnp.asarray(snap.total),
+            eps=jnp.asarray(snap.eps),
+            w_least=jnp.float32(w_least),
+            w_balanced=jnp.float32(w_bal),
+        )
+        state = VictimState(
+            run_live=jnp.asarray(snap.run_valid),
+            idle=jnp.asarray(snap.node_idle),
+            releasing=jnp.asarray(snap.node_releasing),
+            used=jnp.asarray(snap.node_used),
+            task_count=jnp.asarray(snap.node_task_count),
+            job_alloc=jnp.asarray(snap.job_alloc_init),
+            job_occupied=jnp.asarray(snap.job_ready_init),
+            queue_alloc=jnp.asarray(snap.queue_alloc_init),
+        )
+        return consts, state
 
     # -- score weights -------------------------------------------------------
 

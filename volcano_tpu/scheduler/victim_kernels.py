@@ -1,0 +1,260 @@
+"""JAX victim-selection kernel for preempt/reclaim (SURVEY.md section 2.3
+item 6): per-node masked sort + prefix-sum cover test as one device program.
+
+The host loop in the reference walks nodes in score order and, per node,
+filters resident Running tasks through the tiered preemptable/reclaimable
+callbacks, then evicts in reverse task order until the preemptor's request
+is covered (preempt.go:176-243, reclaim.go:115-180). One ``victim_step``
+call computes that whole decision for one preemptor over ALL nodes at once:
+
+  1. candidate mask over the [V] running tasks (mode filter + plugin vetoes),
+  2. per-node eviction-order prefix sums of candidate requests,
+  3. node eligibility = request covered + predicate class + pod-count cap,
+  4. best node by the nodeorder score (first-max tie-break, same as host),
+  5. functional state update (evictions -> releasing, preemptor pipelined).
+
+Veto fidelity notes:
+  * gang: per-candidate check against the call-time occupied count, exactly
+    like gang.go:71-94 (the count does NOT decrement within one call).
+  * drf: the hypothetical allocation decrements for every candidate in
+    iteration order whether or not the candidate is admitted — drf.go:86-117
+    subtracts before testing — so the cumulative sums here are plain
+    per-(node, job) prefix sums, veto-independent.
+  * proportion: same shape per (node, queue) against deserved. Divergence:
+    the host skips (without subtracting) a candidate whose queue allocation
+    is already strictly below its request (proportion.go reclaimableFn's
+    ``allocated.less(resreq)`` guard); this kernel subtracts unconditionally.
+    The guard only fires when a queue's bookkeeping went negative — not
+    reachable through the session seams.
+  * A host node attempt that passes validateVictims but fails the final
+    coverage check strands its evictions in the statement and moves on
+    (preempt.go:176-243). This kernel detects that case and reports
+    ``clean=False`` instead of modeling it; the driver replays such tasks
+    through the host path and resyncs device state, keeping exact parity.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from volcano_tpu.scheduler.kernels import NEG_INF, _score_nodes, dominant_share, less_equal
+
+SHARE_DELTA = 1e-6
+
+
+class VictimConsts(NamedTuple):
+    """Cycle-constant device arrays for victim selection."""
+
+    run_req: jnp.ndarray        # [V, R] resreq of running tasks
+    run_node: jnp.ndarray       # [V] i32 node index
+    run_job: jnp.ndarray        # [V] i32 job index
+    run_prio: jnp.ndarray       # [V] i32 task priority
+    run_rank: jnp.ndarray       # [V] i32 uid rank (for reverse-uid ties)
+    run_evictable: jnp.ndarray  # [V] bool conformance veto precomputed
+    job_queue: jnp.ndarray      # [J] i32
+    job_min: jnp.ndarray        # [J] i32
+    node_alloc: jnp.ndarray     # [N, R]
+    node_max_tasks: jnp.ndarray  # [N] i32
+    node_valid: jnp.ndarray     # [N] bool
+    class_mask: jnp.ndarray     # [C, N] bool
+    class_score: jnp.ndarray    # [C, N] f32
+    queue_deserved: jnp.ndarray  # [Q, R]
+    total: jnp.ndarray          # [R]
+    eps: jnp.ndarray            # [R]
+    w_least: jnp.ndarray        # f32
+    w_balanced: jnp.ndarray     # f32
+
+
+class VictimState(NamedTuple):
+    """Mutating session state mirrored on device; functionally updated per
+    step and checkpointable for Statement rollback."""
+
+    run_live: jnp.ndarray      # [V] bool not yet evicted
+    idle: jnp.ndarray          # [N, R]
+    releasing: jnp.ndarray     # [N, R]
+    used: jnp.ndarray          # [N, R]
+    task_count: jnp.ndarray    # [N] i32
+    job_alloc: jnp.ndarray     # [J, R] drf allocated
+    job_occupied: jnp.ndarray  # [J] i32 ready_task_num
+    queue_alloc: jnp.ndarray   # [Q, R] proportion allocated
+
+
+def _seg_cumsum(values, new_seg):
+    """Inclusive prefix sums within runs delimited by ``new_seg`` flags."""
+    n = values.shape[0]
+    cum = jnp.cumsum(values, axis=0)
+    start = jax.lax.cummax(jnp.where(new_seg, jnp.arange(n), 0))
+    return cum - (cum[start] - values[start])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mode", "use_gang", "use_drf", "use_prop", "use_conformance",
+        "order_by_priority",
+    ),
+)
+def victim_step(
+    c: VictimConsts,
+    s: VictimState,
+    t_req,            # [R] preemptor resreq
+    t_cls,            # i32 predicate class
+    jt,               # i32 preemptor job index
+    qt,               # i32 preemptor queue index
+    mode: str = "queue",          # "queue" | "job" | "reclaim"
+    use_gang: bool = True,
+    use_drf: bool = False,
+    use_prop: bool = False,
+    use_conformance: bool = False,
+    order_by_priority: bool = True,
+):
+    """One preemptor's victim solve over all nodes.
+
+    Returns (new_state, assigned, node_index, victim_mask[V]).
+    """
+    V = c.run_req.shape[0]
+    N = s.idle.shape[0]
+    J = c.job_queue.shape[0]
+    Q = s.queue_alloc.shape[0]
+    vidx = jnp.arange(V, dtype=jnp.int32)
+
+    cand = s.run_live
+    # raw queue rows keep the -1 "queue missing" sentinel so residents of a
+    # deleted queue never match a real queue (host compares queue strings);
+    # clipped rows are only for gathers/scatters, guarded by has_q
+    rq_raw = c.job_queue[c.run_job]
+    has_q = rq_raw >= 0
+    run_q = jnp.clip(rq_raw, 0, Q - 1)
+    if mode == "queue":
+        cand = cand & (rq_raw == qt) & (c.run_job != jt)
+    elif mode == "job":
+        cand = cand & (c.run_job == jt)
+    else:  # reclaim: residents of other queues (including queueless jobs)
+        cand = cand & (rq_raw != qt)
+    if use_conformance:
+        cand = cand & c.run_evictable
+    if use_gang:
+        occ = s.job_occupied[c.run_job]
+        vmin = c.job_min[c.run_job]
+        cand = cand & ((vmin <= occ - 1) | (vmin == 1))
+
+    if use_drf:
+        ls = dominant_share(s.job_alloc[jt] + t_req, c.total)
+        order = jnp.lexsort((vidx, c.run_job, c.run_node, ~cand))
+        sreq = jnp.where(cand[order, None], c.run_req[order], 0.0)
+        sn, sj = c.run_node[order], c.run_job[order]
+        new_seg = jnp.concatenate(
+            [jnp.array([True]), (sn[1:] != sn[:-1]) | (sj[1:] != sj[:-1])]
+        )
+        relcum = _seg_cumsum(sreq, new_seg)
+        rs = dominant_share(s.job_alloc[sj] - relcum, c.total)
+        admit_s = (ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA)
+        cand = cand & jnp.zeros((V,), bool).at[order].set(admit_s)
+
+    if use_prop:
+        order = jnp.lexsort((vidx, run_q, c.run_node, ~cand))
+        # queueless rows don't join the hypothetical subtraction either
+        # (the host's attr-None continue skips before the sub)
+        sreq = jnp.where((cand & has_q)[order, None], c.run_req[order], 0.0)
+        sn, sq = c.run_node[order], run_q[order]
+        new_seg = jnp.concatenate(
+            [jnp.array([True]), (sn[1:] != sn[:-1]) | (sq[1:] != sq[:-1])]
+        )
+        relcum = _seg_cumsum(sreq, new_seg)
+        alloc_after = s.queue_alloc[sq] - relcum
+        # queueless victims have no proportion attr: the host skips them
+        # (reclaimableFn's attr-None continue), so they are never admitted
+        admit_s = less_equal(c.queue_deserved[sq], alloc_after, c.eps) & has_q[order]
+        cand = cand & jnp.zeros((V,), bool).at[order].set(admit_s)
+
+    # eviction order: preempt drains a reversed-TaskOrderFn queue =
+    # (priority asc, uid desc) (preempt.go victimsQueue); reclaim evicts in
+    # candidate list order = node-resident insertion order (reclaim.go:154)
+    if mode == "reclaim":
+        order2 = jnp.lexsort((vidx, c.run_node, ~cand))
+    else:
+        prio_key = c.run_prio if order_by_priority else jnp.zeros((V,), jnp.int32)
+        order2 = jnp.lexsort((-c.run_rank, prio_key, c.run_node, ~cand))
+    s2req = jnp.where(cand[order2, None], c.run_req[order2], 0.0)
+    sn2 = c.run_node[order2]
+    new_seg2 = jnp.concatenate([jnp.array([True]), sn2[1:] != sn2[:-1]])
+    cum2 = _seg_cumsum(s2req, new_seg2)
+    cum_excl = cum2 - s2req
+    # keep evicting while the exclusive prefix does not yet cover the request
+    in_prefix_s = cand[order2] & ~less_equal(t_req[None, :], cum_excl, c.eps)
+
+    node_tgt = jnp.where(cand, c.run_node, N)
+    node_tot = jax.ops.segment_sum(
+        jnp.where(cand[:, None], c.run_req, 0.0), node_tgt, num_segments=N + 1
+    )[:N]
+    any_adm = (
+        jax.ops.segment_sum(cand.astype(jnp.int32), node_tgt, num_segments=N + 1)[:N]
+        > 0
+    )
+    pred_ok = (
+        c.node_valid & c.class_mask[t_cls] & (s.task_count + 1 <= c.node_max_tasks)
+    )
+    # validateVictims (preempt.go:245): skip only when the victim total is
+    # strictly below the request in EVERY dim
+    validate = ~jnp.all(node_tot < t_req[None, :], axis=-1)
+    valid_node = pred_ok & any_adm & validate
+    covered = less_equal(t_req[None, :], node_tot, c.eps) & valid_node
+
+    score = _score_nodes(
+        t_req, s.used, c.node_alloc, c.class_score[t_cls], c.w_least, c.w_balanced
+    )
+    # walk order: preempt visits nodes best-score-first (stable on ties,
+    # preempt.go sortNodes); reclaim visits in snapshot order (reclaim.go
+    # iterates ssn.Nodes directly)
+    nidx = jnp.arange(N, dtype=jnp.int32)
+    if mode == "reclaim":
+        walk_key = nidx.astype(jnp.float32)
+    else:
+        walk_key = -score
+    pos = jnp.zeros((N,), jnp.int32).at[
+        jnp.lexsort((nidx, walk_key))
+    ].set(nidx)  # pos[n] = walk position of node n
+    first_cov_pos = jnp.min(jnp.where(covered, pos, N))
+    first_valid_pos = jnp.min(jnp.where(valid_node, pos, N))
+    assigned = jnp.any(covered)
+    nstar = jnp.argmax(covered & (pos == first_cov_pos)).astype(jnp.int32)
+
+    # clean = the host walk would evict on no node before the chosen one
+    # (otherwise it strands partial evictions on earlier valid nodes —
+    # preempt.go keeps them in the statement — and the caller must take the
+    # per-task host fallback to reproduce that)
+    clean = jnp.where(
+        assigned, first_valid_pos == first_cov_pos, ~jnp.any(valid_node)
+    )
+
+    victim_s = in_prefix_s & (sn2 == nstar) & assigned
+    vmask = jnp.zeros((V,), bool).at[order2].set(victim_s)
+
+    # -- state update (evict victims + pipeline preemptor) -------------------
+    vreq = jnp.where(vmask[:, None], c.run_req, 0.0)
+    vsum = vreq.sum(axis=0)
+    t_add = jnp.where(assigned, t_req, jnp.zeros_like(t_req))
+    new_state = VictimState(
+        run_live=s.run_live & ~vmask,
+        idle=s.idle,  # evict keeps idle (update_task Running->Releasing nets zero)
+        releasing=s.releasing.at[nstar].add(vsum - t_add),
+        used=s.used.at[nstar].add(t_add),
+        task_count=s.task_count.at[nstar].add(jnp.where(assigned, 1, 0)),
+        job_alloc=(
+            s.job_alloc
+            - jax.ops.segment_sum(vreq, c.run_job, num_segments=J)
+        ).at[jt].add(t_add),
+        job_occupied=s.job_occupied
+        - jax.ops.segment_sum(vmask.astype(jnp.int32), c.run_job, num_segments=J),
+        queue_alloc=(
+            s.queue_alloc
+            - jax.ops.segment_sum(
+                vreq, jnp.where(has_q, run_q, Q), num_segments=Q + 1
+            )[:Q]
+        ).at[jnp.clip(qt, 0, Q - 1)].add(t_add),
+    )
+    return new_state, assigned, nstar, vmask, clean
