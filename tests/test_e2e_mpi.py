@@ -1,0 +1,114 @@
+"""MPI-shaped job end-to-end (reference test/e2e/mpi.go:26 +
+example/openmpi-hello.yaml): a master + workers gang with svc/ssh/env
+plugins, verifying the full rsh-discovery contract — headless service,
+hostfile ConfigMap with worker DNS rows, shared keypair, pod DNS identity
+— and job completion when the master's task completes."""
+
+import pytest
+
+from volcano_tpu.api.job import Job, JobSpec, LifecyclePolicy, TaskSpec, make_pod_name
+from volcano_tpu.api.objects import Metadata, PodSpec
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobAction, JobEvent, JobPhase, PodPhase
+from volcano_tpu.sim import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    c.add_queue("default", weight=1)
+    for i in range(2):
+        c.add_node(f"n{i}", {"cpu": "8", "memory": "16Gi", "pods": 110})
+    return c
+
+
+def mpi_job(name="mpi-hello", workers=2):
+    req = Resource.from_resource_list({"cpu": "1", "memory": "1Gi"})
+    return Job(
+        meta=Metadata(name=name, namespace="test"),
+        spec=JobSpec(
+            min_available=1 + workers,
+            plugins={"ssh": [], "svc": [], "env": []},
+            tasks=[
+                TaskSpec(
+                    name="mpimaster",
+                    replicas=1,
+                    template=PodSpec(resources=req.clone()),
+                    policies=[
+                        LifecyclePolicy(
+                            action=JobAction.COMPLETE_JOB,
+                            event=JobEvent.TASK_COMPLETED,
+                        )
+                    ],
+                ),
+                TaskSpec(
+                    name="mpiworker",
+                    replicas=workers,
+                    template=PodSpec(resources=req.clone()),
+                ),
+            ],
+        ),
+    )
+
+
+def test_mpi_job_end_to_end(cluster):
+    job = mpi_job()
+    cluster.submit_job(job)
+    cluster.run_until_idle()
+
+    # gang is up
+    assert job.status.state.phase == JobPhase.RUNNING
+    pods = {p.meta.name: p for p in cluster.store.list("Pod")}
+    assert len(pods) == 3
+    assert all(p.phase == PodPhase.RUNNING for p in pods.values())
+
+    # headless service selects the job's pods
+    svc = cluster.store.get("Service", "test/mpi-hello")
+    assert svc is not None and svc.cluster_ip == "None"
+
+    # hostfile ConfigMap lists every task replica as <pod>.<job> DNS rows
+    hostfile = cluster.store.get("ConfigMap", "test/mpi-hello-svc")
+    assert hostfile is not None
+    workers = hostfile.data["mpiworker.host"].splitlines()
+    assert workers == [
+        f"{make_pod_name('mpi-hello', 'mpiworker', i)}.mpi-hello" for i in range(2)
+    ]
+    assert hostfile.data["mpimaster.host"].splitlines() == [
+        f"{make_pod_name('mpi-hello', 'mpimaster', 0)}.mpi-hello"
+    ]
+
+    # ssh keypair ConfigMap: private key + authorized_keys must pair up
+    ssh = cluster.store.get("ConfigMap", "test/mpi-hello-ssh")
+    assert ssh is not None
+    assert set(ssh.data) == {"id_rsa", "id_rsa.pub", "authorized_keys", "config"}
+    assert ssh.data["authorized_keys"] == ssh.data["id_rsa.pub"]
+
+    # every pod mounts both ConfigMaps and carries DNS identity + task index
+    master_name = make_pod_name("mpi-hello", "mpimaster", 0)
+    for p in pods.values():
+        assert "mpi-hello-svc" in p.volumes and "mpi-hello-ssh" in p.volumes
+        assert p.subdomain == "mpi-hello"
+        assert p.hostname == p.meta.name
+        assert p.env["VT_TASK_INDEX"] in {"0", "1"}
+
+    # master finishes -> TaskCompleted -> CompleteJob; workers get reaped
+    cluster.complete_pod(f"test/{master_name}")
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.COMPLETED
+    assert cluster.store.list("Pod") == []
+
+    # plugin artifacts are cleaned up with the job's pods on delete
+    cluster.store.delete("Job", "test/mpi-hello")
+    cluster.run_until_idle()
+    assert cluster.store.get("Service", "test/mpi-hello") is None
+    assert cluster.store.get("ConfigMap", "test/mpi-hello-svc") is None
+    assert cluster.store.get("ConfigMap", "test/mpi-hello-ssh") is None
+
+
+def test_mpi_gang_waits_for_all_replicas(cluster):
+    # master+workers gang larger than the cluster: nothing binds
+    job = mpi_job(name="mpi-big", workers=20)
+    cluster.submit_job(job)
+    cluster.run_until_idle()
+    assert job.status.state.phase in (JobPhase.PENDING, JobPhase.INQUEUE)
+    assert all(not p.node_name for p in cluster.store.list("Pod"))

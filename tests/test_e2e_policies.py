@@ -1,0 +1,202 @@
+"""End-to-end LifecyclePolicy matrix through the simulated cluster.
+
+Mirrors reference test/e2e/job_error_handling.go: every meaningful
+(event, action) combination — PodFailed/PodEvicted/Any x RestartJob/
+TerminateJob/AbortJob (:31-317) — plus exit-code policies (:472) and
+task-level overrides. Fault injection goes through the store exactly like
+the reference kills pods via the API.
+"""
+
+import pytest
+
+from volcano_tpu.api.job import Job, JobSpec, LifecyclePolicy, TaskSpec
+from volcano_tpu.api.objects import Metadata, PodSpec
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobAction, JobEvent, JobPhase, PodPhase
+from volcano_tpu.sim import Cluster
+
+
+def mk_job(name, replicas=2, policies=None, task_policies=None, max_retry=3):
+    return Job(
+        meta=Metadata(name=name, namespace="test"),
+        spec=JobSpec(
+            min_available=replicas,
+            tasks=[
+                TaskSpec(
+                    name="main",
+                    replicas=replicas,
+                    template=PodSpec(
+                        resources=Resource.from_resource_list(
+                            {"cpu": "1", "memory": "1Gi"}
+                        )
+                    ),
+                    policies=task_policies or [],
+                )
+            ],
+            policies=policies or [],
+            max_retry=max_retry,
+        ),
+    )
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    c.add_queue("default", weight=1)
+    c.add_node("n0", {"cpu": "4", "memory": "8Gi", "pods": 110})
+    return c
+
+
+def start_running(cluster, job):
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.RUNNING
+    return [p.meta.key for p in cluster.store.list("Pod")]
+
+
+def first_pod(cluster):
+    return sorted(p.meta.key for p in cluster.store.list("Pod"))[0]
+
+
+# -- event x action matrix (job_error_handling.go:31-317) ---------------------
+
+@pytest.mark.parametrize("event,inject", [
+    (JobEvent.POD_FAILED, "fail"),
+    (JobEvent.POD_EVICTED, "evict"),
+    (JobEvent.ANY, "fail"),
+    (JobEvent.ANY, "evict"),
+])
+def test_restart_job_policy(cluster, event, inject):
+    job = mk_job("j", policies=[LifecyclePolicy(action=JobAction.RESTART_JOB, event=event)])
+    start_running(cluster, job)
+    version_before = job.status.version
+
+    getattr(cluster, f"{inject}_pod")(first_pod(cluster))
+    cluster.run_until_idle()
+
+    # restarted: version fence bumped, back to Running with fresh pods
+    assert job.status.version > version_before
+    assert job.status.retry_count >= 1
+    assert job.status.state.phase == JobPhase.RUNNING
+    pods = cluster.store.list("Pod")
+    assert len(pods) == 2
+    assert all(p.phase == PodPhase.RUNNING for p in pods)
+
+
+@pytest.mark.parametrize("event,inject", [
+    (JobEvent.POD_FAILED, "fail"),
+    (JobEvent.POD_EVICTED, "evict"),
+])
+def test_terminate_job_policy(cluster, event, inject):
+    job = mk_job("j", policies=[LifecyclePolicy(action=JobAction.TERMINATE_JOB, event=event)])
+    start_running(cluster, job)
+
+    getattr(cluster, f"{inject}_pod")(first_pod(cluster))
+    cluster.run_until_idle()
+
+    assert job.status.state.phase == JobPhase.TERMINATED
+    assert cluster.store.list("Pod") == []
+    # terminated jobs stay dead
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.TERMINATED
+
+
+@pytest.mark.parametrize("event,inject", [
+    (JobEvent.POD_FAILED, "fail"),
+    (JobEvent.POD_EVICTED, "evict"),
+])
+def test_abort_job_policy(cluster, event, inject):
+    job = mk_job("j", policies=[LifecyclePolicy(action=JobAction.ABORT_JOB, event=event)])
+    start_running(cluster, job)
+
+    getattr(cluster, f"{inject}_pod")(first_pod(cluster))
+    cluster.run_until_idle()
+
+    assert job.status.state.phase == JobPhase.ABORTED
+    assert cluster.store.list("Pod") == []
+
+
+def test_complete_job_on_task_completed(cluster):
+    job = mk_job("j", policies=[
+        LifecyclePolicy(action=JobAction.COMPLETE_JOB, event=JobEvent.TASK_COMPLETED)
+    ])
+    pods = start_running(cluster, job)
+    for key in pods:
+        cluster.complete_pod(key)
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.COMPLETED
+
+
+# -- exit-code policies (job_error_handling.go:472) ---------------------------
+
+def test_exit_code_policy_matches(cluster):
+    job = mk_job("j", policies=[LifecyclePolicy(action=JobAction.ABORT_JOB, exit_code=3)])
+    start_running(cluster, job)
+
+    cluster.fail_pod(first_pod(cluster), exit_code=3)
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.ABORTED
+
+
+def test_exit_code_policy_ignores_other_codes(cluster):
+    job = mk_job("j", policies=[LifecyclePolicy(action=JobAction.ABORT_JOB, exit_code=3)])
+    start_running(cluster, job)
+
+    cluster.fail_pod(first_pod(cluster), exit_code=5)
+    cluster.run_until_idle()
+    # no policy matched: default sync just recounts — job keeps running
+    # with one failed pod
+    assert job.status.state.phase == JobPhase.RUNNING
+    assert job.status.failed == 1
+
+
+# -- task-level policy precedence (applyPolicies, job_controller_util.go:136) -
+
+def test_task_policy_overrides_job_policy(cluster):
+    job = mk_job(
+        "j",
+        policies=[LifecyclePolicy(action=JobAction.RESTART_JOB, event=JobEvent.POD_FAILED)],
+        task_policies=[LifecyclePolicy(action=JobAction.ABORT_JOB, event=JobEvent.POD_FAILED)],
+    )
+    start_running(cluster, job)
+
+    cluster.fail_pod(first_pod(cluster))
+    cluster.run_until_idle()
+    assert job.status.state.phase == JobPhase.ABORTED
+
+
+# -- restart under resource pressure (job_error_handling.go:318) --------------
+
+def test_restart_when_cluster_shrunk_waits_pending(cluster):
+    # job restarts on eviction, but the cluster no longer fits the gang:
+    # the restarted job parks Pending/Inqueue with no partial binding
+    job = mk_job("j", replicas=4,
+                 policies=[LifecyclePolicy(action=JobAction.RESTART_JOB,
+                                           event=JobEvent.POD_EVICTED)])
+    start_running(cluster, job)
+
+    node = cluster.store.get("Node", "/n0")
+    node.allocatable = Resource.from_resource_list({"cpu": "2", "memory": "8Gi", "pods": 110})
+    cluster.store.update("Node", node)
+    cluster.evict_pod(first_pod(cluster))
+    cluster.run_until_idle()
+
+    assert job.status.state.phase in (JobPhase.PENDING, JobPhase.INQUEUE)
+    assert all(not p.node_name for p in cluster.store.list("Pod"))
+
+
+def test_max_retry_exhaustion_fails_job(cluster):
+    job = mk_job("j", max_retry=2,
+                 policies=[LifecyclePolicy(action=JobAction.RESTART_JOB,
+                                           event=JobEvent.POD_FAILED)])
+    start_running(cluster, job)
+
+    for _ in range(3):
+        pods = cluster.store.list("Pod")
+        if not pods or job.status.state.phase == JobPhase.FAILED:
+            break
+        cluster.fail_pod(pods[0].meta.key)
+        cluster.run_until_idle()
+
+    assert job.status.state.phase == JobPhase.FAILED
+    assert job.status.retry_count >= 2

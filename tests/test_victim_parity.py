@@ -183,6 +183,49 @@ def test_preempt_parity_conformance_protects_critical():
     assert tpu == []
 
 
+def test_reclaim_parity_same_tier_gang_proportion_intersection():
+    # gang and proportion in ONE tier: vetoes intersect, and proportion's
+    # hypothetical subtraction must run over every preemptee (including
+    # gang-vetoed ones) — the host plugins subtract before any intersection.
+    from volcano_tpu.scheduler.conf import PluginOption, SchedulerConf, Tier
+
+    def build():
+        pods = [
+            # q1 job A: gang needs both -> gang vetoes its pods
+            build_pod("a-0", group="pg-a", cpu="1", phase=PodPhase.RUNNING, node_name="n0"),
+            build_pod("a-1", group="pg-a", cpu="1", phase=PodPhase.RUNNING, node_name="n0"),
+            # q1 job B: single, gang-evictable
+            build_pod("b-0", group="pg-b", cpu="1", phase=PodPhase.RUNNING, node_name="n0"),
+            # q2 pending reclaimer
+            build_pod("q2-0", group="pg-q2", cpu="1"),
+        ]
+        return make_store(
+            nodes=[build_node("n0", cpu="4", memory="8Gi")],
+            queues=[build_queue("q1", weight=1), build_queue("q2", weight=1)],
+            podgroups=[
+                build_podgroup("pg-a", min_member=2, queue="q1"),
+                build_podgroup("pg-b", min_member=1, queue="q1"),
+                build_podgroup("pg-q2", min_member=1, queue="q2"),
+            ],
+            pods=pods,
+        )
+
+    results = {}
+    for backend in ("host", "tpu"):
+        store = build()
+        conf = SchedulerConf(
+            actions=["reclaim"],
+            tiers=[Tier(plugins=[PluginOption("gang"), PluginOption("proportion")])],
+            backend=backend,
+        )
+        sched = Scheduler(store, conf=conf)
+        evictor = FakeEvictor()
+        sched.cache.evictor = evictor
+        sched.run_once()
+        results[backend] = sorted(evictor.evicts)
+    assert results["host"] == results["tpu"]
+
+
 @pytest.mark.parametrize("seed", list(range(8)))
 def test_victim_parity_random_clusters(seed):
     rng = np.random.default_rng(seed)

@@ -38,10 +38,15 @@ class EnqueueAction(Action):
                     jobs_map[job.queue] = PriorityQueue(ssn.job_order_fn)
                 jobs_map[job.queue].push(job)
 
+        from volcano_tpu.scheduler.model import _sub_clamped
+
         idle = Resource()
         for node in ssn.nodes.values():
             overcommitted = node.allocatable.clone().multi(OVERCOMMIT_FACTOR)
-            overcommitted.sub(node.used)
+            # clamp per-node: an oversubscribed node (allocatable shrank
+            # below usage) contributes zero, not a crash — the reference's
+            # Sub would panic here (enqueue.go:80)
+            _sub_clamped(overcommitted, node.used, Resource())
             idle.add(overcommitted)
 
         empty = Resource()

@@ -158,6 +158,37 @@ class JobInfo:
         )
 
 
+def _sub_clamped(pool: Resource, req: Resource, deficit: Resource) -> None:
+    """pool -= req, clamping each dim at zero; the shortfall accumulates in
+    ``deficit`` so later refunds don't inflate the pool."""
+    take = min(pool.milli_cpu, req.milli_cpu)
+    deficit.milli_cpu += req.milli_cpu - take
+    pool.milli_cpu -= take
+    take = min(pool.memory, req.memory)
+    deficit.memory += req.memory - take
+    pool.memory -= take
+    for k, v in req.scalars.items():
+        have = pool.scalars.get(k, 0.0)
+        take = min(have, v)
+        deficit.scalars[k] = deficit.scalars.get(k, 0.0) + v - take
+        pool.scalars[k] = have - take
+
+
+def _add_refund(pool: Resource, req: Resource, deficit: Resource) -> None:
+    """pool += req, but outstanding deficit absorbs the refund first."""
+    pay = min(deficit.milli_cpu, req.milli_cpu)
+    deficit.milli_cpu -= pay
+    pool.milli_cpu += req.milli_cpu - pay
+    pay = min(deficit.memory, req.memory)
+    deficit.memory -= pay
+    pool.memory += req.memory - pay
+    for k, v in req.scalars.items():
+        owed = deficit.scalars.get(k, 0.0)
+        pay = min(owed, v)
+        deficit.scalars[k] = owed - pay
+        pool.scalars[k] = pool.scalars.get(k, 0.0) + v - pay
+
+
 class NodeInfo:
     """Node + resource invariants: Idle/Used/Releasing vs Allocatable.
 
@@ -165,6 +196,12 @@ class NodeInfo:
       Releasing task: charged to Releasing, removed from Idle;
       Pipelined task: *refunds* Releasing (it will consume freed space);
       otherwise: removed from Idle.  Used accumulates all residents.
+
+    Deviation from the reference: node_info.go's Idle.Sub panics when a
+    node is oversubscribed (e.g. allocatable shrank below current usage).
+    Here idle clamps at zero with deficit accounting — the node simply
+    stops fitting new tasks, and capacity only returns once the deficit is
+    paid back by departing residents.
     """
 
     def __init__(self, node: Node):
@@ -175,6 +212,8 @@ class NodeInfo:
         self.idle = node.allocatable.clone()
         self.used = Resource()
         self.releasing = Resource()
+        self.idle_deficit = Resource()
+        self.releasing_deficit = Resource()
         self.tasks: Dict[str, TaskInfo] = {}
 
     def add_task(self, task: TaskInfo) -> None:
@@ -183,11 +222,11 @@ class NodeInfo:
         t = task.clone()
         if t.status == TaskStatus.RELEASING:
             self.releasing.add(t.resreq)
-            self.idle.sub(t.resreq)
+            _sub_clamped(self.idle, t.resreq, self.idle_deficit)
         elif t.status == TaskStatus.PIPELINED:
-            self.releasing.sub(t.resreq)
+            _sub_clamped(self.releasing, t.resreq, self.releasing_deficit)
         else:
-            self.idle.sub(t.resreq)
+            _sub_clamped(self.idle, t.resreq, self.idle_deficit)
         self.used.add(t.resreq)
         self.tasks[t.uid] = t
 
@@ -196,12 +235,12 @@ class NodeInfo:
         if t is None:
             raise ValueError(f"task {task.key} not on node {self.name}")
         if t.status == TaskStatus.RELEASING:
-            self.releasing.sub(t.resreq)
-            self.idle.add(t.resreq)
+            _sub_clamped(self.releasing, t.resreq, self.releasing_deficit)
+            _add_refund(self.idle, t.resreq, self.idle_deficit)
         elif t.status == TaskStatus.PIPELINED:
-            self.releasing.add(t.resreq)
+            _add_refund(self.releasing, t.resreq, self.releasing_deficit)
         else:
-            self.idle.add(t.resreq)
+            _add_refund(self.idle, t.resreq, self.idle_deficit)
         self.used.sub(t.resreq)
 
     def update_task(self, task: TaskInfo) -> None:

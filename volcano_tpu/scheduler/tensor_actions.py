@@ -117,9 +117,12 @@ class _VictimDriver:
         if mode == "reclaim":
             # reclaim evicts in candidate (insertion) order — reclaim.go:154
             vidx = sorted(vidx)
-        else:
+        elif self.kw["order_by_priority"]:
             # preempt drains the reversed task-order queue: (prio asc, uid desc)
             vidx = sorted(vidx, key=lambda i: (snap.run_prio[i], -snap.run_rank[i]))
+        else:
+            # priority task-order disabled: reversed uid fallback only
+            vidx = sorted(vidx, key=lambda i: -snap.run_rank[i])
         victims = []
         for i in vidx:
             job_uid = snap.job_uids[snap.run_job[i]]

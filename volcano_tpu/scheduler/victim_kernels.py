@@ -114,7 +114,10 @@ def victim_step(
 ):
     """One preemptor's victim solve over all nodes.
 
-    Returns (new_state, assigned, node_index, victim_mask[V]).
+    Returns (new_state, assigned, node_index, victim_mask[V], clean).
+    ``clean=False`` means the host walk would strand evictions on nodes
+    that cannot cover the request; the returned state must be DISCARDED
+    and the caller has to replay this preemptor through the host path.
     """
     V = c.run_req.shape[0]
     N = s.idle.shape[0]
@@ -122,7 +125,6 @@ def victim_step(
     Q = s.queue_alloc.shape[0]
     vidx = jnp.arange(V, dtype=jnp.int32)
 
-    cand = s.run_live
     # raw queue rows keep the -1 "queue missing" sentinel so residents of a
     # deleted queue never match a real queue (host compares queue strings);
     # clipped rows are only for gathers/scatters, guarded by has_q
@@ -130,11 +132,17 @@ def victim_step(
     has_q = rq_raw >= 0
     run_q = jnp.clip(rq_raw, 0, Q - 1)
     if mode == "queue":
-        cand = cand & (rq_raw == qt) & (c.run_job != jt)
+        base = s.run_live & (rq_raw == qt) & (c.run_job != jt)
     elif mode == "job":
-        cand = cand & (c.run_job == jt)
+        base = s.run_live & (c.run_job == jt)
     else:  # reclaim: residents of other queues (including queueless jobs)
-        cand = cand & (rq_raw != qt)
+        base = s.run_live & (rq_raw != qt)
+
+    # ``base`` is the preemptee list every plugin sees (the action's task
+    # filter); each veto intersects into ``cand``, but the drf/proportion
+    # hypothetical subtractions run over ALL of base — the host plugins
+    # subtract every preemptee whether or not another plugin vetoes it
+    cand = base
     if use_conformance:
         cand = cand & c.run_evictable
     if use_gang:
@@ -144,8 +152,8 @@ def victim_step(
 
     if use_drf:
         ls = dominant_share(s.job_alloc[jt] + t_req, c.total)
-        order = jnp.lexsort((vidx, c.run_job, c.run_node, ~cand))
-        sreq = jnp.where(cand[order, None], c.run_req[order], 0.0)
+        order = jnp.lexsort((vidx, c.run_job, c.run_node, ~base))
+        sreq = jnp.where(base[order, None], c.run_req[order], 0.0)
         sn, sj = c.run_node[order], c.run_job[order]
         new_seg = jnp.concatenate(
             [jnp.array([True]), (sn[1:] != sn[:-1]) | (sj[1:] != sj[:-1])]
@@ -156,10 +164,10 @@ def victim_step(
         cand = cand & jnp.zeros((V,), bool).at[order].set(admit_s)
 
     if use_prop:
-        order = jnp.lexsort((vidx, run_q, c.run_node, ~cand))
+        order = jnp.lexsort((vidx, run_q, c.run_node, ~base))
         # queueless rows don't join the hypothetical subtraction either
         # (the host's attr-None continue skips before the sub)
-        sreq = jnp.where((cand & has_q)[order, None], c.run_req[order], 0.0)
+        sreq = jnp.where((base & has_q)[order, None], c.run_req[order], 0.0)
         sn, sq = c.run_node[order], run_q[order]
         new_seg = jnp.concatenate(
             [jnp.array([True]), (sn[1:] != sn[:-1]) | (sq[1:] != sq[:-1])]
