@@ -1,0 +1,312 @@
+// Native host-tier allocate solver.
+//
+// This is the C++ analogue of the reference's CPU hot path — the 16-way
+// parallel predicate/score loops of KB/pkg/scheduler/util/
+// scheduler_helper.go:32-106 — operating on the same packed snapshot
+// arrays the JAX kernels consume (volcano_tpu/scheduler/snapshot.py).
+// Semantics mirror kernels.allocate_solve exactly (sequential greedy:
+// queue argmin by proportion share -> job argmin by tier key -> head-task
+// placement by epsilon-tolerant fit + class mask + least-requested/
+// balanced scoring + first-max argmax), so host / tpu / native backends
+// agree bit-for-bit.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -fopenmp solver.cc -o libvtsolver.so
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// job-order key contributors, tier-ordered; 0 = end
+enum JobKey : int32_t { KEY_NONE = 0, KEY_PRIORITY = 1, KEY_GANG = 2, KEY_DRF = 3 };
+
+struct SolveConfig {
+  int32_t n_nodes;
+  int32_t n_tasks;
+  int32_t n_jobs;
+  int32_t n_queues;
+  int32_t n_dims;
+  int32_t n_classes;
+  int32_t use_gang_ready;
+  int32_t use_proportion;
+  int32_t job_keys[4];  // KEY_* sequence
+  float w_least;
+  float w_balanced;
+};
+
+static inline bool less_equal(const float* a, const float* b, const float* eps,
+                              int R) {
+  for (int r = 0; r < R; ++r)
+    if (!(a[r] < b[r] + eps[r])) return false;
+  return true;
+}
+
+static inline float safe_share(float alloc, float denom) {
+  if (denom == 0.0f) return alloc == 0.0f ? 0.0f : 1.0f;
+  return alloc / denom;
+}
+
+static inline float dominant_share(const float* alloc, const float* denom,
+                                   int R) {
+  float s = 0.0f;
+  for (int r = 0; r < R; ++r) {
+    float v = safe_share(alloc[r], denom[r]);
+    if (v > s) s = v;
+  }
+  return s;
+}
+
+// Predicate + score for one (task, node) pair; returns false when the node
+// is infeasible. Shared by the OpenMP and serial loops so the fit/scoring
+// logic exists exactly once (parity with kernels._score_nodes).
+static inline bool eval_node(int n, int R, const float* req, const float* idle,
+                             const float* releasing, const float* used,
+                             const float* node_alloc,
+                             const int32_t* node_max_tasks,
+                             const int32_t* task_count,
+                             const uint8_t* node_valid, const uint8_t* cmask,
+                             const float* cscore, const float* eps,
+                             float w_least, float w_balanced,
+                             float* score_out) {
+  if (!node_valid[n] || !cmask[n]) return false;
+  if (task_count[n] >= node_max_tasks[n]) return false;
+  const float* nid = &idle[(size_t)n * R];
+  const float* nrel = &releasing[(size_t)n * R];
+  bool fit_i = less_equal(req, nid, eps, R);
+  bool fit_r = less_equal(req, nrel, eps, R);
+  if (!fit_i && !fit_r) return false;
+  const float* nal = &node_alloc[(size_t)n * R];
+  const float* nus = &used[(size_t)n * R];
+  float cap_cpu = nal[0], cap_mem = nal[1];
+  float ucpu = nus[0] + req[0], umem = nus[1] + req[1];
+  float least = 0.0f;
+  if (cap_cpu > 0)
+    least += (cap_cpu - ucpu > 0 ? cap_cpu - ucpu : 0) * 10.0f / cap_cpu;
+  if (cap_mem > 0)
+    least += (cap_mem - umem > 0 ? cap_mem - umem : 0) * 10.0f / cap_mem;
+  least *= 0.5f;
+  float cf = safe_share(ucpu, cap_cpu), mf = safe_share(umem, cap_mem);
+  float balanced = (cap_cpu > 0 && cap_mem > 0 && cf < 1.0f && mf < 1.0f)
+                       ? 10.0f - std::fabs(cf - mf) * 10.0f
+                       : 0.0f;
+  *score_out = w_least * least + w_balanced * balanced + cscore[n];
+  return true;
+}
+
+// One scheduling cycle's allocate pass. All arrays are caller-owned numpy
+// buffers; node/job/queue state is mutated in place. Outputs: per task the
+// chosen node (-1 none), kind (0 none / 1 allocated / 2 pipelined) and the
+// placement sequence number.
+void vt_allocate_solve(const SolveConfig* cfg,
+                       // node state [N,R] / [N]
+                       float* idle, float* releasing, float* used,
+                       const float* node_alloc, const int32_t* node_max_tasks,
+                       int32_t* task_count, const uint8_t* node_valid,
+                       // tasks [T,R] / [T]
+                       const float* task_req, const int32_t* task_class,
+                       // jobs [J]
+                       const int32_t* job_queue, const int32_t* job_min,
+                       const int32_t* job_prio, int32_t* job_ready,
+                       float* job_alloc, const uint8_t* job_schedulable,
+                       const int32_t* job_start, const int32_t* job_ntasks,
+                       // queues [Q,R]
+                       float* queue_alloc, const float* queue_deserved,
+                       // predicate classes [C,N]
+                       const uint8_t* class_mask, const float* class_score,
+                       // totals
+                       const float* total, const float* eps,
+                       // outputs [T]
+                       int32_t* out_node, int32_t* out_kind,
+                       int32_t* out_seq) {
+  const int N = cfg->n_nodes, J = cfg->n_jobs, Q = cfg->n_queues,
+            R = cfg->n_dims;
+  const float INF = std::numeric_limits<float>::infinity();
+
+  std::vector<int32_t> cursor(J, 0);
+  std::vector<uint8_t> dropped(J, 0), queue_dropped(Q, 0);
+  int32_t counter = 0;
+  int cur_job = -1;
+
+  auto job_active = [&](int j) -> bool {
+    if (!job_schedulable[j] || dropped[j]) return false;
+    if (cursor[j] >= job_ntasks[j]) return false;
+    int q = job_queue[j];
+    if (q < 0 || q >= Q || queue_dropped[q]) return false;
+    return true;
+  };
+
+  for (;;) {
+    if (cur_job < 0) {
+      // queue selection: lowest proportion share among queues with active
+      // jobs (first-min tie-break), then overused gate
+      std::vector<uint8_t> q_has(Q, 0);
+      bool any = false;
+      for (int j = 0; j < J; ++j)
+        if (job_active(j)) {
+          q_has[job_queue[j]] = 1;
+          any = true;
+        }
+      if (!any) break;
+      int qstar = -1;
+      float best_share = INF;
+      for (int q = 0; q < Q; ++q) {
+        if (!q_has[q]) continue;
+        float share = cfg->use_proportion
+                          ? dominant_share(&queue_alloc[(size_t)q * R],
+                                           &queue_deserved[(size_t)q * R], R)
+                          : 0.0f;
+        if (share < best_share) {
+          best_share = share;
+          qstar = q;
+        }
+      }
+      if (qstar < 0) break;
+      if (cfg->use_proportion &&
+          less_equal(&queue_deserved[(size_t)qstar * R],
+                     &queue_alloc[(size_t)qstar * R], eps, R)) {
+        queue_dropped[qstar] = 1;
+        continue;
+      }
+      // job selection: lexicographic tier keys, creation-index fallback
+      int jstar = -1;
+      float best_keys[4];
+      for (int j = 0; j < J; ++j) {
+        if (!job_active(j) || job_queue[j] != qstar) continue;
+        float keys[4];
+        int nk = 0;
+        for (int k = 0; k < 4 && cfg->job_keys[k] != KEY_NONE; ++k) {
+          switch (cfg->job_keys[k]) {
+            case KEY_PRIORITY:
+              keys[nk++] = -(float)job_prio[j];
+              break;
+            case KEY_GANG:
+              keys[nk++] = job_ready[j] >= job_min[j] ? 1.0f : 0.0f;
+              break;
+            case KEY_DRF:
+              keys[nk++] =
+                  dominant_share(&job_alloc[(size_t)j * R], total, R);
+              break;
+          }
+        }
+        bool better = jstar < 0;
+        if (!better) {
+          for (int k = 0; k < nk; ++k) {
+            if (keys[k] < best_keys[k]) {
+              better = true;
+              break;
+            }
+            if (keys[k] > best_keys[k]) break;
+          }
+        }
+        if (better) {
+          jstar = j;
+          std::memcpy(best_keys, keys, sizeof(float) * nk);
+        }
+      }
+      cur_job = jstar;
+      continue;
+    }
+
+    const int j = cur_job;
+    const int t = job_start[j] + cursor[j];
+    const float* req = &task_req[(size_t)t * R];
+    const int cls = task_class[t];
+    const uint8_t* cmask = &class_mask[(size_t)cls * N];
+    const float* cscore = &class_score[(size_t)cls * N];
+
+    // parallel predicate + score + first-max reduction over nodes — the
+    // scheduler_helper.go 16-goroutine loop, as an OpenMP stripe reduce
+    int best_node = -1;
+    float best_score = -INF;
+#if defined(_OPENMP)
+#pragma omp parallel
+    {
+      int local_best = -1;
+      float local_score = -INF;
+#pragma omp for nowait schedule(static)
+      for (int n = 0; n < N; ++n) {
+        float score;
+        if (!eval_node(n, R, req, idle, releasing, used, node_alloc,
+                       node_max_tasks, task_count, node_valid, cmask, cscore,
+                       eps, cfg->w_least, cfg->w_balanced, &score))
+          continue;
+        if (score > local_score) {
+          local_score = score;
+          local_best = n;
+        }
+      }
+#pragma omp critical
+      {
+        // global first-max: higher score wins, ties go to the lower index
+        if (local_best >= 0 &&
+            (best_node < 0 || local_score > best_score ||
+             (local_score == best_score && local_best < best_node))) {
+          best_score = local_score;
+          best_node = local_best;
+        }
+      }
+    }
+#else
+    for (int n = 0; n < N; ++n) {
+      float score;
+      if (!eval_node(n, R, req, idle, releasing, used, node_alloc,
+                     node_max_tasks, task_count, node_valid, cmask, cscore,
+                     eps, cfg->w_least, cfg->w_balanced, &score))
+        continue;
+      if (score > best_score) {
+        best_score = score;
+        best_node = n;
+      }
+    }
+#endif
+
+    if (best_node < 0) {
+      dropped[j] = 1;
+      cur_job = -1;
+      continue;
+    }
+
+    const int n = best_node;
+    float* nid = &idle[(size_t)n * R];
+    float* nrel = &releasing[(size_t)n * R];
+    bool use_idle = less_equal(req, nid, eps, R);
+    if (use_idle)
+      for (int r = 0; r < R; ++r) nid[r] -= req[r];
+    else
+      for (int r = 0; r < R; ++r) nrel[r] -= req[r];
+    for (int r = 0; r < R; ++r) used[(size_t)n * R + r] += req[r];
+    task_count[n] += 1;
+    for (int r = 0; r < R; ++r) job_alloc[(size_t)j * R + r] += req[r];
+    if (use_idle) job_ready[j] += 1;
+    const int q = job_queue[j];
+    if (q >= 0)
+      for (int r = 0; r < R; ++r) queue_alloc[(size_t)q * R + r] += req[r];
+
+    out_node[t] = n;
+    out_kind[t] = use_idle ? 1 : 2;
+    out_seq[t] = counter++;
+
+    cursor[j] += 1;
+    bool now_ready = cfg->use_gang_ready ? (job_ready[j] >= job_min[j]) : true;
+    bool exhausted = cursor[j] >= job_ntasks[j];
+    if (now_ready || exhausted) cur_job = -1;
+  }
+}
+
+int32_t vt_num_threads(void) {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,248 @@
+"""Native host-tier solver bindings (ctypes over native/solver.cc).
+
+The reference's CPU hot path is Go with 16-way goroutine parallelism
+(KB/pkg/scheduler/util/scheduler_helper.go:32-106); this framework's native
+tier is the same loop in C++/OpenMP, sharing the packed snapshot arrays
+with the JAX kernels. Selected with ``backend: native`` in scheduler-conf —
+the CPU fallback for hosts without a TPU attached.
+
+The shared library builds on demand with g++ (cached next to the source;
+rebuilt when solver.cc is newer). No pybind11: plain ``extern "C"`` +
+ctypes + numpy pointers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "solver.cc")
+_LIB = os.path.join(_REPO_ROOT, "native", "libvtsolver.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _record_failure(err: str) -> None:
+    """Cache the failure and tell the operator once — 'backend: native'
+    silently degrading to the host path every cycle would be invisible."""
+    global _build_error
+    _build_error = err
+    import logging
+
+    logging.getLogger("volcano_tpu.native").warning(
+        "native solver unavailable, scheduler falls back to host path: %s", err
+    )
+
+KEY_NONE, KEY_PRIORITY, KEY_GANG, KEY_DRF = 0, 1, 2, 3
+_KEY_IDS = {"priority": KEY_PRIORITY, "gang": KEY_GANG, "drf": KEY_DRF}
+
+
+class SolveConfig(ctypes.Structure):
+    _fields_ = [
+        ("n_nodes", ctypes.c_int32),
+        ("n_tasks", ctypes.c_int32),
+        ("n_jobs", ctypes.c_int32),
+        ("n_queues", ctypes.c_int32),
+        ("n_dims", ctypes.c_int32),
+        ("n_classes", ctypes.c_int32),
+        ("use_gang_ready", ctypes.c_int32),
+        ("use_proportion", ctypes.c_int32),
+        ("job_keys", ctypes.c_int32 * 4),
+        ("w_least", ctypes.c_float),
+        ("w_balanced", ctypes.c_float),
+    ]
+
+
+def _build() -> Optional[str]:
+    """Compile solver.cc -> libvtsolver.so; returns an error string or None.
+
+    Compiles to a per-pid temp path and renames into place so concurrent
+    processes racing the build never dlopen a half-written library."""
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"native build failed to launch: {e}"
+    if proc.returncode != 0:
+        return f"native build failed: {proc.stderr[-2000:]}"
+    try:
+        os.replace(tmp, _LIB)
+    except OSError as e:
+        return f"native build rename failed: {e}"
+    return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The solver library, building it if needed; None if unavailable."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        if not os.path.exists(_SRC):
+            _build_error = f"native source missing: {_SRC}"
+            return None
+        if (
+            not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            err = _build()
+            if err is not None:
+                _record_failure(err)
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+            lib.vt_allocate_solve.restype = None
+            lib.vt_num_threads.restype = ctypes.c_int32
+        except (OSError, AttributeError) as e:
+            # corrupt .so, wrong arch, or stale symbols from older source:
+            # degrade to the host path instead of crashing the cycle
+            _record_failure(f"native library unusable: {e}")
+            return None
+        _lib = lib
+        return _lib
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+def num_threads() -> int:
+    lib = load()
+    return int(lib.vt_num_threads()) if lib else 0
+
+
+def _f32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _u8(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.uint8)
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def water_fill_np(weight, request, total, eps, participates) -> np.ndarray:
+    """Numpy proportion water-filling — same algorithm as
+    kernels.water_fill, for the native tier (no JAX dependency)."""
+    weight = np.asarray(weight, np.float32)
+    request = np.asarray(request, np.float32)
+    remaining = np.asarray(total, np.float32).copy()
+    eps = np.asarray(eps, np.float32)
+    participates = np.asarray(participates, bool)
+    deserved = np.zeros_like(request)
+    met = np.zeros(weight.shape[0], bool)
+    while True:
+        live = participates & ~met
+        total_weight = weight[live].sum()
+        if total_weight <= 0:
+            break
+        frac = np.where(live, weight / total_weight, 0.0)
+        new_deserved = deserved + remaining[None, :] * frac[:, None]
+        exceeded = ~np.all(new_deserved < request + eps, axis=-1) & live
+        capped = np.where(
+            exceeded[:, None], np.minimum(new_deserved, request), new_deserved
+        )
+        capped = np.where(live[:, None], capped, deserved)
+        met |= exceeded
+        remaining = remaining - (capped - deserved).sum(axis=0)
+        deserved = capped
+        if np.all(remaining < eps):
+            break
+    return deserved.astype(np.float32)
+
+
+def allocate_solve(
+    snap,
+    deserved: np.ndarray,
+    w_least: float,
+    w_balanced: float,
+    job_key_order=("priority", "gang", "drf"),
+    use_gang_ready: bool = True,
+    use_proportion: bool = True,
+):
+    """Run one allocate pass natively over a TensorSnapshot.
+
+    Returns (task_node, task_kind, task_seq, job_ready) int32 arrays — the
+    same decision outputs as kernels.allocate_solve. Raises RuntimeError
+    when the native library is unavailable (callers fall back to the host
+    path).
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError(build_error() or "native solver unavailable")
+
+    N, T, J, Q, C = snap.shape
+    R = len(snap.dims)
+    cfg = SolveConfig(
+        n_nodes=N, n_tasks=T, n_jobs=J, n_queues=Q, n_dims=R, n_classes=C,
+        use_gang_ready=int(use_gang_ready),
+        use_proportion=int(use_proportion),
+        w_least=float(w_least), w_balanced=float(w_balanced),
+    )
+    keys = [_KEY_IDS[k] for k in job_key_order if k in _KEY_IDS][:4]
+    for i in range(4):
+        cfg.job_keys[i] = keys[i] if i < len(keys) else KEY_NONE
+
+    # mutable copies: the solver updates state in place
+    idle = _f32(snap.node_idle.copy())
+    releasing = _f32(snap.node_releasing.copy())
+    used = _f32(snap.node_used.copy())
+    task_count = _i32(snap.node_task_count.copy())
+    job_ready = _i32(snap.job_ready_init.copy())
+    job_alloc = _f32(snap.job_alloc_init.copy())
+    queue_alloc = _f32(snap.queue_alloc_init.copy())
+
+    node_alloc = _f32(snap.node_alloc)
+    node_max_tasks = _i32(snap.node_max_tasks)
+    node_valid = _u8(snap.node_valid)
+    task_req = _f32(snap.task_req)
+    task_class = _i32(snap.task_class)
+    job_queue = _i32(snap.job_queue)
+    job_min = _i32(snap.job_min_available)
+    job_prio = _i32(snap.job_priority)
+    job_schedulable = _u8(snap.job_schedulable)
+    job_start = _i32(snap.job_start)
+    job_ntasks = _i32(snap.job_ntasks)
+    deserved = _f32(deserved)
+    class_mask = _u8(snap.class_node_mask)
+    class_score = _f32(snap.class_node_score)
+    total = _f32(snap.total)
+    eps = _f32(snap.eps)
+
+    out_node = np.full((T,), -1, np.int32)
+    out_kind = np.zeros((T,), np.int32)
+    out_seq = np.full((T,), -1, np.int32)
+
+    lib.vt_allocate_solve(
+        ctypes.byref(cfg),
+        _ptr(idle), _ptr(releasing), _ptr(used),
+        _ptr(node_alloc), _ptr(node_max_tasks), _ptr(task_count), _ptr(node_valid),
+        _ptr(task_req), _ptr(task_class),
+        _ptr(job_queue), _ptr(job_min), _ptr(job_prio), _ptr(job_ready),
+        _ptr(job_alloc), _ptr(job_schedulable), _ptr(job_start), _ptr(job_ntasks),
+        _ptr(queue_alloc), _ptr(deserved),
+        _ptr(class_mask), _ptr(class_score),
+        _ptr(total), _ptr(eps),
+        _ptr(out_node), _ptr(out_kind), _ptr(out_seq),
+    )
+    return out_node, out_kind, out_seq, job_ready

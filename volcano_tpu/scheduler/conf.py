@@ -43,7 +43,7 @@ class Tier:
 class SchedulerConf:
     actions: List[str] = field(default_factory=lambda: ["allocate", "backfill"])
     tiers: List[Tier] = field(default_factory=list)
-    backend: str = "host"  # "tpu" (tensor kernels) | "host" (object oracle path)
+    backend: str = "host"  # "tpu" (JAX kernels) | "native" (C++ solver) | "host" (object oracle)
     solve_mode: str = "auto"  # tpu backend: "auto" | "exact" | "batch"
     schedule_period: float = 1.0
 

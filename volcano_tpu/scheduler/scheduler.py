@@ -39,10 +39,12 @@ class Scheduler:
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers)
 
-        if self.conf.backend == "tpu":
+        if self.conf.backend in ("tpu", "native"):
             from volcano_tpu.scheduler.tensor_backend import TensorBackend
 
-            ssn.tensor_backend = TensorBackend(ssn, solve_mode=self.conf.solve_mode)
+            ssn.tensor_backend = TensorBackend(
+                ssn, solve_mode=self.conf.solve_mode, flavor=self.conf.backend
+            )
         else:
             ssn.tensor_backend = None
 

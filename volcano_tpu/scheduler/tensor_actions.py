@@ -29,6 +29,8 @@ def _victim_path_usable(ssn, backend):
 
     if backend is None or not backend.supported:
         return False
+    if backend.flavor != "tpu":
+        return False  # native victim solver not yet implemented
     snap = backend.snapshot()
     if snap.has_dynamic_predicates:
         return False
@@ -339,56 +341,74 @@ def allocate(ssn) -> None:
         backend.invalidate()  # host path mutated state behind the cache
         return
 
-    import jax.numpy as jnp
-
-    from volcano_tpu.scheduler.kernels import allocate_solve, allocate_solve_batch
-
     w_least, w_balanced = backend.score_weights()
-    deserved = backend.deserved()
 
-    n_pending = int(snap.task_valid.sum())
-    use_batch = backend.solve_mode == "batch" or (
-        backend.solve_mode == "auto" and n_pending > backend.batch_threshold
-    )
-    solve = allocate_solve_batch if use_batch else allocate_solve
+    if backend.flavor == "native":
+        from volcano_tpu import native as native_solver
 
-    out = solve(
-        jnp.asarray(snap.node_idle),
-        jnp.asarray(snap.node_releasing),
-        jnp.asarray(snap.node_used),
-        jnp.asarray(snap.node_alloc),
-        jnp.asarray(snap.node_max_tasks),
-        jnp.asarray(snap.node_task_count),
-        jnp.asarray(snap.node_valid),
-        jnp.asarray(snap.task_req),
-        jnp.asarray(snap.task_job),
-        jnp.asarray(snap.task_class),
-        jnp.asarray(snap.task_valid),
-        jnp.asarray(snap.job_queue),
-        jnp.asarray(snap.job_min_available),
-        jnp.asarray(snap.job_priority),
-        jnp.asarray(snap.job_ready_init),
-        jnp.asarray(snap.job_alloc_init),
-        jnp.asarray(snap.job_schedulable),
-        jnp.asarray(snap.job_start),
-        jnp.asarray(snap.job_ntasks),
-        jnp.asarray(snap.queue_alloc_init),
-        deserved,
-        jnp.asarray(snap.class_node_mask),
-        jnp.asarray(snap.class_node_score),
-        jnp.asarray(snap.total),
-        jnp.asarray(snap.eps),
-        jnp.float32(w_least),
-        jnp.float32(w_balanced),
-        job_key_order=backend.job_key_order,
-        use_gang_ready=backend.gang_job_ready,
-        use_proportion=backend.proportion_queue_order,
-    )
+        try:
+            task_node, task_kind, task_seq, ready = native_solver.allocate_solve(
+                snap,
+                np.asarray(backend.deserved()),
+                w_least,
+                w_balanced,
+                job_key_order=backend.job_key_order,
+                use_gang_ready=backend.gang_job_ready,
+                use_proportion=backend.proportion_queue_order,
+            )
+        except RuntimeError:
+            _host_allocate(ssn)
+            backend.invalidate()
+            return
+    else:
+        import jax.numpy as jnp
 
-    task_node = np.asarray(out[0])
-    task_kind = np.asarray(out[1])
-    task_seq = np.asarray(out[2])
-    ready = np.asarray(out[3])
+        from volcano_tpu.scheduler.kernels import allocate_solve, allocate_solve_batch
+
+        deserved = backend.deserved()
+        n_pending = int(snap.task_valid.sum())
+        use_batch = backend.solve_mode == "batch" or (
+            backend.solve_mode == "auto" and n_pending > backend.batch_threshold
+        )
+        solve = allocate_solve_batch if use_batch else allocate_solve
+
+        out = solve(
+            jnp.asarray(snap.node_idle),
+            jnp.asarray(snap.node_releasing),
+            jnp.asarray(snap.node_used),
+            jnp.asarray(snap.node_alloc),
+            jnp.asarray(snap.node_max_tasks),
+            jnp.asarray(snap.node_task_count),
+            jnp.asarray(snap.node_valid),
+            jnp.asarray(snap.task_req),
+            jnp.asarray(snap.task_job),
+            jnp.asarray(snap.task_class),
+            jnp.asarray(snap.task_valid),
+            jnp.asarray(snap.job_queue),
+            jnp.asarray(snap.job_min_available),
+            jnp.asarray(snap.job_priority),
+            jnp.asarray(snap.job_ready_init),
+            jnp.asarray(snap.job_alloc_init),
+            jnp.asarray(snap.job_schedulable),
+            jnp.asarray(snap.job_start),
+            jnp.asarray(snap.job_ntasks),
+            jnp.asarray(snap.queue_alloc_init),
+            deserved,
+            jnp.asarray(snap.class_node_mask),
+            jnp.asarray(snap.class_node_score),
+            jnp.asarray(snap.total),
+            jnp.asarray(snap.eps),
+            jnp.float32(w_least),
+            jnp.float32(w_balanced),
+            job_key_order=backend.job_key_order,
+            use_gang_ready=backend.gang_job_ready,
+            use_proportion=backend.proportion_queue_order,
+        )
+
+        task_node = np.asarray(out[0])
+        task_kind = np.asarray(out[1])
+        task_seq = np.asarray(out[2])
+        ready = np.asarray(out[3])
 
     placed = np.nonzero(task_kind > 0)[0]
     if placed.size == 0:

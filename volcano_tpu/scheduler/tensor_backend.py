@@ -45,11 +45,13 @@ class TensorBackend:
         bulk_threshold: int = BULK_THRESHOLD,
         solve_mode: str = "auto",  # auto | exact | batch
         batch_threshold: int = BATCH_THRESHOLD,
+        flavor: str = "tpu",  # "tpu" (JAX kernels) | "native" (C++ solver)
     ):
         self.ssn = ssn
         self.bulk_threshold = bulk_threshold
         self.solve_mode = solve_mode
         self.batch_threshold = batch_threshold
+        self.flavor = flavor
         self.enabled: Dict[str, bool] = {}
         self.nodeorder_args: Dict[str, str] = {}
         self.supported = True
@@ -103,20 +105,32 @@ class TensorBackend:
         self._snapshot = None
 
     def deserved(self):
-        """Proportion water-filling deserved shares [Q, R] (device)."""
+        """Proportion water-filling deserved shares [Q, R] (device for the
+        tpu flavor, numpy for native — the native tier has no JAX dep)."""
         if self._deserved is None:
-            import jax.numpy as jnp
-
-            from volcano_tpu.scheduler.kernels import water_fill
-
             snap = self.snapshot()
-            self._deserved = water_fill(
-                jnp.asarray(snap.queue_weight),
-                jnp.asarray(snap.queue_request),
-                jnp.asarray(snap.total),
-                jnp.asarray(snap.eps),
-                jnp.asarray(snap.queue_participates),
-            )
+            if self.flavor == "native":
+                from volcano_tpu.native import water_fill_np
+
+                self._deserved = water_fill_np(
+                    snap.queue_weight,
+                    snap.queue_request,
+                    snap.total,
+                    snap.eps,
+                    snap.queue_participates,
+                )
+            else:
+                import jax.numpy as jnp
+
+                from volcano_tpu.scheduler.kernels import water_fill
+
+                self._deserved = water_fill(
+                    jnp.asarray(snap.queue_weight),
+                    jnp.asarray(snap.queue_request),
+                    jnp.asarray(snap.total),
+                    jnp.asarray(snap.eps),
+                    jnp.asarray(snap.queue_participates),
+                )
         return self._deserved
 
     # -- victim selection (preempt/reclaim) ----------------------------------
