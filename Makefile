@@ -1,0 +1,32 @@
+# Analogue of the reference Makefile targets (Makefile:15-63):
+# unit-test -> test, e2e-test-kind -> e2e (simulator), images -> native lib.
+
+PY ?= python
+
+.PHONY: test e2e parity bench native examples clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+e2e:
+	$(PY) -m pytest tests/test_e2e_policies.py tests/test_e2e_mpi.py tests/test_controller.py -q
+
+parity:
+	$(PY) -m pytest tests/test_tensor_parity.py tests/test_victim_parity.py tests/test_native_backend.py -q
+
+bench:
+	$(PY) bench.py
+
+native: native/libvtsolver.so
+
+native/libvtsolver.so: native/solver.cc
+	g++ -O3 -shared -fPIC -fopenmp -std=c++17 native/solver.cc -o native/libvtsolver.so
+
+examples:
+	$(PY) examples/job_gang.py
+	$(PY) examples/mpi_hello.py
+	$(PY) examples/tensorflow_benchmark.py
+
+clean:
+	rm -f native/libvtsolver.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,63 @@
+"""OpenMPI-shaped job with ssh/svc/env plugins — the analogue of the
+reference's example/integrations/mpi/openmpi-hello.yaml.
+
+Run: python examples/mpi_hello.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from volcano_tpu.api.job import Job, JobSpec, LifecyclePolicy, TaskSpec
+from volcano_tpu.api.objects import Metadata, PodSpec
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobAction, JobEvent
+from volcano_tpu.sim import Cluster
+
+
+def main():
+    c = Cluster()
+    c.add_queue("default", weight=1)
+    for i in range(2):
+        c.add_node(f"node-{i}", {"cpu": "8", "memory": "16Gi", "pods": 110})
+
+    req = Resource.from_resource_list({"cpu": "1", "memory": "1Gi"})
+    job = Job(
+        meta=Metadata(name="openmpi-hello", namespace="default"),
+        spec=JobSpec(
+            min_available=3,
+            plugins={"ssh": [], "svc": [], "env": []},
+            tasks=[
+                TaskSpec(
+                    name="mpimaster", replicas=1,
+                    template=PodSpec(image="openmpi-hello", resources=req.clone()),
+                    policies=[LifecyclePolicy(action=JobAction.COMPLETE_JOB,
+                                              event=JobEvent.TASK_COMPLETED)],
+                ),
+                TaskSpec(
+                    name="mpiworker", replicas=2,
+                    template=PodSpec(image="openmpi-hello", resources=req.clone()),
+                ),
+            ],
+        ),
+    )
+    c.submit_job(job)
+    c.run_until_idle()
+
+    print(f"job phase: {job.status.state.phase.value}")
+    hostfile = c.store.get("ConfigMap", "default/openmpi-hello-svc")
+    print("hostfile (mpiworker.host):")
+    for line in hostfile.data["mpiworker.host"].splitlines():
+        print(f"  {line}")
+    ssh = c.store.get("ConfigMap", "default/openmpi-hello-ssh")
+    print(f"ssh keypair keys: {sorted(ssh.data)}")
+
+    # master finishes -> TaskCompleted policy completes the job
+    c.complete_pod("default/openmpi-hello-mpimaster-0")
+    c.run_until_idle()
+    print(f"after master completion: {job.status.state.phase.value}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""PS/worker TensorFlow-benchmark-shaped job — the analogue of the
+reference's example/tensorflow-benchmark.yaml (2 ps + 3 workers, env+svc
+plugins for TF_CONFIG-style discovery).
+
+Run: python examples/tensorflow_benchmark.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from volcano_tpu.api.job import Job, JobSpec, TaskSpec
+from volcano_tpu.api.objects import Metadata, PodSpec
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.sim import Cluster
+
+
+def main():
+    c = Cluster()
+    c.add_queue("default", weight=1)
+    for i in range(3):
+        c.add_node(
+            f"node-{i}",
+            {"cpu": "16", "memory": "32Gi", "pods": 110, "accelerator": 4},
+        )
+
+    job = Job(
+        meta=Metadata(name="tf-benchmark", namespace="default"),
+        spec=JobSpec(
+            min_available=5,
+            plugins={"env": [], "svc": []},
+            tasks=[
+                TaskSpec(
+                    name="ps", replicas=2,
+                    template=PodSpec(
+                        image="tf-benchmarks",
+                        resources=Resource.from_resource_list(
+                            {"cpu": "2", "memory": "4Gi"}),
+                    ),
+                ),
+                TaskSpec(
+                    name="worker", replicas=3,
+                    template=PodSpec(
+                        image="tf-benchmarks",
+                        resources=Resource.from_resource_list(
+                            {"cpu": "4", "memory": "8Gi", "accelerator": 1}),
+                    ),
+                ),
+            ],
+        ),
+    )
+    c.submit_job(job)
+    c.run_until_idle()
+
+    print(f"job phase: {job.status.state.phase.value}")
+    for pod in sorted(c.store.list("Pod"), key=lambda p: p.meta.name):
+        print(
+            f"  {pod.meta.name:26s} -> {pod.node_name:10s}"
+            f" VT_TASK_INDEX={pod.env.get('VT_TASK_INDEX')}"
+        )
+    hostfile = c.store.get("ConfigMap", "default/tf-benchmark-svc")
+    print("discovery rows:", sorted(hostfile.data))
+
+
+if __name__ == "__main__":
+    main()
