@@ -1,0 +1,203 @@
+"""Events, metrics endpoint, and leader election.
+
+Parity sources: event recorders (KB/pkg/scheduler/cache/cache.go:443,401,
+467; pkg/controllers/job/job_controller.go:115), /metrics endpoint
+(KB/cmd/kube-batch/app/server.go:86-89), leader election
+(cmd/controllers/app/server.go:103-125).
+"""
+
+import urllib.request
+
+from volcano_tpu import events
+from volcano_tpu.api.types import JobPhase, PodPhase
+from volcano_tpu.leader import LeaderElector
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.conf import default_conf
+from volcano_tpu.scheduler.metrics_server import MetricsServer
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.store import Store
+
+from helpers import build_node, build_pod, build_podgroup, make_store
+
+
+def test_scheduled_event_on_bind():
+    store = make_store(
+        nodes=[build_node("n1")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg")],
+    )
+    Scheduler(store, conf=default_conf()).run_once()
+    evs = events.events_for(store, "Pod", "default/p0")
+    assert any(e.reason == "Scheduled" and "n1" in e.message for e in evs)
+
+
+def test_evict_event_and_aggregation():
+    store = Store()
+    events.record(store, "Pod", "default/x", "Evict", "Evicted for preempt",
+                  type=events.WARNING)
+    events.record(store, "Pod", "default/x", "Evict", "Evicted for preempt",
+                  type=events.WARNING)
+    evs = events.events_for(store, "Pod", "default/x")
+    assert len(evs) == 1
+    assert evs[0].count == 2
+    assert evs[0].type == events.WARNING
+
+
+def test_unschedulable_event_on_gang_failure():
+    store = make_store(
+        nodes=[build_node("n1", cpu="1", memory="2Gi")],
+        podgroups=[build_podgroup("pg", min_member=3)],
+        pods=[build_pod(f"p{i}", group="pg", cpu="1") for i in range(3)],
+    )
+    Scheduler(store, conf=default_conf()).run_once()
+    evs = events.events_for(store, "PodGroup", "default/pg")
+    assert any(e.reason == "Unschedulable" for e in evs)
+
+
+def test_unschedulable_condition_clears_and_reevents_on_repeat_episode():
+    # fails -> schedules -> fails again: the stale condition is cleared on
+    # success, so the second episode records a fresh event (count bump)
+    store = make_store(
+        nodes=[build_node("n1", cpu="1", memory="2Gi")],
+        podgroups=[build_podgroup("pg", min_member=2)],
+        pods=[build_pod(f"p{i}", group="pg", cpu="1") for i in range(2)],
+    )
+    sched = Scheduler(store, conf=default_conf())
+    sched.run_once()
+    pg = store.get("PodGroup", "default/pg")
+    assert any(c.kind == "Unschedulable" for c in pg.status.conditions)
+
+    # grow the node so the gang schedules; condition must clear
+    node = store.get("Node", "/n1")
+    node.allocatable = node.allocatable.clone()
+    node.allocatable.milli_cpu = 4000.0
+    store.update("Node", node)
+    sched.run_once()
+    assert not any(c.kind == "Unschedulable" for c in pg.status.conditions)
+
+    # shrink again + new identical-shape failure -> event count grows
+    before = events.events_for(store, "PodGroup", "default/pg")[0].count
+    for p in store.list("Pod"):
+        p.node_name = ""
+        p.phase = PodPhase.PENDING
+        store.update("Pod", p)
+    node.allocatable.milli_cpu = 1000.0
+    store.update("Node", node)
+    sched.run_once()
+    after = events.events_for(store, "PodGroup", "default/pg")[0].count
+    assert after == before + 1
+
+
+def test_command_issued_event():
+    from volcano_tpu.cli.vtctl import cmd_run, cmd_suspend
+    from volcano_tpu.sim import Cluster
+
+    c = Cluster()
+    c.add_queue("default", weight=1)
+    c.add_node("n0", {"cpu": "4", "memory": "8Gi"})
+    cmd_run(c.store, name="j1")
+    c.run_until_idle()
+    cmd_suspend(c.store, "default", "j1")
+    c.run_until_idle()
+    evs = events.events_for(c.store, "Job", "default/j1")
+    assert any(e.reason == "CommandIssued" for e in evs)
+
+
+def test_metrics_endpoint_serves_reference_series():
+    metrics.reset()
+    store = make_store(
+        nodes=[build_node("n1")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg")],
+    )
+    Scheduler(store, conf=default_conf()).run_once()
+
+    srv = MetricsServer(port=0).start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics") as r:
+            body = r.read().decode()
+        assert "volcano_e2e_scheduling_latency_milliseconds" in body
+        assert "volcano_action_scheduling_latency_microseconds" in body
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/healthz") as r:
+            assert r.read() == b"ok\n"
+    finally:
+        srv.stop()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_leader_election_single_winner():
+    store = Store()
+    clock = FakeClock()
+    a = LeaderElector(store, "vt-scheduler", "a", lease_duration=15, clock=clock)
+    b = LeaderElector(store, "vt-scheduler", "b", lease_duration=15, clock=clock)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    assert a.is_leader() and not b.is_leader()
+    # renewal keeps the lease
+    clock.t = 10
+    assert a.try_acquire()
+    clock.t = 20
+    assert not b.try_acquire()  # renewed at t=10, expires at t=25
+
+
+def test_leader_election_takeover_after_expiry():
+    store = Store()
+    clock = FakeClock()
+    a = LeaderElector(store, "vt-scheduler", "a", lease_duration=15, clock=clock)
+    b = LeaderElector(store, "vt-scheduler", "b", lease_duration=15, clock=clock)
+    assert a.try_acquire()
+    clock.t = 16  # a stopped renewing; lease expired
+    assert b.try_acquire()
+    assert b.is_leader() and not a.is_leader()
+    assert store.get("Lease", "/vt-scheduler").transitions == 1
+
+
+def test_leader_election_release_hands_off():
+    store = Store()
+    clock = FakeClock()
+    a = LeaderElector(store, "s", "a", clock=clock)
+    b = LeaderElector(store, "s", "b", clock=clock)
+    assert a.try_acquire()
+    a.release()
+    assert b.try_acquire()
+
+
+def test_standby_scheduler_does_not_bind():
+    clock = FakeClock()
+    store = make_store(
+        nodes=[build_node("n1")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg")],
+    )
+    leader = Scheduler(store, conf=default_conf(),
+                       elector=LeaderElector(store, "sched", "leader", clock=clock))
+    standby = Scheduler(store, conf=default_conf(),
+                        elector=LeaderElector(store, "sched", "standby", clock=clock))
+    leader.run_once()
+    standby.run_once()
+    assert leader.cache.bind_log and not standby.cache.bind_log
+
+    # leader dies; standby takes over next cycle after expiry
+    store2 = make_store(
+        nodes=[build_node("n1")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg")],
+    )
+    clock2 = FakeClock()
+    dead = LeaderElector(store2, "sched", "dead", clock=clock2)
+    assert dead.try_acquire()
+    standby2 = Scheduler(store2, conf=default_conf(),
+                         elector=LeaderElector(store2, "sched", "standby",
+                                               clock=clock2))
+    standby2.run_once()
+    assert not standby2.cache.bind_log
+    clock2.t = 20.0
+    standby2.run_once()
+    assert standby2.cache.bind_log

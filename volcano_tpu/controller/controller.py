@@ -78,12 +78,14 @@ def apply_policies(job: Job, req: Request) -> JobAction:
 
 
 class JobController:
-    def __init__(self, store: Store, scheduler_name: str = "volcano-tpu"):
+    def __init__(self, store: Store, scheduler_name: str = "volcano-tpu",
+                 elector=None):
         self.store = store
         self.scheduler_name = scheduler_name
         self.cache = JobCache()
         self.queue: Deque[Request] = deque()
         self.events: List[str] = []  # human-readable event log (k8s Events)
+        self.elector = elector  # optional LeaderElector (HA analogue)
 
         self._job_w = store.watch("Job")
         self._pod_w = store.watch("Pod")
@@ -95,6 +97,8 @@ class JobController:
     def pump(self) -> bool:
         """Drain watches into requests, then process all requests. Returns
         whether any work happened."""
+        if self.elector is not None and not self.elector.try_acquire():
+            return False  # standby replica: watches stay queued for takeover
         worked = False
         while self._drain_watches():
             worked = True
@@ -225,6 +229,13 @@ class JobController:
             )
             return
         self.events.append(f"CommandIssued {cmd.action} {cmd.meta.namespace}/{job_name}")
+        from volcano_tpu import events as cluster_events
+
+        # job_controller.go:115 recorder analogue
+        cluster_events.record(
+            self.store, "Job", f"{cmd.meta.namespace}/{job_name}",
+            "CommandIssued", f"Start to execute action {cmd.action}",
+        )
         self.queue.append(
             Request(
                 cmd.meta.namespace, job_name,

@@ -1,0 +1,77 @@
+"""Cluster event recorder — the K8s Events analogue.
+
+The reference emits user-facing Events through record.EventRecorder: the
+scheduler cache records "Scheduled" on bind, "Evict" on eviction and
+unschedulable warnings (KB/pkg/scheduler/cache/cache.go:443,401,467), and
+the controller records CommandIssued/PluginError
+(pkg/controllers/job/job_controller.go:115). Here events are first-class
+store objects (kind "Event") so every watcher — tests, the CLI, an
+operator — sees the same stream.
+
+Aggregation follows the k8s pattern: a repeat of (involved, reason,
+message) bumps ``count`` on the existing event instead of growing the
+store unboundedly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from volcano_tpu.api.objects import Metadata, new_uid
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+@dataclass
+class ClusterEvent:
+    meta: Metadata
+    involved: Tuple[str, str] = ("", "")  # (kind, namespace/name)
+    reason: str = ""
+    message: str = ""
+    type: str = NORMAL
+    count: int = 1
+
+
+def record(
+    store,
+    involved_kind: str,
+    involved_key: str,
+    reason: str,
+    message: str,
+    type: str = NORMAL,
+) -> ClusterEvent:
+    """Record (or aggregate) an event about an object."""
+    # O(1) aggregation index, attached lazily to the store
+    idx = getattr(store, "_event_index", None)
+    if idx is None:
+        idx = {}
+        store._event_index = idx
+    key = (involved_kind, involved_key, reason, message)
+    ev = idx.get(key)
+    if ev is not None and store.get("Event", ev.meta.key) is not None:
+        ev.count += 1
+        return store.update("Event", ev)
+    ev = ClusterEvent(
+        meta=Metadata(name=new_uid("event"), namespace=""),
+        involved=(involved_kind, involved_key),
+        reason=reason,
+        message=message,
+        type=type,
+    )
+    idx[key] = ev
+    return store.create("Event", ev)
+
+
+def events_for(store, involved_kind: str, involved_key: str):
+    """All events recorded about one object, oldest first."""
+    out = [
+        ev
+        for ev in store.items("Event")
+        if ev.involved == (involved_kind, involved_key)
+    ]
+    # uids are a zero-padded monotonic counter, so they order by creation
+    # even after aggregation bumps an old event's resource_version
+    out.sort(key=lambda e: e.meta.uid)
+    return out

@@ -1,0 +1,92 @@
+"""Leader election over the store — the ConfigMap-lock analogue.
+
+Both reference binaries leader-elect through a ConfigMap resource lock
+(cmd/controllers/app/server.go:103-125, KB/cmd/kube-batch/app/
+server.go:107-138; 15s lease / 10s renew / 5s retry). Here the lock is a
+first-class "Lease" object in the store: the holder renews a timestamp,
+and any candidate may take over once the lease expires. State lives
+entirely in the store, so a restarted process rejoins the election with
+nothing but its identity — the same rebuild-from-the-bus property the
+reference gets from etcd.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from volcano_tpu.api.objects import Metadata
+
+DEFAULT_LEASE_DURATION = 15.0  # leaseDuration, server.go:115
+DEFAULT_RENEW_DEADLINE = 10.0  # renewDeadline (informational)
+
+
+@dataclass
+class Lease:
+    meta: Metadata
+    holder: str = ""
+    renewed_at: float = 0.0
+    duration: float = DEFAULT_LEASE_DURATION
+    transitions: int = 0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        store,
+        name: str,
+        identity: str,
+        lease_duration: float = DEFAULT_LEASE_DURATION,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.store = store
+        self.name = name
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.clock = clock or time.monotonic
+
+    @property
+    def _key(self) -> str:
+        return f"/{self.name}"
+
+    def try_acquire(self) -> bool:
+        """Acquire or renew the lease; returns whether we are the leader.
+
+        Call once per work loop iteration (the reference's renew loop);
+        losing candidates call it again next cycle (retryPeriod)."""
+        now = self.clock()
+        lease = self.store.get("Lease", self._key)
+        if lease is None:
+            lease = Lease(
+                meta=Metadata(name=self.name, namespace=""),
+                holder=self.identity,
+                renewed_at=now,
+                duration=self.lease_duration,
+            )
+            self.store.create("Lease", lease)
+            return True
+        if lease.holder == self.identity:
+            lease.renewed_at = now
+            lease.duration = self.lease_duration
+            self.store.update("Lease", lease)
+            return True
+        if now - lease.renewed_at > lease.duration:
+            lease.holder = self.identity
+            lease.renewed_at = now
+            lease.duration = self.lease_duration  # new holder's window
+            lease.transitions += 1
+            self.store.update("Lease", lease)
+            return True
+        return False
+
+    def is_leader(self) -> bool:
+        lease = self.store.get("Lease", self._key)
+        return lease is not None and lease.holder == self.identity
+
+    def release(self) -> None:
+        """Voluntary hand-off: expire our own lease immediately."""
+        lease = self.store.get("Lease", self._key)
+        if lease is not None and lease.holder == self.identity:
+            lease.renewed_at = -float("inf")
+            self.store.update("Lease", lease)

@@ -158,12 +158,26 @@ class SchedulerCache:
     # -- side effects --------------------------------------------------------
 
     def bind(self, task: TaskInfo, hostname: str) -> None:
+        from volcano_tpu import events
+
         self.bind_log.append((task.key, hostname))
         self.binder.bind(task, hostname)
+        # "Scheduled" event, cache.go:443
+        events.record(
+            self.store, "Pod", task.key, "Scheduled",
+            f"Successfully assigned {task.key} to {hostname}",
+        )
 
     def evict(self, task: TaskInfo, reason: str) -> None:
+        from volcano_tpu import events
+
         self.evict_log.append((task.key, reason))
         self.evictor.evict(task, reason)
+        # "Evict" event, cache.go:401
+        events.record(
+            self.store, "Pod", task.key, "Evict",
+            f"Evicted for {reason}", type=events.WARNING,
+        )
 
     def update_job_status(self, job: JobInfo) -> None:
         if job.pod_group is not None:

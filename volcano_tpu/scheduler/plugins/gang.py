@@ -62,7 +62,19 @@ class GangPlugin(Plugin):
     def on_session_close(self, ssn: Session) -> None:
         unschedulable_jobs = 0
         for job in ssn.jobs.values():
-            if not job.ready():
+            if job.ready():
+                # clear a stale Unschedulable condition so the next failure
+                # episode is a fresh transition (and a fresh event)
+                if job.pod_group is not None and any(
+                    c.kind == "Unschedulable"
+                    for c in job.pod_group.status.conditions
+                ):
+                    job.pod_group.status.conditions = [
+                        c
+                        for c in job.pod_group.status.conditions
+                        if c.kind != "Unschedulable"
+                    ]
+            else:
                 unready = job.min_available - job.ready_task_num()
                 unschedulable_jobs += 1
                 metrics.update_unschedule_task_count(job.name, int(unready))
@@ -78,9 +90,28 @@ class GangPlugin(Plugin):
                             f"{unready}/{len(job.tasks)} tasks in gang unschedulable"
                         ),
                     )
+                    prev = next(
+                        (
+                            c
+                            for c in job.pod_group.status.conditions
+                            if c.kind == "Unschedulable"
+                        ),
+                        None,
+                    )
                     job.pod_group.status.conditions = [
                         c
                         for c in job.pod_group.status.conditions
                         if c.kind != "Unschedulable"
                     ] + [cond]
+                    # unschedulable warning event (cache.go:467 analogue) —
+                    # only on condition transitions, so a parked gang job
+                    # doesn't generate store writes every idle cycle
+                    if prev is None or prev.message != cond.message:
+                        from volcano_tpu import events
+
+                        events.record(
+                            ssn.cache.store, "PodGroup",
+                            f"{job.namespace}/{job.name}", "Unschedulable",
+                            cond.message, type=events.WARNING,
+                        )
         metrics.update_unschedule_job_count(unschedulable_jobs)

@@ -25,17 +25,21 @@ class Scheduler:
         conf: Optional[SchedulerConf] = None,
         scheduler_name: str = "volcano-tpu",
         default_queue: str = "default",
+        elector=None,  # optional LeaderElector; HA analogue of server.go:107-138
     ):
         self.conf = conf or default_conf()
         self.cache = SchedulerCache(
             store, scheduler_name=scheduler_name, default_queue=default_queue
         )
+        self.elector = elector
 
     @classmethod
     def from_conf_yaml(cls, store: Store, text: str, **kw) -> "Scheduler":
         return cls(store, conf=load_conf(text), **kw)
 
     def run_once(self) -> None:
+        if self.elector is not None and not self.elector.try_acquire():
+            return  # standby replica: only the lease holder schedules
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers)
 
