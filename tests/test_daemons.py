@@ -1,0 +1,119 @@
+"""The three binaries + CLI as real OS processes (reference process model).
+
+Spawns `python -m volcano_tpu.cli apiserver/controller/scheduler/kubelet`
+as subprocesses and drives a job to Running with `vtctl --server job run`,
+mirroring how the reference e2e shells out to the real vkctl binary
+(test/e2e/cli_util.go) against a live control plane.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+ENTRY = [sys.executable, "-m", "volcano_tpu.cli"]
+
+
+def _spawn(args, **kw):
+    return subprocess.Popen(
+        ENTRY + args,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        **kw,
+    )
+
+
+def _vtctl(args, check=True):
+    r = subprocess.run(
+        ENTRY + args, capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if check and r.returncode != 0:
+        raise AssertionError(f"vtctl {args} failed: {r.stdout} {r.stderr}")
+    return r.stdout
+
+
+@pytest.mark.slow
+def test_daemon_processes_run_job_end_to_end(tmp_path):
+    procs = []
+    try:
+        api = _spawn(["apiserver", "--port", "0"])
+        procs.append(api)
+        line = api.stdout.readline().strip()
+        assert "listening on" in line, line
+        url = line.rsplit(" ", 1)[-1]
+
+        metrics_url = ""
+        for comp in ("controller", "scheduler", "kubelet"):
+            extra = (["--period", "0.1", "--metrics-port", "0"]
+                     if comp == "scheduler" else ["--period", "0.05"])
+            p = _spawn([comp, "--server", url] + extra)
+            procs.append(p)
+            assert url in p.stdout.readline()
+            if comp == "scheduler":
+                line = p.stdout.readline()
+                assert "/metrics" in line, line
+                metrics_url = line.strip().rsplit(" ", 1)[-1]
+
+        _vtctl(["--server", url, "cluster", "init", "--nodes", "2"])
+        _vtctl(["--server", url, "job", "run", "--name", "procjob",
+                "--replicas", "2", "--min", "2"])
+
+        deadline = time.monotonic() + 60
+        table = ""
+        while time.monotonic() < deadline:
+            table = _vtctl(["--server", url, "job", "list"])
+            row = next((ln for ln in table.splitlines() if ln.startswith("procjob")), "")
+            if "Running" in row:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"job never ran; last table:\n{table}")
+
+        # suspend -> Aborted, resume -> Running again (command.go round-trip)
+        _vtctl(["--server", url, "job", "suspend", "--name", "procjob"])
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if "Aborted" in _vtctl(["--server", url, "job", "list"]):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("job never aborted after suspend")
+
+        _vtctl(["--server", url, "job", "resume", "--name", "procjob"])
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if "Running" in _vtctl(["--server", url, "job", "list"]):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("job never resumed")
+
+        # the scheduler daemon serves the reference's Prometheus series
+        import urllib.request
+
+        body = urllib.request.urlopen(metrics_url, timeout=10).read().decode()
+        assert "volcano_e2e_scheduling_latency_milliseconds" in body
+
+        # admission over the wire: bad job rejected by the server
+        out = subprocess.run(
+            ENTRY + ["--server", url, "job", "run", "--name", "bad",
+                     "--replicas", "1", "--min", "5"],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.returncode == 1 and "minAvailable" in out.stderr
+
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

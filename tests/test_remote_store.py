@@ -1,0 +1,353 @@
+"""Multi-process parity: codec, store server, RemoteStore, daemons.
+
+The reference's components are separate binaries meeting at the K8s API
+server (SURVEY.md §1); these tests prove the same property for the
+framework: every component runs against the HTTP store server through
+RemoteStore with no code changes, admission gates Job writes server-side
+(the webhook path, §3.3), and leader election works across clients.
+"""
+
+import threading
+import time
+
+import pytest
+
+from volcano_tpu.api.job import Job, JobSpec, LifecyclePolicy, TaskSpec, VolumeSpec
+from volcano_tpu.api.objects import (
+    Affinity,
+    Command,
+    Metadata,
+    Node,
+    Pod,
+    PodGroup,
+    PodSpec,
+    Queue,
+    Toleration,
+)
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobAction, JobEvent, JobPhase, PodPhase
+from volcano_tpu.store.client import RemoteStore
+from volcano_tpu.store.codec import KIND_CLASSES, decode, encode
+from volcano_tpu.store.server import StoreServer
+
+
+@pytest.fixture()
+def server():
+    srv = StoreServer().start()
+    yield srv
+    srv.stop()
+
+
+def make_job(name="j1", namespace="default", replicas=2, min_available=2):
+    return Job(
+        meta=Metadata(name=name, namespace=namespace),
+        spec=JobSpec(
+            min_available=min_available,
+            queue="default",
+            tasks=[
+                TaskSpec(
+                    name="task",
+                    replicas=replicas,
+                    template=PodSpec(resources=Resource(1000, 1 << 30)),
+                )
+            ],
+        ),
+    )
+
+
+# -- codec --------------------------------------------------------------------
+
+
+def test_codec_round_trips_every_kind():
+    import json
+
+    samples = {
+        "Job": Job(
+            meta=Metadata(name="j", labels={"a": "b"}, owner=("Queue", "q")),
+            spec=JobSpec(
+                min_available=2,
+                tasks=[
+                    TaskSpec(
+                        name="t",
+                        replicas=3,
+                        policies=[
+                            LifecyclePolicy(
+                                action=JobAction.RESTART_JOB,
+                                event=JobEvent.POD_FAILED,
+                            )
+                        ],
+                    )
+                ],
+                volumes=[VolumeSpec(mount_path="/data", size="1Gi")],
+            ),
+        ),
+        "Pod": Pod(
+            meta=Metadata(name="p"),
+            spec=PodSpec(
+                resources=Resource(2000, 4 << 30, {"tpu.dev/v5e": 4.0}),
+                affinity=Affinity(
+                    node_terms=[[("zone", "In", ("a", "b"))]],
+                    preferred_node_terms=[(5, [("ssd", "Exists", ())])],
+                    pod_anti_affinity=[{"app": "web"}],
+                ),
+                tolerations=[Toleration(key="k", value="v", effect="NoSchedule")],
+                host_ports=[8080],
+            ),
+            phase=PodPhase.RUNNING,
+            node_name="n1",
+        ),
+        "Node": Node(
+            meta=Metadata(name="n", namespace=""),
+            allocatable=Resource(8000, 16 << 30),
+        ),
+        "Queue": Queue(meta=Metadata(name="q", namespace=""), weight=4),
+        "PodGroup": PodGroup(meta=Metadata(name="pg"), min_member=3),
+        "Command": Command(
+            meta=Metadata(name="c"), action="AbortJob", target=("Job", "j")
+        ),
+    }
+    for kind, obj in samples.items():
+        wire = json.loads(json.dumps(encode(obj)))
+        back = decode(KIND_CLASSES[kind], wire)
+        assert back == obj, f"{kind} did not round-trip"
+
+
+# -- CRUD + watch over HTTP ---------------------------------------------------
+
+
+def test_remote_crud_and_watch(server):
+    a = RemoteStore(server.url)
+    b = RemoteStore(server.url)
+    watch_q = b.watch("Node")
+
+    node = Node(meta=Metadata(name="n1", namespace=""), allocatable=Resource(4000, 8 << 30))
+    a.create("Node", node)
+    assert node.meta.resource_version > 0  # server-stamped, propagated back
+
+    got = b.get("Node", "/n1")
+    assert got is not None and got.allocatable == node.allocatable
+    assert [n.meta.name for n in b.list("Node")] == ["n1"]
+
+    got.unschedulable = True
+    b.update("Node", got)
+    assert a.get("Node", "/n1").unschedulable
+
+    ev = watch_q.popleft()
+    assert (ev.type.value, ev.obj.meta.name) == ("Added", "n1")
+    ev = watch_q.popleft()
+    assert ev.type.value == "Updated" and ev.obj.unschedulable
+    assert ev.old is not None and not ev.old.unschedulable  # shadowed old state
+
+    assert a.delete("Node", "/n1") is not None
+    assert a.get("Node", "/n1") is None
+    assert watch_q.popleft().type.value == "Deleted"
+    assert not watch_q
+
+
+def test_create_conflict_and_update_missing(server):
+    s = RemoteStore(server.url)
+    s.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    with pytest.raises(KeyError):
+        s.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    with pytest.raises(KeyError):
+        s.update("Queue", Queue(meta=Metadata(name="ghost", namespace="")))
+
+
+def test_server_side_admission(server):
+    from volcano_tpu.admission import AdmissionError
+
+    s = RemoteStore(server.url)
+    bad = make_job("bad")
+    bad.spec.min_available = 5  # > total replicas: admit_job.go rejection
+    with pytest.raises(AdmissionError):
+        s.create("Job", bad)
+    assert s.get("Job", "default/bad") is None
+
+    ok = make_job("ok")
+    ok.spec.queue = ""  # webhook mutation fills the default
+    ok.spec.tasks[0].name = ""
+    s.create("Job", ok)
+    assert ok.spec.queue == "default"  # mutation propagated to the caller
+    assert ok.spec.tasks[0].name == "default0"
+
+    # spec is frozen on update (admit_job.go specDeepEqual)
+    stored = s.get("Job", "default/ok")
+    stored.spec.min_available = 1
+    with pytest.raises(AdmissionError):
+        s.update("Job", stored)
+
+
+def test_update_cas_rejects_stale_writes(server):
+    from volcano_tpu.store.store import Conflict
+
+    s = RemoteStore(server.url)
+    node = Node(meta=Metadata(name="n1", namespace=""), allocatable=Resource(1000, 1 << 30))
+    s.create("Node", node)
+
+    stale = s.get("Node", "/n1")
+    fresh = s.get("Node", "/n1")
+    fresh.unschedulable = True
+    s.update("Node", fresh)
+
+    stale.labels["x"] = "y"
+    with pytest.raises(Conflict):
+        s.update_cas("Node", stale, stale.meta.resource_version)
+    # the concurrent write survived
+    assert s.get("Node", "/n1").unschedulable
+
+
+def test_leader_election_create_race_does_not_crash_loser(server):
+    """Two fresh candidates both see no lease; the create loser must stand
+    by, not crash (409 path in RemoteStore.create)."""
+    from volcano_tpu.leader import LeaderElector
+
+    e1 = LeaderElector(RemoteStore(server.url), "vk-scheduler", "a")
+    e2 = LeaderElector(RemoteStore(server.url), "vk-scheduler", "b")
+    # both electors read "no lease" before either creates
+    r1, r2 = e1.try_acquire(), e2.try_acquire()
+    assert (r1, r2) == (True, False)
+    assert e1.is_leader() and not e2.is_leader()
+
+
+def test_controller_seeds_existing_objects_on_start(server):
+    """A controller started against a store with live jobs must reconcile
+    them (informer list+watch warm-up), not wait for new events."""
+    from volcano_tpu.controller import JobController
+
+    submit = RemoteStore(server.url)
+    server.store.create("Queue", Queue(meta=Metadata(name="default", namespace="")))
+    submit.create("Job", make_job("preexisting", replicas=1, min_available=1))
+
+    ctl = JobController(RemoteStore(server.url))
+    ctl.pump()
+    # seeding produced the OutOfSync request: the job got its PodGroup
+    assert submit.get("PodGroup", "default/preexisting") is not None
+
+    # a scheduler cycle enqueues the PodGroup; the next pump creates pods
+    # (§3.3: pods appear only after PodGroup goes Inqueue)
+    from volcano_tpu.scheduler.conf import full_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+
+    server.store.create(
+        "Node",
+        Node(meta=Metadata(name="n0", namespace=""),
+             allocatable=Resource.from_resource_list(
+                 {"cpu": "4", "memory": "8Gi", "pods": 110})),
+    )
+    Scheduler(RemoteStore(server.url), conf=full_conf()).run_once()
+    ctl.pump()
+    pods = [p for p in submit.list("Pod") if "preexisting" in p.meta.name]
+    assert len(pods) == 1
+
+
+def test_leader_election_across_clients(server):
+    from volcano_tpu.leader import LeaderElector
+
+    clock = [0.0]
+    e1 = LeaderElector(RemoteStore(server.url), "vk-controllers", "a",
+                       clock=lambda: clock[0])
+    e2 = LeaderElector(RemoteStore(server.url), "vk-controllers", "b",
+                       clock=lambda: clock[0])
+    assert e1.try_acquire()
+    assert not e2.try_acquire()
+    clock[0] += 20.0  # lease expires without renewal
+    assert e2.try_acquire()
+    assert not e1.try_acquire()
+    assert e2.is_leader() and not e1.is_leader()
+
+
+def test_watch_relist_after_log_overflow(server):
+    from volcano_tpu.store.client import StaleWatch
+    from volcano_tpu.store.server import LOG_CAP
+
+    s = RemoteStore(server.url)
+    s.watch("Queue")
+    s.poll()
+    server.log[:] = []  # simulate cap eviction of everything we missed
+    server.seq += LOG_CAP + 1
+    with pytest.raises(StaleWatch):
+        s.poll()
+    # cursor resynced to the server head: next poll is clean
+    assert s.poll() == 0
+
+
+# -- the full control plane as separate "processes" over HTTP ----------------
+
+
+def test_multiprocess_control_plane_runs_job(server):
+    """Controller, scheduler, and kubelet each on their own RemoteStore,
+    driven concurrently in threads over real HTTP; a job submitted through
+    a fourth client reaches Running — SURVEY.md §3.3 end to end across the
+    process boundary."""
+    from volcano_tpu.controller import JobController
+    from volcano_tpu.scheduler.conf import full_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+    from volcano_tpu.api.types import PodPhase
+
+    server.store.create("Queue", Queue(meta=Metadata(name="default", namespace="")))
+    for i in range(2):
+        server.store.create(
+            "Node",
+            Node(meta=Metadata(name=f"n{i}", namespace=""),
+                 allocatable=Resource.from_resource_list(
+                     {"cpu": "4", "memory": "8Gi", "pods": 110})),
+        )
+
+    stop = threading.Event()
+
+    def controller_loop():
+        ctl = JobController(RemoteStore(server.url))
+        while not stop.is_set():
+            ctl.pump()
+            time.sleep(0.02)
+
+    def scheduler_loop():
+        sched = Scheduler(RemoteStore(server.url), conf=full_conf())
+        while not stop.is_set():
+            sched.run_once()
+            time.sleep(0.02)
+
+    def kubelet_loop():
+        from volcano_tpu.store.store import Conflict
+
+        store = RemoteStore(server.url)
+        while not stop.is_set():
+            for pod in store.list("Pod"):
+                if pod.deleting:
+                    store.delete("Pod", pod.meta.key)
+                elif pod.node_name and pod.phase == PodPhase.PENDING:
+                    rv = pod.meta.resource_version
+                    pod.phase = PodPhase.RUNNING
+                    try:
+                        store.update_cas("Pod", pod, rv)
+                    except (Conflict, KeyError):
+                        pass
+            time.sleep(0.02)
+
+    threads = [
+        threading.Thread(target=f, daemon=True)
+        for f in (controller_loop, scheduler_loop, kubelet_loop)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        client = RemoteStore(server.url)
+        client.create("Job", make_job("mpjob", replicas=2, min_available=2))
+
+        deadline = time.monotonic() + 30
+        job = None
+        while time.monotonic() < deadline:
+            job = client.get("Job", "default/mpjob")
+            if job and job.status.state.phase == JobPhase.RUNNING:
+                break
+            time.sleep(0.05)
+        assert job is not None and job.status.state.phase == JobPhase.RUNNING, (
+            job and job.status
+        )
+        running = [p for p in client.list("Pod") if p.phase == PodPhase.RUNNING]
+        assert len(running) == 2
+        assert all(p.node_name for p in running)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)

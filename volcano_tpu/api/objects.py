@@ -11,6 +11,8 @@ the fields the scheduler/controller/admission paths actually consume:
 from __future__ import annotations
 
 import itertools
+import os
+import secrets
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -18,10 +20,14 @@ from volcano_tpu.api.resource import Resource
 from volcano_tpu.api.types import PodGroupPhase, PodPhase
 
 _uid_counter = itertools.count(1)
+# process-unique token: daemons on separate RemoteStores each run their own
+# counter, so uids (and Event object names built from them) must not collide
+# across processes
+_uid_token = f"{os.getpid():x}{secrets.token_hex(2)}"
 
 
 def new_uid(prefix: str = "obj") -> str:
-    return f"{prefix}-{next(_uid_counter):08d}"
+    return f"{prefix}-{_uid_token}-{next(_uid_counter):08d}"
 
 
 @dataclass

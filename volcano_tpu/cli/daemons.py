@@ -1,0 +1,128 @@
+"""Daemon entrypoints: run each control-plane component as its own process.
+
+The reference ships three binaries — vk-scheduler (kube-batch),
+vk-controllers, vk-admission — plus vkctl, all meeting at the API server
+(SURVEY.md §1). Here the API server is the store server
+(volcano_tpu/store/server.py, admission runs inline on Job writes as the
+webhook does), and the scheduler/controller/kubelet run against it through
+RemoteStore:
+
+  python -m volcano_tpu.cli apiserver  --port 8443
+  python -m volcano_tpu.cli controller --server http://127.0.0.1:8443
+  python -m volcano_tpu.cli scheduler  --server http://127.0.0.1:8443
+  python -m volcano_tpu.cli kubelet    --server http://127.0.0.1:8443
+
+Controller and scheduler leader-elect through a store Lease by default
+(reference cmd/controllers/app/server.go:103-125), so replicas can run
+hot-standby exactly like the reference deployments.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+
+def _elector(store, component: str, identity: str, enabled: bool):
+    if not enabled:
+        return None
+    from volcano_tpu.leader import LeaderElector
+
+    return LeaderElector(store, name=component, identity=identity)
+
+
+def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = True,
+                  announce=print) -> None:
+    from volcano_tpu.api.objects import Metadata, Queue
+    from volcano_tpu.store.server import StoreServer
+
+    srv = StoreServer(host=host, port=port)
+    if default_queue and srv.store.get("Queue", "/default") is None:
+        srv.store.create("Queue", Queue(meta=Metadata(name="default", namespace="")))
+    announce(f"apiserver listening on {srv.url}", flush=True)
+    srv.serve_forever()
+
+
+def run_controller(server: str, identity: str = "", leader_elect: bool = True,
+                   period: float = 0.2, announce=print) -> None:
+    from volcano_tpu.controller import JobController
+    from volcano_tpu.store.client import RemoteStore, StaleWatch
+
+    ident = identity or f"controller-{os.getpid()}"
+
+    def build():
+        store = RemoteStore(server)
+        return JobController(
+            store, elector=_elector(store, "vk-controllers", ident, leader_elect)
+        )
+
+    ctl = build()
+    announce(f"controller {ident} watching {server}", flush=True)
+    while True:
+        try:
+            ctl.pump()
+        except StaleWatch:
+            # fell off the server's event log (e.g. long standby): rebuild
+            # from a fresh list — the reference's relist-on-too-old-watch
+            announce(f"controller {ident}: stale watch, relisting", flush=True)
+            ctl = build()
+        time.sleep(period)
+
+
+def run_scheduler(server: str, conf_path: str = "", identity: str = "",
+                  leader_elect: bool = True, period: float = 1.0,
+                  metrics_port: int = 8080, announce=print) -> None:
+    """schedule-period defaults to the reference's 1s and /metrics to :8080,
+    as the reference binary (options.go:28,63; server.go:86-89). Pass
+    metrics_port<0 to disable the endpoint, 0 for a free port."""
+    from volcano_tpu.scheduler.conf import full_conf, load_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+    from volcano_tpu.store.client import RemoteStore
+
+    store = RemoteStore(server)
+    conf = load_conf(open(conf_path).read()) if conf_path else full_conf()
+    ident = identity or f"scheduler-{os.getpid()}"
+    sched = Scheduler(store, conf=conf,
+                      elector=_elector(store, "vk-scheduler", ident, leader_elect))
+    announce(f"scheduler {ident} cycling every {period}s against {server}", flush=True)
+    if metrics_port >= 0:
+        from volcano_tpu.scheduler.metrics_server import MetricsServer
+
+        ms = MetricsServer(port=metrics_port).start()
+        announce(f"metrics on http://127.0.0.1:{ms.port}/metrics", flush=True)
+    while True:
+        t0 = time.monotonic()
+        sched.run_once()
+        time.sleep(max(0.0, period - (time.monotonic() - t0)))
+
+
+def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
+    """Simulated kubelets over the remote store: bound pending pods start
+    Running; pods marked deleting are reaped (the Releasing window the
+    pipelined tasks wait on, SURVEY.md §3.5)."""
+    from volcano_tpu.api.types import PodPhase
+    from volcano_tpu.store.client import RemoteStore
+    from volcano_tpu.store.store import Conflict
+
+    store = RemoteStore(server)
+    announce(f"kubelet simulating against {server}", flush=True)
+    while True:
+        for pod in store.list("Pod"):
+            if pod.deleting:
+                store.delete("Pod", pod.meta.key)
+            elif pod.node_name and pod.phase == PodPhase.PENDING:
+                rv = pod.meta.resource_version
+                pod.phase = PodPhase.RUNNING
+                try:
+                    # CAS: the controller may have marked this pod deleting
+                    # since the list; never resurrect it with a stale write
+                    store.update_cas("Pod", pod, rv)
+                except (Conflict, KeyError):
+                    pass  # changed under us; reconcile next period
+        time.sleep(period)
+
+
+def install_sigterm_exit() -> None:
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))

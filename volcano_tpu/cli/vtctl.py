@@ -148,6 +148,56 @@ def cmd_resume(store, namespace: str, name: str) -> Command:
     return _issue_command(store, namespace, name, JobAction.RESUME_JOB)
 
 
+def _main_remote(args) -> int:
+    """job/cluster commands against a remote store server — the reference's
+    vkctl-to-API-server path. No local state; admission runs server-side."""
+    from volcano_tpu.store.client import RemoteStore
+
+    store = RemoteStore(args.server)
+    try:
+        if args.group == "cluster" and args.cmd == "init":
+            from volcano_tpu.api.objects import Metadata, Node, Queue
+
+            for entry in args.queues.split(","):
+                qname, _, weight = entry.partition("=")
+                qname = qname.strip()
+                if store.get("Queue", f"/{qname}") is None:
+                    store.create("Queue", Queue(
+                        meta=Metadata(name=qname, namespace=""),
+                        weight=int(weight or 1)))
+            for i in range(args.nodes):
+                name = f"node-{i}"
+                if store.get("Node", f"/{name}") is None:
+                    store.create("Node", Node(
+                        meta=Metadata(name=name, namespace=""),
+                        allocatable=Resource.from_resource_list(
+                            {"cpu": args.cpu, "memory": args.memory, "pods": 110})))
+            print(f"initialized remote cluster: {args.nodes} nodes")
+        elif args.group == "cluster":
+            print("error: cluster step is local-only (daemons drive the "
+                  "remote cluster)", file=sys.stderr)
+            return 1
+        elif args.cmd == "run":
+            # server-side admission mutates/validates (the webhook path)
+            store.create("Job", build_job_from_flags(
+                name=args.name, namespace=args.namespace, image=args.image,
+                min_available=args.min_available, replicas=args.replicas,
+                requests=args.requests, queue=args.queue))
+            print(f"job {args.namespace}/{args.name} created")
+        elif args.cmd == "list":
+            cmd_list(store, namespace=args.namespace, out=sys.stdout)
+        elif args.cmd == "suspend":
+            cmd_suspend(store, args.namespace, args.name)
+            print(f"job {args.namespace}/{args.name} suspend requested")
+        elif args.cmd == "resume":
+            cmd_resume(store, args.namespace, args.name)
+            print(f"job {args.namespace}/{args.name} resume requested")
+    except Exception as e:  # surface as CLI error, not traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 # -- standalone entry over a pickled simulated cluster ------------------------
 
 
@@ -174,12 +224,20 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="vtctl")
     parser.add_argument("--state", default=".vtctl-state.pkl",
                         help="cluster state file (simulated cluster)")
+    parser.add_argument("--server", default="",
+                        help="store server URL; job/cluster commands then "
+                             "target the remote API server instead of the "
+                             "local pickled cluster")
+    # accepted both before and after the subcommand; SUPPRESS keeps the
+    # subparser from clobbering a value parsed at the top level
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--server", default=argparse.SUPPRESS)
     sub = parser.add_subparsers(dest="group", required=True)
 
     job_p = sub.add_parser("job", help="job operations")
     job_sub = job_p.add_subparsers(dest="cmd", required=True)
 
-    run_p = job_sub.add_parser("run")
+    run_p = job_sub.add_parser("run", parents=[common])
     run_p.add_argument("--name", "-n", default="test")
     run_p.add_argument("--namespace", "-N", default="default")
     run_p.add_argument("--image", "-i", default="busybox")
@@ -188,24 +246,69 @@ def main(argv=None) -> int:
     run_p.add_argument("--requests", "-R", default="cpu=1000m,memory=100Mi")
     run_p.add_argument("--queue", "-q", default="")
 
-    list_p = job_sub.add_parser("list")
+    list_p = job_sub.add_parser("list", parents=[common])
     list_p.add_argument("--namespace", "-N", default="default")
 
     for verb in ("suspend", "resume"):
-        p = job_sub.add_parser(verb)
+        p = job_sub.add_parser(verb, parents=[common])
         p.add_argument("--name", "-n", required=True)
         p.add_argument("--namespace", "-N", default="default")
 
     cl_p = sub.add_parser("cluster", help="simulated cluster management")
     cl_sub = cl_p.add_subparsers(dest="cmd", required=True)
-    init_p = cl_sub.add_parser("init")
+    init_p = cl_sub.add_parser("init", parents=[common])
     init_p.add_argument("--nodes", type=int, default=2)
     init_p.add_argument("--cpu", default="8")
     init_p.add_argument("--memory", default="16Gi")
     init_p.add_argument("--queues", default="default=1")
-    cl_sub.add_parser("step")
+    cl_sub.add_parser("step", parents=[common])
+
+    # control-plane daemons (the reference's three binaries; SURVEY.md §1)
+    api_p = sub.add_parser("apiserver", parents=[common], help="run the store API server")
+    api_p.add_argument("--port", type=int, default=8443)
+    api_p.add_argument("--host", default="127.0.0.1")
+    for comp in ("controller", "scheduler", "kubelet"):
+        p = sub.add_parser(comp, parents=[common], help=f"run the {comp} against --server")
+        p.add_argument("--identity", default="")
+        p.add_argument("--period", type=float,
+                       default=1.0 if comp == "scheduler" else 0.2)
+        if comp != "kubelet":
+            p.add_argument("--no-leader-elect", action="store_true")
+        if comp == "scheduler":
+            p.add_argument("--conf", default="", help="scheduler-conf YAML path")
+            p.add_argument("--metrics-port", type=int, default=8080,
+                           help="/metrics port (0 = free port, <0 = disabled)")
 
     args = parser.parse_args(argv)
+
+    if args.group in ("apiserver", "controller", "scheduler", "kubelet"):
+        if args.group != "apiserver" and not args.server:
+            print("error: --server is required", file=sys.stderr)
+            return 1
+        from volcano_tpu.cli import daemons
+
+        daemons.install_sigterm_exit()
+        try:
+            if args.group == "apiserver":
+                daemons.run_apiserver(port=args.port, host=args.host)
+            elif args.group == "controller":
+                daemons.run_controller(args.server, identity=args.identity,
+                                       leader_elect=not args.no_leader_elect,
+                                       period=args.period)
+            elif args.group == "scheduler":
+                daemons.run_scheduler(args.server, conf_path=args.conf,
+                                      identity=args.identity,
+                                      leader_elect=not args.no_leader_elect,
+                                      period=args.period,
+                                      metrics_port=args.metrics_port)
+            else:
+                daemons.run_kubelet(args.server, period=args.period)
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    if args.server:
+        return _main_remote(args)
 
     try:
         cluster = _load_cluster(args.state)

@@ -42,7 +42,7 @@ from volcano_tpu.api.types import (
 from volcano_tpu.controller.cache import CtrlJobInfo, JobCache, Request
 from volcano_tpu.controller.plugins import get_job_plugin
 from volcano_tpu.controller.state import new_state
-from volcano_tpu.store import EventType, Store
+from volcano_tpu.store import Event, EventType, Store
 
 
 def apply_policies(job: Job, req: Request) -> JobAction:
@@ -91,6 +91,22 @@ class JobController:
         self._pod_w = store.watch("Pod")
         self._pg_w = store.watch("PodGroup")
         self._cmd_w = store.watch("Command")
+        self._seed_from_store()
+
+    def _seed_from_store(self) -> None:
+        """Informer list+watch startup: watches only deliver events from now
+        on, so synthesize Added events for everything already in the store —
+        a restarted controller (or one recovering from a stale watch) must
+        rebuild its cache and re-reconcile mid-flight jobs, the reference's
+        WaitForCacheSync warm-up (SURVEY.md §5 checkpoint/resume)."""
+        for kind, handler in (
+            ("Job", self._on_job_event),
+            ("Pod", self._on_pod_event),
+            ("PodGroup", self._on_pg_event),
+            ("Command", self._on_command_event),
+        ):
+            for obj in self.store.list(kind):
+                handler(Event(kind, EventType.ADDED, obj))
 
     # -- event intake ---------------------------------------------------------
 

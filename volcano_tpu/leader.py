@@ -54,7 +54,13 @@ class LeaderElector:
         """Acquire or renew the lease; returns whether we are the leader.
 
         Call once per work loop iteration (the reference's renew loop);
-        losing candidates call it again next cycle (retryPeriod)."""
+        losing candidates call it again next cycle (retryPeriod). All writes
+        are atomic — create loses to an existing lease, takeover and renew
+        go through compare-and-swap — so two candidates racing over a
+        RemoteStore can never both win (the resource-lock property the
+        reference gets from the API server's resourceVersion)."""
+        from volcano_tpu.store.store import Conflict
+
         now = self.clock()
         lease = self.store.get("Lease", self._key)
         if lease is None:
@@ -64,21 +70,27 @@ class LeaderElector:
                 renewed_at=now,
                 duration=self.lease_duration,
             )
-            self.store.create("Lease", lease)
+            try:
+                self.store.create("Lease", lease)
+            except KeyError:  # another candidate created it first
+                return False
             return True
+        rv = lease.meta.resource_version
         if lease.holder == self.identity:
             lease.renewed_at = now
             lease.duration = self.lease_duration
-            self.store.update("Lease", lease)
-            return True
-        if now - lease.renewed_at > lease.duration:
+        elif now - lease.renewed_at > lease.duration:
             lease.holder = self.identity
             lease.renewed_at = now
             lease.duration = self.lease_duration  # new holder's window
             lease.transitions += 1
-            self.store.update("Lease", lease)
-            return True
-        return False
+        else:
+            return False
+        try:
+            self.store.update_cas("Lease", lease, rv)
+        except (Conflict, KeyError):  # lost the renew/takeover race
+            return False
+        return True
 
     def is_leader(self) -> bool:
         lease = self.store.get("Lease", self._key)

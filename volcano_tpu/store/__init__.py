@@ -1,3 +1,3 @@
-from volcano_tpu.store.store import Store, Event, EventType
+from volcano_tpu.store.store import Conflict, Event, EventType, Store
 
-__all__ = ["Store", "Event", "EventType"]
+__all__ = ["Store", "Event", "EventType", "Conflict"]

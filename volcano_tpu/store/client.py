@@ -1,0 +1,214 @@
+"""RemoteStore: a Store-compatible client for the HTTP store server.
+
+Every framework component takes a Store and uses exactly six verbs
+(create/update/delete/get/list/watch), so pointing a SchedulerCache,
+JobController, LeaderElector, or the CLI at a RemoteStore moves it into its
+own OS process with no other changes — the client-go clientset+informer
+role from the reference (SURVEY.md §2.2 "Generated clients"), collapsed
+onto the same interface the in-process Store exposes.
+
+Watch queues buffer locally and refill from the server's ordered event log
+on demand (``popleft``/truthiness trigger a non-blocking poll), preserving
+the deterministic drain-when-pumped model the controller and tests rely
+on. A client that falls off the server's log buffer raises StaleWatch —
+callers relist, the reference's "resourceVersion too old" recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from collections import deque
+from typing import Any, Dict, List, Optional
+from urllib.parse import quote
+
+from volcano_tpu.admission import AdmissionError
+from volcano_tpu.store.codec import decode_object, encode
+from volcano_tpu.store.store import Conflict, Event, EventType
+
+
+class StaleWatch(RuntimeError):
+    """The server dropped events this client never saw; relist required."""
+
+
+class RemoteStoreError(RuntimeError):
+    pass
+
+
+class _RemoteWatchQueue:
+    """deque façade over the client's event buffer for one kind."""
+
+    def __init__(self, client: "RemoteStore", kind: str):
+        self._client = client
+        self._kind = kind
+        self._buf: deque = deque()
+
+    def popleft(self) -> Event:
+        if not self._buf:
+            self._client.poll()
+        return self._buf.popleft()  # IndexError when empty, like deque
+
+    def __len__(self) -> int:
+        if not self._buf:
+            self._client.poll()
+        return len(self._buf)
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def append(self, ev: Event) -> None:
+        self._buf.append(ev)
+
+
+class RemoteStore:
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self._watches: Dict[str, List[_RemoteWatchQueue]] = {}
+        self._cursor = 0
+
+    # -- http ----------------------------------------------------------------
+
+    def _request(self, method: str, path: str, payload: Optional[dict] = None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self.url + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except Exception:  # noqa: BLE001
+                body = {"error": str(e)}
+            return e.code, body
+
+    @staticmethod
+    def _err(code: int, body: dict) -> str:
+        return body.get("error", f"http {code}")
+
+    # -- CRUD (Store interface) ------------------------------------------------
+
+    def create(self, kind: str, obj: Any) -> Any:
+        code, body = self._request("POST", f"/apis/{kind}", {"object": encode(obj)})
+        if code == 422:
+            raise AdmissionError(self._err(code, body))
+        if code == 409:
+            raise KeyError(self._err(code, body))
+        if code != 201:
+            raise RemoteStoreError(self._err(code, body))
+        new = decode_object(kind, body["object"])
+        # propagate server-stamped fields into the caller's object, which
+        # stays live (Store.create mutates in place the same way)
+        obj.meta.resource_version = new.meta.resource_version
+        obj.meta.creation_timestamp = new.meta.creation_timestamp
+        obj.meta.uid = new.meta.uid
+        if kind == "Job":  # admission mutation (default queue/task names)
+            obj.spec = new.spec
+        return obj
+
+    def update(self, kind: str, obj: Any, cas: Optional[int] = None) -> Any:
+        path = f"/apis/{kind}" + (f"?cas={cas}" if cas is not None else "")
+        code, body = self._request("PUT", path, {"object": encode(obj)})
+        if code == 422:
+            raise AdmissionError(self._err(code, body))
+        if code == 404:
+            raise KeyError(self._err(code, body))
+        if code == 409 and body.get("conflict"):
+            raise Conflict(self._err(code, body))
+        if code != 200:
+            raise RemoteStoreError(self._err(code, body))
+        new = decode_object(kind, body["object"])
+        obj.meta.resource_version = new.meta.resource_version
+        return obj
+
+    def update_cas(self, kind: str, obj: Any, expected_rv: int) -> Any:
+        """Compare-and-swap update (Store.update_cas over the wire)."""
+        return self.update(kind, obj, cas=expected_rv)
+
+    def delete(self, kind: str, key: str) -> Optional[Any]:
+        before = self.get(kind, key)
+        code, body = self._request(
+            "DELETE", f"/apis/{kind}/obj?key={quote(key, safe='')}"
+        )
+        if code != 200:
+            raise RemoteStoreError(self._err(code, body))
+        return before if body.get("deleted") else None
+
+    def get(self, kind: str, key: str) -> Optional[Any]:
+        code, body = self._request(
+            "GET", f"/apis/{kind}/obj?key={quote(key, safe='')}"
+        )
+        if code == 404:
+            return None
+        if code != 200:
+            raise RemoteStoreError(self._err(code, body))
+        return decode_object(kind, body["object"])
+
+    def list(self, kind: str) -> List[Any]:
+        code, body = self._request("GET", f"/apis/{kind}")
+        if code != 200:
+            raise RemoteStoreError(self._err(code, body))
+        return [decode_object(kind, item) for item in body["items"]]
+
+    def items(self, kind: str):
+        return iter(self.list(kind))
+
+    @property
+    def resource_version(self) -> int:
+        """The server's event sequence — monotonic like Store.resource_version."""
+        code, body = self._request("GET", "/watch?since=-1&timeout=0")
+        if code != 200:
+            raise RemoteStoreError(self._err(code, body))
+        return body["next"]
+
+    # -- watch -----------------------------------------------------------------
+
+    def watch(self, kind: str) -> _RemoteWatchQueue:
+        if not self._watches:
+            # informer semantics: watches deliver events from now on; the
+            # subscriber lists current state itself (list+watch). Pinning
+            # the cursor here keeps the server's historical log from being
+            # replayed into a fresh client.
+            self._cursor = self.resource_version
+        q = _RemoteWatchQueue(self, kind)
+        if kind not in self._watches:
+            self._watches[kind] = []
+        self._watches[kind].append(q)
+        return q
+
+    def poll(self, timeout: float = 0.0) -> int:
+        """Fetch events after the cursor and fan out to local queues.
+        Returns the number of events received."""
+        if not self._watches:
+            return 0
+        kinds = ",".join(sorted(self._watches))
+        code, body = self._request(
+            "GET", f"/watch?since={self._cursor}&kinds={kinds}&timeout={timeout}"
+        )
+        if code != 200:
+            raise RemoteStoreError(self._err(code, body))
+        if body.get("relist"):
+            self._cursor = body["next"]
+            raise StaleWatch("watch cursor fell off the server log; relist")
+        events = body.get("events") or []
+        for e in events:
+            ev = Event(
+                kind=e["kind"],
+                type=EventType(e["type"]),
+                obj=decode_object(e["kind"], e["object"]),
+                old=decode_object(e["kind"], e["old"]) if e.get("old") else None,
+            )
+            for q in self._watches.get(e["kind"], []):
+                q.append(ev)
+        self._cursor = max(self._cursor, body.get("next", self._cursor))
+        return len(events)
+
+    def pending_events(self) -> bool:
+        self.poll()
+        return any(q._buf for qs in self._watches.values() for q in qs)

@@ -1,0 +1,155 @@
+"""JSON wire codec for the API objects — the serialization layer of the bus.
+
+The reference's components exchange CRDs as JSON through the Kubernetes API
+server (client-go encodes/decodes the generated types). This module is the
+equivalent for the framework's dataclass object model: a generic
+dataclass <-> JSON-dict codec driven by type hints, plus the kind registry
+mapping the store's kind strings to their root classes.
+
+Used by the store server (volcano_tpu/store/server.py) and the RemoteStore
+client so the scheduler, controller, admission webhook, and CLI can run as
+separate processes against one API server — the reference's process model
+(SURVEY.md §1: three binaries + vkctl, all speaking to the API server).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Dict, Optional, Tuple, Type, Union
+
+from volcano_tpu.api.job import Job
+from volcano_tpu.api.objects import (
+    Command,
+    ConfigMap,
+    Node,
+    PersistentVolumeClaim,
+    Pod,
+    PodGroup,
+    PriorityClass,
+    Queue,
+    Service,
+)
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.events import ClusterEvent
+from volcano_tpu.leader import Lease
+
+#: store kind string -> root dataclass (the "scheme" in client-go terms)
+KIND_CLASSES: Dict[str, type] = {
+    "Job": Job,
+    "Pod": Pod,
+    "PodGroup": PodGroup,
+    "Queue": Queue,
+    "Node": Node,
+    "Command": Command,
+    "ConfigMap": ConfigMap,
+    "Service": Service,
+    "PriorityClass": PriorityClass,
+    "PVC": PersistentVolumeClaim,
+    "Lease": Lease,
+    "Event": ClusterEvent,
+}
+
+_hints_cache: Dict[type, Dict[str, Any]] = {}
+
+
+def _hints(cls: type) -> Dict[str, Any]:
+    h = _hints_cache.get(cls)
+    if h is None:
+        h = typing.get_type_hints(cls)
+        _hints_cache[cls] = h
+    return h
+
+
+# -- encode ------------------------------------------------------------------
+
+
+def encode(obj: Any) -> Any:
+    """Dataclass tree -> JSON-compatible value. Type-directed on decode, so
+    encode is purely structural."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        # str enums pass through as their value via isinstance(str)
+        if isinstance(obj, enum.Enum):
+            return obj.value
+        return obj
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, Resource):
+        out: Dict[str, Any] = {"cpu": obj.milli_cpu, "mem": obj.memory}
+        if obj.scalars:
+            out["scalars"] = dict(obj.scalars)
+        if obj.max_task_num is not None:
+            out["max_task_num"] = obj.max_task_num
+        return out
+    if dataclasses.is_dataclass(obj):
+        return {
+            f.name: encode(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, (list, tuple)):
+        return [encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): encode(v) for k, v in obj.items()}
+    raise TypeError(f"cannot encode {type(obj).__name__}: {obj!r}")
+
+
+# -- decode ------------------------------------------------------------------
+
+
+def decode(tp: Any, data: Any) -> Any:
+    """JSON value -> instance of type hint ``tp``."""
+    origin = typing.get_origin(tp)
+    if origin is Union:  # Optional[X] and friends
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if data is None:
+            return None
+        return decode(args[0], data)
+    if tp is Any or tp is None:
+        return data
+    if origin in (list, typing.List):
+        (item_tp,) = typing.get_args(tp) or (Any,)
+        return [decode(item_tp, v) for v in data or []]
+    if origin in (tuple, typing.Tuple):
+        args = typing.get_args(tp)
+        if data is None:
+            return None
+        if len(args) == 2 and args[1] is Ellipsis:
+            return tuple(decode(args[0], v) for v in data)
+        if not args:
+            return tuple(data)
+        return tuple(decode(a, v) for a, v in zip(args, data))
+    if origin in (dict, typing.Dict):
+        kt, vt = typing.get_args(tp) or (str, Any)
+        return {decode(kt, k): decode(vt, v) for k, v in (data or {}).items()}
+    if isinstance(tp, type):
+        if tp is Resource:
+            return Resource(
+                milli_cpu=data.get("cpu", 0.0),
+                memory=data.get("mem", 0.0),
+                scalars=data.get("scalars"),
+                max_task_num=data.get("max_task_num"),
+            )
+        if issubclass(tp, enum.Enum):
+            return tp(data)
+        if dataclasses.is_dataclass(tp):
+            hints = _hints(tp)
+            kwargs = {}
+            for f in dataclasses.fields(tp):
+                if f.name in data:
+                    kwargs[f.name] = decode(hints[f.name], data[f.name])
+            return tp(**kwargs)
+        if tp in (int, float, str, bool):
+            return tp(data) if data is not None else data
+    return data
+
+
+def encode_object(kind: str, obj: Any) -> Dict[str, Any]:
+    return {"kind": kind, "object": encode(obj)}
+
+
+def decode_object(kind: str, data: Dict[str, Any]) -> Any:
+    cls = KIND_CLASSES.get(kind)
+    if cls is None:
+        raise KeyError(f"unknown kind {kind!r}")
+    return decode(cls, data)

@@ -1,0 +1,245 @@
+"""The store server: an HTTP "API server" hosting the watchable Store.
+
+The reference's process model is three binaries plus a CLI that never talk
+to each other — they watch and write CRDs through the Kubernetes API
+server, which also calls the admission webhook inline on Job writes
+(SURVEY.md §1, §3.3: API server -> vk-admission -> persist -> informers).
+This server reproduces that boundary over HTTP so the scheduler,
+controller, and vtctl can each run as separate OS processes:
+
+  GET    /apis/<kind>                 list
+  GET    /apis/<kind>/obj?key=<k>     get
+  POST   /apis/<kind>                 create   (Jobs pass admission first)
+  PUT    /apis/<kind>                 update   (Job spec frozen, as admit_job.go)
+  DELETE /apis/<kind>/obj?key=<k>     delete
+  GET    /watch?since=<seq>&kinds=a,b&timeout=<s>   long-poll event log
+  GET    /healthz
+
+Watch semantics mirror list+watch: every mutation appends to a global
+ordered event log; clients resume from their last sequence number, so a
+restarted client rebuilds state with a list then watches from "now" — the
+same rebuild-from-the-bus property the reference gets from etcd.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from volcano_tpu.store.codec import KIND_CLASSES, decode_object, encode
+from volcano_tpu.store.store import Store
+
+#: cap on buffered events; a client further behind than this must relist
+#: (the reference's "resourceVersion too old" watch error)
+LOG_CAP = 100_000
+
+
+class StoreServer:
+    def __init__(
+        self,
+        store: Optional[Store] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        admission: bool = True,
+    ):
+        self.store = store or Store()
+        self.admission = admission
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self.log: List[Dict[str, Any]] = []
+        self.seq = 0
+        self._queues = {kind: self.store.watch(kind) for kind in KIND_CLASSES}
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> Dict[str, Any]:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                parts = [p for p in u.path.split("/") if p]
+                if u.path == "/healthz":
+                    return self._reply(200, {"ok": True})
+                if u.path == "/watch":
+                    since = int(q.get("since", ["0"])[0])
+                    kinds = set(q.get("kinds", [""])[0].split(",")) - {""}
+                    timeout = float(q.get("timeout", ["0"])[0])
+                    return self._reply(200, server.watch_since(since, kinds, timeout))
+                if len(parts) == 2 and parts[0] == "apis":
+                    kind = parts[1]
+                    with server.lock:
+                        items = [encode(o) for o in server.store.list(kind)]
+                    return self._reply(200, {"items": items, "seq": server.seq})
+                if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
+                    key = q.get("key", [""])[0]
+                    with server.lock:
+                        obj = server.store.get(parts[1], key)
+                    if obj is None:
+                        return self._reply(404, {"error": "not found"})
+                    return self._reply(200, {"object": encode(obj)})
+                return self._reply(404, {"error": f"no route {u.path}"})
+
+            def do_POST(self):
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                if len(parts) == 2 and parts[0] == "apis":
+                    try:
+                        code, payload = server.create(parts[1], self._body())
+                    except Exception as e:  # noqa: BLE001 — wire boundary
+                        code, payload = 500, {"error": repr(e)}
+                    return self._reply(code, payload)
+                return self._reply(404, {"error": "no route"})
+
+            def do_PUT(self):
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                q = parse_qs(u.query)
+                if len(parts) == 2 and parts[0] == "apis":
+                    cas = q.get("cas", [None])[0]
+                    try:
+                        code, payload = server.update(
+                            parts[1], self._body(),
+                            expected_rv=int(cas) if cas is not None else None,
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        code, payload = 500, {"error": repr(e)}
+                    return self._reply(code, payload)
+                return self._reply(404, {"error": "no route"})
+
+            def do_DELETE(self):
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                q = parse_qs(u.query)
+                if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
+                    key = q.get("key", [""])[0]
+                    with server.lock:
+                        obj = server.store.delete(parts[1], key)
+                        server._pump_log()
+                    return self._reply(200, {"deleted": obj is not None})
+                return self._reply(404, {"error": "no route"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- mutations (called from handler threads, locked) ----------------------
+
+    def create(self, kind: str, data: Dict[str, Any]):
+        obj = decode_object(kind, data.get("object", {}))
+        if kind == "Job" and self.admission:
+            from volcano_tpu.admission import mutate_job, validate_job
+
+            obj = mutate_job(obj)
+            ok, msg = validate_job(obj)
+            if not ok:
+                return 422, {"error": msg}
+        with self.lock:
+            if self.store.get(kind, obj.meta.key) is not None:
+                return 409, {"error": f"{kind} {obj.meta.key} already exists"}
+            self.store.create(kind, obj)
+            self._pump_log()
+        return 201, {"object": encode(obj)}
+
+    def update(self, kind: str, data: Dict[str, Any], expected_rv: Optional[int] = None):
+        obj = decode_object(kind, data.get("object", {}))
+        with self.lock:
+            old = self.store.get(kind, obj.meta.key)
+            if old is None:
+                return 404, {"error": f"{kind} {obj.meta.key} not found"}
+            if expected_rv is not None and old.meta.resource_version != expected_rv:
+                return 409, {
+                    "error": f"{kind} {obj.meta.key}: stale resource_version "
+                             f"(expected {expected_rv}, have "
+                             f"{old.meta.resource_version})",
+                    "conflict": True,
+                }
+            if kind == "Job" and self.admission:
+                from volcano_tpu.admission import validate_job_update
+
+                ok, msg = validate_job_update(obj, old)
+                if not ok:
+                    return 422, {"error": msg}
+            self.store.update(kind, obj)
+            self._pump_log()
+        return 200, {"object": encode(obj)}
+
+    def _pump_log(self) -> None:
+        """Drain the store's watch queues into the global ordered log."""
+        moved = False
+        for kind, q in self._queues.items():
+            while q:
+                ev = q.popleft()
+                self.seq += 1
+                self.log.append(
+                    {
+                        "seq": self.seq,
+                        "kind": kind,
+                        "type": ev.type.value,
+                        "object": encode(ev.obj),
+                        "old": encode(ev.old) if ev.old is not None else None,
+                    }
+                )
+                moved = True
+        overflow = len(self.log) - LOG_CAP
+        if overflow > 0:
+            del self.log[:overflow]
+        if moved:
+            self.cond.notify_all()
+
+    def watch_since(self, since: int, kinds, timeout: float) -> Dict[str, Any]:
+        deadline = time.monotonic() + timeout
+        with self.lock:
+            if since < self.seq - len(self.log):
+                # fell off the buffer: tell the client to relist
+                return {"events": None, "next": self.seq, "relist": True}
+            while True:
+                # seqs are contiguous (one append per seq), so the events
+                # after `since` start at a computable offset — no log scan
+                start = max(0, len(self.log) - (self.seq - since))
+                evs = [
+                    e
+                    for e in self.log[start:]
+                    if not kinds or e["kind"] in kinds
+                ]
+                if evs or timeout <= 0:
+                    return {"events": evs, "next": self.seq}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"events": [], "next": self.seq}
+                self.cond.wait(remaining)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "StoreServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()

@@ -26,6 +26,11 @@ class EventType(str, enum.Enum):
     DELETED = "Deleted"
 
 
+class Conflict(Exception):
+    """Optimistic-concurrency failure: the object changed since it was read
+    (the API server's 409 on a stale resourceVersion)."""
+
+
 @dataclass
 class Event:
     kind: str
@@ -89,6 +94,20 @@ class Store:
         self._objects[kind][key] = obj
         self._notify(Event(kind, EventType.UPDATED, obj, old))
         return obj
+
+    def update_cas(self, kind: str, obj: Any, expected_rv: int) -> Any:
+        """Compare-and-swap update: succeeds only if the stored object's
+        resource_version still equals ``expected_rv`` (read-modify-write
+        safety for concurrent writers, e.g. leader leases and kubelets)."""
+        current = self._objects[kind].get(obj.meta.key)
+        if current is None:
+            raise KeyError(f"{kind} {obj.meta.key} not found")
+        if current.meta.resource_version != expected_rv:
+            raise Conflict(
+                f"{kind} {obj.meta.key}: expected rv {expected_rv}, "
+                f"have {current.meta.resource_version}"
+            )
+        return self.update(kind, obj)
 
     def delete(self, kind: str, key: str) -> Optional[Any]:
         obj = self._objects[kind].pop(key, None)
