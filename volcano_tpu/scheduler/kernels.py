@@ -375,7 +375,7 @@ def allocate_solve_batch(
     w_least, w_balanced,
     job_key_order=("priority", "gang", "drf"),
     use_gang_ready=True, use_proportion=True,
-    m_chunk=1024, p_chunk=16,
+    m_chunk=512, p_chunk=16,
 ):
     """Throughput-mode allocate: rounds of parallel block placement.
 
@@ -392,10 +392,18 @@ def allocate_solve_batch(
     Semantics vs the exact solve (documented divergence, bench scale only):
     scores and fair shares are frozen *within* a round and a job's block
     is scored by its head task, so task interleaving differs from the
-    reference's strict greedy order. All policies (gang readiness,
-    predicates, epsilon resource fits, proportion overuse, DRF/priority
-    ordering, node scoring) still hold round-by-round; capacity is never
+    reference's strict greedy order. Node choice is heuristic two ways:
+    spill targets come from `approx_max_k` (TPU-bucketed top-k, reduced
+    recall for ranks 2..K; approx results also depend on data layout, so
+    the mesh-sharded run may pick different spill targets than the
+    single-device run at large N), and each job's top-K list is rotated
+    by its rank so ranked jobs start on different targets (a job may land
+    on its (rank mod K)-th best node even when uncontended). All hard
+    policies (gang readiness, predicates, epsilon resource fits,
+    proportion overuse, DRF/priority ordering) still hold round-by-round;
+    every target is feasibility-re-checked, and capacity is never
     oversubscribed because acceptance is prefix-sum-checked per node.
+    The exact sequential solve remains the bit-level parity oracle.
     """
     N, R = idle.shape
     T = task_req.shape[0]
@@ -502,8 +510,23 @@ def allocate_solve_batch(
         t_prop_c = jnp.clip(t_prop, 0, T - 1)
         preq = task_req[t_prop_c]                                  # [M, P, R]
 
-        _, topk_nodes = jax.lax.top_k(masked, K)                   # [M, K]
+        # approx_max_k: TPU-native bucketed top-k (~40x faster than exact
+        # top_k at [M, 16k]). The K spill targets are a packing heuristic —
+        # the reference randomizes among score ties anyway — and feasibility
+        # is re-checked per returned node, so reduced recall only shifts
+        # which good node a gang lands on, never correctness.
+        _, topk_nodes = jax.lax.approx_max_k(masked, K)            # [M, K]
         topk_nodes = topk_nodes.astype(jnp.int32)
+        # rotate each job's top-K list by its rank: consecutive-ranked jobs
+        # start on different spill targets, which multiplies the per-round
+        # win rate (~3x fewer rounds at bench scale). Score order within a
+        # job is preserved modulo rotation; every target is still feasible
+        # and re-checked below.
+        rot = (
+            jnp.arange(K, dtype=jnp.int32)[None, :]
+            + (jnp.arange(M, dtype=jnp.int32) % K)[:, None]
+        ) % K
+        topk_nodes = jnp.take_along_axis(topk_nodes, rot, axis=1)
         topk_feasible = jnp.take_along_axis(feasible, topk_nodes, axis=1)
         topk_is_idle = jnp.take_along_axis(fit_i, topk_nodes, axis=1) & topk_feasible
         # how many of this job's (head-sized) tasks fit each target node
@@ -638,32 +661,57 @@ def allocate_solve_batch(
         drop_job_mask = jnp.zeros((J,), bool).at[victim].set(do_evict)
         new_dropped = s.dropped | drop_job_mask
         if use_gang_ready:
-            rb_job = drop_job_mask & (s.ready < job_min)
+            need_rb = do_evict & (s.ready[victim] < job_min[victim])
         else:
             # without gang's JobReady, every placement binds — never unwind
-            rb_job = jnp.zeros((J,), bool)
-        tk_cur = tk2[:T]
-        rb_task = rb_job[task_job] & (tk_cur > 0) & task_valid
-        rb_req = jnp.where(rb_task[:, None], task_req, 0.0)
-        t_node = jnp.clip(tn2[:T], 0, N - 1)
-        rb_tgt = jnp.where(rb_task, t_node, N)
-        idle3 = idle2.at[jnp.where(rb_task & (tk_cur == 1), rb_tgt, N)].add(rb_req)
-        rel3 = rel2.at[jnp.where(rb_task & (tk_cur == 2), rb_tgt, N)].add(rb_req)
-        used3 = used2.at[rb_tgt].add(-rb_req)
-        tc3 = tc2.at[rb_tgt].add(-rb_task.astype(jnp.int32))
-        q_of_task = jnp.clip(job_queue[task_job], 0, Q - 1)
-        q_rb = jax.ops.segment_sum(rb_req, jnp.where(rb_task, q_of_task, Q), num_segments=Q + 1)
-        qa3 = qa2[:Q] - q_rb[:Q]
-        ja3 = jnp.where(rb_job[:, None], job_alloc_init, ja2[:J])
-        ready3 = jnp.where(rb_job, job_ready_init, ready2[:J])
-        cursor3 = jnp.where(rb_job, 0, cursor2[:J])
-        tn3 = jnp.where(rb_task, -1, tn2[:T])
-        tk3 = jnp.where(rb_task, 0, tk_cur)
-        ts3 = jnp.where(rb_task, -1, ts2[:T])
+            need_rb = jnp.array(False)
+
+        carry = (idle2, rel2, used2, tc2, ja2, ready2, cursor2, qa2, tn2, tk2, ts2)
+
+        def no_rollback(carry):
+            idle2, rel2, used2, tc2, ja2, ready2, cursor2, qa2, tn2, tk2, ts2 = carry
+            return (
+                idle2[:N], rel2[:N], used2[:N], tc2[:N], ja2[:J], ready2[:J],
+                cursor2[:J], qa2[:Q], tn2[:T], tk2[:T], ts2[:T],
+            )
+
+        def rollback(carry):
+            # the [T]-sized unwind: full task_req reads + T-indexed scatters.
+            # Branch-guarded because it is the round body's most expensive
+            # block and fires only when an unready gang is dropped.
+            idle2, rel2, used2, tc2, ja2, ready2, cursor2, qa2, tn2, tk2, ts2 = carry
+            rb_job = drop_job_mask & (s.ready < job_min)
+            tk_cur = tk2[:T]
+            rb_task = rb_job[task_job] & (tk_cur > 0) & task_valid
+            rb_req = jnp.where(rb_task[:, None], task_req, 0.0)
+            t_node = jnp.clip(tn2[:T], 0, N - 1)
+            rb_tgt = jnp.where(rb_task, t_node, N)
+            idle3 = idle2.at[jnp.where(rb_task & (tk_cur == 1), rb_tgt, N)].add(rb_req)
+            rel3 = rel2.at[jnp.where(rb_task & (tk_cur == 2), rb_tgt, N)].add(rb_req)
+            used3 = used2.at[rb_tgt].add(-rb_req)
+            tc3 = tc2.at[rb_tgt].add(-rb_task.astype(jnp.int32))
+            q_of_task = jnp.clip(job_queue[task_job], 0, Q - 1)
+            q_rb = jax.ops.segment_sum(
+                rb_req, jnp.where(rb_task, q_of_task, Q), num_segments=Q + 1
+            )
+            return (
+                idle3[:N], rel3[:N], used3[:N], tc3[:N],
+                jnp.where(rb_job[:, None], job_alloc_init, ja2[:J]),
+                jnp.where(rb_job, job_ready_init, ready2[:J]),
+                jnp.where(rb_job, 0, cursor2[:J]),
+                qa2[:Q] - q_rb[:Q],
+                jnp.where(rb_task, -1, tn2[:T]),
+                jnp.where(rb_task, 0, tk_cur),
+                jnp.where(rb_task, -1, ts2[:T]),
+            )
+
+        (
+            idle3, rel3, used3, tc3, ja3, ready3, cursor3, qa3, tn3, tk3, ts3,
+        ) = jax.lax.cond(need_rb, rollback, no_rollback, carry)
 
         progressed = any_win | do_evict
         return S(
-            idle=idle3[:N], releasing=rel3[:N], used=used3[:N], task_count=tc3[:N],
+            idle=idle3, releasing=rel3, used=used3, task_count=tc3,
             job_alloc=ja3, ready=ready3, cursor=cursor3,
             dropped=new_dropped, queue_alloc=qa3,
             task_node=tn3, task_kind=tk3, task_seq=ts3,
