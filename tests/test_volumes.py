@@ -1,0 +1,295 @@
+"""Volume binding through the scheduler's VolumeBinder seam.
+
+WaitForFirstConsumer semantics (reference: VolumeBinder seam
+KB/pkg/scheduler/cache/interface.go:83-89 + AllocateVolumes/BindVolumes
+call sites session.go:239,263; PV/PVC/StorageClass informers
+cache.go:258-278): claims stay Pending until their pod is scheduled,
+volume placement constrains node choice, and assumed volumes release when
+a gang never dispatches.
+"""
+
+import pytest
+
+from volcano_tpu.api.job import Job, JobSpec, TaskSpec, VolumeSpec
+from volcano_tpu.api.objects import Metadata, PersistentVolumeClaim, PodSpec
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobPhase, PodPhase
+from volcano_tpu.sim import Cluster
+
+
+def mk_job(name, replicas, req, volumes=None, min_available=None, queue="default"):
+    return Job(
+        meta=Metadata(name=name, namespace="test"),
+        spec=JobSpec(
+            min_available=min_available if min_available is not None else replicas,
+            tasks=[
+                TaskSpec(
+                    name="main",
+                    replicas=replicas,
+                    template=PodSpec(resources=Resource.from_resource_list(req)),
+                )
+            ],
+            volumes=volumes or [],
+            queue=queue,
+        ),
+    )
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    c.add_queue("default", weight=1)
+    for i in range(3):
+        c.add_node(f"n{i}", {"cpu": "4", "memory": "8Gi", "pods": 110})
+    return c
+
+
+def test_dynamic_claim_provisions_pv_on_bind(cluster):
+    job = mk_job(
+        "dyn", 2, {"cpu": "1", "memory": "1Gi"},
+        volumes=[VolumeSpec(mount_path="/data", size="10Gi")],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    assert job.status.state.phase == JobPhase.RUNNING
+    pvc = cluster.store.get("PVC", "test/dyn-pvc-0")
+    assert pvc is not None and pvc.phase == "Bound"
+    pv = cluster.store.get("PV", f"/{pvc.volume_name}")
+    assert pv is not None and pv.claim_ref == "test/dyn-pvc-0"
+
+
+def test_static_local_pv_pins_pod_to_its_node(cluster):
+    cluster.add_storage_class("local", provisioner="")
+    cluster.add_pv(
+        "pv-n2", capacity="20Gi", storage_class="local",
+        node_affinity={"kubernetes.io/hostname": "n2"},
+    )
+    job = mk_job(
+        "pinned", 1, {"cpu": "1", "memory": "1Gi"},
+        volumes=[VolumeSpec(mount_path="/scratch", size="10Gi", storage_class="local")],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    pods = [p for p in cluster.store.list("Pod")]
+    assert len(pods) == 1 and pods[0].node_name == "n2"
+    pvc = cluster.store.get("PVC", "test/pinned-pvc-0")
+    assert pvc.phase == "Bound" and pvc.volume_name == "pv-n2"
+
+
+def test_no_available_static_pv_leaves_job_pending(cluster):
+    cluster.add_storage_class("local", provisioner="")
+    # only PV is too small for the claim
+    cluster.add_pv("tiny", capacity="1Gi", storage_class="local")
+    job = mk_job(
+        "starved", 1, {"cpu": "1", "memory": "1Gi"},
+        volumes=[VolumeSpec(mount_path="/x", size="10Gi", storage_class="local")],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    pods = cluster.store.list("Pod")
+    assert all(not p.node_name for p in pods)
+    assert job.status.state.phase != JobPhase.RUNNING
+
+
+def test_prebound_claim_constrains_to_pv_node(cluster):
+    cluster.add_storage_class("local", provisioner="")
+    cluster.add_pv(
+        "disk0", capacity="50Gi", storage_class="local",
+        node_affinity={"kubernetes.io/hostname": "n1"},
+    )
+    # claim already bound to disk0 (e.g. from a previous job run)
+    pvc = PersistentVolumeClaim(
+        meta=Metadata(name="reused", namespace="test"),
+        size="10Gi", storage_class="local", volume_name="disk0", phase="Bound",
+    )
+    cluster.store.create("PVC", pvc)
+    pv = cluster.store.get("PV", "/disk0")
+    pv.claim_ref = "test/reused"
+    cluster.store.update("PV", pv)
+
+    job = mk_job(
+        "reuser", 1, {"cpu": "1", "memory": "1Gi"},
+        volumes=[VolumeSpec(mount_path="/x", volume_claim_name="reused")],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    pods = cluster.store.list("Pod")
+    assert len(pods) == 1 and pods[0].node_name == "n1"
+
+
+def test_two_tasks_one_pv_only_one_schedules(cluster):
+    cluster.add_storage_class("local", provisioner="")
+    cluster.add_pv("only", capacity="20Gi", storage_class="local")
+    # two single-task jobs each wanting their own local claim
+    for name in ("a", "b"):
+        cluster.store.create(
+            "Job",
+            mk_job(
+                name, 1, {"cpu": "1", "memory": "1Gi"},
+                volumes=[VolumeSpec(mount_path="/x", size="5Gi", storage_class="local")],
+            ),
+        )
+    cluster.run_until_idle()
+
+    bound = [p for p in cluster.store.list("Pod") if p.node_name]
+    assert len(bound) == 1
+    claimed = [
+        pvc for pvc in cluster.store.list("PVC") if pvc.phase == "Bound"
+    ]
+    assert len(claimed) == 1 and claimed[0].volume_name == "only"
+
+
+def test_gang_never_ready_releases_assumed_volumes(cluster):
+    cluster.add_storage_class("local", provisioner="")
+    cluster.add_pv("solo", capacity="20Gi", storage_class="local")
+    # gang of 2, but only one PV of the class exists (per-pod claims via two
+    # jobs sharing minAvailable=2 is not expressible; use one job with two
+    # volumes so each pod mounts BOTH claims: first pod assumes the PV for
+    # claim 0, then fails claim 1 -> nothing binds, PV must stay Available
+    job = mk_job(
+        "gang", 2, {"cpu": "1", "memory": "1Gi"},
+        volumes=[
+            VolumeSpec(mount_path="/x", size="5Gi", storage_class="local"),
+            VolumeSpec(mount_path="/y", size="5Gi", storage_class="local"),
+        ],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    assert all(not p.node_name for p in cluster.store.list("Pod"))
+    pv = cluster.store.get("PV", "/solo")
+    assert pv.phase == "Available" and not pv.claim_ref
+    assert all(pvc.phase == "Pending" for pvc in cluster.store.list("PVC"))
+
+
+def test_volume_constrained_tasks_fall_back_to_host_solve(cluster):
+    """The tensor tier must not claim tasks whose placement depends on
+    resident volume state (snapshot marks them dynamic)."""
+    from volcano_tpu.scheduler.framework import open_session
+    from volcano_tpu.scheduler.snapshot import build_tensor_snapshot
+
+    cluster.add_storage_class("local", provisioner="")
+    # no PV large enough: the pod stays pending with a static-class claim
+    cluster.add_pv("d0", capacity="1Gi", storage_class="local")
+    job = mk_job(
+        "vc", 1, {"cpu": "1", "memory": "1Gi"},
+        volumes=[VolumeSpec(mount_path="/x", size="5Gi", storage_class="local")],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    pods = cluster.store.list("Pod")
+    assert pods and all(not p.node_name for p in pods)
+    ssn = open_session(cluster.scheduler.cache, cluster.scheduler.conf.tiers)
+    snap = build_tensor_snapshot(ssn)
+    assert snap.has_dynamic_predicates
+
+
+def test_gang_shares_one_claim_one_pv(cluster):
+    """All pods of a job mount the same job-level claim: the claim's PV is
+    assumed once and shared, not grabbed per-task."""
+    cluster.add_storage_class("local", provisioner="")
+    cluster.add_pv("shared", capacity="50Gi", storage_class="local")
+    job = mk_job(
+        "team", 2, {"cpu": "1", "memory": "1Gi"},
+        volumes=[VolumeSpec(mount_path="/x", size="5Gi", storage_class="local")],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    assert job.status.state.phase == JobPhase.RUNNING
+    pods = cluster.store.list("Pod")
+    assert len(pods) == 2 and all(p.node_name for p in pods)
+    pvc = cluster.store.get("PVC", "test/team-pvc-0")
+    assert pvc.phase == "Bound" and pvc.volume_name == "shared"
+    # exactly one PV bound, to this claim
+    bound_pvs = [pv for pv in cluster.store.list("PV") if pv.claim_ref]
+    assert [pv.meta.name for pv in bound_pvs] == ["shared"]
+
+
+def test_node_pinned_shared_claim_colocates_gang(cluster):
+    """Once the first task assumes a node-pinned PV for the shared claim,
+    siblings must land on nodes that can reach it."""
+    cluster.add_storage_class("local", provisioner="")
+    cluster.add_pv(
+        "pinned", capacity="50Gi", storage_class="local",
+        node_affinity={"kubernetes.io/hostname": "n1"},
+    )
+    job = mk_job(
+        "colo", 2, {"cpu": "1", "memory": "1Gi"},
+        volumes=[VolumeSpec(mount_path="/x", size="5Gi", storage_class="local")],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()
+
+    pods = cluster.store.list("Pod")
+    assert len(pods) == 2 and all(p.node_name == "n1" for p in pods)
+
+
+def test_bound_network_pv_does_not_force_host_fallback(cluster):
+    """A claim bound to a PV with empty node affinity can never veto a node,
+    so it must not push the tensor tier off the device path."""
+    from volcano_tpu.scheduler.framework import open_session
+
+    pvc = PersistentVolumeClaim(
+        meta=Metadata(name="net", namespace="test"),
+        size="5Gi", volume_name="pv-net", phase="Bound",
+    )
+    cluster.store.create("PVC", pvc)
+    from volcano_tpu.api.objects import PersistentVolume
+    cluster.store.create(
+        "PV",
+        PersistentVolume(meta=Metadata(name="pv-net", namespace=""),
+                         capacity="5Gi", claim_ref="test/net"),
+    )
+    from volcano_tpu.api.objects import Pod, PodSpec as PS
+    from volcano_tpu.scheduler.model import TaskInfo
+
+    pod = Pod(
+        meta=Metadata(name="p0", namespace="test"),
+        spec=PS(resources=Resource.from_resource_list({"cpu": "1"})),
+    )
+    pod.volumes.append("net")
+    task = TaskInfo(pod)
+    vb = cluster.scheduler.cache.volume_binder
+    assert not vb.task_constrains_nodes(task)
+
+
+def test_best_effort_with_unsatisfiable_volume_survives_backfill(cluster):
+    """VolumeBindingError inside backfill must not crash the cycle."""
+    cluster.add_storage_class("local", provisioner="")
+    cluster.add_pv("one", capacity="20Gi", storage_class="local")
+    job = mk_job(
+        "be", 1, {},  # empty request -> BestEffort -> backfill path
+        volumes=[
+            VolumeSpec(mount_path="/x", size="5Gi", storage_class="local"),
+            VolumeSpec(mount_path="/y", size="5Gi", storage_class="local"),
+        ],
+    )
+    cluster.store.create("Job", job)
+    cluster.run_until_idle()  # must not raise
+    assert all(not p.node_name for p in cluster.store.list("Pod"))
+    pv = cluster.store.get("PV", "/one")
+    assert pv.phase == "Available"
+
+
+def test_dynamic_class_not_poisoned_by_provisioned_pv(cluster):
+    """A dynamically provisioned (Bound) PV must not flip its class to
+    static: a second job with an identical dynamic claim still runs."""
+    for name in ("first", "second"):
+        cluster.store.create(
+            "Job",
+            mk_job(
+                name, 1, {"cpu": "1", "memory": "1Gi"},
+                volumes=[VolumeSpec(mount_path="/x", size="5Gi")],
+            ),
+        )
+        cluster.run_until_idle()
+        job = cluster.store.get("Job", f"test/{name}")
+        assert job.status.state.phase == JobPhase.RUNNING, name
+    assert all(pvc.phase == "Bound" for pvc in cluster.store.list("PVC"))
+    assert len([pv for pv in cluster.store.list("PV") if pv.claim_ref]) == 2

@@ -23,10 +23,13 @@ from volcano_tpu.api.job import (
 from volcano_tpu.api.objects import (
     Command,
     Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
     Pod,
     PodGroup,
     PodGroupStatus,
     Queue,
+    StorageClass,
     Toleration,
     Taint,
 )

@@ -44,6 +44,7 @@ class VolumeSpec:
     mount_path: str
     volume_claim_name: str = ""   # existing claim; empty => generated/emptyDir
     size: str = ""                # claim template shorthand
+    storage_class: str = ""       # "" = default dynamic class
 
 
 @dataclass

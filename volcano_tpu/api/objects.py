@@ -272,7 +272,52 @@ class Service:
 
 @dataclass
 class PersistentVolumeClaim:
-    """Volume claim created for Job.spec.volumes entries."""
+    """Volume claim created for Job.spec.volumes entries.
+
+    WaitForFirstConsumer semantics: the claim stays ``Pending`` until a pod
+    that mounts it is scheduled; the scheduler's VolumeBinder picks (or
+    provisions) a PV at allocate time and commits it at bind time
+    (reference: KB/pkg/scheduler/cache/interface.go VolumeBinder,
+    cache.go:451-463).
+    """
 
     meta: Metadata
     size: str = ""
+    storage_class: str = ""
+    volume_name: str = ""      # bound PV name; empty while Pending
+    phase: str = "Pending"     # Pending | Bound
+
+
+@dataclass
+class StorageClass:
+    """Provisioning policy for claims (reference: StorageClass informer,
+    KB/pkg/scheduler/cache/cache.go:272-278).
+
+    ``provisioner`` empty means static-only: claims of this class must bind
+    to a pre-created PV. Non-empty means dynamic: a PV is provisioned at
+    bind time wherever the pod lands.
+    """
+
+    meta: Metadata
+    provisioner: str = "volcano.tpu/dynamic"
+    volume_binding_mode: str = "WaitForFirstConsumer"
+
+
+@dataclass
+class PersistentVolume:
+    """A provisioned volume (reference: PV informer, cache.go:258-264).
+
+    ``node_affinity`` is a node-label selector (empty = reachable from any
+    node — network storage); local volumes set it to pin claims to one
+    node, which constrains scheduling of pods mounting them.
+    """
+
+    meta: Metadata
+    capacity: str = ""
+    storage_class: str = ""
+    node_affinity: Dict[str, str] = field(default_factory=dict)
+    claim_ref: str = ""        # bound PVC key; empty while Available
+
+    @property
+    def phase(self) -> str:
+        return "Bound" if self.claim_ref else "Available"

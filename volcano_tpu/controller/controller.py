@@ -319,6 +319,7 @@ class JobController:
                             owner=("Job", job.meta.name),
                         ),
                         size=vol.size,
+                        storage_class=vol.storage_class,
                     ),
                 )
                 job.status.controlled_resources[f"volume-{name}"] = name

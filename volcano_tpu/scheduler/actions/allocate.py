@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 from volcano_tpu.scheduler import util
+from volcano_tpu.scheduler.cache import VolumeBindingError
 from volcano_tpu.scheduler.framework import Action
 from volcano_tpu.scheduler.pqueue import PriorityQueue
 from volcano_tpu.scheduler.session import Session
@@ -136,7 +137,14 @@ class AllocateAction(Action):
             node = util.select_best_node(scores)
 
             if task.init_resreq.less_equal(node.idle):
-                ssn.allocate(task, node.name)
+                try:
+                    ssn.allocate(task, node.name)
+                except VolumeBindingError:
+                    # volume state changed between predicate and allocate
+                    # (another task claimed the PV); task stays pending
+                    # (reference: AllocateVolumes error skips the task,
+                    # session.go:239-244)
+                    pass
             else:
                 delta = node.idle.clone()
                 delta.fit_delta(task.init_resreq)

@@ -7,6 +7,7 @@ Parity: reference KB/pkg/scheduler/actions/backfill/backfill.go:41-78.
 from __future__ import annotations
 
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.scheduler.cache import VolumeBindingError
 from volcano_tpu.scheduler.framework import Action
 from volcano_tpu.scheduler.session import Session
 
@@ -29,5 +30,8 @@ class BackfillAction(Action):
                 for node in ssn.nodes.values():
                     if ssn.predicate_fn(task, node) is not None:
                         continue
-                    ssn.allocate(task, node.name)
+                    try:
+                        ssn.allocate(task, node.name)
+                    except VolumeBindingError:
+                        continue  # try the next node
                     break

@@ -59,6 +59,262 @@ class StatusUpdater:
             self.store.update("PodGroup", pg)
 
 
+class VolumeBindingError(Exception):
+    """No PV satisfies a claim mounted by the task on the chosen node."""
+
+
+class VolumeBinder:
+    """WaitForFirstConsumer volume binding through the scheduler
+    (reference: VolumeBinder seam, KB/pkg/scheduler/cache/interface.go:83-89,
+    default impl cache.go:173-185 delegating to the k8s volume binder; here
+    the binder owns the assume/commit state itself).
+
+    Claim resolution per pod volume:
+      * bound claim (``volume_name`` set): the PV's node affinity must match
+        the candidate node — a hard scheduling constraint;
+      * pending claim of a *static* class (a ``StorageClass`` with empty
+        ``provisioner``, or any class that has pre-created PVs): an
+        Available PV with matching class, sufficient capacity, and node
+        affinity compatible with the candidate node is *assumed*
+        session-locally at allocate time and committed at bind time;
+      * pending claim of a dynamic class (the default): always fits — a PV
+        is provisioned at bind time.
+
+    Assumed assignments are session-scoped: ``clear_session`` drops them at
+    cycle end, so gangs that never became ready release their volumes
+    (the reference's volume binder assume-cache behaves the same way).
+    """
+
+    def __init__(self, store: Store):
+        self.store = store
+        # pvc_key -> assumed pv_name ("" = dynamic, provision at bind);
+        # one assumption per CLAIM, shared by every task mounting it (all
+        # pods of a job mount the same job-level claims)
+        self._claim_assumed: Dict[str, str] = {}
+        self._assumed_pvs: Dict[str, str] = {}  # pv_name -> pvc_key
+        # session-invariant caches (cleared by clear_session): a task's
+        # claim list and a class's staticness don't change within a cycle,
+        # and volume_fit sits in the per-(task,node) predicate hot path
+        self._claims_cache: Dict[str, List[str]] = {}
+        self._static_cache: Dict[str, bool] = {}
+        self._qty_cache: Dict[str, float] = {}  # quantity string -> bytes
+        # PVC objects and the PV list, fetched once per session — volume_fit
+        # runs per (task, node) and store reads may be HTTP round trips
+        # (RemoteStore); bind_volumes invalidates both
+        self._pvc_obj_cache: Dict[str, object] = {}
+        self._pv_list_cache: Optional[List] = None
+
+    # -- resolution helpers --------------------------------------------------
+
+    def _pending_claims(self, task: TaskInfo):
+        pod = task.pod
+        if pod is None:
+            return []
+        keys = self._claims_cache.get(task.key)
+        if keys is None:
+            keys = []
+            for name in pod.volumes:
+                key = f"{pod.meta.namespace}/{name}"
+                if self.store.get("PVC", key) is not None:
+                    keys.append(key)
+            self._claims_cache[task.key] = keys
+        out = []
+        for key in keys:
+            pvc = self._pvc_obj_cache.get(key)
+            if pvc is None:
+                pvc = self.store.get("PVC", key)
+                if pvc is not None:
+                    self._pvc_obj_cache[key] = pvc
+            if pvc is not None:
+                out.append(pvc)
+        return out
+
+    def _pvs(self) -> List:
+        if self._pv_list_cache is None:
+            self._pv_list_cache = list(self.store.items("PV"))
+        return self._pv_list_cache
+
+    def _is_static_class(self, class_name: str) -> bool:
+        cached = self._static_cache.get(class_name)
+        if cached is not None:
+            return cached
+        sc = self.store.get("StorageClass", f"/{class_name}")
+        if sc is not None:
+            static = not sc.provisioner
+        else:
+            # no StorageClass object: static iff AVAILABLE pre-created PVs
+            # carry it (Bound PVs don't count — dynamically provisioned
+            # volumes keep their claim's class and must not flip the class
+            # to static for later claims)
+            static = any(
+                pv.storage_class == class_name and not pv.claim_ref
+                for pv in self._pvs()
+            )
+        self._static_cache[class_name] = static
+        return static
+
+    def _qty(self, s: str) -> float:
+        """Parsed byte quantity, memoized — _find_pv sits in the
+        per-(task,node) predicate hot path."""
+        v = self._qty_cache.get(s)
+        if v is None:
+            from volcano_tpu.api.resource import parse_quantity
+
+            v = parse_quantity("memory", s)
+            self._qty_cache[s] = v
+        return v
+
+    @staticmethod
+    def _affinity_matches(pv, node_labels: Dict[str, str]) -> bool:
+        return all(node_labels.get(k) == v for k, v in pv.node_affinity.items())
+
+    def _find_pv(self, pvc, node_labels: Dict[str, str]):
+        """Smallest Available un-assumed PV fitting the claim on this node."""
+        want = self._qty(pvc.size) if pvc.size else 0.0
+        best = None
+        best_cap = None
+        for pv in self._pvs():
+            if pv.claim_ref or pv.meta.name in self._assumed_pvs:
+                continue
+            if pv.storage_class != pvc.storage_class:
+                continue
+            if not self._affinity_matches(pv, node_labels):
+                continue
+            cap = self._qty(pv.capacity) if pv.capacity else float("inf")
+            if cap < want:
+                continue
+            if best is None or cap < best_cap:
+                best, best_cap = pv, cap
+        return best
+
+    def _resolve_claim(self, pvc, labels) -> Tuple[Optional[str], Optional[str]]:
+        """(reason, assumption) for one claim on a node with these labels —
+        the single resolution rule shared by the predicate face
+        (``volume_fit``) and the allocator (``allocate_volumes``) so the
+        two can never disagree.
+
+        reason is non-None when the claim cannot land there. assumption is
+        the PV name to assume, "" for provision-at-bind dynamic, or None
+        when the claim is already bound/assumed (nothing new to record).
+        """
+        assumed = self._claim_assumed.get(pvc.meta.key)
+        if pvc.volume_name or assumed:
+            reason = self._reachable(pvc.volume_name or assumed, labels)
+            if reason is not None:
+                return f"{reason} (claim {pvc.meta.name})", None
+            return None, None
+        if self._is_static_class(pvc.storage_class):
+            pv = self._find_pv(pvc, labels)
+            if pv is None:
+                return (
+                    f"no available volume for claim {pvc.meta.name} "
+                    f"(class {pvc.storage_class!r})",
+                    None,
+                )
+            return None, pv.meta.name
+        return None, ""  # dynamic: provision at bind
+
+    def _reachable(self, pv_name: str, labels) -> Optional[str]:
+        """Reason pv_name can't serve a pod on a node with these labels."""
+        pv = next((p for p in self._pvs() if p.meta.name == pv_name), None)
+        if pv is not None and pv.node_affinity and not self._affinity_matches(pv, labels):
+            return f"volume {pv_name} not reachable"
+        return None
+
+    # -- the predicate face --------------------------------------------------
+
+    def volume_fit(self, task: TaskInfo, node) -> Optional[str]:
+        """Reason the task's volumes cannot land on ``node``, or None."""
+        labels = node.node.labels
+        for pvc in self._pending_claims(task):
+            reason, _ = self._resolve_claim(pvc, labels)
+            if reason is not None:
+                return f"{reason} on {node.name}"
+        return None
+
+    def task_constrains_nodes(self, task: TaskInfo) -> bool:
+        """Whether volume state can veto nodes for this task (drives the
+        tensor tier's host fallback — volume placement is resident state
+        the device kernels don't model)."""
+        for pvc in self._pending_claims(task):
+            if pvc.volume_name:
+                name = pvc.volume_name
+                pv = next((p for p in self._pvs() if p.meta.name == name), None)
+                if pv is not None and pv.node_affinity:
+                    return True  # node-pinned bound volume
+            elif self._is_static_class(pvc.storage_class):
+                return True
+        return False
+
+    # -- allocate / bind (interface.go:83-89) --------------------------------
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        node = self.store.get("Node", f"/{hostname}")
+        labels = node.labels if node is not None else {}
+        created: List[str] = []  # claim keys assumed by THIS call, for rollback
+        try:
+            for pvc in self._pending_claims(task):
+                key = pvc.meta.key
+                reason, assumption = self._resolve_claim(pvc, labels)
+                if reason is not None:
+                    raise VolumeBindingError(f"{reason} from {hostname}")
+                if assumption is None:
+                    continue  # already bound or assumed by a sibling
+                self._claim_assumed[key] = assumption
+                if assumption:
+                    self._assumed_pvs[assumption] = key
+                created.append(key)
+        except VolumeBindingError:
+            for key in created:
+                pv_name = self._claim_assumed.pop(key, "")
+                if pv_name:
+                    self._assumed_pvs.pop(pv_name, None)
+            raise
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        from volcano_tpu.api.objects import Metadata, PersistentVolume
+
+        for pvc in self._pending_claims(task):
+            key = pvc.meta.key
+            if key not in self._claim_assumed:
+                continue  # already committed by a sibling task, or unbound
+            pv_name = self._claim_assumed.pop(key)
+            if not pv_name:
+                # dynamic provisioning: materialize a network PV (no node
+                # affinity) named by the claim's uid — unambiguous across
+                # namespaces
+                pv_name = f"pv-{pvc.meta.uid}"
+                if self.store.get("PV", f"/{pv_name}") is None:
+                    self.store.create(
+                        "PV",
+                        PersistentVolume(
+                            meta=Metadata(name=pv_name, namespace=""),
+                            capacity=pvc.size,
+                            storage_class=pvc.storage_class,
+                            claim_ref=key,
+                        ),
+                    )
+            else:
+                pv = self.store.get("PV", f"/{pv_name}")
+                if pv is not None:
+                    pv.claim_ref = key
+                    self.store.update("PV", pv)
+                self._assumed_pvs.pop(pv_name, None)
+            pvc.volume_name = pv_name
+            pvc.phase = "Bound"
+            self.store.update("PVC", pvc)
+            self._pvc_obj_cache[key] = pvc
+            self._pv_list_cache = None  # a PV was created or mutated
+
+    def clear_session(self) -> None:
+        self._claim_assumed.clear()
+        self._assumed_pvs.clear()
+        self._claims_cache.clear()
+        self._static_cache.clear()
+        self._pvc_obj_cache.clear()
+        self._pv_list_cache = None
+
+
 class SchedulerCache:
     def __init__(
         self,
@@ -72,6 +328,7 @@ class SchedulerCache:
         self.binder = Binder(store)
         self.evictor = Evictor(store)
         self.status_updater = StatusUpdater(store)
+        self.volume_binder = VolumeBinder(store)
         # (task_key, hostname) bind log and (task_key, reason) evict log for
         # observability/tests; cleared by callers.
         self.bind_log: List[Tuple[str, str]] = []
@@ -184,7 +441,13 @@ class SchedulerCache:
             self.status_updater.update_pod_group(job.pod_group)
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
-        pass  # volume binding is a no-op in the simulator
+        self.volume_binder.allocate_volumes(task, hostname)
 
     def bind_volumes(self, task: TaskInfo) -> None:
-        pass
+        self.volume_binder.bind_volumes(task)
+
+    def volume_fit(self, task: TaskInfo, node) -> Optional[str]:
+        return self.volume_binder.volume_fit(task, node)
+
+    def clear_session_volumes(self) -> None:
+        self.volume_binder.clear_session()

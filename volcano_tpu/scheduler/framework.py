@@ -114,6 +114,11 @@ def open_session(cache, tiers: List[Tier]) -> Session:
 
 
 def close_session(ssn: Session) -> None:
+    # drop session-scoped assumed volume assignments (gangs that never
+    # became ready release their volumes)
+    clear_volumes = getattr(ssn.cache, "clear_session_volumes", None)
+    if clear_volumes is not None:
+        clear_volumes()
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
         plugin.on_session_close(ssn)

@@ -101,6 +101,15 @@ class PredicatesPlugin(Plugin):
                     return f"node {node.name} under {cond.kind}"
             if not pod_affinity_fits(task, node):
                 return f"pod affinity/anti-affinity mismatch on {node.name}"
+            # volume binding predicate: bound-PV node affinity / static-PV
+            # availability (the k8s CheckVolumeBinding analogue; the
+            # reference reaches it through the VolumeBinder seam instead,
+            # cache.go:173-185)
+            volume_fit = getattr(ssn.cache, "volume_fit", None)
+            if volume_fit is not None:
+                reason = volume_fit(task, node)
+                if reason is not None:
+                    return reason
             return None
 
         ssn.add_predicate_fn(self.name, predicate_fn)

@@ -156,6 +156,9 @@ def build_tensor_snapshot(
     """Build the dense snapshot from a Session's object state."""
     from volcano_tpu.scheduler.plugins.nodeorder import node_affinity_score
 
+    vb = getattr(ssn.cache, "volume_binder", None)
+    volume_constrains = None if vb is None else vb.task_constrains_nodes
+
     # -- resource dims -------------------------------------------------------
     scalar_names: List[str] = []
     seen = set()
@@ -291,6 +294,10 @@ def build_tensor_snapshot(
             if t.pod.spec.host_ports or (
                 aff and (aff.pod_affinity or aff.pod_anti_affinity)
             ):
+                dynamic_predicates = True
+            elif t.pod.volumes and volume_constrains is not None and volume_constrains(t):
+                # bound-PV affinity / static-PV availability is resident
+                # store state the device kernels don't model
                 dynamic_predicates = True
 
     T = _bucket(max(len(task_rows), 1))

@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler.cache import VolumeBindingError
 
 
 def _host_allocate(ssn) -> None:
@@ -435,7 +436,12 @@ def _replay_exact(ssn, snap, order, task_node, task_kind) -> None:
         task = job.tasks[snap.task_uids[t]]
         node_name = snap.node_names[task_node[t]]
         if task_kind[t] == 1:
-            ssn.allocate(task, node_name)
+            try:
+                ssn.allocate(task, node_name)
+            except VolumeBindingError:
+                # volume state changed under the solve (concurrent store
+                # writer); the task stays pending, same as the host path
+                continue
         else:
             ssn.pipeline(task, node_name)
 
@@ -467,6 +473,17 @@ def _apply_bulk(ssn, snap, order, task_node, task_kind, ready, use_gang=True) ->
         task.node_name = node_name
         if task_kind[t] == 1:
             if job_uid in ready_jobs:
+                if task.pod is not None and task.pod.volumes:
+                    # dynamic-claim provisioning must not be skipped on the
+                    # bulk path (volume-constrained tasks fell back to host,
+                    # so this cannot raise for a node the solve chose; guard
+                    # anyway and leave the task allocated-unbound)
+                    try:
+                        ssn.cache.allocate_volumes(task, node_name)
+                    except VolumeBindingError:
+                        job.update_task_status(task, TaskStatus.ALLOCATED)
+                        continue
+                    ssn.cache.bind_volumes(task)
                 ssn.cache.bind(task, node_name)
                 job.update_task_status(task, TaskStatus.BINDING)
             else:

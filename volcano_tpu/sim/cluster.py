@@ -15,7 +15,14 @@ from __future__ import annotations
 
 from typing import Optional
 
-from volcano_tpu.api.objects import Metadata, Node, PriorityClass, Queue
+from volcano_tpu.api.objects import (
+    Metadata,
+    Node,
+    PersistentVolume,
+    PriorityClass,
+    Queue,
+    StorageClass,
+)
 from volcano_tpu.api.resource import Resource
 from volcano_tpu.api.types import PodPhase
 from volcano_tpu.controller import JobController
@@ -55,6 +62,37 @@ class Cluster:
         return self.store.create(
             "Node",
             Node(meta=Metadata(name=name, namespace=""), allocatable=alloc, **node_kw),
+        )
+
+    def add_storage_class(
+        self, name: str, provisioner: str = "volcano.tpu/dynamic"
+    ) -> StorageClass:
+        """provisioner="" declares a static-only class: claims bind to
+        pre-created PVs (``add_pv``) chosen by the scheduler's VolumeBinder."""
+        return self.store.create(
+            "StorageClass",
+            StorageClass(
+                meta=Metadata(name=name, namespace=""), provisioner=provisioner
+            ),
+        )
+
+    def add_pv(
+        self,
+        name: str,
+        capacity: str = "",
+        storage_class: str = "",
+        node_affinity=None,
+    ) -> PersistentVolume:
+        """Pre-created volume; ``node_affinity`` is a node-label selector
+        (e.g. {"kubernetes.io/hostname": "n0"} for a local volume)."""
+        return self.store.create(
+            "PV",
+            PersistentVolume(
+                meta=Metadata(name=name, namespace=""),
+                capacity=capacity,
+                storage_class=storage_class,
+                node_affinity=dict(node_affinity or {}),
+            ),
         )
 
     def add_priority_class(self, name: str, value: int, global_default=False):

@@ -24,7 +24,9 @@ from volcano_tpu.api.objects import (
     Command,
     ConfigMap,
     Node,
+    PersistentVolume,
     PersistentVolumeClaim,
+    StorageClass,
     Pod,
     PodGroup,
     PriorityClass,
@@ -47,6 +49,8 @@ KIND_CLASSES: Dict[str, type] = {
     "Service": Service,
     "PriorityClass": PriorityClass,
     "PVC": PersistentVolumeClaim,
+    "PV": PersistentVolume,
+    "StorageClass": StorageClass,
     "Lease": Lease,
     "Event": ClusterEvent,
 }
