@@ -293,3 +293,28 @@ def test_dynamic_class_not_poisoned_by_provisioned_pv(cluster):
         assert job.status.state.phase == JobPhase.RUNNING, name
     assert all(pvc.phase == "Bound" for pvc in cluster.store.list("PVC"))
     assert len([pv for pv in cluster.store.list("PV") if pv.claim_ref]) == 2
+
+
+def test_classless_static_class_survives_binding_last_pv(cluster):
+    """Without a StorageClass object, a class inferred static from its
+    pre-created PV must stay static after that PV binds: a second claim
+    waits instead of silently dynamic-provisioning."""
+    cluster.add_pv("lone", capacity="20Gi", storage_class="local")  # no StorageClass object
+    cluster.store.create(
+        "Job",
+        mk_job("one", 1, {"cpu": "1", "memory": "1Gi"},
+               volumes=[VolumeSpec(mount_path="/x", size="5Gi", storage_class="local")]),
+    )
+    cluster.run_until_idle()
+    assert cluster.store.get("Job", "test/one").status.state.phase == JobPhase.RUNNING
+    assert cluster.store.get("PV", "/lone").claim_ref
+
+    cluster.store.create(
+        "Job",
+        mk_job("two", 1, {"cpu": "1", "memory": "1Gi"},
+               volumes=[VolumeSpec(mount_path="/x", size="5Gi", storage_class="local")]),
+    )
+    cluster.run_until_idle()
+    # no second PV may appear; the job waits for a pre-created volume
+    assert cluster.store.get("Job", "test/two").status.state.phase != JobPhase.RUNNING
+    assert len(cluster.store.list("PV")) == 1

@@ -317,6 +317,7 @@ class PersistentVolume:
     storage_class: str = ""
     node_affinity: Dict[str, str] = field(default_factory=dict)
     claim_ref: str = ""        # bound PVC key; empty while Available
+    provisioned: bool = False  # dynamically created at bind (vs pre-created)
 
     @property
     def phase(self) -> str:

@@ -103,6 +103,7 @@ class VolumeBinder:
         # (RemoteStore); bind_volumes invalidates both
         self._pvc_obj_cache: Dict[str, object] = {}
         self._pv_list_cache: Optional[List] = None
+        self._pv_by_name: Dict[str, object] = {}
 
     # -- resolution helpers --------------------------------------------------
 
@@ -132,7 +133,12 @@ class VolumeBinder:
     def _pvs(self) -> List:
         if self._pv_list_cache is None:
             self._pv_list_cache = list(self.store.items("PV"))
+            self._pv_by_name = {pv.meta.name: pv for pv in self._pv_list_cache}
         return self._pv_list_cache
+
+    def _pv(self, name: str):
+        self._pvs()
+        return self._pv_by_name.get(name)
 
     def _is_static_class(self, class_name: str) -> bool:
         cached = self._static_cache.get(class_name)
@@ -142,12 +148,12 @@ class VolumeBinder:
         if sc is not None:
             static = not sc.provisioner
         else:
-            # no StorageClass object: static iff AVAILABLE pre-created PVs
-            # carry it (Bound PVs don't count — dynamically provisioned
-            # volumes keep their claim's class and must not flip the class
-            # to static for later claims)
+            # no StorageClass object: static iff PRE-CREATED PVs carry it
+            # (any phase — binding the last Available PV must not flip the
+            # class to dynamic); PVs this binder provisioned at bind time
+            # never count, so dynamic classes stay dynamic
             static = any(
-                pv.storage_class == class_name and not pv.claim_ref
+                pv.storage_class == class_name and not pv.provisioned
                 for pv in self._pvs()
             )
         self._static_cache[class_name] = static
@@ -216,7 +222,7 @@ class VolumeBinder:
 
     def _reachable(self, pv_name: str, labels) -> Optional[str]:
         """Reason pv_name can't serve a pod on a node with these labels."""
-        pv = next((p for p in self._pvs() if p.meta.name == pv_name), None)
+        pv = self._pv(pv_name)
         if pv is not None and pv.node_affinity and not self._affinity_matches(pv, labels):
             return f"volume {pv_name} not reachable"
         return None
@@ -238,8 +244,7 @@ class VolumeBinder:
         the device kernels don't model)."""
         for pvc in self._pending_claims(task):
             if pvc.volume_name:
-                name = pvc.volume_name
-                pv = next((p for p in self._pvs() if p.meta.name == name), None)
+                pv = self._pv(pvc.volume_name)
                 if pv is not None and pv.node_affinity:
                     return True  # node-pinned bound volume
             elif self._is_static_class(pvc.storage_class):
@@ -292,6 +297,7 @@ class VolumeBinder:
                             capacity=pvc.size,
                             storage_class=pvc.storage_class,
                             claim_ref=key,
+                            provisioned=True,
                         ),
                     )
             else:
@@ -305,6 +311,7 @@ class VolumeBinder:
             self.store.update("PVC", pvc)
             self._pvc_obj_cache[key] = pvc
             self._pv_list_cache = None  # a PV was created or mutated
+            self._pv_by_name = {}
 
     def clear_session(self) -> None:
         self._claim_assumed.clear()
@@ -313,6 +320,7 @@ class VolumeBinder:
         self._static_cache.clear()
         self._pvc_obj_cache.clear()
         self._pv_list_cache = None
+        self._pv_by_name = {}
 
 
 class SchedulerCache:

@@ -74,6 +74,12 @@ def open_session(cache, tiers: List[Tier]) -> Session:
     PodGroups must survive into the session for the enqueue action to
     admit them (the controller only creates pods after Inqueue).
     """
+    # start from clean volume session state even if the previous cycle
+    # aborted before close_session could clear it (assumed PVs and store
+    # caches must never leak across sessions)
+    clear_volumes = getattr(cache, "clear_session_volumes", None)
+    if clear_volumes is not None:
+        clear_volumes()
     cluster = cache.snapshot()
     ssn = Session(cache, tiers, cluster)
 

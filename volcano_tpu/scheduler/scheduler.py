@@ -32,6 +32,14 @@ class Scheduler:
             store, scheduler_name=scheduler_name, default_queue=default_queue
         )
         self.elector = elector
+        # cross-cycle incremental snapshot state (class masks, node-static
+        # arrays, device uploads) — survives sessions, invalidated by node
+        # epoch changes
+        self.snapshot_cache = None
+        if self.conf.backend in ("tpu", "native"):
+            from volcano_tpu.scheduler.snapshot import SnapshotCache
+
+            self.snapshot_cache = SnapshotCache()
 
     @classmethod
     def from_conf_yaml(cls, store: Store, text: str, **kw) -> "Scheduler":
@@ -47,7 +55,10 @@ class Scheduler:
             from volcano_tpu.scheduler.tensor_backend import TensorBackend
 
             ssn.tensor_backend = TensorBackend(
-                ssn, solve_mode=self.conf.solve_mode, flavor=self.conf.backend
+                ssn,
+                solve_mode=self.conf.solve_mode,
+                flavor=self.conf.backend,
+                snapshot_cache=self.snapshot_cache,
             )
         else:
             ssn.tensor_backend = None

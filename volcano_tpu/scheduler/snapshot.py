@@ -150,8 +150,78 @@ def _static_predicate(task: TaskInfo, node: NodeInfo) -> bool:
     return True
 
 
+class SnapshotCache:
+    """Cross-cycle snapshot cache (SURVEY §7 hard part (e): keep repeat work
+    and host→device transfer out of the schedule cycle).
+
+    Three tiers, all invalidated by the *node epoch* — the ordered tuple of
+    (name, resource_version) over session nodes, which changes whenever a
+    node is added/removed/relabeled/retainted but NOT when pod placement
+    shifts Idle/Used:
+
+      * per-class [N] static-predicate mask/score rows — saves the
+        O(classes × nodes) Python predicate sweep, the dominant snapshot
+        build cost on big clusters;
+      * the assembled [C, N] mask/score and node-static arrays
+        (allocatable, max-tasks, validity), returned as the SAME numpy
+        objects while unchanged so device-upload caching can key on
+        identity;
+      * an id-keyed host→device upload memo (``to_device``) so unchanged
+        arrays are not re-uploaded every cycle.
+
+    The reference rebuilds its object snapshot from the informer cache each
+    cycle under a mutex (cache.go:537-589); here the equivalent rebuild is
+    incremental against device-resident state.
+    """
+
+    def __init__(self, max_device_entries: int = 64):
+        from collections import OrderedDict
+
+        self._epoch = None
+        self._weight: Optional[float] = None
+        self._rows: Dict[object, Tuple[np.ndarray, np.ndarray]] = {}
+        # (class_keys tuple, mask [C,N], score [C,N])
+        self._assembled: Optional[Tuple[tuple, np.ndarray, np.ndarray]] = None
+        # (dims tuple, allocatable [N,R], max_tasks [N], valid [N], names)
+        self._node_static = None
+        self._dev = OrderedDict()  # id(np) -> (np ref, device array)
+        self._max_dev = max_device_entries
+
+    @staticmethod
+    def node_epoch(nodes) -> tuple:
+        return tuple((n.name, n.node.meta.resource_version) for n in nodes)
+
+    def roll_epoch(self, epoch, weight: float) -> None:
+        if epoch != self._epoch or weight != self._weight:
+            self._rows.clear()
+            self._assembled = None
+            self._node_static = None
+            self._epoch = epoch
+            self._weight = weight
+
+    def to_device(self, arr):
+        """Device copy of a host array, memoized by object identity — a
+        reused numpy object (cache hit above) skips the upload."""
+        import jax.numpy as jnp
+
+        key = id(arr)
+        hit = self._dev.get(key)
+        if hit is not None and hit[0] is arr:
+            self._dev.move_to_end(key)
+            return hit[1]
+        dev = jnp.asarray(arr)
+        self._dev[key] = (arr, dev)
+        self._dev.move_to_end(key)
+        while len(self._dev) > self._max_dev:
+            self._dev.popitem(last=False)
+        return dev
+
+
 def build_tensor_snapshot(
-    ssn, nodeaffinity_weight: float = 1.0, task_order_by_priority: bool = True
+    ssn,
+    nodeaffinity_weight: float = 1.0,
+    task_order_by_priority: bool = True,
+    cache: Optional[SnapshotCache] = None,
 ) -> TensorSnapshot:
     """Build the dense snapshot from a Session's object state."""
     from volcano_tpu.scheduler.plugins.nodeorder import node_affinity_score
@@ -183,22 +253,35 @@ def build_tensor_snapshot(
     # -- nodes ---------------------------------------------------------------
     nodes = list(ssn.nodes.values())
     N = _bucket(max(len(nodes), 1))
+    if cache is not None:
+        cache.roll_epoch(SnapshotCache.node_epoch(nodes), nodeaffinity_weight)
+
     node_idle = np.zeros((N, R), np.float32)
     node_rel = np.zeros((N, R), np.float32)
     node_used = np.zeros((N, R), np.float32)
-    node_allocatable = np.zeros((N, R), np.float32)
-    node_max_tasks = np.full((N,), np.iinfo(np.int32).max, np.int32)
     node_tc = np.zeros((N,), np.int32)
-    node_valid = np.zeros((N,), bool)
+
+    static = cache._node_static if cache is not None else None
+    if static is not None and static[0] == tuple(dims):
+        _, node_allocatable, node_max_tasks, node_valid = static
+    else:
+        node_allocatable = np.zeros((N, R), np.float32)
+        node_max_tasks = np.full((N,), np.iinfo(np.int32).max, np.int32)
+        node_valid = np.zeros((N,), bool)
+        for i, ni in enumerate(nodes):
+            _resource_vec(ni.allocatable, dims, node_allocatable[i])
+            if ni.allocatable.max_task_num is not None:
+                node_max_tasks[i] = ni.allocatable.max_task_num
+            node_valid[i] = True
+        if cache is not None:
+            cache._node_static = (
+                tuple(dims), node_allocatable, node_max_tasks, node_valid,
+            )
     for i, ni in enumerate(nodes):
         _resource_vec(ni.idle, dims, node_idle[i])
         _resource_vec(ni.releasing, dims, node_rel[i])
         _resource_vec(ni.used, dims, node_used[i])
-        _resource_vec(ni.allocatable, dims, node_allocatable[i])
-        if ni.allocatable.max_task_num is not None:
-            node_max_tasks[i] = ni.allocatable.max_task_num
         node_tc[i] = len(ni.tasks)
-        node_valid[i] = True
 
     # -- queues --------------------------------------------------------------
     # sorted by uid so index-order tie-breaking matches the host fallback
@@ -314,19 +397,38 @@ def build_tensor_snapshot(
         task_uids.append(t.uid)
 
     # -- predicate classes ---------------------------------------------------
+    # the O(classes × nodes) Python predicate sweep is the dominant build
+    # cost on big clusters; per-class rows (and the assembled arrays) are
+    # reused across cycles while the node epoch holds (SnapshotCache)
     C = max(len(classes), 1)
-    class_mask = np.zeros((C, N), bool)
-    class_score = np.zeros((C, N), np.float32)
-    for c, example in enumerate(class_examples):
-        for i, ni in enumerate(nodes):
-            ok = _static_predicate(example, ni)
-            class_mask[c, i] = ok
-            if ok:
-                class_score[c, i] = nodeaffinity_weight * node_affinity_score(
-                    example, ni
-                )
-    if not class_examples:
-        class_mask[:, : len(nodes)] = True
+    class_keys = tuple(classes)  # insertion order == class index order
+    assembled = cache._assembled if cache is not None else None
+    if assembled is not None and assembled[0] == class_keys and assembled[1].shape == (C, N):
+        class_mask, class_score = assembled[1], assembled[2]
+    else:
+        class_mask = np.zeros((C, N), bool)
+        class_score = np.zeros((C, N), np.float32)
+        rows = cache._rows if cache is not None else {}
+        for c, example in enumerate(class_examples):
+            key = class_keys[c]
+            cached_row = rows.get(key)
+            if cached_row is not None:
+                class_mask[c, : len(nodes)] = cached_row[0][: len(nodes)]
+                class_score[c, : len(nodes)] = cached_row[1][: len(nodes)]
+                continue
+            for i, ni in enumerate(nodes):
+                ok = _static_predicate(example, ni)
+                class_mask[c, i] = ok
+                if ok:
+                    class_score[c, i] = nodeaffinity_weight * node_affinity_score(
+                        example, ni
+                    )
+            if cache is not None:
+                rows[key] = (class_mask[c].copy(), class_score[c].copy())
+        if not class_examples:
+            class_mask[:, : len(nodes)] = True
+        if cache is not None:
+            cache._assembled = (class_keys, class_mask, class_score)
 
     total = node_allocatable[node_valid].sum(axis=0).astype(np.float32)
 

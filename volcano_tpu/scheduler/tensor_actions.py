@@ -373,32 +373,33 @@ def allocate(ssn) -> None:
         )
         solve = allocate_solve_batch if use_batch else allocate_solve
 
+        dev = backend.to_device
         out = solve(
-            jnp.asarray(snap.node_idle),
-            jnp.asarray(snap.node_releasing),
-            jnp.asarray(snap.node_used),
-            jnp.asarray(snap.node_alloc),
-            jnp.asarray(snap.node_max_tasks),
-            jnp.asarray(snap.node_task_count),
-            jnp.asarray(snap.node_valid),
-            jnp.asarray(snap.task_req),
-            jnp.asarray(snap.task_job),
-            jnp.asarray(snap.task_class),
-            jnp.asarray(snap.task_valid),
-            jnp.asarray(snap.job_queue),
-            jnp.asarray(snap.job_min_available),
-            jnp.asarray(snap.job_priority),
-            jnp.asarray(snap.job_ready_init),
-            jnp.asarray(snap.job_alloc_init),
-            jnp.asarray(snap.job_schedulable),
-            jnp.asarray(snap.job_start),
-            jnp.asarray(snap.job_ntasks),
-            jnp.asarray(snap.queue_alloc_init),
+            dev(snap.node_idle),
+            dev(snap.node_releasing),
+            dev(snap.node_used),
+            dev(snap.node_alloc),
+            dev(snap.node_max_tasks),
+            dev(snap.node_task_count),
+            dev(snap.node_valid),
+            dev(snap.task_req),
+            dev(snap.task_job),
+            dev(snap.task_class),
+            dev(snap.task_valid),
+            dev(snap.job_queue),
+            dev(snap.job_min_available),
+            dev(snap.job_priority),
+            dev(snap.job_ready_init),
+            dev(snap.job_alloc_init),
+            dev(snap.job_schedulable),
+            dev(snap.job_start),
+            dev(snap.job_ntasks),
+            dev(snap.queue_alloc_init),
             deserved,
-            jnp.asarray(snap.class_node_mask),
-            jnp.asarray(snap.class_node_score),
-            jnp.asarray(snap.total),
-            jnp.asarray(snap.eps),
+            dev(snap.class_node_mask),
+            dev(snap.class_node_score),
+            dev(snap.total),
+            dev(snap.eps),
             jnp.float32(w_least),
             jnp.float32(w_balanced),
             job_key_order=backend.job_key_order,

@@ -46,12 +46,14 @@ class TensorBackend:
         solve_mode: str = "auto",  # auto | exact | batch
         batch_threshold: int = BATCH_THRESHOLD,
         flavor: str = "tpu",  # "tpu" (JAX kernels) | "native" (C++ solver)
+        snapshot_cache=None,  # persistent SnapshotCache owned by the Scheduler
     ):
         self.ssn = ssn
         self.bulk_threshold = bulk_threshold
         self.solve_mode = solve_mode
         self.batch_threshold = batch_threshold
         self.flavor = flavor
+        self.snapshot_cache = snapshot_cache
         self.enabled: Dict[str, bool] = {}
         self.nodeorder_args: Dict[str, str] = {}
         self.supported = True
@@ -92,8 +94,18 @@ class TensorBackend:
                 self.ssn,
                 nodeaffinity_weight=w_nodeaff if self.enabled["nodeorder"] else 0.0,
                 task_order_by_priority=self.task_order_by_priority,
+                cache=self.snapshot_cache,
             )
         return self._snapshot
+
+    def to_device(self, arr):
+        """Host→device with the persistent identity memo when available —
+        arrays the SnapshotCache reused across cycles skip the upload."""
+        if self.snapshot_cache is not None:
+            return self.snapshot_cache.to_device(arr)
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
 
     def invalidate(self) -> None:
         """Host state changed outside the tensor path (e.g. a host action
@@ -168,20 +180,21 @@ class TensorBackend:
 
         snap = self.snapshot()
         w_least, w_bal = self.score_weights()
+        dev = self.to_device
         consts = VictimConsts(
-            run_req=jnp.asarray(snap.run_req),
-            run_node=jnp.asarray(snap.run_node),
-            run_job=jnp.asarray(snap.run_job),
-            run_prio=jnp.asarray(snap.run_prio),
-            run_rank=jnp.asarray(snap.run_rank),
-            run_evictable=jnp.asarray(snap.run_evictable),
-            job_queue=jnp.asarray(snap.job_queue),
-            job_min=jnp.asarray(snap.job_min_available),
-            node_alloc=jnp.asarray(snap.node_alloc),
-            node_max_tasks=jnp.asarray(snap.node_max_tasks),
-            node_valid=jnp.asarray(snap.node_valid),
-            class_mask=jnp.asarray(snap.class_node_mask),
-            class_score=jnp.asarray(snap.class_node_score),
+            run_req=dev(snap.run_req),
+            run_node=dev(snap.run_node),
+            run_job=dev(snap.run_job),
+            run_prio=dev(snap.run_prio),
+            run_rank=dev(snap.run_rank),
+            run_evictable=dev(snap.run_evictable),
+            job_queue=dev(snap.job_queue),
+            job_min=dev(snap.job_min_available),
+            node_alloc=dev(snap.node_alloc),
+            node_max_tasks=dev(snap.node_max_tasks),
+            node_valid=dev(snap.node_valid),
+            class_mask=dev(snap.class_node_mask),
+            class_score=dev(snap.class_node_score),
             queue_deserved=self.deserved(),
             total=jnp.asarray(snap.total),
             eps=jnp.asarray(snap.eps),
