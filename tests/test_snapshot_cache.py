@@ -66,10 +66,11 @@ def test_class_rows_and_node_static_reused_across_cycles(cluster):
 
     s1 = build_tensor_snapshot(_session(cluster), cache=cache)
     s2 = build_tensor_snapshot(_session(cluster), cache=cache)
-    if tuple(np.nonzero(s1.task_valid)[0]) == tuple(np.nonzero(s2.task_valid)[0]):
-        # identical class sets: assembled arrays are the same objects
-        assert s2.class_node_mask is s1.class_node_mask
-        assert s2.class_node_score is s1.class_node_score
+    # identical pending set across the two builds -> assembled arrays must
+    # be the same objects (the cache's whole point); assert, don't branch
+    assert tuple(np.nonzero(s1.task_valid)[0]) == tuple(np.nonzero(s2.task_valid)[0])
+    assert s2.class_node_mask is s1.class_node_mask
+    assert s2.class_node_score is s1.class_node_score
     assert s2.node_alloc is s1.node_alloc
     assert s2.node_max_tasks is s1.node_max_tasks
 

@@ -174,12 +174,15 @@ class SnapshotCache:
     incremental against device-resident state.
     """
 
-    def __init__(self, max_device_entries: int = 64):
+    def __init__(self, max_device_entries: int = 64, max_class_rows: int = 4096):
         from collections import OrderedDict
 
         self._epoch = None
         self._weight: Optional[float] = None
-        self._rows: Dict[object, Tuple[np.ndarray, np.ndarray]] = {}
+        # LRU: class keys from long-gone jobs must not pin [N]-sized rows
+        # forever on a stable cluster
+        self._rows = OrderedDict()
+        self._max_rows = max_class_rows
         # (class_keys tuple, mask [C,N], score [C,N])
         self._assembled: Optional[Tuple[tuple, np.ndarray, np.ndarray]] = None
         # (dims tuple, allocatable [N,R], max_tasks [N], valid [N], names)
@@ -196,6 +199,9 @@ class SnapshotCache:
             self._rows.clear()
             self._assembled = None
             self._node_static = None
+            # all host arrays are about to be rebuilt with new identities;
+            # dead device uploads must not stay pinned through the roll
+            self._dev.clear()
             self._epoch = epoch
             self._weight = weight
 
@@ -425,6 +431,9 @@ def build_tensor_snapshot(
                     )
             if cache is not None:
                 rows[key] = (class_mask[c].copy(), class_score[c].copy())
+                rows.move_to_end(key)
+                while len(rows) > cache._max_rows:
+                    rows.popitem(last=False)
         if not class_examples:
             class_mask[:, : len(nodes)] = True
         if cache is not None:
