@@ -12,6 +12,7 @@
 //
 // Build: g++ -O3 -march=native -shared -fPIC -fopenmp solver.cc -o libvtsolver.so
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
@@ -64,9 +65,32 @@ static inline float dominant_share(const float* alloc, const float* denom,
   return s;
 }
 
-// Predicate + score for one (task, node) pair; returns false when the node
-// is infeasible. Shared by the OpenMP and serial loops so the fit/scoring
-// logic exists exactly once (parity with kernels._score_nodes).
+// least-requested + balanced-resource score for one node (parity with
+// kernels._score_nodes) — the ONE copy both the allocate and victim paths
+// use, so a nodeorder formula change can never split them.
+static inline float node_base_score(int n, int R, const float* req,
+                                    const float* used, const float* node_alloc,
+                                    const float* cscore, float w_least,
+                                    float w_balanced) {
+  const float* nal = &node_alloc[(size_t)n * R];
+  const float* nus = &used[(size_t)n * R];
+  float cap_cpu = nal[0], cap_mem = nal[1];
+  float ucpu = nus[0] + req[0], umem = nus[1] + req[1];
+  float least = 0.0f;
+  if (cap_cpu > 0)
+    least += (cap_cpu - ucpu > 0 ? cap_cpu - ucpu : 0) * 10.0f / cap_cpu;
+  if (cap_mem > 0)
+    least += (cap_mem - umem > 0 ? cap_mem - umem : 0) * 10.0f / cap_mem;
+  least *= 0.5f;
+  float cf = safe_share(ucpu, cap_cpu), mf = safe_share(umem, cap_mem);
+  float balanced = (cap_cpu > 0 && cap_mem > 0 && cf < 1.0f && mf < 1.0f)
+                       ? 10.0f - std::fabs(cf - mf) * 10.0f
+                       : 0.0f;
+  return w_least * least + w_balanced * balanced + cscore[n];
+}
+
+// Predicate + fit + score for one (task, node) pair; returns false when the
+// node is infeasible. Shared by the OpenMP and serial allocate loops.
 static inline bool eval_node(int n, int R, const float* req, const float* idle,
                              const float* releasing, const float* used,
                              const float* node_alloc,
@@ -83,21 +107,8 @@ static inline bool eval_node(int n, int R, const float* req, const float* idle,
   bool fit_i = less_equal(req, nid, eps, R);
   bool fit_r = less_equal(req, nrel, eps, R);
   if (!fit_i && !fit_r) return false;
-  const float* nal = &node_alloc[(size_t)n * R];
-  const float* nus = &used[(size_t)n * R];
-  float cap_cpu = nal[0], cap_mem = nal[1];
-  float ucpu = nus[0] + req[0], umem = nus[1] + req[1];
-  float least = 0.0f;
-  if (cap_cpu > 0)
-    least += (cap_cpu - ucpu > 0 ? cap_cpu - ucpu : 0) * 10.0f / cap_cpu;
-  if (cap_mem > 0)
-    least += (cap_mem - umem > 0 ? cap_mem - umem : 0) * 10.0f / cap_mem;
-  least *= 0.5f;
-  float cf = safe_share(ucpu, cap_cpu), mf = safe_share(umem, cap_mem);
-  float balanced = (cap_cpu > 0 && cap_mem > 0 && cf < 1.0f && mf < 1.0f)
-                       ? 10.0f - std::fabs(cf - mf) * 10.0f
-                       : 0.0f;
-  *score_out = w_least * least + w_balanced * balanced + cscore[n];
+  *score_out =
+      node_base_score(n, R, req, used, node_alloc, cscore, w_least, w_balanced);
   return true;
 }
 
@@ -307,6 +318,271 @@ int32_t vt_num_threads(void) {
 #else
   return 1;
 #endif
+}
+
+// ---------------------------------------------------------------------------
+// Victim selection (preempt/reclaim) — the native analogue of
+// victim_kernels.victim_step: candidate vetoes (gang/drf/proportion/
+// conformance), per-node eviction-order prefix cover test, scored node
+// choice, in-place state update. Semantics mirror the JAX kernel (and the
+// host walk of preempt.go:176-243 / reclaim.go:115-180) exactly, including
+// the ``clean`` contract: when the host walk would strand evictions on a
+// non-covering node visited before the chosen one, no state is touched and
+// clean=0 tells the driver to replay through the host path.
+
+enum VictimMode : int32_t { MODE_QUEUE = 0, MODE_JOB = 1, MODE_RECLAIM = 2 };
+
+struct VictimConfig {
+  int32_t n_victims;   // V (padded rows have run_live=0)
+  int32_t n_nodes;
+  int32_t n_jobs;
+  int32_t n_queues;
+  int32_t n_dims;
+  int32_t mode;        // VictimMode
+  int32_t use_gang;
+  int32_t use_drf;
+  int32_t use_prop;
+  int32_t use_conformance;
+  int32_t order_by_priority;
+  int32_t jt;          // preemptor job row
+  int32_t qt;          // preemptor queue row (-1 = missing)
+  float w_least;
+  float w_balanced;
+};
+
+static const float kShareDelta = 1e-6f;
+
+void vt_victim_step(const VictimConfig* cfg,
+                    // cycle constants
+                    const float* run_req, const int32_t* run_node,
+                    const int32_t* run_job, const int32_t* run_prio,
+                    const int32_t* run_rank, const uint8_t* run_evictable,
+                    const int32_t* job_queue, const int32_t* job_min,
+                    const float* node_alloc, const int32_t* node_max_tasks,
+                    const uint8_t* node_valid, const uint8_t* class_mask_row,
+                    const float* class_score_row, const float* queue_deserved,
+                    const float* total, const float* eps, const float* t_req,
+                    // mutable state (updated in place on clean assignment)
+                    // (no idle: evictions keep idle — Running->Releasing
+                    // nets zero — so the victim path never touches it)
+                    uint8_t* run_live, float* releasing,
+                    float* used, int32_t* task_count, float* job_alloc,
+                    int32_t* job_occupied, float* queue_alloc,
+                    // outputs
+                    int32_t* out_assigned, int32_t* out_node,
+                    int32_t* out_clean, uint8_t* out_vmask) {
+  const int V = cfg->n_victims, N = cfg->n_nodes, Q = cfg->n_queues,
+            R = cfg->n_dims;
+  const int jt = cfg->jt, qt = cfg->qt;
+
+  std::vector<uint8_t> base(V, 0), cand(V, 0);
+  for (int v = 0; v < V; ++v) {
+    if (!run_live[v]) continue;
+    int rq = job_queue[run_job[v]];
+    bool in;
+    switch (cfg->mode) {
+      case MODE_QUEUE:  in = (rq == qt) && (run_job[v] != jt); break;
+      case MODE_JOB:    in = run_job[v] == jt; break;
+      default:          in = rq != qt; break;  // reclaim: other queues
+    }
+    base[v] = in;
+    if (!in) continue;
+    bool ok = true;
+    if (cfg->use_conformance && !run_evictable[v]) ok = false;
+    if (ok && cfg->use_gang) {
+      int occ = job_occupied[run_job[v]], vmin = job_min[run_job[v]];
+      if (!(vmin <= occ - 1 || vmin == 1)) ok = false;
+    }
+    cand[v] = ok;
+  }
+
+  // drf veto: hypothetical transfer over ALL base rows in (node, job, uid)
+  // order — the subtraction runs whether or not another plugin vetoes the
+  // row (drf.go:86-117 subtracts before testing)
+  if (cfg->use_drf) {
+    std::vector<float> lvec(R);
+    for (int r = 0; r < R; ++r) lvec[r] = job_alloc[(size_t)jt * R + r] + t_req[r];
+    float ls = dominant_share(lvec.data(), total, R);
+    std::vector<int32_t> rows;
+    rows.reserve(V);
+    for (int v = 0; v < V; ++v)
+      if (base[v]) rows.push_back(v);
+    std::sort(rows.begin(), rows.end(), [&](int a, int b) {
+      if (run_node[a] != run_node[b]) return run_node[a] < run_node[b];
+      if (run_job[a] != run_job[b]) return run_job[a] < run_job[b];
+      return a < b;
+    });
+    std::vector<float> sub(R), after(R);
+    int seg_node = -1, seg_job = -1;
+    for (int32_t v : rows) {
+      if (run_node[v] != seg_node || run_job[v] != seg_job) {
+        seg_node = run_node[v];
+        seg_job = run_job[v];
+        std::fill(sub.begin(), sub.end(), 0.0f);
+      }
+      for (int r = 0; r < R; ++r) sub[r] += run_req[(size_t)v * R + r];
+      for (int r = 0; r < R; ++r)
+        after[r] = job_alloc[(size_t)run_job[v] * R + r] - sub[r];
+      float rs = dominant_share(after.data(), total, R);
+      if (!(ls < rs || std::fabs(ls - rs) <= kShareDelta)) cand[v] = 0;
+    }
+  }
+
+  // proportion veto: per (node, queue) hypothetical against deserved;
+  // queueless rows neither subtract nor admit (reclaimableFn attr-None skip)
+  if (cfg->use_prop) {
+    std::vector<int32_t> rows;
+    rows.reserve(V);
+    for (int v = 0; v < V; ++v)
+      if (base[v]) rows.push_back(v);
+    auto qof = [&](int v) {
+      int q = job_queue[run_job[v]];
+      return q < 0 ? -1 : (q >= Q ? Q - 1 : q);
+    };
+    std::sort(rows.begin(), rows.end(), [&](int a, int b) {
+      if (run_node[a] != run_node[b]) return run_node[a] < run_node[b];
+      int qa = qof(a) < 0 ? 0 : qof(a), qb = qof(b) < 0 ? 0 : qof(b);
+      if (qa != qb) return qa < qb;
+      return a < b;
+    });
+    std::vector<float> sub(R), after(R);
+    int seg_node = -1, seg_q = -2;
+    for (int32_t v : rows) {
+      int q = qof(v);
+      int qkey = q < 0 ? 0 : q;
+      if (run_node[v] != seg_node || qkey != seg_q) {
+        seg_node = run_node[v];
+        seg_q = qkey;
+        std::fill(sub.begin(), sub.end(), 0.0f);
+      }
+      if (q < 0) {
+        cand[v] = 0;  // queueless: never admitted, no subtraction
+        continue;
+      }
+      for (int r = 0; r < R; ++r) sub[r] += run_req[(size_t)v * R + r];
+      for (int r = 0; r < R; ++r)
+        after[r] = queue_alloc[(size_t)q * R + r] - sub[r];
+      if (!less_equal(&queue_deserved[(size_t)q * R], after.data(), eps, R))
+        cand[v] = 0;
+    }
+  }
+
+  // eviction order within each node: preempt drains the reversed
+  // TaskOrderFn queue = (priority asc, uid-rank desc); reclaim evicts in
+  // candidate (insertion/uid) order
+  std::vector<int32_t> crows;
+  crows.reserve(V);
+  for (int v = 0; v < V; ++v)
+    if (cand[v]) crows.push_back(v);
+  if (cfg->mode == MODE_RECLAIM) {
+    std::sort(crows.begin(), crows.end(), [&](int a, int b) {
+      if (run_node[a] != run_node[b]) return run_node[a] < run_node[b];
+      return a < b;
+    });
+  } else {
+    const bool by_prio = cfg->order_by_priority;
+    std::sort(crows.begin(), crows.end(), [&](int a, int b) {
+      if (run_node[a] != run_node[b]) return run_node[a] < run_node[b];
+      if (by_prio && run_prio[a] != run_prio[b])
+        return run_prio[a] < run_prio[b];
+      return run_rank[a] > run_rank[b];
+    });
+  }
+
+  // per-node exclusive prefix cover test + totals
+  std::vector<uint8_t> in_prefix(V, 0);
+  std::vector<float> node_tot((size_t)N * R, 0.0f);
+  std::vector<uint8_t> any_adm(N, 0);
+  {
+    std::vector<float> prefix(R);
+    int seg_node = -1;
+    for (int32_t v : crows) {
+      int n = run_node[v];
+      if (n < 0 || n >= N) continue;
+      if (n != seg_node) {
+        seg_node = n;
+        std::fill(prefix.begin(), prefix.end(), 0.0f);
+      }
+      any_adm[n] = 1;
+      // evict while the exclusive prefix does not yet cover the request
+      if (!less_equal(t_req, prefix.data(), eps, R)) in_prefix[v] = 1;
+      for (int r = 0; r < R; ++r) {
+        prefix[r] += run_req[(size_t)v * R + r];
+        node_tot[(size_t)n * R + r] += run_req[(size_t)v * R + r];
+      }
+    }
+  }
+
+  // node eligibility + walk order (preempt: best score first, stable;
+  // reclaim: snapshot order) — first covered position wins
+  int first_cov_node = -1, first_valid_node = -1;
+  bool any_valid = false;
+  {
+    std::vector<int32_t> walk(N);
+    for (int n = 0; n < N; ++n) walk[n] = n;
+    std::vector<float> score(N);
+    if (cfg->mode != MODE_RECLAIM) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+      for (int n = 0; n < N; ++n)
+        score[n] = node_base_score(n, R, t_req, used, node_alloc,
+                                   class_score_row, cfg->w_least,
+                                   cfg->w_balanced);
+      std::stable_sort(walk.begin(), walk.end(),
+                       [&](int a, int b) { return score[a] > score[b]; });
+    }
+    for (int idx = 0; idx < N; ++idx) {
+      int n = walk[idx];
+      if (!node_valid[n] || !class_mask_row[n]) continue;
+      if (task_count[n] + 1 > node_max_tasks[n]) continue;
+      if (!any_adm[n]) continue;
+      // validateVictims: skip only when strictly below in EVERY dim
+      bool all_below = true;
+      for (int r = 0; r < R; ++r)
+        if (!(node_tot[(size_t)n * R + r] < t_req[r])) { all_below = false; break; }
+      if (all_below) continue;
+      any_valid = true;
+      if (first_valid_node < 0) first_valid_node = n;
+      if (less_equal(t_req, &node_tot[(size_t)n * R], eps, R)) {
+        first_cov_node = n;
+        break;
+      }
+    }
+  }
+
+  const bool assigned = first_cov_node >= 0;
+  const bool clean = assigned ? (first_valid_node == first_cov_node)
+                              : !any_valid;
+  *out_assigned = assigned ? 1 : 0;
+  *out_node = assigned ? first_cov_node : 0;
+  *out_clean = clean ? 1 : 0;
+  std::memset(out_vmask, 0, V);
+  if (!clean || !assigned) return;
+
+  const int n = first_cov_node;
+  for (int32_t v : crows) {
+    if (run_node[v] != n || !in_prefix[v]) continue;
+    out_vmask[v] = 1;
+    run_live[v] = 0;
+    const float* vreq = &run_req[(size_t)v * R];
+    // evict keeps idle (Running->Releasing nets zero); frees releasing
+    for (int r = 0; r < R; ++r) releasing[(size_t)n * R + r] += vreq[r];
+    for (int r = 0; r < R; ++r) job_alloc[(size_t)run_job[v] * R + r] -= vreq[r];
+    job_occupied[run_job[v]] -= 1;
+    int q = job_queue[run_job[v]];
+    if (q >= 0 && q < Q)
+      for (int r = 0; r < R; ++r) queue_alloc[(size_t)q * R + r] -= vreq[r];
+  }
+  // pipeline the preemptor onto the chosen node
+  for (int r = 0; r < R; ++r) {
+    releasing[(size_t)n * R + r] -= t_req[r];
+    used[(size_t)n * R + r] += t_req[r];
+  }
+  task_count[n] += 1;
+  for (int r = 0; r < R; ++r) job_alloc[(size_t)jt * R + r] += t_req[r];
+  if (qt >= 0 && qt < Q)
+    for (int r = 0; r < R; ++r) queue_alloc[(size_t)qt * R + r] += t_req[r];
 }
 
 }  // extern "C"

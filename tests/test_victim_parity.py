@@ -25,8 +25,12 @@ from helpers import (
 
 
 def run_both(make_store_fn, actions):
+    """Run host/tpu/native backends; assert tpu AND native match host and
+    return (host, tpu) for the per-test shape assertions. When the native
+    library is unavailable its backend falls back to the host path, so the
+    comparison stays meaningful either way."""
     logs = {}
-    for backend in ("host", "tpu"):
+    for backend in ("host", "tpu", "native"):
         store = make_store_fn()
         conf = default_conf(backend=backend)
         conf.actions = list(actions)
@@ -36,6 +40,7 @@ def run_both(make_store_fn, actions):
         sched.cache.evictor = evictor
         sched.run_once()
         logs[backend] = (dict(binder.binds), sorted(evictor.evicts))
+    assert logs["native"] == logs["host"], "native backend diverged from host"
     return logs["host"], logs["tpu"]
 
 
@@ -178,6 +183,7 @@ def test_preempt_parity_conformance_protects_critical():
 
     host, tpu = run("host"), run("tpu")
     assert host == tpu
+    assert run("native") == host
     # the 2-cpu preemptor needs both pods; the critical one is protected,
     # so the single admissible victim cannot cover -> nothing evicts
     assert tpu == []
@@ -211,7 +217,7 @@ def test_reclaim_parity_same_tier_gang_proportion_intersection():
         )
 
     results = {}
-    for backend in ("host", "tpu"):
+    for backend in ("host", "tpu", "native"):
         store = build()
         conf = SchedulerConf(
             actions=["reclaim"],
@@ -224,6 +230,7 @@ def test_reclaim_parity_same_tier_gang_proportion_intersection():
         sched.run_once()
         results[backend] = sorted(evictor.evicts)
     assert results["host"] == results["tpu"]
+    assert results["native"] == results["host"]
 
 
 @pytest.mark.parametrize("seed", list(range(8)))
@@ -282,7 +289,7 @@ def test_victim_parity_random_clusters(seed):
     # freeze the generated cluster: build once, snapshot the RNG state by
     # rebuilding from the same seed for each backend
     states = []
-    for backend in ("host", "tpu"):
+    for backend in ("host", "tpu", "native"):
         rng = np.random.default_rng(seed)
         store = build()
         conf = default_conf(backend=backend)
@@ -294,3 +301,4 @@ def test_victim_parity_random_clusters(seed):
         sched.run_once()
         states.append((dict(binder.binds), sorted(evictor.evicts)))
     assert states[0] == states[1]
+    assert states[2] == states[0], "native backend diverged from host"

@@ -106,6 +106,7 @@ def load() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(_LIB)
             lib.vt_allocate_solve.restype = None
+            lib.vt_victim_step.restype = None
             lib.vt_num_threads.restype = ctypes.c_int32
         except (OSError, AttributeError) as e:
             # corrupt .so, wrong arch, or stale symbols from older source:
@@ -169,6 +170,128 @@ def water_fill_np(weight, request, total, eps, participates) -> np.ndarray:
         if np.all(remaining < eps):
             break
     return deserved.astype(np.float32)
+
+
+class VictimConfig(ctypes.Structure):
+    _fields_ = [
+        ("n_victims", ctypes.c_int32),
+        ("n_nodes", ctypes.c_int32),
+        ("n_jobs", ctypes.c_int32),
+        ("n_queues", ctypes.c_int32),
+        ("n_dims", ctypes.c_int32),
+        ("mode", ctypes.c_int32),
+        ("use_gang", ctypes.c_int32),
+        ("use_drf", ctypes.c_int32),
+        ("use_prop", ctypes.c_int32),
+        ("use_conformance", ctypes.c_int32),
+        ("order_by_priority", ctypes.c_int32),
+        ("jt", ctypes.c_int32),
+        ("qt", ctypes.c_int32),
+        ("w_least", ctypes.c_float),
+        ("w_balanced", ctypes.c_float),
+    ]
+
+
+_VICTIM_MODES = {"queue": 0, "job": 1, "reclaim": 2}
+
+
+def victim_consts_state(snap, deserved, w_least, w_balanced):
+    """(consts, state) numpy dicts for ``victim_step`` — the native twin of
+    TensorBackend.victim_arrays. ``state`` arrays are mutated in place by
+    clean assignments; checkpoint/restore is a dict-of-copies."""
+    consts = dict(
+        run_req=_f32(snap.run_req),
+        run_node=_i32(snap.run_node),
+        run_job=_i32(snap.run_job),
+        run_prio=_i32(snap.run_prio),
+        run_rank=_i32(snap.run_rank),
+        run_evictable=_u8(snap.run_evictable),
+        job_queue=_i32(snap.job_queue),
+        job_min=_i32(snap.job_min_available),
+        node_alloc=_f32(snap.node_alloc),
+        node_max_tasks=_i32(snap.node_max_tasks),
+        node_valid=_u8(snap.node_valid),
+        class_mask=_u8(snap.class_node_mask),
+        class_score=_f32(snap.class_node_score),
+        queue_deserved=_f32(deserved),
+        total=_f32(snap.total),
+        eps=_f32(snap.eps),
+        w_least=float(w_least),
+        w_balanced=float(w_balanced),
+    )
+    # no idle row: evictions keep idle (Running->Releasing nets zero), so
+    # the native victim path never reads or writes it
+    state = dict(
+        run_live=_u8(snap.run_valid.copy()),
+        releasing=_f32(snap.node_releasing.copy()),
+        used=_f32(snap.node_used.copy()),
+        task_count=_i32(snap.node_task_count.copy()),
+        job_alloc=_f32(snap.job_alloc_init.copy()),
+        job_occupied=_i32(snap.job_ready_init.copy()),
+        queue_alloc=_f32(snap.queue_alloc_init.copy()),
+    )
+    return consts, state
+
+
+def victim_step(
+    consts, state, t_req, t_cls, jt, qt,
+    mode="queue", use_gang=True, use_drf=False, use_prop=False,
+    use_conformance=False, order_by_priority=True,
+):
+    """One preemptor's native victim solve (mirrors
+    victim_kernels.victim_step). Returns (assigned, node_index, vmask,
+    clean); ``state`` is advanced in place ONLY on a clean assignment.
+    Raises RuntimeError when the native library is unavailable."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(build_error() or "native solver unavailable")
+
+    V = consts["run_req"].shape[0]
+    N = consts["node_alloc"].shape[0]
+    J = consts["job_queue"].shape[0]
+    Q = consts["queue_deserved"].shape[0]
+    R = consts["run_req"].shape[1]
+    cfg = VictimConfig(
+        n_victims=V, n_nodes=N, n_jobs=J, n_queues=Q, n_dims=R,
+        mode=_VICTIM_MODES[mode],
+        use_gang=int(use_gang), use_drf=int(use_drf), use_prop=int(use_prop),
+        use_conformance=int(use_conformance),
+        order_by_priority=int(order_by_priority),
+        jt=int(jt), qt=int(qt),
+        w_least=consts["w_least"], w_balanced=consts["w_balanced"],
+    )
+    t_req = _f32(t_req)
+    cls_mask_row = _u8(consts["class_mask"][int(t_cls)])
+    cls_score_row = _f32(consts["class_score"][int(t_cls)])
+
+    out_assigned = ctypes.c_int32(0)
+    out_node = ctypes.c_int32(0)
+    out_clean = ctypes.c_int32(0)
+    vmask = np.zeros((V,), np.uint8)
+
+    lib.vt_victim_step(
+        ctypes.byref(cfg),
+        _ptr(consts["run_req"]), _ptr(consts["run_node"]),
+        _ptr(consts["run_job"]), _ptr(consts["run_prio"]),
+        _ptr(consts["run_rank"]), _ptr(consts["run_evictable"]),
+        _ptr(consts["job_queue"]), _ptr(consts["job_min"]),
+        _ptr(consts["node_alloc"]), _ptr(consts["node_max_tasks"]),
+        _ptr(consts["node_valid"]), _ptr(cls_mask_row), _ptr(cls_score_row),
+        _ptr(consts["queue_deserved"]), _ptr(consts["total"]),
+        _ptr(consts["eps"]), _ptr(t_req),
+        _ptr(state["run_live"]),
+        _ptr(state["releasing"]), _ptr(state["used"]),
+        _ptr(state["task_count"]), _ptr(state["job_alloc"]),
+        _ptr(state["job_occupied"]), _ptr(state["queue_alloc"]),
+        ctypes.byref(out_assigned), ctypes.byref(out_node),
+        ctypes.byref(out_clean), _ptr(vmask),
+    )
+    return (
+        bool(out_assigned.value),
+        int(out_node.value),
+        vmask.astype(bool),
+        bool(out_clean.value),
+    )
 
 
 def allocate_solve(

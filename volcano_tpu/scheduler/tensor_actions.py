@@ -30,8 +30,11 @@ def _victim_path_usable(ssn, backend):
 
     if backend is None or not backend.supported:
         return False
-    if backend.flavor != "tpu":
-        return False  # native victim solver not yet implemented
+    if backend.flavor == "native":
+        from volcano_tpu import native as native_solver
+
+        if native_solver.load() is None:
+            return False  # library unavailable: host path
     snap = backend.snapshot()
     if snap.has_dynamic_predicates:
         return False
@@ -56,11 +59,13 @@ class _VictimDriver:
     victim math runs on device."""
 
     def __init__(self, ssn, backend, veto_set, use_drf, use_prop):
-        import jax.numpy as jnp
-
         self.ssn = ssn
         self.backend = backend
-        self.jnp = jnp
+        self.native = backend.flavor == "native"
+        if not self.native:
+            import jax.numpy as jnp
+
+            self.jnp = jnp
         self.kw = dict(
             use_gang="gang" in veto_set,
             use_drf=use_drf and "drf" in veto_set,
@@ -72,7 +77,15 @@ class _VictimDriver:
 
     def _load(self):
         self.snap = self.backend.snapshot()
-        self.consts, self.state = self.backend.victim_arrays()
+        if self.native:
+            from volcano_tpu import native as native_solver
+
+            w_least, w_bal = self.backend.score_weights()
+            self.consts, self.state = native_solver.victim_consts_state(
+                self.snap, np.asarray(self.backend.deserved()), w_least, w_bal
+            )
+        else:
+            self.consts, self.state = self.backend.victim_arrays()
         self.task_row = {uid: i for i, uid in enumerate(self.snap.task_uids)}
         self.job_row = {uid: i for i, uid in enumerate(self.snap.job_uids)}
         self.queue_row = {name: i for i, name in enumerate(self.snap.queue_names)}
@@ -84,12 +97,23 @@ class _VictimDriver:
         self._load()
 
     def checkpoint(self):
-        return (self.snap, self.consts, self.state, self.task_row,
+        # JAX state tuples are immutable (functional updates) — reference
+        # capture suffices; the native tier mutates numpy arrays in place,
+        # so the checkpoint must deep-copy them
+        state = (
+            {k: v.copy() for k, v in self.state.items()}
+            if self.native else self.state
+        )
+        return (self.snap, self.consts, state, self.task_row,
                 self.job_row, self.queue_row)
 
     def restore(self, ckpt):
-        (self.snap, self.consts, self.state, self.task_row,
+        (self.snap, self.consts, state, self.task_row,
          self.job_row, self.queue_row) = ckpt
+        # re-copy so a second restore of the same checkpoint stays pristine
+        self.state = (
+            {k: v.copy() for k, v in state.items()} if self.native else state
+        )
 
     def attempt(self, task, mode):
         """Solve one preemptor. Returns (assigned, node_name, victims,
@@ -97,25 +121,40 @@ class _VictimDriver:
         replay is the caller's job. ``clean=False`` means the host walk
         would strand evictions on non-covering nodes — state is untouched
         and the caller must take the host fallback, then resync."""
-        from volcano_tpu.scheduler.victim_kernels import victim_step
-
         t = self.task_row[task.uid]
         snap = self.snap
-        out_state, assigned, nstar, vmask, clean = victim_step(
-            self.consts,
-            self.state,
-            self.jnp.asarray(snap.task_req[t]),
-            int(snap.task_class[t]),
-            self.job_row[task.job_uid],
-            self.queue_row.get(self.ssn.jobs[task.job_uid].queue, -1),
-            mode=mode,
-            **self.kw,
-        )
-        if not bool(clean):
-            return False, "", [], False
-        if not bool(assigned):
-            return False, "", [], True
-        self.state = out_state
+        jt = self.job_row[task.job_uid]
+        qt = self.queue_row.get(self.ssn.jobs[task.job_uid].queue, -1)
+        if self.native:
+            from volcano_tpu import native as native_solver
+
+            # state advances in place only on a clean assignment
+            assigned, nstar, vmask, clean = native_solver.victim_step(
+                self.consts, self.state, snap.task_req[t],
+                int(snap.task_class[t]), jt, qt, mode=mode, **self.kw,
+            )
+            if not clean:
+                return False, "", [], False
+            if not assigned:
+                return False, "", [], True
+        else:
+            from volcano_tpu.scheduler.victim_kernels import victim_step
+
+            out_state, assigned, nstar, vmask, clean = victim_step(
+                self.consts,
+                self.state,
+                self.jnp.asarray(snap.task_req[t]),
+                int(snap.task_class[t]),
+                jt,
+                qt,
+                mode=mode,
+                **self.kw,
+            )
+            if not bool(clean):
+                return False, "", [], False
+            if not bool(assigned):
+                return False, "", [], True
+            self.state = out_state
         vidx = np.nonzero(np.asarray(vmask))[0]
         if mode == "reclaim":
             # reclaim evicts in candidate (insertion) order — reclaim.go:154
