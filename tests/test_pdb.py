@@ -1,0 +1,42 @@
+"""PodDisruptionBudget shadow gangs (reference setPDB,
+KB/pkg/scheduler/cache/event_handlers.go:494-510): plain controller-owned
+pods grouped into one shadow job whose MinAvailable comes from the budget.
+"""
+
+from volcano_tpu.api.objects import Metadata
+from volcano_tpu.api.resource import Resource
+
+def test_pdb_gangs_plain_pods():
+    """A PodDisruptionBudget groups its controller's plain pods into one
+    shadow job with MinAvailable from the budget (reference setPDB,
+    KB cache/event_handlers.go:494-510): when the gang can't fully fit,
+    nothing binds; without the budget, whatever fits binds."""
+    from volcano_tpu.api.objects import Pod, PodDisruptionBudget, PodSpec as PS
+    from volcano_tpu.sim import Cluster
+
+    def run(with_pdb):
+        c = Cluster(with_controller=False)
+        c.add_queue("default", weight=1)
+        c.add_node("n0", {"cpu": "2", "memory": "4Gi", "pods": 110})
+        if with_pdb:
+            c.store.create(
+                "PodDisruptionBudget",
+                PodDisruptionBudget(
+                    meta=Metadata(name="budget", namespace="d",
+                                  owner=("ReplicaSet", "rs-a")),
+                    min_available=3,
+                ),
+            )
+        for i in range(3):  # 3 x 1cpu pods, only 2 cpu available
+            c.store.create(
+                "Pod",
+                Pod(meta=Metadata(name=f"p{i}", namespace="d",
+                                  owner=("ReplicaSet", "rs-a")),
+                    spec=PS(resources=Resource.from_resource_list(
+                        {"cpu": "1", "memory": "1Gi"}))),
+            )
+        c.scheduler.run_once()
+        return [p for p in c.store.list("Pod") if p.node_name]
+
+    assert len(run(with_pdb=False)) == 2   # plain pods bind individually
+    assert len(run(with_pdb=True)) == 0    # gang of 3 can't fit -> nothing

@@ -318,3 +318,4 @@ def test_classless_static_class_survives_binding_last_pv(cluster):
     # no second PV may appear; the job waits for a pre-created volume
     assert cluster.store.get("Job", "test/two").status.state.phase != JobPhase.RUNNING
     assert len(cluster.store.list("PV")) == 1
+

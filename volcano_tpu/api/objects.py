@@ -271,6 +271,17 @@ class Service:
 
 
 @dataclass
+class PodDisruptionBudget:
+    """Gang grouping for plain controller-owned pods (reference: PDB
+    informer + SetPDB, KB/pkg/scheduler/cache/event_handlers.go:494-510,
+    api/job_info.go:194-202): pods sharing the PDB's controlling owner
+    form one shadow job whose MinAvailable comes from the budget."""
+
+    meta: Metadata  # meta.owner = the controlling object, shared with pods
+    min_available: int = 1
+
+
+@dataclass
 class PersistentVolumeClaim:
     """Volume claim created for Job.spec.volumes entries.
 

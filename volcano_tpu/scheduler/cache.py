@@ -382,6 +382,24 @@ class SchedulerCache:
             )
             cluster.jobs[ji.uid] = ji
 
+        # PDB pass BEFORE pods: a budget creates (or will configure) the
+        # shadow job for its controller's pods — setPDB semantics
+        # (event_handlers.go:494-510): MinAvailable from the budget, name
+        # from the PDB, default queue
+        for pdb in self.store.items("PodDisruptionBudget"):
+            if pdb.meta.owner is None:
+                continue  # "controller of PodDisruptionBudget is empty"
+            uid = f"shadow/{pdb.meta.namespace}/{pdb.meta.owner[1]}"
+            if uid not in cluster.jobs:
+                shadow = JobInfo(uid, None)
+                shadow.namespace = pdb.meta.namespace
+                shadow.queue = self.default_queue
+                shadow.creation_order = order
+                order += 1
+                cluster.jobs[uid] = shadow
+            cluster.jobs[uid].name = pdb.meta.name
+            cluster.jobs[uid].min_available = pdb.min_available
+
         for pod in self.store.items("Pod"):
             if pod.spec.scheduler_name != self.scheduler_name:
                 continue

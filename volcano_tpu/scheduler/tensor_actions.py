@@ -133,10 +133,7 @@ class _VictimDriver:
                 self.consts, self.state, snap.task_req[t],
                 int(snap.task_class[t]), jt, qt, mode=mode, **self.kw,
             )
-            if not clean:
-                return False, "", [], False
-            if not assigned:
-                return False, "", [], True
+            out_state = self.state
         else:
             from volcano_tpu.scheduler.victim_kernels import victim_step
 
@@ -150,11 +147,11 @@ class _VictimDriver:
                 mode=mode,
                 **self.kw,
             )
-            if not bool(clean):
-                return False, "", [], False
-            if not bool(assigned):
-                return False, "", [], True
-            self.state = out_state
+        if not bool(clean):
+            return False, "", [], False
+        if not bool(assigned):
+            return False, "", [], True
+        self.state = out_state
         vidx = np.nonzero(np.asarray(vmask))[0]
         if mode == "reclaim":
             # reclaim evicts in candidate (insertion) order — reclaim.go:154

@@ -263,6 +263,10 @@ def victim_step(
             - jax.ops.segment_sum(
                 vreq, jnp.where(has_q, run_q, Q), num_segments=Q + 1
             )[:Q]
-        ).at[jnp.clip(qt, 0, Q - 1)].add(t_add),
+        # qt = -1 (queue missing) must not credit queue 0 — the native twin
+        # skips the update for qt < 0 and the two must agree
+        ).at[jnp.clip(qt, 0, Q - 1)].add(
+            jnp.where(qt >= 0, t_add, jnp.zeros_like(t_add))
+        ),
     )
     return new_state, assigned, nstar, vmask, clean

@@ -26,6 +26,7 @@ from volcano_tpu.api.objects import (
     Node,
     PersistentVolume,
     PersistentVolumeClaim,
+    PodDisruptionBudget,
     StorageClass,
     Pod,
     PodGroup,
@@ -51,6 +52,7 @@ KIND_CLASSES: Dict[str, type] = {
     "PVC": PersistentVolumeClaim,
     "PV": PersistentVolume,
     "StorageClass": StorageClass,
+    "PodDisruptionBudget": PodDisruptionBudget,
     "Lease": Lease,
     "Event": ClusterEvent,
 }
