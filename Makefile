@@ -26,6 +26,7 @@ examples:
 	$(PY) examples/job_gang.py
 	$(PY) examples/mpi_hello.py
 	$(PY) examples/tensorflow_benchmark.py
+	$(PY) examples/job_with_volumes.py
 
 clean:
 	rm -f native/libvtsolver.so

@@ -6,6 +6,7 @@ cmd/kube-batch/app/server.go (loop @ schedule-period).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -32,6 +33,8 @@ class Scheduler:
             store, scheduler_name=scheduler_name, default_queue=default_queue
         )
         self.elector = elector
+        self._profile_cycle = 0
+        self._profile_warned = False
         # cross-cycle incremental snapshot state (class masks, node-static
         # arrays, device uploads) — survives sessions, invalidated by node
         # epoch changes
@@ -48,6 +51,39 @@ class Scheduler:
     def run_once(self) -> None:
         if self.elector is not None and not self.elector.try_acquire():
             return  # standby replica: only the lease holder schedules
+        profile_dir = os.environ.get("VOLCANO_TPU_PROFILE")
+        if profile_dir:
+            # device-level tracing around the whole cycle (SURVEY §5: the
+            # new build's analogue of the reference's glog V-level tracing
+            # is the JAX profiler + per-action wall-clock metrics). View
+            # with tensorboard/xprof pointed at the directory.
+            try:
+                import jax
+            except ImportError:
+                # host-backend deployments may not ship jax; schedule
+                # untraced rather than dying every cycle, and say so once
+                if not self._profile_warned:
+                    self._profile_warned = True
+                    import logging
+
+                    logging.getLogger("volcano_tpu.scheduler").warning(
+                        "VOLCANO_TPU_PROFILE set but jax is unavailable; "
+                        "cycles run untraced"
+                    )
+            else:
+                # jax's trace dirs are second-granularity timestamps, so
+                # same-second cycles would clobber each other — give every
+                # cycle its own subdirectory
+                cycle_dir = os.path.join(
+                    profile_dir, f"cycle-{self._profile_cycle:06d}"
+                )
+                self._profile_cycle += 1
+                with jax.profiler.trace(cycle_dir):
+                    self._run_once_inner()
+                return
+        self._run_once_inner()
+
+    def _run_once_inner(self) -> None:
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers)
 
