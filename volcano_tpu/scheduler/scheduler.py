@@ -52,7 +52,7 @@ class Scheduler:
         if self.elector is not None and not self.elector.try_acquire():
             return  # standby replica: only the lease holder schedules
         profile_dir = os.environ.get("VOLCANO_TPU_PROFILE")
-        if profile_dir:
+        if profile_dir and not self._profile_warned:
             # device-level tracing around the whole cycle (SURVEY §5: the
             # new build's analogue of the reference's glog V-level tracing
             # is the JAX profiler + per-action wall-clock metrics). View
@@ -61,15 +61,16 @@ class Scheduler:
                 import jax
             except ImportError:
                 # host-backend deployments may not ship jax; schedule
-                # untraced rather than dying every cycle, and say so once
-                if not self._profile_warned:
-                    self._profile_warned = True
-                    import logging
+                # untraced rather than dying every cycle. The flag also
+                # short-circuits the (uncached-by-Python) failing import on
+                # every later cycle.
+                self._profile_warned = True
+                import logging
 
-                    logging.getLogger("volcano_tpu.scheduler").warning(
-                        "VOLCANO_TPU_PROFILE set but jax is unavailable; "
-                        "cycles run untraced"
-                    )
+                logging.getLogger("volcano_tpu.scheduler").warning(
+                    "VOLCANO_TPU_PROFILE set but jax is unavailable; "
+                    "cycles run untraced"
+                )
             else:
                 # jax's trace dirs are second-granularity timestamps, so
                 # same-second cycles would clobber each other — give every

@@ -20,6 +20,8 @@ def build_sim_args(
     n_jobs: int,
     n_queues: int = 2,
     seed: int = 0,
+    n_classes: int = 1,
+    class_fill: float = 1.0,
 ):
     """Return the host-side (numpy) kwargs dict for one allocate cycle.
 
@@ -76,6 +78,29 @@ def build_sim_args(
     eps = np.array([10.0, 10 * 1024 * 1024], np.float32)
     total = node_alloc[node_valid].sum(0)
 
+    # predicate classes (BASELINE config 3 shape): tasks of one job share a
+    # class; each class admits a random ``class_fill`` fraction of nodes
+    # (node-affinity-style masks) and carries a static affinity score
+    C = max(n_classes, 1)
+    task_class = np.zeros(T, np.int32)
+    if n_classes > 1:
+        job_class = rng.integers(0, n_classes, n_jobs).astype(np.int32)
+        task_class[:n_tasks] = job_class[task_job[:n_tasks]]
+    if n_classes > 1 or class_fill < 1.0:
+        class_mask = np.zeros((C, N), bool)
+        class_mask[:, :n_nodes] = rng.random((C, n_nodes)) < class_fill
+        # a class that matched no node would make its jobs trivially
+        # unschedulable; rescue with ONE random node so the requested
+        # sparsity is preserved (not flipped to all-True)
+        for c in np.nonzero(~class_mask[:, :n_nodes].any(1))[0]:
+            class_mask[c, rng.integers(0, n_nodes)] = True
+        class_score = np.where(
+            class_mask, rng.random((C, N)).astype(np.float32) * 10.0, 0.0
+        ).astype(np.float32)
+    else:
+        class_mask = np.ones((C, N), bool)
+        class_score = np.zeros((C, N), np.float32)
+
     return dict(
         idle=node_alloc.copy(),
         releasing=np.zeros((N, R), np.float32),
@@ -86,7 +111,7 @@ def build_sim_args(
         node_valid=node_valid,
         task_req=task_req,
         task_job=task_job,
-        task_class=np.zeros(T, np.int32),
+        task_class=task_class,
         task_valid=task_valid,
         job_queue=job_queue,
         job_min=job_min,
@@ -97,8 +122,8 @@ def build_sim_args(
         job_start=job_start,
         job_ntasks=job_ntasks,
         queue_alloc_init=np.zeros((Q, R), np.float32),
-        class_mask=np.ones((1, N), bool),
-        class_score=np.zeros((1, N), np.float32),
+        class_mask=class_mask,
+        class_score=class_score,
         total=total,
         eps=eps,
         queue_weight=queue_weight,
