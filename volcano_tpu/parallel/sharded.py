@@ -115,6 +115,37 @@ def run_cycle_reference(args, w_least=1.0, w_balanced=1.0,
     )
 
 
+#: VictimConsts/VictimState fields shard by the SAME node-axis map as the
+#: cycle args (identical names and shapes); the [V] victim pool replicates
+#: (its sorts and segment sums are global over V and V rows are small next
+#: to [C, N] masks).
+_VICTIM_SPECS = _SPECS
+
+
+def make_sharded_victim_step(mesh: Mesh, consts, state, **static_kw):
+    """(jitted_fn, device_consts, device_state): victim_step compiled with
+    node-axis shardings over the mesh. ``jitted_fn(consts, state, t_req,
+    t_cls, jt, qt)`` runs one preemptor's solve; the returned new state
+    keeps node-shaped rows sharded so chained solves stay distributed."""
+    from volcano_tpu.scheduler.victim_kernels import victim_step
+
+    def shard_tuple(tup):
+        placed = {}
+        for name in tup._fields:
+            spec = _VICTIM_SPECS.get(name, P())
+            placed[name] = jax.device_put(
+                np.asarray(getattr(tup, name)), NamedSharding(mesh, spec)
+            )
+        return type(tup)(**placed)
+
+    dev_consts = shard_tuple(consts)
+    dev_state = shard_tuple(state)
+    # victim_step is already jitted; committed input shardings from the
+    # device_put above drive the SPMD partitioning
+    fn = functools.partial(victim_step, **static_kw)
+    return fn, dev_consts, dev_state
+
+
 def make_sharded_cycle(
     mesh: Mesh,
     args: Dict[str, object],

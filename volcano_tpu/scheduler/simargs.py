@@ -14,6 +14,91 @@ import numpy as np
 from volcano_tpu.scheduler.snapshot import _bucket
 
 
+def build_victim_sim(
+    n_nodes: int,
+    n_victims: int,
+    n_jobs: int,
+    n_queues: int = 2,
+    seed: int = 0,
+    node_cpu: float = 16000.0,
+    node_mem: float = 32.0 * (1 << 30),
+):
+    """(consts_kwargs, state_kwargs) numpy dicts for one victim-selection
+    scenario: ``n_victims`` running tasks spread over ``n_nodes``, with all
+    derived state (used/idle, per-job allocation and occupancy, per-node
+    task counts, per-queue allocation) accumulated consistently. Job row 0
+    is reserved for the preemptor (no residents). Field names match
+    ``VictimConsts`` / ``VictimState`` — construct with ``Consts(**c)``.
+    """
+    rng = np.random.default_rng(seed)
+    R = 2
+    N, V, J, Q = (
+        _bucket(n_nodes),
+        _bucket(n_victims),
+        _bucket(n_jobs, 4),
+        _bucket(n_queues, 4),
+    )
+
+    node_alloc = np.zeros((N, R), np.float32)
+    node_alloc[:n_nodes, 0] = node_cpu
+    node_alloc[:n_nodes, 1] = node_mem
+    run_req = np.zeros((V, R), np.float32)
+    run_req[:n_victims, 0] = rng.choice([250, 500, 1000], n_victims)
+    run_req[:n_victims, 1] = rng.choice([256, 512, 1024], n_victims) * (1 << 20)
+    run_node = np.zeros(V, np.int32)
+    run_node[:n_victims] = rng.integers(0, n_nodes, n_victims)
+    run_job = np.zeros(V, np.int32)
+    run_job[:n_victims] = rng.integers(1, n_jobs, n_victims)  # job 0 = preemptor
+    job_queue = np.zeros(J, np.int32)
+    job_queue[:n_jobs] = rng.integers(0, n_queues, n_jobs)
+    job_queue[0] = 0  # the reserved preemptor job; callers pass qt=0
+
+    live = np.arange(V) < n_victims
+    used = np.zeros((N, R), np.float32)
+    np.add.at(used, run_node[live], run_req[live])
+    job_alloc = np.zeros((J, R), np.float32)
+    np.add.at(job_alloc, run_job[live], run_req[live])
+    occupied = np.zeros(J, np.int32)
+    np.add.at(occupied, run_job[live], 1)
+    task_count = np.zeros(N, np.int32)
+    np.add.at(task_count, run_node[live], 1)
+    queue_alloc = np.zeros((Q, R), np.float32)
+    np.add.at(queue_alloc, job_queue[run_job[live]], run_req[live])
+
+    total = node_alloc[:n_nodes].sum(0).astype(np.float32)
+    consts = dict(
+        run_req=run_req,
+        run_node=run_node,
+        run_job=run_job,
+        run_prio=rng.integers(0, 3, V).astype(np.int32),
+        run_rank=rng.permutation(V).astype(np.int32),
+        run_evictable=np.ones(V, bool),
+        job_queue=job_queue,
+        job_min=np.ones(J, np.int32),
+        node_alloc=node_alloc,
+        node_max_tasks=np.full(N, 2**31 - 1, np.int32),
+        node_valid=(np.arange(N) < n_nodes),
+        class_mask=np.ones((1, N), bool),
+        class_score=np.zeros((1, N), np.float32),
+        queue_deserved=np.full((Q, R), 1e15, np.float32),
+        total=total,
+        eps=np.array([10.0, 10 * 1024 * 1024], np.float32),
+        w_least=np.float32(1.0),
+        w_balanced=np.float32(1.0),
+    )
+    state = dict(
+        run_live=live.copy(),
+        idle=np.maximum(node_alloc - used, 0.0).astype(np.float32),
+        releasing=np.zeros((N, R), np.float32),
+        used=used,
+        task_count=task_count,
+        job_alloc=job_alloc,
+        job_occupied=occupied,
+        queue_alloc=queue_alloc,
+    )
+    return consts, state
+
+
 def build_sim_args(
     n_nodes: int,
     n_tasks: int,
