@@ -158,3 +158,34 @@ def test_cluster_submit_path_enforces_admission():
     c.submit_job(job)
     assert job.spec.queue == "default"
     assert c.store.get("Job", "test/j") is not None
+
+
+def test_update_exemption_limited_to_generated_claim_names():
+    """Filling a previously-empty volume_claim_name is allowed ONLY for the
+    controller's generated name; pointing at someone else's claim is a
+    frozen-spec violation."""
+    import copy
+
+    from volcano_tpu.api.job import Job, JobSpec, TaskSpec, VolumeSpec
+    from volcano_tpu.api.objects import Metadata, PodSpec
+    from volcano_tpu.admission.admit import validate_job_update
+
+    def mk(claim=""):
+        return Job(
+            meta=Metadata(name="j", namespace="d"),
+            spec=JobSpec(
+                min_available=1,
+                tasks=[TaskSpec(name="t", replicas=1, template=PodSpec())],
+                volumes=[VolumeSpec(mount_path="/x", size="1Gi",
+                                    volume_claim_name=claim)],
+            ),
+        )
+
+    old = mk("")
+    ok, _ = validate_job_update(mk("j-pvc-0"), old)   # controller write-back
+    assert ok
+    ok, msg = validate_job_update(mk("victim-pvc-0"), old)  # claim hijack
+    assert not ok and "not allowed" in msg
+    # overwriting an existing name is frozen even if it matches the pattern
+    ok, _ = validate_job_update(mk("j-pvc-0"), mk("other"))
+    assert not ok

@@ -100,6 +100,44 @@ def test_daemon_processes_run_job_end_to_end(tmp_path):
         body = urllib.request.urlopen(metrics_url, timeout=10).read().decode()
         assert "volcano_e2e_scheduling_latency_milliseconds" in body
 
+        # volume binding over the wire: StorageClass/PV/PVC round-trip
+        # through the HTTP store codec, scheduler pins the job to the PV's
+        # node, claim binds
+        from volcano_tpu.api.job import Job, JobSpec, TaskSpec, VolumeSpec
+        from volcano_tpu.api.objects import Metadata, PersistentVolume, PodSpec, StorageClass
+        from volcano_tpu.api.resource import Resource
+        from volcano_tpu.store.client import RemoteStore
+
+        rs = RemoteStore(url)
+        rs.create("StorageClass", StorageClass(
+            meta=Metadata(name="local", namespace=""), provisioner=""))
+        rs.create("PV", PersistentVolume(
+            meta=Metadata(name="disk1", namespace=""), capacity="20Gi",
+            storage_class="local",
+            node_affinity={"kubernetes.io/hostname": "node-1"}))
+        rs.create("Job", Job(
+            meta=Metadata(name="voljob", namespace="default"),
+            spec=JobSpec(
+                min_available=1,
+                tasks=[TaskSpec(name="main", replicas=1,
+                                template=PodSpec(resources=Resource.from_resource_list(
+                                    {"cpu": "1", "memory": "1Gi"})))],
+                volumes=[VolumeSpec(mount_path="/x", size="5Gi",
+                                    storage_class="local")],
+                queue="default",
+            )))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pvc = rs.get("PVC", "default/voljob-pvc-0")
+            if pvc is not None and pvc.phase == "Bound":
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("volume claim never bound over the wire")
+        assert pvc.volume_name == "disk1"
+        vol_pods = [p for p in rs.list("Pod") if "voljob" in p.meta.name]
+        assert vol_pods and all(p.node_name == "node-1" for p in vol_pods)
+
         # admission over the wire: bad job rejected by the server
         out = subprocess.run(
             ENTRY + ["--server", url, "job", "run", "--name", "bad",

@@ -1,0 +1,72 @@
+"""Enqueue action admission gate (reference
+KB/pkg/scheduler/actions/enqueue/enqueue.go:42-128): Pending PodGroups move
+to Inqueue only when cluster idle capacity with the 1.2x overcommit factor
+covers their MinResources; admitted groups consume from the budget within
+the cycle.
+"""
+
+from volcano_tpu.api.objects import Metadata, PodGroup
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import PodGroupPhase, PodPhase
+from volcano_tpu.scheduler.conf import full_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from helpers import build_node, build_pod, build_queue, make_store
+
+
+def mk_pg(name, min_cpu):
+    pg = PodGroup(
+        meta=Metadata(name=name, namespace="default"),
+        min_member=1,
+        queue="default",
+        min_resources=Resource.from_resource_list({"cpu": str(min_cpu)}),
+    )
+    pg.status.phase = PodGroupPhase.PENDING
+    return pg
+
+
+def run_enqueue(podgroups, running_cpu=8):
+    # one 10-cpu node with `running_cpu` already used:
+    # overcommit budget = 10 * 1.2 - running_cpu
+    pods = [
+        build_pod(f"busy-{i}", group="busy", cpu="1",
+                  phase=PodPhase.RUNNING, node_name="n0")
+        for i in range(running_cpu)
+    ]
+    busy = PodGroup(meta=Metadata(name="busy", namespace="default"),
+                    min_member=1, queue="default")
+    busy.status.phase = PodGroupPhase.RUNNING
+    store = make_store(
+        nodes=[build_node("n0", cpu="10", memory="64Gi")],
+        queues=[build_queue("default")],
+        podgroups=[busy, *podgroups],
+        pods=pods,
+    )
+    conf = full_conf()
+    conf.actions = ["enqueue"]
+    Scheduler(store, conf=conf).run_once()
+    return {pg.meta.name: pg.status.phase for pg in store.list("PodGroup")}
+
+
+def test_min_resources_within_overcommit_enqueues():
+    # budget = 10 * 1.2 - 8 = 4 cpu
+    phases = run_enqueue([mk_pg("fits", 4)])
+    assert phases["fits"] == PodGroupPhase.INQUEUE
+
+
+def test_min_resources_beyond_overcommit_stays_pending():
+    phases = run_enqueue([mk_pg("too-big", 5)])
+    assert phases["too-big"] == PodGroupPhase.PENDING
+
+
+def test_admitted_group_consumes_budget():
+    # 3 + 3 fits within the 4-cpu budget only once: first (by creation
+    # order) admits, second waits
+    phases = run_enqueue([mk_pg("first", 3), mk_pg("second", 3)])
+    assert phases["first"] == PodGroupPhase.INQUEUE
+    assert phases["second"] == PodGroupPhase.PENDING
+
+
+def test_empty_min_resources_always_enqueues():
+    phases = run_enqueue([mk_pg("free", 0)], running_cpu=10)
+    assert phases["free"] == PodGroupPhase.INQUEUE

@@ -162,10 +162,31 @@ def validate_job(job: Job) -> Tuple[bool, str]:
 
 
 def validate_job_update(new: Job, old: Job) -> Tuple[bool, str]:
-    """Updates must not modify the spec (admit_job.go:160-170)."""
-    if new.spec != old.spec:
-        return False, "job.spec is not allowed to modify when update jobs"
-    return True, ""
+    """Updates must not modify the spec (admit_job.go:160-170), with ONE
+    exemption: the controller fills a previously-empty generated
+    ``volume_claim_name`` (the needUpdateForVolumeClaim round-trip,
+    job_controller_actions.go:359-379). That write-back completes a
+    server-side default rather than editing user intent — the reference's
+    strict DeepEqual would deny its own controller here, an upstream
+    inconsistency its ``failurePolicy: Ignore`` papers over."""
+    if new.spec == old.spec:
+        return True, ""
+    if len(new.spec.volumes) == len(old.spec.volumes):
+        import copy
+
+        normalized = copy.deepcopy(new.spec)
+        for i, (nv, ov) in enumerate(zip(new.spec.volumes, old.spec.volumes)):
+            # only the controller's generated name qualifies — any other
+            # fill-in is a user spec edit (e.g. pointing at another job's
+            # claim) and stays frozen
+            if (
+                not ov.volume_claim_name
+                and nv.volume_claim_name == f"{new.meta.name}-pvc-{i}"
+            ):
+                normalized.volumes[i].volume_claim_name = ""
+        if normalized == old.spec:
+            return True, ""
+    return False, "job.spec is not allowed to modify when update jobs"
 
 
 def mutate_job(job: Job) -> Job:

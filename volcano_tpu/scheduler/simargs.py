@@ -30,6 +30,7 @@ def build_victim_sim(
     is reserved for the preemptor (no residents). Field names match
     ``VictimConsts`` / ``VictimState`` — construct with ``Consts(**c)``.
     """
+    assert n_jobs >= 2, "n_jobs must be >= 2: job 0 is the reserved preemptor"
     rng = np.random.default_rng(seed)
     R = 2
     N, V, J, Q = (
