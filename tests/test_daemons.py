@@ -155,3 +155,74 @@ def test_daemon_processes_run_job_end_to_end(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@pytest.mark.slow
+def test_apiserver_restart_with_durable_state(tmp_path):
+    """Kill the apiserver mid-workload and restart it from its --state file
+    (the etcd-persistence analogue): the running job survives, the live
+    daemons ride out the outage and relist, and new work schedules."""
+    state = str(tmp_path / "state.json")
+    procs = []
+    try:
+        api = _spawn(["apiserver", "--port", "0", "--state", state])
+        procs.append(api)
+        url = api.stdout.readline().strip().rsplit(" ", 1)[-1]
+        port = url.rsplit(":", 1)[-1]
+        for comp in ("controller", "scheduler", "kubelet"):
+            extra = (["--period", "0.1", "--metrics-port", "0"]
+                     if comp == "scheduler" else ["--period", "0.05"])
+            p = _spawn([comp, "--server", url] + extra)
+            procs.append(p)
+            p.stdout.readline()
+            if comp == "scheduler":
+                p.stdout.readline()
+
+        _vtctl(["--server", url, "cluster", "init", "--nodes", "2"])
+        _vtctl(["--server", url, "job", "run", "--name", "durable",
+                "--replicas", "2", "--min", "2"])
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if "Running" in _vtctl(["--server", url, "job", "list"]):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("job never ran")
+
+        api.send_signal(signal.SIGTERM)
+        api.wait(timeout=10)
+        time.sleep(1)  # daemons hit the outage path
+
+        api2 = _spawn(["apiserver", "--port", port, "--state", state])
+        procs.append(api2)
+        assert "listening" in api2.stdout.readline()
+
+        deadline = time.monotonic() + 60
+        table = ""
+        while time.monotonic() < deadline:
+            table = _vtctl(["--server", url, "job", "list"])
+            if "durable" in table and "Running" in table:
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError(f"job lost after apiserver restart:\n{table}")
+
+        _vtctl(["--server", url, "job", "run", "--name", "after",
+                "--replicas", "1", "--min", "1"])
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            t = _vtctl(["--server", url, "job", "list"])
+            row = next((ln for ln in t.splitlines() if ln.startswith("after")), "")
+            if "Running" in row:
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError("post-restart job never scheduled")
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

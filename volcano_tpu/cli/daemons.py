@@ -25,6 +25,17 @@ import sys
 import time
 
 
+#: errors a store outage can surface through RemoteStore: connection
+#: failures (OSError/URLError), server-side 5xx (RemoteStoreError), and a
+#: response cut mid-body (http.client.HTTPException, NOT an OSError)
+def _transient_errors():
+    import http.client
+
+    from volcano_tpu.store.client import RemoteStoreError
+
+    return (RemoteStoreError, OSError, http.client.HTTPException)
+
+
 def _elector(store, component: str, identity: str, enabled: bool):
     if not enabled:
         return None
@@ -34,15 +45,26 @@ def _elector(store, component: str, identity: str, enabled: bool):
 
 
 def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = True,
-                  announce=print) -> None:
+                  state: str = "", announce=print) -> None:
+    """``state`` names a JSON file the server persists all objects to (the
+    etcd analogue): a restarted apiserver resumes with every CRD, and
+    clients behind the restart relist."""
     from volcano_tpu.api.objects import Metadata, Queue
     from volcano_tpu.store.server import StoreServer
 
-    srv = StoreServer(host=host, port=port)
+    srv = StoreServer(host=host, port=port, state_path=state or None)
     if default_queue and srv.store.get("Queue", "/default") is None:
         srv.store.create("Queue", Queue(meta=Metadata(name="default", namespace="")))
     announce(f"apiserver listening on {srv.url}", flush=True)
-    srv.serve_forever()
+
+    # SIGTERM -> SystemExit on the serving (main) thread (httpd.shutdown()
+    # from a signal handler would deadlock: shutdown must come from a
+    # different thread than serve_forever); the finally flushes state
+    install_sigterm_exit()
+    try:
+        srv.serve_forever()
+    finally:
+        srv.flush_state()
 
 
 def run_controller(server: str, identity: str = "", leader_elect: bool = True,
@@ -58,16 +80,37 @@ def run_controller(server: str, identity: str = "", leader_elect: bool = True,
             store, elector=_elector(store, "vk-controllers", ident, leader_elect)
         )
 
+    transient = _transient_errors()
     ctl = build()
     announce(f"controller {ident} watching {server}", flush=True)
+    down = False
+    need_rebuild = False
     while True:
         try:
+            if need_rebuild:
+                # build() lists every kind over the wire — it must sit
+                # inside the outage guard too, or a flapping server kills
+                # the controller during the very recovery it relists for
+                ctl = build()
+                need_rebuild = False
             ctl.pump()
+            if down:
+                announce(f"controller {ident}: store back, relisting", flush=True)
+                down = False
+                need_rebuild = True  # full relist after an apiserver outage
+                continue
         except StaleWatch:
             # fell off the server's event log (e.g. long standby): rebuild
             # from a fresh list — the reference's relist-on-too-old-watch
             announce(f"controller {ident}: stale watch, relisting", flush=True)
-            ctl = build()
+            need_rebuild = True
+            continue
+        except transient as e:
+            # apiserver outage: keep retrying, as client-go reflectors do
+            if not down:
+                announce(f"controller {ident}: store unavailable ({e}); retrying",
+                         flush=True)
+                down = True
         time.sleep(period)
 
 
@@ -92,9 +135,20 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
 
         ms = MetricsServer(port=metrics_port).start()
         announce(f"metrics on http://127.0.0.1:{ms.port}/metrics", flush=True)
+    transient = _transient_errors()
+    down = False
     while True:
         t0 = time.monotonic()
-        sched.run_once()
+        try:
+            sched.run_once()
+            if down:
+                announce(f"scheduler {ident}: store back", flush=True)
+                down = False
+        except transient as e:
+            if not down:
+                announce(f"scheduler {ident}: store unavailable ({e}); retrying",
+                         flush=True)
+                down = True
         time.sleep(max(0.0, period - (time.monotonic() - t0)))
 
 
@@ -108,19 +162,30 @@ def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
 
     store = RemoteStore(server)
     announce(f"kubelet simulating against {server}", flush=True)
+    transient = _transient_errors()
+    down = False
     while True:
-        for pod in store.list("Pod"):
-            if pod.deleting:
-                store.delete("Pod", pod.meta.key)
-            elif pod.node_name and pod.phase == PodPhase.PENDING:
-                rv = pod.meta.resource_version
-                pod.phase = PodPhase.RUNNING
-                try:
-                    # CAS: the controller may have marked this pod deleting
-                    # since the list; never resurrect it with a stale write
-                    store.update_cas("Pod", pod, rv)
-                except (Conflict, KeyError):
-                    pass  # changed under us; reconcile next period
+        try:
+            for pod in store.list("Pod"):
+                if pod.deleting:
+                    store.delete("Pod", pod.meta.key)
+                elif pod.node_name and pod.phase == PodPhase.PENDING:
+                    rv = pod.meta.resource_version
+                    pod.phase = PodPhase.RUNNING
+                    try:
+                        # CAS: the controller may have marked this pod
+                        # deleting since the list; never resurrect it with
+                        # a stale write
+                        store.update_cas("Pod", pod, rv)
+                    except (Conflict, KeyError):
+                        pass  # changed under us; reconcile next period
+            if down:
+                announce("kubelet: store back", flush=True)
+                down = False
+        except transient as e:
+            if not down:
+                announce(f"kubelet: store unavailable ({e}); retrying", flush=True)
+                down = True
         time.sleep(period)
 
 

@@ -267,6 +267,9 @@ def main(argv=None) -> int:
     api_p = sub.add_parser("apiserver", parents=[common], help="run the store API server")
     api_p.add_argument("--port", type=int, default=8443)
     api_p.add_argument("--host", default="127.0.0.1")
+    api_p.add_argument("--state", default="",
+                       help="persist objects to this JSON file (etcd analogue); "
+                            "a restart resumes with all CRDs")
     for comp in ("controller", "scheduler", "kubelet"):
         p = sub.add_parser(comp, parents=[common], help=f"run the {comp} against --server")
         p.add_argument("--identity", default="")
@@ -290,7 +293,8 @@ def main(argv=None) -> int:
         daemons.install_sigterm_exit()
         try:
             if args.group == "apiserver":
-                daemons.run_apiserver(port=args.port, host=args.host)
+                daemons.run_apiserver(port=args.port, host=args.host,
+                                      state=args.state)
             elif args.group == "controller":
                 daemons.run_controller(args.server, identity=args.identity,
                                        leader_elect=not args.no_leader_elect,

@@ -45,6 +45,8 @@ class StoreServer:
         host: str = "127.0.0.1",
         port: int = 0,
         admission: bool = True,
+        state_path: Optional[str] = None,
+        save_interval: float = 0.25,
     ):
         self.store = store or Store()
         self.admission = admission
@@ -52,6 +54,23 @@ class StoreServer:
         self.cond = threading.Condition(self.lock)
         self.log: List[Dict[str, Any]] = []
         self.seq = 0
+        # durability (the etcd analogue): objects + sequence persist to
+        # ``state_path`` so a restarted server resumes with all CRDs; the
+        # event log is NOT persisted — clients behind the restart relist,
+        # the same recovery the reference gets from a compacted etcd watch
+        self.state_path = state_path
+        self.save_interval = save_interval
+        self._dirty = False
+        self._saver_stop = threading.Event()
+        self._saver: Optional[threading.Thread] = None
+        if state_path is not None:
+            self._load_state()
+            # background saver: snapshots are encoded under the lock but
+            # written outside it, OFF the mutation path — a synchronous
+            # save inside _pump_log would stall every API request for the
+            # duration of a full-store serialization
+            self._saver = threading.Thread(target=self._saver_loop, daemon=True)
+            self._saver.start()
         self._queues = {kind: self.store.watch(kind) for kind in KIND_CLASSES}
 
         server = self
@@ -183,6 +202,66 @@ class StoreServer:
             self._pump_log()
         return 200, {"object": encode(obj)}
 
+    # -- persistence -----------------------------------------------------------
+
+    def _load_state(self) -> None:
+        import os
+
+        if not os.path.exists(self.state_path):
+            return
+        with open(self.state_path) as f:
+            data = json.load(f)
+        max_rv = 0
+        for kind, items in data.get("kinds", {}).items():
+            if kind not in KIND_CLASSES:
+                continue  # state written by a newer version; skip unknown
+            for enc in items:
+                obj = decode_object(kind, enc)
+                rv = obj.meta.resource_version
+                self.store.create(kind, obj)
+                # create stamps a fresh rv; restore the persisted one on
+                # BOTH the live object and the store's no-op-suppression
+                # shadow copy, or the first unchanged write-back after a
+                # restart would fan out a phantom UPDATED event
+                obj.meta.resource_version = rv
+                shadow = self.store._shadow[kind].get(obj.meta.key)
+                if shadow is not None:
+                    shadow.meta.resource_version = rv
+                max_rv = max(max_rv, rv)
+        # future writes continue the persisted version sequence so CAS
+        # (leases) and epoch caches stay monotonic across restarts
+        self.store._rv = max(self.store._rv, max_rv)
+        self.seq = int(data.get("seq", 0))
+        # note: the reload happens before any watch queue is registered, so
+        # the synthetic creations produce no events — clients relist
+
+    def _saver_loop(self) -> None:
+        interval = max(self.save_interval, 0.05)
+        while not self._saver_stop.wait(interval):
+            self.flush_state()
+
+    def flush_state(self) -> None:
+        """Persist the store if dirty: encode under the lock, write the
+        file outside it (atomic tmp+rename)."""
+        if self.state_path is None:
+            return
+        with self.lock:
+            if not self._dirty:
+                return
+            kinds: Dict[str, List[Any]] = {}
+            for kind in KIND_CLASSES:
+                items = self.store.list(kind)
+                if items:
+                    kinds[kind] = [encode(o) for o in items]
+            payload = {"seq": self.seq, "kinds": kinds}
+            self._dirty = False
+        import os
+
+        tmp = f"{self.state_path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.state_path)
+
     def _pump_log(self) -> None:
         """Drain the store's watch queues into the global ordered log."""
         moved = False
@@ -204,13 +283,15 @@ class StoreServer:
         if overflow > 0:
             del self.log[:overflow]
         if moved:
+            self._dirty = True
             self.cond.notify_all()
 
     def watch_since(self, since: int, kinds, timeout: float) -> Dict[str, Any]:
         deadline = time.monotonic() + timeout
         with self.lock:
-            if since < self.seq - len(self.log):
-                # fell off the buffer: tell the client to relist
+            if since < self.seq - len(self.log) or since > self.seq:
+                # fell off the buffer — or the client's cursor is from
+                # before a server restart: tell it to relist
                 return {"events": None, "next": self.seq, "relist": True}
             while True:
                 # seqs are contiguous (one append per seq), so the events
@@ -240,6 +321,10 @@ class StoreServer:
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        self._saver_stop.set()
+        if self._saver is not None:
+            self._saver.join(timeout=5)
+        self.flush_state()
 
     def serve_forever(self) -> None:
         self.httpd.serve_forever()
