@@ -201,3 +201,32 @@ def test_standby_scheduler_does_not_bind():
     clock2.t = 20.0
     standby2.run_once()
     assert standby2.cache.bind_log
+
+
+def test_failed_bind_recorded_not_raised():
+    """A pod deleted between snapshot and bind must not crash the cycle:
+    the failure lands in the cache's err log (the reference's errTasks
+    resync queue, cache.go:512-533) and the next cycle's fresh snapshot
+    simply no longer sees the task."""
+    from volcano_tpu.scheduler.cache import SchedulerCache
+
+    from helpers import build_node, build_pod, build_podgroup, make_store
+
+    store = make_store(
+        nodes=[build_node("n0")],
+        podgroups=[build_podgroup("g", min_member=1)],
+        pods=[build_pod("p0", group="g", cpu="1")],
+    )
+    cache = SchedulerCache(store)
+    cluster = cache.snapshot()
+    task = next(
+        t for j in cluster.jobs.values() for t in j.tasks.values()
+    )
+    store.delete("Pod", "default/p0")  # vanishes mid-cycle
+
+    cache.bind(task, "n0")  # must not raise
+    assert cache.bind_log == []
+    assert cache.err_log and cache.err_log[0][0] == "bind"
+
+    cache.evict(task, "test")  # evictor tolerates missing pods already
+    assert cache.evict_log == [(task.key, "test")]

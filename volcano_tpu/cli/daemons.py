@@ -64,6 +64,7 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
     try:
         srv.serve_forever()
     finally:
+        srv._saver_stop.set()
         srv.flush_state()
 
 
@@ -100,10 +101,13 @@ def run_controller(server: str, identity: str = "", leader_elect: bool = True,
                 need_rebuild = True  # full relist after an apiserver outage
                 continue
         except StaleWatch:
-            # fell off the server's event log (e.g. long standby): rebuild
-            # from a fresh list — the reference's relist-on-too-old-watch
+            # fell off the server's event log (e.g. long standby) or the
+            # server restarted: rebuild from a fresh list — this IS the
+            # post-outage relist, so clear ``down`` or the next successful
+            # pump would trigger a redundant second rebuild
             announce(f"controller {ident}: stale watch, relisting", flush=True)
             need_rebuild = True
+            down = False
             continue
         except transient as e:
             # apiserver outage: keep retrying, as client-go reflectors do

@@ -341,6 +341,24 @@ class SchedulerCache:
         # observability/tests; cleared by callers.
         self.bind_log: List[Tuple[str, str]] = []
         self.evict_log: List[Tuple[str, str]] = []
+        # failed side effects (the reference's errTasks resync queue,
+        # cache.go:512-533): a pod deleted between snapshot and bind, or a
+        # store outage mid-write, must not crash the cycle — the task is
+        # recorded here and naturally retried next cycle, since every
+        # session re-snapshots from the store
+        self.err_log: List[Tuple[str, str, str]] = []  # (op, task_key, error)
+
+    _ERR_LOG_CAP = 1000
+
+    def _record_err(self, op: str, task_key: str, err: Exception) -> None:
+        import logging
+
+        logging.getLogger("volcano_tpu.scheduler").warning(
+            "%s of %s failed (will retry next cycle): %r", op, task_key, err
+        )
+        self.err_log.append((op, task_key, repr(err)))
+        if len(self.err_log) > self._ERR_LOG_CAP:
+            del self.err_log[: -self._ERR_LOG_CAP]
 
     # -- snapshot ------------------------------------------------------------
 
@@ -443,8 +461,15 @@ class SchedulerCache:
     def bind(self, task: TaskInfo, hostname: str) -> None:
         from volcano_tpu import events
 
+        try:
+            self.binder.bind(task, hostname)
+        except Exception as e:  # noqa: BLE001 — side-effect boundary
+            # resyncTask semantics (cache.go:393-397,512-533): a vanished
+            # pod or failed write is retried by the NEXT cycle's fresh
+            # snapshot; the session state for this task is simply stale
+            self._record_err("bind", task.key, e)
+            return
         self.bind_log.append((task.key, hostname))
-        self.binder.bind(task, hostname)
         # "Scheduled" event, cache.go:443
         events.record(
             self.store, "Pod", task.key, "Scheduled",
@@ -454,8 +479,12 @@ class SchedulerCache:
     def evict(self, task: TaskInfo, reason: str) -> None:
         from volcano_tpu import events
 
+        try:
+            self.evictor.evict(task, reason)
+        except Exception as e:  # noqa: BLE001
+            self._record_err("evict", task.key, e)
+            return
         self.evict_log.append((task.key, reason))
-        self.evictor.evict(task, reason)
         # "Evict" event, cache.go:401
         events.record(
             self.store, "Pod", task.key, "Evict",

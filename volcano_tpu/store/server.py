@@ -60,7 +60,16 @@ class StoreServer:
         # the same recovery the reference gets from a compacted etcd watch
         self.state_path = state_path
         self.save_interval = save_interval
-        self._dirty = False
+        self._dirty_kinds: set = set()
+        # serializes concurrent flushes end-to-end (saver thread vs the
+        # shutdown flush): encode+write happen under this lock so a stale
+        # snapshot can never overwrite a fresher one, and the shared tmp
+        # path is never written by two threads at once
+        self._flush_lock = threading.Lock()
+        # per-kind encoded cache: only kinds dirtied since the last flush
+        # re-encode, so steady-state lease renewals don't pay a full-store
+        # serialization under the server lock every interval
+        self._enc_cache: Dict[str, List[Any]] = {}
         self._saver_stop = threading.Event()
         self._saver: Optional[threading.Thread] = None
         if state_path is not None:
@@ -241,26 +250,31 @@ class StoreServer:
             self.flush_state()
 
     def flush_state(self) -> None:
-        """Persist the store if dirty: encode under the lock, write the
-        file outside it (atomic tmp+rename)."""
+        """Persist the store if dirty. Only kinds dirtied since the last
+        flush re-encode (under the server lock); the file write happens
+        outside it. The flush lock serializes whole flushes so concurrent
+        saver/shutdown calls can neither interleave on the tmp file nor
+        overwrite a fresher snapshot with a staler one."""
         if self.state_path is None:
             return
-        with self.lock:
-            if not self._dirty:
-                return
-            kinds: Dict[str, List[Any]] = {}
-            for kind in KIND_CLASSES:
-                items = self.store.list(kind)
-                if items:
-                    kinds[kind] = [encode(o) for o in items]
-            payload = {"seq": self.seq, "kinds": kinds}
-            self._dirty = False
-        import os
+        with self._flush_lock:
+            with self.lock:
+                if not self._dirty_kinds:
+                    return
+                for kind in self._dirty_kinds:
+                    items = self.store.list(kind)
+                    if items:
+                        self._enc_cache[kind] = [encode(o) for o in items]
+                    else:
+                        self._enc_cache.pop(kind, None)
+                self._dirty_kinds.clear()
+                payload = {"seq": self.seq, "kinds": dict(self._enc_cache)}
+            import os
 
-        tmp = f"{self.state_path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, self.state_path)
+            tmp = f"{self.state_path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.state_path)
 
     def _pump_log(self) -> None:
         """Drain the store's watch queues into the global ordered log."""
@@ -268,6 +282,7 @@ class StoreServer:
         for kind, q in self._queues.items():
             while q:
                 ev = q.popleft()
+                self._dirty_kinds.add(kind)
                 self.seq += 1
                 self.log.append(
                     {
@@ -283,7 +298,6 @@ class StoreServer:
         if overflow > 0:
             del self.log[:overflow]
         if moved:
-            self._dirty = True
             self.cond.notify_all()
 
     def watch_since(self, since: int, kinds, timeout: float) -> Dict[str, Any]:
