@@ -390,3 +390,38 @@ def test_server_state_survives_restart(tmp_path):
         assert out.get("relist")
     finally:
         srv2.stop()
+
+
+def test_state_kinds_survive_double_restart(tmp_path):
+    """Regression: the incremental flush builds the file from the encoded
+    cache, which must be seeded at load — otherwise the first post-restart
+    flush silently drops every kind that wasn't re-dirtied."""
+    from volcano_tpu.api.objects import Metadata, Node, Queue
+    from volcano_tpu.api.resource import Resource
+    from volcano_tpu.store.server import StoreServer
+
+    state = str(tmp_path / "state.json")
+    srv = StoreServer(state_path=state).start()
+    srv.store.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    srv.store.create("Node", Node(meta=Metadata(name="n0", namespace=""),
+                                  allocatable=Resource.from_resource_list(
+                                      {"cpu": "4", "memory": "8Gi"})))
+    with srv.lock:
+        srv._pump_log()
+    srv.stop()
+
+    srv2 = StoreServer(state_path=state).start()
+    # dirty ONE kind only, then flush and restart again
+    q = srv2.store.get("Queue", "/q")
+    q.weight = 7
+    srv2.store.update("Queue", q)
+    with srv2.lock:
+        srv2._pump_log()
+    srv2.stop()
+
+    srv3 = StoreServer(state_path=state).start()
+    try:
+        assert srv3.store.get("Node", "/n0") is not None, "Node dropped from state"
+        assert srv3.store.get("Queue", "/q").weight == 7
+    finally:
+        srv3.stop()

@@ -64,8 +64,9 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
     try:
         srv.serve_forever()
     finally:
-        srv._saver_stop.set()
-        srv.flush_state()
+        # serve_forever has returned, so stop() is safe here: it joins the
+        # saver thread and performs the final state flush in one place
+        srv.stop()
 
 
 def run_controller(server: str, identity: str = "", leader_elect: bool = True,

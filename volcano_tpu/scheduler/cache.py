@@ -470,11 +470,15 @@ class SchedulerCache:
             self._record_err("bind", task.key, e)
             return
         self.bind_log.append((task.key, hostname))
-        # "Scheduled" event, cache.go:443
-        events.record(
-            self.store, "Pod", task.key, "Scheduled",
-            f"Successfully assigned {task.key} to {hostname}",
-        )
+        # "Scheduled" event, cache.go:443 — the bind itself succeeded, so
+        # an event-write failure must not unwind the cycle either
+        try:
+            events.record(
+                self.store, "Pod", task.key, "Scheduled",
+                f"Successfully assigned {task.key} to {hostname}",
+            )
+        except Exception as e:  # noqa: BLE001
+            self._record_err("event", task.key, e)
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         from volcano_tpu import events
@@ -486,10 +490,13 @@ class SchedulerCache:
             return
         self.evict_log.append((task.key, reason))
         # "Evict" event, cache.go:401
-        events.record(
-            self.store, "Pod", task.key, "Evict",
-            f"Evicted for {reason}", type=events.WARNING,
-        )
+        try:
+            events.record(
+                self.store, "Pod", task.key, "Evict",
+                f"Evicted for {reason}", type=events.WARNING,
+            )
+        except Exception as e:  # noqa: BLE001
+            self._record_err("event", task.key, e)
 
     def update_job_status(self, job: JobInfo) -> None:
         if job.pod_group is not None:

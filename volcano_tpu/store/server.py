@@ -224,6 +224,11 @@ class StoreServer:
         for kind, items in data.get("kinds", {}).items():
             if kind not in KIND_CLASSES:
                 continue  # state written by a newer version; skip unknown
+            # seed the encoded cache with the loaded payload: the
+            # incremental flush only re-encodes dirtied kinds and builds
+            # the file from this cache, so an unseeded kind would be
+            # DROPPED from the state file by the first post-restart flush
+            self._enc_cache[kind] = list(items)
             for enc in items:
                 obj = decode_object(kind, enc)
                 rv = obj.meta.resource_version
