@@ -64,7 +64,7 @@ def test_daemon_processes_run_job_end_to_end(tmp_path):
         _vtctl(["--server", url, "job", "run", "--name", "procjob",
                 "--replicas", "2", "--min", "2"])
 
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         table = ""
         while time.monotonic() < deadline:
             table = _vtctl(["--server", url, "job", "list"])
@@ -77,7 +77,7 @@ def test_daemon_processes_run_job_end_to_end(tmp_path):
 
         # suspend -> Aborted, resume -> Running again (command.go round-trip)
         _vtctl(["--server", url, "job", "suspend", "--name", "procjob"])
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             if "Aborted" in _vtctl(["--server", url, "job", "list"]):
                 break
@@ -86,7 +86,7 @@ def test_daemon_processes_run_job_end_to_end(tmp_path):
             raise AssertionError("job never aborted after suspend")
 
         _vtctl(["--server", url, "job", "resume", "--name", "procjob"])
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             if "Running" in _vtctl(["--server", url, "job", "list"]):
                 break
@@ -126,7 +126,7 @@ def test_daemon_processes_run_job_end_to_end(tmp_path):
                                     storage_class="local")],
                 queue="default",
             )))
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             pvc = rs.get("PVC", "default/voljob-pvc-0")
             if pvc is not None and pvc.phase == "Bound":
@@ -181,7 +181,7 @@ def test_apiserver_restart_with_durable_state(tmp_path):
         _vtctl(["--server", url, "cluster", "init", "--nodes", "2"])
         _vtctl(["--server", url, "job", "run", "--name", "durable",
                 "--replicas", "2", "--min", "2"])
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             if "Running" in _vtctl(["--server", url, "job", "list"]):
                 break
@@ -197,7 +197,7 @@ def test_apiserver_restart_with_durable_state(tmp_path):
         procs.append(api2)
         assert "listening" in api2.stdout.readline()
 
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         table = ""
         while time.monotonic() < deadline:
             table = _vtctl(["--server", url, "job", "list"])
@@ -209,7 +209,7 @@ def test_apiserver_restart_with_durable_state(tmp_path):
 
         _vtctl(["--server", url, "job", "run", "--name", "after",
                 "--replicas", "1", "--min", "1"])
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             t = _vtctl(["--server", url, "job", "list"])
             row = next((ln for ln in t.splitlines() if ln.startswith("after")), "")
