@@ -9,10 +9,13 @@ test:
 	$(PY) -m pytest tests/ -q
 
 e2e:
-	$(PY) -m pytest tests/test_e2e_policies.py tests/test_e2e_mpi.py tests/test_controller.py -q
+	$(PY) -m pytest tests/test_e2e_policies.py tests/test_e2e_mpi.py \
+	  tests/test_e2e_recovery.py tests/test_controller.py tests/test_volumes.py \
+	  tests/test_daemons.py tests/test_churn_soak.py -q
 
 parity:
-	$(PY) -m pytest tests/test_tensor_parity.py tests/test_victim_parity.py tests/test_native_backend.py -q
+	$(PY) -m pytest tests/test_tensor_parity.py tests/test_victim_parity.py \
+	  tests/test_native_backend.py tests/test_batch_solve.py -q
 
 bench:
 	$(PY) bench.py
