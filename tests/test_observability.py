@@ -230,3 +230,25 @@ def test_failed_bind_recorded_not_raised():
 
     cache.evict(task, "test")  # evictor tolerates missing pods already
     assert cache.evict_log == [(task.key, "test")]
+
+
+def test_profiler_hook_traces_each_cycle(tmp_path, monkeypatch):
+    """VOLCANO_TPU_PROFILE wraps every cycle in a JAX profiler trace with a
+    per-cycle subdirectory (same-second cycles must not clobber)."""
+    import glob
+    import os
+
+    monkeypatch.setenv("VOLCANO_TPU_PROFILE", str(tmp_path))
+    from volcano_tpu.scheduler.conf import full_conf
+    from volcano_tpu.sim import Cluster
+
+    c = Cluster(scheduler_conf=full_conf("tpu"))
+    c.add_queue("default")
+    c.add_node("n0", {"cpu": "4", "memory": "8Gi", "pods": 110})
+    c.scheduler.run_once()
+    c.scheduler.run_once()  # back-to-back, same wall-clock second
+
+    dirs = sorted(os.listdir(tmp_path))
+    assert dirs == ["cycle-000000", "cycle-000001"], dirs
+    for d in dirs:
+        assert glob.glob(str(tmp_path / d / "**" / "*"), recursive=True), d
