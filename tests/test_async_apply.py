@@ -1,0 +1,199 @@
+"""Async batched decision application (the reference's per-bind goroutines +
+errTasks resync, KB cache.go:393-447,512-533) and the store bulk/patch verbs
+it rides on."""
+
+import threading
+
+import pytest
+
+from tests.helpers import build_node, build_pod, build_podgroup, make_store
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.events import events_for
+from volcano_tpu.scheduler.cache import SchedulerCache
+from volcano_tpu.scheduler.conf import default_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.store import Store
+
+
+# -- store verbs --------------------------------------------------------------
+
+
+def test_store_patch_updates_fields_and_bumps_rv():
+    store = Store()
+    pod = build_pod("p1")
+    store.create("Pod", pod)
+    rv = pod.meta.resource_version
+    out = store.patch("Pod", pod.meta.key, {"node_name": "n1"})
+    assert out.node_name == "n1"
+    assert out.meta.resource_version > rv
+    assert store.get("Pod", pod.meta.key).node_name == "n1"
+
+
+def test_store_patch_unknown_field_fails_loudly():
+    store = Store()
+    store.create("Pod", build_pod("p1"))
+    with pytest.raises(AttributeError):
+        store.patch("Pod", "default/p1", {"nodename_typo": "n1"})
+
+
+def test_store_patch_missing_object_raises():
+    store = Store()
+    with pytest.raises(KeyError):
+        store.patch("Pod", "default/nope", {"node_name": "n1"})
+
+
+def test_store_bulk_applies_ops_in_order_with_per_op_errors():
+    store = Store()
+    store.create("Pod", build_pod("p1"))
+    p2 = build_pod("p2")
+    results = store.bulk([
+        {"op": "create", "kind": "Pod", "object": p2},
+        {"op": "patch", "kind": "Pod", "key": "default/p1",
+         "fields": {"node_name": "n1"}},
+        {"op": "patch", "kind": "Pod", "key": "default/ghost",
+         "fields": {"node_name": "n1"}},
+        {"op": "delete", "kind": "Pod", "key": "default/p2"},
+    ])
+    assert results[0] is None and results[1] is None and results[3] is None
+    assert "ghost" in results[2]
+    assert store.get("Pod", "default/p1").node_name == "n1"
+    assert store.get("Pod", "default/p2") is None
+
+
+# -- async applier ------------------------------------------------------------
+
+
+def _async_scheduler(store):
+    conf = default_conf(backend="host")
+    conf.apply_mode = "async"
+    return Scheduler(store, conf=conf)
+
+
+def _gang_fixture(store, n_tasks=3):
+    store.create("Node", build_node("n1", cpu="16", memory="32Gi"))
+    pg = build_podgroup("pg1", min_member=n_tasks)
+    pg.status.phase = PodGroupPhase.INQUEUE
+    store.create("PodGroup", pg)
+    for i in range(n_tasks):
+        store.create("Pod", build_pod(f"p{i}", group="pg1", cpu="1"))
+
+
+def test_async_binds_reach_store_after_flush():
+    store = make_store([])
+    _gang_fixture(store)
+    sched = _async_scheduler(store)
+    sched.run_once()
+    assert sched.cache.applier.flush(timeout=10)
+    bound = [p for p in store.list("Pod") if p.node_name == "n1"]
+    assert len(bound) == 3
+    # "Scheduled" events arrived via the bulk path
+    evs = events_for(store, "Pod", "default/p0")
+    assert [e.reason for e in evs] == ["Scheduled"]
+    assert sched.cache.err_log == []
+
+
+def test_inflight_bind_overlays_snapshot_as_bound():
+    store = make_store([])
+    _gang_fixture(store, n_tasks=1)
+    cache = SchedulerCache(store, async_apply=True)
+    # freeze the applier so the decision stays in flight deterministically
+    gate = threading.Event()
+    orig_bulk = store.bulk
+    store.bulk = lambda ops: (gate.wait(10), orig_bulk(ops))[1]
+    try:
+        task = next(
+            t for j in cache.snapshot().jobs.values() for t in j.tasks.values()
+        )
+        cache.bind(task, "n1")
+        snap = cache.snapshot()  # store write still gated: overlay must cover
+        t2 = next(t for j in snap.jobs.values() for t in j.tasks.values())
+        assert t2.status == TaskStatus.BOUND
+        assert t2.node_name == "n1"
+        # node accounting charged the in-flight bind
+        node = snap.nodes["n1"]
+        assert t2.uid in node.tasks
+        assert node.idle.milli_cpu < node.allocatable.milli_cpu
+    finally:
+        gate.set()
+        assert cache.applier.flush(timeout=10)
+    assert store.get("Pod", "default/p0").node_name == "n1"
+    # confirmed: overlay marker gone, snapshot now reads pure store state
+    assert cache.applier.inflight_binds == {}
+    snap3 = cache.snapshot()
+    t3 = next(t for j in snap3.jobs.values() for t in j.tasks.values())
+    assert t3.status == TaskStatus.BOUND
+
+
+def test_failed_async_bind_records_err_and_retries_next_cycle():
+    store = make_store([])
+    _gang_fixture(store, n_tasks=1)
+    cache = SchedulerCache(store, async_apply=True)
+    task = next(
+        t for j in cache.snapshot().jobs.values() for t in j.tasks.values()
+    )
+    store.delete("Pod", task.key)  # pod vanishes between snapshot and bind
+    cache.bind(task, "n1")
+    assert cache.applier.flush(timeout=10)
+    assert [(op, key) for op, key, _ in cache.err_log] == [("bind", task.key)]
+    assert cache.applier.inflight_binds == {}  # marker dropped -> retry path
+
+
+def test_async_evict_marks_deleting_and_overlays_releasing():
+    from volcano_tpu.api.types import PodPhase
+
+    store = make_store([])
+    store.create("Node", build_node("n1"))
+    pg = build_podgroup("pg1", min_member=1)
+    pg.status.phase = PodGroupPhase.INQUEUE
+    store.create("PodGroup", pg)
+    store.create(
+        "Pod",
+        build_pod("p0", group="pg1", node_name="n1", phase=PodPhase.RUNNING),
+    )
+    cache = SchedulerCache(store, async_apply=True)
+    gate = threading.Event()
+    orig_bulk = store.bulk
+    store.bulk = lambda ops: (gate.wait(10), orig_bulk(ops))[1]
+    try:
+        task = next(
+            t for j in cache.snapshot().jobs.values() for t in j.tasks.values()
+        )
+        cache.evict(task, "preempt")
+        snap = cache.snapshot()
+        t2 = next(t for j in snap.jobs.values() for t in j.tasks.values())
+        assert t2.status == TaskStatus.RELEASING
+    finally:
+        gate.set()
+        assert cache.applier.flush(timeout=10)
+    assert store.get("Pod", "default/p0").deleting
+    assert [e.reason for e in events_for(store, "Pod", "default/p0")] == ["Evict"]
+
+
+def test_async_second_cycle_does_not_double_schedule():
+    """A cycle starting while last cycle's binds are in flight must see the
+    pods as bound (no re-placement, no duplicate bind submissions)."""
+    store = make_store([])
+    _gang_fixture(store)
+    sched = _async_scheduler(store)
+    gate = threading.Event()
+    orig_bulk = store.bulk
+    store.bulk = lambda ops: (gate.wait(10), orig_bulk(ops))[1]
+    try:
+        sched.run_once()
+        n_first = len(sched.cache.bind_log)
+        assert n_first == 3
+        sched.run_once()  # in-flight overlay: nothing new to place
+        assert len(sched.cache.bind_log) == n_first
+    finally:
+        gate.set()
+        assert sched.cache.applier.flush(timeout=10)
+    assert sum(1 for p in store.list("Pod") if p.node_name == "n1") == 3
+
+
+def test_load_conf_rejects_bad_apply_mode():
+    from volcano_tpu.scheduler.conf import load_conf
+
+    with pytest.raises(ValueError):
+        load_conf("applyMode: Async\n")
+    assert load_conf("applyMode: async\n").apply_mode == "async"
+    assert load_conf("actions: allocate\n").apply_mode is None

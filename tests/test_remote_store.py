@@ -425,3 +425,106 @@ def test_state_kinds_survive_double_restart(tmp_path):
         assert srv3.store.get("Queue", "/q").weight == 7
     finally:
         srv3.stop()
+
+
+# -- patch / bulk over the wire ----------------------------------------------
+
+
+def test_remote_patch_and_bulk_round_trip(server):
+    from tests.helpers import build_pod
+
+    s = RemoteStore(server.url)
+    s.create("Pod", build_pod("bp1"))
+    s.create("Pod", build_pod("bp2"))
+
+    out = s.patch("Pod", "default/bp1", {"node_name": "n7"})
+    assert out.node_name == "n7"
+    assert s.get("Pod", "default/bp1").node_name == "n7"
+    with pytest.raises(KeyError):
+        s.patch("Pod", "default/ghost", {"node_name": "n7"})
+
+    results = s.bulk([
+        {"op": "patch", "kind": "Pod", "key": "default/bp2",
+         "fields": {"node_name": "n8", "deleting": True}},
+        {"op": "patch", "kind": "Pod", "key": "default/ghost",
+         "fields": {"node_name": "n8"}},
+        {"op": "create", "kind": "Pod", "object": build_pod("bp3")},
+        {"op": "delete", "kind": "Pod", "key": "default/bp1"},
+    ])
+    assert results[0] is None and results[2] is None and results[3] is None
+    assert results[1] is not None and "ghost" in results[1]
+    p2 = s.get("Pod", "default/bp2")
+    assert p2.node_name == "n8" and p2.deleting
+    assert s.get("Pod", "default/bp3") is not None
+    assert s.get("Pod", "default/bp1") is None
+
+
+def test_remote_bulk_events_flow_to_watchers(server):
+    from tests.helpers import build_pod
+
+    writer = RemoteStore(server.url)
+    watcher = RemoteStore(server.url)
+    writer.create("Pod", build_pod("wp1"))
+    q = watcher.watch("Pod")
+    writer.bulk([
+        {"op": "patch", "kind": "Pod", "key": "default/wp1",
+         "fields": {"node_name": "n1"}},
+    ])
+    deadline = time.monotonic() + 5
+    seen = []
+    while time.monotonic() < deadline and not seen:
+        watcher.poll()
+        while q:
+            seen.append(q.popleft())
+    assert any(
+        ev.obj.meta.key == "default/wp1" and ev.obj.node_name == "n1"
+        for ev in seen
+    )
+
+
+def test_remote_patch_on_job_rejected_by_admission(server):
+    from volcano_tpu.admission import AdmissionError
+
+    s = RemoteStore(server.url)
+    s.create("Job", make_job("patchjob"))
+    with pytest.raises(AdmissionError):
+        s.patch("Job", "default/patchjob", {"max_retry": 5})
+
+
+def test_flush_state_picks_up_direct_store_writes(tmp_path):
+    """Objects created directly on srv.store (no API request) must reach the
+    state file: flush_state pumps the watch log itself."""
+    from volcano_tpu.api.objects import Metadata, Queue
+    from volcano_tpu.store.server import StoreServer
+
+    state = str(tmp_path / "state.json")
+    srv = StoreServer(state_path=state)  # never started, no API traffic
+    srv.store.create("Queue", Queue(meta=Metadata(name="direct", namespace="")))
+    srv.flush_state()
+    srv2 = StoreServer(state_path=state)
+    assert srv2.store.get("Queue", "/direct") is not None
+
+
+def test_sync_persist_mode_is_durable_before_ack(tmp_path):
+    """save_interval <= 0: a mutation is persisted before the client's
+    request returns — killing the server right after an ack loses nothing."""
+    import json as _json
+
+    from tests.helpers import build_pod
+    from volcano_tpu.store.client import RemoteStore
+    from volcano_tpu.store.server import StoreServer
+
+    state = str(tmp_path / "state.json")
+    srv = StoreServer(state_path=state, save_interval=0).start()
+    try:
+        rs = RemoteStore(srv.url)
+        rs.create("Pod", build_pod("dur1"))
+        rs.bulk([{"op": "patch", "kind": "Pod", "key": "default/dur1",
+                  "fields": {"node_name": "n1"}}])
+        # state file reflects both writes NOW, with the server still live
+        # (no stop-flush involved)
+        data = _json.load(open(state))
+        pods = data["kinds"]["Pod"]
+        assert len(pods) == 1 and pods[0]["node_name"] == "n1"
+    finally:
+        srv.stop()

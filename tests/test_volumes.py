@@ -319,3 +319,66 @@ def test_classless_static_class_survives_binding_last_pv(cluster):
     assert cluster.store.get("Job", "test/two").status.state.phase != JobPhase.RUNNING
     assert len(cluster.store.list("PV")) == 1
 
+
+
+def test_assumed_pv_vanishing_before_bind_fails_softly():
+    """ADVICE r1: a statically-assumed PV deleted between allocate and bind
+    must not wedge the claim as Bound-to-nothing, and must not unwind the
+    dispatch loop — the bind is skipped and retried next cycle."""
+    from tests.helpers import build_node, build_pod, build_podgroup, make_store
+    from volcano_tpu.api.objects import (
+        Metadata, PersistentVolume, PersistentVolumeClaim, StorageClass,
+    )
+    from volcano_tpu.scheduler.cache import SchedulerCache
+    from volcano_tpu.scheduler.conf import default_conf
+    from volcano_tpu.scheduler.session import Session
+
+    store = make_store([build_node("n1")])
+    store.create("StorageClass", StorageClass(
+        meta=Metadata(name="local", namespace=""), provisioner=""))
+    store.create("PV", PersistentVolume(
+        meta=Metadata(name="pv1", namespace=""), capacity="1Gi",
+        storage_class="local"))
+    store.create("PVC", PersistentVolumeClaim(
+        meta=Metadata(name="c1", namespace="default"), size="1Gi",
+        storage_class="local"))
+    store.create("PodGroup", build_podgroup("pg1", min_member=1))
+    pod = build_pod("p0", group="pg1")
+    pod.volumes = ["c1"]
+    store.create("Pod", pod)
+    cache = SchedulerCache(store)
+    snap = cache.snapshot()
+    task = next(t for j in snap.jobs.values() for t in j.tasks.values())
+    cache.allocate_volumes(task, "n1")
+    store.delete("PV", "/pv1")  # vanishes between allocate and bind
+    ssn = Session(cache, default_conf().tiers, snap)
+    task.node_name = "n1"
+    ssn.dispatch(task)  # must not raise
+    assert [(op, key) for op, key, _ in cache.err_log] == [
+        ("bind_volumes", "default/p0")
+    ]
+    pvc = store.get("PVC", "default/c1")
+    assert pvc.volume_name == "" and pvc.phase == "Pending"
+    assert store.get("Pod", "default/p0").node_name == ""
+
+
+def test_missing_bound_pv_makes_claim_unschedulable():
+    """ADVICE r1: a pod mounting a claim whose bound PV was deleted is
+    unschedulable (k8s semantics), not free to land anywhere."""
+    from tests.helpers import build_node, build_pod, build_podgroup, make_store
+    from volcano_tpu.api.objects import Metadata, PersistentVolumeClaim
+    from volcano_tpu.scheduler.cache import SchedulerCache
+
+    store = make_store([build_node("n1")])
+    store.create("PVC", PersistentVolumeClaim(
+        meta=Metadata(name="c1", namespace="default"), size="1Gi",
+        storage_class="fast", volume_name="gone-pv", phase="Bound"))
+    store.create("PodGroup", build_podgroup("pg1", min_member=1))
+    pod = build_pod("p0", group="pg1")
+    pod.volumes = ["c1"]
+    store.create("Pod", pod)
+    cache = SchedulerCache(store)
+    snap = cache.snapshot()
+    task = next(t for j in snap.jobs.values() for t in j.tasks.values())
+    reason = cache.volume_fit(task, snap.nodes["n1"])
+    assert reason is not None and "gone-pv not found" in reason

@@ -131,6 +131,11 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
 
     store = RemoteStore(server)
     conf = load_conf(open(conf_path).read()) if conf_path else full_conf()
+    if conf.apply_mode is None:
+        # deployed default: async batched decision application — a cycle's
+        # binds are one bulk round trip off the critical path (a conf file
+        # can still pin applyMode: sync)
+        conf.apply_mode = "async"
     ident = identity or f"scheduler-{os.getpid()}"
     sched = Scheduler(store, conf=conf,
                       elector=_elector(store, "vk-scheduler", ident, leader_elect))

@@ -1,0 +1,229 @@
+"""Asynchronous, batched side-effect application for the scheduler cache.
+
+The reference never serializes its 1 s cycle behind API writes: every bind
+and evict runs on its own goroutine with resync-on-error
+(KB/pkg/scheduler/cache/cache.go:393-447). The TPU-native analogue is one
+applier thread draining a decision queue into the store's bulk verb — a
+whole batch of binds is ONE round trip over RemoteStore — so the schedule
+cycle publishes decisions and returns instead of paying per-pod writes.
+
+In-flight decisions (submitted, not yet confirmed by the store) overlay the
+next snapshot: a cycle that starts before the writes land still sees the
+pods as bound/releasing, so nothing double-schedules. A failed write drops
+the in-flight marker and records to the cache's err_log — the next cycle's
+fresh snapshot simply retries the task (errTasks resync semantics,
+cache.go:512-533).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+#: cap on the event-aggregation index (pod keys churn in a long-lived
+#: daemon; entries beyond this fall back to fresh Event objects)
+EVENT_INDEX_CAP = 4096
+
+
+class AsyncApplier:
+    def __init__(self, cache, batch_max: int = 16384):
+        self.cache = cache
+        self.store = cache.store
+        self.batch_max = batch_max
+        self._cv = threading.Condition()
+        self._q: deque = deque()  # ("bind", key, hostname) | ("evict", key, reason)
+        #: decisions submitted but not yet confirmed — read by snapshot().
+        #: _pending counts queued+applying ops per (verb, key): a marker is
+        #: only dropped when ITS LAST pending op finishes, so a resubmission
+        #: racing an in-flight batch keeps its overlay.
+        self.inflight_binds: Dict[str, str] = {}
+        self.inflight_evicts: Dict[str, str] = {}
+        self._pending: Dict[Tuple[str, str], int] = {}
+        self._applying = 0
+        self._stopped = False
+        # (involved_kind, involved_key, reason, message) -> ClusterEvent,
+        # the k8s count-aggregation pattern (events.record), applier-local;
+        # entries are inserted only after the store CONFIRMS the create
+        self._event_index: OrderedDict = OrderedDict()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="volcano-applier"
+        )
+        self._thread.start()
+
+    # -- producer side (the schedule cycle) -----------------------------------
+
+    def submit_bind(self, task_key: str, hostname: str) -> None:
+        with self._cv:
+            self.inflight_binds[task_key] = hostname
+            self.inflight_evicts.pop(task_key, None)
+            self._pending[("bind", task_key)] = (
+                self._pending.get(("bind", task_key), 0) + 1
+            )
+            self._q.append(("bind", task_key, hostname))
+            self._cv.notify_all()
+
+    def submit_evict(self, task_key: str, reason: str) -> None:
+        with self._cv:
+            self.inflight_evicts[task_key] = reason
+            self._pending[("evict", task_key)] = (
+                self._pending.get(("evict", task_key), 0) + 1
+            )
+            self._q.append(("evict", task_key, reason))
+            self._cv.notify_all()
+
+    def inflight_view(self) -> Tuple[Dict[str, str], Dict[str, str]]:
+        """Consistent copies of the in-flight maps. Callers MUST take this
+        BEFORE listing pods from the store: marker-then-list ordering makes
+        the overlay conservative — a decision confirmed between the two
+        reads shows up in both, which is harmless, while list-then-marker
+        could miss it in both and double-schedule."""
+        with self._cv:
+            return dict(self.inflight_binds), dict(self.inflight_evicts)
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted decision has been applied (or failed).
+        Returns False on timeout."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._q or self._applying:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    def stop(self, flush: bool = True, timeout: float = 30.0) -> None:
+        if flush:
+            self.flush(timeout)
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+    @property
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._q) + self._applying
+
+    # -- consumer side (the applier thread) ------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait()
+                if not self._q and self._stopped:
+                    return
+                n = min(len(self._q), self.batch_max)
+                batch = [self._q.popleft() for _ in range(n)]
+                self._applying = n
+            try:
+                self._apply(batch)
+            finally:
+                with self._cv:
+                    self._applying = 0
+                    for verb, key, _ in batch:
+                        left = self._pending.get((verb, key), 1) - 1
+                        if left <= 0:
+                            self._pending.pop((verb, key), None)
+                            # only the LAST pending op for a key clears its
+                            # overlay marker — a newer decision queued while
+                            # this batch was in flight keeps it
+                            if verb == "bind":
+                                self.inflight_binds.pop(key, None)
+                            else:
+                                self.inflight_evicts.pop(key, None)
+                        else:
+                            self._pending[(verb, key)] = left
+                    self._cv.notify_all()
+
+    def _apply(self, batch) -> None:
+        ops = []
+        for verb, key, arg in batch:
+            if verb == "bind":
+                ops.append({"op": "patch", "kind": "Pod", "key": key,
+                            "fields": {"node_name": arg}})
+            else:
+                ops.append({"op": "patch", "kind": "Pod", "key": key,
+                            "fields": {"deleting": True}})
+        try:
+            results = self.store.bulk(ops)
+        except Exception as e:  # noqa: BLE001 — store outage: retry next cycle
+            for verb, key, _ in batch:
+                self.cache._record_err(verb, key, e)
+            return
+        ev_ops: List[dict] = []
+        ev_meta: List[Tuple[tuple, object, bool]] = []  # (idx_key, ev, is_new)
+        for (verb, key, arg), err in zip(batch, results):
+            if err is not None:
+                # vanished pod / conflict: the task stays pending in the
+                # store; next cycle's snapshot retries it
+                self.cache._record_err(verb, key, RuntimeError(err))
+                continue
+            if verb == "bind":
+                op, meta = self._event_op(
+                    "Pod", key, "Scheduled",
+                    f"Successfully assigned {key} to {arg}", "Normal",
+                )
+            else:
+                op, meta = self._event_op(
+                    "Pod", key, "Evict", f"Evicted for {arg}", "Warning",
+                )
+            ev_ops.append(op)
+            ev_meta.append(meta)
+        if not ev_ops:
+            return
+        try:
+            ev_results = self.store.bulk(ev_ops)
+        except Exception as e:  # noqa: BLE001
+            self.cache._record_err("event", "batch", e)
+            return
+        for op, (idx_key, ev, is_new), err in zip(ev_ops, ev_meta, ev_results):
+            if err is not None:
+                # failed create: do NOT index it, the next occurrence
+                # retries a fresh create; failed count-bump: drop the entry
+                # so the next occurrence re-creates instead of patching a
+                # nonexistent Event forever
+                self._event_index.pop(idx_key, None)
+                self.cache._record_err(
+                    "event", op.get("key", op["kind"]), RuntimeError(err)
+                )
+            elif is_new:
+                ev.count = 1
+                self._event_index[idx_key] = ev
+                self._event_index.move_to_end(idx_key)
+                while len(self._event_index) > EVENT_INDEX_CAP:
+                    self._event_index.popitem(last=False)
+
+    def _event_op(self, ikind, ikey, reason, message, type_):
+        """A bulk op recording (or count-aggregating) a cluster event —
+        events.record without the per-event store round trip. Returns
+        (op, (index_key, event, is_new)); new events join the index only
+        after the store confirms the create (see _apply)."""
+        from volcano_tpu.api.objects import Metadata, new_uid
+        from volcano_tpu.events import ClusterEvent
+
+        idx_key = (ikind, ikey, reason, message)
+        ev = self._event_index.get(idx_key)
+        if ev is not None:
+            ev.count += 1
+            self._event_index.move_to_end(idx_key)
+            return (
+                {"op": "patch", "kind": "Event", "key": ev.meta.key,
+                 "fields": {"count": ev.count}},
+                (idx_key, ev, False),
+            )
+        ev = ClusterEvent(
+            meta=Metadata(name=new_uid("event"), namespace=""),
+            involved=(ikind, ikey),
+            reason=reason,
+            message=message,
+            type=type_,
+        )
+        return (
+            {"op": "create", "kind": "Event", "object": ev},
+            (idx_key, ev, True),
+        )

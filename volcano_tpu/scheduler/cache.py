@@ -223,7 +223,12 @@ class VolumeBinder:
     def _reachable(self, pv_name: str, labels) -> Optional[str]:
         """Reason pv_name can't serve a pod on a node with these labels."""
         pv = self._pv(pv_name)
-        if pv is not None and pv.node_affinity and not self._affinity_matches(pv, labels):
+        if pv is None:
+            # bound/assumed PV deleted from the store: the claim is
+            # unschedulable everywhere (k8s treats a missing bound PV the
+            # same way), not free to land anywhere
+            return f"volume {pv_name} not found"
+        if pv.node_affinity and not self._affinity_matches(pv, labels):
             return f"volume {pv_name} not reachable"
         return None
 
@@ -302,9 +307,18 @@ class VolumeBinder:
                     )
             else:
                 pv = self.store.get("PV", f"/{pv_name}")
-                if pv is not None:
-                    pv.claim_ref = key
-                    self.store.update("PV", pv)
+                if pv is None:
+                    # the statically-assumed PV vanished between allocate
+                    # and bind: writing claim_ref would wedge the claim as
+                    # Bound to a nonexistent volume forever — fail the bind
+                    # instead (callers leave the task pending and retry)
+                    self._assumed_pvs.pop(pv_name, None)
+                    raise VolumeBindingError(
+                        f"assumed volume {pv_name} for claim {key} vanished "
+                        "before bind"
+                    )
+                pv.claim_ref = key
+                self.store.update("PV", pv)
                 self._assumed_pvs.pop(pv_name, None)
             pvc.volume_name = pv_name
             pvc.phase = "Bound"
@@ -329,6 +343,7 @@ class SchedulerCache:
         store: Store,
         scheduler_name: str = "volcano-tpu",
         default_queue: str = "default",
+        async_apply: bool = False,
     ):
         self.store = store
         self.scheduler_name = scheduler_name
@@ -337,6 +352,16 @@ class SchedulerCache:
         self.evictor = Evictor(store)
         self.status_updater = StatusUpdater(store)
         self.volume_binder = VolumeBinder(store)
+        # async decision application (the reference's per-bind goroutines,
+        # cache.go:393-447): binds/evicts enqueue to a background applier
+        # that batches them through the store's bulk verb; in-flight
+        # decisions overlay snapshot(). Off by default — tests and the
+        # in-process simulator rely on synchronous visibility.
+        self.applier = None
+        if async_apply:
+            from volcano_tpu.scheduler.apply import AsyncApplier
+
+            self.applier = AsyncApplier(self)
         # (task_key, hostname) bind log and (task_key, reason) evict log for
         # observability/tests; cleared by callers.
         self.bind_log: List[Tuple[str, str]] = []
@@ -418,10 +443,29 @@ class SchedulerCache:
             cluster.jobs[uid].name = pdb.meta.name
             cluster.jobs[uid].min_available = pdb.min_available
 
+        from volcano_tpu.api.types import TaskStatus as TS
+
+        # overlay for in-flight async decisions: a bind/evict published last
+        # cycle but not yet confirmed by the store must not look
+        # schedulable/evictable again. The marker copies are taken BEFORE
+        # the pod list: a decision confirmed in between appears in both
+        # (harmless), while the reverse order could miss it in both.
+        inflight_binds: Dict[str, str] = {}
+        inflight_evicts: Dict[str, str] = {}
+        if self.applier is not None:
+            inflight_binds, inflight_evicts = self.applier.inflight_view()
         for pod in self.store.items("Pod"):
             if pod.spec.scheduler_name != self.scheduler_name:
                 continue
             task = TaskInfo(pod)
+            if inflight_binds or inflight_evicts:
+                host = inflight_binds.get(task.key)
+                if host and not pod.node_name and task.status == TS.PENDING:
+                    task.node_name = host
+                    task.status = TS.BOUND
+                if task.key in inflight_evicts and not pod.deleting:
+                    if task.status in (TS.RUNNING, TS.BOUND):
+                        task.status = TS.RELEASING
             if task.priority == 0 and task.priority_class:
                 task.priority = priority_classes.get(task.priority_class, default_priority)
             job_uid = self._job_uid_for(pod, pg_by_key)
@@ -439,8 +483,8 @@ class SchedulerCache:
                 order += 1
                 cluster.jobs[job_uid] = shadow
             cluster.jobs[job_uid].add_task(task)
-            if pod.node_name and pod.node_name in cluster.nodes:
-                cluster.nodes[pod.node_name].add_task(task)
+            if task.node_name and task.node_name in cluster.nodes:
+                cluster.nodes[task.node_name].add_task(task)
 
         return cluster
 
@@ -461,6 +505,14 @@ class SchedulerCache:
     def bind(self, task: TaskInfo, hostname: str) -> None:
         from volcano_tpu import events
 
+        if self.applier is not None:
+            # async path: publish the decision; the applier thread batches
+            # it into a store bulk write. bind_log records the decision at
+            # publish time; failures surface in err_log and retry next
+            # cycle via the fresh snapshot.
+            self.applier.submit_bind(task.key, hostname)
+            self.bind_log.append((task.key, hostname))
+            return
         try:
             self.binder.bind(task, hostname)
         except Exception as e:  # noqa: BLE001 — side-effect boundary
@@ -483,6 +535,10 @@ class SchedulerCache:
     def evict(self, task: TaskInfo, reason: str) -> None:
         from volcano_tpu import events
 
+        if self.applier is not None:
+            self.applier.submit_evict(task.key, reason)
+            self.evict_log.append((task.key, reason))
+            return
         try:
             self.evictor.evict(task, reason)
         except Exception as e:  # noqa: BLE001

@@ -46,6 +46,11 @@ class SchedulerConf:
     backend: str = "host"  # "tpu" (JAX kernels) | "native" (C++ solver) | "host" (object oracle)
     solve_mode: str = "auto"  # tpu backend: "auto" | "exact" | "batch"
     schedule_period: float = 1.0
+    # "async": binds/evicts batch through a background applier thread (the
+    # reference's per-bind goroutines, cache.go:393-447); "sync": applied
+    # inline, deterministic. None = unset: library/simulator use resolves
+    # to sync; the deployed daemon resolves to async.
+    apply_mode: Optional[str] = None
 
 
 def default_conf(backend: str = "host") -> SchedulerConf:
@@ -107,6 +112,13 @@ def load_conf(text: str) -> SchedulerConf:
         conf.tiers = default_conf().tiers
     conf.backend = str(data.get("backend", conf.backend))
     conf.solve_mode = str(data.get("solveMode", conf.solve_mode))
+    if "applyMode" in data:
+        mode = str(data["applyMode"])
+        if mode not in ("sync", "async"):
+            raise ValueError(
+                f"applyMode must be 'sync' or 'async', got {mode!r}"
+            )
+        conf.apply_mode = mode
     if "schedulePeriod" in data:
         conf.schedule_period = float(data["schedulePeriod"])
     return conf

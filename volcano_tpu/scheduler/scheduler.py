@@ -30,7 +30,10 @@ class Scheduler:
     ):
         self.conf = conf or default_conf()
         self.cache = SchedulerCache(
-            store, scheduler_name=scheduler_name, default_queue=default_queue
+            store,
+            scheduler_name=scheduler_name,
+            default_queue=default_queue,
+            async_apply=self.conf.apply_mode == "async",
         )
         self.elector = elector
         self._profile_cycle = 0

@@ -260,7 +260,17 @@ class Session:
                 self.dispatch(t)
 
     def dispatch(self, task: TaskInfo) -> None:
-        self.cache.bind_volumes(task)
+        from volcano_tpu.scheduler.cache import VolumeBindingError
+
+        try:
+            self.cache.bind_volumes(task)
+        except VolumeBindingError as e:
+            # the assumed PV vanished between allocate and bind: skip the
+            # bind (store untouched, task retried by next cycle's snapshot)
+            # instead of unwinding the gang dispatch loop mid-flight —
+            # failed-side-effect semantics, same as a failed cache.bind
+            self.cache._record_err("bind_volumes", task.key, e)
+            return
         self.cache.bind(task, task.node_name)
         job = self.jobs[task.job_uid]
         job.update_task_status(task, TaskStatus.BINDING)

@@ -514,13 +514,14 @@ def _apply_bulk(ssn, snap, order, task_node, task_kind, ready, use_gang=True) ->
                     # dynamic-claim provisioning must not be skipped on the
                     # bulk path (volume-constrained tasks fell back to host,
                     # so this cannot raise for a node the solve chose; guard
-                    # anyway and leave the task allocated-unbound)
+                    # anyway — incl. a PV vanishing before bind — and leave
+                    # the task allocated-unbound for next cycle's retry)
                     try:
                         ssn.cache.allocate_volumes(task, node_name)
+                        ssn.cache.bind_volumes(task)
                     except VolumeBindingError:
                         job.update_task_status(task, TaskStatus.ALLOCATED)
                         continue
-                    ssn.cache.bind_volumes(task)
                 ssn.cache.bind(task, node_name)
                 job.update_task_status(task, TaskStatus.BINDING)
             else:

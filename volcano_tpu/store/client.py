@@ -131,6 +131,46 @@ class RemoteStore:
         """Compare-and-swap update (Store.update_cas over the wire)."""
         return self.update(kind, obj, cas=expected_rv)
 
+    def patch(self, kind: str, key: str, fields: Dict[str, Any]) -> Any:
+        code, body = self._request(
+            "PATCH", f"/apis/{kind}/obj?key={quote(key, safe='')}",
+            {"fields": fields},
+        )
+        if code == 404:
+            raise KeyError(self._err(code, body))
+        if code == 422:
+            raise AdmissionError(self._err(code, body))
+        if code != 200:
+            raise RemoteStoreError(self._err(code, body))
+        return decode_object(kind, body["object"])
+
+    def bulk(self, ops: List[Dict[str, Any]]) -> List[Optional[str]]:
+        """Store.bulk over the wire: ONE round trip for N mutations (async
+        decision application batches a cycle's binds/evicts through this).
+        Ops carry live objects; they are encoded here. Returns one error
+        string (or None) per op, like Store.bulk."""
+        wire = []
+        for op in ops:
+            w = {"op": op["op"], "kind": op["kind"]}
+            if "object" in op:
+                w["object"] = encode(op["object"])
+            if "key" in op:
+                w["key"] = op["key"]
+            if "fields" in op:
+                w["fields"] = op["fields"]
+            if "cas" in op:
+                w["cas"] = op["cas"]
+            wire.append(w)
+        code, body = self._request("POST", "/bulk", {"ops": wire})
+        if code != 200:
+            raise RemoteStoreError(self._err(code, body))
+        results = body.get("results") or []
+        if len(results) != len(ops):
+            raise RemoteStoreError(
+                f"bulk returned {len(results)} results for {len(ops)} ops"
+            )
+        return results
+
     def delete(self, kind: str, key: str) -> Optional[Any]:
         before = self.get(kind, key)
         code, body = self._request(

@@ -60,6 +60,13 @@ class StoreServer:
         # the same recovery the reference gets from a compacted etcd watch
         self.state_path = state_path
         self.save_interval = save_interval
+        # Durability contract: with save_interval > 0 mutations are ACKed
+        # before persistence — up to one interval of acked writes can be
+        # lost on a crash (weaker than etcd, which fsyncs before acking;
+        # watchers relist on restart either way). Pass save_interval <= 0
+        # for sync-on-mutate: every ACKed mutation is flushed to the state
+        # file first, the etcd contract, at per-request fsync cost.
+        self._sync_persist = state_path is not None and save_interval <= 0
         self._dirty_kinds: set = set()
         # serializes concurrent flushes end-to-end (saver thread vs the
         # shutdown flush): encode+write happen under this lock so a stale
@@ -77,9 +84,11 @@ class StoreServer:
             # background saver: snapshots are encoded under the lock but
             # written outside it, OFF the mutation path — a synchronous
             # save inside _pump_log would stall every API request for the
-            # duration of a full-store serialization
-            self._saver = threading.Thread(target=self._saver_loop, daemon=True)
-            self._saver.start()
+            # duration of a full-store serialization. (Sync-persist mode
+            # flushes inline in the handlers instead; no saver thread.)
+            if not self._sync_persist:
+                self._saver = threading.Thread(target=self._saver_loop, daemon=True)
+                self._saver.start()
         self._queues = {kind: self.store.watch(kind) for kind in KIND_CLASSES}
 
         server = self
@@ -128,11 +137,35 @@ class StoreServer:
                 return self._reply(404, {"error": f"no route {u.path}"})
 
             def do_POST(self):
-                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                if u.path == "/bulk":
+                    try:
+                        body = self._body()
+                        results = server.bulk(body.get("ops") or [])
+                        code, payload = 200, {"results": results}
+                    except Exception as e:  # noqa: BLE001 — wire boundary
+                        code, payload = 500, {"error": repr(e)}
+                    return self._reply(code, payload)
                 if len(parts) == 2 and parts[0] == "apis":
                     try:
                         code, payload = server.create(parts[1], self._body())
                     except Exception as e:  # noqa: BLE001 — wire boundary
+                        code, payload = 500, {"error": repr(e)}
+                    return self._reply(code, payload)
+                return self._reply(404, {"error": "no route"})
+
+            def do_PATCH(self):
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                q = parse_qs(u.query)
+                if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
+                    key = q.get("key", [""])[0]
+                    try:
+                        code, payload = server.patch(
+                            parts[1], key, self._body().get("fields") or {}
+                        )
+                    except Exception as e:  # noqa: BLE001
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
                 return self._reply(404, {"error": "no route"})
@@ -162,6 +195,8 @@ class StoreServer:
                     with server.lock:
                         obj = server.store.delete(parts[1], key)
                         server._pump_log()
+                    if server._sync_persist:
+                        server.flush_state()
                     return self._reply(200, {"deleted": obj is not None})
                 return self._reply(404, {"error": "no route"})
 
@@ -172,7 +207,7 @@ class StoreServer:
 
     # -- mutations (called from handler threads, locked) ----------------------
 
-    def create(self, kind: str, data: Dict[str, Any]):
+    def create(self, kind: str, data: Dict[str, Any], _flush: bool = True):
         obj = decode_object(kind, data.get("object", {}))
         if kind == "Job" and self.admission:
             from volcano_tpu.admission import mutate_job, validate_job
@@ -186,9 +221,15 @@ class StoreServer:
                 return 409, {"error": f"{kind} {obj.meta.key} already exists"}
             self.store.create(kind, obj)
             self._pump_log()
+        if self._sync_persist and _flush:
+            # outside self.lock: the saver/shutdown flusher takes
+            # _flush_lock before self.lock, so flushing while holding the
+            # server lock would be an ABBA deadlock
+            self.flush_state()
         return 201, {"object": encode(obj)}
 
-    def update(self, kind: str, data: Dict[str, Any], expected_rv: Optional[int] = None):
+    def update(self, kind: str, data: Dict[str, Any], expected_rv: Optional[int] = None,
+               _flush: bool = True):
         obj = decode_object(kind, data.get("object", {}))
         with self.lock:
             old = self.store.get(kind, obj.meta.key)
@@ -209,7 +250,66 @@ class StoreServer:
                     return 422, {"error": msg}
             self.store.update(kind, obj)
             self._pump_log()
+        if self._sync_persist and _flush:
+            self.flush_state()
         return 200, {"object": encode(obj)}
+
+    def patch(self, kind: str, key: str, fields: Dict[str, Any], _flush: bool = True):
+        if kind == "Job" and self.admission:
+            # spec-freeze admission compares whole objects; field patches
+            # would bypass it — Jobs must go through PUT
+            return 422, {"error": "patch is not supported on Job; use update"}
+        with self.lock:
+            try:
+                obj = self.store.patch(kind, key, fields)
+            except KeyError as e:
+                return 404, {"error": str(e)}
+            self._pump_log()
+        if self._sync_persist and _flush:
+            self.flush_state()
+        return 200, {"object": encode(obj)}
+
+    def bulk(self, ops: List[Dict[str, Any]]) -> List[Optional[str]]:
+        """Batched mutations: one HTTP round trip for N ops (the server half
+        of async decision application — see Store.bulk for the op shapes;
+        objects arrive encoded). Per-op admission still applies. The lock is
+        reentrant, so holding it across the batch while delegating to
+        create/update keeps the batch contiguous in the event log."""
+        results: List[Optional[str]] = []
+        with self.lock:
+            for op in ops:
+                try:
+                    verb = op.get("op")
+                    kind = op.get("kind", "")
+                    if verb == "create":
+                        code, payload = self.create(
+                            kind, {"object": op.get("object", {})}, _flush=False
+                        )
+                        ok = code == 201
+                    elif verb == "update":
+                        code, payload = self.update(
+                            kind, {"object": op.get("object", {})},
+                            expected_rv=op.get("cas"), _flush=False,
+                        )
+                        ok = code == 200
+                    elif verb == "patch":
+                        code, payload = self.patch(
+                            kind, op.get("key", ""), op.get("fields") or {},
+                            _flush=False,
+                        )
+                        ok = code == 200
+                    elif verb == "delete":
+                        self.store.delete(kind, op.get("key", ""))
+                        self._pump_log()
+                        ok, payload = True, {}
+                    else:
+                        ok, payload = False, {"error": f"unknown bulk op {verb!r}"}
+                    results.append(None if ok else payload.get("error", "failed"))
+                except Exception as e:  # noqa: BLE001 — per-op isolation
+                    results.append(repr(e))
+        if self._sync_persist:
+            self.flush_state()
+        return results
 
     # -- persistence -----------------------------------------------------------
 
@@ -264,6 +364,11 @@ class StoreServer:
             return
         with self._flush_lock:
             with self.lock:
+                # drain any watch events queued by writes that bypassed the
+                # API handlers (direct srv.store mutations, e.g. seeding a
+                # default Queue at startup) so their kinds are dirtied and
+                # persisted too
+                self._pump_log()
                 if not self._dirty_kinds:
                     return
                 for kind in self._dirty_kinds:

@@ -47,6 +47,8 @@ class Store:
     """
 
     def __init__(self):
+        import threading
+
         self._objects: Dict[str, Dict[str, Any]] = defaultdict(dict)
         # deep-copied last-notified state per object, so Event.old reflects
         # the pre-update object even though callers mutate in place (the
@@ -54,6 +56,23 @@ class Store:
         self._shadow: Dict[str, Dict[str, Any]] = defaultdict(dict)
         self._watchers: Dict[str, List[Deque[Event]]] = defaultdict(list)
         self._rv = 0
+        # mutation lock: the async applier writes from its own thread while
+        # the owning thread reads/writes (StoreServer adds its own RLock on
+        # top for multi-client HTTP, which nests fine)
+        self._mu = threading.RLock()
+
+    def __getstate__(self):
+        # the mutation lock is process-local (vtctl pickles the simulated
+        # cluster's store for persisted state)
+        state = self.__dict__.copy()
+        del state["_mu"]
+        return state
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
+        self._mu = threading.RLock()
 
     def _watched(self, kind: str) -> bool:
         return bool(self._watchers[kind])
@@ -66,60 +85,114 @@ class Store:
     # -- CRUD ---------------------------------------------------------------
 
     def create(self, kind: str, obj: Any) -> Any:
-        key = obj.meta.key
-        if key in self._objects[kind]:
-            raise KeyError(f"{kind} {key} already exists")
-        self._rv += 1
-        obj.meta.resource_version = self._rv
-        if not obj.meta.creation_timestamp:
-            import time
+        with self._mu:
+            key = obj.meta.key
+            if key in self._objects[kind]:
+                raise KeyError(f"{kind} {key} already exists")
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            if not obj.meta.creation_timestamp:
+                import time
 
-            obj.meta.creation_timestamp = time.time()
-        self._objects[kind][key] = obj
-        self._notify(Event(kind, EventType.ADDED, obj))
-        return obj
+                obj.meta.creation_timestamp = time.time()
+            self._objects[kind][key] = obj
+            self._notify(Event(kind, EventType.ADDED, obj))
+            return obj
 
     def update(self, kind: str, obj: Any) -> Any:
-        key = obj.meta.key
-        if key not in self._objects[kind]:
-            raise KeyError(f"{kind} {key} not found")
-        old = self._shadow[kind].get(key)
-        # no-op writes don't bump the version or fan out events — callers
-        # (scheduler close_session, controller status writers) write
-        # unconditionally each cycle and rely on this for quiescence
-        if old is not None and old == obj:
+        with self._mu:
+            key = obj.meta.key
+            if key not in self._objects[kind]:
+                raise KeyError(f"{kind} {key} not found")
+            old = self._shadow[kind].get(key)
+            # no-op writes don't bump the version or fan out events — callers
+            # (scheduler close_session, controller status writers) write
+            # unconditionally each cycle and rely on this for quiescence
+            if old is not None and old == obj:
+                return obj
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            self._objects[kind][key] = obj
+            self._notify(Event(kind, EventType.UPDATED, obj, old))
             return obj
-        self._rv += 1
-        obj.meta.resource_version = self._rv
-        self._objects[kind][key] = obj
-        self._notify(Event(kind, EventType.UPDATED, obj, old))
-        return obj
 
     def update_cas(self, kind: str, obj: Any, expected_rv: int) -> Any:
         """Compare-and-swap update: succeeds only if the stored object's
         resource_version still equals ``expected_rv`` (read-modify-write
         safety for concurrent writers, e.g. leader leases and kubelets)."""
-        current = self._objects[kind].get(obj.meta.key)
-        if current is None:
-            raise KeyError(f"{kind} {obj.meta.key} not found")
-        if current.meta.resource_version != expected_rv:
-            raise Conflict(
-                f"{kind} {obj.meta.key}: expected rv {expected_rv}, "
-                f"have {current.meta.resource_version}"
-            )
-        return self.update(kind, obj)
+        with self._mu:
+            current = self._objects[kind].get(obj.meta.key)
+            if current is None:
+                raise KeyError(f"{kind} {obj.meta.key} not found")
+            if current.meta.resource_version != expected_rv:
+                raise Conflict(
+                    f"{kind} {obj.meta.key}: expected rv {expected_rv}, "
+                    f"have {current.meta.resource_version}"
+                )
+            return self.update(kind, obj)
+
+    def patch(self, kind: str, key: str, fields: Dict[str, Any]) -> Any:
+        """Apply field updates to the stored object in place (the API
+        server's PATCH; Bind is a node_name patch). Attribute names must
+        already exist on the object — typos fail loudly."""
+        with self._mu:
+            obj = self._objects[kind].get(key)
+            if obj is None:
+                raise KeyError(f"{kind} {key} not found")
+            # validate every name BEFORE mutating: a bad field must not
+            # leave earlier fields silently applied with no event/version
+            for k in fields:
+                if not hasattr(obj, k):
+                    raise AttributeError(f"{kind} has no field {k!r}")
+            for k, v in fields.items():
+                setattr(obj, k, v)
+            return self.update(kind, obj)
+
+    def bulk(self, ops: List[Dict[str, Any]]) -> List[Optional[str]]:
+        """Apply N mutations in one call — the store-side half of batched
+        side-effect application (one round trip for a cycle's binds over
+        RemoteStore). Each op is a dict:
+
+          {"op": "create"|"update", "kind": K, "object": obj}
+          {"op": "patch",  "kind": K, "key": key, "fields": {...}}
+          {"op": "delete", "kind": K, "key": key}
+
+        Ops apply independently in order (no transaction — semantically N
+        API calls); the result is one error string (or None) per op.
+        """
+        results: List[Optional[str]] = []
+        for op in ops:
+            try:
+                verb = op["op"]
+                kind = op["kind"]
+                if verb == "create":
+                    self.create(kind, op["object"])
+                elif verb == "update":
+                    self.update(kind, op["object"])
+                elif verb == "patch":
+                    self.patch(kind, op["key"], op["fields"])
+                elif verb == "delete":
+                    self.delete(kind, op["key"])
+                else:
+                    raise ValueError(f"unknown bulk op {verb!r}")
+                results.append(None)
+            except Exception as e:  # noqa: BLE001 — per-op isolation
+                results.append(repr(e))
+        return results
 
     def delete(self, kind: str, key: str) -> Optional[Any]:
-        obj = self._objects[kind].pop(key, None)
-        if obj is not None:
-            self._notify(Event(kind, EventType.DELETED, obj))  # drops the shadow too
-        return obj
+        with self._mu:
+            obj = self._objects[kind].pop(key, None)
+            if obj is not None:
+                self._notify(Event(kind, EventType.DELETED, obj))  # drops the shadow too
+            return obj
 
     def get(self, kind: str, key: str) -> Optional[Any]:
         return self._objects[kind].get(key)
 
     def list(self, kind: str) -> List[Any]:
-        return list(self._objects[kind].values())
+        with self._mu:
+            return list(self._objects[kind].values())
 
     def items(self, kind: str) -> Iterator[Any]:
         return iter(list(self._objects[kind].values()))
@@ -133,7 +206,7 @@ class Store:
         return q
 
     def _notify(self, ev: Event) -> None:
-        import copy
+        from volcano_tpu.api.fastclone import deep_clone
 
         for q in self._watchers[ev.kind]:
             q.append(ev)
@@ -143,7 +216,7 @@ class Store:
         if ev.type == EventType.DELETED:
             self._shadow[ev.kind].pop(ev.obj.meta.key, None)
         else:
-            self._shadow[ev.kind][ev.obj.meta.key] = copy.deepcopy(ev.obj)
+            self._shadow[ev.kind][ev.obj.meta.key] = deep_clone(ev.obj)
 
     def pending_events(self) -> bool:
         return any(q for qs in self._watchers.values() for q in qs)
