@@ -197,3 +197,38 @@ def test_load_conf_rejects_bad_apply_mode():
         load_conf("applyMode: Async\n")
     assert load_conf("applyMode: async\n").apply_mode == "async"
     assert load_conf("actions: allocate\n").apply_mode is None
+
+
+def test_leadership_loss_purges_queued_decisions():
+    """A deposed leader's queued (unapplied) decisions are dropped instead
+    of landing on top of the new leader's placements."""
+
+    class FlappingElector:
+        def __init__(self):
+            self.leader = True
+
+        def try_acquire(self):
+            return self.leader
+
+    store = make_store([])
+    _gang_fixture(store)
+    conf = default_conf(backend="host")
+    conf.apply_mode = "async"
+    elector = FlappingElector()
+    sched = Scheduler(store, conf=conf, elector=elector)
+    gate = threading.Event()
+    orig_bulk = store.bulk
+    store.bulk = lambda ops: (gate.wait(10), orig_bulk(ops))[1]
+    try:
+        sched.run_once()
+        assert len(sched.cache.bind_log) == 3
+        elector.leader = False
+        sched.run_once()  # deposed: purges whatever is still queued
+        assert sched.cache.applier.pending <= sched.cache.applier.batch_max
+    finally:
+        gate.set()
+        sched.cache.applier.flush(timeout=10)
+    # whatever was already inside the store write may have landed (the
+    # reference's goroutine window); everything queued behind it must not
+    bound = sum(1 for p in store.list("Pod") if p.node_name)
+    assert bound <= 3

@@ -24,6 +24,45 @@ NORMAL = "Normal"
 WARNING = "Warning"
 
 
+def scheduled_message(task_key: str, hostname: str) -> str:
+    """The bind event message (cache.go:443) — single source for the sync
+    and async-batched recording paths."""
+    return f"Successfully assigned {task_key} to {hostname}"
+
+
+def evicted_message(reason: str) -> str:
+    """The evict event message (cache.go:401)."""
+    return f"Evicted for {reason}"
+
+
+def record_op(index, involved_kind, involved_key, reason, message, type=NORMAL):
+    """Batched counterpart of ``record``: returns (bulk_op, meta) where
+    bulk_op is a Store.bulk operation recording (or count-aggregating) the
+    event against a caller-owned aggregation ``index`` dict, and meta is
+    ``(index_key, event, is_new)``. New events must join the index only
+    AFTER the store confirms the create — otherwise a failed write leaves
+    the index pointing at an Event that never existed and every later
+    aggregation patches a ghost. On a failed op, pop ``index[index_key]``
+    so the next occurrence re-creates."""
+    idx_key = (involved_kind, involved_key, reason, message)
+    ev = index.get(idx_key)
+    if ev is not None:
+        ev.count += 1
+        return (
+            {"op": "patch", "kind": "Event", "key": ev.meta.key,
+             "fields": {"count": ev.count}},
+            (idx_key, ev, False),
+        )
+    ev = ClusterEvent(
+        meta=Metadata(name=new_uid("event"), namespace=""),
+        involved=(involved_kind, involved_key),
+        reason=reason,
+        message=message,
+        type=type,
+    )
+    return {"op": "create", "kind": "Event", "object": ev}, (idx_key, ev, True)
+
+
 @dataclass
 class ClusterEvent:
     meta: Metadata

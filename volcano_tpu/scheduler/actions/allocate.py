@@ -39,13 +39,15 @@ class AllocateAction(Action):
             return
         self._execute_host(ssn)
 
-    def _execute_host(self, ssn: Session) -> None:
+    def _execute_host(self, ssn: Session, job_filter=None) -> None:
         # Ordering note: the reference holds queues/jobs in lazy binary heaps
         # whose comparisons see mutating DRF/proportion shares only at sift
         # time, so its pop order is a stale approximation of the share
         # ordering. Both backends here re-select the exact best queue/job
         # each iteration instead — same loop, exact ordering (first-minimum
         # on ties, matching the kernel's argmin).
+        # ``job_filter`` restricts the pass to a job subset — the dynamic-
+        # predicate residue after a device solve (tensor_actions.allocate).
         jobs_by_queue = {}
 
         for job in sorted(ssn.jobs.values(), key=lambda j: j.creation_order):
@@ -53,6 +55,8 @@ class AllocateAction(Action):
                 job.pod_group is not None
                 and job.pod_group.status.phase == PodGroupPhase.PENDING
             ):
+                continue
+            if job_filter is not None and not job_filter(job):
                 continue
             queue = ssn.queues.get(job.queue)
             if queue is None:

@@ -21,6 +21,8 @@ import threading
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
+from volcano_tpu import events
+
 #: cap on the event-aggregation index (pod keys churn in a long-lived
 #: daemon; entries beyond this fall back to fresh Event objects)
 EVENT_INDEX_CAP = 4096
@@ -80,6 +82,29 @@ class AsyncApplier:
         could miss it in both and double-schedule."""
         with self._cv:
             return dict(self.inflight_binds), dict(self.inflight_evicts)
+
+    def abort_pending(self) -> int:
+        """Drop every queued (not yet applying) decision and its overlay
+        marker — called on leadership loss so a deposed leader's stale
+        decisions never overwrite the new leader's placements. A batch
+        already inside the store write cannot be recalled (the reference's
+        in-flight bind goroutines have the same window; leader election is
+        cooperative, not a hard fence). Returns the number dropped."""
+        with self._cv:
+            dropped = len(self._q)
+            for verb, key, _ in self._q:
+                left = self._pending.get((verb, key), 1) - 1
+                if left <= 0:
+                    self._pending.pop((verb, key), None)
+                    if verb == "bind":
+                        self.inflight_binds.pop(key, None)
+                    else:
+                        self.inflight_evicts.pop(key, None)
+                else:
+                    self._pending[(verb, key)] = left
+            self._q.clear()
+            self._cv.notify_all()
+        return dropped
 
     def flush(self, timeout: Optional[float] = None) -> bool:
         """Block until every submitted decision has been applied (or failed).
@@ -164,13 +189,14 @@ class AsyncApplier:
                 self.cache._record_err(verb, key, RuntimeError(err))
                 continue
             if verb == "bind":
-                op, meta = self._event_op(
-                    "Pod", key, "Scheduled",
-                    f"Successfully assigned {key} to {arg}", "Normal",
+                op, meta = events.record_op(
+                    self._event_index, "Pod", key, "Scheduled",
+                    events.scheduled_message(key, arg), events.NORMAL,
                 )
             else:
-                op, meta = self._event_op(
-                    "Pod", key, "Evict", f"Evicted for {arg}", "Warning",
+                op, meta = events.record_op(
+                    self._event_index, "Pod", key, "Evict",
+                    events.evicted_message(arg), events.WARNING,
                 )
             ev_ops.append(op)
             ev_meta.append(meta)
@@ -186,44 +212,15 @@ class AsyncApplier:
                 # failed create: do NOT index it, the next occurrence
                 # retries a fresh create; failed count-bump: drop the entry
                 # so the next occurrence re-creates instead of patching a
-                # nonexistent Event forever
+                # nonexistent Event forever (events.record_op contract)
                 self._event_index.pop(idx_key, None)
                 self.cache._record_err(
                     "event", op.get("key", op["kind"]), RuntimeError(err)
                 )
             elif is_new:
-                ev.count = 1
                 self._event_index[idx_key] = ev
                 self._event_index.move_to_end(idx_key)
                 while len(self._event_index) > EVENT_INDEX_CAP:
                     self._event_index.popitem(last=False)
-
-    def _event_op(self, ikind, ikey, reason, message, type_):
-        """A bulk op recording (or count-aggregating) a cluster event —
-        events.record without the per-event store round trip. Returns
-        (op, (index_key, event, is_new)); new events join the index only
-        after the store confirms the create (see _apply)."""
-        from volcano_tpu.api.objects import Metadata, new_uid
-        from volcano_tpu.events import ClusterEvent
-
-        idx_key = (ikind, ikey, reason, message)
-        ev = self._event_index.get(idx_key)
-        if ev is not None:
-            ev.count += 1
-            self._event_index.move_to_end(idx_key)
-            return (
-                {"op": "patch", "kind": "Event", "key": ev.meta.key,
-                 "fields": {"count": ev.count}},
-                (idx_key, ev, False),
-            )
-        ev = ClusterEvent(
-            meta=Metadata(name=new_uid("event"), namespace=""),
-            involved=(ikind, ikey),
-            reason=reason,
-            message=message,
-            type=type_,
-        )
-        return (
-            {"op": "create", "kind": "Event", "object": ev},
-            (idx_key, ev, True),
-        )
+            else:
+                self._event_index.move_to_end(idx_key)

@@ -527,7 +527,7 @@ class SchedulerCache:
         try:
             events.record(
                 self.store, "Pod", task.key, "Scheduled",
-                f"Successfully assigned {task.key} to {hostname}",
+                events.scheduled_message(task.key, hostname),
             )
         except Exception as e:  # noqa: BLE001
             self._record_err("event", task.key, e)
@@ -549,7 +549,7 @@ class SchedulerCache:
         try:
             events.record(
                 self.store, "Pod", task.key, "Evict",
-                f"Evicted for {reason}", type=events.WARNING,
+                events.evicted_message(reason), type=events.WARNING,
             )
         except Exception as e:  # noqa: BLE001
             self._record_err("event", task.key, e)

@@ -85,6 +85,23 @@ class DRFPlugin(Plugin):
             EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
         )
 
+    def resync(self, ssn: Session) -> None:
+        """Recompute shares from current session task state — called after a
+        bulk device apply (which accounts shares on device and skips
+        per-task events) so a host residue pass orders jobs correctly.
+        Pipelined tasks count: the event path charges them via pipeline's
+        allocate event."""
+        from volcano_tpu.api.types import TaskStatus
+
+        for job in ssn.jobs.values():
+            allocated = job.allocated.clone()
+            for t in job.task_status_index.get(TaskStatus.PIPELINED, {}).values():
+                allocated.add(t.resreq)
+            self.job_attrs[job.uid] = {
+                "allocated": allocated,
+                "share": allocated.dominant_share(self.total),
+            }
+
     def on_session_close(self, ssn: Session) -> None:
         self.total = Resource()
         self.job_attrs = {}

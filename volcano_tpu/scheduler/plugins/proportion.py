@@ -160,6 +160,23 @@ class ProportionPlugin(Plugin):
             EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
         )
 
+    def resync(self, ssn: Session) -> None:
+        """Recompute per-queue allocated/share from current session task
+        state after a bulk device apply (deserved shares stay frozen for
+        the cycle, as on the host path). Pipelined tasks count, matching
+        the event path."""
+        for attr in self.queue_attrs.values():
+            attr.allocated = Resource()
+        for job in ssn.jobs.values():
+            attr = self.queue_attrs.get(job.queue)
+            if attr is None:
+                continue
+            attr.allocated.add(job.allocated)
+            for t in job.task_status_index.get(TaskStatus.PIPELINED, {}).values():
+                attr.allocated.add(t.resreq)
+        for attr in self.queue_attrs.values():
+            attr.update_share()
+
     def on_session_close(self, ssn: Session) -> None:
         self.total = Resource()
         self.queue_attrs = {}

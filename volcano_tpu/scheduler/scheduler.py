@@ -53,7 +53,19 @@ class Scheduler:
 
     def run_once(self) -> None:
         if self.elector is not None and not self.elector.try_acquire():
-            return  # standby replica: only the lease holder schedules
+            # standby replica (or deposed leader): only the lease holder
+            # schedules — and any decisions still queued from a lost
+            # leadership must not land on top of the new leader's
+            if self.cache.applier is not None:
+                dropped = self.cache.applier.abort_pending()
+                if dropped:
+                    import logging
+
+                    logging.getLogger("volcano_tpu.scheduler").warning(
+                        "dropped %d queued decisions on leadership loss",
+                        dropped,
+                    )
+            return
         profile_dir = os.environ.get("VOLCANO_TPU_PROFILE")
         if profile_dir and not self._profile_warned:
             # device-level tracing around the whole cycle (SURVEY §5: the

@@ -235,6 +235,15 @@ class Session:
                     return vr
         return None
 
+    def resync_plugin_shares(self) -> None:
+        """Rebuild plugin fair-share state from current session task state.
+        Called after a bulk device apply (shares were accounted on device,
+        per-task events skipped) before any host pass that reads them."""
+        for plugin in self.plugins.values():
+            resync = getattr(plugin, "resync", None)
+            if resync is not None:
+                resync(self)
+
     # -- mutation ops (session.go:194-331) -----------------------------------
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:

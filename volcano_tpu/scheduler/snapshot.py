@@ -88,9 +88,17 @@ class TensorSnapshot:
 
     total: np.ndarray = field(default=None)  # [R] cluster allocatable total
     # true when a pending task uses resident-pod-dependent predicates
-    # (host ports, pod affinity) that per-class masks cannot express;
-    # the tensor backend falls back to the host path in that case
+    # (host ports, pod affinity) or resident-volume constraints that
+    # per-class masks cannot express. The allocate path PARTITIONS: jobs
+    # with such tasks (``dynamic_job_uids``) are excluded from the task
+    # arrays and host-solved after the device pass; preempt/reclaim still
+    # fall back wholesale on this flag (victim pools span running pods).
     has_dynamic_predicates: bool = False
+    dynamic_job_uids: List[str] = field(default_factory=list)
+    # a dynamic job outranks (priority) an express job in its queue: the
+    # device-first partition would invert priority order under contention,
+    # so the allocate path must take the wholesale host fallback instead
+    partition_unsafe: bool = False
 
     # running tasks — the victim pool for preempt/reclaim, in node-resident
     # insertion order (the order the host's node.tasks iteration sees)
@@ -323,6 +331,9 @@ def build_tensor_snapshot(
     task_job_list: List[int] = []
     task_class_list: List[int] = []
     dynamic_predicates = False
+    dynamic_job_uids: List[str] = []
+    queue_max_dynamic_prio: Dict[int, int] = {}
+    queue_min_express_prio: Dict[int, int] = {}
 
     tmp = np.zeros((R,), np.float32)
     for j, job in enumerate(jobs):
@@ -369,6 +380,41 @@ def build_tensor_snapshot(
             pend.sort(key=lambda t: (-t.priority, t.uid))
         else:
             pend.sort(key=lambda t: t.uid)
+
+        # partition at JOB granularity: a job whose pending set contains any
+        # resident-state-dependent task (host ports, pod (anti)affinity,
+        # constraining volumes) is excluded from the device arrays whole —
+        # the host residue pass places it with within-job task order intact
+        # and gang atomicity preserved (SURVEY §7 hard part (c); VERDICT r1
+        # weak #3)
+        job_dynamic = False
+        for t in pend:
+            aff = t.pod.spec.affinity
+            if t.pod.spec.host_ports or (
+                aff and (aff.pod_affinity or aff.pod_anti_affinity)
+            ):
+                job_dynamic = True
+                break
+            if t.pod.volumes and volume_constrains is not None and volume_constrains(t):
+                # bound-PV affinity / static-PV availability is resident
+                # store state the device kernels don't model
+                job_dynamic = True
+                break
+        if job_dynamic and pend:
+            dynamic_predicates = True
+            dynamic_job_uids.append(job.uid)
+            if qi is not None:
+                cur = queue_max_dynamic_prio.get(qi)
+                if cur is None or job.priority > cur:
+                    queue_max_dynamic_prio[qi] = job.priority
+            job_start[j] = len(task_rows)
+            job_ntasks[j] = 0
+            continue
+        if pend and qi is not None:
+            cur = queue_min_express_prio.get(qi)
+            if cur is None or job.priority < cur:
+                queue_min_express_prio[qi] = job.priority
+
         job_start[j] = len(task_rows)
         job_ntasks[j] = len(pend)
         for t in pend:
@@ -379,15 +425,6 @@ def build_tensor_snapshot(
             task_rows.append(t)
             task_job_list.append(j)
             task_class_list.append(classes[key])
-            aff = t.pod.spec.affinity
-            if t.pod.spec.host_ports or (
-                aff and (aff.pod_affinity or aff.pod_anti_affinity)
-            ):
-                dynamic_predicates = True
-            elif t.pod.volumes and volume_constrains is not None and volume_constrains(t):
-                # bound-PV affinity / static-PV availability is resident
-                # store state the device kernels don't model
-                dynamic_predicates = True
 
     T = _bucket(max(len(task_rows), 1))
     task_req = np.zeros((T, R), np.float32)
@@ -513,6 +550,16 @@ def build_tensor_snapshot(
         class_node_score=class_score,
         total=total,
         has_dynamic_predicates=dynamic_predicates,
+        dynamic_job_uids=dynamic_job_uids,
+        # device-first residue would hand contested capacity to LOWER-
+        # priority express jobs if a dynamic job outranks one in its queue;
+        # flag it so allocate takes the exact host path instead. (Equal-
+        # priority interleave divergence under contention remains — the
+        # same approximation class as the reference's stale-heap ordering.)
+        partition_unsafe=any(
+            queue_max_dynamic_prio[qi] > queue_min_express_prio.get(qi, dp)
+            for qi, dp in queue_max_dynamic_prio.items()
+        ),
         run_uids=run_uids,
         run_req=run_req,
         run_node=run_node,

@@ -373,9 +373,16 @@ def allocate(ssn) -> None:
         return
 
     snap = backend.snapshot()
-    if snap.has_dynamic_predicates:
+    # dynamic-predicate jobs were partitioned out of the arrays at snapshot
+    # build; after the device pass they get a host residue pass (below) —
+    # one odd pod no longer forfeits the tensor path for the other 100k
+    residue = set(snap.dynamic_job_uids)
+    if residue and (snap.partition_unsafe or not np.any(snap.task_valid)):
+        # a dynamic job outranks an express job in its queue (device-first
+        # would invert priority under contention), or nothing is
+        # expressible: take the exact host path for the whole cycle
         _host_allocate(ssn)
-        backend.invalidate()  # host path mutated state behind the cache
+        backend.invalidate()
         return
 
     w_least, w_balanced = backend.score_weights()
@@ -449,18 +456,35 @@ def allocate(ssn) -> None:
         ready = np.asarray(out[3])
 
     placed = np.nonzero(task_kind > 0)[0]
-    if placed.size == 0:
-        return
-    order = placed[np.argsort(task_seq[placed])]
-
-    if placed.size <= backend.bulk_threshold:
-        _replay_exact(ssn, snap, order, task_node, task_kind)
-    else:
-        _apply_bulk(
-            ssn, snap, order, task_node, task_kind, ready,
-            use_gang=backend.gang_job_ready,
-        )
+    if not placed.size and not residue:
+        return  # nothing changed: keep the cached snapshot for later actions
+    if placed.size:
+        order = placed[np.argsort(task_seq[placed])]
+        if placed.size <= backend.bulk_threshold:
+            _replay_exact(ssn, snap, order, task_node, task_kind)
+        else:
+            # a residue pass reads host NodeInfo capacity and fair-share
+            # state afterwards, so the bulk apply must maintain both
+            _apply_bulk(
+                ssn, snap, order, task_node, task_kind, ready,
+                use_gang=backend.gang_job_ready,
+                account_nodes=bool(residue),
+            )
+            if residue:
+                ssn.resync_plugin_shares()
+    if residue:
+        _host_allocate_jobs(ssn, residue)
     backend.invalidate()
+
+
+def _host_allocate_jobs(ssn, job_uids) -> None:
+    """Host residue pass over the dynamic-predicate jobs, against session
+    state already advanced by the device pass."""
+    from volcano_tpu.scheduler.actions.allocate import AllocateAction
+
+    AllocateAction()._execute_host(
+        ssn, job_filter=lambda job: job.uid in job_uids
+    )
 
 
 def _replay_exact(ssn, snap, order, task_node, task_kind) -> None:
@@ -483,7 +507,8 @@ def _replay_exact(ssn, snap, order, task_node, task_kind) -> None:
             ssn.pipeline(task, node_name)
 
 
-def _apply_bulk(ssn, snap, order, task_node, task_kind, ready, use_gang=True) -> None:
+def _apply_bulk(ssn, snap, order, task_node, task_kind, ready,
+                use_gang=True, account_nodes=False) -> None:
     """Batch application for bench-scale decision sets.
 
     Binds flow to the cache for all allocated tasks of gang-ready jobs
@@ -491,6 +516,11 @@ def _apply_bulk(ssn, snap, order, task_node, task_kind, ready, use_gang=True) ->
     session object state is updated with O(1) python per task (status +
     node) so close_session writes correct PodGroup statuses. Plugin event
     handlers are NOT fired (shares were already accounted on device).
+
+    ``account_nodes``: also charge placements to host NodeInfo objects —
+    required when a host residue pass will read node capacity afterwards
+    (dynamic-predicate partition); skipped otherwise since close_session
+    never reads node state.
     """
     if use_gang:
         ready_jobs = {
@@ -528,3 +558,6 @@ def _apply_bulk(ssn, snap, order, task_node, task_kind, ready, use_gang=True) ->
                 job.update_task_status(task, TaskStatus.ALLOCATED)
         else:
             job.update_task_status(task, TaskStatus.PIPELINED)
+        if account_nodes:
+            # status set above drives the idle/releasing branch in add_task
+            ssn.nodes[node_name].add_task(task)

@@ -195,7 +195,7 @@ class Store:
             return list(self._objects[kind].values())
 
     def items(self, kind: str) -> Iterator[Any]:
-        return iter(list(self._objects[kind].values()))
+        return iter(self.list(kind))
 
     # -- watch --------------------------------------------------------------
 
