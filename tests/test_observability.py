@@ -88,6 +88,77 @@ def test_unschedulable_condition_clears_and_reevents_on_repeat_episode():
     assert after == before + 1
 
 
+def test_fit_error_aggregate_in_gang_condition():
+    """Gang's Unschedulable condition carries the aggregated fit-error
+    message (gang.go:138-139 + job_info.go:338-373): every node failing
+    resource fit is histogrammed per insufficient dimension."""
+    store = make_store(
+        nodes=[build_node(f"n{i}", cpu="1", memory="2Gi") for i in range(3)],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg", cpu="2")],
+    )
+    Scheduler(store, conf=default_conf()).run_once()
+    pg = store.get("PodGroup", "default/pg")
+    cond = next(c for c in pg.status.conditions if c.kind == "Unschedulable")
+    assert "0/3 nodes are available" in cond.message, cond.message
+    assert "3 insufficient cpu" in cond.message, cond.message
+
+
+def test_fit_error_mixes_predicate_and_resource_reasons():
+    """Predicate failures and resource shortfalls aggregate into one
+    histogram, k8s-scheduler style."""
+    n_sel = build_node("sel", cpu="8", memory="16Gi", labels={"zone": "a"})
+    small = [build_node(f"small{i}", cpu="1", memory="2Gi") for i in range(2)]
+    pod = build_pod("p0", group="pg", cpu="2")
+    pod.spec.node_selector = {"zone": "b"}
+    store = make_store(
+        nodes=[n_sel] + small,
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[pod],
+    )
+    Scheduler(store, conf=default_conf()).run_once()
+    pg = store.get("PodGroup", "default/pg")
+    cond = next(c for c in pg.status.conditions if c.kind == "Unschedulable")
+    assert "0/3 nodes are available" in cond.message, cond.message
+    assert "2 insufficient cpu" in cond.message, cond.message
+    assert "1 node(s) didn't match node selector" in cond.message, cond.message
+
+
+def test_fit_error_aggregate_tensor_path():
+    """The device solve leaves unplaced jobs with a lazy fit-error producer
+    rendering the same aggregate shape as the host path."""
+    from volcano_tpu.scheduler.conf import default_conf as dc
+
+    store = make_store(
+        nodes=[build_node(f"n{i}", cpu="1", memory="2Gi") for i in range(3)],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg", cpu="2")],
+    )
+    Scheduler(store, conf=dc("tpu")).run_once()
+    pg = store.get("PodGroup", "default/pg")
+    cond = next(c for c in pg.status.conditions if c.kind == "Unschedulable")
+    assert "0/3 nodes are available" in cond.message, cond.message
+    assert "insufficient cpu" in cond.message, cond.message
+
+
+def test_backfill_unschedulable_event_carries_fit_error():
+    """A best-effort task with no feasible node records a Warning event on
+    its PodGroup with the aggregated reasons (the backfill analogue of
+    RecordJobStatusEvent, cache.go:622-638)."""
+    pod = build_pod("p0", group="pg", cpu="0", memory="0")
+    pod.spec.node_selector = {"zone": "nowhere"}
+    store = make_store(
+        nodes=[build_node("n1"), build_node("n2")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[pod],
+    )
+    Scheduler(store, conf=default_conf()).run_once()
+    evs = events.events_for(store, "PodGroup", "default/pg")
+    ev = next(e for e in evs if e.reason == "Unschedulable")
+    assert "0/2 nodes are available" in ev.message, ev.message
+    assert "2 node(s) didn't match node selector" in ev.message, ev.message
+
+
 def test_command_issued_event():
     from volcano_tpu.cli.vtctl import cmd_run, cmd_suspend
     from volcano_tpu.sim import Cluster

@@ -196,3 +196,31 @@ def test_partition_unsafe_when_dynamic_job_outranks_express():
     binds = _run(store_mk(), "tpu")
     assert binds == {"default/hi-0": "n0"}  # priority respected
     assert _run(store_mk(), "host") == binds
+
+
+def test_bulk_apply_forces_exact_replay_for_foreign_handlers():
+    """An event handler registered by anything other than the device-modeled
+    plugins (drf/proportion) must see every allocate decision: the bulk
+    apply path (which skips per-task events) is bypassed in favor of exact
+    replay even above the bulk threshold."""
+    from volcano_tpu.scheduler import tensor_actions
+    from volcano_tpu.scheduler.cache import SchedulerCache
+    from volcano_tpu.scheduler.framework import open_session
+    from volcano_tpu.scheduler.session import EventHandler
+    from volcano_tpu.scheduler.tensor_backend import TensorBackend
+
+    nodes = [build_node(f"n{i}", cpu="4", memory="8Gi") for i in range(2)]
+    podgroups = [build_podgroup("ej", min_member=3)]
+    pods = [build_pod(f"ej-{t}", group="ej", cpu="1", memory="1Gi")
+            for t in range(3)]
+    store = make_store(nodes=nodes, queues=[build_queue("default")],
+                       podgroups=podgroups, pods=pods)
+    cache = SchedulerCache(store)
+    ssn = open_session(cache, default_conf(backend="tpu").tiers)
+    ssn.tensor_backend = TensorBackend(ssn, bulk_threshold=0)
+    seen = []
+    ssn.add_event_handler(
+        EventHandler(allocate_func=lambda e: seen.append(e.task.key))
+    )
+    tensor_actions.allocate(ssn)
+    assert sorted(seen) == [f"default/ej-{t}" for t in range(3)]

@@ -103,6 +103,26 @@ def record(
     return store.create("Event", ev)
 
 
+def record_once(
+    store,
+    involved_kind: str,
+    involved_key: str,
+    reason: str,
+    message: str,
+    type: str = NORMAL,
+) -> ClusterEvent:
+    """``record`` but idempotent: a repeat of an identical (involved,
+    reason, message) is a no-op instead of a count bump.  For per-cycle
+    re-emission of a steady condition (e.g. a parked best-effort task) the
+    store stays untouched, so the cluster can quiesce."""
+    idx = getattr(store, "_event_index", None)
+    if idx is not None:
+        ev = idx.get((involved_kind, involved_key, reason, message))
+        if ev is not None and store.get("Event", ev.meta.key) is not None:
+            return ev
+    return record(store, involved_kind, involved_key, reason, message, type)
+
+
 def events_for(store, involved_kind: str, involved_key: str):
     """All events recorded about one object, oldest first."""
     out = [

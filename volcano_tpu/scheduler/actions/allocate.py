@@ -28,6 +28,22 @@ from volcano_tpu.scheduler.pqueue import PriorityQueue
 from volcano_tpu.scheduler.session import Session
 
 
+def _fit_failure_reason(task, node) -> str:
+    """Canonical per-dimension resource-fit failure, "; "-joined so
+    util.predicate_nodes histograms each insufficient dimension separately
+    (the job_info.go:345-357 reason scheme)."""
+    req, idle = task.init_resreq, node.idle
+    dims = []
+    if req.milli_cpu > idle.milli_cpu:
+        dims.append("insufficient cpu")
+    if req.memory > idle.memory:
+        dims.append("insufficient memory")
+    for name, v in req.scalars.items():
+        if v > idle.scalars.get(name, 0.0):
+            dims.append(f"insufficient {name}")
+    return "; ".join(dims) or "insufficient resources"
+
+
 class AllocateAction(Action):
     name = "allocate"
 
@@ -74,7 +90,7 @@ class AllocateAction(Action):
                 task.init_resreq.less_equal(node.idle)
                 or task.init_resreq.less_equal(node.releasing)
             ):
-                return f"task {task.key} resource fit failed on {node.name}"
+                return _fit_failure_reason(task, node)
             return ssn.predicate_fn(task, node)
 
         def job_tasks(job):
@@ -126,9 +142,13 @@ class AllocateAction(Action):
             if job.nodes_fit_delta:
                 job.nodes_fit_delta = {}
 
-            feasible = util.predicate_nodes(task, all_nodes, predicate_fn)
+            reasons: dict = {}
+            feasible = util.predicate_nodes(task, all_nodes, predicate_fn, reasons)
             if not feasible:
-                # head task unschedulable: drop the job for this cycle
+                # head task unschedulable: record the reason histogram for
+                # fit_error() reporting and drop the job for this cycle
+                job.fit_errors = reasons
+                job.fit_total_nodes = len(all_nodes)
                 jobs_by_queue[job.queue] = [
                     j for j in jobs_by_queue.get(job.queue, ()) if j.uid != job.uid
                 ]

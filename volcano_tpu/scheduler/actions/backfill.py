@@ -7,8 +7,10 @@ Parity: reference KB/pkg/scheduler/actions/backfill/backfill.go:41-78.
 from __future__ import annotations
 
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.scheduler import util
 from volcano_tpu.scheduler.cache import VolumeBindingError
 from volcano_tpu.scheduler.framework import Action
+from volcano_tpu.scheduler.model import render_fit_error
 from volcano_tpu.scheduler.session import Session
 
 
@@ -22,16 +24,45 @@ class BackfillAction(Action):
                 and job.pod_group.status.phase == PodGroupPhase.PENDING
             ):
                 continue
+            all_nodes = util.get_node_list(ssn.nodes)
             for task in list(
                 job.task_status_index.get(TaskStatus.PENDING, {}).values()
             ):
                 if not task.init_resreq.is_empty():
                     continue
-                for node in ssn.nodes.values():
-                    if ssn.predicate_fn(task, node) is not None:
-                        continue
+                reasons: dict = {}
+                placed = False
+                feasible = util.predicate_nodes(
+                    task, all_nodes, ssn.predicate_fn, reasons
+                )
+                for node in feasible:
                     try:
                         ssn.allocate(task, node.name)
                     except VolumeBindingError:
+                        reasons["volume binding failed"] = (
+                            reasons.get("volume binding failed", 0) + 1
+                        )
                         continue  # try the next node
+                    placed = True
                     break
+                if not placed:
+                    # surface the aggregated reasons: keep allocate's
+                    # head-task histogram if it recorded one (that is what
+                    # blocks the gang), and record a Warning event for this
+                    # task — idempotently, so a parked task never prevents
+                    # the cluster from quiescing
+                    if not job.fit_errors and not job.nodes_fit_delta:
+                        job.fit_errors = reasons
+                        job.fit_total_nodes = len(all_nodes)
+                    msg = (
+                        render_fit_error(len(all_nodes), reasons)
+                        if reasons else "0 nodes are available"
+                    )
+                    from volcano_tpu import events
+
+                    events.record_once(
+                        ssn.cache.store, "PodGroup",
+                        f"{job.namespace}/{job.name}", "Unschedulable",
+                        f"task {task.key} unschedulable: {msg}",
+                        type=events.WARNING,
+                    )

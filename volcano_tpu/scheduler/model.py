@@ -15,7 +15,7 @@ Parity sources (behavior, not code):
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from volcano_tpu.api.objects import Node, Pod, PodGroup, Queue
 from volcano_tpu.api.resource import Resource
@@ -64,6 +64,13 @@ class TaskInfo:
         )
 
 
+def render_fit_error(total_nodes: int, reasons: Dict[str, int]) -> str:
+    """The "0/N nodes are available, <count> <reason>, ..." aggregate
+    (job_info.go:338-373's format, reasons sorted for determinism)."""
+    parts = sorted(f"{count} {reason}" for reason, count in reasons.items())
+    return f"0/{total_nodes} nodes are available, {', '.join(parts)}."
+
+
 class JobInfo:
     """A PodGroup + its member tasks, with per-status indexing."""
 
@@ -80,7 +87,14 @@ class JobInfo:
         self.total_request = Resource()
         self.allocated = Resource()
         self.nodes_fit_delta: Dict[str, Resource] = {}
-        self.fit_errors: List[str] = []
+        # reason -> node count histogram for the head pending task that
+        # could not be placed this cycle (job_info.go:338-373 analogue)
+        self.fit_errors: Dict[str, int] = {}
+        self.fit_total_nodes = 0
+        # tensor path: lazy histogram producer () -> (total_nodes, reasons),
+        # evaluated (and cached into fit_errors) on first fit_error() call so
+        # the per-job numpy reductions only run for jobs someone reports on
+        self.fit_error_fn: Optional[Callable[[], Tuple[int, Dict[str, int]]]] = None
         self.creation_order = 0
 
     # -- membership ---------------------------------------------------------
@@ -135,6 +149,43 @@ class JobInfo:
             or status
             in (TaskStatus.SUCCEEDED, TaskStatus.PIPELINED, TaskStatus.PENDING)
         )
+
+    def fit_error(self) -> str:
+        """Aggregated unschedulable message: "0/N nodes are available,
+        <count> <reason>, ...".  Sources, in precedence order: the reason
+        histogram collected by allocate/backfill predicate sweeps
+        (fit_errors), insufficient-dimension counts from nodes_fit_delta
+        (job_info.go:338-373), or the tensor path's lazy producer.
+
+        Returns "" when this cycle produced no fit data for the job (e.g.
+        it was quota-blocked and allocate never examined it) — unlike the
+        reference's misleading "0 nodes are available" fallback, callers
+        append nothing rather than send operators chasing node capacity.
+        """
+        if (
+            self.fit_error_fn is not None
+            and not self.fit_errors
+            and not self.nodes_fit_delta
+        ):
+            self.fit_total_nodes, produced = self.fit_error_fn()
+            self.fit_errors = dict(produced)
+            self.fit_error_fn = None  # evaluate once, even when empty
+        reasons = dict(self.fit_errors)
+        for delta in self.nodes_fit_delta.values():
+            if delta.milli_cpu < 0:
+                reasons["insufficient cpu"] = reasons.get("insufficient cpu", 0) + 1
+            if delta.memory < 0:
+                reasons["insufficient memory"] = (
+                    reasons.get("insufficient memory", 0) + 1
+                )
+            for name, v in delta.scalars.items():
+                if v < 0:
+                    key = f"insufficient {name}"
+                    reasons[key] = reasons.get(key, 0) + 1
+        if not reasons:
+            return ""
+        total = max(self.fit_total_nodes, len(self.nodes_fit_delta))
+        return render_fit_error(total, reasons)
 
     def ready(self) -> bool:
         return self.ready_task_num() >= self.min_available

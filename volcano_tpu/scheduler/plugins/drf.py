@@ -82,7 +82,8 @@ class DRFPlugin(Plugin):
             attr["share"] = attr["allocated"].dominant_share(self.total)
 
         ssn.add_event_handler(
-            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+            EventHandler(allocate_func=on_allocate,
+                         deallocate_func=on_deallocate, owner="drf")
         )
 
     def resync(self, ssn: Session) -> None:

@@ -82,12 +82,17 @@ class GangPlugin(Plugin):
                 if job.pod_group is not None:
                     from volcano_tpu.api.objects import PodGroupCondition
 
+                    # gang.go:138-139 appends FitError(); "" means the cycle
+                    # produced no fit data (quota-blocked job) — append
+                    # nothing rather than a misleading "0 nodes" claim
+                    fe = job.fit_error()
                     cond = PodGroupCondition(
                         kind="Unschedulable",
                         status="True",
                         reason=NOT_ENOUGH_RESOURCES,
                         message=(
-                            f"{unready}/{len(job.tasks)} tasks in gang unschedulable"
+                            f"{unready}/{len(job.tasks)} tasks in gang "
+                            f"unschedulable" + (f": {fe}" if fe else "")
                         ),
                     )
                     prev = next(

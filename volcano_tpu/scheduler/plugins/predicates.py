@@ -82,25 +82,27 @@ class PredicatesPlugin(Plugin):
 
     def on_session_open(self, ssn: Session) -> None:
         def predicate_fn(task: TaskInfo, node: NodeInfo) -> Optional[str]:
+            # reasons are canonical (node-free) so JobInfo.fit_error() can
+            # histogram them across nodes; the caller knows which node failed
             n = node.node
             max_tasks = node.allocatable.max_task_num
             if max_tasks is not None and len(node.tasks) + 1 > max_tasks:
-                return f"node {node.name} task number exceeded"
+                return "node(s) had too many tasks"
             if not n.ready():
-                return f"node {node.name} not ready"
+                return "node(s) were not ready"
             if n.unschedulable:
-                return f"node {node.name} unschedulable"
+                return "node(s) were unschedulable"
             if not node_selector_fits(task, node):
-                return f"node(s) didn't match node selector on {node.name}"
+                return "node(s) didn't match node selector"
             if not host_ports_free(task, node):
-                return f"host port conflict on {node.name}"
+                return "node(s) didn't have free ports"
             if not taints_tolerated(task, node):
-                return f"taints not tolerated on {node.name}"
+                return "node(s) had taints that the pod didn't tolerate"
             for cond in n.conditions:
                 if cond.kind in PRESSURE_CONDITIONS and cond.status == "True":
-                    return f"node {node.name} under {cond.kind}"
+                    return f"node(s) had {cond.kind}"
             if not pod_affinity_fits(task, node):
-                return f"pod affinity/anti-affinity mismatch on {node.name}"
+                return "node(s) didn't satisfy pod affinity/anti-affinity"
             # volume binding predicate: bound-PV node affinity / static-PV
             # availability (the k8s CheckVolumeBinding analogue; the
             # reference reaches it through the VolumeBinder seam instead,

@@ -157,7 +157,8 @@ class ProportionPlugin(Plugin):
                 attr.update_share()
 
         ssn.add_event_handler(
-            EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+            EventHandler(allocate_func=on_allocate,
+                         deallocate_func=on_deallocate, owner="proportion")
         )
 
     def resync(self, ssn: Session) -> None:

@@ -36,6 +36,10 @@ class Event:
 class EventHandler:
     allocate_func: Optional[Callable[[Event], None]] = None
     deallocate_func: Optional[Callable[[Event], None]] = None
+    # registering plugin; the bulk device-apply path only skips per-task
+    # events for plugins whose accounting it models on device (and resyncs
+    # after) — an unknown owner forces the exact replay path
+    owner: str = ""
 
 
 @dataclass

@@ -456,11 +456,19 @@ def allocate(ssn) -> None:
         ready = np.asarray(out[3])
 
     placed = np.nonzero(task_kind > 0)[0]
+    _set_fit_error_fns(ssn, snap, task_node, task_kind, placed)
     if not placed.size and not residue:
         return  # nothing changed: keep the cached snapshot for later actions
     if placed.size:
         order = placed[np.argsort(task_seq[placed])]
-        if placed.size <= backend.bulk_threshold:
+        # the bulk path skips per-task allocate events, which is only sound
+        # for plugins whose accounting the kernels model on device (drf,
+        # proportion — resynced after); a handler from any other plugin
+        # forces the exact replay so it observes every decision
+        foreign_handlers = any(
+            eh.owner not in ("drf", "proportion") for eh in ssn.event_handlers
+        )
+        if placed.size <= backend.bulk_threshold or foreign_handlers:
             _replay_exact(ssn, snap, order, task_node, task_kind)
         else:
             # a residue pass reads host NodeInfo capacity and fair-share
@@ -475,6 +483,54 @@ def allocate(ssn) -> None:
     if residue:
         _host_allocate_jobs(ssn, residue)
     backend.invalidate()
+
+
+def _set_fit_error_fns(ssn, snap, task_node, task_kind, placed) -> None:
+    """Attach a lazy fit-error histogram producer to every express job the
+    solve left with unplaced pending tasks, so gang's close-time condition
+    and RecordJobStatusEvent-style reporting render the same
+    "0/N nodes are available, ..." aggregate as the host path
+    (job_info.go:338-373).  Lazy: the per-job [N,R] numpy reductions only
+    run if something actually reports on the job."""
+    unplaced = np.nonzero(snap.task_valid & (task_kind == 0))[0]
+    if not unplaced.size:
+        return
+    # post-solve idle: allocations (kind 1) consume idle; pipelines (kind 2)
+    # consume releasing space and leave idle untouched
+    alloc_rows = placed[task_kind[placed] == 1]
+    idle_after = snap.node_idle.copy()
+    if alloc_rows.size:
+        np.subtract.at(
+            idle_after, task_node[alloc_rows], snap.task_req[alloc_rows]
+        )
+    seen = set()
+    for t in unplaced:
+        j = int(snap.task_job[t])
+        if j in seen:
+            continue
+        seen.add(j)
+        job = ssn.jobs.get(snap.job_uids[j])
+        if job is not None:
+            job.fit_error_fn = _fit_error_producer(snap, idle_after, int(t))
+
+
+def _fit_error_producer(snap, idle_after, t):
+    def produce():
+        valid = snap.node_valid.astype(bool)
+        total = int(valid.sum())
+        mask = snap.class_node_mask[int(snap.task_class[t])].astype(bool) & valid
+        reasons = {}
+        excluded = total - int(mask.sum())
+        if excluded:
+            reasons["node(s) excluded by predicates"] = excluded
+        insufficient = idle_after < snap.task_req[t][None, :]  # [N, R]
+        for r, dim in enumerate(snap.dims):
+            count = int((insufficient[:, r] & mask).sum())
+            if count:
+                reasons[f"insufficient {dim}"] = count
+        return total, reasons
+
+    return produce
 
 
 def _host_allocate_jobs(ssn, job_uids) -> None:

@@ -15,9 +15,26 @@ from volcano_tpu.scheduler.model import NodeInfo, TaskInfo
 
 
 def predicate_nodes(
-    task: TaskInfo, nodes: List[NodeInfo], fn: Callable[[TaskInfo, NodeInfo], Optional[str]]
+    task: TaskInfo,
+    nodes: List[NodeInfo],
+    fn: Callable[[TaskInfo, NodeInfo], Optional[str]],
+    reasons: Optional[Dict[str, int]] = None,
 ) -> List[NodeInfo]:
-    return [n for n in nodes if fn(task, n) is None]
+    """Nodes passing ``fn``.  When ``reasons`` is given, failure messages are
+    histogrammed into it (reason -> node count) for JobInfo.fit_error();
+    multi-reason messages are "; "-joined by convention and counted per part.
+    """
+    if reasons is None:
+        return [n for n in nodes if fn(task, n) is None]
+    feasible = []
+    for n in nodes:
+        msg = fn(task, n)
+        if msg is None:
+            feasible.append(n)
+        else:
+            for part in msg.split("; "):
+                reasons[part] = reasons.get(part, 0) + 1
+    return feasible
 
 
 def prioritize_nodes(
