@@ -38,8 +38,10 @@ def main():
             min_available=2,
             tasks=[TaskSpec(
                 name="worker", replicas=2,
-                template=PodSpec(resources=Resource.from_resource_list(
-                    {"cpu": "2", "memory": "4Gi"})),
+                template=PodSpec(
+                    image="busybox",
+                    resources=Resource.from_resource_list(
+                        {"cpu": "2", "memory": "4Gi"})),
             )],
             volumes=[
                 VolumeSpec(mount_path="/scratch", size="50Gi",

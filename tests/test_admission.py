@@ -20,7 +20,8 @@ def mk_task(name="main", replicas=1, policies=None):
     return TaskSpec(
         name=name,
         replicas=replicas,
-        template=PodSpec(resources=Resource.from_resource_list({"cpu": "1"})),
+        template=PodSpec(image="busybox",
+                         resources=Resource.from_resource_list({"cpu": "1"})),
         policies=policies or [],
     )
 
@@ -175,7 +176,8 @@ def test_update_exemption_limited_to_generated_claim_names():
             meta=Metadata(name="j", namespace="d"),
             spec=JobSpec(
                 min_available=1,
-                tasks=[TaskSpec(name="t", replicas=1, template=PodSpec())],
+                tasks=[TaskSpec(name="t", replicas=1,
+                                template=PodSpec(image="busybox"))],
                 volumes=[VolumeSpec(mount_path="/x", size="1Gi",
                                     volume_claim_name=claim)],
             ),
@@ -189,3 +191,77 @@ def test_update_exemption_limited_to_generated_claim_names():
     # overwriting an existing name is frozen even if it matches the pattern
     ok, _ = validate_job_update(mk("j-pvc-0"), mk("other"))
     assert not ok
+
+
+# -- PodTemplate field validation (admit_job.go:160-193) ---------------------
+
+def mk_tmpl_job(**tmpl_kw):
+    tmpl_kw.setdefault("image", "busybox")
+    tmpl_kw.setdefault("resources", Resource.from_resource_list({"cpu": "1"}))
+    return mk_job(tasks=[TaskSpec(name="main", replicas=1,
+                                  template=PodSpec(**tmpl_kw))])
+
+
+def test_template_missing_image_rejected():
+    ok, msg = validate_job(mk_tmpl_job(image=""))
+    assert not ok and "image: Required value" in msg and "spec.task[0]" in msg
+
+
+def test_template_bad_restart_policy_rejected():
+    ok, msg = validate_job(mk_tmpl_job(restart_policy="WheneverConvenient"))
+    assert not ok and "restartPolicy" in msg
+
+
+def test_template_negative_resource_rejected():
+    ok, msg = validate_job(mk_tmpl_job(resources=Resource(-100, 1 << 30)))
+    assert not ok and "resources.cpu" in msg and "non-negative" in msg
+
+
+def test_template_negative_scalar_rejected():
+    ok, msg = validate_job(
+        mk_tmpl_job(resources=Resource(100, 0, {"tpu.dev/v5e": -1.0}))
+    )
+    assert not ok and "tpu.dev/v5e" in msg
+
+
+def test_template_nan_and_inf_rejected():
+    ok, msg = validate_job(mk_tmpl_job(resources=Resource(float("nan"), 0)))
+    assert not ok and "resources.cpu" in msg
+    ok, msg = validate_job(
+        mk_tmpl_job(init_resources=Resource(0, float("inf")))
+    )
+    assert not ok and "initResources.memory" in msg
+
+
+def test_template_host_port_range_and_duplicates_rejected():
+    ok, msg = validate_job(mk_tmpl_job(host_ports=[0]))
+    assert not ok and "between 1 and 65535" in msg
+    ok, msg = validate_job(mk_tmpl_job(host_ports=[70000]))
+    assert not ok
+    ok, msg = validate_job(mk_tmpl_job(host_ports=[8080, 8080]))
+    assert not ok and "duplicate port 8080" in msg
+
+
+def test_template_bad_toleration_rejected():
+    from volcano_tpu.api.objects import Toleration
+
+    ok, msg = validate_job(
+        mk_tmpl_job(tolerations=[Toleration(key="k", operator="Sometimes")])
+    )
+    assert not ok and "tolerations.operator" in msg
+    ok, msg = validate_job(
+        mk_tmpl_job(tolerations=[Toleration(key="k", operator="Exists",
+                                            value="v")])
+    )
+    assert not ok and "must be empty" in msg
+
+
+def test_template_valid_passes():
+    from volcano_tpu.api.objects import Toleration
+
+    ok, msg = validate_job(mk_tmpl_job(
+        host_ports=[8080, 9090],
+        tolerations=[Toleration(key="k", operator="Exists")],
+        restart_policy="Never",
+    ))
+    assert ok, msg

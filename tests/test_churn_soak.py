@@ -84,7 +84,8 @@ def test_churn_soak_tpu_backend():
         if action < 0.45 or not live_jobs:
             name = f"j{step}"
             replicas = int(rng.integers(1, 4))
-            tmpl = PodSpec(resources=Resource.from_resource_list(
+            tmpl = PodSpec(image="busybox",
+                           resources=Resource.from_resource_list(
                 {"cpu": str(int(rng.integers(1, 3))), "memory": "1Gi"}))
             if rng.random() < 0.4:
                 tmpl.node_selector = {"zone": f"z{int(rng.integers(0, 2))}"}

@@ -25,7 +25,8 @@ def mk_job(name, tasks, min_available=None, policies=None, plugins=None,
         TaskSpec(
             name=tname,
             replicas=replicas,
-            template=PodSpec(resources=Resource.from_resource_list(req)),
+            template=PodSpec(image="busybox",
+                             resources=Resource.from_resource_list(req)),
             policies=tpolicies or [],
         )
         for tname, replicas, req, tpolicies in tasks

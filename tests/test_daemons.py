@@ -120,7 +120,8 @@ def test_daemon_processes_run_job_end_to_end(tmp_path):
             spec=JobSpec(
                 min_available=1,
                 tasks=[TaskSpec(name="main", replicas=1,
-                                template=PodSpec(resources=Resource.from_resource_list(
+                                template=PodSpec(image="busybox",
+                                    resources=Resource.from_resource_list(
                                     {"cpu": "1", "memory": "1Gi"})))],
                 volumes=[VolumeSpec(mount_path="/x", size="5Gi",
                                     storage_class="local")],

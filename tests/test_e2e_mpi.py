@@ -33,7 +33,7 @@ def mpi_job(name="mpi-hello", workers=2):
                 TaskSpec(
                     name="mpimaster",
                     replicas=1,
-                    template=PodSpec(resources=req.clone()),
+                    template=PodSpec(image="busybox", resources=req.clone()),
                     policies=[
                         LifecyclePolicy(
                             action=JobAction.COMPLETE_JOB,
@@ -44,7 +44,7 @@ def mpi_job(name="mpi-hello", workers=2):
                 TaskSpec(
                     name="mpiworker",
                     replicas=workers,
-                    template=PodSpec(resources=req.clone()),
+                    template=PodSpec(image="busybox", resources=req.clone()),
                 ),
             ],
         ),

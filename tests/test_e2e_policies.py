@@ -26,6 +26,7 @@ def mk_job(name, replicas=2, policies=None, task_policies=None, max_retry=3):
                     name="main",
                     replicas=replicas,
                     template=PodSpec(
+                        image="busybox",
                         resources=Resource.from_resource_list(
                             {"cpu": "1", "memory": "1Gi"}
                         )

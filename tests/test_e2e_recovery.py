@@ -31,6 +31,7 @@ def mk_job(name, replicas, cpu="1", min_available=None, policies=None):
                     name="main",
                     replicas=replicas,
                     template=PodSpec(
+                        image="busybox",
                         resources=Resource.from_resource_list(
                             {"cpu": cpu, "memory": "1Gi"}
                         )

@@ -48,7 +48,8 @@ def make_job(name="j1", namespace="default", replicas=2, min_available=2):
                 TaskSpec(
                     name="task",
                     replicas=replicas,
-                    template=PodSpec(resources=Resource(1000, 1 << 30)),
+                    template=PodSpec(image="busybox",
+                                     resources=Resource(1000, 1 << 30)),
                 )
             ],
         ),

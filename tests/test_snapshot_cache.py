@@ -22,7 +22,8 @@ from volcano_tpu.sim import Cluster
 
 
 def mk_job(name, replicas, req, selector=None):
-    tmpl = PodSpec(resources=Resource.from_resource_list(req))
+    tmpl = PodSpec(image="busybox",
+                   resources=Resource.from_resource_list(req))
     if selector:
         tmpl.node_selector = dict(selector)
     return Job(

@@ -26,7 +26,8 @@ def mk_job(name, replicas, req, volumes=None, min_available=None, queue="default
                 TaskSpec(
                     name="main",
                     replicas=replicas,
-                    template=PodSpec(resources=Resource.from_resource_list(req)),
+                    template=PodSpec(image="busybox",
+                                     resources=Resource.from_resource_list(req)),
                 )
             ],
             volumes=volumes or [],

@@ -12,6 +12,7 @@ from volcano_tpu.admission.admit import (
     mutate_job,
     validate_job,
     validate_job_update,
+    validate_task_template,
 )
 
 __all__ = [
@@ -20,4 +21,5 @@ __all__ = [
     "mutate_job",
     "validate_job",
     "validate_job_update",
+    "validate_task_template",
 ]

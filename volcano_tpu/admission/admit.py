@@ -97,6 +97,65 @@ def validate_policies(policies: List[LifecyclePolicy]) -> List[str]:
     return errs
 
 
+#: k8s core validation's allowed pod restart policies
+VALID_RESTART_POLICIES = ("Always", "OnFailure", "Never")
+
+
+def validate_task_template(task, index: int) -> List[str]:
+    """Per-task PodTemplate field validation — the tpu-native analogue of
+    the reference's full k8s ValidatePodTemplate call
+    (admit_job.go:167-193): every field our PodSpec models is checked the
+    way k8s core validation would check the corresponding template field.
+    Quantity *parse* errors surface earlier, at Resource.from_resource_list
+    time; here the parsed values are range-checked."""
+    import math
+
+    msgs: List[str] = []
+    prefix = f"spec.task[{index}]."
+    tpl = task.template
+    if not tpl.image:
+        msgs.append(prefix + "template.spec.image: Required value")
+    if tpl.restart_policy not in VALID_RESTART_POLICIES:
+        msgs.append(
+            prefix + f"template.spec.restartPolicy: Unsupported value: "
+            f"{tpl.restart_policy!r}"
+        )
+    for label, res in (
+        ("resources", tpl.resources),
+        ("initResources", tpl.init_resources),
+    ):
+        dims = [("cpu", res.milli_cpu), ("memory", res.memory)]
+        dims.extend(res.scalars.items())
+        for dim, value in dims:
+            if not (value >= 0) or math.isinf(value):  # NaN fails >= too
+                msgs.append(
+                    prefix + f"template.spec.{label}.{dim}: must be a "
+                    f"non-negative finite quantity, got {value}"
+                )
+    seen_ports = set()
+    for port in tpl.host_ports:
+        if not 0 < port <= 65535:
+            msgs.append(
+                prefix + f"template.spec.hostPort: {port} must be "
+                "between 1 and 65535, inclusive"
+            )
+        elif port in seen_ports:
+            msgs.append(prefix + f"template.spec.hostPort: duplicate port {port}")
+        seen_ports.add(port)
+    for tol in tpl.tolerations:
+        if tol.operator not in ("Equal", "Exists"):
+            msgs.append(
+                prefix + "template.spec.tolerations.operator: "
+                f"Unsupported value: {tol.operator!r}"
+            )
+        elif tol.operator == "Exists" and tol.value:
+            msgs.append(
+                prefix + "template.spec.tolerations.value: must be empty "
+                "when `operator` is 'Exists'"
+            )
+    return msgs
+
+
 def validate_io(volumes) -> Optional[str]:
     seen = set()
     for volume in volumes:
@@ -120,7 +179,7 @@ def validate_job(job: Job) -> Tuple[bool, str]:
 
     total_replicas = 0
     task_names = set()
-    for task in job.spec.tasks:
+    for index, task in enumerate(job.spec.tasks):
         if task.replicas <= 0:
             msgs.append(f"'replicas' is not set positive in task: {task.name}")
         total_replicas += max(task.replicas, 0)
@@ -136,6 +195,7 @@ def validate_job(job: Job) -> Tuple[bool, str]:
         if dup:
             msgs.append(f"duplicated task event policies: {dup}")
         msgs.extend(validate_policies(task.policies))
+        msgs.extend(validate_task_template(task, index))
 
     if total_replicas < job.spec.min_available:
         msgs.append(

@@ -173,6 +173,7 @@ class AllocateAction(Action):
                 delta = node.idle.clone()
                 delta.fit_delta(task.init_resreq)
                 job.nodes_fit_delta[node.name] = delta
+                job.fit_total_nodes = len(all_nodes)
                 if task.init_resreq.less_equal(node.releasing):
                     ssn.pipeline(task, node.name)
 

@@ -6,6 +6,7 @@ Parity: reference KB/pkg/scheduler/actions/backfill/backfill.go:41-78.
 
 from __future__ import annotations
 
+from volcano_tpu import events
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 from volcano_tpu.scheduler import util
 from volcano_tpu.scheduler.cache import VolumeBindingError
@@ -18,13 +19,13 @@ class BackfillAction(Action):
     name = "backfill"
 
     def execute(self, ssn: Session) -> None:
+        all_nodes = util.get_node_list(ssn.nodes)
         for job in list(ssn.jobs.values()):
             if (
                 job.pod_group is not None
                 and job.pod_group.status.phase == PodGroupPhase.PENDING
             ):
                 continue
-            all_nodes = util.get_node_list(ssn.nodes)
             for task in list(
                 job.task_status_index.get(TaskStatus.PENDING, {}).values()
             ):
@@ -51,15 +52,17 @@ class BackfillAction(Action):
                     # blocks the gang), and record a Warning event for this
                     # task — idempotently, so a parked task never prevents
                     # the cluster from quiescing
-                    if not job.fit_errors and not job.nodes_fit_delta:
+                    if (
+                        not job.fit_errors
+                        and not job.nodes_fit_delta
+                        and job.fit_error_fn is None
+                    ):
                         job.fit_errors = reasons
                         job.fit_total_nodes = len(all_nodes)
                     msg = (
                         render_fit_error(len(all_nodes), reasons)
                         if reasons else "0 nodes are available"
                     )
-                    from volcano_tpu import events
-
                     events.record_once(
                         ssn.cache.store, "PodGroup",
                         f"{job.namespace}/{job.name}", "Unschedulable",

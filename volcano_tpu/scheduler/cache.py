@@ -235,12 +235,14 @@ class VolumeBinder:
     # -- the predicate face --------------------------------------------------
 
     def volume_fit(self, task: TaskInfo, node) -> Optional[str]:
-        """Reason the task's volumes cannot land on ``node``, or None."""
+        """Reason the task's volumes cannot land on ``node``, or None.
+        Node-free wording (the caller knows the node) so JobInfo.fit_error()
+        aggregates one histogram entry per volume, not per (volume, node)."""
         labels = node.node.labels
         for pvc in self._pending_claims(task):
             reason, _ = self._resolve_claim(pvc, labels)
             if reason is not None:
-                return f"{reason} on {node.name}"
+                return reason
         return None
 
     def task_constrains_nodes(self, task: TaskInfo) -> bool:
