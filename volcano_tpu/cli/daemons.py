@@ -137,9 +137,31 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
         # can still pin applyMode: sync)
         conf.apply_mode = "async"
     ident = identity or f"scheduler-{os.getpid()}"
+    if conf.backend == "tpu":
+        from volcano_tpu.scheduler.scheduler import (
+            enable_persistent_compilation_cache,
+        )
+
+        cache_dir = enable_persistent_compilation_cache(
+            default_dir=os.path.join(
+                os.path.expanduser("~"), ".cache", "volcano_tpu", "xla"
+            )
+        )
+        if cache_dir:
+            announce(f"scheduler {ident}: XLA compilation cache at {cache_dir}",
+                     flush=True)
     sched = Scheduler(store, conf=conf,
                       elector=_elector(store, "vk-scheduler", ident, leader_elect))
     announce(f"scheduler {ident} cycling every {period}s against {server}", flush=True)
+    try:
+        warm = sched.prewarm()
+    except _transient_errors() as e:
+        announce(f"scheduler {ident}: prewarm skipped (store unavailable: {e})",
+                 flush=True)
+    else:
+        if warm:
+            announce(f"scheduler {ident}: solves warm in {warm:.1f}s "
+                     "(persistent XLA cache on)", flush=True)
     if metrics_port >= 0:
         from volcano_tpu.scheduler.metrics_server import MetricsServer
 

@@ -122,6 +122,30 @@ class TensorSnapshot:
         )
 
 
+def pad_task_bucket(snap: "TensorSnapshot", new_t: int) -> "TensorSnapshot":
+    """Copy of ``snap`` with the task axis padded (invalid rows) to
+    ``new_t``.  Only the solve-relevant task arrays are padded — used by
+    Scheduler.prewarm to pre-compile the allocate solve for larger task
+    buckets before the cluster actually crosses the boundary."""
+    import dataclasses
+
+    def pad(a: np.ndarray) -> np.ndarray:
+        extra = new_t - a.shape[0]
+        if extra <= 0:
+            return a
+        return np.concatenate(
+            [a, np.zeros((extra,) + a.shape[1:], a.dtype)]
+        )
+
+    return dataclasses.replace(
+        snap,
+        task_req=pad(snap.task_req),
+        task_job=pad(snap.task_job),
+        task_class=pad(snap.task_class),
+        task_valid=pad(snap.task_valid),
+    )
+
+
 def _resource_vec(res, dims: List[str], out: np.ndarray) -> None:
     out[0] = res.milli_cpu
     out[1] = res.memory

@@ -385,11 +385,10 @@ def allocate(ssn) -> None:
         backend.invalidate()
         return
 
-    w_least, w_balanced = backend.score_weights()
-
     if backend.flavor == "native":
         from volcano_tpu import native as native_solver
 
+        w_least, w_balanced = backend.score_weights()
         try:
             task_node, task_kind, task_seq, ready = native_solver.allocate_solve(
                 snap,
@@ -405,55 +404,7 @@ def allocate(ssn) -> None:
             backend.invalidate()
             return
     else:
-        import jax.numpy as jnp
-
-        from volcano_tpu.scheduler.kernels import allocate_solve, allocate_solve_batch
-
-        deserved = backend.deserved()
-        n_pending = int(snap.task_valid.sum())
-        use_batch = backend.solve_mode == "batch" or (
-            backend.solve_mode == "auto" and n_pending > backend.batch_threshold
-        )
-        solve = allocate_solve_batch if use_batch else allocate_solve
-
-        dev = backend.to_device
-        out = solve(
-            dev(snap.node_idle),
-            dev(snap.node_releasing),
-            dev(snap.node_used),
-            dev(snap.node_alloc),
-            dev(snap.node_max_tasks),
-            dev(snap.node_task_count),
-            dev(snap.node_valid),
-            dev(snap.task_req),
-            dev(snap.task_job),
-            dev(snap.task_class),
-            dev(snap.task_valid),
-            dev(snap.job_queue),
-            dev(snap.job_min_available),
-            dev(snap.job_priority),
-            dev(snap.job_ready_init),
-            dev(snap.job_alloc_init),
-            dev(snap.job_schedulable),
-            dev(snap.job_start),
-            dev(snap.job_ntasks),
-            dev(snap.queue_alloc_init),
-            deserved,
-            dev(snap.class_node_mask),
-            dev(snap.class_node_score),
-            dev(snap.total),
-            dev(snap.eps),
-            jnp.float32(w_least),
-            jnp.float32(w_balanced),
-            job_key_order=backend.job_key_order,
-            use_gang_ready=backend.gang_job_ready,
-            use_proportion=backend.proportion_queue_order,
-        )
-
-        task_node = np.asarray(out[0])
-        task_kind = np.asarray(out[1])
-        task_seq = np.asarray(out[2])
-        ready = np.asarray(out[3])
+        task_node, task_kind, task_seq, ready = jax_allocate_solve(backend, snap)
 
     placed = np.nonzero(task_kind > 0)[0]
     _set_fit_error_fns(ssn, snap, task_node, task_kind, placed)
@@ -483,6 +434,68 @@ def allocate(ssn) -> None:
     if residue:
         _host_allocate_jobs(ssn, residue)
     backend.invalidate()
+
+
+def jax_allocate_solve(backend, snap, n_pending=None):
+    """Run the jitted allocate solve for ``snap`` with the backend's static
+    policy args; returns numpy (task_node, task_kind, task_seq, ready).
+
+    Shared by the allocate action and Scheduler.prewarm — prewarm calls it
+    on synthetic-shaped snapshots purely for the XLA-compilation (and
+    persistent-cache population) side effect.  ``n_pending`` overrides the
+    pending count used to pick the exact-vs-batched solve variant so a
+    prewarm of a larger bucket compiles the variant that bucket would run.
+    """
+    import jax.numpy as jnp
+
+    from volcano_tpu.scheduler.kernels import allocate_solve, allocate_solve_batch
+
+    deserved = backend.deserved()
+    if n_pending is None:
+        n_pending = int(snap.task_valid.sum())
+    use_batch = backend.solve_mode == "batch" or (
+        backend.solve_mode == "auto" and n_pending > backend.batch_threshold
+    )
+    solve = allocate_solve_batch if use_batch else allocate_solve
+    w_least, w_balanced = backend.score_weights()
+
+    dev = backend.to_device
+    out = solve(
+        dev(snap.node_idle),
+        dev(snap.node_releasing),
+        dev(snap.node_used),
+        dev(snap.node_alloc),
+        dev(snap.node_max_tasks),
+        dev(snap.node_task_count),
+        dev(snap.node_valid),
+        dev(snap.task_req),
+        dev(snap.task_job),
+        dev(snap.task_class),
+        dev(snap.task_valid),
+        dev(snap.job_queue),
+        dev(snap.job_min_available),
+        dev(snap.job_priority),
+        dev(snap.job_ready_init),
+        dev(snap.job_alloc_init),
+        dev(snap.job_schedulable),
+        dev(snap.job_start),
+        dev(snap.job_ntasks),
+        dev(snap.queue_alloc_init),
+        deserved,
+        dev(snap.class_node_mask),
+        dev(snap.class_node_score),
+        dev(snap.total),
+        dev(snap.eps),
+        jnp.float32(w_least),
+        jnp.float32(w_balanced),
+        job_key_order=backend.job_key_order,
+        use_gang_ready=backend.gang_job_ready,
+        use_proportion=backend.proportion_queue_order,
+    )
+    return (
+        np.asarray(out[0]), np.asarray(out[1]),
+        np.asarray(out[2]), np.asarray(out[3]),
+    )
 
 
 def _set_fit_error_fns(ssn, snap, task_node, task_kind, placed) -> None:
