@@ -1,0 +1,291 @@
+"""Array-native fast cycle (scheduler/fastpath.py): snapshot parity with
+the object builder, decision parity with the object path, incremental
+mirror maintenance, eligibility fallbacks, and status/condition writes.
+"""
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api.types import PodGroupPhase, PodPhase
+from volcano_tpu.scheduler.conf import default_conf, full_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+
+from helpers import (
+    FakeBinder,
+    build_node,
+    build_pod,
+    build_podgroup,
+    build_queue,
+    make_store,
+)
+
+
+def mixed_store(seed=0, n_nodes=5, n_jobs=6, running_jobs=2):
+    """Queues + podgroups + pending pods + some already-running pods."""
+    import random
+
+    rng = random.Random(seed)
+    nodes = [
+        build_node(f"n{i:02d}", cpu=str(rng.choice([4, 8])),
+                   memory=f"{rng.choice([8, 16])}Gi")
+        for i in range(n_nodes)
+    ]
+    queues = [build_queue("qa", weight=2), build_queue("qb", weight=1),
+              build_queue("default")]
+    podgroups, pods = [], []
+    for j in range(n_jobs):
+        n_tasks = rng.randint(1, 4)
+        pg = build_podgroup(f"job{j}", min_member=rng.randint(1, n_tasks),
+                            queue=rng.choice(["qa", "qb"]))
+        podgroups.append(pg)
+        running = j < running_jobs
+        for t in range(n_tasks):
+            pod = build_pod(
+                f"job{j}-{t}", group=f"job{j}",
+                cpu=rng.choice(["500m", "1"]),
+                memory=f"{rng.choice([512, 1024])}Mi",
+                priority=rng.choice([0, 5]),
+            )
+            if running:
+                pod.node_name = nodes[t % n_nodes].meta.name
+                pod.phase = PodPhase.RUNNING
+            pods.append(pod)
+    return make_store(nodes=nodes, queues=queues, podgroups=podgroups,
+                      pods=pods)
+
+
+def _object_snapshot(store):
+    from volcano_tpu.scheduler.cache import SchedulerCache
+    from volcano_tpu.scheduler.framework import open_session
+    from volcano_tpu.scheduler.snapshot import build_tensor_snapshot
+
+    cache = SchedulerCache(store)
+    ssn = open_session(cache, default_conf("tpu").tiers)
+    return build_tensor_snapshot(ssn)
+
+
+def _fast_snapshot(store):
+    from volcano_tpu.scheduler.fastpath import ArrayMirror, build_fast_snapshot
+
+    m = ArrayMirror(store, "volcano-tpu", "default")
+    m.drain()
+    assert m.ineligible_reason() is None
+    return build_fast_snapshot(m)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_fast_snapshot_equals_object_builder(seed):
+    store = mixed_store(seed)
+    obj = _object_snapshot(store)
+    fast, aux = _fast_snapshot(store)
+
+    assert fast.dims == obj.dims
+    assert fast.node_names == obj.node_names
+    for field in (
+        "node_idle", "node_releasing", "node_used", "node_alloc",
+        "node_max_tasks", "node_task_count", "node_valid",
+        "task_req", "task_job", "task_valid",
+        "job_queue", "job_min_available", "job_priority", "job_ready_init",
+        "job_alloc_init", "job_schedulable", "job_start", "job_ntasks",
+        "queue_weight", "queue_alloc_init", "queue_request", "queue_valid",
+        "queue_participates", "class_node_mask", "class_node_score",
+        "total", "eps",
+    ):
+        np.testing.assert_array_equal(
+            getattr(fast, field), getattr(obj, field), err_msg=field
+        )
+    assert fast.queue_names == obj.queue_names
+
+
+def _binds(store, conf):
+    sched = Scheduler(store, conf=conf)
+    binder = FakeBinder()
+    sched.cache.binder = binder
+    sched.run_once()
+    return sched, binder.binds
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fast_cycle_binds_equal_object_path(seed):
+    conf_fast = default_conf("tpu")
+    conf_obj = default_conf("tpu")
+    conf_obj.fast_path = "off"
+    s1, fast = _binds(mixed_store(seed), conf_fast)
+    assert s1.fast_cycle is not None and s1.fast_cycle.mirror is not None
+    _, obj = _binds(mixed_store(seed), conf_obj)
+    assert fast == obj
+
+
+def test_fast_cycle_incremental_updates():
+    store = mixed_store(1, running_jobs=0)
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    sched.run_once()
+    first = len(sched.cache.bind_log)
+    assert first > 0
+    # new job arrives: only watch deltas flow into the mirror
+    store.create("PodGroup", build_podgroup("late", min_member=2,
+                                            queue="qa"))
+    for t in range(2):
+        store.create("Pod", build_pod(f"late-{t}", group="late", cpu="500m",
+                                      memory="256Mi"))
+    sched.run_once()
+    late_binds = [k for k, _ in sched.cache.bind_log[first:]]
+    assert sorted(late_binds) == ["default/late-0", "default/late-1"]
+
+
+def test_fast_cycle_updates_podgroup_status():
+    store = make_store(
+        nodes=[build_node("n0")],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod(f"p{i}", group="pg", cpu="1") for i in range(2)],
+    )
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    sched.run_once()
+    pg = store.get("PodGroup", "default/pg")
+    # strict allocated > min_member (session.go jobStatus parity)
+    assert pg.status.phase == PodGroupPhase.RUNNING
+
+
+def test_fast_cycle_unschedulable_condition_and_event():
+    from volcano_tpu import events
+
+    store = make_store(
+        nodes=[build_node(f"n{i}", cpu="1", memory="2Gi") for i in range(2)],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg", cpu="4")],
+    )
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    assert sched.fast_cycle is not None
+    sched.run_once()
+    pg = store.get("PodGroup", "default/pg")
+    cond = next(c for c in pg.status.conditions if c.kind == "Unschedulable")
+    assert "tasks in gang unschedulable" in cond.message
+    assert "insufficient cpu" in cond.message, cond.message
+    evs = events.events_for(store, "PodGroup", "default/pg")
+    assert any(e.reason == "Unschedulable" for e in evs)
+    # steady state: the identical message must not rewrite the store
+    rv = store.resource_version
+    sched.run_once()
+    assert store.resource_version == rv
+
+    # capacity appears -> schedules, condition clears
+    node = store.get("Node", "/n0")
+    node.allocatable = node.allocatable.clone()
+    node.allocatable.milli_cpu = 8000.0
+    store.update("Node", node)
+    sched.run_once()
+    pg = store.get("PodGroup", "default/pg")
+    assert not any(c.kind == "Unschedulable" for c in pg.status.conditions)
+
+
+def _spy_fast(sched):
+    calls = []
+    orig = sched.fast_cycle.try_run
+
+    def spy():
+        r = orig()
+        calls.append(r)
+        return r
+
+    sched.fast_cycle.try_run = spy
+    return calls
+
+
+def test_fallback_on_dynamic_pod():
+    store = mixed_store(2)
+    p = build_pod("dyn-0", group="job0", cpu="500m")
+    p.spec.host_ports = [8080]
+    store.create("Pod", p)
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [False]
+    assert sched.cache.bind_log  # object path scheduled anyway
+
+
+def test_fallback_on_volume_objects():
+    from volcano_tpu.api.objects import Metadata, StorageClass
+
+    store = mixed_store(3)
+    store.create("StorageClass", StorageClass(meta=Metadata(name="sc",
+                                                            namespace="")))
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [False]
+    assert sched.cache.bind_log
+
+
+def test_fallback_on_groupless_pod():
+    store = mixed_store(4)
+    store.create("Pod", build_pod("plain", cpu="500m"))
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [False]
+
+
+def test_fallback_when_preempt_could_act():
+    """Running evictable victims + a starving job in the same queue: the
+    precheck must hand the cycle to the object path."""
+    nodes = [build_node("n0", cpu="2", memory="4Gi")]
+    pg_run = build_podgroup("rich", min_member=1, queue="default")
+    pods = []
+    for t in range(2):
+        p = build_pod(f"rich-{t}", group="rich", cpu="1", memory="1Gi")
+        p.node_name = "n0"
+        p.phase = PodPhase.RUNNING
+        pods.append(p)
+    pg_poor = build_podgroup("poor", min_member=1, queue="default")
+    pods.append(build_pod("poor-0", group="poor", cpu="1", memory="1Gi",
+                          priority=10))
+    store = make_store(nodes=nodes, podgroups=[pg_run, pg_poor], pods=pods)
+    sched = Scheduler(store, conf=full_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [False]
+
+
+def test_full_conf_fast_when_no_preempt_work():
+    """Full 5-action conf on a fresh cluster (no residents): prechecks
+    prove preempt/reclaim vacuous and the fast path serves the cycle."""
+    store = mixed_store(5, running_jobs=0)
+    sched = Scheduler(store, conf=full_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [True]
+    assert sched.cache.bind_log
+
+
+def test_fast_enqueue_admits_pending_groups():
+    store = make_store(
+        nodes=[build_node("n0", cpu="4", memory="8Gi")],
+        podgroups=[build_podgroup("pg", min_member=1,
+                                  phase=PodGroupPhase.PENDING)],
+        pods=[build_pod(f"p{i}", group="pg", cpu="1") for i in range(2)],
+    )
+    conf = full_conf("tpu")
+    sched = Scheduler(store, conf=conf)
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [True]
+    assert len(sched.cache.bind_log) == 2  # enqueued AND scheduled in one cycle
+    pg = store.get("PodGroup", "default/pg")
+    assert pg.status.phase == PodGroupPhase.RUNNING
+
+
+def test_fast_backfill_places_best_effort():
+    store = make_store(
+        nodes=[build_node("n0", cpu="1", memory="2Gi")],
+        podgroups=[build_podgroup("pg", min_member=2)],
+        pods=[
+            build_pod("p0", group="pg", cpu="1"),
+            build_pod("be-0", group="pg", cpu="0", memory="0"),
+        ],
+    )
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [True]
+    binds = dict(sched.cache.bind_log)
+    assert binds == {"default/p0": "n0", "default/be-0": "n0"}

@@ -65,6 +65,31 @@ class AsyncApplier:
             self._q.append(("bind", task_key, hostname))
             self._cv.notify_all()
 
+    def submit_binds(self, binds) -> None:
+        """Bulk submit_bind: one lock acquisition for a whole cycle's
+        decisions (the fast path publishes 100k binds in one call)."""
+        with self._cv:
+            self.inflight_binds.update(binds)
+            pending = self._pending
+            q = self._q
+            drop_evict = self.inflight_evicts.pop
+            get = pending.get
+            for task_key, hostname in binds:
+                drop_evict(task_key, None)
+                pk = ("bind", task_key)
+                pending[pk] = get(pk, 0) + 1
+                q.append(("bind", task_key, hostname))
+            self._cv.notify_all()
+
+    def submit_ops(self, ops) -> None:
+        """Queue pre-built store ops (status patches, condition events) for
+        asynchronous application.  No overlay markers and no per-op events —
+        callers own any dedup/transition logic; failures land in the
+        cache's err_log keyed by the op's kind/key."""
+        with self._cv:
+            self._q.append(("ops", ops, None))
+            self._cv.notify_all()
+
     def submit_evict(self, task_key: str, reason: str) -> None:
         with self._cv:
             self.inflight_evicts[task_key] = reason
@@ -93,6 +118,8 @@ class AsyncApplier:
         with self._cv:
             dropped = len(self._q)
             for verb, key, _ in self._q:
+                if verb == "ops":
+                    continue
                 left = self._pending.get((verb, key), 1) - 1
                 if left <= 0:
                     self._pending.pop((verb, key), None)
@@ -151,6 +178,8 @@ class AsyncApplier:
                 with self._cv:
                     self._applying = 0
                     for verb, key, _ in batch:
+                        if verb == "ops":
+                            continue
                         left = self._pending.get((verb, key), 1) - 1
                         if left <= 0:
                             self._pending.pop((verb, key), None)
@@ -167,22 +196,35 @@ class AsyncApplier:
 
     def _apply(self, batch) -> None:
         ops = []
+        flat = []  # one (verb, key, arg) per op, "ops" entries expanded
         for verb, key, arg in batch:
             if verb == "bind":
                 ops.append({"op": "patch", "kind": "Pod", "key": key,
                             "fields": {"node_name": arg}})
-            else:
+                flat.append((verb, key, arg))
+            elif verb == "evict":
                 ops.append({"op": "patch", "kind": "Pod", "key": key,
                             "fields": {"deleting": True}})
+                flat.append((verb, key, arg))
+            else:  # pre-built op list (submit_ops)
+                for op in key:
+                    ops.append(op)
+                    # recorded as "status" so FastCycle._reconcile_failures
+                    # retries the podgroup on either failure path
+                    flat.append(("status", op.get("key", op["kind"]), None))
         try:
             results = self.store.bulk(ops)
         except Exception as e:  # noqa: BLE001 — store outage: retry next cycle
-            for verb, key, _ in batch:
+            for verb, key, _ in flat:
                 self.cache._record_err(verb, key, e)
             return
         ev_ops: List[dict] = []
         ev_meta: List[Tuple[tuple, object, bool]] = []  # (idx_key, ev, is_new)
-        for (verb, key, arg), err in zip(batch, results):
+        for (verb, key, arg), err in zip(flat, results):
+            if verb == "status":
+                if err is not None:
+                    self.cache._record_err("status", key, RuntimeError(err))
+                continue
             if err is not None:
                 # vanished pod / conflict: the task stays pending in the
                 # store; next cycle's snapshot retries it

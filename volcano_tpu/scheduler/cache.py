@@ -35,6 +35,26 @@ class Binder:
         pod.node_name = hostname
         self.store.update("Pod", pod)
 
+    def bind_bulk(self, binds):
+        """Batched bind: one store round trip for a whole cycle's
+        placements. Returns per-bind error strings (None on success).
+        Custom binders without this method get the per-bind seam."""
+        return self.store.bulk([
+            {"op": "patch", "kind": "Pod", "key": key,
+             "fields": {"node_name": hostname}}
+            for key, hostname in binds
+        ])
+
+
+class _TaskRef:
+    """Minimal task view handed to custom per-bind Binder seams by the
+    bulk path (they contractually read ``key`` only)."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
 
 class Evictor:
     """Default evictor: marks the pod for deletion (the sim kubelet reaps it)."""
@@ -533,6 +553,43 @@ class SchedulerCache:
             )
         except Exception as e:  # noqa: BLE001
             self._record_err("event", task.key, e)
+
+    def bind_bulk(self, binds) -> None:
+        """Bind a whole cycle's placements: async -> one applier submit;
+        sync -> the Binder's bulk verb (or the per-bind seam for custom
+        binders), with the same bind_log/event/err_log semantics as
+        ``bind``.  ``binds`` is a list of (pod_key, hostname)."""
+        from volcano_tpu import events
+
+        if not binds:
+            return
+        if self.applier is not None:
+            self.applier.submit_binds(binds)
+            self.bind_log.extend(binds)
+            return
+        bulk = getattr(self.binder, "bind_bulk", None)
+        if bulk is None:
+            for key, hostname in binds:
+                self.bind(_TaskRef(key), hostname)
+            return
+        try:
+            errs = bulk(binds)
+        except Exception as e:  # noqa: BLE001 — store outage: retry next cycle
+            for key, _ in binds:
+                self._record_err("bind", key, e)
+            return
+        for (key, hostname), err in zip(binds, errs):
+            if err is not None:
+                self._record_err("bind", key, RuntimeError(err))
+                continue
+            self.bind_log.append((key, hostname))
+            try:
+                events.record(
+                    self.store, "Pod", key, "Scheduled",
+                    events.scheduled_message(key, hostname),
+                )
+            except Exception as e:  # noqa: BLE001
+                self._record_err("event", key, e)
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         from volcano_tpu import events

@@ -51,6 +51,10 @@ class SchedulerConf:
     # inline, deterministic. None = unset: library/simulator use resolves
     # to sync; the deployed daemon resolves to async.
     apply_mode: Optional[str] = None
+    # "auto": the tpu backend runs each cycle array-native (watch-fed
+    # mirror, no per-pod Python) whenever the cluster/conf is expressible,
+    # falling back to the object path otherwise; "off": always object path.
+    fast_path: str = "auto"
 
 
 def default_conf(backend: str = "host") -> SchedulerConf:
@@ -121,6 +125,11 @@ def load_conf(text: str) -> SchedulerConf:
         conf.apply_mode = mode
     if "schedulePeriod" in data:
         conf.schedule_period = float(data["schedulePeriod"])
+    if "fastPath" in data:
+        mode = str(data["fastPath"])
+        if mode not in ("auto", "off"):
+            raise ValueError(f"fastPath must be 'auto' or 'off', got {mode!r}")
+        conf.fast_path = mode
     return conf
 
 

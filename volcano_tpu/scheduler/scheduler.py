@@ -79,6 +79,13 @@ class Scheduler:
             self.snapshot_cache = SnapshotCache()
         if self.conf.backend == "tpu":
             enable_persistent_compilation_cache()
+        # array-native fast cycle (fastpath.py): used per cycle whenever the
+        # cluster/conf is expressible; object path otherwise
+        self.fast_cycle = None
+        if self.conf.backend == "tpu" and self.conf.fast_path != "off":
+            from volcano_tpu.scheduler.fastpath import FastCycle
+
+            self.fast_cycle = FastCycle(self)
 
     def prewarm(self, bucket_levels: int = 1) -> float:
         """Compile the cycle's device solves before the first real cycle.
@@ -100,6 +107,10 @@ class Scheduler:
         from volcano_tpu.scheduler.tensor_backend import TensorBackend
 
         t0 = time.perf_counter()
+        if self.fast_cycle is not None:
+            # the mirror's one-time full list sync belongs to startup, not
+            # to the first scheduling cycle
+            self.fast_cycle.sync_mirror()
         ssn = open_session(self.cache, self.conf.tiers)
         backend = TensorBackend(
             ssn,
@@ -219,6 +230,9 @@ class Scheduler:
 
     def _run_once_inner(self) -> None:
         start = time.perf_counter()
+        if self.fast_cycle is not None and self.fast_cycle.try_run():
+            metrics.update_e2e_duration(start)
+            return
         ssn = open_session(self.cache, self.conf.tiers)
 
         if self.conf.backend in ("tpu", "native"):
