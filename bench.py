@@ -226,7 +226,7 @@ def _build_e2e_store(n_best_effort=2000):
                              resources=Resource(float(cpus[k]),
                                                 float(mems[k])))))
             k += 1
-        if j % (N_JOBS // max(n_best_effort, 1) or 1) == 0 and n_best_effort:
+        if j < n_best_effort:
             store.create("Pod", Pod(
                 meta=Metadata(name=f"be{j:05d}", namespace="default",
                               annotations=dict(ann)),

@@ -7,6 +7,11 @@ dry-runs the multichip path); real-TPU numbers come from bench.py only.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# daemon subprocesses (test_daemons etc.) default to the host backend so
+# every spawned scheduler doesn't pay a jax import + XLA compile; the
+# deployed default is tpu (daemons.run_scheduler), covered explicitly by
+# tests that set this to "tpu"
+os.environ.setdefault("VOLCANO_TPU_BACKEND", "host")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

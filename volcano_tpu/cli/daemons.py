@@ -130,7 +130,14 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
     from volcano_tpu.store.client import RemoteStore
 
     store = RemoteStore(server)
-    conf = load_conf(open(conf_path).read()) if conf_path else full_conf()
+    # deployed default: the fully-loaded 5-action conf on the tpu backend
+    # (VOLCANO_TPU_BACKEND=host opts out — e.g. deployments without jax;
+    # the test suite sets it to keep daemon subprocesses light)
+    conf = (
+        load_conf(open(conf_path).read())
+        if conf_path
+        else full_conf(os.environ.get("VOLCANO_TPU_BACKEND", "tpu"))
+    )
     if conf.apply_mode is None:
         # deployed default: async batched decision application — a cycle's
         # binds are one bulk round trip off the critical path (a conf file
@@ -223,3 +230,194 @@ def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
 
 def install_sigterm_exit() -> None:
     signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+
+
+# -- one-command process model (the installer/ analogue) ----------------------
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_http(url: str, timeout: float = 30.0) -> bool:
+    from volcano_tpu.store.client import RemoteStore
+
+    deadline = time.monotonic() + timeout
+    transient = _transient_errors()
+    store = RemoteStore(url, timeout=2.0)
+    while time.monotonic() < deadline:
+        try:
+            store.resource_version
+            return True
+        except transient:
+            time.sleep(0.1)
+    return False
+
+
+def run_up(port: int = 8443, state: str = "", conf_path: str = "",
+           pidfile: str = ".vt-up.json", detach: bool = False,
+           schedulers: int = 1, controllers: int = 1,
+           announce=print) -> int:
+    """Bring up the whole control plane — apiserver (+durable state),
+    scheduler(s), controller(s), kubelet — as real OS processes with
+    health checks: the reference's helm-chart/3-image deployment collapsed
+    to one command (installer/chart/volcano/templates analogue).
+
+    Foreground by default (Ctrl-C tears everything down); ``detach=True``
+    writes a pidfile and returns, ``run_down`` reads it back.  Extra
+    scheduler/controller replicas hot-standby through store Leases exactly
+    like the reference's leader-elected deployments.
+    """
+    import json
+    import subprocess
+
+    # refuse to orphan a previous detached control plane
+    try:
+        with open(pidfile) as f:
+            prev = json.load(f)
+        for pid in prev.get("pids", []):
+            os.kill(pid, 0)  # raises if gone
+            announce(
+                f"error: a control plane from {pidfile} is still running "
+                f"(pid {pid}); run 'vtctl down' first", flush=True,
+            )
+            return 1
+    except (OSError, ValueError):
+        pass  # no pidfile / stale pids / unreadable: proceed
+
+    if port == 0:
+        port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    py = sys.executable
+    procs = []
+    # detached daemons must not inherit our stdout (a piped `vtctl up -d`
+    # would otherwise never see EOF): component output goes to a log file
+    log = open(pidfile + ".log", "ab") if detach else None
+
+    def spawn(*argv):
+        p = subprocess.Popen([py, "-m", "volcano_tpu.cli", *argv],
+                             stdout=log, stderr=log,
+                             start_new_session=detach)
+        procs.append(p)
+        return p
+
+    api_args = ["apiserver", "--port", str(port)]
+    if state:
+        api_args += ["--state", state]
+    spawn(*api_args)
+    if not _wait_http(url):
+        announce("error: apiserver failed its health check", flush=True)
+        for p in procs:
+            p.terminate()
+        return 1
+    announce(f"apiserver ready at {url}", flush=True)
+
+    for i in range(schedulers):
+        argv = ["scheduler", "--server", url, "--identity", f"sched-{i}",
+                "--metrics-port", "-1"]
+        if conf_path:
+            argv += ["--conf", conf_path]
+        spawn(*argv)
+    for i in range(controllers):
+        spawn("controller", "--server", url, "--identity", f"ctl-{i}")
+    spawn("kubelet", "--server", url)
+
+    time.sleep(0.3)
+    dead = [p for p in procs if p.poll() is not None]
+    if dead:
+        announce("error: a component exited at startup", flush=True)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        return 1
+    announce(
+        f"control plane up: 1 apiserver, {schedulers} scheduler(s), "
+        f"{controllers} controller(s), 1 kubelet "
+        f"(submit with: vtctl --server {url} job run ...)", flush=True,
+    )
+
+    with open(pidfile, "w") as f:
+        json.dump({"url": url, "pids": [p.pid for p in procs]}, f)
+
+    if detach:
+        if log is not None:
+            log.close()
+        return 0
+    try:
+        while all(p.poll() is None for p in procs):
+            time.sleep(0.5)
+        announce("a component exited; shutting down", flush=True)
+        code = 1
+    except KeyboardInterrupt:
+        code = 0
+    finally:
+        for p in reversed(procs):  # kubelet/controller first, apiserver last
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        try:
+            os.unlink(pidfile)
+        except OSError:
+            pass
+    return code
+
+
+def run_down(pidfile: str = ".vt-up.json", announce=print) -> int:
+    """Tear down a detached ``run_up`` control plane via its pidfile."""
+    import json
+
+    try:
+        with open(pidfile) as f:
+            info = json.load(f)
+    except (OSError, ValueError):
+        announce(f"no control plane found ({pidfile})", flush=True)
+        return 1
+    pids = info.get("pids", [])
+    for pid in reversed(pids):
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def survivors():
+        out = []
+        for pid in pids:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                continue
+            out.append(pid)
+        return out
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and survivors():
+        time.sleep(0.1)
+    left = survivors()
+    if left:
+        # grace expired (e.g. a scheduler mid-XLA-compile): escalate
+        for pid in left:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        time.sleep(0.2)
+        left = survivors()
+    try:
+        os.unlink(pidfile)
+    except OSError:
+        pass
+    if left:
+        announce(f"warning: pids still alive after SIGKILL: {left}",
+                 flush=True)
+        return 1
+    announce("control plane stopped", flush=True)
+    return 0

@@ -263,6 +263,24 @@ def main(argv=None) -> int:
     init_p.add_argument("--queues", default="default=1")
     cl_sub.add_parser("step", parents=[common])
 
+    # one-command process model (installer/chart analogue)
+    up_p = sub.add_parser("up", parents=[common],
+                          help="bring up apiserver+scheduler+controller+"
+                               "kubelet with health checks")
+    up_p.add_argument("--port", type=int, default=8443,
+                      help="apiserver port (0 = pick a free port)")
+    up_p.add_argument("--state", default="",
+                      help="durable apiserver state file (etcd analogue)")
+    up_p.add_argument("--conf", default="", help="scheduler-conf YAML path")
+    up_p.add_argument("--detach", "-d", action="store_true",
+                      help="return after startup; tear down with 'vtctl down'")
+    up_p.add_argument("--pidfile", default=".vt-up.json")
+    up_p.add_argument("--schedulers", type=int, default=1)
+    up_p.add_argument("--controllers", type=int, default=1)
+    down_p = sub.add_parser("down", parents=[common],
+                            help="stop a detached 'vtctl up' control plane")
+    down_p.add_argument("--pidfile", default=".vt-up.json")
+
     # control-plane daemons (the reference's three binaries; SURVEY.md §1)
     api_p = sub.add_parser("apiserver", parents=[common], help="run the store API server")
     api_p.add_argument("--port", type=int, default=8443)
@@ -283,6 +301,19 @@ def main(argv=None) -> int:
                            help="/metrics port (0 = free port, <0 = disabled)")
 
     args = parser.parse_args(argv)
+
+    if args.group == "up":
+        from volcano_tpu.cli import daemons
+
+        return daemons.run_up(port=args.port, state=args.state,
+                              conf_path=args.conf, pidfile=args.pidfile,
+                              detach=args.detach,
+                              schedulers=args.schedulers,
+                              controllers=args.controllers)
+    if args.group == "down":
+        from volcano_tpu.cli import daemons
+
+        return daemons.run_down(pidfile=args.pidfile)
 
     if args.group in ("apiserver", "controller", "scheduler", "kubelet"):
         if args.group != "apiserver" and not args.server:

@@ -232,11 +232,11 @@ class ArrayMirror:
         self._synced = True
 
     def drain(self) -> None:
-        """Apply queued watch events; first call performs the full sync."""
+        """Apply queued watch events; first call performs the full sync.
+        Events queued before/during the sync are NOT discarded — row
+        upserts are idempotent, and RemoteStore watch queues (which pin
+        their cursor at subscription) have no local backlog to drop."""
         if not self._synced:
-            # events arriving during the sync re-apply idempotently
-            for _, q in self._watches:
-                q.clear()
             self._full_sync()
             return
         resync = False
@@ -314,6 +314,7 @@ class ArrayMirror:
         self.n_max_tasks = _grow(self.n_max_tasks, n)
         self.n_static_ok = _grow(self.n_static_ok, n)
         self.n_live = _grow(self.n_live, n)
+        self.n_alloc[row] = 0.0  # updates may drop a scalar dim
         if not self._vec(node.allocatable, self.n_alloc[row]):
             self._widen_dims(node.allocatable)
             return
@@ -363,6 +364,7 @@ class ArrayMirror:
         )
         self.j_phase[row] = self._phase_idx[pg.status.phase]
         self.j_rv[row] = pg.meta.resource_version
+        self.j_min_req[row] = 0.0
         if not self._vec(pg.min_resources, self.j_min_req[row]):
             self._widen_dims(pg.min_resources)
             return
@@ -443,6 +445,10 @@ class ArrayMirror:
 
         resreq = pod.spec.resreq()
         init = pod.spec.init_resreq()
+        # zero first: a reused row (or an update that dropped a scalar)
+        # must not inherit stale resource columns
+        self.p_resreq[row] = 0.0
+        self.p_req[row] = 0.0
         if not self._vec(resreq, self.p_resreq[row]):
             self._widen_dims(resreq)
             return
@@ -829,6 +835,16 @@ class FastCycle:
                 self.store, self.cache.scheduler_name, self.cache.default_queue
             )
         self.mirror.drain()
+
+    def reset_after_abort(self) -> None:
+        """Leadership loss dropped queued decisions (applier.abort_pending):
+        the mirror's optimistic row updates and status fingerprints no
+        longer reflect the store — rebuild from a fresh list before the
+        next cycle this scheduler leads."""
+        self._status_fp.clear()
+        self._last_unsched.clear()
+        if self.mirror is not None:
+            self.mirror._resync(dims=self.mirror.dims)
 
     def try_run(self) -> bool:
         if not self.conf_ok:

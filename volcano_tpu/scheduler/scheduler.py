@@ -194,6 +194,10 @@ class Scheduler:
                         "dropped %d queued decisions on leadership loss",
                         dropped,
                     )
+                    if self.fast_cycle is not None:
+                        # the fast mirror optimistically recorded those
+                        # decisions; resync it from the store
+                        self.fast_cycle.reset_after_abort()
             return
         profile_dir = os.environ.get("VOLCANO_TPU_PROFILE")
         if profile_dir and not self._profile_warned:

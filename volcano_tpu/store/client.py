@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional
 from urllib.parse import quote
 
 from volcano_tpu.admission import AdmissionError
-from volcano_tpu.store.codec import decode_object, encode
+from volcano_tpu.store.codec import decode_object, encode, encode_fields
 from volcano_tpu.store.store import Conflict, Event, EventType
 
 
@@ -134,7 +134,7 @@ class RemoteStore:
     def patch(self, kind: str, key: str, fields: Dict[str, Any]) -> Any:
         code, body = self._request(
             "PATCH", f"/apis/{kind}/obj?key={quote(key, safe='')}",
-            {"fields": fields},
+            {"fields": encode_fields(fields)},
         )
         if code == 404:
             raise KeyError(self._err(code, body))
@@ -157,7 +157,7 @@ class RemoteStore:
             if "key" in op:
                 w["key"] = op["key"]
             if "fields" in op:
-                w["fields"] = op["fields"]
+                w["fields"] = encode_fields(op["fields"])
             if "cas" in op:
                 w["cas"] = op["cas"]
             wire.append(w)

@@ -154,6 +154,26 @@ def encode_object(kind: str, obj: Any) -> Dict[str, Any]:
     return {"kind": kind, "object": encode(obj)}
 
 
+def encode_fields(fields: Dict[str, Any]) -> Dict[str, Any]:
+    """Patch-field map -> JSON-compatible values (field values may be
+    nested dataclasses, e.g. a whole PodGroupStatus)."""
+    return {k: encode(v) for k, v in fields.items()}
+
+
+def decode_fields(kind: str, fields: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of ``encode_fields``, type-directed by the kind's class
+    hints so object-valued fields rebuild their dataclasses.  Unknown
+    kinds/fields pass through (Store.patch validates attribute names)."""
+    cls = KIND_CLASSES.get(kind)
+    if cls is None or not dataclasses.is_dataclass(cls):
+        return fields
+    hints = _hints(cls)
+    return {
+        k: decode(hints[k], v) if k in hints else v
+        for k, v in fields.items()
+    }
+
+
 def decode_object(kind: str, data: Dict[str, Any]) -> Any:
     cls = KIND_CLASSES.get(kind)
     if cls is None:

@@ -30,7 +30,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
-from volcano_tpu.store.codec import KIND_CLASSES, decode_object, encode
+from volcano_tpu.store.codec import (
+    KIND_CLASSES,
+    decode_fields,
+    decode_object,
+    encode,
+)
 from volcano_tpu.store.store import Store
 
 #: cap on buffered events; a client further behind than this must relist
@@ -261,7 +266,7 @@ class StoreServer:
             return 422, {"error": "patch is not supported on Job; use update"}
         with self.lock:
             try:
-                obj = self.store.patch(kind, key, fields)
+                obj = self.store.patch(kind, key, decode_fields(kind, fields))
             except KeyError as e:
                 return 404, {"error": str(e)}
             self._pump_log()
