@@ -62,3 +62,45 @@ def test_graft_entry_multichip():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_exact_topk_mesh_sweep_bitwise_parity():
+    """exact_topk=True makes the sharded batch solve layout-independent:
+    every mesh size (1/2/4/8 devices) reproduces the single-device run
+    BIT-FOR-BIT at an N large enough that approx_max_k's bucketed
+    reduction is layout-sensitive (VERDICT r1 next #8)."""
+    args = build_sim_args(n_nodes=512, n_tasks=2048, n_jobs=128,
+                          n_queues=2, seed=11)
+    ref = _outputs(run_cycle_reference(args, m_chunk=32, p_chunk=8,
+                                       exact_topk=True))
+    names = [
+        "task_node", "task_kind", "task_seq", "ready", "job_alloc",
+        "queue_alloc", "idle", "releasing", "used", "dropped", "rounds",
+    ]
+    for n_dev in (1, 2, 4, 8):
+        mesh = make_mesh(n_dev)
+        fn, dev_args = make_sharded_cycle(
+            args=args, mesh=mesh, m_chunk=32, p_chunk=8, exact_topk=True
+        )
+        got = _outputs(fn(dev_args))
+        for name, r, g in zip(names, ref, got):
+            np.testing.assert_array_equal(g, r, err_msg=f"{name}@{n_dev}dev")
+
+
+def test_exact_topk_scheduler_conf_plumbs_through():
+    """exactTopK in the scheduler-conf YAML reaches the batch solve."""
+    from volcano_tpu.scheduler.conf import load_conf
+
+    conf = load_conf("backend: tpu\nexactTopK: true\nsolveMode: batch\n")
+    assert conf.exact_topk is True
+    from volcano_tpu.scheduler.scheduler import Scheduler
+    from helpers import build_node, build_pod, build_podgroup, make_store
+
+    store = make_store(
+        nodes=[build_node(f"n{i}") for i in range(2)],
+        podgroups=[build_podgroup("pg", min_member=2)],
+        pods=[build_pod(f"p{i}", group="pg", cpu="1") for i in range(2)],
+    )
+    sched = Scheduler(store, conf=conf)
+    sched.run_once()
+    assert len(sched.cache.bind_log) == 2

@@ -276,21 +276,33 @@ def run_up(port: int = 8443, state: str = "", conf_path: str = "",
     import json
     import subprocess
 
-    # refuse to orphan a previous detached control plane
+    # refuse to orphan a previous detached control plane — every recorded
+    # pid is checked (a crashed apiserver must not hide live schedulers)
     try:
         with open(pidfile) as f:
             prev = json.load(f)
+    except (OSError, ValueError):
+        prev = None
+    if prev:
+        alive = []
         for pid in prev.get("pids", []):
-            os.kill(pid, 0)  # raises if gone
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                continue
+            except OSError:
+                alive.append(pid)  # exists but not ours: still refuse
+            else:
+                alive.append(pid)
+        if alive:
             announce(
                 f"error: a control plane from {pidfile} is still running "
-                f"(pid {pid}); run 'vtctl down' first", flush=True,
+                f"(pids {alive}); run 'vtctl down' first", flush=True,
             )
             return 1
-    except (OSError, ValueError):
-        pass  # no pidfile / stale pids / unreadable: proceed
 
-    if port == 0:
+    port_was_auto = port == 0
+    if port_was_auto:
         port = _free_port()
     url = f"http://127.0.0.1:{port}"
     py = sys.executable
@@ -306,11 +318,22 @@ def run_up(port: int = 8443, state: str = "", conf_path: str = "",
         procs.append(p)
         return p
 
-    api_args = ["apiserver", "--port", str(port)]
-    if state:
-        api_args += ["--state", state]
-    spawn(*api_args)
-    if not _wait_http(url):
+    def start_apiserver():
+        args = ["apiserver", "--port", str(port)]
+        if state:
+            args += ["--state", state]
+        spawn(*args)
+        return _wait_http(url)
+
+    ok = start_apiserver()
+    if not ok and port_was_auto:
+        # _free_port's bind-then-close probe can lose a TOCTOU race on a
+        # busy host: retry once on a fresh port
+        procs.pop().terminate()
+        port = _free_port()
+        url = f"http://127.0.0.1:{port}"
+        ok = start_apiserver()
+    if not ok:
         announce("error: apiserver failed its health check", flush=True)
         for p in procs:
             p.terminate()

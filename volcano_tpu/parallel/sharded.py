@@ -18,12 +18,15 @@ The cycle function is jitted with explicit NamedSharding in_shardings, so
 the same code runs single-chip (trivial mesh) or on a slice. The driver's
 ``dryrun_multichip`` entry exercises it on an N-device virtual CPU mesh.
 
-Sharded-vs-unsharded equivalence is policy-level, not bit-level: the batch
-solve's spill targets come from ``approx_max_k``, whose bucketed reduction
-depends on data layout, so a mesh-sharded run may choose different (equally
-feasible, comparably scored) nodes than the single-device run at large N.
-Small-N runs reduce to exact top-k and match bit-for-bit (what
-tests/test_parallel.py asserts); all hard policies hold at any scale.
+Sharded-vs-unsharded equivalence is policy-level by default, bit-level on
+request: the batch solve's spill targets come from ``approx_max_k``, whose
+bucketed reduction depends on data layout, so a mesh-sharded run may choose
+different (equally feasible, comparably scored) nodes than the
+single-device run at large N. Small-N runs reduce to exact top-k and match
+bit-for-bit; ``exact_topk=True`` swaps in the exact, layout-independent
+``lax.top_k`` so ANY mesh size reproduces the single-device run
+bit-for-bit at any N (tests/test_parallel.py sweeps 1/2/4/8 devices) at
+the cost of the slower reduction; all hard policies hold in either mode.
 
 Why GSPMD rather than hand-written shard_map collectives: every round's
 cross-shard data is tiny (per-job candidate lists), while the sharded
@@ -75,7 +78,7 @@ def cycle_shardings(mesh: Mesh, args: Dict[str, object]) -> Dict[str, NamedShard
 
 
 def _cycle(args, w_least, w_balanced, job_key_order, use_gang_ready,
-           use_proportion, m_chunk, p_chunk):
+           use_proportion, m_chunk, p_chunk, exact_topk=False):
     """One full decision cycle: proportion water-fill + batched allocate."""
     deserved = water_fill(
         args["queue_weight"], args["queue_request"], args["total"],
@@ -98,13 +101,14 @@ def _cycle(args, w_least, w_balanced, job_key_order, use_gang_ready,
         use_proportion=use_proportion,
         m_chunk=m_chunk,
         p_chunk=p_chunk,
+        exact_topk=exact_topk,
     )
 
 
 def run_cycle_reference(args, w_least=1.0, w_balanced=1.0,
                         job_key_order=("priority", "gang", "drf"),
                         use_gang_ready=True, use_proportion=True,
-                        m_chunk=512, p_chunk=16):
+                        m_chunk=512, p_chunk=16, exact_topk=False):
     """Unsharded cycle on default device placement (parity oracle)."""
     import jax.numpy as jnp
 
@@ -112,6 +116,7 @@ def run_cycle_reference(args, w_least=1.0, w_balanced=1.0,
         {k: jnp.asarray(v) for k, v in args.items()},
         jnp.float32(w_least), jnp.float32(w_balanced),
         job_key_order, use_gang_ready, use_proportion, m_chunk, p_chunk,
+        exact_topk,
     )
 
 
@@ -156,6 +161,7 @@ def make_sharded_cycle(
     use_proportion: bool = True,
     m_chunk: int = 512,
     p_chunk: int = 16,
+    exact_topk: bool = False,
 ):
     """Return (jitted_fn, device_args): the cycle compiled with node-axis
     shardings, and the host args placed onto the mesh accordingly.
@@ -181,6 +187,7 @@ def make_sharded_cycle(
             use_proportion=use_proportion,
             m_chunk=m_chunk,
             p_chunk=p_chunk,
+            exact_topk=exact_topk,
         ),
         in_shardings=(shardings, None, None),
     )

@@ -51,6 +51,9 @@ class SchedulerConf:
     # inline, deterministic. None = unset: library/simulator use resolves
     # to sync; the deployed daemon resolves to async.
     apply_mode: Optional[str] = None
+    # exact (layout-independent) top-k spill targets in the batch solve:
+    # multi-chip == single-chip bit-for-bit, at some solve-speed cost
+    exact_topk: bool = False
     # "auto": the tpu backend runs each cycle array-native (watch-fed
     # mirror, no per-pod Python) whenever the cluster/conf is expressible,
     # falling back to the object path otherwise; "off": always object path.
@@ -125,6 +128,8 @@ def load_conf(text: str) -> SchedulerConf:
         conf.apply_mode = mode
     if "schedulePeriod" in data:
         conf.schedule_period = float(data["schedulePeriod"])
+    if "exactTopK" in data:
+        conf.exact_topk = bool(data["exactTopK"])
     if "fastPath" in data:
         mode = str(data["fastPath"])
         if mode not in ("auto", "off"):

@@ -452,7 +452,11 @@ class ArrayMirror:
         if not self._vec(resreq, self.p_resreq[row]):
             self._widen_dims(resreq)
             return
-        self._vec(init, self.p_req[row])
+        if not self._vec(init, self.p_req[row]):
+            # a scalar appearing only in init-container requests still
+            # widens the dim set — p_req is the fit requirement
+            self._widen_dims(init)
+            return
         prio = pod.spec.priority
         if prio == 0 and pod.spec.priority_class:
             prio = self.priority_classes.get(
@@ -879,6 +883,7 @@ class FastCycle:
                 _TiersOnly(self.conf.tiers),
                 solve_mode=self.conf.solve_mode,
                 flavor="tpu",
+                exact_topk=self.conf.exact_topk,
             )
             backend._snapshot = snap
             task_node, task_kind, task_seq, ready = jax_allocate_solve(

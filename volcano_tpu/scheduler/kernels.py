@@ -362,6 +362,7 @@ def allocate_solve(
     jax.jit,
     static_argnames=(
         "job_key_order", "use_gang_ready", "use_proportion", "m_chunk", "p_chunk",
+        "exact_topk",
     ),
 )
 def allocate_solve_batch(
@@ -375,7 +376,7 @@ def allocate_solve_batch(
     w_least, w_balanced,
     job_key_order=("priority", "gang", "drf"),
     use_gang_ready=True, use_proportion=True,
-    m_chunk=512, p_chunk=16,
+    m_chunk=512, p_chunk=16, exact_topk=False,
 ):
     """Throughput-mode allocate: rounds of parallel block placement.
 
@@ -514,8 +515,15 @@ def allocate_solve_batch(
         # top_k at [M, 16k]). The K spill targets are a packing heuristic —
         # the reference randomizes among score ties anyway — and feasibility
         # is re-checked per returned node, so reduced recall only shifts
-        # which good node a gang lands on, never correctness.
-        _, topk_nodes = jax.lax.approx_max_k(masked, K)            # [M, K]
+        # which good node a gang lands on, never correctness. exact_topk
+        # swaps in the exact (layout-independent) reduction so a
+        # mesh-sharded run reproduces the single-device run bit-for-bit —
+        # approx_max_k's bucketing depends on data layout, which a sharded
+        # node axis changes.
+        if exact_topk:
+            _, topk_nodes = jax.lax.top_k(masked, K)               # [M, K]
+        else:
+            _, topk_nodes = jax.lax.approx_max_k(masked, K)        # [M, K]
         topk_nodes = topk_nodes.astype(jnp.int32)
         # rotate each job's top-K list by its rank: consecutive-ranked jobs
         # start on different spill targets, which multiplies the per-round

@@ -117,6 +117,7 @@ class Scheduler:
             solve_mode=self.conf.solve_mode,
             flavor="tpu",
             snapshot_cache=self.snapshot_cache,
+            exact_topk=self.conf.exact_topk,
         )
         if not backend.supported:
             return 0.0
@@ -247,6 +248,7 @@ class Scheduler:
                 solve_mode=self.conf.solve_mode,
                 flavor=self.conf.backend,
                 snapshot_cache=self.snapshot_cache,
+                exact_topk=self.conf.exact_topk,
             )
         else:
             ssn.tensor_backend = None

@@ -457,6 +457,7 @@ def jax_allocate_solve(backend, snap, n_pending=None):
         backend.solve_mode == "auto" and n_pending > backend.batch_threshold
     )
     solve = allocate_solve_batch if use_batch else allocate_solve
+    extra = {"exact_topk": backend.exact_topk} if use_batch else {}
     w_least, w_balanced = backend.score_weights()
 
     dev = backend.to_device
@@ -491,6 +492,7 @@ def jax_allocate_solve(backend, snap, n_pending=None):
         job_key_order=backend.job_key_order,
         use_gang_ready=backend.gang_job_ready,
         use_proportion=backend.proportion_queue_order,
+        **extra,
     )
     return (
         np.asarray(out[0]), np.asarray(out[1]),

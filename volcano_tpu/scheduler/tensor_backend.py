@@ -47,6 +47,7 @@ class TensorBackend:
         batch_threshold: int = BATCH_THRESHOLD,
         flavor: str = "tpu",  # "tpu" (JAX kernels) | "native" (C++ solver)
         snapshot_cache=None,  # persistent SnapshotCache owned by the Scheduler
+        exact_topk: bool = False,  # bit-level multi-chip reproducibility
     ):
         self.ssn = ssn
         self.bulk_threshold = bulk_threshold
@@ -54,6 +55,7 @@ class TensorBackend:
         self.batch_threshold = batch_threshold
         self.flavor = flavor
         self.snapshot_cache = snapshot_cache
+        self.exact_topk = exact_topk
         self.enabled: Dict[str, bool] = {}
         self.nodeorder_args: Dict[str, str] = {}
         self.supported = True
