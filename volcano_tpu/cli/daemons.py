@@ -328,8 +328,16 @@ def run_up(port: int = 8443, state: str = "", conf_path: str = "",
     ok = start_apiserver()
     if not ok and port_was_auto:
         # _free_port's bind-then-close probe can lose a TOCTOU race on a
-        # busy host: retry once on a fresh port
-        procs.pop().terminate()
+        # busy host: retry once on a fresh port. The failed process must be
+        # fully gone first — two apiservers racing one --state file would
+        # interleave flushes
+        failed = procs.pop()
+        failed.terminate()
+        try:
+            failed.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            failed.kill()
+            failed.wait()
         port = _free_port()
         url = f"http://127.0.0.1:{port}"
         ok = start_apiserver()

@@ -14,10 +14,14 @@ equivalent of "wait for informer sync").
 
 from __future__ import annotations
 
+import copy
 import enum
 from collections import defaultdict, deque
 from dataclasses import dataclass
 from typing import Any, Callable, Deque, Dict, Iterator, List, Optional
+
+#: sentinel for "attribute absent" in patch's no-op field comparison
+_MISSING = object()
 
 
 class EventType(str, enum.Enum):
@@ -134,7 +138,13 @@ class Store:
     def patch(self, kind: str, key: str, fields: Dict[str, Any]) -> Any:
         """Apply field updates to the stored object in place (the API
         server's PATCH; Bind is a node_name patch). Attribute names must
-        already exist on the object — typos fail loudly."""
+        already exist on the object — typos fail loudly.
+
+        Hot path for the async applier's bind batches: when a shadow
+        exists, only the patched fields are cloned into a copy-on-write
+        shadow instead of re-cloning the whole object per write — the
+        full-object deep_clone was 75% of drain time at 100k binds/cycle.
+        """
         with self._mu:
             obj = self._objects[kind].get(key)
             if obj is None:
@@ -144,9 +154,35 @@ class Store:
             for k in fields:
                 if not hasattr(obj, k):
                     raise AttributeError(f"{kind} has no field {k!r}")
+            shadow = self._shadow[kind].get(key)
+            if shadow is None or "meta" in fields:
+                for k, v in fields.items():
+                    setattr(obj, k, v)
+                return self.update(kind, obj)
+            if all(
+                getattr(obj, k) == v and getattr(shadow, k, _MISSING) == v
+                for k, v in fields.items()
+            ):
+                return obj  # no-op: quiescence contract (see update())
+            from volcano_tpu.api.fastclone import deep_clone
+
             for k, v in fields.items():
                 setattr(obj, k, v)
-            return self.update(kind, obj)
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            # copy-on-write shadow: unpatched fields share the old shadow's
+            # (immutable-by-contract) values; the queued Event keeps the old
+            # shadow object untouched as its pre-update view
+            new_shadow = copy.copy(shadow)
+            new_shadow.meta = copy.copy(shadow.meta)
+            new_shadow.meta.resource_version = self._rv
+            for k, v in fields.items():
+                setattr(new_shadow, k, deep_clone(v))
+            ev = Event(kind, EventType.UPDATED, obj, shadow)
+            for q in self._watchers[kind]:
+                q.append(ev)
+            self._shadow[kind][key] = new_shadow
+            return obj
 
     def bulk(self, ops: List[Dict[str, Any]]) -> List[Optional[str]]:
         """Apply N mutations in one call — the store-side half of batched
