@@ -289,3 +289,138 @@ def test_fast_backfill_places_best_effort():
     assert calls == [True]
     binds = dict(sched.cache.bind_log)
     assert binds == {"default/p0": "n0", "default/be-0": "n0"}
+
+
+# -- static predicate classes on the fast path -------------------------------
+
+def classy_store(seed=0):
+    """Selectors, node affinity, tolerations, tainted/cordoned nodes —
+    everything the class system expresses."""
+    import random
+
+    from volcano_tpu.api.objects import Affinity, Taint, Toleration
+
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(6):
+        n = build_node(f"n{i:02d}", cpu="8", memory="16Gi",
+                       labels={"zone": "a" if i % 2 else "b",
+                               "disk": "ssd" if i < 3 else "hdd"})
+        if i == 4:
+            n.taints.append(Taint(key="dedicated", value="infra",
+                                  effect="NoSchedule"))
+        if i == 5:
+            n.unschedulable = True
+        nodes.append(n)
+    queues = [build_queue("default")]
+    podgroups, pods = [], []
+    for j in range(6):
+        n_tasks = rng.randint(1, 3)
+        podgroups.append(build_podgroup(f"job{j}", min_member=1))
+        for t in range(n_tasks):
+            pod = build_pod(f"job{j}-{t}", group=f"job{j}",
+                            cpu=rng.choice(["500m", "1"]),
+                            priority=rng.choice([0, 5]))
+            if j % 3 == 0:
+                pod.spec.node_selector = {"zone": "a"}
+            elif j % 3 == 1:
+                pod.spec.affinity = Affinity(
+                    node_terms=[[("disk", "In", ("ssd",))]],
+                    preferred_node_terms=[(7, [("zone", "In", ("a",))])],
+                )
+            else:
+                pod.spec.tolerations = [
+                    Toleration(key="dedicated", operator="Equal",
+                               value="infra", effect="NoSchedule")
+                ]
+            pods.append(pod)
+    return make_store(nodes=nodes, queues=queues, podgroups=podgroups,
+                      pods=pods)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fast_snapshot_class_parity(seed):
+    """Per-class masks/scores and task class indices match the object
+    builder exactly for selector/affinity/toleration workloads."""
+    store = classy_store(seed)
+    obj = _object_snapshot(store)
+    fast, aux = _fast_snapshot(store)
+    for field in ("task_class", "class_node_mask", "class_node_score",
+                  "task_req", "task_job", "job_start", "job_ntasks"):
+        np.testing.assert_array_equal(
+            getattr(fast, field), getattr(obj, field), err_msg=field
+        )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fast_cycle_classy_binds_equal_object_path(seed):
+    conf_obj = default_conf("tpu")
+    conf_obj.fast_path = "off"
+    s1, fast = _binds(classy_store(seed), default_conf("tpu"))
+    assert s1.fast_cycle is not None and s1.fast_cycle.mirror is not None
+    _, obj = _binds(classy_store(seed), conf_obj)
+    assert fast == obj
+
+
+def test_fast_cycle_class_cache_tracks_node_relabel():
+    """A node label change must invalidate its class cells: a selector job
+    that could not fit starts fitting after the relabel."""
+    store = make_store(
+        nodes=[build_node("n0", labels={"zone": "b"})],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg", cpu="1")],
+    )
+    store.get("Pod", "default/p0").spec.node_selector = {"zone": "a"}
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [True] and not sched.cache.bind_log
+    node = store.get("Node", "/n0")
+    node.labels = {"zone": "a"}
+    store.update("Node", node)
+    sched.run_once()
+    assert dict(sched.cache.bind_log) == {"default/p0": "n0"}
+
+
+def test_fast_backfill_respects_classes():
+    """Best-effort tasks only land on nodes passing their own class."""
+    store = make_store(
+        nodes=[build_node("n0", labels={"zone": "b"}),
+               build_node("n1", labels={"zone": "a"})],
+        podgroups=[build_podgroup("pg", min_member=1)],
+        pods=[build_pod("p0", group="pg", cpu="1")],
+    )
+    be = build_pod("be0", group="pg", cpu="0", memory="0")
+    be.spec.node_selector = {"zone": "a"}
+    store.create("Pod", be)
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [True]
+    assert dict(sched.cache.bind_log)["default/be0"] == "n1"
+
+
+def test_class_cap_overflow_falls_back_not_recurses(monkeypatch):
+    """Live classes beyond the cap must flag ineligibility (object path),
+    not recurse through resyncs."""
+    from volcano_tpu.scheduler import fastpath as fp
+
+    monkeypatch.setattr(fp.ArrayMirror, "_MAX_CLASSES", 8)
+    nodes = [build_node("n0", labels={"zone": "a"})]
+    podgroups = [build_podgroup("pg", min_member=1)]
+    pods = []
+    for i in range(12):
+        p = build_pod(f"p{i}", group="pg", cpu="100m", memory="64Mi")
+        p.spec.node_selector = {"zone": "a", f"k{i}": "v"}  # distinct keys
+        pods.append(p)
+    for n in nodes:
+        n.labels.update({f"k{i}": "v" for i in range(12)})
+    store = make_store(nodes=nodes, podgroups=podgroups, pods=pods)
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()  # must terminate, not RecursionError
+    assert calls == [False]
+    assert sched.fast_cycle.mirror.ineligible_reason() == (
+        "predicate class cap exceeded"
+    )
+    assert len(sched.cache.bind_log) == 12  # object path scheduled them

@@ -19,11 +19,13 @@ cluster state as arrays end-to-end:
                                               ▼
                      applier bulk verbs ◀── decisions + status patches
 
-The fast cycle runs when the session is *expressible*: every predicate the
-cluster needs collapses into the node-static mask (no selectors, affinity,
-tolerations, host ports, volumes, PDBs, or group-less pods — counters track
-these incrementally) and the configured tiers are kernel-modeled.  Anything
-else falls back to the object path for that cycle, unchanged.
+The fast cycle runs when the session is *expressible*: static predicates
+(node selectors, node affinity, tolerations — plus node readiness/taints/
+pressure) factor into per-class [C, N] mask rows exactly as on the object
+tensor path, computed by the SAME shared helpers and cached per
+(class, node) cell with node-event invalidation.  Only resident-state
+predicates (host ports, pod (anti)affinity, volumes), PDBs, and group-less
+pods force the object path — counters track these incrementally.
 
 Decision parity: the fast snapshot builder reproduces snapshot.py's array
 semantics field-for-field (tests/test_fastpath.py asserts equality against
@@ -71,6 +73,27 @@ _ALLOCATED_CODES = (_BOUND, _RUNNING)
 _READY_CODES = (_BOUND, _RUNNING, _SUCCEEDED)
 
 _INT32_MAX = np.iinfo(np.int32).max
+
+
+class _TaskShim:
+    """Minimal TaskInfo view for the shared predicate/class helpers (they
+    read ``task.pod.spec`` only)."""
+
+    __slots__ = ("pod",)
+
+    def __init__(self, pod):
+        self.pod = pod
+
+
+class _NodeShim:
+    """Minimal NodeInfo view for the shared predicate/score helpers (they
+    read ``node.node`` and ``node.name`` only)."""
+
+    __slots__ = ("node", "name")
+
+    def __init__(self, node_obj):
+        self.node = node_obj
+        self.name = node_obj.meta.name
 
 
 class _Rows:
@@ -143,6 +166,7 @@ class ArrayMirror:
             )
         ]
         self._synced = False
+        self._resyncing = False
         self._reset_tables(["cpu", "memory"])
 
     def _reset_tables(self, dims: List[str]) -> None:
@@ -167,8 +191,22 @@ class ArrayMirror:
         self.nodes = _Rows(reuse=False)  # pod rows hold node row indices
         self.n_alloc = np.zeros((0, R), np.float32)
         self.n_max_tasks = np.zeros((0,), np.int32)
-        self.n_static_ok = np.zeros((0,), bool)  # ready/schedulable/untainted
         self.n_live = np.zeros((0,), bool)
+        self.node_objs: List[Optional[object]] = []  # row -> Node object
+
+        # static predicate classes (snapshot.py's factorization): pods
+        # intern their (selector, affinity, tolerations, ports) key to a
+        # mirror-global class id; per-(class, node) mask/raw-affinity-score
+        # cells are computed lazily via the SAME _static_predicate /
+        # node_affinity_score code the object builder uses, and node events
+        # invalidate just that node's column
+        self.class_ids: Dict[object, int] = {}
+        self.class_examples: List[object] = []   # class id -> example pod
+        self.class_overflow = False  # live classes exceed the cap
+        self.cls_mask = np.zeros((0, 0), bool)   # [Ccap, Ncap]
+        self.cls_score = np.zeros((0, 0), np.float32)
+        self.cls_valid = np.zeros((0, 0), bool)  # cell computed?
+        self.p_class = np.zeros((0,), np.int32)
         # name -> retired row list: a node deleted and re-created must pull
         # its still-resident pods' p_node links onto the new row, or their
         # usage would silently vanish from the reborn node
@@ -210,9 +248,15 @@ class ArrayMirror:
 
     def _resync(self, dims: Optional[List[str]] = None) -> None:
         """Full rebuild from store lists (queue/priority-class change,
-        scalar-dim widening). Watches stay subscribed; tables reset."""
+        scalar-dim widening, class-cap churn). Watches stay subscribed;
+        tables reset. Re-entrant class-cap overflow during the rebuild
+        flags the mirror instead of recursing (see _class_id)."""
         self._reset_tables(dims or ["cpu", "memory"])
-        self._full_sync()
+        self._resyncing = True
+        try:
+            self._full_sync()
+        finally:
+            self._resyncing = False
 
     def _full_sync(self) -> None:
         for pc in self.store.items("PriorityClass"):
@@ -312,8 +356,9 @@ class ArrayMirror:
         n = row + 1
         self.n_alloc = _grow(self.n_alloc, n)
         self.n_max_tasks = _grow(self.n_max_tasks, n)
-        self.n_static_ok = _grow(self.n_static_ok, n)
         self.n_live = _grow(self.n_live, n)
+        while len(self.node_objs) < n:
+            self.node_objs.append(None)
         self.n_alloc[row] = 0.0  # updates may drop a scalar dim
         if not self._vec(node.allocatable, self.n_alloc[row]):
             self._widen_dims(node.allocatable)
@@ -322,27 +367,18 @@ class ArrayMirror:
             node.allocatable.max_task_num
             if node.allocatable.max_task_num is not None else _INT32_MAX
         )
-        pressure = any(
-            c.kind in ("MemoryPressure", "DiskPressure", "PIDPressure")
-            and c.status == "True"
-            for c in node.conditions
-        )
-        # taints exclude the node outright: a toleration-carrying pod would
-        # be dynamic, which forces the object path anyway, so on the fast
-        # path no pod can land on a tainted node — same as _static_predicate
-        tainted = any(
-            t.effect in ("NoSchedule", "NoExecute") for t in node.taints
-        )
-        self.n_static_ok[row] = (
-            node.ready() and not node.unschedulable and not pressure
-            and not tainted
-        )
+        self.node_objs[row] = node
         self.n_live[row] = True
+        # labels/taints/conditions may have changed: every class's cell for
+        # this node recomputes lazily at next build
+        if self.cls_valid.shape[1] > row:
+            self.cls_valid[:, row] = False
 
     def _del_node(self, node) -> None:
         row = self.nodes.release(node.meta.name)
         if row is not None:
             self.n_live[row] = False
+            self.node_objs[row] = None  # retired rows must not pin objects
             self._retired_node_rows.setdefault(node.meta.name, []).append(row)
 
     def _on_podgroup(self, pg) -> None:
@@ -415,14 +451,99 @@ class ArrayMirror:
 
     @staticmethod
     def _pod_dynamic(pod) -> bool:
+        """Resident-state-dependent predicates the class system cannot
+        express (host ports, pod (anti)affinity, volumes) — node selector,
+        node affinity, and tolerations are static and factor into classes,
+        exactly as on the object tensor path (snapshot.py:415-426)."""
         spec = pod.spec
+        aff = spec.affinity
         return bool(
-            spec.node_selector
-            or spec.affinity is not None
-            or spec.tolerations
-            or spec.host_ports
+            spec.host_ports
+            or (aff is not None and (aff.pod_affinity or aff.pod_anti_affinity))
             or pod.volumes
         )
+
+    #: class-count backstop: key churn from long-gone pods eventually
+    #: forces a resync (which drops retired keys), like SnapshotCache's LRU
+    _MAX_CLASSES = 4096
+
+    def _class_id(self, pod) -> Optional[int]:
+        """Intern the pod's static-predicate class key.  Returns None when
+        the class cap was hit: retired-key churn is cured by one full
+        resync (which re-ingests this pod, so the caller must abandon its
+        now-stale row writes); if LIVE pods alone exceed the cap, the
+        mirror marks itself class-overflowed — ineligible_reason() then
+        routes every cycle to the object path instead of resyncing forever.
+        """
+        from volcano_tpu.scheduler.snapshot import _task_class_key
+
+        key = _task_class_key(_TaskShim(pod))
+        cid = self.class_ids.get(key)
+        if cid is not None:
+            return cid
+        if len(self.class_examples) >= self._MAX_CLASSES:
+            if self._resyncing:
+                self.class_overflow = True
+                return None
+            self._resync(dims=self.dims)
+            return None
+        cid = len(self.class_examples)
+        self.class_ids[key] = cid
+        self.class_examples.append(pod)
+        self._ensure_cls_capacity(cid, len(self.node_objs) - 1)
+        return cid
+
+    def _ensure_cls_capacity(self, cid: int, nrow: int) -> None:
+        """Grow the per-(class, node) cell arrays geometrically to cover
+        (cid, nrow) — the single owner of the growth policy."""
+        cap_c, cap_n = self.cls_mask.shape
+        if cid < cap_c and nrow < cap_n:
+            return
+        new_c = max(cap_c, 8)
+        while new_c <= cid:
+            new_c *= 2
+        new_n = max(cap_n, 64)
+        while new_n <= nrow:
+            new_n *= 2
+        mask = np.zeros((new_c, new_n), bool)
+        score = np.zeros((new_c, new_n), np.float32)
+        valid = np.zeros((new_c, new_n), bool)
+        mask[:cap_c, :cap_n] = self.cls_mask
+        score[:cap_c, :cap_n] = self.cls_score
+        valid[:cap_c, :cap_n] = self.cls_valid
+        self.cls_mask, self.cls_score, self.cls_valid = mask, score, valid
+
+    def fill_class_cells(self, cids: np.ndarray, node_rows: np.ndarray,
+                         nodeaffinity_weight: float) -> None:
+        """Compute any uncomputed (class, node) mask/score cells — the SAME
+        predicate/score code the object builder runs (snapshot.py
+        _static_predicate + nodeorder.node_affinity_score), invoked
+        O(new cells) rather than O(C x N) per cycle."""
+        if not cids.size or not node_rows.size:
+            return
+        self._ensure_cls_capacity(int(cids.max()), int(node_rows.max()))
+        from volcano_tpu.scheduler.plugins.nodeorder import node_affinity_score
+        from volcano_tpu.scheduler.snapshot import _static_predicate
+
+        sub_valid = self.cls_valid[np.ix_(cids, node_rows)]
+        if sub_valid.all():
+            return
+        missing_c, missing_n = np.nonzero(~sub_valid)
+        for ci, ni in zip(missing_c, missing_n):
+            cid = int(cids[ci])
+            nrow = int(node_rows[ni])
+            node_obj = self.node_objs[nrow]
+            if node_obj is None:
+                continue
+            task = _TaskShim(self.class_examples[cid])
+            nview = _NodeShim(node_obj)
+            ok = _static_predicate(task, nview)
+            self.cls_mask[cid, nrow] = ok
+            self.cls_score[cid, nrow] = (
+                nodeaffinity_weight * node_affinity_score(task, nview)
+                if ok else 0.0
+            )
+            self.cls_valid[cid, nrow] = True
 
     def _on_pod(self, pod) -> None:
         if pod.spec.scheduler_name != self.scheduler_name:
@@ -439,9 +560,14 @@ class ArrayMirror:
         self.p_best_effort = _grow(self.p_best_effort, n)
         self.p_live = _grow(self.p_live, n)
         self.p_rank = _grow(self.p_rank, n)
+        self.p_class = _grow(self.p_class, n)
         if new:
             self.p_rank[row] = self._next_rank
             self._next_rank += 1
+        cid = self._class_id(pod)
+        if cid is None:
+            return  # class-cap resync re-ingested everything incl. this pod
+        self.p_class[row] = cid
 
         resreq = pod.spec.resreq()
         init = pod.spec.init_resreq()
@@ -519,6 +645,8 @@ class ArrayMirror:
     # -- eligibility ----------------------------------------------------------
 
     def ineligible_reason(self) -> Optional[str]:
+        if self.class_overflow:
+            return "predicate class cap exceeded"
         if self.other_objects:
             return "PDB/volume objects present"
         if self.dynamic_pods:
@@ -537,14 +665,17 @@ class _TiersOnly:
         self.tiers = tiers
 
 
-def build_fast_snapshot(m: ArrayMirror) -> Tuple[Optional[TensorSnapshot], dict]:
+def build_fast_snapshot(
+    m: ArrayMirror, nodeaffinity_weight: float = 1.0
+) -> Tuple[Optional[TensorSnapshot], dict]:
     """Vectorized TensorSnapshot from the mirror — semantics identical to
     snapshot.build_tensor_snapshot on the same store (asserted by
-    tests/test_fastpath.py), with the predicate system collapsed to the one
-    static class eligibility guarantees.  Returns (snapshot, aux) where aux
-    carries the row<->key mappings the publish step needs; snapshot is None
-    when there are no live queues (nothing schedulable — object path would
-    drop every job too).
+    tests/test_fastpath.py), including the static predicate-class
+    factorization (selectors, node affinity, tolerations — computed by the
+    same shared helpers, cached per (class, node) cell).  Returns
+    (snapshot, aux) where aux carries the row<->key mappings the publish
+    step needs; snapshot is None when there are no live queues (nothing
+    schedulable — object path would drop every job too).
     """
     from volcano_tpu.api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_SCALAR
 
@@ -580,12 +711,10 @@ def build_fast_snapshot(m: ArrayMirror) -> Tuple[Optional[TensorSnapshot], dict]
     node_alloc = np.zeros((N, R), np.float32)
     node_max_tasks = np.full((N,), _INT32_MAX, np.int32)
     node_valid = np.zeros((N,), bool)
-    static_ok = np.zeros((N,), bool)
     if n_live_ct:
         node_alloc[:n_live_ct] = m.n_alloc[node_rows_arr]
         node_max_tasks[:n_live_ct] = m.n_max_tasks[node_rows_arr]
         node_valid[:n_live_ct] = True
-        static_ok[:n_live_ct] = m.n_static_ok[node_rows_arr]
 
     # -- jobs (sorted by PodGroup resource_version, cache.py:415) ------------
     job_rows = np.nonzero(m.j_live)[0]
@@ -702,14 +831,32 @@ def build_fast_snapshot(m: ArrayMirror) -> Tuple[Optional[TensorSnapshot], dict]
             np.cumsum(counts[:-1], out=starts[1:])
         job_start[:n_jobs] = starts.astype(np.int32)
 
-    # single predicate class: the static node mask (all-True when there are
-    # no pending tasks, snapshot.py:498-499)
-    class_mask = np.zeros((1, N), bool)
-    class_score = np.zeros((1, N), np.float32)
+    # predicate classes: remap mirror-global class ids to snapshot indices
+    # in first-appearance order over the (sorted) task rows — the object
+    # builder's insertion-order class indexing (snapshot.py:444-451) —
+    # then gather the lazily-filled per-(class, node) mask/score cells
+    task_class_arr = np.zeros((T,), np.int32)
     if n_tasks:
-        class_mask[0, :n_live_ct] = static_ok[:n_live_ct]
+        g_cls = m.p_class[pe_rows].astype(np.int64)
+        uniq, first_idx = np.unique(g_cls, return_index=True)
+        order = np.argsort(first_idx, kind="stable")
+        lut = np.empty(uniq.size, np.int32)
+        lut[order] = np.arange(uniq.size, dtype=np.int32)
+        task_class_arr[:n_tasks] = lut[np.searchsorted(uniq, g_cls)]
+        cids_in_order = uniq[order]  # snapshot class idx -> mirror class id
     else:
-        class_mask[0, :n_live_ct] = True
+        cids_in_order = np.zeros(0, np.int64)
+    C = max(cids_in_order.size, 1)
+    class_mask = np.zeros((C, N), bool)
+    class_score = np.zeros((C, N), np.float32)
+    if cids_in_order.size and n_live_ct:
+        m.fill_class_cells(cids_in_order, node_rows_arr, nodeaffinity_weight)
+        sel = np.ix_(cids_in_order, node_rows_arr)
+        class_mask[:, :n_live_ct] = m.cls_mask[sel]
+        class_score[:, :n_live_ct] = m.cls_score[sel]
+    else:
+        # no pending tasks: all-True row, matching snapshot.py:498-499
+        class_mask[:, :n_live_ct] = True
 
     total = node_alloc[node_valid].sum(axis=0).astype(np.float32)
 
@@ -730,7 +877,7 @@ def build_fast_snapshot(m: ArrayMirror) -> Tuple[Optional[TensorSnapshot], dict]
         task_uids=pod_keys,  # fast path keys rows by pod key, not uid
         task_req=task_req,
         task_job=task_job,
-        task_class=np.zeros((T,), np.int32),
+        task_class=task_class_arr,
         task_valid=task_valid,
         job_uids=[m.jobs.row_key[r] for r in job_rows],
         job_queue=job_queue,
@@ -797,8 +944,10 @@ class FastCycle:
 
     Divergence from the object path, by design: PodGroup status writes
     replace the whole status (conditions other than Unschedulable are not
-    preserved — nothing else writes conditions today), and unschedulable-
-    condition events are recorded on message transitions only.
+    preserved — nothing else writes conditions today), unschedulable-
+    condition events are recorded on message transitions only, and an
+    unplaceable best-effort task surfaces through the gang condition
+    rather than its own per-task backfill event.
     """
 
     def __init__(self, scheduler):
@@ -819,6 +968,12 @@ class FastCycle:
         )
         self.probe = probe
         self.gang_on = probe.gang_job_ready
+        from volcano_tpu.scheduler.conf import get_plugin_arg
+
+        self.nodeaffinity_weight = (
+            get_plugin_arg(probe.nodeorder_args, "nodeaffinity.weight", 1.0)
+            if probe.enabled.get("nodeorder") else 0.0
+        )
         self.mirror: Optional[ArrayMirror] = None
         self._err_seen = 0
         self._last_unsched: Dict[str, str] = {}
@@ -862,7 +1017,7 @@ class FastCycle:
         self._reconcile_failures(m)
         if m.ineligible_reason() is not None:
             return False
-        snap, aux = build_fast_snapshot(m)
+        snap, aux = build_fast_snapshot(m, self.nodeaffinity_weight)
         if snap is None:
             return False
         if "preempt" in self.conf.actions and self._preempt_possible(snap, aux):
@@ -1099,23 +1254,33 @@ class FastCycle:
                 task_node[placed], minlength=counts.shape[0]
             ).astype(counts.dtype)
         n_nodes = aux["n_nodes"]
-        mask = snap.class_node_mask[0][:n_nodes] & snap.node_valid[:n_nodes]
         max_tasks = snap.node_max_tasks[:n_nodes]
         # order: jobs in creation order, tasks by arrival (ssn.jobs /
         # job.tasks dict order on the object path)
         order = np.lexsort((m.p_rank[be_rows], aux["pod_j"][be_rows]))
         be_rows = be_rows[order]
+        be_cls = m.p_class[be_rows].astype(np.int64)
+        ucids = np.unique(be_cls)
+        m.fill_class_cells(ucids, aux["node_rows"], self.nodeaffinity_weight)
+        cls_masks = {
+            int(cid): m.cls_mask[cid, aux["node_rows"]] for cid in ucids
+        }
         out_nodes = np.full(be_rows.size, -1, np.int32)
-        # first-fit is monotone: capacity only shrinks, so a single forward
-        # scan over nodes serves every task (O(N + B))
-        ptr = 0
+        # first-fit is monotone per class: capacity only shrinks, so one
+        # forward pointer per predicate class serves every task while the
+        # shared count array preserves global task-order semantics
+        ptrs = {int(cid): 0 for cid in ucids}
         for i in range(be_rows.size):
+            cid = int(be_cls[i])
+            mask = cls_masks[cid]
+            ptr = ptrs[cid]
             while ptr < n_nodes and not (
                 mask[ptr] and counts[ptr] < max_tasks[ptr]
             ):
                 ptr += 1
+            ptrs[cid] = ptr
             if ptr >= n_nodes:
-                break
+                continue
             out_nodes[i] = ptr
             counts[ptr] += 1
         ok = out_nodes >= 0
@@ -1318,23 +1483,30 @@ class FastCycle:
             np.subtract.at(
                 idle_after, task_node[placed], snap.task_req[placed]
             )
-        mask = snap.class_node_mask[0][:n_nodes] & snap.node_valid[:n_nodes]
         total = int(snap.node_valid[:n_nodes].sum())
-        excluded = total - int(mask.sum())
         heads = snap.job_start[ujobs]
+        head_cls = snap.task_class[heads]
         req = snap.task_req[heads]  # [U, R]
         out = {}
         R = req.shape[1]
         counts = np.zeros((ujobs.size, R), np.int64)
-        masked = idle_after[mask]
-        for r in range(R):
-            col = np.sort(masked[:, r])
-            # nodes with idle < req  ==  index of first element >= req
-            counts[:, r] = np.searchsorted(col, req[:, r], side="left")
+        excluded = np.zeros(ujobs.size, np.int64)
+        # one sorted-idle column set per predicate class in play
+        for cid in np.unique(head_cls):
+            rows = np.nonzero(head_cls == cid)[0]
+            mask = snap.class_node_mask[cid][:n_nodes] & snap.node_valid[:n_nodes]
+            excluded[rows] = total - int(mask.sum())
+            masked = idle_after[mask]
+            for r in range(R):
+                col = np.sort(masked[:, r])
+                # nodes with idle < req == index of first element >= req
+                counts[rows, r] = np.searchsorted(
+                    col, req[rows, r], side="left"
+                )
         for u, j in enumerate(ujobs):
             reasons = {}
-            if excluded:
-                reasons["node(s) excluded by predicates"] = excluded
+            if excluded[u]:
+                reasons["node(s) excluded by predicates"] = int(excluded[u])
             for r, dim in enumerate(snap.dims):
                 c = int(counts[u, r])
                 if c:
