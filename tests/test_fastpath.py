@@ -225,25 +225,38 @@ def test_fallback_on_groupless_pod():
     assert calls == [False]
 
 
-def test_fallback_when_preempt_could_act():
+def test_preempt_runs_as_object_subcycle_after_fast_passes():
     """Running evictable victims + a starving job in the same queue: the
-    precheck must hand the cycle to the object path."""
-    nodes = [build_node("n0", cpu="2", memory="4Gi")]
-    pg_run = build_podgroup("rich", min_member=1, queue="default")
-    pods = []
-    for t in range(2):
-        p = build_pod(f"rich-{t}", group="rich", cpu="1", memory="1Gi")
-        p.node_name = "n0"
-        p.phase = PodPhase.RUNNING
-        pods.append(p)
-    pg_poor = build_podgroup("poor", min_member=1, queue="default")
-    pods.append(build_pod("poor-0", group="poor", cpu="1", memory="1Gi",
-                          priority=10))
-    store = make_store(nodes=nodes, podgroups=[pg_run, pg_poor], pods=pods)
-    sched = Scheduler(store, conf=full_conf("tpu"))
+    fast passes still run (allocate stays array-native) and the object
+    preempt machinery takes over for the starving tail — victims are
+    evicted and the preemptor pipelines, matching the object-path cycle."""
+    def mk_store():
+        nodes = [build_node("n0", cpu="2", memory="4Gi")]
+        pg_run = build_podgroup("rich", min_member=1, queue="default")
+        pods = []
+        for t in range(2):
+            p = build_pod(f"rich-{t}", group="rich", cpu="1", memory="1Gi")
+            p.node_name = "n0"
+            p.phase = PodPhase.RUNNING
+            pods.append(p)
+        pg_poor = build_podgroup("poor", min_member=1, queue="default")
+        pods.append(build_pod("poor-0", group="poor", cpu="1", memory="1Gi",
+                              priority=10))
+        return make_store(nodes=nodes, podgroups=[pg_run, pg_poor],
+                          pods=pods)
+
+    sched = Scheduler(mk_store(), conf=full_conf("tpu"))
     calls = _spy_fast(sched)
     sched.run_once()
-    assert calls == [False]
+    assert calls == [True]
+    fast_evicts = sorted(sched.cache.evict_log)
+    assert fast_evicts, "preempt sub-cycle evicted nothing"
+
+    conf_obj = full_conf("tpu")
+    conf_obj.fast_path = "off"
+    obj = Scheduler(mk_store(), conf=conf_obj)
+    obj.run_once()
+    assert fast_evicts == sorted(obj.cache.evict_log)
 
 
 def test_full_conf_fast_when_no_preempt_work():
@@ -424,3 +437,14 @@ def test_class_cap_overflow_falls_back_not_recurses(monkeypatch):
         "predicate class cap exceeded"
     )
     assert len(sched.cache.bind_log) == 12  # object path scheduled them
+
+
+def test_non_canonical_action_order_takes_object_path():
+    """The fast passes assume enqueue->reclaim->allocate->backfill->preempt;
+    any other conf order must run the object path (literal conf order)."""
+    conf = full_conf("tpu")
+    conf.actions = ["enqueue", "preempt", "allocate", "backfill"]
+    sched = Scheduler(mixed_store(0), conf=conf)
+    assert not sched.fast_cycle.conf_ok
+    sched.run_once()
+    assert sched.cache.bind_log  # object path still scheduled

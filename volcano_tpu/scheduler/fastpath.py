@@ -960,11 +960,18 @@ class FastCycle:
         probe = TensorBackend(
             _TiersOnly(self.conf.tiers), solve_mode=self.conf.solve_mode
         )
-        known = {"enqueue", "allocate", "backfill", "preempt", "reclaim"}
+        # the fast passes run enqueue -> (reclaim precheck) -> allocate ->
+        # backfill -> (preempt tail); only confs whose action order is a
+        # subsequence of that canonical order preserve object-path parity —
+        # anything else (e.g. preempt before allocate) takes the object
+        # path, which executes actions in literal conf order
+        canonical = ["enqueue", "reclaim", "allocate", "backfill", "preempt"]
+        it = iter(canonical)
+        is_subsequence = all(a in it for a in self.conf.actions)
         self.conf_ok = (
             probe.supported
             and "allocate" in self.conf.actions
-            and set(self.conf.actions) <= known
+            and is_subsequence
         )
         self.probe = probe
         self.gang_on = probe.gang_job_ready
@@ -1020,14 +1027,27 @@ class FastCycle:
         snap, aux = build_fast_snapshot(m, self.nodeaffinity_weight)
         if snap is None:
             return False
-        if "preempt" in self.conf.actions and self._preempt_possible(snap, aux):
-            return False
         if "reclaim" in self.conf.actions and self._reclaim_possible(snap, aux):
+            # reclaim runs BEFORE allocate in conf order: possible work
+            # means the whole cycle must honor that ordering on the object
+            # path
             return False
+        # preempt is the LAST action: the fast passes can run first, with
+        # the object preempt machinery (statements + victim solves) taking
+        # over only if starving tasks actually remain afterwards
+        preempt_later = (
+            "preempt" in self.conf.actions
+            and self._preempt_possible(snap, aux)
+        )
 
         enq_rows = []
         if "enqueue" in self.conf.actions:
             enq_rows = self._enqueue(m, snap, aux)
+            # ship admissions synchronously and immediately: the controller
+            # creates pods only after Inqueue, and a preempt sub-cycle's
+            # close_session (which reads the STORE phase) must not undo an
+            # admission that only lived in the mirror/async queue
+            self._ship_enqueue(m, aux, enq_rows)
 
         t0 = time.perf_counter()
         if aux["n_tasks"]:
@@ -1061,11 +1081,33 @@ class FastCycle:
                   np.zeros(snap.job_min_available.shape[0], np.int64))
         )
 
+        unplaced = bool((snap.task_valid & (task_kind == 0)).any())
+        run_preempt = preempt_later and unplaced
         self._publish_and_close(
             m, snap, aux, task_node, task_kind, ready, be_rows, be_nodes,
             be_per_job, enq_rows,
+            # the object preempt sub-cycle's close_session owns this
+            # cycle's PodGroup statuses (it sees the complete state incl.
+            # preempt pipelines); writing them twice could land out of
+            # order through the async applier
+            write_status=not run_preempt,
         )
+        if run_preempt:
+            self._object_preempt()
         return True
+
+    def _object_preempt(self) -> None:
+        """Starving tasks survived the fast passes and victims may exist:
+        run ONLY the preempt action through the object machinery (its
+        statements + tensor victim solves), on a fresh session that sees
+        the fast cycle's published binds via the in-flight overlay.  This
+        replaces the old whole-cycle fallback — allocate stays array-native
+        even on cycles that preempt."""
+        self.sched.run_object_actions(["preempt"])
+        # close_session wrote statuses the fast fingerprints don't know;
+        # _last_unsched survives — it tracks message transitions, and the
+        # sub-cycle's gang close applies the same transition-only rule
+        self._status_fp.clear()
 
     def _reconcile_failures(self, m: ArrayMirror) -> None:
         """Async-apply failures mean the mirror's optimistic row updates (or
@@ -1223,6 +1265,20 @@ class FastCycle:
             m.j_phase[aux["job_rows"][j]] = inqueue_phase
         return admitted
 
+    def _ship_enqueue(self, m: ArrayMirror, aux: dict, admitted) -> None:
+        """Write admitted groups' Inqueue phase to the store now (read-
+        modify-write preserves counts/conditions).  Admissions are few per
+        cycle; failures land in err_log and retry next cycle."""
+        for j in admitted:
+            pg_key = m.jobs.row_key[aux["job_rows"][j]]
+            try:
+                pg = self.store.get("PodGroup", pg_key)
+                if pg is not None and pg.status.phase == PodGroupPhase.PENDING:
+                    pg.status.phase = PodGroupPhase.INQUEUE
+                    self.store.update("PodGroup", pg)
+            except Exception as e:  # noqa: BLE001 — store outage
+                self.cache._record_err("status", pg_key, e)
+
     # -- backfill (backfill.go:41-78 over arrays) ----------------------------
 
     def _backfill(self, m, snap, aux, task_node, task_kind):
@@ -1292,7 +1348,8 @@ class FastCycle:
     # -- publish + close -----------------------------------------------------
 
     def _publish_and_close(self, m, snap, aux, task_node, task_kind, ready,
-                           be_rows, be_nodes, be_per_job, enq_rows) -> None:
+                           be_rows, be_nodes, be_per_job, enq_rows,
+                           write_status: bool = True) -> None:
         from volcano_tpu.api.objects import PodGroupCondition, PodGroupStatus
 
         n_jobs = aux["n_jobs"]
@@ -1368,7 +1425,10 @@ class FastCycle:
         # (job_info.go:338-373): per-dim insufficient-node counts via a
         # sorted idle column + searchsorted — O((N + U) log N), no [U, N]
         # materialization
-        fit_msgs = self._fit_errors(snap, aux, task_node, task_kind, unready)
+        fit_msgs = (
+            self._fit_errors(snap, aux, task_node, task_kind, unready)
+            if write_status else {}
+        )
 
         inqueue_idx = m._phase_idx[PodGroupPhase.INQUEUE]
         running_phase = m._phase_idx[PodGroupPhase.RUNNING]
@@ -1377,7 +1437,7 @@ class FastCycle:
 
         ops: List[dict] = []
         n_unsched_jobs = 0
-        for j in range(n_jobs):
+        for j in range(n_jobs) if write_status else ():
             jrow = aux["job_rows"][j]
             pg_key = m.jobs.row_key[jrow]
             cur_phase = int(m.j_phase[jrow])
@@ -1442,7 +1502,8 @@ class FastCycle:
             self._status_fp[pg_key] = fp
             ops.append({"op": "patch", "kind": "PodGroup", "key": pg_key,
                         "fields": {"status": status}})
-        metrics.update_unschedule_job_count(n_unsched_jobs)
+        if write_status:
+            metrics.update_unschedule_job_count(n_unsched_jobs)
 
         # -- ship -----------------------------------------------------------
         self.cache.bind_bulk(binds)

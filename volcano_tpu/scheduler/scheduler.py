@@ -238,6 +238,13 @@ class Scheduler:
         if self.fast_cycle is not None and self.fast_cycle.try_run():
             metrics.update_e2e_duration(start)
             return
+        self.run_object_actions(self.conf.actions)
+        metrics.update_e2e_duration(start)
+
+    def run_object_actions(self, names) -> None:
+        """One object-path pass: open a session (with the configured tensor
+        backend attached), execute ``names`` in order, close. Used for the
+        full cycle and by the fast path's preempt sub-cycle."""
         ssn = open_session(self.cache, self.conf.tiers)
 
         if self.conf.backend in ("tpu", "native"):
@@ -253,7 +260,7 @@ class Scheduler:
         else:
             ssn.tensor_backend = None
 
-        for name in self.conf.actions:
+        for name in names:
             action = get_action(name)
             if action is None:
                 continue
@@ -262,4 +269,3 @@ class Scheduler:
             metrics.update_action_duration(name, action_start)
 
         close_session(ssn)
-        metrics.update_e2e_duration(start)
