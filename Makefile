@@ -15,7 +15,8 @@ e2e:
 
 parity:
 	$(PY) -m pytest tests/test_tensor_parity.py tests/test_victim_parity.py \
-	  tests/test_native_backend.py tests/test_batch_solve.py -q
+	  tests/test_native_backend.py tests/test_batch_solve.py \
+	  tests/test_fastpath.py tests/test_parallel.py -q
 
 bench:
 	$(PY) bench.py
