@@ -448,3 +448,46 @@ def test_non_canonical_action_order_takes_object_path():
     assert not sched.fast_cycle.conf_ok
     sched.run_once()
     assert sched.cache.bind_log  # object path still scheduled
+
+
+def test_leadership_loss_resyncs_mirror():
+    """A deposed leader drops its queued decisions (abort_pending) — the
+    fast mirror's optimistic BOUND rows and status fingerprints must
+    resync from the store so re-election schedules those pods again."""
+    from volcano_tpu.leader import LeaderElector
+
+    clock = lambda: 0.0  # takeovers use delete/release, never expiry
+    store = make_store(
+        nodes=[build_node("n0")],
+        podgroups=[build_podgroup("pg", min_member=2)],
+        pods=[build_pod(f"p{i}", group="pg", cpu="1") for i in range(2)],
+    )
+    conf = default_conf("tpu")
+    conf.apply_mode = "async"
+    sched = Scheduler(store, conf=conf,
+                      elector=LeaderElector(store, "s", "a", clock=clock))
+    # stop the applier thread so published decisions stay queued
+    applier = sched.cache.applier
+    applier.stop(flush=False)
+    sched.run_once()  # leads, publishes 2 binds into the (dead) queue
+    assert applier.pending >= 2
+    m = sched.fast_cycle.mirror
+    import volcano_tpu.scheduler.fastpath as fp
+
+    assert (m.p_status[: 2] == fp._BOUND).all()  # optimistic rows
+
+    # lease stolen: next run_once aborts the queue and resyncs the mirror
+    store.delete("Lease", "/s")
+    other = LeaderElector(store, "s", "b", clock=clock)
+    assert other.try_acquire()
+    sched.run_once()
+    assert applier.pending == 0
+    assert (m.p_status[: 2] == fp._PENDING).all()  # store truth restored
+
+    # lease released -> re-election -> pods scheduled again
+    other.release()
+    sched.cache.applier = None  # dead thread; bind synchronously now
+    sched.run_once()
+    assert sorted(k for k, _ in sched.cache.bind_log[2:]) == [
+        "default/p0", "default/p1",
+    ]
