@@ -280,3 +280,56 @@ def test_vtctl_up_one_command_control_plane(tmp_path):
             up.terminate()
         subprocess.run(ENTRY + ["down", "--pidfile", pidfile],
                        capture_output=True, text=True)
+
+
+@pytest.mark.slow
+def test_vtctl_up_tpu_backend_schedules(tmp_path):
+    """The deployed default — tpu backend + fast cycle over RemoteStore —
+    drives a gang job to Running through real processes (this exact path
+    once hid a wire-codec bug the host-backend test could not see)."""
+    pidfile = str(tmp_path / "up.json")
+    env = {**os.environ, "VOLCANO_TPU_BACKEND": "tpu",
+           "VOLCANO_TPU_XLA_CACHE": str(tmp_path / "xla")}
+    up = subprocess.Popen(
+        ENTRY + ["up", "--port", "0", "--detach", "--pidfile", pidfile],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    try:
+        url = ""
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            line = up.stdout.readline()
+            if not line:
+                break
+            if "control plane up" in line:
+                url = line.split("vtctl --server ", 1)[1].split()[0]
+                break
+        assert url, "vtctl up never reported readiness"
+        assert up.wait(timeout=30) == 0
+
+        _vtctl(["--server", url, "cluster", "init", "--nodes", "2"])
+        _vtctl(["--server", url, "job", "run", "--name", "tpujob",
+                "--replicas", "2", "--min", "2"])
+        # generous deadline: the scheduler subprocess compiles its solves
+        # in prewarm before the first cycle
+        deadline = time.monotonic() + 240
+        table = ""
+        while time.monotonic() < deadline:
+            table = _vtctl(["--server", url, "job", "list"])
+            row = next(
+                (ln for ln in table.splitlines() if ln.startswith("tpujob")),
+                "",
+            )
+            if "Running" in row:
+                break
+            time.sleep(0.5)
+        else:
+            log = open(pidfile + ".log").read()[-2000:]
+            raise AssertionError(
+                f"job never ran; table:\n{table}\nlog tail:\n{log}"
+            )
+    finally:
+        if up.poll() is None:
+            up.terminate()
+        subprocess.run(ENTRY + ["down", "--pidfile", pidfile],
+                       capture_output=True, text=True)
