@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test e2e parity bench native examples clean
+.PHONY: test e2e parity bench native examples install clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -21,10 +21,14 @@ parity:
 bench:
 	$(PY) bench.py
 
-native: native/libvtsolver.so
+native: volcano_tpu/native/libvtsolver.so
 
-native/libvtsolver.so: native/solver.cc
-	g++ -O3 -shared -fPIC -fopenmp -std=c++17 native/solver.cc -o native/libvtsolver.so
+volcano_tpu/native/libvtsolver.so: volcano_tpu/native/solver.cc
+	g++ -O3 -shared -fPIC -fopenmp -std=c++17 volcano_tpu/native/solver.cc \
+	  -o volcano_tpu/native/libvtsolver.so
+
+install:
+	$(PY) -m pip install .
 
 examples:
 	$(PY) examples/job_gang.py
@@ -33,5 +37,5 @@ examples:
 	$(PY) examples/job_with_volumes.py
 
 clean:
-	rm -f native/libvtsolver.so
+	rm -f volcano_tpu/native/libvtsolver.so
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -138,6 +138,18 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
         if conf_path
         else full_conf(os.environ.get("VOLCANO_TPU_BACKEND", "tpu"))
     )
+    if conf.backend == "tpu":
+        # a bare `pip install volcano-tpu` has no jax (the [tpu] extra);
+        # degrade the deployed default to the native/host tier instead of
+        # crash-looping the scheduler unit
+        try:
+            import jax  # noqa: F401
+        except ImportError:
+            announce("jax unavailable; scheduler falls back to "
+                     "'native' backend (install volcano-tpu[tpu] for the "
+                     "TPU path)", flush=True)
+            conf.backend = "native"
+            conf.fast_path = "off"
     if conf.apply_mode is None:
         # deployed default: async batched decision application — a cycle's
         # binds are one bulk round trip off the critical path (a conf file
@@ -262,7 +274,7 @@ def _wait_http(url: str, timeout: float = 30.0) -> bool:
 def run_up(port: int = 8443, state: str = "", conf_path: str = "",
            pidfile: str = ".vt-up.json", detach: bool = False,
            schedulers: int = 1, controllers: int = 1,
-           announce=print) -> int:
+           host: str = "127.0.0.1", announce=print) -> int:
     """Bring up the whole control plane — apiserver (+durable state),
     scheduler(s), controller(s), kubelet — as real OS processes with
     health checks: the reference's helm-chart/3-image deployment collapsed
@@ -304,7 +316,11 @@ def run_up(port: int = 8443, state: str = "", conf_path: str = "",
     port_was_auto = port == 0
     if port_was_auto:
         port = _free_port()
-    url = f"http://127.0.0.1:{port}"
+    # children and the health probe dial loopback when the bind address is
+    # a wildcard (0.0.0.0 in containers); a specific interface address is
+    # dialed directly, since it may not answer on 127.0.0.1
+    dial = "127.0.0.1" if host in ("0.0.0.0", "::", "") else host
+    url = f"http://{dial}:{port}"
     py = sys.executable
     procs = []
     # detached daemons must not inherit our stdout (a piped `vtctl up -d`
@@ -319,7 +335,7 @@ def run_up(port: int = 8443, state: str = "", conf_path: str = "",
         return p
 
     def start_apiserver():
-        args = ["apiserver", "--port", str(port)]
+        args = ["apiserver", "--port", str(port), "--host", host]
         if state:
             args += ["--state", state]
         spawn(*args)
@@ -339,7 +355,7 @@ def run_up(port: int = 8443, state: str = "", conf_path: str = "",
             failed.kill()
             failed.wait()
         port = _free_port()
-        url = f"http://127.0.0.1:{port}"
+        url = f"http://{dial}:{port}"
         ok = start_apiserver()
     if not ok:
         announce("error: apiserver failed its health check", flush=True)

@@ -269,6 +269,8 @@ def main(argv=None) -> int:
                                "kubelet with health checks")
     up_p.add_argument("--port", type=int, default=8443,
                       help="apiserver port (0 = pick a free port)")
+    up_p.add_argument("--host", default="127.0.0.1",
+                      help="apiserver bind address (0.0.0.0 in containers)")
     up_p.add_argument("--state", default="",
                       help="durable apiserver state file (etcd analogue)")
     up_p.add_argument("--conf", default="", help="scheduler-conf YAML path")
@@ -309,7 +311,8 @@ def main(argv=None) -> int:
                               conf_path=args.conf, pidfile=args.pidfile,
                               detach=args.detach,
                               schedulers=args.schedulers,
-                              controllers=args.controllers)
+                              controllers=args.controllers,
+                              host=args.host)
     if args.group == "down":
         from volcano_tpu.cli import daemons
 

@@ -1,4 +1,4 @@
-"""Native host-tier solver bindings (ctypes over native/solver.cc).
+"""Native host-tier solver bindings (ctypes over solver.cc in this package).
 
 The reference's CPU hot path is Go with 16-way goroutine parallelism
 (KB/pkg/scheduler/util/scheduler_helper.go:32-106); this framework's native
@@ -21,9 +21,26 @@ from typing import Optional
 
 import numpy as np
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "solver.cc")
-_LIB = os.path.join(_REPO_ROOT, "native", "libvtsolver.so")
+# the source ships inside the package so an installed wheel
+# (`pip install .`) carries it; the on-demand build compiles next to the
+# source when the directory is writable, else under a per-user cache dir
+# (read-only site-packages: root-installed wheel, locked-down container)
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_PKG_DIR, "solver.cc")
+
+
+def _lib_path() -> str:
+    if os.access(_PKG_DIR, os.W_OK):
+        return os.path.join(_PKG_DIR, "libvtsolver.so")
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "volcano_tpu", "native",
+    )
+    return os.path.join(cache, "libvtsolver.so")
+
+
+_LIB = _lib_path()
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -66,6 +83,10 @@ def _build() -> Optional[str]:
 
     Compiles to a per-pid temp path and renames into place so concurrent
     processes racing the build never dlopen a half-written library."""
+    try:
+        os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+    except OSError as e:
+        return f"native build dir unavailable: {e}"
     tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17",
@@ -103,18 +124,31 @@ def load() -> Optional[ctypes.CDLL]:
             if err is not None:
                 _record_failure(err)
                 return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-            lib.vt_allocate_solve.restype = None
-            lib.vt_victim_step.restype = None
-            lib.vt_num_threads.restype = ctypes.c_int32
-        except (OSError, AttributeError) as e:
-            # corrupt .so, wrong arch, or stale symbols from older source:
-            # degrade to the host path instead of crashing the cycle
-            _record_failure(f"native library unusable: {e}")
-            return None
-        _lib = lib
-        return _lib
+        for attempt in (0, 1):
+            try:
+                lib = ctypes.CDLL(_LIB)
+                lib.vt_allocate_solve.restype = None
+                lib.vt_victim_step.restype = None
+                lib.vt_num_threads.restype = ctypes.c_int32
+            except (OSError, AttributeError) as e:
+                # corrupt .so, wrong arch (a stale library shipped or left
+                # over from another machine), or stale symbols: drop it and
+                # rebuild from source once before degrading to the host path
+                if attempt == 0:
+                    try:
+                        os.unlink(_LIB)
+                    except OSError:
+                        pass
+                    err = _build()
+                    if err is None:
+                        continue
+                    _record_failure(err)
+                else:
+                    _record_failure(f"native library unusable: {e}")
+                return None
+            _lib = lib
+            return _lib
+        return None  # unreachable; keeps the lock-scoped contract explicit
 
 
 def build_error() -> Optional[str]:
