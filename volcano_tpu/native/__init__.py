@@ -30,8 +30,17 @@ _SRC = os.path.join(_PKG_DIR, "solver.cc")
 
 
 def _lib_path() -> str:
+    pkg_lib = os.path.join(_PKG_DIR, "libvtsolver.so")
     if os.access(_PKG_DIR, os.W_OK):
-        return os.path.join(_PKG_DIR, "libvtsolver.so")
+        return pkg_lib
+    try:
+        # read-only install but a current prebuilt library sits next to the
+        # source (root built it once for every user): use it rather than
+        # forcing a per-user recompile that needs g++ at runtime
+        if os.path.getmtime(pkg_lib) >= os.path.getmtime(_SRC):
+            return pkg_lib
+    except OSError:
+        pass
     cache = os.path.join(
         os.environ.get("XDG_CACHE_HOME")
         or os.path.join(os.path.expanduser("~"), ".cache"),

@@ -384,6 +384,13 @@ class SchedulerCache:
             from volcano_tpu.scheduler.apply import AsyncApplier
 
             self.applier = AsyncApplier(self)
+        # binds the fast cycle published THIS cycle (pod key -> node): the
+        # residue/preempt sub-cycle's snapshot folds them in exactly like
+        # in-flight async decisions, so the sub-cycle sees the array path's
+        # placements regardless of the Binder seam's write-back timing
+        # (a hermetic FakeBinder never writes the store at all).  Set and
+        # cleared (try/finally) by FastCycle.try_run around its sub-cycle.
+        self.cycle_overlay: Dict[str, str] = {}
         # (task_key, hostname) bind log and (task_key, reason) evict log for
         # observability/tests; cleared by callers.
         self.bind_log: List[Tuple[str, str]] = []
@@ -476,6 +483,10 @@ class SchedulerCache:
         inflight_evicts: Dict[str, str] = {}
         if self.applier is not None:
             inflight_binds, inflight_evicts = self.applier.inflight_view()
+        if self.cycle_overlay:
+            merged = dict(self.cycle_overlay)
+            merged.update(inflight_binds)
+            inflight_binds = merged
         for pod in self.store.items("Pod"):
             if pod.spec.scheduler_name != self.scheduler_name:
                 continue

@@ -19,13 +19,19 @@ cluster state as arrays end-to-end:
                                               ▼
                      applier bulk verbs ◀── decisions + status patches
 
-The fast cycle runs when the session is *expressible*: static predicates
-(node selectors, node affinity, tolerations — plus node readiness/taints/
-pressure) factor into per-class [C, N] mask rows exactly as on the object
-tensor path, computed by the SAME shared helpers and cached per
-(class, node) cell with node-event invalidation.  Only resident-state
-predicates (host ports, pod (anti)affinity, volumes), PDBs, and group-less
-pods force the object path — counters track these incrementally.
+The fast cycle runs whenever the cluster is *expressible*: static
+predicates (node selectors, node affinity, tolerations — plus node
+readiness/taints/pressure) factor into per-class [C, N] mask rows exactly
+as on the object tensor path, computed by the SAME shared helpers and
+cached per (class, node) cell with node-event invalidation.  Jobs whose
+pending pods carry resident-state predicates (host ports, pod
+(anti)affinity, volumes) are PARTITIONED out of the array solve and
+host-solved in an object residue sub-cycle — one odd pod does not forfeit
+the fast path for the rest of the cluster; PDB/PV/PVC/StorageClass objects
+alone never force the object path (PDB shadow gangs attach only to
+group-less pods, volume objects only to claim-referencing pods).  Only
+group-less/unlinked pods and predicate-class-cap overflow take the whole
+cycle to the object path.
 
 Decision parity: the fast snapshot builder reproduces snapshot.py's array
 semantics field-for-field (tests/test_fastpath.py asserts equality against
@@ -186,6 +192,10 @@ class ArrayMirror:
         self.p_best_effort = np.zeros((0,), bool)
         self.p_live = np.zeros((0,), bool)
         self.p_rank = np.zeros((0,), np.int64)          # arrival order
+        # resident-state predicates (host ports, pod (anti)affinity,
+        # volumes): the pod's JOB is partitioned out of the array solve
+        # and host-solved in the residue sub-cycle
+        self.p_dynamic = np.zeros((0,), bool)
         self._next_rank = 0
 
         self.nodes = _Rows(reuse=False)  # pod rows hold node row indices
@@ -237,9 +247,8 @@ class ArrayMirror:
         self.default_priority = 0
 
         # conditions that force the object path, maintained incrementally
-        self.dynamic_pods: Set[str] = set()    # selector/affinity/toleration/
-        self.groupless_pods: Set[str] = set()  # ports/volumes | no PodGroup
-        self.other_objects: Set[Tuple[str, str]] = set()  # PDB/PV/PVC/SC keys
+        # (dynamic pods no longer do: p_dynamic partitions them per job)
+        self.groupless_pods: Set[str] = set()  # pods with no PodGroup annotation
 
         self._phases = list(PodGroupPhase)
         self._phase_idx = {p: i for i, p in enumerate(self._phases)}
@@ -263,10 +272,6 @@ class ArrayMirror:
             self._on_priority_class(pc)
         for q in self.store.items("Queue"):
             self._on_queue(q)
-        for kind in ("PodDisruptionBudget", "PersistentVolume",
-                     "PersistentVolumeClaim", "StorageClass"):
-            for obj in self.store.items(kind):
-                self.other_objects.add((kind, obj.meta.key))
         for node in self.store.items("Node"):
             self._on_node(node)
         for pg in self.store.items("PodGroup"):
@@ -309,11 +314,8 @@ class ArrayMirror:
                     resync = True
                 elif kind == "PriorityClass":
                     resync = True  # priorities baked into pod/job rows
-                else:
-                    if deleted:
-                        self.other_objects.discard((kind, ev.obj.meta.key))
-                    else:
-                        self.other_objects.add((kind, ev.obj.meta.key))
+                # PDB/PV/PVC/StorageClass events need no mirror state:
+                # the residue/preempt sub-cycles read the store directly
         if resync:
             self._resync()
 
@@ -560,6 +562,7 @@ class ArrayMirror:
         self.p_best_effort = _grow(self.p_best_effort, n)
         self.p_live = _grow(self.p_live, n)
         self.p_rank = _grow(self.p_rank, n)
+        self.p_dynamic = _grow(self.p_dynamic, n)
         self.p_class = _grow(self.p_class, n)
         if new:
             self.p_rank[row] = self._next_rank
@@ -612,16 +615,12 @@ class ArrayMirror:
             self._clear_wait(key)
             self.p_job[row] = -1
         self.p_best_effort[row] = resreq.is_empty()
-        if self._pod_dynamic(pod):
-            self.dynamic_pods.add(key)
-        else:
-            self.dynamic_pods.discard(key)
+        self.p_dynamic[row] = self._pod_dynamic(pod)
         self.p_live[row] = True
 
     def _del_pod(self, pod) -> None:
         key = pod.meta.key
         row = self.pods.release(key)
-        self.dynamic_pods.discard(key)
         self.groupless_pods.discard(key)
         self.unlinked_pods.discard(key)
         self._clear_wait(key)
@@ -633,7 +632,6 @@ class ArrayMirror:
         pod = self.store.get("Pod", key)
         if pod is None:
             row = self.pods.release(key)
-            self.dynamic_pods.discard(key)
             self.groupless_pods.discard(key)
             self.unlinked_pods.discard(key)
             self._clear_wait(key)
@@ -645,12 +643,17 @@ class ArrayMirror:
     # -- eligibility ----------------------------------------------------------
 
     def ineligible_reason(self) -> Optional[str]:
+        """Only conditions the mirror structurally cannot express force the
+        object path.  Deliberately NOT here:
+          * PDB/PV/PVC/StorageClass objects — PDB shadow gangs attach only
+            via owner refs on group-less pods (cache.py:454-466), which
+            already defer below; volume objects matter only to pods that
+            reference a claim, and those are dynamic pods;
+          * dynamic pods (host ports, pod (anti)affinity, volumes) — their
+            JOBS are partitioned out of the array solve and host-solved in
+            the residue sub-cycle (build_fast_snapshot / FastCycle)."""
         if self.class_overflow:
             return "predicate class cap exceeded"
-        if self.other_objects:
-            return "PDB/volume objects present"
-        if self.dynamic_pods:
-            return "pods with resident-state predicates"
         if self.groupless_pods:
             return "pods without a PodGroup"
         if self.unlinked_pods:
@@ -805,10 +808,45 @@ def build_fast_snapshot(
             pod_j[rd_rows], minlength=n_jobs
         ).astype(np.int32)[:n_jobs]
 
-    # pending non-BestEffort task rows, grouped by job in job order, within
-    # a job by (-priority, arrival) — snapshot.py:395-406 with the uid-
-    # arrival divergence documented in the module docstring
-    pend_express = pend_all & ~m.p_best_effort[:P]
+    # -- dynamic-job partition (snapshot.py:414-436) -------------------------
+    # a job with any live PENDING resident-state pod (host ports, pod
+    # (anti)affinity, volumes) is excluded WHOLE from the array solve; the
+    # residue sub-cycle host-solves it (within-job task order intact, gang
+    # atomicity preserved).  Resident dynamic pods need no exclusion: their
+    # usage is plain resources and express pods carry no resident-state
+    # predicates of their own.
+    nJ = max(n_jobs, 1)
+    dyn_job = np.zeros(nJ, bool)
+    dyn_rows = np.nonzero(pend_all & m.p_dynamic[:P])[0]
+    if dyn_rows.size and n_jobs:
+        dyn_job[np.unique(pod_j[dyn_rows])] = True
+    # job-order safety (snapshot.py:581-586): a dynamic job outranking an
+    # express job in its queue would be served AFTER it by the device-first
+    # partition — priority inversion under contention; the caller must take
+    # the exact host path for the whole cycle instead.  (Equal-priority
+    # interleave divergence remains, the documented approximation class.)
+    partition_unsafe = False
+    if dyn_rows.size and n_jobs:
+        pend_nonbe = pend_all & ~m.p_best_effort[:P]
+        contender = np.zeros(nJ, bool)
+        nb_rows = np.nonzero(pend_nonbe)[0]
+        if nb_rows.size:
+            contender[np.unique(pod_j[nb_rows])] = True
+        for q in np.unique(job_q_idx[dyn_job[:n_jobs] & contender[:n_jobs]]):
+            sel = job_q_idx == q
+            dp = m.j_prio[job_rows[sel & dyn_job[:n_jobs] & contender[:n_jobs]]]
+            ep = m.j_prio[job_rows[sel & ~dyn_job[:n_jobs] & contender[:n_jobs]]]
+            if dp.size and ep.size and dp.max() > ep.min():
+                partition_unsafe = True
+                break
+
+    # pending non-BestEffort task rows of EXPRESS jobs, grouped by job in
+    # job order, within a job by (-priority, arrival) — snapshot.py:395-406
+    # with the uid-arrival divergence documented in the module docstring
+    dyn_of_pod = np.zeros(P, bool)
+    if dyn_rows.size:
+        dyn_of_pod[pod_j >= 0] = dyn_job[np.clip(pod_j[pod_j >= 0], 0, nJ - 1)]
+    pend_express = pend_all & ~m.p_best_effort[:P] & ~dyn_of_pod
     pe_rows = np.nonzero(pend_express)[0]
     if pe_rows.size:
         sort = np.lexsort(
@@ -911,6 +949,15 @@ def build_fast_snapshot(
         pend_any_per_job[:n_jobs] = np.bincount(
             pod_j[pd_rows], minlength=n_jobs
         )[:n_jobs]
+    # pending non-BE counts INCLUDING dynamic jobs — the preempt/reclaim
+    # prechecks must see residue starvation too (conservative direction:
+    # more pending can only make the precheck answer "possible")
+    pend_nonbe_per_job = np.zeros(nJ, np.int64)
+    nb_all = np.nonzero(pend_all & ~m.p_best_effort[:P])[0]
+    if nb_all.size and n_jobs:
+        pend_nonbe_per_job[:n_jobs] = np.bincount(
+            pod_j[nb_all], minlength=n_jobs
+        )[:n_jobs]
 
     aux = {
         "pe_rows": pe_rows,            # task row index -> mirror pod row
@@ -928,6 +975,14 @@ def build_fast_snapshot(
         "node_used": node_used,
         "run_per_job": run_per_job,
         "pend_any_per_job": pend_any_per_job,
+        "pend_nonbe_per_job": pend_nonbe_per_job,
+        # dynamic-job partition outputs
+        "dyn_job": dyn_job,            # [max(n_jobs,1)] bool
+        "partition_unsafe": partition_unsafe,
+        "residue_keys": {
+            m.jobs.row_key[job_rows[j]]
+            for j in np.nonzero(dyn_job[:n_jobs])[0]
+        },
     }
     return snap, aux
 
@@ -1027,6 +1082,10 @@ class FastCycle:
         snap, aux = build_fast_snapshot(m, self.nodeaffinity_weight)
         if snap is None:
             return False
+        if aux["partition_unsafe"]:
+            # a dynamic job outranks an express contender in its queue:
+            # device-first residue would invert priority under contention
+            return False
         if "reclaim" in self.conf.actions and self._reclaim_possible(snap, aux):
             # reclaim runs BEFORE allocate in conf order: possible work
             # means the whole cycle must honor that ordering on the object
@@ -1081,29 +1140,40 @@ class FastCycle:
                   np.zeros(snap.job_min_available.shape[0], np.int64))
         )
 
+        residue = bool(aux["residue_keys"])
         unplaced = bool((snap.task_valid & (task_kind == 0)).any())
-        run_preempt = preempt_later and unplaced
-        self._publish_and_close(
+        run_preempt = preempt_later and (unplaced or residue)
+        run_sub = residue or run_preempt
+        pub_binds = self._publish_and_close(
             m, snap, aux, task_node, task_kind, ready, be_rows, be_nodes,
             be_per_job, enq_rows,
-            # the object preempt sub-cycle's close_session owns this
-            # cycle's PodGroup statuses (it sees the complete state incl.
-            # preempt pipelines); writing them twice could land out of
-            # order through the async applier
-            write_status=not run_preempt,
+            # the object sub-cycle's close_session owns this cycle's
+            # PodGroup statuses (it sees the complete state incl. residue
+            # placements and preempt pipelines); writing them twice could
+            # land out of order through the async applier
+            write_status=not run_sub,
         )
-        if run_preempt:
-            self._object_preempt()
+        if run_sub:
+            # the sub-cycle's snapshot must see this cycle's published
+            # binds even when the Binder seam has not written the store yet
+            self.cache.cycle_overlay = dict(pub_binds)
+            try:
+                self._object_subcycle(aux["residue_keys"], run_preempt)
+            finally:
+                self.cache.cycle_overlay = {}
         return True
 
-    def _object_preempt(self) -> None:
-        """Starving tasks survived the fast passes and victims may exist:
-        run ONLY the preempt action through the object machinery (its
-        statements + tensor victim solves), on a fresh session that sees
-        the fast cycle's published binds via the in-flight overlay.  This
-        replaces the old whole-cycle fallback — allocate stays array-native
-        even on cycles that preempt."""
-        self.sched.run_object_actions(["preempt"])
+    def _object_subcycle(self, residue_keys: Set[str], run_preempt: bool) -> None:
+        """Work survived the fast passes that needs the object machinery —
+        dynamic-predicate jobs (host ports, pod (anti)affinity, volumes)
+        and/or preempt with possible victims (statements + tensor victim
+        solves).  One fresh session sees the fast cycle's published binds
+        via the in-flight overlay, host-solves the residue jobs, runs
+        preempt if needed, and owns the cycle's PodGroup status writes.
+        This replaces the old whole-cycle fallback — allocate stays
+        array-native for express jobs even on cycles that preempt or carry
+        dynamic pods."""
+        self.sched.run_object_residue(residue_keys, run_preempt)
         # close_session wrote statuses the fast fingerprints don't know;
         # _last_unsched survives — it tracks message transitions, and the
         # sub-cycle's gang close applies the same transition-only rule
@@ -1149,7 +1219,9 @@ class FastCycle:
         veto_p, _ = self.probe.victim_vetoes()
         escape = self._gang_escape(snap, aux, veto_p)
         run_per_job = aux["run_per_job"][:n_jobs]
-        pend_per_job = snap.job_ntasks[:n_jobs]
+        # includes dynamic-job pending: residue starvation must reach the
+        # preempt sub-cycle too
+        pend_per_job = aux["pend_nonbe_per_job"][:n_jobs]
         # phase 1: same-queue, cross-job victims
         Q = snap.queue_weight.shape[0]
         q_pending = np.zeros(Q, bool)
@@ -1173,7 +1245,7 @@ class FastCycle:
         _, veto_r = self.probe.victim_vetoes()
         escape = self._gang_escape(snap, aux, veto_r)
         run_per_job = aux["run_per_job"][:n_jobs]
-        pend_per_job = snap.job_ntasks[:n_jobs]
+        pend_per_job = aux["pend_nonbe_per_job"][:n_jobs]
         Q = snap.queue_weight.shape[0]
         q_pending = np.zeros(Q, bool)
         q_victims = np.zeros(Q, bool)
@@ -1299,6 +1371,10 @@ class FastCycle:
             pod_j = aux["pod_j"]
             sched_ok = snap.job_schedulable[pod_j[be_rows]]
             be_rows = be_rows[sched_ok]
+        if be_rows.size:
+            # dynamic jobs backfill in the residue sub-cycle (a BE pod with
+            # host ports needs resident-state predicates)
+            be_rows = be_rows[~aux["dyn_job"][aux["pod_j"][be_rows]]]
         if not be_rows.size:
             return np.zeros(0, np.int64), np.zeros(0, np.int32), be_per_job
         # session node task counts after the allocate pass (both allocation
@@ -1349,7 +1425,7 @@ class FastCycle:
 
     def _publish_and_close(self, m, snap, aux, task_node, task_kind, ready,
                            be_rows, be_nodes, be_per_job, enq_rows,
-                           write_status: bool = True) -> None:
+                           write_status: bool = True) -> List[Tuple[str, str]]:
         from volcano_tpu.api.objects import PodGroupCondition, PodGroupStatus
 
         n_jobs = aux["n_jobs"]
@@ -1526,6 +1602,7 @@ class FastCycle:
                                 "status", op.get("key", op["kind"]),
                                 RuntimeError(err),
                             )
+        return binds
 
     def _fit_errors(self, snap, aux, task_node, task_kind, unready):
         n_jobs = aux["n_jobs"]

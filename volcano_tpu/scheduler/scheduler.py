@@ -241,12 +241,8 @@ class Scheduler:
         self.run_object_actions(self.conf.actions)
         metrics.update_e2e_duration(start)
 
-    def run_object_actions(self, names) -> None:
-        """One object-path pass: open a session (with the configured tensor
-        backend attached), execute ``names`` in order, close. Used for the
-        full cycle and by the fast path's preempt sub-cycle."""
+    def _open_object_session(self):
         ssn = open_session(self.cache, self.conf.tiers)
-
         if self.conf.backend in ("tpu", "native"):
             from volcano_tpu.scheduler.tensor_backend import TensorBackend
 
@@ -259,7 +255,13 @@ class Scheduler:
             )
         else:
             ssn.tensor_backend = None
+        return ssn
 
+    def run_object_actions(self, names) -> None:
+        """One object-path pass: open a session (with the configured tensor
+        backend attached), execute ``names`` in order, close. Used for the
+        full cycle."""
+        ssn = self._open_object_session()
         for name in names:
             action = get_action(name)
             if action is None:
@@ -267,5 +269,37 @@ class Scheduler:
             action_start = time.perf_counter()
             action.execute(ssn)
             metrics.update_action_duration(name, action_start)
+        close_session(ssn)
 
+    def run_object_residue(self, residue_keys, run_preempt: bool) -> None:
+        """The fast cycle's object sub-cycle: host allocate+backfill scoped
+        to the dynamic-predicate residue jobs (identified by PodGroup key),
+        then optionally the full preempt action, in one session that sees
+        the fast cycle's published binds through the in-flight overlay.
+        close_session owns this cycle's PodGroup status writes."""
+        from volcano_tpu.scheduler.actions.allocate import AllocateAction
+        from volcano_tpu.scheduler.actions.backfill import BackfillAction
+
+        ssn = self._open_object_session()
+        if residue_keys:
+            def in_residue(job):
+                return (
+                    job.pod_group is not None
+                    and job.pod_group.meta.key in residue_keys
+                )
+
+            if "allocate" in self.conf.actions:
+                t0 = time.perf_counter()
+                AllocateAction()._execute_host(ssn, job_filter=in_residue)
+                metrics.update_action_duration("allocate", t0)
+            if "backfill" in self.conf.actions:
+                t0 = time.perf_counter()
+                BackfillAction().execute(ssn, job_filter=in_residue)
+                metrics.update_action_duration("backfill", t0)
+        if run_preempt:
+            action = get_action("preempt")
+            if action is not None:
+                t0 = time.perf_counter()
+                action.execute(ssn)
+                metrics.update_action_duration("preempt", t0)
         close_session(ssn)
