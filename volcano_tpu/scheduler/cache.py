@@ -69,6 +69,20 @@ class Evictor:
         pod.deleting = True
         self.store.update("Pod", pod)
 
+    def evict_bulk(self, evicts):
+        """Batched evict: one store round trip for a cycle's victims.
+        Returns per-evict error strings (None on success).  A vanished pod
+        is a success like the per-evict seam (nothing left to delete)."""
+        results = self.store.bulk([
+            {"op": "patch", "kind": "Pod", "key": key,
+             "fields": {"deleting": True}}
+            for key, _ in evicts
+        ])
+        return [
+            None if (err is None or "not found" in err) else err
+            for err in results
+        ]
+
 
 class StatusUpdater:
     def __init__(self, store: Store):
@@ -598,6 +612,43 @@ class SchedulerCache:
                 events.record(
                     self.store, "Pod", key, "Scheduled",
                     events.scheduled_message(key, hostname),
+                )
+            except Exception as e:  # noqa: BLE001
+                self._record_err("event", key, e)
+
+    def evict_bulk(self, evicts) -> None:
+        """Evict a whole cycle's victims: async -> one applier submit;
+        sync -> the Evictor's bulk verb (or the per-evict seam for custom
+        evictors), with the same evict_log/event/err_log semantics as
+        ``evict``.  ``evicts`` is a list of (pod_key, reason)."""
+        from volcano_tpu import events
+
+        if not evicts:
+            return
+        if self.applier is not None:
+            self.applier.submit_evicts(evicts)
+            self.evict_log.extend(evicts)
+            return
+        bulk = getattr(self.evictor, "evict_bulk", None)
+        if bulk is None:
+            for key, reason in evicts:
+                self.evict(_TaskRef(key), reason)
+            return
+        try:
+            errs = bulk(evicts)
+        except Exception as e:  # noqa: BLE001 — store outage: retry next cycle
+            for key, _ in evicts:
+                self._record_err("evict", key, e)
+            return
+        for (key, reason), err in zip(evicts, errs):
+            if err is not None:
+                self._record_err("evict", key, RuntimeError(err))
+                continue
+            self.evict_log.append((key, reason))
+            try:
+                events.record(
+                    self.store, "Pod", key, "Evict",
+                    events.evicted_message(reason), type=events.WARNING,
                 )
             except Exception as e:  # noqa: BLE001
                 self._record_err("event", key, e)

@@ -1,0 +1,539 @@
+"""Array-native preempt/reclaim for the fast cycle (VERDICT r2 next #2).
+
+The object path's contention actions (tensor_actions.preempt/reclaim) keep
+the reference's host loop structure — per-queue priority queues, Statement
+commit/discard, one victim solve per preemptor — but run inside a full
+object Session whose open/close costs O(cluster) Python.  This module runs
+the SAME loop structure directly against the fast mirror's arrays:
+
+  * the per-preemptor victim math is the SAME jitted ``victim_step`` device
+    program (victim_kernels.py) the object tensor path uses, with the same
+    static veto flags, so one compilation serves both paths;
+  * Statement semantics are functional: the device ``VictimState`` tuple is
+    immutable, so checkpoint = keeping the reference and discard = dropping
+    the candidate state (SURVEY §7 step 6's "trivially pure in JAX" note);
+    host-side order-key arrays are small and copied;
+  * ordering parity uses the SAME ``PriorityQueue`` class over less-fns
+    computed from array state, pushed in session iteration order, so the
+    lazy-heap pop behavior under mutating DRF/proportion shares matches the
+    object path exactly (pqueue.py's stale-heap contract);
+  * anything the kernel cannot express — a host walk that would strand
+    evictions on non-covering nodes (``clean=False``, see
+    victim_kernels.py), a best-effort (empty-request) preemptor — aborts
+    the fast pass with nothing published; the caller falls back to the
+    object machinery, which recomputes the same decisions from the store.
+
+Divergences from the object path, same documented class as the fast
+allocate passes: eviction-order ties break by pod *arrival* rank rather
+than uid string order.
+
+Reference loops mirrored: preempt.go:45-273 (two-phase preemption,
+statement per preemptor job), reclaim.go:42-201 (queue-ordered cross-queue
+reclaim, one task per queue visit).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.pqueue import PriorityQueue
+
+
+def _share(alloc: np.ndarray, denom: np.ndarray) -> float:
+    """max over dims of l/r with 0/0 = 0 and x/0 = 1 (helpers.Share)."""
+    zero = denom == 0
+    ratio = np.where(zero, np.where(alloc == 0, 0.0, 1.0),
+                     alloc / np.where(zero, 1.0, denom))
+    return float(ratio.max()) if ratio.size else 0.0
+
+
+def _less_equal(a: np.ndarray, b: np.ndarray, eps: np.ndarray) -> bool:
+    """ε-tolerant a <= b over all dims (resource.py less_equal / the
+    kernels.less_equal twin)."""
+    return bool(((a < b) | (np.abs(a - b) < eps)).all())
+
+
+class FastContention:
+    """One cycle's contention driver over the fast snapshot.
+
+    Owns the device VictimConsts/VictimState plus host order-key state
+    (occupied/pipelined counts, drf job allocations, proportion queue
+    allocations) and the committed eviction/pipeline records.  Build it
+    after enqueue; run ``reclaim_pass`` before the allocate solve and
+    ``preempt_pass`` after backfill (conf action order).
+    """
+
+    def __init__(self, fc, snap, aux, deserved: np.ndarray):
+        import jax.numpy as jnp
+
+        self.fc = fc
+        self.snap = snap
+        self.aux = aux
+        self.jnp = jnp
+        probe = fc.probe
+        self.probe = probe
+        n_jobs = aux["n_jobs"]
+        self.n_jobs = n_jobs
+        self.deserved = deserved  # [Q, R] numpy
+        self.eps = snap.eps
+        self.total = snap.total
+        self.job_min = snap.job_min_available
+        self.job_prio = snap.job_priority
+        self.job_queue = snap.job_queue
+
+        # host order-key state (the plugin attrs the object path tracks via
+        # event handlers)
+        self.occ = snap.job_ready_init.astype(np.int64).copy()
+        self.pipe = np.zeros(self.occ.shape[0], np.int64)
+        self.job_alloc = snap.job_alloc_init.astype(np.float64).copy()
+        self.queue_alloc = snap.queue_alloc_init.astype(np.float64).copy()
+
+        # committed decisions (published by the caller at cycle end)
+        self.evictions: List[Tuple[int, str]] = []  # (pool idx, reason)
+        self.pipelines: List[Tuple[int, int]] = []  # (task row, node idx)
+        self.advanced = False  # advance_post_solve folded the solve in
+
+        veto_p, veto_r = probe.victim_vetoes()
+        self.kw_preempt = dict(
+            use_gang="gang" in veto_p,
+            use_drf="drf" in veto_p,
+            use_prop=False,
+            use_conformance="conformance" in veto_p,
+            order_by_priority=probe.task_order_by_priority,
+        )
+        self.kw_reclaim = dict(
+            use_gang="gang" in veto_r,
+            use_drf=False,
+            use_prop="proportion" in veto_r,
+            use_conformance="conformance" in veto_r,
+            order_by_priority=probe.task_order_by_priority,
+        )
+        self.gang_pipelined = any(
+            opt.name == "gang" and opt.enabled_job_pipelined
+            for tier in fc.conf.tiers for opt in tier.plugins
+        )
+        self.has_proportion = probe.enabled.get("proportion", False)
+
+        from volcano_tpu.scheduler.victim_kernels import VictimConsts, VictimState
+
+        self.consts = VictimConsts(
+            run_req=jnp.asarray(snap.run_req),
+            run_node=jnp.asarray(snap.run_node),
+            run_job=jnp.asarray(snap.run_job),
+            run_prio=jnp.asarray(snap.run_prio),
+            run_rank=jnp.asarray(snap.run_rank),
+            run_evictable=jnp.asarray(snap.run_evictable),
+            job_queue=jnp.asarray(snap.job_queue),
+            job_min=jnp.asarray(snap.job_min_available),
+            node_alloc=jnp.asarray(snap.node_alloc),
+            node_max_tasks=jnp.asarray(snap.node_max_tasks),
+            node_valid=jnp.asarray(snap.node_valid),
+            class_mask=jnp.asarray(snap.class_node_mask),
+            class_score=jnp.asarray(snap.class_node_score),
+            queue_deserved=jnp.asarray(deserved.astype(np.float32)),
+            total=jnp.asarray(snap.total),
+            eps=jnp.asarray(snap.eps),
+            w_least=jnp.float32(probe.score_weights()[0]),
+            w_balanced=jnp.float32(probe.score_weights()[1]),
+        )
+        self.run_live = snap.run_valid.copy()  # host mirror for bookkeeping
+        # one upload for every preemptor's request row: attempt() slices on
+        # device instead of paying a host->device transfer per call
+        self.task_req_dev = jnp.asarray(snap.task_req)
+        self.state = VictimState(
+            run_live=jnp.asarray(snap.run_valid),
+            idle=jnp.asarray(snap.node_idle),
+            releasing=jnp.asarray(snap.node_releasing),
+            used=jnp.asarray(snap.node_used),
+            task_count=jnp.asarray(snap.node_task_count),
+            job_alloc=jnp.asarray(snap.job_alloc_init),
+            job_occupied=jnp.asarray(snap.job_ready_init),
+            queue_alloc=jnp.asarray(snap.queue_alloc_init),
+        )
+
+    # -- consts rebuild after the task re-pack -------------------------------
+
+    def refresh_for_preempt(self, snap) -> None:
+        """The reclaim pass re-packed the task/class arrays (consumed
+        preemptor rows); the preempt pass gathers t_cls against the NEW
+        class indexing, so the consts' class planes must follow."""
+        jnp = self.jnp
+        self.consts = self.consts._replace(
+            class_mask=jnp.asarray(snap.class_node_mask),
+            class_score=jnp.asarray(snap.class_node_score),
+        )
+        self.task_req_dev = jnp.asarray(snap.task_req)
+
+    def advance_post_solve(self, task_node, task_kind, ready,
+                           be_rows, be_nodes) -> None:
+        """Fold the allocate solve's and backfill's session effects into the
+        victim state — the object path gets this from rebuilding the
+        snapshot off the post-allocate session (tensor_actions preempt's
+        _VictimDriver._load).  Allocations consume idle and count ready;
+        pipelines consume releasing and count waiting; backfill placements
+        count ready and a task slot."""
+        jnp = self.jnp
+        snap, aux = self.snap, self.aux
+        idle = np.asarray(self.state.idle).copy()
+        releasing = np.asarray(self.state.releasing).copy()
+        used = np.asarray(self.state.used).copy()
+        tc = np.asarray(self.state.task_count).copy()
+
+        # end-state ready counts: the solve's own output (it already folds
+        # the job_ready_init this state was built from, including any
+        # reclaim evictions), plus backfill below
+        self.occ = np.asarray(ready).astype(np.int64).copy()
+
+        placed = np.nonzero(task_kind == 1)[0]
+        piped = np.nonzero(task_kind == 2)[0]
+        if placed.size:
+            np.subtract.at(idle, task_node[placed], snap.task_req[placed])
+            np.add.at(used, task_node[placed], snap.task_req[placed])
+            np.add.at(tc, task_node[placed], 1)
+            jj = snap.task_job[placed]
+            np.add.at(self.job_alloc, jj, snap.task_req[placed])
+            np.add.at(self.queue_alloc, snap.job_queue[jj],
+                      snap.task_req[placed])
+        if piped.size:
+            np.subtract.at(releasing, task_node[piped], snap.task_req[piped])
+            np.add.at(used, task_node[piped], snap.task_req[piped])
+            np.add.at(tc, task_node[piped], 1)
+            jj = snap.task_job[piped]
+            np.add.at(self.job_alloc, jj, snap.task_req[piped])
+            np.add.at(self.queue_alloc, snap.job_queue[jj],
+                      snap.task_req[piped])
+            np.add.at(self.pipe, jj, 1)
+        if be_rows.size:
+            np.add.at(tc, be_nodes, 1)
+            np.add.at(self.occ, aux["pod_j"][be_rows], 1)
+        idle = np.maximum(idle, 0.0)
+        releasing = np.maximum(releasing, 0.0)
+        self.state = self.state._replace(
+            idle=jnp.asarray(idle.astype(np.float32)),
+            releasing=jnp.asarray(releasing.astype(np.float32)),
+            used=jnp.asarray(used.astype(np.float32)),
+            task_count=jnp.asarray(tc.astype(np.int32)),
+            job_alloc=jnp.asarray(self.job_alloc.astype(np.float32)),
+            job_occupied=jnp.asarray(self.occ.astype(np.int32)),
+            queue_alloc=jnp.asarray(self.queue_alloc.astype(np.float32)),
+        )
+        self.advanced = True
+
+    # -- order fns (session.job_order_fn / queue_order_fn over arrays) -------
+
+    def _job_ready(self, j: int) -> bool:
+        return self.occ[j] >= self.job_min[j]
+
+    def job_pipelined(self, j: int) -> bool:
+        if not self.gang_pipelined:
+            return True
+        return self.occ[j] + self.pipe[j] >= self.job_min[j]
+
+    def _job_share(self, j: int) -> float:
+        return _share(self.job_alloc[j], self.total)
+
+    def _job_less(self, l: int, r: int) -> bool:
+        for key in self.probe.job_key_order:
+            if key == "priority":
+                lp, rp = self.job_prio[l], self.job_prio[r]
+                if lp != rp:
+                    return bool(lp > rp)
+            elif key == "gang":
+                lr, rr = self._job_ready(l), self._job_ready(r)
+                if lr != rr:
+                    return rr  # not-ready schedules first (gang.py:48-57)
+            elif key == "drf":
+                ls, rs = self._job_share(l), self._job_share(r)
+                if ls != rs:
+                    return ls < rs
+        # creation order == job index (snapshot job order); uid never ties
+        return l < r
+
+    def _queue_share(self, q: int) -> float:
+        return _share(self.queue_alloc[q], self.deserved[q])
+
+    def _queue_less(self, l: int, r: int) -> bool:
+        if self.has_proportion:
+            ls, rs = self._queue_share(l), self._queue_share(r)
+            if ls != rs:
+                return ls < rs
+        # queue index order == sorted-uid order (build_fast_snapshot)
+        return l < r
+
+    def overused(self, q: int) -> bool:
+        if not self.has_proportion:
+            return False
+        return _less_equal(self.deserved[q], self.queue_alloc[q], self.eps)
+
+    # -- one preemptor's device solve ----------------------------------------
+
+    def attempt(self, t: int, mode: str):
+        """Returns (ok, clean).  On ok the state advanced and the decision
+        is recorded in the PENDING lists (committed by the caller)."""
+        from volcano_tpu.scheduler.victim_kernels import victim_step
+
+        import jax
+
+        snap = self.snap
+        jt = int(snap.task_job[t])
+        qt = int(snap.job_queue[jt])
+        kw = self.kw_reclaim if mode == "reclaim" else self.kw_preempt
+        out_state, assigned, nstar, vmask, clean = victim_step(
+            self.consts, self.state, self.task_req_dev[t],
+            int(snap.task_class[t]), jt, qt, mode=mode, **kw,
+        )
+        # ONE device round trip for all control-flow outputs (per-output
+        # np.asarray would pay a tunnel RTT each)
+        assigned, nstar, vmask, clean = jax.device_get(
+            (assigned, nstar, vmask, clean)
+        )
+        if not bool(clean):
+            return False, False
+        if not bool(assigned):
+            return False, True
+        self.state = out_state
+        nstar = int(nstar)
+        vidx = np.nonzero(vmask)[0]
+        # eviction record order: preempt drains the reversed task-order
+        # queue (prio asc, rank desc); reclaim evicts in pool (insertion)
+        # order — tensor_actions._VictimDriver.attempt's exact rule
+        if mode == "reclaim":
+            vlist = sorted(int(i) for i in vidx)
+        elif kw["order_by_priority"]:
+            vlist = sorted(
+                (int(i) for i in vidx),
+                key=lambda i: (snap.run_prio[i], -snap.run_rank[i]),
+            )
+        else:
+            vlist = sorted((int(i) for i in vidx),
+                           key=lambda i: -snap.run_rank[i])
+
+        # host order-key bookkeeping (the object path's event handlers)
+        t_req = snap.task_req[t]
+        if vidx.size:
+            vjobs = snap.run_job[vidx]
+            np.subtract.at(self.job_alloc, vjobs, snap.run_req[vidx])
+            np.subtract.at(self.occ, vjobs, 1)
+            vq = snap.job_queue[vjobs]
+            ok_q = vq >= 0
+            if ok_q.any():
+                np.subtract.at(self.queue_alloc, vq[ok_q],
+                               snap.run_req[vidx[ok_q]])
+            self.run_live[vidx] = False
+        self.job_alloc[jt] += t_req
+        if qt >= 0:
+            self.queue_alloc[qt] += t_req
+        self.pipe[jt] += 1
+
+        reason = "reclaim" if mode == "reclaim" else "preempt"
+        self.evictions.extend((i, reason) for i in vlist)
+        self.pipelines.append((t, nstar))
+        return True, True
+
+    # -- statement (functional checkpoint) -----------------------------------
+
+    def checkpoint(self):
+        return (
+            self.state, self.occ.copy(), self.pipe.copy(),
+            self.job_alloc.copy(), self.queue_alloc.copy(),
+            self.run_live.copy(), len(self.evictions), len(self.pipelines),
+        )
+
+    def restore(self, ckpt) -> None:
+        (self.state, self.occ, self.pipe, self.job_alloc, self.queue_alloc,
+         self.run_live, ne, np_) = ckpt
+        del self.evictions[ne:]
+        del self.pipelines[np_:]
+
+    # -- the passes ----------------------------------------------------------
+
+    def _sched_jobs(self):
+        """Job indices the contention loops visit, in session iteration
+        order: schedulable PodGroup phase (enqueue's admissions included),
+        queue always known (queue-less jobs were dropped at build)."""
+        snap = self.snap
+        return [
+            j for j in range(self.n_jobs) if snap.job_schedulable[j]
+        ]
+
+    def _pending_rows(self, j: int, placed_mask: Optional[np.ndarray]):
+        """This job's pending express rows in task order; ``placed_mask``
+        (by task row) excludes rows the solve placed (preempt runs on the
+        post-allocate pending set)."""
+        snap = self.snap
+        start, n = int(snap.job_start[j]), int(snap.job_ntasks[j])
+        rows = range(start, start + n)
+        if placed_mask is None:
+            return deque(rows)
+        return deque(r for r in rows if not placed_mask[r])
+
+    def reclaim_pass(self) -> bool:
+        """reclaim.go:42-201 / tensor_actions.reclaim: queue-ordered, one
+        job + one task per queue visit, re-push the queue on success.
+        Returns False when the object machinery must take the whole cycle
+        (kernel-inexpressible case encountered); nothing was published."""
+        aux = self.aux
+        pend = aux["pend_nonbe_per_job"]
+        queues_seen: List[int] = []
+        jobs_by_q: Dict[int, PriorityQueue] = {}
+        tasks_by_job: Dict[int, deque] = {}
+        for j in self._sched_jobs():
+            q = int(self.job_queue[j])
+            if q not in jobs_by_q:
+                queues_seen.append(q)
+                jobs_by_q[q] = PriorityQueue(self._job_less)
+            if pend[j] > 0:
+                jobs_by_q[q].push(j)
+                tasks_by_job[j] = self._pending_rows(j, None)
+
+        qpq = PriorityQueue(self._queue_less)
+        for q in queues_seen:
+            qpq.push(q)
+        while not qpq.empty():
+            q = qpq.pop()
+            if self.overused(q):
+                continue
+            jobs = jobs_by_q.get(q)
+            if jobs is None or jobs.empty():
+                continue
+            j = jobs.pop()
+            tasks = tasks_by_job.get(j)
+            if tasks is None or not tasks:
+                continue
+            t = tasks.popleft()
+            ok, clean = self.attempt(t, "reclaim")
+            if not clean:
+                return False
+            if ok:
+                qpq.push(q)
+        return True
+
+    def preempt_pass(self, placed_mask: np.ndarray) -> bool:
+        """preempt.go:45-273 / tensor_actions.preempt: phase 1 same-queue
+        cross-job preemption under statement semantics, phase 2 within-job.
+        Returns False when the object sub-cycle must take over (nothing
+        recorded by this pass survives — the caller discards)."""
+        aux = self.aux
+        pend = aux["pend_nonbe_per_job"]
+        start_ckpt = self.checkpoint()
+        queues_seen: List[int] = []
+        preemptors: Dict[int, PriorityQueue] = {}
+        tasks_by_job: Dict[int, deque] = {}
+        under_request: List[int] = []
+        for j in self._sched_jobs():
+            q = int(self.job_queue[j])
+            if q not in queues_seen:
+                queues_seen.append(q)
+            if pend[j] > 0:
+                rows = self._pending_rows(j, placed_mask)
+                if not rows:
+                    continue  # everything placed: not a preemptor anymore
+                if q not in preemptors:
+                    preemptors[q] = PriorityQueue(self._job_less)
+                preemptors[q].push(j)
+                under_request.append(j)
+                tasks_by_job[j] = rows
+
+        for q in queues_seen:
+            while True:
+                jobs = preemptors.get(q)
+                if jobs is None or jobs.empty():
+                    break
+                j = jobs.pop()
+                ckpt = self.checkpoint()
+                assigned = False
+                while tasks_by_job[j]:
+                    t = tasks_by_job[j].popleft()
+                    before = len(self.evictions)
+                    ok, clean = self.attempt(t, "queue")
+                    if not clean:
+                        self.restore(start_ckpt)
+                        return False
+                    if ok:
+                        assigned = True
+                        metrics.update_preemption_victims(
+                            len(self.evictions) - before
+                        )
+                        metrics.register_preemption_attempt()
+                    if self.job_pipelined(j):
+                        break  # commit: records stay
+                if not self.job_pipelined(j):
+                    self.restore(ckpt)
+                    continue
+                if assigned:
+                    jobs.push(j)
+
+            # phase 2: within-job preemption over ALL under-request jobs —
+            # INSIDE the queue loop, as the reference has it
+            # (preempt.go:146-168 sits inside `for _, queue := range
+            # queues`), so a later queue's phase 1 sees the task queues
+            # phase 2 already drained
+            for j in under_request:
+                while True:
+                    tasks = tasks_by_job.get(j)
+                    if tasks is None or not tasks:
+                        break
+                    t = tasks.popleft()
+                    ok, clean = self.attempt(t, "job")
+                    if not clean:
+                        self.restore(start_ckpt)
+                        return False
+                    if ok:
+                        metrics.register_preemption_attempt()
+                    else:
+                        break
+        return True
+
+    # -- integration back into the fast snapshot -----------------------------
+
+    def fold_into_snapshot(self, m) -> None:
+        """After the reclaim pass: write the advanced node/job/queue state
+        back into the snapshot arrays the allocate solve reads, and re-pack
+        the task arrays without the pipelined preemptor rows (the kernels
+        walk contiguous per-job row ranges)."""
+        snap, aux = self.snap, self.aux
+        snap.node_idle[:] = np.asarray(self.state.idle)
+        snap.node_releasing[:] = np.asarray(self.state.releasing)
+        snap.node_used[:] = np.asarray(self.state.used)
+        snap.node_task_count[:] = np.asarray(self.state.task_count)
+        snap.job_alloc_init[:] = self.job_alloc.astype(np.float32)
+        snap.queue_alloc_init[:] = self.queue_alloc.astype(np.float32)
+        # evictions dropped victims from ready counts; the solve's gang
+        # admission must see it
+        snap.job_ready_init[:] = self.occ.astype(np.int32)
+        if not self.pipelines:
+            return
+        consumed = {t for t, _ in self.pipelines}
+        pe_rows = aux["pe_rows"]
+        keep = np.asarray(
+            [i for i in range(pe_rows.size) if i not in consumed], np.int64
+        )
+        _rebuild_task_arrays(m, self.fc, snap, aux, pe_rows[keep])
+        self.refresh_for_preempt(snap)
+
+
+def _rebuild_task_arrays(m, fc, snap, aux, new_pe_rows) -> None:
+    """Re-pack snap's task/class arrays over the surviving pending rows."""
+    from volcano_tpu.scheduler.fastpath import _task_arrays
+
+    n_jobs = aux["n_jobs"]
+    R = snap.node_idle.shape[1]
+    N = snap.node_idle.shape[0]
+    ta = _task_arrays(
+        m, new_pe_rows, aux["pod_j"], n_jobs, N, R, aux["node_rows"],
+        aux["n_nodes"], fc.nodeaffinity_weight,
+        snap.job_start, snap.job_ntasks,
+    )
+    snap.task_req = ta["task_req"]
+    snap.task_job = ta["task_job"]
+    snap.task_class = ta["task_class"]
+    snap.task_valid = ta["task_valid"]
+    snap.class_node_mask = ta["class_mask"]
+    snap.class_node_score = ta["class_score"]
+    snap.task_uids = ta["pod_keys"]
+    aux["pe_rows"] = new_pe_rows
+    aux["n_tasks"] = ta["n_tasks"]

@@ -58,6 +58,7 @@ from volcano_tpu.api.job import POD_GROUP_KEY
 from volcano_tpu.api.types import PodGroupPhase, PodPhase, TaskStatus
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.scheduler.snapshot import TensorSnapshot, _bucket
+from volcano_tpu.store.store import EventType
 
 # status codes (i8) — a compressed TaskStatus for the pod table
 _PENDING, _BOUND, _RUNNING, _RELEASING, _SUCCEEDED, _FAILED, _OTHER = range(7)
@@ -196,6 +197,10 @@ class ArrayMirror:
         # volumes): the pod's JOB is partitioned out of the array solve
         # and host-solved in the residue sub-cycle
         self.p_dynamic = np.zeros((0,), bool)
+        # conformance veto (plugins/conformance.py): False for
+        # system-critical / kube-system pods — victim pool input for the
+        # fast preempt/reclaim passes (fast_victims.py)
+        self.p_evictable = np.zeros((0,), bool)
         self._next_rank = 0
 
         self.nodes = _Rows(reuse=False)  # pod rows hold node row indices
@@ -292,7 +297,11 @@ class ArrayMirror:
         for kind, q in self._watches:
             while q:
                 ev = q.popleft()
-                deleted = ev.type == "DELETED"  # EventType is a str enum
+                # EventType is a str enum whose VALUE is "Deleted" — a
+                # "DELETED" (name) comparison silently never matches and
+                # every deletion would re-ingest as an upsert, leaving dead
+                # pods consuming mirror capacity forever
+                deleted = ev.type == EventType.DELETED
                 if kind == "Pod":
                     if deleted:
                         self._del_pod(ev.obj)
@@ -563,6 +572,7 @@ class ArrayMirror:
         self.p_live = _grow(self.p_live, n)
         self.p_rank = _grow(self.p_rank, n)
         self.p_dynamic = _grow(self.p_dynamic, n)
+        self.p_evictable = _grow(self.p_evictable, n)
         self.p_class = _grow(self.p_class, n)
         if new:
             self.p_rank[row] = self._next_rank
@@ -616,6 +626,11 @@ class ArrayMirror:
             self.p_job[row] = -1
         self.p_best_effort[row] = resreq.is_empty()
         self.p_dynamic[row] = self._pod_dynamic(pod)
+        self.p_evictable[row] = not (
+            pod.spec.priority_class
+            in ("system-cluster-critical", "system-node-critical")
+            or pod.meta.namespace == "kube-system"
+        )
         self.p_live[row] = True
 
     def _del_pod(self, pod) -> None:
@@ -668,8 +683,131 @@ class _TiersOnly:
         self.tiers = tiers
 
 
+def _task_arrays(m: ArrayMirror, pe_rows: np.ndarray, pod_j: np.ndarray,
+                 n_jobs: int, N: int, R: int, node_rows_arr: np.ndarray,
+                 n_live_ct: int, nodeaffinity_weight: float,
+                 job_start: np.ndarray, job_ntasks: np.ndarray) -> dict:
+    """Task/class arrays from sorted pending express rows.  Called at
+    snapshot build, and AGAIN by the fast reclaim pass after it pipelines
+    preemptors (the kernels walk contiguous job_start..+job_ntasks row
+    ranges, so a consumed row forces a re-pack — the object path gets the
+    same effect from backend.invalidate() between actions).  ``job_start``
+    and ``job_ntasks`` are written in place."""
+    n_tasks = pe_rows.size
+    T = _bucket(max(n_tasks, 1))
+    task_req = np.zeros((T, R), np.float32)
+    task_job = np.zeros((T,), np.int32)
+    task_valid = np.zeros((T,), bool)
+    job_start[:] = 0
+    job_ntasks[:] = 0
+    if n_tasks:
+        task_req[:n_tasks] = m.p_req[pe_rows]
+        task_job[:n_tasks] = pod_j[pe_rows]
+        task_valid[:n_tasks] = True
+        counts = np.bincount(pod_j[pe_rows], minlength=n_jobs)[:n_jobs]
+        job_ntasks[:n_jobs] = counts.astype(np.int32)
+        starts = np.zeros(n_jobs, np.int64)
+        if n_jobs > 1:
+            np.cumsum(counts[:-1], out=starts[1:])
+        job_start[:n_jobs] = starts.astype(np.int32)
+
+    # predicate classes: remap mirror-global class ids to snapshot indices
+    # in first-appearance order over the (sorted) task rows — the object
+    # builder's insertion-order class indexing (snapshot.py:444-451) —
+    # then gather the lazily-filled per-(class, node) mask/score cells
+    task_class_arr = np.zeros((T,), np.int32)
+    if n_tasks:
+        g_cls = m.p_class[pe_rows].astype(np.int64)
+        uniq, first_idx = np.unique(g_cls, return_index=True)
+        order = np.argsort(first_idx, kind="stable")
+        lut = np.empty(uniq.size, np.int32)
+        lut[order] = np.arange(uniq.size, dtype=np.int32)
+        task_class_arr[:n_tasks] = lut[np.searchsorted(uniq, g_cls)]
+        cids_in_order = uniq[order]  # snapshot class idx -> mirror class id
+    else:
+        cids_in_order = np.zeros(0, np.int64)
+    C = max(cids_in_order.size, 1)
+    class_mask = np.zeros((C, N), bool)
+    class_score = np.zeros((C, N), np.float32)
+    if cids_in_order.size and n_live_ct:
+        m.fill_class_cells(cids_in_order, node_rows_arr, nodeaffinity_weight)
+        sel = np.ix_(cids_in_order, node_rows_arr)
+        class_mask[:, :n_live_ct] = m.cls_mask[sel]
+        class_score[:, :n_live_ct] = m.cls_score[sel]
+    else:
+        # no pending tasks: all-True row, matching snapshot.py:498-499
+        class_mask[:, :n_live_ct] = True
+    return {
+        "n_tasks": n_tasks,
+        "task_req": task_req,
+        "task_job": task_job,
+        "task_class": task_class_arr,
+        "task_valid": task_valid,
+        "class_mask": class_mask,
+        "class_score": class_score,
+        "pod_keys": [m.pods.row_key[r] for r in pe_rows],
+    }
+
+
+def build_victim_pool(m: ArrayMirror, snap: TensorSnapshot, aux: dict) -> None:
+    """Fill snap.run_* (the preempt/reclaim victim pool, snapshot.py
+    505-539 semantics) from mirror rows: running tasks in node-resident
+    insertion order — nodes in snapshot order, within a node by arrival
+    (the object pool iterates node.tasks insertion order; arrival-vs-uid
+    rank is the documented divergence).  Called lazily only on cycles
+    whose prechecks say contention work may exist; adds
+    aux["run_rows"] = pool index -> mirror pod row."""
+    live, codes, pod_j = aux["live"], aux["codes"], aux["pod_j"]
+    R = snap.node_idle.shape[1]
+    node_rows_arr = aux["node_rows"]
+    n_idx_of_row = np.full(len(m.n_live), -1, np.int32)
+    if node_rows_arr.size:
+        n_idx_of_row[node_rows_arr] = np.arange(
+            node_rows_arr.size, dtype=np.int32
+        )
+    rrows = np.nonzero(live & (codes == _RUNNING))[0]
+    rnode = rrows
+    if rrows.size:
+        rn = m.p_node[rrows]
+        ok = rn >= 0
+        rrows, rn = rrows[ok], rn[ok]
+        if rrows.size:
+            ok = m.n_live[rn]
+            rrows, rn = rrows[ok], rn[ok]
+        rnode = n_idx_of_row[rn] if rrows.size else rn
+        if rrows.size:
+            ok = rnode >= 0
+            rrows, rnode = rrows[ok], rnode[ok]
+        if rrows.size:
+            order2 = np.lexsort((m.p_rank[rrows], rnode))
+            rrows, rnode = rrows[order2], rnode[order2]
+    nv = rrows.size
+    V = _bucket(max(nv, 1))
+    run_req = np.zeros((V, R), np.float32)
+    run_node = np.zeros((V,), np.int32)
+    run_job = np.zeros((V,), np.int32)
+    run_prio = np.zeros((V,), np.int32)
+    run_rank = np.zeros((V,), np.int32)
+    run_evictable = np.zeros((V,), bool)
+    run_valid = np.zeros((V,), bool)
+    if nv:
+        run_req[:nv] = m.p_resreq[rrows]
+        run_node[:nv] = rnode
+        run_job[:nv] = pod_j[rrows]
+        run_prio[:nv] = m.p_prio[rrows]
+        # dense rank over the pool by arrival (uid-rank stand-in)
+        run_rank[:nv] = np.argsort(np.argsort(m.p_rank[rrows])).astype(np.int32)
+        run_evictable[:nv] = m.p_evictable[rrows]
+        run_valid[:nv] = True
+    snap.run_uids = [m.pods.row_key[r] for r in rrows]
+    snap.run_req, snap.run_node, snap.run_job = run_req, run_node, run_job
+    snap.run_prio, snap.run_rank = run_prio, run_rank
+    snap.run_evictable, snap.run_valid = run_evictable, run_valid
+    aux["run_rows"] = rrows
+
+
 def build_fast_snapshot(
-    m: ArrayMirror, nodeaffinity_weight: float = 1.0
+    m: ArrayMirror, nodeaffinity_weight: float = 1.0,
 ) -> Tuple[Optional[TensorSnapshot], dict]:
     """Vectorized TensorSnapshot from the mirror — semantics identical to
     snapshot.build_tensor_snapshot on the same store (asserted by
@@ -853,53 +991,18 @@ def build_fast_snapshot(
             (m.p_rank[pe_rows], -m.p_prio[pe_rows], pod_j[pe_rows])
         )
         pe_rows = pe_rows[sort]
-    n_tasks = pe_rows.size
-    T = _bucket(max(n_tasks, 1))
-    task_req = np.zeros((T, R), np.float32)
-    task_job = np.zeros((T,), np.int32)
-    task_valid = np.zeros((T,), bool)
-    if n_tasks:
-        task_req[:n_tasks] = m.p_req[pe_rows]
-        task_job[:n_tasks] = pod_j[pe_rows]
-        task_valid[:n_tasks] = True
-        counts = np.bincount(pod_j[pe_rows], minlength=n_jobs)[:n_jobs]
-        job_ntasks[:n_jobs] = counts.astype(np.int32)
-        starts = np.zeros(n_jobs, np.int64)
-        if n_jobs > 1:
-            np.cumsum(counts[:-1], out=starts[1:])
-        job_start[:n_jobs] = starts.astype(np.int32)
-
-    # predicate classes: remap mirror-global class ids to snapshot indices
-    # in first-appearance order over the (sorted) task rows — the object
-    # builder's insertion-order class indexing (snapshot.py:444-451) —
-    # then gather the lazily-filled per-(class, node) mask/score cells
-    task_class_arr = np.zeros((T,), np.int32)
-    if n_tasks:
-        g_cls = m.p_class[pe_rows].astype(np.int64)
-        uniq, first_idx = np.unique(g_cls, return_index=True)
-        order = np.argsort(first_idx, kind="stable")
-        lut = np.empty(uniq.size, np.int32)
-        lut[order] = np.arange(uniq.size, dtype=np.int32)
-        task_class_arr[:n_tasks] = lut[np.searchsorted(uniq, g_cls)]
-        cids_in_order = uniq[order]  # snapshot class idx -> mirror class id
-    else:
-        cids_in_order = np.zeros(0, np.int64)
-    C = max(cids_in_order.size, 1)
-    class_mask = np.zeros((C, N), bool)
-    class_score = np.zeros((C, N), np.float32)
-    if cids_in_order.size and n_live_ct:
-        m.fill_class_cells(cids_in_order, node_rows_arr, nodeaffinity_weight)
-        sel = np.ix_(cids_in_order, node_rows_arr)
-        class_mask[:, :n_live_ct] = m.cls_mask[sel]
-        class_score[:, :n_live_ct] = m.cls_score[sel]
-    else:
-        # no pending tasks: all-True row, matching snapshot.py:498-499
-        class_mask[:, :n_live_ct] = True
+    ta = _task_arrays(m, pe_rows, pod_j, n_jobs, N, R, node_rows_arr,
+                      n_live_ct, nodeaffinity_weight,
+                      job_start, job_ntasks)
+    n_tasks = ta["n_tasks"]
+    task_req, task_job = ta["task_req"], ta["task_job"]
+    task_class_arr, task_valid = ta["task_class"], ta["task_valid"]
+    class_mask, class_score = ta["class_mask"], ta["class_score"]
+    pod_keys = ta["pod_keys"]
 
     total = node_alloc[node_valid].sum(axis=0).astype(np.float32)
 
     node_names = [k for k in m.nodes.key_row]
-    pod_keys = [m.pods.row_key[r] for r in pe_rows]
 
     snap = TensorSnapshot(
         dims=list(m.dims),
@@ -1086,14 +1189,13 @@ class FastCycle:
             # a dynamic job outranks an express contender in its queue:
             # device-first residue would invert priority under contention
             return False
-        if "reclaim" in self.conf.actions and self._reclaim_possible(snap, aux):
-            # reclaim runs BEFORE allocate in conf order: possible work
-            # means the whole cycle must honor that ordering on the object
-            # path
-            return False
-        # preempt is the LAST action: the fast passes can run first, with
-        # the object preempt machinery (statements + victim solves) taking
-        # over only if starving tasks actually remain afterwards
+        reclaim_work = (
+            "reclaim" in self.conf.actions
+            and self._reclaim_possible(snap, aux)
+        )
+        # preempt is the LAST action: the fast passes run first, with the
+        # array-native preempt pass (fast_victims.py) taking over only if
+        # starving tasks actually remain afterwards
         preempt_later = (
             "preempt" in self.conf.actions
             and self._preempt_possible(snap, aux)
@@ -1107,6 +1209,26 @@ class FastCycle:
             # close_session (which reads the STORE phase) must not undo an
             # admission that only lived in the mirror/async queue
             self._ship_enqueue(m, aux, enq_rows)
+
+        cont = None
+        if reclaim_work:
+            # array-native reclaim (conf order: after enqueue, before
+            # allocate).  Kernel-inexpressible reclaimers — dynamic-
+            # predicate (residue) jobs or empty-request tasks — need the
+            # object walk for the WHOLE cycle; nothing is published yet
+            # (the shipped enqueue admissions are idempotent), so the
+            # object path simply re-runs everything from the store.
+            if aux["residue_keys"] or self._pending_best_effort(m, snap, aux):
+                return False
+            t0 = time.perf_counter()
+            cont = self._make_contention(snap, aux)
+            if not cont.reclaim_pass():
+                # the host walk would strand evictions on non-covering
+                # nodes (victim_kernels clean=False): exact parity needs
+                # the object machinery
+                return False
+            cont.fold_into_snapshot(m)
+            metrics.update_action_duration("reclaim", t0)
 
         t0 = time.perf_counter()
         if aux["n_tasks"]:
@@ -1142,8 +1264,35 @@ class FastCycle:
 
         residue = bool(aux["residue_keys"])
         unplaced = bool((snap.task_valid & (task_kind == 0)).any())
-        run_preempt = preempt_later and (unplaced or residue)
-        run_sub = residue or run_preempt
+        obj_preempt = False
+        if preempt_later and (unplaced or residue):
+            if residue or self._pending_best_effort(
+                m, snap, aux, minus_placed=be_rows
+            ):
+                # dynamic or empty-request preemptors: the object preempt
+                # machinery must run — safe only while the fast contention
+                # state holds nothing unpublished
+                if cont is not None and (cont.evictions or cont.pipelines):
+                    return False
+                obj_preempt = True
+            else:
+                t0 = time.perf_counter()
+                if cont is None:
+                    cont = self._make_contention(snap, aux)
+                cont.advance_post_solve(
+                    task_node, task_kind, ready, be_rows, be_nodes
+                )
+                if not cont.preempt_pass(task_kind > 0):
+                    # stranded-eviction case mid-pass: its records were
+                    # rolled back; reclaim's (if any) must not publish
+                    # without the preempt the conf ordered after them
+                    if cont.evictions or cont.pipelines:
+                        return False
+                    obj_preempt = True
+                metrics.update_action_duration("preempt", t0)
+
+        run_sub = residue or obj_preempt
+        evicts, ready_status = self._collect_contention(m, snap, aux, cont)
         pub_binds = self._publish_and_close(
             m, snap, aux, task_node, task_kind, ready, be_rows, be_nodes,
             be_per_job, enq_rows,
@@ -1152,16 +1301,70 @@ class FastCycle:
             # placements and preempt pipelines); writing them twice could
             # land out of order through the async applier
             write_status=not run_sub,
+            evicts=evicts,
+            ready_status=ready_status,
         )
         if run_sub:
             # the sub-cycle's snapshot must see this cycle's published
             # binds even when the Binder seam has not written the store yet
             self.cache.cycle_overlay = dict(pub_binds)
             try:
-                self._object_subcycle(aux["residue_keys"], run_preempt)
+                self._object_subcycle(aux["residue_keys"], obj_preempt)
             finally:
                 self.cache.cycle_overlay = {}
         return True
+
+    def _make_contention(self, snap, aux):
+        """Victim pool + FastContention for this cycle's reclaim/preempt
+        passes (lazy: only cycles whose prechecks found possible work)."""
+        from volcano_tpu.native import water_fill_np
+        from volcano_tpu.scheduler.fast_victims import FastContention
+
+        build_victim_pool(self.mirror, snap, aux)
+        deserved = np.asarray(water_fill_np(
+            snap.queue_weight, snap.queue_request, snap.total, snap.eps,
+            snap.queue_participates,
+        ))
+        return FastContention(self, snap, aux, deserved)
+
+    def _pending_best_effort(self, m, snap, aux, minus_placed=None) -> bool:
+        """Any pending empty-request task of a schedulable job — the
+        kernel-inexpressible preemptor/reclaimer class (its host path takes
+        one victim then stops; tensor_actions._victim_path_usable's rule).
+        ``minus_placed``: mirror rows backfill already placed this cycle."""
+        P = aux["codes"].shape[0]
+        be = aux["live"] & (aux["codes"] == _PENDING) & m.p_best_effort[:P]
+        rows = np.nonzero(be)[0]
+        if not rows.size:
+            return False
+        rows = rows[snap.job_schedulable[aux["pod_j"][rows]]]
+        if minus_placed is not None and minus_placed.size and rows.size:
+            rows = np.setdiff1d(rows, minus_placed, assume_unique=False)
+        return bool(rows.size)
+
+    def _collect_contention(self, m, snap, aux, cont):
+        """Turn the contention passes' records into publishable evictions
+        (+ mirror/status bookkeeping) and the end-state ready counts the
+        status writes should use."""
+        if cont is None or not (cont.evictions or cont.pipelines):
+            return [], None
+        evicts = []
+        run_rows = aux["run_rows"]
+        codes = aux["codes"]
+        for i, reason in cont.evictions:
+            prow = int(run_rows[i])
+            # optimistic mirror update (the store's deleting=True watch
+            # event confirms it); codes drives the status counts — the
+            # object path's close also sees victims as RELEASING
+            m.p_status[prow] = _RELEASING
+            codes[prow] = _RELEASING
+            evicts.append((snap.run_uids[i], reason))
+        # end-state ready counts (post solve/backfill/evictions) exist only
+        # once advance_post_solve folded the solve in; a reclaim-only cycle
+        # already carries its eviction effects through job_ready_init into
+        # the solve's own ready output
+        ready_status = cont.occ.copy() if cont.advanced else None
+        return evicts, ready_status
 
     def _object_subcycle(self, residue_keys: Set[str], run_preempt: bool) -> None:
         """Work survived the fast passes that needs the object machinery —
@@ -1425,7 +1628,15 @@ class FastCycle:
 
     def _publish_and_close(self, m, snap, aux, task_node, task_kind, ready,
                            be_rows, be_nodes, be_per_job, enq_rows,
-                           write_status: bool = True) -> List[Tuple[str, str]]:
+                           write_status: bool = True,
+                           evicts=None,
+                           ready_status=None) -> List[Tuple[str, str]]:
+        """``evicts``: (pod_key, reason) victims from the contention
+        passes, published through the evictor's bulk verb.
+        ``ready_status``: end-state per-job ready counts for the STATUS
+        section when preempt evictions ran after allocate (the bind filter
+        keeps allocate-time readiness, as the object path's dispatch
+        does)."""
         from volcano_tpu.api.objects import PodGroupCondition, PodGroupStatus
 
         n_jobs = aux["n_jobs"]
@@ -1495,7 +1706,14 @@ class FastCycle:
                 pod_j[lrows], minlength=n_jobs
             )[:n_jobs]
 
-        unready = ~gang_ready[:n_jobs] if self.gang_on else np.zeros(n_jobs, bool)
+        status_ready = (
+            ready_final if ready_status is None
+            else ready_status.astype(np.int64)
+        )
+        unready = (
+            status_ready[:n_jobs] < jm[:n_jobs].astype(np.int64)
+            if self.gang_on else np.zeros(n_jobs, bool)
+        )
 
         # fit-error aggregates for unready jobs with pending express tasks
         # (job_info.go:338-373): per-dim insufficient-node counts via a
@@ -1520,7 +1738,7 @@ class FastCycle:
             unsched = bool(unready[j])
             if unsched:
                 n_unsched_jobs += 1
-                unready_n = int(jm[j] - ready_final[j])
+                unready_n = int(jm[j] - status_ready[j])
                 fit = fit_msgs.get(j, "")
                 msg = (
                     f"{unready_n}/{int(ntasks_per_job[j])} tasks in gang "
@@ -1583,6 +1801,8 @@ class FastCycle:
 
         # -- ship -----------------------------------------------------------
         self.cache.bind_bulk(binds)
+        if evicts:
+            self.cache.evict_bulk(evicts)
         if ops:
             applier = self.cache.applier
             if applier is not None:
