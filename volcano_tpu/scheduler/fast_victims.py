@@ -1,31 +1,39 @@
-"""Array-native preempt/reclaim for the fast cycle (VERDICT r2 next #2).
+"""Array-native preempt/reclaim for the fast cycle (VERDICT r2 next #2,
+device-resident storm driver per VERDICT r3 next #1).
 
 The object path's contention actions (tensor_actions.preempt/reclaim) keep
 the reference's host loop structure — per-queue priority queues, Statement
 commit/discard, one victim solve per preemptor — but run inside a full
 object Session whose open/close costs O(cluster) Python.  This module runs
-the SAME loop structure directly against the fast mirror's arrays:
+the SAME loop structure directly against the fast mirror's arrays, and —
+unlike round 3's driver, which paid one host<->device round trip per
+preemptor (~2,000 round trips = the 356 s contended cycle) — the ENTIRE
+pass now runs as one device program:
 
-  * the per-preemptor victim math is the SAME jitted ``victim_step`` device
-    program (victim_kernels.py) the object tensor path uses, with the same
-    static veto flags, so one compilation serves both paths;
+  * ``victim_kernels.reclaim_solve`` / ``preempt_solve`` execute the whole
+    queue-ordered walk (job selection by the session order keys, statement
+    checkpoint/rollback, two-phase preemption) inside a ``lax.while_loop``,
+    so a storm costs ONE dispatch + ONE ``device_get`` regardless of size;
   * Statement semantics are functional: the device ``VictimState`` tuple is
-    immutable, so checkpoint = keeping the reference and discard = dropping
-    the candidate state (SURVEY §7 step 6's "trivially pure in JAX" note);
-    host-side order-key arrays are small and copied;
-  * ordering parity uses the SAME ``PriorityQueue`` class over less-fns
-    computed from array state, pushed in session iteration order, so the
-    lazy-heap pop behavior under mutating DRF/proportion shares matches the
-    object path exactly (pqueue.py's stale-heap contract);
+    immutable, so checkpoint = carrying the reference and discard =
+    selecting it back (SURVEY §7 step 6's "trivially pure in JAX" note);
+  * the kernels record each decision as (victim -> ok-attempt seq,
+    preemptor task -> node + seq) arrays; the host reconstructs the ordered
+    eviction/pipeline lists from one fetch;
   * anything the kernel cannot express — a host walk that would strand
     evictions on non-covering nodes (``clean=False``, see
     victim_kernels.py), a best-effort (empty-request) preemptor — aborts
-    the fast pass with nothing published; the caller falls back to the
-    object machinery, which recomputes the same decisions from the store.
+    the pass with nothing published; the caller falls back to the object
+    machinery, which recomputes the same decisions from the store.
 
 Divergences from the object path, same documented class as the fast
 allocate passes: eviction-order ties break by pod *arrival* rank rather
-than uid string order.
+than uid string order, and job/queue selection takes the exact
+lexicographic minimum of the session order keys each step (the object path
+pops a lazy binary heap whose stale entries can reorder under mutating
+DRF/proportion shares — kernels.allocate_solve's existing, parity-tested
+divergence).  Shares compare in f32 on device vs f64 on host, inside the
+same ε tolerances.
 
 Reference loops mirrored: preempt.go:45-273 (two-phase preemption,
 statement per preemptor job), reclaim.go:42-201 (queue-ordered cross-queue
@@ -34,37 +42,50 @@ reclaim, one task per queue visit).
 
 from __future__ import annotations
 
-from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 
 from volcano_tpu.scheduler import metrics
-from volcano_tpu.scheduler.pqueue import PriorityQueue
 
 
-def _share(alloc: np.ndarray, denom: np.ndarray) -> float:
-    """max over dims of l/r with 0/0 = 0 and x/0 = 1 (helpers.Share)."""
-    zero = denom == 0
-    ratio = np.where(zero, np.where(alloc == 0, 0.0, 1.0),
-                     alloc / np.where(zero, 1.0, denom))
-    return float(ratio.max()) if ratio.size else 0.0
+def contention_static_args(conf, probe) -> dict:
+    """The storm solves' static jit arguments, derived from the conf/probe.
 
-
-def _less_equal(a: np.ndarray, b: np.ndarray, eps: np.ndarray) -> bool:
-    """ε-tolerant a <= b over all dims (resource.py less_equal / the
-    kernels.less_equal twin)."""
-    return bool(((a < b) | (np.abs(a - b) < eps)).all())
+    Shared by FastContention (which drives the kernels) and
+    Scheduler.prewarm (which compiles them ahead of the first contended
+    cycle) so the two can never warm different variants."""
+    veto_p, veto_r = probe.victim_vetoes()
+    return dict(
+        kw_preempt=dict(
+            use_gang="gang" in veto_p,
+            use_drf="drf" in veto_p,
+            use_conformance="conformance" in veto_p,
+            order_by_priority=probe.task_order_by_priority,
+        ),
+        kw_reclaim=dict(
+            use_gang="gang" in veto_r,
+            use_prop="proportion" in veto_r,
+            use_conformance="conformance" in veto_r,
+            order_by_priority=probe.task_order_by_priority,
+        ),
+        gang_pipelined=any(
+            opt.name == "gang" and opt.enabled_job_pipelined
+            for tier in conf.tiers for opt in tier.plugins
+        ),
+        has_proportion=probe.enabled.get("proportion", False),
+        job_key_order=tuple(probe.job_key_order),
+    )
 
 
 class FastContention:
     """One cycle's contention driver over the fast snapshot.
 
-    Owns the device VictimConsts/VictimState plus host order-key state
-    (occupied/pipelined counts, drf job allocations, proportion queue
-    allocations) and the committed eviction/pipeline records.  Build it
-    after enqueue; run ``reclaim_pass`` before the allocate solve and
-    ``preempt_pass`` after backfill (conf action order).
+    Owns the device VictimConsts plus the host-resident VictimState and
+    order-key mirrors (occupied/pipelined counts, drf job allocations,
+    proportion queue allocations) and the committed eviction/pipeline
+    records.  Build it after enqueue; run ``reclaim_pass`` before the
+    allocate solve and ``preempt_pass`` after backfill (conf action order).
     """
 
     def __init__(self, fc, snap, aux, deserved: np.ndarray):
@@ -78,12 +99,7 @@ class FastContention:
         self.probe = probe
         n_jobs = aux["n_jobs"]
         self.n_jobs = n_jobs
-        self.deserved = deserved  # [Q, R] numpy
-        self.eps = snap.eps
-        self.total = snap.total
-        self.job_min = snap.job_min_available
         self.job_prio = snap.job_priority
-        self.job_queue = snap.job_queue
 
         # host order-key state (the plugin attrs the object path tracks via
         # event handlers)
@@ -97,26 +113,12 @@ class FastContention:
         self.pipelines: List[Tuple[int, int]] = []  # (task row, node idx)
         self.advanced = False  # advance_post_solve folded the solve in
 
-        veto_p, veto_r = probe.victim_vetoes()
-        self.kw_preempt = dict(
-            use_gang="gang" in veto_p,
-            use_drf="drf" in veto_p,
-            use_prop=False,
-            use_conformance="conformance" in veto_p,
-            order_by_priority=probe.task_order_by_priority,
-        )
-        self.kw_reclaim = dict(
-            use_gang="gang" in veto_r,
-            use_drf=False,
-            use_prop="proportion" in veto_r,
-            use_conformance="conformance" in veto_r,
-            order_by_priority=probe.task_order_by_priority,
-        )
-        self.gang_pipelined = any(
-            opt.name == "gang" and opt.enabled_job_pipelined
-            for tier in fc.conf.tiers for opt in tier.plugins
-        )
-        self.has_proportion = probe.enabled.get("proportion", False)
+        static = contention_static_args(fc.conf, probe)
+        self.kw_preempt = static["kw_preempt"]
+        self.kw_reclaim = static["kw_reclaim"]
+        self.gang_pipelined = static["gang_pipelined"]
+        self.has_proportion = static["has_proportion"]
+        self.job_key_order = static["job_key_order"]
 
         from volcano_tpu.scheduler.victim_kernels import VictimConsts, VictimState
 
@@ -140,19 +142,23 @@ class FastContention:
             w_least=jnp.float32(probe.score_weights()[0]),
             w_balanced=jnp.float32(probe.score_weights()[1]),
         )
-        self.run_live = snap.run_valid.copy()  # host mirror for bookkeeping
-        # one upload for every preemptor's request row: attempt() slices on
-        # device instead of paying a host->device transfer per call
+        # one upload for every preemptor's request row: the storm solves
+        # gather on device instead of paying a transfer per attempt
         self.task_req_dev = jnp.asarray(snap.task_req)
+        self.task_class_dev = jnp.asarray(snap.task_class)
+        # the mutable session state stays HOST-resident between kernel
+        # calls (the kernels upload it; outputs come back in the one
+        # batched fetch) — copies, because fold_into_snapshot mutates the
+        # snap arrays these start from
         self.state = VictimState(
-            run_live=jnp.asarray(snap.run_valid),
-            idle=jnp.asarray(snap.node_idle),
-            releasing=jnp.asarray(snap.node_releasing),
-            used=jnp.asarray(snap.node_used),
-            task_count=jnp.asarray(snap.node_task_count),
-            job_alloc=jnp.asarray(snap.job_alloc_init),
-            job_occupied=jnp.asarray(snap.job_ready_init),
-            queue_alloc=jnp.asarray(snap.queue_alloc_init),
+            run_live=snap.run_valid.copy(),
+            idle=snap.node_idle.copy(),
+            releasing=snap.node_releasing.copy(),
+            used=snap.node_used.copy(),
+            task_count=snap.node_task_count.copy(),
+            job_alloc=snap.job_alloc_init.copy(),
+            job_occupied=snap.job_ready_init.copy(),
+            queue_alloc=snap.queue_alloc_init.copy(),
         )
 
     # -- consts rebuild after the task re-pack -------------------------------
@@ -167,6 +173,7 @@ class FastContention:
             class_score=jnp.asarray(snap.class_node_score),
         )
         self.task_req_dev = jnp.asarray(snap.task_req)
+        self.task_class_dev = jnp.asarray(snap.task_class)
 
     def advance_post_solve(self, task_node, task_kind, ready,
                            be_rows, be_nodes) -> None:
@@ -176,7 +183,6 @@ class FastContention:
         _VictimDriver._load).  Allocations consume idle and count ready;
         pipelines consume releasing and count waiting; backfill placements
         count ready and a task slot."""
-        jnp = self.jnp
         snap, aux = self.snap, self.aux
         idle = np.asarray(self.state.idle).copy()
         releasing = np.asarray(self.state.releasing).copy()
@@ -213,279 +219,183 @@ class FastContention:
         idle = np.maximum(idle, 0.0)
         releasing = np.maximum(releasing, 0.0)
         self.state = self.state._replace(
-            idle=jnp.asarray(idle.astype(np.float32)),
-            releasing=jnp.asarray(releasing.astype(np.float32)),
-            used=jnp.asarray(used.astype(np.float32)),
-            task_count=jnp.asarray(tc.astype(np.int32)),
-            job_alloc=jnp.asarray(self.job_alloc.astype(np.float32)),
-            job_occupied=jnp.asarray(self.occ.astype(np.int32)),
-            queue_alloc=jnp.asarray(self.queue_alloc.astype(np.float32)),
+            idle=idle.astype(np.float32),
+            releasing=releasing.astype(np.float32),
+            used=used.astype(np.float32),
+            task_count=tc.astype(np.int32),
+            job_alloc=self.job_alloc.astype(np.float32),
+            job_occupied=self.occ.astype(np.int32),
+            queue_alloc=self.queue_alloc.astype(np.float32),
         )
         self.advanced = True
 
-    # -- order fns (session.job_order_fn / queue_order_fn over arrays) -------
+    # -- shared host plumbing around the storm kernels -----------------------
 
-    def _job_ready(self, j: int) -> bool:
-        return self.occ[j] >= self.job_min[j]
+    def _schedulable(self) -> np.ndarray:
+        J = self.snap.job_queue.shape[0]
+        sched = np.zeros(J, bool)
+        sched[: self.n_jobs] = self.snap.job_schedulable[: self.n_jobs]
+        return sched
 
-    def job_pipelined(self, j: int) -> bool:
-        if not self.gang_pipelined:
-            return True
-        return self.occ[j] + self.pipe[j] >= self.job_min[j]
+    def _pend_per_job(self) -> np.ndarray:
+        J = self.snap.job_queue.shape[0]
+        pend = np.zeros(J, np.int64)
+        src = np.asarray(self.aux["pend_nonbe_per_job"])
+        n = min(J, src.shape[0])
+        pend[:n] = src[:n]
+        return pend
 
-    def _job_share(self, j: int) -> float:
-        return _share(self.job_alloc[j], self.total)
+    def _absorb(self, out_s, pipe) -> None:
+        """Adopt a storm solve's final state as the session state and
+        refresh the host order-key mirrors from it."""
+        from volcano_tpu.scheduler.victim_kernels import VictimState
 
-    def _job_less(self, l: int, r: int) -> bool:
-        for key in self.probe.job_key_order:
-            if key == "priority":
-                lp, rp = self.job_prio[l], self.job_prio[r]
-                if lp != rp:
-                    return bool(lp > rp)
-            elif key == "gang":
-                lr, rr = self._job_ready(l), self._job_ready(r)
-                if lr != rr:
-                    return rr  # not-ready schedules first (gang.py:48-57)
-            elif key == "drf":
-                ls, rs = self._job_share(l), self._job_share(r)
-                if ls != rs:
-                    return ls < rs
-        # creation order == job index (snapshot job order); uid never ties
-        return l < r
+        self.state = VictimState(*[np.asarray(x) for x in out_s])
+        self.pipe = np.asarray(pipe).astype(np.int64)
+        self.occ = np.asarray(self.state.job_occupied).astype(np.int64)
+        self.job_alloc = np.asarray(self.state.job_alloc).astype(np.float64)
+        self.queue_alloc = np.asarray(
+            self.state.queue_alloc
+        ).astype(np.float64)
 
-    def _queue_share(self, q: int) -> float:
-        return _share(self.queue_alloc[q], self.deserved[q])
-
-    def _queue_less(self, l: int, r: int) -> bool:
-        if self.has_proportion:
-            ls, rs = self._queue_share(l), self._queue_share(r)
-            if ls != rs:
-                return ls < rs
-        # queue index order == sorted-uid order (build_fast_snapshot)
-        return l < r
-
-    def overused(self, q: int) -> bool:
-        if not self.has_proportion:
-            return False
-        return _less_equal(self.deserved[q], self.queue_alloc[q], self.eps)
-
-    # -- one preemptor's device solve ----------------------------------------
-
-    def attempt(self, t: int, mode: str):
-        """Returns (ok, clean).  On ok the state advanced and the decision
-        is recorded in the PENDING lists (committed by the caller)."""
-        from volcano_tpu.scheduler.victim_kernels import victim_step
-
-        import jax
-
+    def _append_records(self, evict_att, pipe_node, pipe_att,
+                        reason: str) -> None:
+        """Rebuild the ordered decision lists from the kernel's per-row
+        attempt-sequence records.  Eviction record order: preempt drains
+        the reversed task-order queue (prio asc, rank desc); reclaim
+        evicts in pool (insertion) order — tensor_actions._VictimDriver's
+        exact rule, applied within each ok-attempt group."""
         snap = self.snap
-        jt = int(snap.task_job[t])
-        qt = int(snap.job_queue[jt])
-        kw = self.kw_reclaim if mode == "reclaim" else self.kw_preempt
-        out_state, assigned, nstar, vmask, clean = victim_step(
-            self.consts, self.state, self.task_req_dev[t],
-            int(snap.task_class[t]), jt, qt, mode=mode, **kw,
-        )
-        # ONE device round trip for all control-flow outputs (per-output
-        # np.asarray would pay a tunnel RTT each)
-        assigned, nstar, vmask, clean = jax.device_get(
-            (assigned, nstar, vmask, clean)
-        )
-        if not bool(clean):
-            return False, False
-        if not bool(assigned):
-            return False, True
-        self.state = out_state
-        nstar = int(nstar)
-        vidx = np.nonzero(vmask)[0]
-        # eviction record order: preempt drains the reversed task-order
-        # queue (prio asc, rank desc); reclaim evicts in pool (insertion)
-        # order — tensor_actions._VictimDriver.attempt's exact rule
-        if mode == "reclaim":
-            vlist = sorted(int(i) for i in vidx)
-        elif kw["order_by_priority"]:
-            vlist = sorted(
-                (int(i) for i in vidx),
-                key=lambda i: (snap.run_prio[i], -snap.run_rank[i]),
-            )
-        else:
-            vlist = sorted((int(i) for i in vidx),
-                           key=lambda i: -snap.run_rank[i])
-
-        # host order-key bookkeeping (the object path's event handlers)
-        t_req = snap.task_req[t]
-        if vidx.size:
-            vjobs = snap.run_job[vidx]
-            np.subtract.at(self.job_alloc, vjobs, snap.run_req[vidx])
-            np.subtract.at(self.occ, vjobs, 1)
-            vq = snap.job_queue[vjobs]
-            ok_q = vq >= 0
-            if ok_q.any():
-                np.subtract.at(self.queue_alloc, vq[ok_q],
-                               snap.run_req[vidx[ok_q]])
-            self.run_live[vidx] = False
-        self.job_alloc[jt] += t_req
-        if qt >= 0:
-            self.queue_alloc[qt] += t_req
-        self.pipe[jt] += 1
-
-        reason = "reclaim" if mode == "reclaim" else "preempt"
-        self.evictions.extend((i, reason) for i in vlist)
-        self.pipelines.append((t, nstar))
-        return True, True
-
-    # -- statement (functional checkpoint) -----------------------------------
-
-    def checkpoint(self):
-        return (
-            self.state, self.occ.copy(), self.pipe.copy(),
-            self.job_alloc.copy(), self.queue_alloc.copy(),
-            self.run_live.copy(), len(self.evictions), len(self.pipelines),
-        )
-
-    def restore(self, ckpt) -> None:
-        (self.state, self.occ, self.pipe, self.job_alloc, self.queue_alloc,
-         self.run_live, ne, np_) = ckpt
-        del self.evictions[ne:]
-        del self.pipelines[np_:]
+        ev = np.nonzero(evict_att >= 0)[0]
+        if ev.size:
+            if reason == "reclaim":
+                order = np.lexsort((ev, evict_att[ev]))
+            elif self.kw_preempt["order_by_priority"]:
+                order = np.lexsort(
+                    (-snap.run_rank[ev], snap.run_prio[ev], evict_att[ev])
+                )
+            else:
+                order = np.lexsort((-snap.run_rank[ev], evict_att[ev]))
+            self.evictions.extend((int(i), reason) for i in ev[order])
+        pt = np.nonzero(pipe_att >= 0)[0]
+        if pt.size:
+            for t in pt[np.argsort(pipe_att[pt], kind="stable")]:
+                self.pipelines.append((int(t), int(pipe_node[t])))
 
     # -- the passes ----------------------------------------------------------
 
-    def _sched_jobs(self):
-        """Job indices the contention loops visit, in session iteration
-        order: schedulable PodGroup phase (enqueue's admissions included),
-        queue always known (queue-less jobs were dropped at build)."""
-        snap = self.snap
-        return [
-            j for j in range(self.n_jobs) if snap.job_schedulable[j]
-        ]
-
-    def _pending_rows(self, j: int, placed_mask: Optional[np.ndarray]):
-        """This job's pending express rows in task order; ``placed_mask``
-        (by task row) excludes rows the solve placed (preempt runs on the
-        post-allocate pending set)."""
-        snap = self.snap
-        start, n = int(snap.job_start[j]), int(snap.job_ntasks[j])
-        rows = range(start, start + n)
-        if placed_mask is None:
-            return deque(rows)
-        return deque(r for r in rows if not placed_mask[r])
-
     def reclaim_pass(self) -> bool:
-        """reclaim.go:42-201 / tensor_actions.reclaim: queue-ordered, one
-        job + one task per queue visit, re-push the queue on success.
-        Returns False when the object machinery must take the whole cycle
-        (kernel-inexpressible case encountered); nothing was published."""
-        aux = self.aux
-        pend = aux["pend_nonbe_per_job"]
-        queues_seen: List[int] = []
-        jobs_by_q: Dict[int, PriorityQueue] = {}
-        tasks_by_job: Dict[int, deque] = {}
-        for j in self._sched_jobs():
-            q = int(self.job_queue[j])
-            if q not in jobs_by_q:
-                queues_seen.append(q)
-                jobs_by_q[q] = PriorityQueue(self._job_less)
-            if pend[j] > 0:
-                jobs_by_q[q].push(j)
-                tasks_by_job[j] = self._pending_rows(j, None)
+        """reclaim.go:42-201 / tensor_actions.reclaim as ONE device
+        program: queue-ordered, one job + one task per queue visit,
+        re-arm the queue on success.  Returns False when the object
+        machinery must take the whole cycle (kernel-inexpressible case
+        encountered); nothing was published."""
+        import jax
 
-        qpq = PriorityQueue(self._queue_less)
-        for q in queues_seen:
-            qpq.push(q)
-        while not qpq.empty():
-            q = qpq.pop()
-            if self.overused(q):
-                continue
-            jobs = jobs_by_q.get(q)
-            if jobs is None or jobs.empty():
-                continue
-            j = jobs.pop()
-            tasks = tasks_by_job.get(j)
-            if tasks is None or not tasks:
-                continue
-            t = tasks.popleft()
-            ok, clean = self.attempt(t, "reclaim")
-            if not clean:
-                return False
-            if ok:
-                qpq.push(q)
+        from volcano_tpu.scheduler.victim_kernels import reclaim_solve
+
+        snap = self.snap
+        sched = self._schedulable()
+        job_cand = sched & (self._pend_per_job() > 0)
+        Q = snap.queue_alloc_init.shape[0]
+        queue_live = np.zeros(Q, bool)
+        qs = snap.job_queue[sched]
+        qs = qs[qs >= 0]
+        if qs.size:
+            queue_live[qs] = True
+        if not job_cand.any() or not queue_live.any():
+            return True
+        out_s, pipe, rec, abort = reclaim_solve(
+            self.consts, self.state,
+            self.task_req_dev, self.task_class_dev,
+            snap.job_start.astype(np.int32),
+            self.job_prio.astype(np.int32),
+            job_cand, queue_live, self.pipe.astype(np.int32),
+            use_gang=self.kw_reclaim["use_gang"],
+            use_prop=self.kw_reclaim["use_prop"],
+            use_conformance=self.kw_reclaim["use_conformance"],
+            order_by_priority=self.kw_reclaim["order_by_priority"],
+            has_proportion=self.has_proportion,
+            job_key_order=self.job_key_order,
+        )
+        # ONE device round trip for the whole pass
+        out_s, pipe, ea, pn, pa, abort = jax.device_get(
+            (out_s, pipe, rec.evict_att, rec.pipe_node, rec.pipe_att, abort)
+        )
+        if bool(abort):
+            return False
+        self._absorb(out_s, pipe)
+        self._append_records(ea, pn, pa, "reclaim")
         return True
 
     def preempt_pass(self, placed_mask: np.ndarray) -> bool:
-        """preempt.go:45-273 / tensor_actions.preempt: phase 1 same-queue
-        cross-job preemption under statement semantics, phase 2 within-job.
-        Returns False when the object sub-cycle must take over (nothing
-        recorded by this pass survives — the caller discards)."""
-        aux = self.aux
-        pend = aux["pend_nonbe_per_job"]
-        start_ckpt = self.checkpoint()
-        queues_seen: List[int] = []
-        preemptors: Dict[int, PriorityQueue] = {}
-        tasks_by_job: Dict[int, deque] = {}
-        under_request: List[int] = []
-        for j in self._sched_jobs():
-            q = int(self.job_queue[j])
-            if q not in queues_seen:
-                queues_seen.append(q)
-            if pend[j] > 0:
-                rows = self._pending_rows(j, placed_mask)
-                if not rows:
-                    continue  # everything placed: not a preemptor anymore
-                if q not in preemptors:
-                    preemptors[q] = PriorityQueue(self._job_less)
-                preemptors[q].push(j)
-                under_request.append(j)
-                tasks_by_job[j] = rows
+        """preempt.go:45-273 / tensor_actions.preempt as ONE device
+        program: phase 1 same-queue cross-job preemption under statement
+        semantics, phase 2 within-job.  Returns False when the object
+        sub-cycle must take over (nothing recorded by this pass survives —
+        the kernel aborted before recording)."""
+        import jax
 
-        for q in queues_seen:
-            while True:
-                jobs = preemptors.get(q)
-                if jobs is None or jobs.empty():
-                    break
-                j = jobs.pop()
-                ckpt = self.checkpoint()
-                assigned = False
-                while tasks_by_job[j]:
-                    t = tasks_by_job[j].popleft()
-                    before = len(self.evictions)
-                    ok, clean = self.attempt(t, "queue")
-                    if not clean:
-                        self.restore(start_ckpt)
-                        return False
-                    if ok:
-                        assigned = True
-                        metrics.update_preemption_victims(
-                            len(self.evictions) - before
-                        )
-                        metrics.register_preemption_attempt()
-                    if self.job_pipelined(j):
-                        break  # commit: records stay
-                if not self.job_pipelined(j):
-                    self.restore(ckpt)
-                    continue
-                if assigned:
-                    jobs.push(j)
+        from volcano_tpu.scheduler.victim_kernels import preempt_solve
 
-            # phase 2: within-job preemption over ALL under-request jobs —
-            # INSIDE the queue loop, as the reference has it
-            # (preempt.go:146-168 sits inside `for _, queue := range
-            # queues`), so a later queue's phase 1 sees the task queues
-            # phase 2 already drained
-            for j in under_request:
-                while True:
-                    tasks = tasks_by_job.get(j)
-                    if tasks is None or not tasks:
-                        break
-                    t = tasks.popleft()
-                    ok, clean = self.attempt(t, "job")
-                    if not clean:
-                        self.restore(start_ckpt)
-                        return False
-                    if ok:
-                        metrics.register_preemption_attempt()
-                    else:
-                        break
+        snap = self.snap
+        J = snap.job_queue.shape[0]
+        T = snap.task_req.shape[0]
+        sched = self._schedulable()
+        attempt_rows = snap.task_valid & ~placed_mask
+        if attempt_rows.any():
+            unplaced = np.bincount(
+                snap.task_job[attempt_rows], minlength=J
+            )[:J]
+        else:
+            unplaced = np.zeros(J, np.int64)
+        is_pre = sched & (self._pend_per_job() > 0) & (unplaced > 0)
+        under = np.nonzero(is_pre)[0].astype(np.int32)
+        nu = under.size
+        # queues in first-appearance order over schedulable jobs —
+        # preempt.go iterates the queue set it discovered, not by share
+        jq = snap.job_queue[: self.n_jobs][
+            snap.job_schedulable[: self.n_jobs]
+        ]
+        jq = jq[jq >= 0]
+        _, first = np.unique(jq, return_index=True)
+        qorder = jq[np.sort(first)].astype(np.int32)
+        nq = qorder.size
+        if nu == 0 or nq == 0:
+            return True
+        Q = snap.queue_alloc_init.shape[0]
+        under_pad = np.zeros(J, np.int32)
+        under_pad[:nu] = under
+        qpad = np.zeros(Q, np.int32)
+        qpad[:nq] = qorder
+        out_s, pipe, rec, att_total, last_v, any_p1, abort = preempt_solve(
+            self.consts, self.state,
+            self.task_req_dev, self.task_class_dev, attempt_rows,
+            snap.job_start.astype(np.int32),
+            snap.job_ntasks.astype(np.int32),
+            self.job_prio.astype(np.int32),
+            is_pre, under_pad, np.int32(nu), qpad, np.int32(nq),
+            self.pipe.astype(np.int32),
+            use_gang=self.kw_preempt["use_gang"],
+            use_drf=self.kw_preempt["use_drf"],
+            use_conformance=self.kw_preempt["use_conformance"],
+            order_by_priority=self.kw_preempt["order_by_priority"],
+            job_key_order=self.job_key_order,
+            gang_pipelined=self.gang_pipelined,
+        )
+        (out_s, pipe, ea, pn, pa, att_total, last_v, any_p1,
+         abort) = jax.device_get(
+            (out_s, pipe, rec.evict_att, rec.pipe_node, rec.pipe_att,
+             att_total, last_v, any_p1, abort)
+        )
+        if bool(abort):
+            return False
+        self._absorb(out_s, pipe)
+        if bool(any_p1):
+            metrics.update_preemption_victims(int(last_v))
+        for _ in range(int(att_total)):
+            metrics.register_preemption_attempt()
+        self._append_records(ea, pn, pa, "preempt")
         return True
 
     # -- integration back into the fast snapshot -----------------------------
@@ -527,6 +437,7 @@ def _rebuild_task_arrays(m, fc, snap, aux, new_pe_rows) -> None:
         m, new_pe_rows, aux["pod_j"], n_jobs, N, R, aux["node_rows"],
         aux["n_nodes"], fc.nodeaffinity_weight,
         snap.job_start, snap.job_ntasks,
+        min_T=snap.task_req.shape[0],
     )
     snap.task_req = ta["task_req"]
     snap.task_job = ta["task_job"]

@@ -686,15 +686,19 @@ class _TiersOnly:
 def _task_arrays(m: ArrayMirror, pe_rows: np.ndarray, pod_j: np.ndarray,
                  n_jobs: int, N: int, R: int, node_rows_arr: np.ndarray,
                  n_live_ct: int, nodeaffinity_weight: float,
-                 job_start: np.ndarray, job_ntasks: np.ndarray) -> dict:
+                 job_start: np.ndarray, job_ntasks: np.ndarray,
+                 min_T: int = 1) -> dict:
     """Task/class arrays from sorted pending express rows.  Called at
     snapshot build, and AGAIN by the fast reclaim pass after it pipelines
     preemptors (the kernels walk contiguous job_start..+job_ntasks row
     ranges, so a consumed row forces a re-pack — the object path gets the
     same effect from backend.invalidate() between actions).  ``job_start``
-    and ``job_ntasks`` are written in place."""
+    and ``job_ntasks`` are written in place.  ``min_T`` keeps a re-pack at
+    the cycle's original task bucket so the preempt solve reuses the shape
+    the cycle (and prewarm) already compiled instead of re-bucketing down
+    and JIT-compiling mid-cycle."""
     n_tasks = pe_rows.size
-    T = _bucket(max(n_tasks, 1))
+    T = max(_bucket(max(n_tasks, 1)), min_T)
     task_req = np.zeros((T, R), np.float32)
     task_job = np.zeros((T,), np.int32)
     task_valid = np.zeros((T,), bool)

@@ -142,36 +142,65 @@ class Scheduler:
             import jax
             import jax.numpy as jnp
 
-            from volcano_tpu.scheduler.victim_kernels import victim_step
+            from volcano_tpu.scheduler.fast_victims import (
+                contention_static_args,
+            )
+            from volcano_tpu.scheduler.victim_kernels import (
+                preempt_solve, reclaim_solve, victim_step,
+            )
 
-            veto_p, veto_r = backend.victim_vetoes()
+            # the same static-variant derivation FastContention uses, so
+            # prewarm can never compile a different jit specialization
+            static = contention_static_args(self.conf, backend)
             consts, state = backend.victim_arrays()
             t_req = jnp.asarray(snap.task_req[0])
+            T = snap.task_req.shape[0]
+            J = snap.job_queue.shape[0]
+            Q = snap.queue_alloc_init.shape[0]
+            task_req_d = jnp.asarray(snap.task_req)
+            task_class_d = jnp.asarray(snap.task_class)
+            job_i32 = dict(
+                start=jnp.asarray(snap.job_start.astype("int32")),
+                ntasks=jnp.asarray(snap.job_ntasks.astype("int32")),
+                prio=jnp.asarray(snap.job_priority.astype("int32")),
+            )
+            zJ32 = jnp.zeros((J,), jnp.int32)
+            zJb = jnp.zeros((J,), bool)
             if "preempt" in self.conf.actions:
-                # static flags must mirror _VictimDriver's (tensor_actions):
-                # preempt enables drf vetoes, never proportion
-                kw = dict(
-                    use_gang="gang" in veto_p,
-                    use_drf="drf" in veto_p,
-                    use_prop=False,
-                    use_conformance="conformance" in veto_p,
-                    order_by_priority=backend.task_order_by_priority,
-                )
+                kw = static["kw_preempt"]
                 for mode in ("queue", "job"):
                     out = victim_step(
-                        consts, state, t_req, 0, 0, 0, mode=mode, **kw
+                        consts, state, t_req, 0, 0, 0, mode=mode,
+                        use_prop=False, **kw
                     )
                     jax.block_until_ready(out)
-            if "reclaim" in self.conf.actions:
-                kw = dict(
-                    use_gang="gang" in veto_r,
-                    use_drf=False,
-                    use_prop="proportion" in veto_r,
-                    use_conformance="conformance" in veto_r,
-                    order_by_priority=backend.task_order_by_priority,
+                # the fast cycle's whole-storm solve at the same shapes
+                # (empty work: jit compiles the loop regardless of trips)
+                out = preempt_solve(
+                    consts, state, task_req_d, task_class_d,
+                    jnp.zeros((T,), bool),
+                    job_i32["start"], job_i32["ntasks"], job_i32["prio"],
+                    zJb, zJ32, jnp.int32(0),
+                    jnp.zeros((Q,), jnp.int32), jnp.int32(0), zJ32,
+                    job_key_order=static["job_key_order"],
+                    gang_pipelined=static["gang_pipelined"],
+                    **kw,
                 )
+                jax.block_until_ready(out)
+            if "reclaim" in self.conf.actions:
+                kw = static["kw_reclaim"]
                 out = victim_step(
-                    consts, state, t_req, 0, 0, 0, mode="reclaim", **kw
+                    consts, state, t_req, 0, 0, 0, mode="reclaim",
+                    use_drf=False, **kw
+                )
+                jax.block_until_ready(out)
+                out = reclaim_solve(
+                    consts, state, task_req_d, task_class_d,
+                    job_i32["start"], job_i32["prio"], zJb,
+                    jnp.zeros((Q,), bool), zJ32,
+                    has_proportion=static["has_proportion"],
+                    job_key_order=static["job_key_order"],
+                    **kw,
                 )
                 jax.block_until_ready(out)
         backend.invalidate()

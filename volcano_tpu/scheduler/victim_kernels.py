@@ -1,4 +1,4 @@
-"""JAX victim-selection kernel for preempt/reclaim (SURVEY.md section 2.3
+"""JAX victim-selection kernels for preempt/reclaim (SURVEY.md section 2.3
 item 6): per-node masked sort + prefix-sum cover test as one device program.
 
 The host loop in the reference walks nodes in score order and, per node,
@@ -12,6 +12,26 @@ call computes that whole decision for one preemptor over ALL nodes at once:
   3. node eligibility = request covered + predicate class + pod-count cap,
   4. best node by the nodeorder score (first-max tie-break, same as host),
   5. functional state update (evictions -> releasing, preemptor pipelined).
+
+``reclaim_solve`` and ``preempt_solve`` go one level further: they run the
+ENTIRE reclaim/preempt action loop (the reference's per-queue priority-
+queue walk, statement checkpoint/rollback, two-phase preemption) as one
+device program — a ``lax.while_loop`` whose body selects the next
+(queue, job, task) by the same ordering keys the host loop uses and runs
+the victim core, so a 2,000-preemptor storm costs ONE dispatch + ONE
+host round trip instead of 2,000 (the round-trip-per-preemptor driver was
+round 3's 356 s contended cycle; see fast_victims.py).
+
+Two batching devices make the storm loop cheap:
+
+  * all sort orders are hoisted out of the loop.  The per-preemptor
+    cumsums previously sorted by ``(~candidate, node, key...)``; a masked
+    segment-cumsum over the STATIC ``(node, key...)`` order produces
+    bit-identical prefix sums at candidate rows, because the interspersed
+    zeros of non-candidates do not change partial sums.  No per-step
+    [V]-sized sort remains.
+  * the best-node walk is two lexicographic argmin reductions (covered
+    and valid) instead of a positional sort of all nodes.
 
 Veto fidelity notes:
   * gang: per-candidate check against the call-time occupied count, exactly
@@ -28,9 +48,10 @@ Veto fidelity notes:
     reachable through the session seams.
   * A host node attempt that passes validateVictims but fails the final
     coverage check strands its evictions in the statement and moves on
-    (preempt.go:176-243). This kernel detects that case and reports
-    ``clean=False`` instead of modeling it; the driver replays such tasks
-    through the host path and resyncs device state, keeping exact parity.
+    (preempt.go:176-243). The kernels detect that case and report
+    ``clean=False`` instead of modeling it; the storm solves abort with
+    nothing recorded and the caller replays the cycle through the object
+    path, keeping exact parity.
 """
 
 from __future__ import annotations
@@ -41,7 +62,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from volcano_tpu.scheduler.kernels import NEG_INF, _score_nodes, dominant_share, less_equal
+from volcano_tpu.scheduler.kernels import (
+    NEG_INF,
+    POS_INF,
+    _lex_argmin,
+    _score_nodes,
+    dominant_share,
+    less_equal,
+)
 
 SHARE_DELTA = 1e-6
 
@@ -91,52 +119,96 @@ def _seg_cumsum(values, new_seg):
     return cum - (cum[start] - values[start])
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "mode", "use_gang", "use_drf", "use_prop", "use_conformance",
-        "order_by_priority",
-    ),
-)
-def victim_step(
+def _tree_where(pred, a, b):
+    """Elementwise select over matching pytrees with a scalar predicate."""
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+# --------------------------------------------------------------------------
+# static orderings (consts-only, hoisted out of the storm loops)
+# --------------------------------------------------------------------------
+
+def _orders_drf(c: VictimConsts):
+    """(node, job, pool-index) order + segment-start flags for the DRF
+    hypothetical-transfer cumsum."""
+    V = c.run_req.shape[0]
+    vidx = jnp.arange(V, dtype=jnp.int32)
+    o = jnp.lexsort((vidx, c.run_job, c.run_node))
+    sn, sj = c.run_node[o], c.run_job[o]
+    seg = jnp.concatenate(
+        [jnp.array([True]), (sn[1:] != sn[:-1]) | (sj[1:] != sj[:-1])]
+    )
+    return o, seg
+
+
+def _orders_prop(c: VictimConsts, Q: int):
+    """(node, queue, pool-index) order + segment flags for proportion."""
+    V = c.run_req.shape[0]
+    vidx = jnp.arange(V, dtype=jnp.int32)
+    rq = jnp.clip(c.job_queue[c.run_job], 0, Q - 1)
+    o = jnp.lexsort((vidx, rq, c.run_node))
+    sn, sq = c.run_node[o], rq[o]
+    seg = jnp.concatenate(
+        [jnp.array([True]), (sn[1:] != sn[:-1]) | (sq[1:] != sq[:-1])]
+    )
+    return o, seg
+
+
+def _orders_evict(c: VictimConsts, order_by_priority: bool,
+                  reclaim_mode: bool):
+    """Per-node eviction order: preempt drains a reversed-TaskOrderFn queue
+    = (priority asc, uid desc) (preempt.go victimsQueue); reclaim evicts in
+    candidate list order = node-resident insertion order (reclaim.go:154)."""
+    V = c.run_req.shape[0]
+    vidx = jnp.arange(V, dtype=jnp.int32)
+    if reclaim_mode:
+        o = jnp.lexsort((vidx, c.run_node))
+    else:
+        prio_key = (
+            c.run_prio if order_by_priority else jnp.zeros((V,), jnp.int32)
+        )
+        o = jnp.lexsort((-c.run_rank, prio_key, c.run_node))
+    sn = c.run_node[o]
+    seg = jnp.concatenate([jnp.array([True]), sn[1:] != sn[:-1]])
+    return o, seg
+
+
+# --------------------------------------------------------------------------
+# one preemptor's victim solve (the shared core)
+# --------------------------------------------------------------------------
+
+def _victim_core(
     c: VictimConsts,
     s: VictimState,
     t_req,            # [R] preemptor resreq
     t_cls,            # i32 predicate class
     jt,               # i32 preemptor job index
     qt,               # i32 preemptor queue index
-    mode: str = "queue",          # "queue" | "job" | "reclaim"
-    use_gang: bool = True,
-    use_drf: bool = False,
-    use_prop: bool = False,
-    use_conformance: bool = False,
-    order_by_priority: bool = True,
+    base,             # [V] bool preemptee list (mode filter, precomputed)
+    o_drf=None, seg_drf=None,
+    o_prop=None, seg_prop=None,
+    o_ev=None, seg_ev=None,
+    *,
+    use_gang: bool,
+    use_drf: bool,
+    use_prop: bool,
+    use_conformance: bool,
+    reclaim_mode: bool,
 ):
-    """One preemptor's victim solve over all nodes.
-
-    Returns (new_state, assigned, node_index, victim_mask[V], clean).
+    """Returns (new_state, assigned, node_index, victim_mask[V], clean).
     ``clean=False`` means the host walk would strand evictions on nodes
-    that cannot cover the request; the returned state must be DISCARDED
-    and the caller has to replay this preemptor through the host path.
+    that cannot cover the request; the returned state must be DISCARDED.
+    The ``o_*``/``seg_*`` orders come from the ``_orders_*`` helpers and
+    depend only on consts, so storm callers hoist them out of their loops.
     """
     V = c.run_req.shape[0]
     N = s.idle.shape[0]
     J = c.job_queue.shape[0]
     Q = s.queue_alloc.shape[0]
-    vidx = jnp.arange(V, dtype=jnp.int32)
 
-    # raw queue rows keep the -1 "queue missing" sentinel so residents of a
-    # deleted queue never match a real queue (host compares queue strings);
-    # clipped rows are only for gathers/scatters, guarded by has_q
     rq_raw = c.job_queue[c.run_job]
     has_q = rq_raw >= 0
     run_q = jnp.clip(rq_raw, 0, Q - 1)
-    if mode == "queue":
-        base = s.run_live & (rq_raw == qt) & (c.run_job != jt)
-    elif mode == "job":
-        base = s.run_live & (c.run_job == jt)
-    else:  # reclaim: residents of other queues (including queueless jobs)
-        base = s.run_live & (rq_raw != qt)
 
     # ``base`` is the preemptee list every plugin sees (the action's task
     # filter); each veto intersects into ``cand``, but the drf/proportion
@@ -152,55 +224,47 @@ def victim_step(
 
     if use_drf:
         ls = dominant_share(s.job_alloc[jt] + t_req, c.total)
-        order = jnp.lexsort((vidx, c.run_job, c.run_node, ~base))
-        sreq = jnp.where(base[order, None], c.run_req[order], 0.0)
-        sn, sj = c.run_node[order], c.run_job[order]
-        new_seg = jnp.concatenate(
-            [jnp.array([True]), (sn[1:] != sn[:-1]) | (sj[1:] != sj[:-1])]
-        )
-        relcum = _seg_cumsum(sreq, new_seg)
-        rs = dominant_share(s.job_alloc[sj] - relcum, c.total)
+        sreq = jnp.where(base[o_drf, None], c.run_req[o_drf], 0.0)
+        relcum = _seg_cumsum(sreq, seg_drf)
+        rs = dominant_share(s.job_alloc[c.run_job[o_drf]] - relcum, c.total)
         admit_s = (ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA)
-        cand = cand & jnp.zeros((V,), bool).at[order].set(admit_s)
+        # scatter is only meaningful at base rows; cand is already a subset
+        # of base, so garbage at non-base rows cannot admit anything
+        cand = cand & jnp.zeros((V,), bool).at[o_drf].set(admit_s)
 
     if use_prop:
-        order = jnp.lexsort((vidx, run_q, c.run_node, ~base))
         # queueless rows don't join the hypothetical subtraction either
         # (the host's attr-None continue skips before the sub)
-        sreq = jnp.where((base & has_q)[order, None], c.run_req[order], 0.0)
-        sn, sq = c.run_node[order], run_q[order]
-        new_seg = jnp.concatenate(
-            [jnp.array([True]), (sn[1:] != sn[:-1]) | (sq[1:] != sq[:-1])]
+        sreq = jnp.where(
+            (base & has_q)[o_prop, None], c.run_req[o_prop], 0.0
         )
-        relcum = _seg_cumsum(sreq, new_seg)
+        relcum = _seg_cumsum(sreq, seg_prop)
+        sq = run_q[o_prop]
         alloc_after = s.queue_alloc[sq] - relcum
         # queueless victims have no proportion attr: the host skips them
         # (reclaimableFn's attr-None continue), so they are never admitted
-        admit_s = less_equal(c.queue_deserved[sq], alloc_after, c.eps) & has_q[order]
-        cand = cand & jnp.zeros((V,), bool).at[order].set(admit_s)
+        admit_s = (
+            less_equal(c.queue_deserved[sq], alloc_after, c.eps)
+            & has_q[o_prop]
+        )
+        cand = cand & jnp.zeros((V,), bool).at[o_prop].set(admit_s)
 
-    # eviction order: preempt drains a reversed-TaskOrderFn queue =
-    # (priority asc, uid desc) (preempt.go victimsQueue); reclaim evicts in
-    # candidate list order = node-resident insertion order (reclaim.go:154)
-    if mode == "reclaim":
-        order2 = jnp.lexsort((vidx, c.run_node, ~cand))
-    else:
-        prio_key = c.run_prio if order_by_priority else jnp.zeros((V,), jnp.int32)
-        order2 = jnp.lexsort((-c.run_rank, prio_key, c.run_node, ~cand))
-    s2req = jnp.where(cand[order2, None], c.run_req[order2], 0.0)
-    sn2 = c.run_node[order2]
-    new_seg2 = jnp.concatenate([jnp.array([True]), sn2[1:] != sn2[:-1]])
-    cum2 = _seg_cumsum(s2req, new_seg2)
+    # per-node eviction-order prefix sums: keep evicting while the
+    # exclusive prefix does not yet cover the request
+    s2req = jnp.where(cand[o_ev, None], c.run_req[o_ev], 0.0)
+    sn2 = c.run_node[o_ev]
+    cum2 = _seg_cumsum(s2req, seg_ev)
     cum_excl = cum2 - s2req
-    # keep evicting while the exclusive prefix does not yet cover the request
-    in_prefix_s = cand[order2] & ~less_equal(t_req[None, :], cum_excl, c.eps)
+    in_prefix_s = cand[o_ev] & ~less_equal(t_req[None, :], cum_excl, c.eps)
 
     node_tgt = jnp.where(cand, c.run_node, N)
     node_tot = jax.ops.segment_sum(
         jnp.where(cand[:, None], c.run_req, 0.0), node_tgt, num_segments=N + 1
     )[:N]
     any_adm = (
-        jax.ops.segment_sum(cand.astype(jnp.int32), node_tgt, num_segments=N + 1)[:N]
+        jax.ops.segment_sum(
+            cand.astype(jnp.int32), node_tgt, num_segments=N + 1
+        )[:N]
         > 0
     )
     pred_ok = (
@@ -217,30 +281,33 @@ def victim_step(
     )
     # walk order: preempt visits nodes best-score-first (stable on ties,
     # preempt.go sortNodes); reclaim visits in snapshot order (reclaim.go
-    # iterates ssn.Nodes directly)
+    # iterates ssn.Nodes directly).  The first covered / first valid nodes
+    # of that walk are lexicographic argmins over (walk_key, index) — no
+    # positional sort needed.
     nidx = jnp.arange(N, dtype=jnp.int32)
-    if mode == "reclaim":
+    if reclaim_mode:
         walk_key = nidx.astype(jnp.float32)
     else:
         walk_key = -score
-    pos = jnp.zeros((N,), jnp.int32).at[
-        jnp.lexsort((nidx, walk_key))
-    ].set(nidx)  # pos[n] = walk position of node n
-    first_cov_pos = jnp.min(jnp.where(covered, pos, N))
-    first_valid_pos = jnp.min(jnp.where(valid_node, pos, N))
+    kmin_cov = jnp.min(jnp.where(covered, walk_key, POS_INF))
+    nstar = jnp.argmax(covered & (walk_key == kmin_cov)).astype(jnp.int32)
+    kmin_val = jnp.min(jnp.where(valid_node, walk_key, POS_INF))
+    nstar_val = jnp.argmax(valid_node & (walk_key == kmin_val)).astype(jnp.int32)
     assigned = jnp.any(covered)
-    nstar = jnp.argmax(covered & (pos == first_cov_pos)).astype(jnp.int32)
 
     # clean = the host walk would evict on no node before the chosen one
     # (otherwise it strands partial evictions on earlier valid nodes —
     # preempt.go keeps them in the statement — and the caller must take the
-    # per-task host fallback to reproduce that)
+    # object fallback to reproduce that).  Same node <=> equal keys AND
+    # equal first index among key ties.
     clean = jnp.where(
-        assigned, first_valid_pos == first_cov_pos, ~jnp.any(valid_node)
+        assigned,
+        (kmin_val == kmin_cov) & (nstar_val == nstar),
+        ~jnp.any(valid_node),
     )
 
     victim_s = in_prefix_s & (sn2 == nstar) & assigned
-    vmask = jnp.zeros((V,), bool).at[order2].set(victim_s)
+    vmask = jnp.zeros((V,), bool).at[o_ev].set(victim_s)
 
     # -- state update (evict victims + pipeline preemptor) -------------------
     vreq = jnp.where(vmask[:, None], c.run_req, 0.0)
@@ -270,3 +337,453 @@ def victim_step(
         ),
     )
     return new_state, assigned, nstar, vmask, clean
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mode", "use_gang", "use_drf", "use_prop", "use_conformance",
+        "order_by_priority",
+    ),
+)
+def victim_step(
+    c: VictimConsts,
+    s: VictimState,
+    t_req,            # [R] preemptor resreq
+    t_cls,            # i32 predicate class
+    jt,               # i32 preemptor job index
+    qt,               # i32 preemptor queue index
+    mode: str = "queue",          # "queue" | "job" | "reclaim"
+    use_gang: bool = True,
+    use_drf: bool = False,
+    use_prop: bool = False,
+    use_conformance: bool = False,
+    order_by_priority: bool = True,
+):
+    """One preemptor's victim solve over all nodes (standalone entry used
+    by the object tensor path, the sharded variant, and the native-twin
+    parity tests; the fast cycle's storms use reclaim_solve/preempt_solve).
+
+    Returns (new_state, assigned, node_index, victim_mask[V], clean).
+    ``clean=False`` means the host walk would strand evictions on nodes
+    that cannot cover the request; the returned state must be DISCARDED
+    and the caller has to replay this preemptor through the host path.
+    """
+    Q = s.queue_alloc.shape[0]
+    # raw queue rows keep the -1 "queue missing" sentinel so residents of a
+    # deleted queue never match a real queue (host compares queue strings)
+    rq_raw = c.job_queue[c.run_job]
+    if mode == "queue":
+        base = s.run_live & (rq_raw == qt) & (c.run_job != jt)
+    elif mode == "job":
+        base = s.run_live & (c.run_job == jt)
+    else:  # reclaim: residents of other queues (including queueless jobs)
+        base = s.run_live & (rq_raw != qt)
+
+    o_drf = seg_drf = o_prop = seg_prop = None
+    if use_drf:
+        o_drf, seg_drf = _orders_drf(c)
+    if use_prop:
+        o_prop, seg_prop = _orders_prop(c, Q)
+    o_ev, seg_ev = _orders_evict(c, order_by_priority, mode == "reclaim")
+    return _victim_core(
+        c, s, t_req, t_cls, jt, qt, base,
+        o_drf, seg_drf, o_prop, seg_prop, o_ev, seg_ev,
+        use_gang=use_gang, use_drf=use_drf, use_prop=use_prop,
+        use_conformance=use_conformance, reclaim_mode=(mode == "reclaim"),
+    )
+
+
+# --------------------------------------------------------------------------
+# storm solves: the full reclaim/preempt action loops as device programs
+# --------------------------------------------------------------------------
+
+def _job_order_keys(c, s, job_prio, job_key_order, jidx):
+    """Session job_order_fn as lexicographic keys — identical contributors
+    to kernels.allocate_solve's job selection (priority desc, gang
+    not-ready-first, DRF share asc, creation/index order)."""
+    keys = []
+    for name in job_key_order:
+        if name == "priority":
+            keys.append(-job_prio.astype(jnp.float32))
+        elif name == "gang":
+            keys.append((s.job_occupied >= c.job_min).astype(jnp.float32))
+        elif name == "drf":
+            keys.append(dominant_share(s.job_alloc, c.total[None, :]))
+    keys.append(jidx.astype(jnp.float32))
+    return keys
+
+
+class _StormRecords(NamedTuple):
+    """Decision log of a storm solve, reconstructed host-side into the
+    ordered eviction/pipeline lists after ONE device_get."""
+
+    evict_att: jnp.ndarray  # [V] i32: ok-attempt seq that evicted row, -1
+    pipe_node: jnp.ndarray  # [T] i32: node the task pipelined onto, -1
+    pipe_att: jnp.ndarray   # [T] i32: ok-attempt seq of the pipeline, -1
+    att: jnp.ndarray        # i32 count of ok attempts
+
+
+class _ReclaimCarry(NamedTuple):
+    s: VictimState
+    qlive: jnp.ndarray      # [Q] bool queue still in the priority queue
+    javail: jnp.ndarray     # [J] bool job not yet visited
+    pipe: jnp.ndarray       # [J] i32 pipelined count (JobPipelined input)
+    rec: _StormRecords
+    abort: jnp.ndarray      # bool: kernel-inexpressible case hit
+    iters: jnp.ndarray      # i32 runaway guard
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "use_gang", "use_prop", "use_conformance", "order_by_priority",
+        "has_proportion", "job_key_order",
+    ),
+)
+def reclaim_solve(
+    c: VictimConsts,
+    s0: VictimState,
+    task_req,        # [T, R]
+    task_class,      # [T] i32
+    job_first,       # [J] i32 first pending task row per job (job_start)
+    job_prio,        # [J] i32
+    job_cand0,       # [J] bool schedulable jobs with pending work
+    queue_live0,     # [Q] bool queues of schedulable jobs
+    pipe0,           # [J] i32
+    *,
+    use_gang: bool,
+    use_prop: bool,
+    use_conformance: bool,
+    order_by_priority: bool,
+    has_proportion: bool,
+    job_key_order=("priority", "gang", "drf"),
+):
+    """The whole reclaim action on device (reclaim.go:42-201 /
+    fast_victims.reclaim_pass): pop the queue with the lowest proportion
+    share, pop its best job ONCE, attempt its head task cross-queue, and
+    re-arm the queue only on success.  Returns
+    (final_state, pipe, records, abort) — on abort the caller discards
+    everything and replays through the object machinery.
+    """
+    T = task_req.shape[0]
+    J = c.job_queue.shape[0]
+    Q = s0.queue_alloc.shape[0]
+    jidx = jnp.arange(J, dtype=jnp.int32)
+
+    o_prop = seg_prop = None
+    if use_prop:
+        o_prop, seg_prop = _orders_prop(c, Q)
+    o_ev, seg_ev = _orders_evict(c, order_by_priority, True)
+
+    cap = jnp.int32(2 * (J + Q) + 64)
+
+    def cond(cy: _ReclaimCarry):
+        return ~cy.abort & jnp.any(cy.qlive) & (cy.iters < cap)
+
+    def body(cy: _ReclaimCarry):
+        if has_proportion:
+            q_share = dominant_share(cy.s.queue_alloc, c.queue_deserved)
+        else:
+            q_share = jnp.zeros((Q,), jnp.float32)
+        qkey = jnp.where(cy.qlive, q_share, POS_INF)
+        qmin = jnp.min(qkey)
+        qstar = jnp.argmax(cy.qlive & (q_share == qmin)).astype(jnp.int32)
+        if has_proportion:
+            overused = less_equal(
+                c.queue_deserved[qstar], cy.s.queue_alloc[qstar], c.eps
+            )
+        else:
+            overused = jnp.array(False)
+        jcand = cy.javail & (c.job_queue == qstar)
+        take = jnp.any(jcand) & ~overused
+
+        def drop(cy):
+            return cy._replace(qlive=cy.qlive.at[qstar].set(False))
+
+        def attempt(cy):
+            keys = _job_order_keys(c, cy.s, job_prio, job_key_order, jidx)
+            j, _ = _lex_argmin(jcand, keys, jidx)
+            j = j.astype(jnp.int32)
+            t = jnp.clip(job_first[j], 0, T - 1)
+            qt = c.job_queue[j]
+            base = cy.s.run_live & (c.job_queue[c.run_job] != qt)
+            new_s, assigned, nstar, vmask, clean = _victim_core(
+                c, cy.s, task_req[t], task_class[t], j, qt, base,
+                None, None, o_prop, seg_prop, o_ev, seg_ev,
+                use_gang=use_gang, use_drf=False, use_prop=use_prop,
+                use_conformance=use_conformance, reclaim_mode=True,
+            )
+            ok = assigned & clean
+            rec = cy.rec
+            return cy._replace(
+                s=_tree_where(ok, new_s, cy.s),
+                javail=cy.javail.at[j].set(False),
+                # the queue survives only a successful visit
+                # (host: ``if ok: qpq.push(q)``)
+                qlive=cy.qlive.at[qstar].set(ok),
+                pipe=cy.pipe.at[j].add(jnp.where(ok, 1, 0)),
+                rec=rec._replace(
+                    evict_att=jnp.where(ok & vmask, rec.att, rec.evict_att),
+                    pipe_node=rec.pipe_node.at[t].set(
+                        jnp.where(ok, nstar, rec.pipe_node[t])
+                    ),
+                    pipe_att=rec.pipe_att.at[t].set(
+                        jnp.where(ok, rec.att, rec.pipe_att[t])
+                    ),
+                    att=rec.att + jnp.where(ok, 1, 0),
+                ),
+                abort=cy.abort | ~clean,
+            )
+
+        cy = jax.lax.cond(take, attempt, drop, cy)
+        return cy._replace(iters=cy.iters + 1)
+
+    V = c.run_req.shape[0]
+    init = _ReclaimCarry(
+        s=s0,
+        qlive=queue_live0,
+        javail=job_cand0,
+        pipe=pipe0,
+        rec=_StormRecords(
+            evict_att=jnp.full((V,), -1, jnp.int32),
+            pipe_node=jnp.full((T,), -1, jnp.int32),
+            pipe_att=jnp.full((T,), -1, jnp.int32),
+            att=jnp.int32(0),
+        ),
+        abort=jnp.array(False),
+        iters=jnp.int32(0),
+    )
+    out = jax.lax.while_loop(cond, body, init)
+    abort = out.abort | (out.iters >= cap)
+    return out.s, out.pipe, out.rec, abort
+
+
+class _PreemptCarry(NamedTuple):
+    s: VictimState
+    pipe: jnp.ndarray       # [J] i32
+    rec: _StormRecords
+    # statement checkpoint (taken at phase-1 job pop; restore = Discard)
+    ck_s: VictimState
+    ck_pipe: jnp.ndarray
+    ck_rec: _StormRecords
+    job_avail: jnp.ndarray  # [J] bool phase-1 heap membership
+    cursor: jnp.ndarray     # [J] i32 per-job task-deque position
+    qpos: jnp.ndarray       # i32 index into queues_order
+    phase: jnp.ndarray      # i32: 0 select, 1 drain job, 2 within-job
+    cur_job: jnp.ndarray    # i32
+    assigned: jnp.ndarray   # bool: current pop placed something
+    j2pos: jnp.ndarray      # i32 index into under_request
+    last_v: jnp.ndarray     # i32 victim count of last phase-1 ok attempt
+    any_p1: jnp.ndarray     # bool: any phase-1 ok attempt happened
+    # ok attempts for the metrics counter: unlike rec.att it is NOT part of
+    # the statement checkpoint — the host registers each ok attempt as it
+    # happens and never un-registers on Discard (tensor_actions parity)
+    att_total: jnp.ndarray  # i32
+    abort: jnp.ndarray
+    iters: jnp.ndarray
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "use_gang", "use_drf", "use_conformance", "order_by_priority",
+        "job_key_order", "gang_pipelined",
+    ),
+)
+def preempt_solve(
+    c: VictimConsts,
+    s0: VictimState,
+    task_req,        # [T, R]
+    task_class,      # [T] i32
+    task_attempt,    # [T] bool: valid pending rows the solve left unplaced
+    job_start,       # [J] i32
+    job_ntasks,      # [J] i32
+    job_prio,        # [J] i32
+    job_avail0,      # [J] bool: under-request preemptor jobs
+    under_request,   # [J] i32 preemptor job ids in index order, padded
+    nu,              # i32 count of under_request entries
+    queues_order,    # [Q] i32 queue ids in first-appearance order, padded
+    nq,              # i32 count of queues_order entries
+    pipe0,           # [J] i32
+    *,
+    use_gang: bool,
+    use_drf: bool,
+    use_conformance: bool,
+    order_by_priority: bool,
+    job_key_order=("priority", "gang", "drf"),
+    gang_pipelined: bool = True,
+):
+    """The whole preempt action on device (preempt.go:45-273 /
+    fast_victims.preempt_pass): per queue, phase-1 same-queue cross-job
+    preemption under statement checkpoint/rollback semantics, then phase-2
+    within-job preemption over every under-request job.  Returns
+    (final_state, pipe, records, last_p1_victims, any_p1, abort).
+    """
+    T = task_req.shape[0]
+    J = c.job_queue.shape[0]
+    Q = queues_order.shape[0]
+    jidx = jnp.arange(J, dtype=jnp.int32)
+
+    o_drf = seg_drf = None
+    if use_drf:
+        o_drf, seg_drf = _orders_drf(c)
+    o_ev, seg_ev = _orders_evict(c, order_by_priority, False)
+
+    # every iteration consumes a task row, retires/re-arms a job, or
+    # advances a (phase, queue, under-request) pointer
+    cap = 4 * T + 4 * jnp.int32(J) + nq * (nu + 4) + 64
+
+    def _pipelined(cy, j):
+        if gang_pipelined:
+            return cy.s.job_occupied[j] + cy.pipe[j] >= c.job_min[j]
+        return jnp.array(True)
+
+    def _finish_job(cy):
+        """Host epilogue of one phase-1 pop: Discard when the gang never
+        reached JobPipelined, re-push (keep available) only when it both
+        pipelined and placed something this pop."""
+        j = cy.cur_job
+        pip = _pipelined(cy, j)
+        restore = ~pip
+        return cy._replace(
+            s=_tree_where(restore, cy.ck_s, cy.s),
+            pipe=jnp.where(restore, cy.ck_pipe, cy.pipe),
+            rec=_tree_where(restore, cy.ck_rec, cy.rec),
+            job_avail=cy.job_avail.at[j].set(pip & cy.assigned),
+            phase=jnp.int32(0),
+        )
+
+    def sel(cy):
+        """Phase 0: pop the best preemptor job of the current queue, take
+        the statement checkpoint; empty heap -> phase 2."""
+        q = queues_order[jnp.clip(cy.qpos, 0, Q - 1)]
+        cand = cy.job_avail & (c.job_queue == q)
+        has = jnp.any(cand)
+        keys = _job_order_keys(c, cy.s, job_prio, job_key_order, jidx)
+        j, _ = _lex_argmin(cand, keys, jidx)
+        j = j.astype(jnp.int32)
+        cy2 = cy._replace(
+            cur_job=jnp.where(has, j, cy.cur_job),
+            assigned=jnp.where(has, False, cy.assigned),
+            job_avail=cy.job_avail.at[j].set(
+                jnp.where(has, False, cy.job_avail[j])
+            ),
+            ck_s=_tree_where(has, cy.s, cy.ck_s),
+            ck_pipe=jnp.where(has, cy.pipe, cy.ck_pipe),
+            ck_rec=_tree_where(has, cy.rec, cy.ck_rec),
+            phase=jnp.where(has, jnp.int32(1), jnp.int32(2)),
+            j2pos=jnp.where(has, cy.j2pos, jnp.int32(0)),
+        )
+        return cy2, jnp.array(False), jnp.int32(0), j, jnp.array(True)
+
+    def drain(cy):
+        """Phase 1: consume the current job's next pending row."""
+        j = cy.cur_job
+        exhausted = cy.cursor[j] >= job_ntasks[j]
+        t = jnp.clip(job_start[j] + cy.cursor[j], 0, T - 1)
+        do_att = ~exhausted & task_attempt[t]
+        cy2 = cy._replace(
+            cursor=cy.cursor.at[j].add(jnp.where(exhausted, 0, 1))
+        )
+        cy3 = jax.lax.cond(exhausted, _finish_job, lambda x: x, cy2)
+        return cy3, do_att, t, j, jnp.array(True)
+
+    def p2(cy):
+        """Phase 2: within-job preemption over the under-request list."""
+        done = cy.j2pos >= nu
+        j = under_request[jnp.clip(cy.j2pos, 0, J - 1)]
+        exhausted = cy.cursor[j] >= job_ntasks[j]
+        t = jnp.clip(job_start[j] + cy.cursor[j], 0, T - 1)
+        do_att = ~done & ~exhausted & task_attempt[t]
+        cy2 = cy._replace(
+            qpos=jnp.where(done, cy.qpos + 1, cy.qpos),
+            phase=jnp.where(done, jnp.int32(0), jnp.int32(2)),
+            j2pos=jnp.where(~done & exhausted, cy.j2pos + 1, cy.j2pos),
+            cursor=cy.cursor.at[j].add(jnp.where(~done & ~exhausted, 1, 0)),
+        )
+        return cy2, do_att, t, j, jnp.array(False)
+
+    def attempt(args):
+        cy, t, jt, queue_mode = args
+        qt = c.job_queue[jt]
+        rq_raw = c.job_queue[c.run_job]
+        base = jnp.where(
+            queue_mode,
+            cy.s.run_live & (rq_raw == qt) & (c.run_job != jt),
+            cy.s.run_live & (c.run_job == jt),
+        )
+        new_s, assigned_t, nstar, vmask, clean = _victim_core(
+            c, cy.s, task_req[t], task_class[t], jt, qt, base,
+            o_drf, seg_drf, None, None, o_ev, seg_ev,
+            use_gang=use_gang, use_drf=use_drf, use_prop=False,
+            use_conformance=use_conformance, reclaim_mode=False,
+        )
+        ok = assigned_t & clean
+        nv = jnp.sum(vmask).astype(jnp.int32)
+        rec = cy.rec
+        cy2 = cy._replace(
+            abort=cy.abort | ~clean,
+            s=_tree_where(ok, new_s, cy.s),
+            pipe=cy.pipe.at[jt].add(jnp.where(ok, 1, 0)),
+            rec=rec._replace(
+                evict_att=jnp.where(ok & vmask, rec.att, rec.evict_att),
+                pipe_node=rec.pipe_node.at[t].set(
+                    jnp.where(ok, nstar, rec.pipe_node[t])
+                ),
+                pipe_att=rec.pipe_att.at[t].set(
+                    jnp.where(ok, rec.att, rec.pipe_att[t])
+                ),
+                att=rec.att + jnp.where(ok, 1, 0),
+            ),
+            assigned=cy.assigned | (ok & queue_mode),
+            last_v=jnp.where(ok & queue_mode, nv, cy.last_v),
+            any_p1=cy.any_p1 | (ok & queue_mode),
+            att_total=cy.att_total + jnp.where(ok, 1, 0),
+            # phase 2 stops a job's drain at the first failed attempt
+            j2pos=jnp.where(
+                ~queue_mode & clean & ~assigned_t, cy.j2pos + 1, cy.j2pos
+            ),
+        )
+        # phase 1 checks JobPipelined after EVERY attempt, ok or not
+        return jax.lax.cond(
+            queue_mode & ~cy2.abort & _pipelined(cy2, jt),
+            _finish_job, lambda x: x, cy2,
+        )
+
+    def body(cy):
+        cy, do_att, t, jt, qm = jax.lax.switch(
+            cy.phase, [sel, drain, p2], cy
+        )
+        cy = jax.lax.cond(
+            do_att & ~cy.abort, attempt, lambda a: a[0], (cy, t, jt, qm)
+        )
+        return cy._replace(iters=cy.iters + 1)
+
+    def cond(cy):
+        return ~cy.abort & (cy.qpos < nq) & (cy.iters < cap)
+
+    V = c.run_req.shape[0]
+    rec0 = _StormRecords(
+        evict_att=jnp.full((V,), -1, jnp.int32),
+        pipe_node=jnp.full((T,), -1, jnp.int32),
+        pipe_att=jnp.full((T,), -1, jnp.int32),
+        att=jnp.int32(0),
+    )
+    init = _PreemptCarry(
+        s=s0, pipe=pipe0, rec=rec0,
+        ck_s=s0, ck_pipe=pipe0, ck_rec=rec0,
+        job_avail=job_avail0,
+        cursor=jnp.zeros((J,), jnp.int32),
+        qpos=jnp.int32(0), phase=jnp.int32(0), cur_job=jnp.int32(0),
+        assigned=jnp.array(False), j2pos=jnp.int32(0),
+        last_v=jnp.int32(0), any_p1=jnp.array(False),
+        att_total=jnp.int32(0),
+        abort=jnp.array(False), iters=jnp.int32(0),
+    )
+    out = jax.lax.while_loop(cond, body, init)
+    abort = out.abort | (out.qpos < nq)
+    return (
+        out.s, out.pipe, out.rec, out.att_total, out.last_v, out.any_p1,
+        abort,
+    )
