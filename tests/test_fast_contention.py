@@ -119,10 +119,12 @@ def random_contended_store(seed):
     return store
 
 
-def _outcome(store, fast: bool):
+def _outcome(store, fast: bool, solve_mode=None):
     conf = full_conf("tpu")
     if not fast:
         conf.fast_path = "off"
+    if solve_mode is not None:
+        conf.solve_mode = solve_mode
     sched = Scheduler(store, conf=conf)
     sched.run_once()
     pods = {
@@ -185,6 +187,132 @@ def test_two_cycle_convergence():
     hi_nodes = [store.get("Pod", f"default/hi-{t}").node_name
                 for t in range(2)]
     assert all(hi_nodes), hi_nodes
+
+
+def test_batched_rounds_equivalence_on_simple_storm():
+    """solve_mode: batch forces the batched-rounds contention kernel even
+    below the auto threshold.  Like the batched allocate solve, node
+    choice diverges on score ties (the reference randomizes those), so
+    the contract is outcome equivalence, not bit parity: same eviction
+    count from the same victim class, and the gang converges."""
+    store = preempt_store()
+    conf = full_conf("tpu")
+    conf.solve_mode = "batch"
+    sched = Scheduler(store, conf=conf)
+    sched.run_once()
+    assert _fast_used(sched)
+    _, obj = _outcome(preempt_store(), False)
+    evicted = [k for k, _ in sched.cache.evict_log]
+    assert len(evicted) == len(obj["evicts"]) == 2
+    for key in evicted:
+        assert key.startswith("default/low"), key
+        pod = store.get("Pod", key)
+        assert pod.deleting
+        store.delete("Pod", key)
+    sched.run_once()
+    hi_nodes = [store.get("Pod", f"default/hi-{t}").node_name
+                for t in range(2)]
+    assert all(hi_nodes), hi_nodes
+
+
+def test_batched_rounds_storm_above_threshold():
+    """A storm wider than CONTENTION_BATCH_THRESHOLD takes the rounds
+    kernel on the auto path; every gang must be served (enough victims
+    exist), nothing may be over-evicted, and the next cycle must bind the
+    preemptors."""
+    from volcano_tpu.scheduler import fast_victims
+
+    n_nodes, per_node = 12, 8
+    nodes = [build_node(f"n{i:02d}", cpu=str(2 * per_node), memory="64Gi")
+             for i in range(n_nodes)]
+    queues = [build_queue("qa", weight=1), build_queue("default")]
+    podgroups, pods = [], []
+    for i in range(n_nodes * per_node):
+        pg = build_podgroup(f"low{i:03d}", min_member=1, queue="qa")
+        pg.priority_class_name = "low"
+        podgroups.append(pg)
+        p = build_pod(f"low{i:03d}-0", group=f"low{i:03d}", cpu="2",
+                      memory="2Gi", priority=1)
+        p.node_name = f"n{i % n_nodes:02d}"
+        p.phase = PodPhase.RUNNING
+        pods.append(p)
+    # 24 urgent gangs x 3 tasks = 72 preemptors > threshold (64); each
+    # task displaces exactly one resident
+    n_gangs, gang_size = 24, 3
+    for g in range(n_gangs):
+        pg = build_podgroup(f"hot{g:02d}", min_member=gang_size, queue="qa")
+        pg.priority_class_name = "urgent"
+        podgroups.append(pg)
+        for t in range(gang_size):
+            pods.append(build_pod(f"hot{g:02d}-{t}", group=f"hot{g:02d}",
+                                  cpu="2", memory="2Gi", priority=10))
+    store = make_store(nodes=nodes, queues=queues, podgroups=podgroups,
+                       pods=pods)
+    _prio_classes(store)
+
+    assert n_gangs * gang_size > fast_victims.CONTENTION_BATCH_THRESHOLD
+    conf = full_conf("tpu")
+    sched = Scheduler(store, conf=conf)
+    sched.run_once()
+    assert _fast_used(sched)
+    evicted = [k for k, _ in sched.cache.evict_log]
+    assert len(evicted) == n_gangs * gang_size, len(evicted)
+    for key in evicted:
+        pod = store.get("Pod", key)
+        assert pod.deleting
+        store.delete("Pod", key)
+    sched.run_once()
+    for g in range(n_gangs):
+        for t in range(gang_size):
+            p = store.get("Pod", f"default/hot{g:02d}-{t}")
+            assert p.node_name, f"hot{g:02d}-{t} unbound"
+
+
+def test_batched_rounds_never_evicts_cross_queue():
+    """Phase-1 preemption is strictly same-queue; the rounds kernel's
+    capacity curves are per-(node, queue), so a qa storm must never be
+    funded by qb residents — even when qb's pods sort earlier in the
+    node's eviction order (lower priority)."""
+    nodes = [build_node(f"n{i}", cpu="4", memory="8Gi") for i in range(4)]
+    queues = [build_queue("qa", weight=1), build_queue("qb", weight=1),
+              build_queue("default")]
+    podgroups, pods = [], []
+    for i in range(4):
+        pg = build_podgroup(f"a{i}", min_member=1, queue="qa")
+        pg.priority_class_name = "low"
+        podgroups.append(pg)
+        p = build_pod(f"a{i}-0", group=f"a{i}", cpu="2", memory="2Gi",
+                      priority=1)
+        p.node_name = f"n{i}"
+        p.phase = PodPhase.RUNNING
+        pods.append(p)
+        # qb resident on the same node, LOWER priority: first in the
+        # node's pooled eviction order, must still be untouchable
+        pg = build_podgroup(f"b{i}", min_member=1, queue="qb")
+        podgroups.append(pg)
+        p = build_pod(f"b{i}-0", group=f"b{i}", cpu="2", memory="2Gi",
+                      priority=0)
+        p.node_name = f"n{i}"
+        p.phase = PodPhase.RUNNING
+        pods.append(p)
+    pg = build_podgroup("hi", min_member=2, queue="qa")
+    pg.priority_class_name = "urgent"
+    podgroups.append(pg)
+    for t in range(2):
+        pods.append(build_pod(f"hi-{t}", group="hi", cpu="2", memory="2Gi",
+                              priority=10))
+    store = make_store(nodes=nodes, queues=queues, podgroups=podgroups,
+                       pods=pods)
+    _prio_classes(store)
+    conf = full_conf("tpu")
+    conf.solve_mode = "batch"
+    sched = Scheduler(store, conf=conf)
+    sched.run_once()
+    preempted = [k for k, r in sched.cache.evict_log if r == "preempt"]
+    assert preempted, "storm must preempt"
+    # cross-queue eviction is reclaim's (proportion-gated) domain only;
+    # the preempt action must never touch qb residents
+    assert all("/a" in k for k in preempted), preempted
 
 
 def test_best_effort_preemptor_falls_back_to_object_machinery():

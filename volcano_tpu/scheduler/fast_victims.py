@@ -20,6 +20,15 @@ pass now runs as one device program:
   * the kernels record each decision as (victim -> ok-attempt seq,
     preemptor task -> node + seq) arrays; the host reconstructs the ordered
     eviction/pipeline lists from one fetch;
+  * storms wider than ``CONTENTION_BATCH_THRESHOLD`` preemptor tasks run
+    ``victim_kernels.preempt_rounds`` first — the contention analogue of
+    the batched allocate solve: rounds of parallel placement against
+    per-node evictable-capacity curves, ~3 orders of magnitude cheaper
+    than per-attempt exact solves at bench scale (the exact loop's
+    O(pool) passes per attempt cost ~10 ms each at a 131k pool).  The
+    exact loop mops up whatever the rounds could not serve, and remains
+    the parity oracle below the threshold (and always under
+    ``solveMode: exact``);
   * anything the kernel cannot express — a host walk that would strand
     evictions on non-covering nodes (``clean=False``, see
     victim_kernels.py), a best-effort (empty-request) preemptor — aborts
@@ -47,6 +56,12 @@ from typing import List, Tuple
 import numpy as np
 
 from volcano_tpu.scheduler import metrics
+
+# storms above this many preemptor tasks take the batched-rounds kernel
+# first (solve_mode "auto"; "batch" always does, "exact" never) — the
+# exact storm loop costs several O(pool) passes per preemptor, which at
+# bench scale is ~10 ms per attempt
+CONTENTION_BATCH_THRESHOLD = 64
 
 
 def contention_static_args(conf, probe) -> dict:
@@ -349,7 +364,8 @@ class FastContention:
             )[:J]
         else:
             unplaced = np.zeros(J, np.int64)
-        is_pre = sched & (self._pend_per_job() > 0) & (unplaced > 0)
+        pend_ok = sched & (self._pend_per_job() > 0)
+        is_pre = pend_ok & (unplaced > 0)
         under = np.nonzero(is_pre)[0].astype(np.int32)
         nu = under.size
         # queues in first-appearance order over schedulable jobs —
@@ -368,6 +384,41 @@ class FastContention:
         under_pad[:nu] = under
         qpad = np.zeros(Q, np.int32)
         qpad[:nq] = qorder
+
+        # large storms: the batched-rounds kernel serves the bulk, the
+        # exact loop mops up stragglers (or everything, below threshold)
+        mode = self.fc.conf.solve_mode
+        n_storm = int(unplaced[is_pre].sum())
+        if mode == "batch" or (
+            mode == "auto" and n_storm > CONTENTION_BATCH_THRESHOLD
+        ):
+            # rounds-eligible jobs only: a queueless job's commit would
+            # credit queue 0 (the exact kernels guard qt < 0), and a gang
+            # whose remaining min-need exceeds one round's proposal window
+            # can never satisfy the all-or-nothing commit — both classes
+            # go straight to the exact loop instead of burning rounds
+            from volcano_tpu.scheduler.victim_kernels import (
+                ROUNDS_P_CHUNK,
+            )
+
+            need = np.maximum(
+                snap.job_min_available.astype(np.int64)
+                - self.occ - self.pipe, 0,
+            )
+            eligible = (
+                is_pre & (snap.job_queue >= 0) & (need <= ROUNDS_P_CHUNK)
+            )
+            if eligible.any():
+                attempt_rows = self._rounds_stage(attempt_rows, eligible)
+            left = attempt_rows & is_pre[snap.task_job] & snap.task_valid
+            if not left.any():
+                return True
+            counts_left = np.bincount(
+                snap.task_job[left], minlength=J
+            )[:J]
+            is_pre = pend_ok & (counts_left > 0)
+            if not is_pre.any():
+                return True
         out_s, pipe, rec, att_total, last_v, any_p1, abort = preempt_solve(
             self.consts, self.state,
             self.task_req_dev, self.task_class_dev, attempt_rows,
@@ -397,6 +448,59 @@ class FastContention:
             metrics.register_preemption_attempt()
         self._append_records(ea, pn, pa, "preempt")
         return True
+
+    def _rounds_stage(self, attempt_rows: np.ndarray,
+                      is_pre: np.ndarray) -> np.ndarray:
+        """Run the batched-rounds kernel over the storm and absorb what it
+        committed; returns the surviving attemptable-row mask for the
+        exact tail.  Never aborts — rounds are capacity-safe by
+        construction, and anything they could not serve is simply left
+        for the exact loop."""
+        import jax
+
+        from volcano_tpu.scheduler.victim_kernels import preempt_rounds
+
+        snap = self.snap
+        J = snap.job_queue.shape[0]
+        T = snap.task_req.shape[0]
+        rows = np.nonzero(attempt_rows & is_pre[snap.task_job])[0]
+        counts = np.bincount(
+            snap.task_job[rows], minlength=J
+        )[:J].astype(np.int32)
+        pstart = np.zeros(J, np.int32)
+        if J > 1:
+            pstart[1:] = np.cumsum(counts[:-1]).astype(np.int32)
+        rows_packed = np.zeros(T, np.int32)
+        rows_packed[: rows.size] = rows
+        out_s, pipe, rec, att_total, last_v, any_commit, _, _ = (
+            preempt_rounds(
+                self.consts, self.state,
+                self.task_req_dev, self.task_class_dev,
+                rows_packed, pstart, counts,
+                self.job_prio.astype(np.int32),
+                is_pre, self.pipe.astype(np.int32),
+                use_gang=self.kw_preempt["use_gang"],
+                use_drf=self.kw_preempt["use_drf"],
+                use_conformance=self.kw_preempt["use_conformance"],
+                order_by_priority=self.kw_preempt["order_by_priority"],
+                job_key_order=self.job_key_order,
+                gang_pipelined=self.gang_pipelined,
+            )
+        )
+        (out_s, pipe, ea, pn, pa, att_total, last_v,
+         any_commit) = jax.device_get(
+            (out_s, pipe, rec.evict_att, rec.pipe_node, rec.pipe_att,
+             att_total, last_v, any_commit)
+        )
+        if int(att_total) == 0:
+            return attempt_rows
+        self._absorb(out_s, pipe)
+        if bool(any_commit):
+            metrics.update_preemption_victims(int(last_v))
+        for _ in range(int(att_total)):
+            metrics.register_preemption_attempt()
+        self._append_records(ea, pn, pa, "preempt")
+        return attempt_rows & ~(pa >= 0)
 
     # -- integration back into the fast snapshot -----------------------------
 

@@ -146,7 +146,7 @@ class Scheduler:
                 contention_static_args,
             )
             from volcano_tpu.scheduler.victim_kernels import (
-                preempt_solve, reclaim_solve, victim_step,
+                preempt_rounds, preempt_solve, reclaim_solve, victim_step,
             )
 
             # the same static-variant derivation FastContention uses, so
@@ -187,6 +187,18 @@ class Scheduler:
                     **kw,
                 )
                 jax.block_until_ready(out)
+                if self.conf.solve_mode != "exact":
+                    # solveMode exact can never dispatch the rounds kernel
+                    # (fast_victims gates on batch/auto) — don't compile it
+                    out = preempt_rounds(
+                        consts, state, task_req_d, task_class_d,
+                        jnp.zeros((T,), jnp.int32), zJ32, zJ32,
+                        job_i32["prio"], zJb, zJ32,
+                        job_key_order=static["job_key_order"],
+                        gang_pipelined=static["gang_pipelined"],
+                        **kw,
+                    )
+                    jax.block_until_ready(out)
             if "reclaim" in self.conf.actions:
                 kw = static["kw_reclaim"]
                 out = victim_step(

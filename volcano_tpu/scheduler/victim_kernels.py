@@ -72,6 +72,10 @@ from volcano_tpu.scheduler.kernels import (
 )
 
 SHARE_DELTA = 1e-6
+# one round's per-job proposal window in preempt_rounds; a gang whose
+# remaining min-need exceeds it cannot satisfy the all-or-nothing commit
+# and must take the exact loop (fast_victims gates on this)
+ROUNDS_P_CHUNK = 32
 
 
 class VictimConsts(NamedTuple):
@@ -786,4 +790,490 @@ def preempt_solve(
     return (
         out.s, out.pipe, out.rec, out.att_total, out.last_v, out.any_p1,
         abort,
+    )
+
+
+# --------------------------------------------------------------------------
+# batched-rounds preempt: throughput mode for large storms
+# --------------------------------------------------------------------------
+
+class _RoundsCarry(NamedTuple):
+    s: VictimState          # run_live is maintained in ev layout (live_ev)
+    live_ev: jnp.ndarray    # [V] bool, evict-order layout
+    cursor: jnp.ndarray     # [J] i32 position into the job's packed rows
+    pipe: jnp.ndarray       # [J] i32
+    dropped: jnp.ndarray    # [J] bool
+    rec: _StormRecords
+    att_total: jnp.ndarray  # i32 committed tasks (metrics counter)
+    last_v: jnp.ndarray     # i32 victims of the last progressing round
+    any_commit: jnp.ndarray  # bool
+    round_: jnp.ndarray     # i32
+    progressed: jnp.ndarray  # bool
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "use_gang", "use_drf", "use_conformance", "order_by_priority",
+        "job_key_order", "gang_pipelined", "m_chunk", "p_chunk", "k_chunk",
+    ),
+)
+def preempt_rounds(
+    c: VictimConsts,
+    s0: VictimState,
+    task_req,        # [T, R]
+    task_class,      # [T] i32
+    rows_packed,     # [T] i32 attemptable task rows, contiguous per job
+    job_pstart,      # [J] i32 offset into rows_packed
+    job_pcount,      # [J] i32 attemptable row count per job
+    job_prio,        # [J] i32
+    job_avail0,      # [J] bool preemptor jobs
+    pipe0,           # [J] i32
+    *,
+    use_gang: bool,
+    use_drf: bool,
+    use_conformance: bool,
+    order_by_priority: bool,
+    job_key_order=("priority", "gang", "drf"),
+    gang_pipelined: bool = True,
+    m_chunk: int = 128,
+    p_chunk: int = ROUNDS_P_CHUNK,
+    k_chunk: int = 8,
+):
+    """Throughput-mode preemption: rounds of parallel victim-capacity
+    placement, the contention analogue of ``kernels.allocate_solve_batch``.
+
+    The exact storm loop (``preempt_solve``) pays several O(V)
+    gather/scatter passes PER PREEMPTOR — ~10 ms per attempt at a 131k
+    victim pool on TPU, which is the whole budget for a 2,000-task storm.
+    This kernel amortizes those passes over a round:
+
+      1. per-round candidate analysis over the pool ONCE — conformance
+         (static), gang budgets (a job's first ``occupied - min_available``
+         rows in global evict order; the sequential path decrements the
+         count attempt by attempt), and the DRF hypothetical-transfer test
+         at the round's most-restrictive preemptor share per queue
+         (conservative: admits no victim the weakest preemptor could not
+         take);
+      2. per-node evictable-capacity curves (evict-order prefix sums of
+         admitted requests);
+      3. a round of parallel proposals: the top-``m_chunk`` preemptor jobs
+         (ranked by the session job-order keys) spread their next
+         ``p_chunk`` tasks over their ``k_chunk`` best-scoring feasible
+         nodes; conflicts resolve by (node, rank) prefix sums against the
+         capacity curve, pod-count caps included;
+      4. gang all-or-nothing: a job not yet JobPipelined must win at least
+         ``min_available - occupied - pipelined`` tasks in one round or its
+         wins are cancelled (the sequential path drains a popped gang to
+         pipelined-or-Discard in one statement, so the unit matches);
+      5. committed capacity materializes into victims at round end — the
+         admitted evict-order prefix of each consumed node — and the next
+         round's analysis sees the updated pool/occupancies/shares.
+
+    A round with zero wins drops every selected job (state is unchanged,
+    so they cannot win later); the caller retries leftovers through the
+    exact loop.  Divergences vs the sequential path (documented, bench
+    scale only): scores and shares freeze within a round, the DRF veto
+    uses the per-queue worst-case share, victims attribute to rounds
+    rather than single attempts, running rows of preemptor jobs are never
+    victims (the host only excludes them for their own job), and queues
+    interleave within a round instead of completing in discovery order.
+    Capacity is never oversubscribed: every grant is prefix-checked
+    against the admitted victim totals of its node, and evictions cover
+    grants exactly as the per-attempt rule does (minimal evict-order
+    prefix whose total covers the consumed capacity).
+
+    Returns (final_state, pipe, records, att_total, last_v, any_commit,
+    cursor, dropped).
+    """
+    V = c.run_req.shape[0]
+    N = s0.idle.shape[0]
+    T = task_req.shape[0]
+    J = c.job_queue.shape[0]
+    Q = s0.queue_alloc.shape[0]
+    R = c.run_req.shape[1]
+    M = min(m_chunk, J)
+    P = p_chunk
+    K = min(k_chunk, N)
+    jidx = jnp.arange(J, dtype=jnp.int32)
+    vidx = jnp.arange(V, dtype=jnp.int32)
+
+    # ---- hoisted static layouts -----------------------------------------
+    # eviction order grouped per (node, QUEUE): phase-1 preemption is
+    # strictly same-queue (preempt_solve base: rq == qt), so capacity
+    # curves and victim prefixes must never fund a preemptor with another
+    # queue's residents.  Within a (node, queue) group the order is the
+    # host rule (priority asc, rank desc); a queue's rows of one node are
+    # a contiguous segment, so one cumsum yields per-(node, queue) curves.
+    rq_pool_raw = c.job_queue[c.run_job]
+    rq_pool = jnp.clip(rq_pool_raw, 0, Q - 1)
+    prio_pool = (
+        c.run_prio if order_by_priority else jnp.zeros((V,), jnp.int32)
+    )
+    o_ev = jnp.lexsort((-c.run_rank, prio_pool, rq_pool, c.run_node))
+    inv_ev = jnp.zeros((V,), jnp.int32).at[o_ev].set(vidx)  # pool -> ev pos
+    sn2 = c.run_node[o_ev]
+    req_ev = c.run_req[o_ev]
+    job_ev = c.run_job[o_ev]
+    rq_ev_raw = c.job_queue[job_ev]
+    has_q_ev = rq_ev_raw >= 0
+    rq_ev = jnp.clip(rq_ev_raw, 0, Q - 1)
+    flat_ev = sn2 * Q + rq_ev  # (node, queue) cell of each row
+    seg_ev = jnp.concatenate(
+        [jnp.array([True]), flat_ev[1:] != flat_ev[:-1]]
+    )
+    evictable_ev = c.run_evictable[o_ev]
+    start_ev = jax.lax.cummax(jnp.where(seg_ev, jnp.arange(V), 0))
+    # last row of each (node, queue) segment (for the curve totals)
+    last_ev = jnp.concatenate([seg_ev[1:], jnp.array([True])])
+    # within-job rank in global evict order, for gang eviction budgets
+    o_jb = jnp.lexsort((inv_ev[vidx], c.run_job))  # pool rows by (job, ev)
+    jb_seg = jnp.concatenate(
+        [jnp.array([True]), c.run_job[o_jb][1:] != c.run_job[o_jb][:-1]]
+    )
+    jb_start = jax.lax.cummax(jnp.where(jb_seg, jnp.arange(V), 0))
+    cnt_in_job_pool = jnp.zeros((V,), jnp.int32).at[o_jb].set(
+        (jnp.arange(V) - jb_start).astype(jnp.int32)
+    )
+    cnt_in_job_ev = cnt_in_job_pool[o_ev]
+    row_is_pre_ev = job_avail0[job_ev]
+
+    if use_drf:
+        o_drf, seg_drf = _orders_drf(c)
+        # static perms between the ev and drf layouts
+        ev_pos_drf = inv_ev[o_drf]            # drf pos -> ev pos
+        inv_drf = jnp.zeros((V,), jnp.int32).at[o_drf].set(vidx)
+        drf_pos_ev = inv_drf[o_ev]            # ev pos -> drf pos
+        req_drf = c.run_req[o_drf]
+        job_drf = c.run_job[o_drf]
+        rq_drf_raw = c.job_queue[job_drf]
+        has_q_drf = rq_drf_raw >= 0
+        rq_drf = jnp.clip(rq_drf_raw, 0, Q - 1)
+        start_drf = jax.lax.cummax(jnp.where(seg_drf, jnp.arange(V), 0))
+
+    def _cumsum_seg(values, start):
+        cum = jnp.cumsum(values, axis=0)
+        return cum - (cum[start] - values[start])
+
+    def body(cy: _RoundsCarry):
+        s = cy.s
+        active = (
+            job_avail0 & ~cy.dropped & (cy.cursor < job_pcount)
+        )
+        act_q = (
+            jax.ops.segment_sum(
+                (active & (c.job_queue >= 0)).astype(jnp.int32),
+                jnp.clip(c.job_queue, 0, Q - 1), num_segments=Q,
+            )
+            > 0
+        )
+
+        # ---- candidate analysis (once per round over the pool) ----------
+        cand_ev = cy.live_ev & act_q[rq_ev] & has_q_ev & ~row_is_pre_ev
+        if use_conformance:
+            cand_ev = cand_ev & evictable_ev
+        if use_gang:
+            budget = jnp.where(
+                c.job_min > 1,
+                s.job_occupied - c.job_min,
+                jnp.int32(2**31 - 1),
+            )
+            cand_ev = cand_ev & (cnt_in_job_ev < budget[job_ev])
+
+        head_t = rows_packed[
+            jnp.clip(job_pstart + cy.cursor, 0, T - 1)
+        ]                                              # [J]
+        head_req_all = task_req[jnp.clip(head_t, 0, T - 1)]  # [J, R]
+
+        if use_drf:
+            # worst-case (largest) preemptor share per queue this round —
+            # conservative: admits only victims every active preemptor of
+            # the queue could take
+            ls_j = dominant_share(s.job_alloc + head_req_all, c.total)
+            ls_q = jax.ops.segment_max(
+                jnp.where(active, ls_j, -jnp.inf),
+                jnp.clip(c.job_queue, 0, Q - 1), num_segments=Q,
+            )
+            live_drf = cy.live_ev[ev_pos_drf]  # live in drf order
+            base_drf = live_drf & act_q[rq_drf] & has_q_drf
+            sreq = jnp.where(base_drf[:, None], req_drf, 0.0)
+            relcum = _cumsum_seg(sreq, start_drf)
+            rs = dominant_share(s.job_alloc[job_drf] - relcum, c.total)
+            admit_drf = (ls_q[rq_drf] < rs + SHARE_DELTA) & has_q_drf
+            cand_ev = cand_ev & admit_drf[drf_pos_ev]
+
+        # ---- per-(node, queue) evictable-capacity curves ----------------
+        vr = jnp.where(cand_ev[:, None], req_ev, 0.0)
+        cum = _cumsum_seg(vr, start_ev)
+        cap_flat = (
+            jnp.zeros((N * Q + 1, R), jnp.float32)
+            .at[jnp.where(last_ev, flat_ev, N * Q)].set(cum)
+        )[: N * Q]
+
+        # ---- job ranking + proposals (allocate_solve_batch pattern) -----
+        keys = [jidx.astype(jnp.float32)]
+        for name in reversed(job_key_order):
+            if name == "priority":
+                keys.append(-job_prio.astype(jnp.float32))
+            elif name == "gang":
+                keys.append((s.job_occupied >= c.job_min).astype(jnp.float32))
+            elif name == "drf":
+                keys.append(dominant_share(s.job_alloc, c.total[None, :]))
+        keys.append(~active)
+        order = jnp.lexsort(tuple(keys))
+        sel = order[:M]
+        sel_active = active[sel]
+
+        head_req = head_req_all[sel]                   # [M, R]
+        head_cls = task_class[jnp.clip(head_t[sel], 0, T - 1)]
+        # each job sees only its OWN queue's capacity column
+        q_sel = jnp.clip(c.job_queue[sel], 0, Q - 1)   # [M]
+        cap_mnr = cap_flat.reshape(N, Q, R)[:, q_sel, :].transpose(1, 0, 2)
+        covered = jnp.all(
+            head_req[:, None, :] < cap_mnr + c.eps, axis=-1
+        )
+        pred = (
+            c.class_mask[head_cls]
+            & (s.task_count < c.node_max_tasks)[None, :]
+            & c.node_valid[None, :]
+        )
+        feasible = covered & pred & sel_active[:, None]
+        job_ok = jnp.any(feasible, axis=1)
+
+        score = _score_nodes(
+            head_req, s.used, c.node_alloc, c.class_score[head_cls],
+            c.w_least, c.w_balanced,
+        )
+        jh = (sel.astype(jnp.uint32) * jnp.uint32(2654435761))[:, None]
+        nh = (jnp.arange(N, dtype=jnp.uint32) * jnp.uint32(40503))[None, :]
+        h = (jh ^ nh) * jnp.uint32(2246822519)
+        h = h ^ (h >> 15)
+        jitter = (h & jnp.uint32(0xFFFF)).astype(jnp.float32) * (1e-4 / 65535.0)
+        masked = jnp.where(feasible, score + jitter, NEG_INF)
+        _, topk_nodes = jax.lax.top_k(masked, K)
+        topk_nodes = topk_nodes.astype(jnp.int32)
+        rot = (
+            jnp.arange(K, dtype=jnp.int32)[None, :]
+            + (jnp.arange(M, dtype=jnp.int32) % K)[:, None]
+        ) % K
+        topk_nodes = jnp.take_along_axis(topk_nodes, rot, axis=1)
+        topk_ok = jnp.take_along_axis(feasible, topk_nodes, axis=1)
+        cap_k = cap_mnr[jnp.arange(M)[:, None], topk_nodes]  # [M, K, R]
+        req_safe = jnp.maximum(head_req, 1e-30)[:, None, :]
+        cnt = jnp.floor((cap_k + c.eps) / req_safe)
+        cnt = jnp.where(head_req[:, None, :] > 0, cnt, jnp.inf).min(axis=-1)
+        cnt = jnp.where(topk_ok, jnp.maximum(cnt, 0.0), 0.0)
+        cum_cnt = jnp.cumsum(cnt, axis=1)
+        offs = jnp.arange(P, dtype=jnp.int32)
+        slot = jnp.sum(offs[None, :, None] >= cum_cnt[:, None, :], axis=-1)
+        in_range = slot < K
+        slot_c = jnp.clip(slot, 0, K - 1)
+        prop_node_mp = jnp.take_along_axis(topk_nodes, slot_c, axis=1)
+
+        F = M * P
+        pofs = job_pstart[sel][:, None] + cy.cursor[sel][:, None] + offs[None, :]
+        prop_valid = (
+            sel_active[:, None]
+            & job_ok[:, None]
+            & (cy.cursor[sel][:, None] + offs[None, :] < job_pcount[sel][:, None])
+            & in_range
+        )
+        t_prop = rows_packed[jnp.clip(pofs, 0, T - 1)]
+        fr = lambda x: x.reshape((F,) + x.shape[2:])
+        p_valid = fr(prop_valid)
+        p_t = fr(jnp.clip(t_prop, 0, T - 1))
+        p_req = task_req[p_t]
+        p_node = fr(prop_node_mp)
+        p_job = fr(jnp.broadcast_to(sel[:, None], (M, P)))
+        rank = jnp.arange(F, dtype=jnp.int32)
+
+        # conflict resolution against the proposer's own (node, queue)
+        # capacity cell.  The pod-count cap is checked per segment, so two
+        # queues storming the same node can jointly overshoot max_tasks by
+        # up to (queues - 1) in one round — the same class of per-round
+        # slack allocate_solve_batch documents; corrected next cycle.
+        p_q = jnp.clip(c.job_queue[p_job], 0, Q - 1)
+        key_flat = jnp.where(p_valid, p_node * Q + p_q, N * Q)
+        order2 = jnp.lexsort((rank, key_flat))
+        skf = key_flat[order2]
+        snp = jnp.where(skf < N * Q, skf // Q, N)
+        sreqp = jnp.where(p_valid[order2, None], p_req[order2], 0.0)
+        seg_start = jnp.concatenate([jnp.array([True]), skf[1:] != skf[:-1]])
+        cump = jnp.cumsum(sreqp, axis=0)
+        start_pos = jax.lax.cummax(jnp.where(seg_start, jnp.arange(F), 0))
+        relcump = cump - (cump[start_pos] - sreqp[start_pos])
+        cap_rows = jnp.concatenate(
+            [cap_flat, jnp.zeros((1, R), jnp.float32)], 0
+        )[jnp.clip(skf, 0, N * Q)]
+        tc_rows = jnp.concatenate(
+            [s.task_count, jnp.zeros((1,), jnp.int32)], 0
+        )[snp]
+        max_rows = jnp.concatenate(
+            [c.node_max_tasks, jnp.full((1,), 2**31 - 1, jnp.int32)], 0
+        )[snp]
+        pos_in_seg = jnp.arange(F) - start_pos
+        accept_sorted = (
+            jnp.all(relcump < cap_rows + c.eps, axis=-1)
+            & (tc_rows + pos_in_seg < max_rows)
+            & (snp < N)
+        )
+        win0 = jnp.zeros((F,), bool).at[order2].set(accept_sorted) & p_valid
+
+        # no holes: a job's accepted offsets must be a prefix
+        win_mp = win0.reshape(M, P)
+        prefix_ok = jnp.cumsum((~win_mp).astype(jnp.int32), axis=1) == 0
+        win_mp = win_mp & prefix_ok
+        # gang all-or-nothing: win at least the remaining min-need in this
+        # round, or nothing (the sequential statement drains a popped gang
+        # to pipelined-or-Discard as one unit)
+        if gang_pipelined:
+            need = jnp.maximum(
+                c.job_min[sel] - s.job_occupied[sel] - cy.pipe[sel], 0
+            )
+        else:
+            need = jnp.zeros((M,), jnp.int32)
+        wins_m = jnp.sum(win_mp.astype(jnp.int32), axis=1)
+        commit_m = wins_m >= need
+        win = (win_mp & commit_m[:, None]).reshape(F)
+
+        any_win = jnp.any(win)
+
+        # ---- commit: preemptor placements -------------------------------
+        delta = jnp.where(win[:, None], p_req, 0.0)
+        node_tgt = jnp.where(win, p_node, N)
+        flat_tgt = jnp.where(win, p_node * Q + p_q, N * Q)
+        consumed_flat = (
+            jnp.zeros((N * Q + 1, R), jnp.float32).at[flat_tgt].add(delta)
+        )[: N * Q]
+        consumed = consumed_flat.reshape(N, Q, R).sum(axis=1)  # per node
+        placed_cnt = (
+            jnp.zeros((N + 1,), jnp.int32)
+            .at[node_tgt].add(jnp.where(win, 1, 0))
+        )[:N]
+        job_tgt = jnp.where(win, p_job, J)
+        ja2 = (
+            jnp.concatenate([s.job_alloc, jnp.zeros((1, R), jnp.float32)], 0)
+            .at[job_tgt].add(delta)
+        )
+        q_tgt = jnp.where(
+            win, jnp.clip(c.job_queue[p_job], 0, Q - 1), Q
+        )
+        qa2 = (
+            jnp.concatenate([s.queue_alloc, jnp.zeros((1, R), jnp.float32)], 0)
+            .at[q_tgt].add(delta)
+        )[:Q]
+        pipe2 = (
+            jnp.concatenate([cy.pipe, jnp.zeros((1,), jnp.int32)], 0)
+            .at[job_tgt].add(jnp.where(win, 1, 0))
+        )[:J]
+        cursor2 = (
+            jnp.concatenate([cy.cursor, jnp.zeros((1,), jnp.int32)], 0)
+            .at[job_tgt].add(jnp.where(win, 1, 0))
+        )[:J]
+        t_tgt = jnp.where(win, p_t, T)
+        att_seq = cy.rec.att + rank  # round-grouped attempt ids
+        pn2 = (
+            jnp.concatenate([cy.rec.pipe_node, jnp.zeros((1,), jnp.int32)], 0)
+            .at[t_tgt].set(jnp.where(win, p_node, 0))
+        )[:T]
+        pa2 = (
+            jnp.concatenate([cy.rec.pipe_att, jnp.zeros((1,), jnp.int32)], 0)
+            .at[t_tgt].set(jnp.where(win, att_seq, 0))
+        )[:T]
+
+        # ---- materialize victims: minimal admitted evict-order prefix of
+        # each (node, queue) cell covering that cell's consumed capacity
+        # (the per-attempt cover rule, aggregated per cell — same-queue
+        # funding only).  evict_att is kept in the ev layout inside the
+        # loop and converted to pool order on return.
+        cum_excl = cum - vr
+        new_vict = cand_ev & ~less_equal(
+            consumed_flat[flat_ev], cum_excl, c.eps
+        )
+        live2 = cy.live_ev & ~new_vict
+        ea2 = jnp.where(new_vict, cy.rec.att + F, cy.rec.evict_att)
+        vreq_new = jnp.where(new_vict[:, None], req_ev, 0.0)
+        vict_node = jax.ops.segment_sum(
+            vreq_new, sn2, num_segments=N, indices_are_sorted=True
+        )
+        vict_job = jax.ops.segment_sum(vreq_new, job_ev, num_segments=J)
+        vict_job_cnt = jax.ops.segment_sum(
+            new_vict.astype(jnp.int32), job_ev, num_segments=J
+        )
+        vict_q = jax.ops.segment_sum(
+            vreq_new, jnp.where(has_q_ev, rq_ev, Q), num_segments=Q + 1
+        )[:Q]
+        n_vict = jnp.sum(new_vict.astype(jnp.int32))
+
+        s2 = VictimState(
+            run_live=s.run_live,  # reconciled from live_ev after the loop
+            idle=s.idle,
+            releasing=s.releasing + vict_node - consumed,
+            used=s.used + consumed,
+            task_count=s.task_count + placed_cnt,
+            job_alloc=(ja2[:J] - vict_job),
+            job_occupied=s.job_occupied - vict_job_cnt,
+            queue_alloc=qa2 - vict_q,
+        )
+
+        # ---- stall: nothing won => every selected job is stuck at this
+        # state; drop them all (no rollback needed — cancelled wins never
+        # commit anything) and let the next window (or the exact tail) try
+        drop_now = jnp.where(
+            any_win, jnp.zeros((J,), bool),
+            jnp.zeros((J,), bool).at[sel].set(sel_active),
+        )
+
+        return _RoundsCarry(
+            s=s2,
+            live_ev=live2,
+            cursor=cursor2,
+            pipe=pipe2,
+            dropped=cy.dropped | drop_now,
+            rec=cy.rec._replace(
+                evict_att=ea2, pipe_node=pn2, pipe_att=pa2,
+                att=cy.rec.att + F + 1,
+            ),
+            att_total=cy.att_total + jnp.sum(win.astype(jnp.int32)),
+            last_v=jnp.where(any_win, n_vict, cy.last_v),
+            any_commit=cy.any_commit | any_win,
+            round_=cy.round_ + 1,
+            progressed=any_win | jnp.any(drop_now),
+        )
+
+    def cond(cy: _RoundsCarry):
+        active = job_avail0 & ~cy.dropped & (cy.cursor < job_pcount)
+        return cy.progressed & jnp.any(active) & (cy.round_ < J + 8)
+
+    V_ = V
+    init = _RoundsCarry(
+        s=s0,
+        live_ev=s0.run_live[o_ev],
+        cursor=jnp.zeros((J,), jnp.int32),
+        pipe=pipe0,
+        dropped=jnp.zeros((J,), bool),
+        rec=_StormRecords(
+            evict_att=jnp.full((V_,), -1, jnp.int32),
+            pipe_node=jnp.full((T,), -1, jnp.int32),
+            pipe_att=jnp.full((T,), -1, jnp.int32),
+            att=jnp.int32(0),
+        ),
+        att_total=jnp.int32(0),
+        last_v=jnp.int32(0),
+        any_commit=jnp.array(False),
+        round_=jnp.int32(0),
+        progressed=jnp.array(True),
+    )
+    out = jax.lax.while_loop(cond, body, init)
+    final_s = out.s._replace(
+        run_live=jnp.zeros((V_,), bool).at[o_ev].set(out.live_ev)
+    )
+    final_rec = out.rec._replace(
+        evict_att=jnp.full((V_,), -1, jnp.int32).at[o_ev].set(
+            out.rec.evict_att
+        )
+    )
+    return (
+        final_s, out.pipe, final_rec, out.att_total, out.last_v,
+        out.any_commit, out.cursor, out.dropped,
     )
