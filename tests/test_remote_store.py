@@ -460,6 +460,66 @@ def test_remote_patch_and_bulk_round_trip(server):
     assert s.get("Pod", "default/bp1") is None
 
 
+def test_conditional_dotted_patch_local_and_remote(server):
+    """Dotted-path patch with a precondition — the fast cycle's bulk
+    enqueue shipping verb: status.phase flips Pending -> Inqueue in one
+    call, siblings preserved, precondition misses skip without writing —
+    identical semantics in-process and over HTTP."""
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.store import Store
+    from volcano_tpu.store.store import PreconditionFailed
+    from tests.helpers import build_podgroup
+
+    def drive(s):
+        pg = build_podgroup("cp1", min_member=3)
+        pg.status.phase = PodGroupPhase.PENDING
+        pg.status.running = 2
+        s.create("PodGroup", pg)
+        out = s.patch(
+            "PodGroup", "default/cp1",
+            {"status.phase": PodGroupPhase.INQUEUE},
+            when={"status.phase": PodGroupPhase.PENDING},
+        )
+        assert out.status.phase == PodGroupPhase.INQUEUE
+        got = s.get("PodGroup", "default/cp1")
+        assert got.status.phase == PodGroupPhase.INQUEUE
+        assert got.status.running == 2  # sibling fields preserved
+        rv = got.meta.resource_version
+        # precondition miss: nothing written, no version bump
+        with pytest.raises(PreconditionFailed):
+            s.patch(
+                "PodGroup", "default/cp1",
+                {"status.phase": PodGroupPhase.RUNNING},
+                when={"status.phase": PodGroupPhase.PENDING},
+            )
+        got = s.get("PodGroup", "default/cp1")
+        assert got.status.phase == PodGroupPhase.INQUEUE
+        assert got.meta.resource_version == rv
+        # bulk: ok + precondition-miss + bad path, per-op isolation
+        pg2 = build_podgroup("cp2", min_member=1)
+        pg2.status.phase = PodGroupPhase.PENDING
+        s.create("PodGroup", pg2)
+        res = s.bulk([
+            {"op": "patch", "kind": "PodGroup", "key": "default/cp2",
+             "fields": {"status.phase": PodGroupPhase.INQUEUE},
+             "when": {"status.phase": PodGroupPhase.PENDING}},
+            {"op": "patch", "kind": "PodGroup", "key": "default/cp1",
+             "fields": {"status.phase": PodGroupPhase.RUNNING},
+             "when": {"status.phase": PodGroupPhase.PENDING}},
+            {"op": "patch", "kind": "PodGroup", "key": "default/cp2",
+             "fields": {"status.nope": 1}},
+        ])
+        assert res[0] is None
+        assert res[1] is not None and res[1].startswith("PreconditionFailed")
+        assert res[2] is not None and "nope" in res[2]
+        assert s.get("PodGroup", "default/cp2").status.phase == (
+            PodGroupPhase.INQUEUE
+        )
+
+    drive(Store())
+    drive(RemoteStore(server.url))
+
+
 def test_remote_bulk_events_flow_to_watchers(server):
     from tests.helpers import build_pod
 

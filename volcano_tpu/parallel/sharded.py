@@ -68,6 +68,51 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "nodes") -> Mesh:
     return Mesh(np.asarray(devs), (axis,))
 
 
+def resolve_mesh(setting: Optional[str]) -> Optional[Mesh]:
+    """The scheduler-conf ``mesh:`` key -> a Mesh (or None = single device).
+
+    "off"/None/empty -> None; "auto" -> every visible device; "N" -> the
+    first N.  A size-1 result resolves to None (nothing to shard); asking
+    for more devices than exist raises, because silently running
+    single-device would defeat the conf's intent."""
+    if not setting or setting == "off":
+        return None
+    devs = jax.devices()
+    if setting == "auto":
+        n = len(devs)
+        # snapshot node axes bucket to powers of two; a non-pow2 mesh
+        # could never divide them — auto rounds down to the largest
+        # shardable size instead of silently not sharding
+        while n & (n - 1):
+            n -= 1
+    else:
+        n = int(setting)
+        if n > len(devs):
+            raise ValueError(
+                f"mesh: {setting} requested but only {len(devs)} "
+                "devices are visible"
+            )
+        if n & (n - 1):
+            raise ValueError(
+                f"mesh: {setting} is not a power of two — snapshot node "
+                "axes bucket to powers of two, so this mesh could never "
+                "divide them and every solve would silently run "
+                "single-device"
+            )
+    if n <= 1:
+        return None
+    return make_mesh(n)
+
+
+def named_sharding_for(mesh: Mesh, name: str) -> Optional[NamedSharding]:
+    """The node-axis NamedSharding for a snapshot/victim field, or None
+    when the field replicates (task/job/queue state)."""
+    spec = _SPECS.get(name)
+    if spec is None:
+        return None
+    return NamedSharding(mesh, spec)
+
+
 def cycle_shardings(mesh: Mesh, args: Dict[str, object]) -> Dict[str, NamedSharding]:
     """NamedSharding per cycle argument; non-node args replicate."""
     out = {}

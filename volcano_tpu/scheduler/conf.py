@@ -58,6 +58,14 @@ class SchedulerConf:
     # mirror, no per-pod Python) whenever the cluster/conf is expressible,
     # falling back to the object path otherwise; "off": always object path.
     fast_path: str = "auto"
+    # device mesh for the tpu backend's batched solves (SURVEY §5: shard
+    # the [T, N] solve over TPU cores when it exceeds single-chip HBM):
+    # "off" = single device; "auto" = all visible devices; "N" = first N.
+    # Node-shaped snapshot state shards over the mesh's node axis
+    # (parallel/sharded.py's NamedShardings); the sequential exact solve
+    # stays single-device — scalar while-loop steps gain nothing from
+    # SPMD — so mesh implies the batched variants wherever they exist.
+    mesh: str = "off"
 
 
 def default_conf(backend: str = "host") -> SchedulerConf:
@@ -130,6 +138,18 @@ def load_conf(text: str) -> SchedulerConf:
         conf.schedule_period = float(data["schedulePeriod"])
     if "exactTopK" in data:
         conf.exact_topk = bool(data["exactTopK"])
+    if "mesh" in data:
+        raw = data["mesh"]
+        if isinstance(raw, bool):
+            # YAML 1.1 reads a bare `off` as boolean False
+            mesh = "auto" if raw else "off"
+        else:
+            mesh = str(raw)
+        if mesh != "off" and mesh != "auto" and not mesh.isdigit():
+            raise ValueError(
+                f"mesh must be 'off', 'auto' or a device count, got {mesh!r}"
+            )
+        conf.mesh = mesh
     if "fastPath" in data:
         mode = str(data["fastPath"])
         if mode not in ("auto", "off"):

@@ -137,6 +137,17 @@ class FastContention:
 
         from volcano_tpu.scheduler.victim_kernels import VictimConsts, VictimState
 
+        # conf mesh: node planes shard over the device mesh via the
+        # probe's named placement — only under solveMode: batch, where
+        # every contention dispatch is the round-vectorized kernel; the
+        # exact scalar loops (auto's small storms and the rounds tail)
+        # would turn each step's node gathers into cross-device
+        # collectives (conf.py's mesh note)
+        if probe.mesh is not None and fc.conf.solve_mode == "batch":
+            devn = probe.to_device_named
+        else:
+            devn = lambda a, name: jnp.asarray(a)  # noqa: E731
+        self._devn = devn
         self.consts = VictimConsts(
             run_req=jnp.asarray(snap.run_req),
             run_node=jnp.asarray(snap.run_node),
@@ -146,11 +157,11 @@ class FastContention:
             run_evictable=jnp.asarray(snap.run_evictable),
             job_queue=jnp.asarray(snap.job_queue),
             job_min=jnp.asarray(snap.job_min_available),
-            node_alloc=jnp.asarray(snap.node_alloc),
-            node_max_tasks=jnp.asarray(snap.node_max_tasks),
-            node_valid=jnp.asarray(snap.node_valid),
-            class_mask=jnp.asarray(snap.class_node_mask),
-            class_score=jnp.asarray(snap.class_node_score),
+            node_alloc=devn(snap.node_alloc, "node_alloc"),
+            node_max_tasks=devn(snap.node_max_tasks, "node_max_tasks"),
+            node_valid=devn(snap.node_valid, "node_valid"),
+            class_mask=devn(snap.class_node_mask, "class_mask"),
+            class_score=devn(snap.class_node_score, "class_score"),
             queue_deserved=jnp.asarray(deserved.astype(np.float32)),
             total=jnp.asarray(snap.total),
             eps=jnp.asarray(snap.eps),
@@ -183,9 +194,10 @@ class FastContention:
         preemptor rows); the preempt pass gathers t_cls against the NEW
         class indexing, so the consts' class planes must follow."""
         jnp = self.jnp
+        devn = self._devn
         self.consts = self.consts._replace(
-            class_mask=jnp.asarray(snap.class_node_mask),
-            class_score=jnp.asarray(snap.class_node_score),
+            class_mask=devn(snap.class_node_mask, "class_mask"),
+            class_score=devn(snap.class_node_score, "class_score"),
         )
         self.task_req_dev = jnp.asarray(snap.task_req)
         self.task_class_dev = jnp.asarray(snap.task_class)

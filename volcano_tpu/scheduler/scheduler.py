@@ -79,6 +79,14 @@ class Scheduler:
             self.snapshot_cache = SnapshotCache()
         if self.conf.backend == "tpu":
             enable_persistent_compilation_cache()
+        # conf mesh: the device mesh every batched solve shards its node
+        # axis over (SURVEY §5's scale axis, deployed — not just the
+        # library/dryrun path)
+        self.mesh = None
+        if self.conf.backend == "tpu" and self.conf.mesh != "off":
+            from volcano_tpu.parallel.sharded import resolve_mesh
+
+            self.mesh = resolve_mesh(self.conf.mesh)
         # array-native fast cycle (fastpath.py): used per cycle whenever the
         # cluster/conf is expressible; object path otherwise
         self.fast_cycle = None
@@ -118,6 +126,7 @@ class Scheduler:
             flavor="tpu",
             snapshot_cache=self.snapshot_cache,
             exact_topk=self.conf.exact_topk,
+            mesh=self.mesh,
         )
         if not backend.supported:
             return 0.0
@@ -293,6 +302,7 @@ class Scheduler:
                 flavor=self.conf.backend,
                 snapshot_cache=self.snapshot_cache,
                 exact_topk=self.conf.exact_topk,
+                mesh=self.mesh,
             )
         else:
             ssn.tensor_backend = None

@@ -461,14 +461,23 @@ def jax_allocate_solve(backend, snap, n_pending=None):
     w_least, w_balanced = backend.score_weights()
 
     dev = backend.to_device
+    if use_batch and getattr(backend, "mesh", None) is not None:
+        # conf mesh: node-axis state shards over the device mesh
+        # (parallel/sharded.py's NamedShardings); the committed input
+        # shardings drive GSPMD partitioning of the round kernel.  The
+        # exact solve never shards — its scalar per-step updates would
+        # turn into per-iteration collectives.
+        devn = backend.to_device_named
+    else:
+        devn = lambda arr, name: dev(arr)
     out = solve(
-        dev(snap.node_idle),
-        dev(snap.node_releasing),
-        dev(snap.node_used),
-        dev(snap.node_alloc),
-        dev(snap.node_max_tasks),
-        dev(snap.node_task_count),
-        dev(snap.node_valid),
+        devn(snap.node_idle, "idle"),
+        devn(snap.node_releasing, "releasing"),
+        devn(snap.node_used, "used"),
+        devn(snap.node_alloc, "node_alloc"),
+        devn(snap.node_max_tasks, "node_max_tasks"),
+        devn(snap.node_task_count, "task_count"),
+        devn(snap.node_valid, "node_valid"),
         dev(snap.task_req),
         dev(snap.task_job),
         dev(snap.task_class),
@@ -483,8 +492,8 @@ def jax_allocate_solve(backend, snap, n_pending=None):
         dev(snap.job_ntasks),
         dev(snap.queue_alloc_init),
         deserved,
-        dev(snap.class_node_mask),
-        dev(snap.class_node_score),
+        devn(snap.class_node_mask, "class_mask"),
+        devn(snap.class_node_score, "class_score"),
         dev(snap.total),
         dev(snap.eps),
         jnp.float32(w_least),

@@ -48,6 +48,7 @@ class TensorBackend:
         flavor: str = "tpu",  # "tpu" (JAX kernels) | "native" (C++ solver)
         snapshot_cache=None,  # persistent SnapshotCache owned by the Scheduler
         exact_topk: bool = False,  # bit-level multi-chip reproducibility
+        mesh=None,  # jax.sharding.Mesh: shard node-axis state (conf mesh:)
     ):
         self.ssn = ssn
         self.bulk_threshold = bulk_threshold
@@ -56,6 +57,9 @@ class TensorBackend:
         self.flavor = flavor
         self.snapshot_cache = snapshot_cache
         self.exact_topk = exact_topk
+        self.mesh = mesh
+        # sharded-placement memo: id(host array) -> (array, name, device)
+        self._mesh_memo: Dict[int, tuple] = {}
         self.enabled: Dict[str, bool] = {}
         self.nodeorder_args: Dict[str, str] = {}
         self.supported = True
@@ -108,6 +112,41 @@ class TensorBackend:
         import jax.numpy as jnp
 
         return jnp.asarray(arr)
+
+    def to_device_named(self, arr, name: str):
+        """Host→device with the conf mesh's node-axis NamedSharding for
+        node-shaped fields (``name`` follows parallel/sharded._SPECS);
+        everything else — and every field when no mesh is configured, or
+        when the sharded dim does not divide by the mesh — places like
+        ``to_device``.  Committed shardings drive the jitted solves' SPMD
+        partitioning, so the same kernels run sharded with no code
+        change.  Sharded placements memoize by host-array identity (the
+        SnapshotCache pattern) so stable arrays skip the re-upload; a
+        fresh-per-cycle array still pays one transfer per cycle in mesh
+        mode."""
+        if self.mesh is None:
+            return self.to_device(arr)
+        from volcano_tpu.parallel.sharded import named_sharding_for
+
+        sharding = named_sharding_for(self.mesh, name)
+        if sharding is None:
+            return self.to_device(arr)
+        import numpy as np
+
+        a = np.asarray(arr)
+        size = self.mesh.devices.size
+        axis = 1 if name in ("class_mask", "class_score") else 0
+        if a.shape[axis] % size:
+            return self.to_device(arr)
+        memo = self._mesh_memo
+        hit = memo.get(id(a))
+        if hit is not None and hit[0] is a and hit[1] == name:
+            return hit[2]
+        import jax
+
+        dev = jax.device_put(a, sharding)
+        memo[id(a)] = (a, name, dev)  # holds `a` so its id cannot be reused
+        return dev
 
     def invalidate(self) -> None:
         """Host state changed outside the tensor path (e.g. a host action
@@ -183,6 +222,12 @@ class TensorBackend:
         snap = self.snapshot()
         w_least, w_bal = self.score_weights()
         dev = self.to_device
+        # victim consts shard only under solveMode: batch — see
+        # fast_victims.FastContention's placement note
+        if self.solve_mode == "batch":
+            devn = self.to_device_named
+        else:
+            devn = lambda a, name: dev(a)  # noqa: E731
         consts = VictimConsts(
             run_req=dev(snap.run_req),
             run_node=dev(snap.run_node),
@@ -192,11 +237,11 @@ class TensorBackend:
             run_evictable=dev(snap.run_evictable),
             job_queue=dev(snap.job_queue),
             job_min=dev(snap.job_min_available),
-            node_alloc=dev(snap.node_alloc),
-            node_max_tasks=dev(snap.node_max_tasks),
-            node_valid=dev(snap.node_valid),
-            class_mask=dev(snap.class_node_mask),
-            class_score=dev(snap.class_node_score),
+            node_alloc=devn(snap.node_alloc, "node_alloc"),
+            node_max_tasks=devn(snap.node_max_tasks, "node_max_tasks"),
+            node_valid=devn(snap.node_valid, "node_valid"),
+            class_mask=devn(snap.class_node_mask, "class_mask"),
+            class_score=devn(snap.class_node_score, "class_score"),
             queue_deserved=self.deserved(),
             total=jnp.asarray(snap.total),
             eps=jnp.asarray(snap.eps),

@@ -131,13 +131,21 @@ class RemoteStore:
         """Compare-and-swap update (Store.update_cas over the wire)."""
         return self.update(kind, obj, cas=expected_rv)
 
-    def patch(self, kind: str, key: str, fields: Dict[str, Any]) -> Any:
+    def patch(self, kind: str, key: str, fields: Dict[str, Any],
+              when: Optional[Dict[str, Any]] = None) -> Any:
+        payload = {"fields": encode_fields(fields)}
+        if when:
+            payload["when"] = encode_fields(when)
         code, body = self._request(
             "PATCH", f"/apis/{kind}/obj?key={quote(key, safe='')}",
-            {"fields": encode_fields(fields)},
+            payload,
         )
         if code == 404:
             raise KeyError(self._err(code, body))
+        if code == 409:
+            from volcano_tpu.store.store import PreconditionFailed
+
+            raise PreconditionFailed(self._err(code, body))
         if code == 422:
             raise AdmissionError(self._err(code, body))
         if code != 200:
@@ -158,6 +166,8 @@ class RemoteStore:
                 w["key"] = op["key"]
             if "fields" in op:
                 w["fields"] = encode_fields(op["fields"])
+            if "when" in op:
+                w["when"] = encode_fields(op["when"])
             if "cas" in op:
                 w["cas"] = op["cas"]
             wire.append(w)

@@ -160,18 +160,31 @@ def encode_fields(fields: Dict[str, Any]) -> Dict[str, Any]:
     return {k: encode(v) for k, v in fields.items()}
 
 
+def _resolve_hint(cls: Any, dotted: str) -> Any:
+    """Type hint at a dotted attribute path ('status.phase'), walking
+    nested dataclass hints; None when any hop is unknown."""
+    cur = cls
+    for part in dotted.split("."):
+        if cur is None or not dataclasses.is_dataclass(cur):
+            return None
+        cur = _hints(cur).get(part)
+    return cur
+
+
 def decode_fields(kind: str, fields: Dict[str, Any]) -> Dict[str, Any]:
     """Inverse of ``encode_fields``, type-directed by the kind's class
-    hints so object-valued fields rebuild their dataclasses.  Unknown
-    kinds/fields pass through (Store.patch validates attribute names)."""
+    hints so object-valued fields rebuild their dataclasses.  Dotted
+    paths ('status.phase') resolve through nested dataclass hints.
+    Unknown kinds/fields pass through (Store.patch validates attribute
+    names)."""
     cls = KIND_CLASSES.get(kind)
     if cls is None or not dataclasses.is_dataclass(cls):
         return fields
-    hints = _hints(cls)
-    return {
-        k: decode(hints[k], v) if k in hints else v
-        for k, v in fields.items()
-    }
+    out = {}
+    for k, v in fields.items():
+        hint = _resolve_hint(cls, k)
+        out[k] = decode(hint, v) if hint is not None else v
+    return out
 
 
 def decode_object(kind: str, data: Dict[str, Any]) -> Any:

@@ -36,7 +36,7 @@ from volcano_tpu.store.codec import (
     decode_object,
     encode,
 )
-from volcano_tpu.store.store import Store
+from volcano_tpu.store.store import PreconditionFailed, Store
 
 #: cap on buffered events; a client further behind than this must relist
 #: (the reference's "resourceVersion too old" watch error)
@@ -167,8 +167,10 @@ class StoreServer:
                 if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
                     key = q.get("key", [""])[0]
                     try:
+                        body = self._body()
                         code, payload = server.patch(
-                            parts[1], key, self._body().get("fields") or {}
+                            parts[1], key, body.get("fields") or {},
+                            when=body.get("when"),
                         )
                     except Exception as e:  # noqa: BLE001
                         code, payload = 500, {"error": repr(e)}
@@ -259,16 +261,22 @@ class StoreServer:
             self.flush_state()
         return 200, {"object": encode(obj)}
 
-    def patch(self, kind: str, key: str, fields: Dict[str, Any], _flush: bool = True):
+    def patch(self, kind: str, key: str, fields: Dict[str, Any],
+              when: Dict[str, Any] = None, _flush: bool = True):
         if kind == "Job" and self.admission:
             # spec-freeze admission compares whole objects; field patches
             # would bypass it — Jobs must go through PUT
             return 422, {"error": "patch is not supported on Job; use update"}
         with self.lock:
             try:
-                obj = self.store.patch(kind, key, decode_fields(kind, fields))
+                obj = self.store.patch(
+                    kind, key, decode_fields(kind, fields),
+                    when=decode_fields(kind, when) if when else None,
+                )
             except KeyError as e:
                 return 404, {"error": str(e)}
+            except PreconditionFailed as e:
+                return 409, {"error": repr(e)}
             self._pump_log()
         if self._sync_persist and _flush:
             self.flush_state()
@@ -300,7 +308,7 @@ class StoreServer:
                     elif verb == "patch":
                         code, payload = self.patch(
                             kind, op.get("key", ""), op.get("fields") or {},
-                            _flush=False,
+                            when=op.get("when"), _flush=False,
                         )
                         ok = code == 200
                     elif verb == "delete":

@@ -35,6 +35,26 @@ class Conflict(Exception):
     (the API server's 409 on a stale resourceVersion)."""
 
 
+class PreconditionFailed(Exception):
+    """A patch's ``when`` clause did not match the stored object — the
+    write was skipped entirely (the conditional-patch analogue of a CAS
+    miss; callers that race benignly treat it as a no-op)."""
+
+
+def _walk(obj: Any, dotted: str):
+    """(parent, leaf_name) for a dotted attribute path; raises
+    AttributeError on any missing hop."""
+    parts = dotted.split(".")
+    cur = obj
+    for p in parts[:-1]:
+        if not hasattr(cur, p):
+            raise AttributeError(f"no field {p!r} on path {dotted!r}")
+        cur = getattr(cur, p)
+    if not hasattr(cur, parts[-1]):
+        raise AttributeError(f"no field {parts[-1]!r} on path {dotted!r}")
+    return cur, parts[-1]
+
+
 @dataclass
 class Event:
     kind: str
@@ -135,10 +155,16 @@ class Store:
                 )
             return self.update(kind, obj)
 
-    def patch(self, kind: str, key: str, fields: Dict[str, Any]) -> Any:
+    def patch(self, kind: str, key: str, fields: Dict[str, Any],
+              when: Optional[Dict[str, Any]] = None) -> Any:
         """Apply field updates to the stored object in place (the API
         server's PATCH; Bind is a node_name patch). Attribute names must
-        already exist on the object — typos fail loudly.
+        already exist on the object — typos fail loudly.  Names may be
+        dotted paths ('status.phase': set one nested field, preserve its
+        siblings).  ``when`` is an optional precondition map of dotted
+        paths to expected values; any mismatch raises PreconditionFailed
+        and nothing is written (the conditional read-modify-write the
+        fast cycle's bulk enqueue shipping needs in ONE round trip).
 
         Hot path for the async applier's bind batches: when a shadow
         exists, only the patched fields are cloned into a copy-on-write
@@ -149,6 +175,25 @@ class Store:
             obj = self._objects[kind].get(key)
             if obj is None:
                 raise KeyError(f"{kind} {key} not found")
+            if when:
+                for k, expect in when.items():
+                    parent, leaf = _walk(obj, k)
+                    got = getattr(parent, leaf)
+                    if got != expect:
+                        raise PreconditionFailed(
+                            f"{kind} {key}: {k} is {got!r}, wanted {expect!r}"
+                        )
+            if any("." in k for k in fields):
+                # dotted patches mutate a nested field and republish via
+                # update() (full-clone shadow) — they are control-plane
+                # writes (enqueue admissions, status nudges), never the
+                # 100k-bind hot path the COW fast path below serves
+                for k in fields:
+                    _walk(obj, k)  # validate every path BEFORE mutating
+                for k, v in fields.items():
+                    parent, leaf = _walk(obj, k)
+                    setattr(parent, leaf, v)
+                return self.update(kind, obj)
             # validate every name BEFORE mutating: a bad field must not
             # leave earlier fields silently applied with no event/version
             for k in fields:
@@ -206,7 +251,8 @@ class Store:
                 elif verb == "update":
                     self.update(kind, op["object"])
                 elif verb == "patch":
-                    self.patch(kind, op["key"], op["fields"])
+                    self.patch(kind, op["key"], op["fields"],
+                               when=op.get("when"))
                 elif verb == "delete":
                     self.delete(kind, op["key"])
                 else:
