@@ -169,6 +169,74 @@ def test_random_contention_parity(seed):
     assert fast == obj
 
 
+def test_best_effort_preemptor_evicts_on_fast_path():
+    """Without backfill in the conf a pending BE task reaches preempt;
+    the fast path re-packs it into the task arrays and the DO-while core
+    takes exactly one victim for it — parity with the object path, no
+    fallback."""
+    def build():
+        store = preempt_store()
+        store.create("Pod", build_pod("hi-be", group="hi", cpu="0", memory="0"))
+        return store
+
+    def outcome(store, fast):
+        conf = full_conf("tpu")
+        conf.actions = ["enqueue", "allocate", "preempt"]
+        if not fast:
+            conf.fast_path = "off"
+        sched = Scheduler(store, conf=conf)
+        called = []
+        sched.run_object_residue = lambda *a, **k: called.append(a)
+        sched.run_once()
+        state = {
+            "pods": {p.meta.key: (p.node_name, p.deleting)
+                     for p in store.items("Pod")},
+            "evicts": sorted(k for k, _ in sched.cache.evict_log),
+        }
+        return sched, called, state
+
+    s_fast, called, fast = outcome(build(), True)
+    _, _, obj = outcome(build(), False)
+    assert _fast_used(s_fast)
+    assert not called, "BE preemptor fell back to the object sub-cycle"
+    assert fast == obj
+    # 2 victims for the express gang tasks + 1 for the BE task
+    assert len(fast["evicts"]) == 3, fast["evicts"]
+
+
+def test_best_effort_repack_does_not_shift_published_binds():
+    """Spare capacity + a best-effort preemptor: allocate places express
+    tasks, then the BE re-pack rebuilds the task arrays BEFORE publish —
+    binds must keep indexing the solve's layout (the re-pack inserts the
+    BE row mid-array when its job is not last)."""
+    def build():
+        nodes = [build_node(f"n{i}", cpu="4", memory="8Gi")
+                 for i in range(2)]
+        # job "a..." sorts first; its BE row lands between a's and z's
+        # express rows after the re-pack
+        pga = build_podgroup("aaa", min_member=1, queue="qa")
+        pgz = build_podgroup("zzz", min_member=2, queue="qa")
+        pods = [build_pod("aaa-0", group="aaa", cpu="1", memory="1Gi",
+                          priority=5)]
+        be = build_pod("aaa-be", group="aaa", cpu="0", memory="0")
+        be.spec.node_selector = {"zone": "nowhere"}
+        pods.append(be)
+        pods += [build_pod(f"zzz-{t}", group="zzz", cpu="1", memory="1Gi")
+                 for t in range(2)]
+        store = make_store(
+            nodes=nodes, queues=[build_queue("qa"), build_queue("default")],
+            podgroups=[pga, pgz], pods=pods)
+        _prio_classes(store)
+        return store
+
+    s_fast, fast = _outcome(build(), True)
+    _, obj = _outcome(build(), False)
+    assert _fast_used(s_fast)
+    assert fast == obj
+    bound = {k: v[0] for k, v in fast["pods"].items() if v[0]}
+    assert set(bound) == {"default/aaa-0", "default/zzz-0", "default/zzz-1"}
+
+
 def test_two_cycle_convergence():
     """After the kubelet reaps evicted victims, the next cycle binds the
     pipelined preemptors — end-to-end over the fast path."""
@@ -315,15 +383,31 @@ def test_batched_rounds_never_evicts_cross_queue():
     assert all("/a" in k for k in preempted), preempted
 
 
-def test_best_effort_preemptor_falls_back_to_object_machinery():
-    """An empty-request pending task among the preemptors is the
-    kernel-inexpressible case: the cycle must still produce object-parity
-    decisions (via the object sub-cycle)."""
+def test_best_effort_preemptor_served_by_fast_path():
+    """An empty-request pending task among the preemptors used to force
+    the O(cluster) object sub-cycle; the DO-while victim core (one victim
+    for an empty request, host rule) makes it array-native.  Parity must
+    hold AND the object machinery must never run."""
     def build():
         store = preempt_store()
-        store.create("Pod", build_pod("hi-be", group="hi"))
+        store.create("Pod", build_pod("hi-be", group="hi", cpu="0", memory="0"))
         return store
 
-    _, fast = _outcome(build(), True)
+    conf = full_conf("tpu")
+    store = build()
+    sched = Scheduler(store, conf=conf)
+    called = []
+    sched.run_object_residue = lambda *a, **k: called.append(a)
+    sched.run_once()
+    assert _fast_used(sched)
+    assert not called, "BE preemptor fell back to the object sub-cycle"
+    fast = {
+        "pods": {p.meta.key: (p.node_name, p.deleting)
+                 for p in store.items("Pod")},
+        "pgs": {pg.meta.key: (pg.status.phase,
+                              sorted(c.kind for c in pg.status.conditions))
+                for pg in store.items("PodGroup")},
+        "evicts": sorted(k for k, _ in sched.cache.evict_log),
+    }
     _, obj = _outcome(build(), False)
     assert fast == obj

@@ -233,6 +233,36 @@ def test_reclaim_parity_same_tier_gang_proportion_intersection():
     assert results["native"] == results["host"]
 
 
+def test_preempt_parity_best_effort_preemptor_takes_one_victim():
+    """An empty-request preemptor: the host DO-while loop evicts exactly
+    one victim before its (trivially satisfied) cover check; the tensor
+    and native kernels must reproduce that, not zero victims (the old
+    while-shaped prefix) — 3-way parity through the real action."""
+    def build():
+        pg_low = build_podgroup("pg-low", min_member=1)
+        pg_high = build_podgroup("pg-high", min_member=1)
+        pg_high.priority_class_name = "high"
+        store = make_store(
+            nodes=[build_node("n0", cpu="2", memory="4Gi")],
+            podgroups=[pg_low, pg_high],
+            pods=[],
+        )
+        p = build_pod("low-0", group="pg-low", cpu="1", memory="1Gi",
+                      priority=1)
+        p.node_name = "n0"
+        p.phase = PodPhase.RUNNING
+        store.create("Pod", p)
+        store.create("Pod", build_pod("hi-be", group="pg-high", cpu="0", memory="0", priority=100))
+        _priority_classes(store)
+        return store
+
+    # no backfill in the conf: a feasible node would otherwise backfill
+    # the BE task before preempt ever attempts it
+    host, tpu = run_both(build, ["enqueue", "allocate", "preempt"])
+    assert tpu == host
+    assert len(host[1]) == 1, host  # exactly one victim
+
+
 @pytest.mark.parametrize("seed", list(range(8)))
 def test_victim_parity_random_clusters(seed):
     rng = np.random.default_rng(seed)

@@ -496,16 +496,23 @@ void vt_victim_step(const VictimConfig* cfg,
   {
     std::vector<float> prefix(R);
     int seg_node = -1;
+    bool first_in_seg = false;
     for (int32_t v : crows) {
       int n = run_node[v];
       if (n < 0 || n >= N) continue;
       if (n != seg_node) {
         seg_node = n;
+        first_in_seg = true;
         std::fill(prefix.begin(), prefix.end(), 0.0f);
       }
       any_adm[n] = 1;
-      // evict while the exclusive prefix does not yet cover the request
-      if (!less_equal(t_req, prefix.data(), eps, R)) in_prefix[v] = 1;
+      // DO-while eviction, like the host loop: a node's first victim is
+      // evicted before the cover check (matters only for empty-request
+      // preemptors, whose request zero victims already cover), then keep
+      // evicting while the exclusive prefix does not yet cover
+      if (first_in_seg || !less_equal(t_req, prefix.data(), eps, R))
+        in_prefix[v] = 1;
+      first_in_seg = false;
       for (int r = 0; r < R; ++r) {
         prefix[r] += run_req[(size_t)v * R + r];
         node_tot[(size_t)n * R + r] += run_req[(size_t)v * R + r];

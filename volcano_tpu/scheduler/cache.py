@@ -73,22 +73,19 @@ class Evictor:
         """Batched evict: one store round trip for a cycle's victims.
         Returns per-evict error strings (None on success).  A vanished pod
         is a success like the per-evict seam (nothing left to delete) —
-        matched as the exact KeyError repr Store.patch raises for that
-        pod, never by substring, so an unrelated error mentioning 'not
-        found' still surfaces and triggers the mirror refresh."""
+        both bulk transports mark that case with the structured
+        "NotFound:" prefix (Store.bulk / StoreServer.patch), so an
+        unrelated error that merely mentions 'not found' still surfaces
+        and triggers the mirror refresh."""
         results = self.store.bulk([
             {"op": "patch", "kind": "Pod", "key": key,
              "fields": {"deleting": True}}
             for key, _ in evicts
         ])
-        out = []
-        for (key, _), err in zip(evicts, results):
-            e = KeyError(f"Pod {key} not found")
-            # in-process bulk reports repr(e); the HTTP server's 404 path
-            # reports str(e) — both exact, nothing substring-matched
-            vanished = (repr(e), str(e))
-            out.append(None if (err is None or err in vanished) else err)
-        return out
+        return [
+            None if (err is None or err.startswith("NotFound:")) else err
+            for err in results
+        ]
 
 
 class StatusUpdater:

@@ -31,9 +31,12 @@ pass now runs as one device program:
     ``solveMode: exact``);
   * anything the kernel cannot express — a host walk that would strand
     evictions on non-covering nodes (``clean=False``, see
-    victim_kernels.py), a best-effort (empty-request) preemptor — aborts
-    the pass with nothing published; the caller falls back to the object
-    machinery, which recomputes the same decisions from the store.
+    victim_kernels.py) — aborts the pass with nothing published; the
+    caller falls back to the object machinery, which recomputes the same
+    decisions from the store.  Best-effort (empty-request) preemptors ARE
+    expressible: the core's DO-while prefix takes exactly one victim for
+    them like the host loop, and fastpath re-packs their rows into the
+    task arrays before the preempt pass.
 
 Divergences from the object path, same documented class as the fast
 allocate passes: eviction-order ties break by pod *arrival* rank rather
@@ -137,16 +140,11 @@ class FastContention:
 
         from volcano_tpu.scheduler.victim_kernels import VictimConsts, VictimState
 
-        # conf mesh: node planes shard over the device mesh via the
-        # probe's named placement — only under solveMode: batch, where
-        # every contention dispatch is the round-vectorized kernel; the
-        # exact scalar loops (auto's small storms and the rounds tail)
-        # would turn each step's node gathers into cross-device
-        # collectives (conf.py's mesh note)
-        if probe.mesh is not None and fc.conf.solve_mode == "batch":
-            devn = probe.to_device_named
-        else:
-            devn = lambda a, name: jnp.asarray(a)  # noqa: E731
+        # conf mesh: node planes shard only when every contention
+        # dispatch is the round-vectorized kernel (solveMode: batch) —
+        # the exact scalar loops would turn each step's node gathers
+        # into cross-device collectives (tensor_backend.placement_fn)
+        devn = probe.placement_fn(fc.conf.solve_mode == "batch")
         self._devn = devn
         self.consts = VictimConsts(
             run_req=jnp.asarray(snap.run_req),
@@ -264,10 +262,10 @@ class FastContention:
         sched[: self.n_jobs] = self.snap.job_schedulable[: self.n_jobs]
         return sched
 
-    def _pend_per_job(self) -> np.ndarray:
+    def _pend_per_job(self, key: str = "pend_nonbe_per_job") -> np.ndarray:
         J = self.snap.job_queue.shape[0]
         pend = np.zeros(J, np.int64)
-        src = np.asarray(self.aux["pend_nonbe_per_job"])
+        src = np.asarray(self.aux[key])
         n = min(J, src.shape[0])
         pend[:n] = src[:n]
         return pend
@@ -376,7 +374,10 @@ class FastContention:
             )[:J]
         else:
             unplaced = np.zeros(J, np.int64)
-        pend_ok = sched & (self._pend_per_job() > 0)
+        # ANY pending task (incl. best-effort) keeps a job a preemptor —
+        # the host preemptor walk includes empty-request tasks, which the
+        # pre-preempt re-pack placed into these arrays
+        pend_ok = sched & (self._pend_per_job("pend_any_per_job") > 0)
         is_pre = pend_ok & (unplaced > 0)
         under = np.nonzero(is_pre)[0].astype(np.int32)
         nu = under.size
@@ -417,8 +418,21 @@ class FastContention:
                 snap.job_min_available.astype(np.int64)
                 - self.occ - self.pipe, 0,
             )
+            # jobs with a best-effort pending row take the exact loop: the
+            # rounds kernel's capacity math has no do-while eviction (an
+            # empty request consumes zero capacity => zero victims)
+            be_jobs = np.zeros(J, bool)
+            pe = self.aux["pe_rows"]
+            n = min(T, pe.size)
+            if n:
+                is_be = np.zeros(T, bool)
+                is_be[:n] = self.fc.mirror.p_best_effort[pe[:n]]
+                rows_be = np.nonzero(is_be & snap.task_valid)[0]
+                if rows_be.size:
+                    be_jobs[np.unique(snap.task_job[rows_be])] = True
             eligible = (
                 is_pre & (snap.job_queue >= 0) & (need <= ROUNDS_P_CHUNK)
+                & ~be_jobs
             )
             if eligible.any():
                 attempt_rows = self._rounds_stage(attempt_rows, eligible)

@@ -749,14 +749,18 @@ def _task_arrays(m: ArrayMirror, pe_rows: np.ndarray, pod_j: np.ndarray,
         cids_in_order = uniq[order]  # snapshot class idx -> mirror class id
     else:
         cids_in_order = np.zeros(0, np.int64)
-    C = max(cids_in_order.size, 1)
+    # class axis bucketed like the object snapshot (snapshot.py): a fresh
+    # class mid-cycle must not change the [C, N] shape and trigger an
+    # in-cycle storm-kernel recompile
+    C = _bucket(max(cids_in_order.size, 1), minimum=4)
     class_mask = np.zeros((C, N), bool)
     class_score = np.zeros((C, N), np.float32)
     if cids_in_order.size and n_live_ct:
         m.fill_class_cells(cids_in_order, node_rows_arr, nodeaffinity_weight)
         sel = np.ix_(cids_in_order, node_rows_arr)
-        class_mask[:, :n_live_ct] = m.cls_mask[sel]
-        class_score[:, :n_live_ct] = m.cls_score[sel]
+        nC = cids_in_order.size
+        class_mask[:nC, :n_live_ct] = m.cls_mask[sel]
+        class_score[:nC, :n_live_ct] = m.cls_score[sel]
     else:
         # no pending tasks: all-True row, matching snapshot.py:498-499
         class_mask[:, :n_live_ct] = True
@@ -1289,12 +1293,17 @@ class FastCycle:
 
         residue = bool(aux["residue_keys"])
         unplaced = bool((snap.task_valid & (task_kind == 0)).any())
+        # solve-layout row maps: the preempt pass may re-pack the task
+        # arrays below (best-effort rows joining), but task_node/task_kind
+        # index THIS layout — publish must keep using it
+        pe_rows_solve = aux["pe_rows"]
+        task_job_solve = snap.task_job
+        task_req_solve = snap.task_req
+        be_left = self._pending_best_effort(m, snap, aux, minus_placed=be_rows)
         obj_preempt = False
-        if preempt_later and (unplaced or residue):
-            if residue or self._pending_best_effort(
-                m, snap, aux, minus_placed=be_rows
-            ):
-                # dynamic or empty-request preemptors: the object preempt
+        if preempt_later and (unplaced or residue or be_left):
+            if residue:
+                # dynamic-predicate preemptors: the object preempt
                 # machinery must run — safe only while the fast contention
                 # state holds nothing unpublished
                 if cont is not None and (cont.evictions or cont.pipelines):
@@ -1307,7 +1316,17 @@ class FastCycle:
                 cont.advance_post_solve(
                     task_node, task_kind, ready, be_rows, be_nodes
                 )
-                if not cont.preempt_pass(task_kind > 0):
+                if be_left:
+                    # empty-request preemptors join the preempt task
+                    # arrays (the DO-while victim core takes exactly one
+                    # victim for them, like the host loop) — no object
+                    # fallback, no O(cluster) session for a mixed storm
+                    placed_mask = self._repack_with_best_effort(
+                        m, snap, aux, cont, task_kind, be_rows
+                    )
+                else:
+                    placed_mask = task_kind > 0
+                if not cont.preempt_pass(placed_mask):
                     # stranded-eviction case mid-pass: its records were
                     # rolled back; reclaim's (if any) must not publish
                     # without the preempt the conf ordered after them
@@ -1328,6 +1347,9 @@ class FastCycle:
             write_status=not run_sub,
             evicts=evicts,
             ready_status=ready_status,
+            pe_rows_solve=pe_rows_solve,
+            task_job_solve=task_job_solve,
+            task_req_solve=task_req_solve,
         )
         if run_sub:
             # the sub-cycle's snapshot must see this cycle's published
@@ -1351,6 +1373,41 @@ class FastCycle:
             snap.queue_participates,
         ))
         return FastContention(self, snap, aux, deserved)
+
+    def _repack_with_best_effort(self, m, snap, aux, cont, task_kind,
+                                 be_rows) -> np.ndarray:
+        """Rebuild the task arrays to include pending best-effort rows of
+        schedulable express jobs for the preempt pass (the host preemptor
+        set includes them; allocate/backfill exclude them, so they only
+        join here).  Returns the placed mask over the NEW arrays: rows the
+        solve placed stay excluded from the preemptor walk, like the host
+        deques."""
+        P = aux["codes"].shape[0]
+        be = aux["live"] & (aux["codes"] == _PENDING) & m.p_best_effort[:P]
+        rows = np.nonzero(be)[0]
+        if rows.size:
+            rows = rows[snap.job_schedulable[aux["pod_j"][rows]]]
+        if rows.size:
+            rows = rows[~aux["dyn_job"][aux["pod_j"][rows]]]
+        if be_rows.size and rows.size:
+            rows = np.setdiff1d(rows, be_rows, assume_unique=False)
+        pe_rows = aux["pe_rows"]
+        placed_mirror = pe_rows[np.nonzero(task_kind > 0)[0]]
+        combined = np.concatenate([pe_rows, rows])
+        order = np.lexsort((
+            m.p_rank[combined], -m.p_prio[combined],
+            aux["pod_j"][combined],
+        ))
+        combined = combined[order]
+        from volcano_tpu.scheduler.fast_victims import _rebuild_task_arrays
+
+        _rebuild_task_arrays(m, self, snap, aux, combined)
+        cont.refresh_for_preempt(snap)
+        new_pe = aux["pe_rows"]
+        placed_mask = np.zeros(snap.task_req.shape[0], bool)
+        if placed_mirror.size:
+            placed_mask[: new_pe.size] = np.isin(new_pe, placed_mirror)
+        return placed_mask
 
     def _pending_best_effort(self, m, snap, aux, minus_placed=None) -> bool:
         """Any pending empty-request task of a schedulable job — the
@@ -1447,9 +1504,10 @@ class FastCycle:
         veto_p, _ = self.probe.victim_vetoes()
         escape = self._gang_escape(snap, aux, veto_p)
         run_per_job = aux["run_per_job"][:n_jobs]
-        # includes dynamic-job pending: residue starvation must reach the
-        # preempt sub-cycle too
-        pend_per_job = aux["pend_nonbe_per_job"][:n_jobs]
+        # includes dynamic-job pending (residue starvation must reach the
+        # preempt sub-cycle too) AND best-effort pending: the host
+        # preemptor walk attempts empty-request tasks
+        pend_per_job = aux["pend_any_per_job"][:n_jobs]
         # phase 1: same-queue, cross-job victims
         Q = snap.queue_weight.shape[0]
         q_pending = np.zeros(Q, bool)
@@ -1673,25 +1731,37 @@ class FastCycle:
                            be_rows, be_nodes, be_per_job, enq_rows,
                            write_status: bool = True,
                            evicts=None,
-                           ready_status=None) -> List[Tuple[str, str]]:
+                           ready_status=None,
+                           pe_rows_solve=None,
+                           task_job_solve=None,
+                           task_req_solve=None) -> List[Tuple[str, str]]:
         """``evicts``: (pod_key, reason) victims from the contention
         passes, published through the evictor's bulk verb.
         ``ready_status``: end-state per-job ready counts for the STATUS
         section when preempt evictions ran after allocate (the bind filter
         keeps allocate-time readiness, as the object path's dispatch
-        does)."""
+        does).  ``pe_rows_solve``/``task_job_solve``: the task-array
+        layout ``task_node``/``task_kind`` index — the preempt pass may
+        have re-packed ``aux``/``snap`` since the solve (best-effort rows
+        joining), so the caller passes the solve-time arrays."""
         from volcano_tpu.api.objects import PodGroupCondition, PodGroupStatus
 
         n_jobs = aux["n_jobs"]
         J = snap.job_min_available.shape[0]
         jm = snap.job_min_available
         pod_j = aux["pod_j"]
+        if pe_rows_solve is None:
+            pe_rows_solve = aux["pe_rows"]
+        if task_job_solve is None:
+            task_job_solve = snap.task_job
+        if task_req_solve is None:
+            task_req_solve = snap.task_req
 
         express = np.nonzero(task_kind == 1)[0]
         express_per_job = np.zeros(J, np.int64)
         if express.size:
             express_per_job += np.bincount(
-                snap.task_job[express], minlength=J
+                task_job_solve[express], minlength=J
             )
         ready_final = ready.astype(np.int64) + be_per_job
         if self.gang_on:
@@ -1701,8 +1771,8 @@ class FastCycle:
 
         # -- binds (vectorized: row indices all the way) ---------------------
         node_rows = aux["node_rows"]
-        pe_rows = aux["pe_rows"]
-        pub_express = express[gang_ready[snap.task_job[express]]] if express.size else express
+        pe_rows = pe_rows_solve
+        pub_express = express[gang_ready[task_job_solve[express]]] if express.size else express
         row_key = m.pods.row_key
         names = snap.node_names
         binds: List[Tuple[str, str]] = []
@@ -1763,7 +1833,8 @@ class FastCycle:
         # sorted idle column + searchsorted — O((N + U) log N), no [U, N]
         # materialization
         fit_msgs = (
-            self._fit_errors(snap, aux, task_node, task_kind, unready)
+            self._fit_errors(snap, aux, task_node, task_kind, unready,
+                             task_req_solve)
             if write_status else {}
         )
 
@@ -1867,8 +1938,11 @@ class FastCycle:
                             )
         return binds
 
-    def _fit_errors(self, snap, aux, task_node, task_kind, unready):
+    def _fit_errors(self, snap, aux, task_node, task_kind, unready,
+                    task_req_solve=None):
         n_jobs = aux["n_jobs"]
+        if task_req_solve is None:
+            task_req_solve = snap.task_req
         if not self.gang_on or not unready.any():
             return {}
         with_pend = unready & (snap.job_ntasks[:n_jobs] > 0)
@@ -1882,7 +1956,7 @@ class FastCycle:
         placed = np.nonzero(task_kind == 1)[0]
         if placed.size:
             np.subtract.at(
-                idle_after, task_node[placed], snap.task_req[placed]
+                idle_after, task_node[placed], task_req_solve[placed]
             )
         total = int(snap.node_valid[:n_nodes].sum())
         heads = snap.job_start[ujobs]

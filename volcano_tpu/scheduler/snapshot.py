@@ -467,7 +467,11 @@ def build_tensor_snapshot(
     # the O(classes × nodes) Python predicate sweep is the dominant build
     # cost on big clusters; per-class rows (and the assembled arrays) are
     # reused across cycles while the node epoch holds (SnapshotCache)
-    C = max(len(classes), 1)
+    # the class axis buckets like every other dim: a new predicate class
+    # appearing mid-day (one pod with a fresh node selector) must not
+    # change the [C, N] plane shape and recompile every storm kernel
+    # inside a scheduling cycle
+    C = _bucket(max(len(classes), 1), minimum=4)
     class_keys = tuple(classes)  # insertion order == class index order
     assembled = cache._assembled if cache is not None else None
     if assembled is not None and assembled[0] == class_keys and assembled[1].shape == (C, N):

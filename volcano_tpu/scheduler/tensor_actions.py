@@ -21,13 +21,11 @@ def _host_allocate(ssn) -> None:
 
 
 def _victim_path_usable(ssn, backend):
-    """Whether the victim kernel can serve this session: tensorizable tiers,
-    class-expressible predicates, and no best-effort pending preemptors
-    (empty-request preemptors take the one-victim-then-stop host path that
-    the prefix-cover rule cannot express). Only jobs the preempt/reclaim
-    loops actually visit (schedulable pod group, known queue) matter."""
-    from volcano_tpu.api.types import PodGroupPhase
-
+    """Whether the victim kernel can serve this session: tensorizable tiers
+    and class-expressible predicates.  Empty-request (best-effort)
+    preemptors are expressible since the kernel's prefix rule went
+    DO-while shaped like the host loop (a node's first victim is evicted
+    before the cover check), so they no longer force the host path."""
     if backend is None or not backend.supported:
         return False
     if backend.flavor == "native":
@@ -38,17 +36,6 @@ def _victim_path_usable(ssn, backend):
     snap = backend.snapshot()
     if snap.has_dynamic_predicates:
         return False
-    for job in ssn.jobs.values():
-        if (
-            job.pod_group is not None
-            and job.pod_group.status.phase == PodGroupPhase.PENDING
-        ):
-            continue
-        if job.queue not in ssn.queues:
-            continue
-        for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
-            if t.resreq.is_empty():
-                return False
     return True
 
 
@@ -120,7 +107,12 @@ class _VictimDriver:
         clean); on clean assignment the device state advances and the host
         replay is the caller's job. ``clean=False`` means the host walk
         would strand evictions on non-covering nodes — state is untouched
-        and the caller must take the host fallback, then resync."""
+        and the caller must take the host fallback, then resync.  A task
+        with no snapshot row (a best-effort pending task — the allocate
+        task arrays exclude them) reports ``clean=False`` too: the caller's
+        per-preemptor host fallback computes its decision exactly."""
+        if task.uid not in self.task_row:
+            return False, "", [], False
         t = self.task_row[task.uid]
         snap = self.snap
         jt = self.job_row[task.job_uid]
@@ -461,15 +453,10 @@ def jax_allocate_solve(backend, snap, n_pending=None):
     w_least, w_balanced = backend.score_weights()
 
     dev = backend.to_device
-    if use_batch and getattr(backend, "mesh", None) is not None:
-        # conf mesh: node-axis state shards over the device mesh
-        # (parallel/sharded.py's NamedShardings); the committed input
-        # shardings drive GSPMD partitioning of the round kernel.  The
-        # exact solve never shards — its scalar per-step updates would
-        # turn into per-iteration collectives.
-        devn = backend.to_device_named
-    else:
-        devn = lambda arr, name: dev(arr)
+    # conf mesh: node-axis state shards over the device mesh for the
+    # batched solve only (parallel/sharded.py's NamedShardings; committed
+    # input shardings drive GSPMD partitioning of the round kernel)
+    devn = backend.placement_fn(use_batch)
     out = solve(
         devn(snap.node_idle, "idle"),
         devn(snap.node_releasing, "releasing"),

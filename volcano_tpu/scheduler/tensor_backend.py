@@ -58,8 +58,8 @@ class TensorBackend:
         self.snapshot_cache = snapshot_cache
         self.exact_topk = exact_topk
         self.mesh = mesh
-        # sharded-placement memo: id(host array) -> (array, name, device)
-        self._mesh_memo: Dict[int, tuple] = {}
+        # sharded-placement memo: field name -> (host array, device array)
+        self._mesh_memo: Dict[str, tuple] = {}
         self.enabled: Dict[str, bool] = {}
         self.nodeorder_args: Dict[str, str] = {}
         self.supported = True
@@ -138,15 +138,29 @@ class TensorBackend:
         axis = 1 if name in ("class_mask", "class_score") else 0
         if a.shape[axis] % size:
             return self.to_device(arr)
+        # memo keyed by FIELD name (bounded at the field count): replaced
+        # whenever a fresh host array arrives for the field, so stable
+        # arrays skip the re-upload and rebuilt ones never accumulate
         memo = self._mesh_memo
-        hit = memo.get(id(a))
-        if hit is not None and hit[0] is a and hit[1] == name:
-            return hit[2]
+        hit = memo.get(name)
+        if hit is not None and hit[0] is a:
+            return hit[1]
         import jax
 
         dev = jax.device_put(a, sharding)
-        memo[id(a)] = (a, name, dev)  # holds `a` so its id cannot be reused
+        memo[name] = (a, dev)
         return dev
+
+    def placement_fn(self, batch_active: bool):
+        """The ONE sharding-policy decision: named (mesh-sharded) placement
+        only when a round-vectorized kernel will consume the arrays —
+        scalar exact loops over node-sharded state would turn every step's
+        gathers into cross-device collectives.  Callers pass whether the
+        batched variant is active; the returned callable has the
+        ``(arr, name)`` shape of ``to_device_named``."""
+        if batch_active and self.mesh is not None:
+            return self.to_device_named
+        return lambda arr, name: self.to_device(arr)
 
     def invalidate(self) -> None:
         """Host state changed outside the tensor path (e.g. a host action
@@ -222,12 +236,9 @@ class TensorBackend:
         snap = self.snapshot()
         w_least, w_bal = self.score_weights()
         dev = self.to_device
-        # victim consts shard only under solveMode: batch — see
-        # fast_victims.FastContention's placement note
-        if self.solve_mode == "batch":
-            devn = self.to_device_named
-        else:
-            devn = lambda a, name: dev(a)  # noqa: E731
+        # victim consts shard only when every contention dispatch is the
+        # round-vectorized kernel (solveMode: batch)
+        devn = self.placement_fn(self.solve_mode == "batch")
         consts = VictimConsts(
             run_req=dev(snap.run_req),
             run_node=dev(snap.run_node),

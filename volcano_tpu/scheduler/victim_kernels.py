@@ -253,13 +253,22 @@ def _victim_core(
         )
         cand = cand & jnp.zeros((V,), bool).at[o_prop].set(admit_s)
 
-    # per-node eviction-order prefix sums: keep evicting while the
-    # exclusive prefix does not yet cover the request
-    s2req = jnp.where(cand[o_ev, None], c.run_req[o_ev], 0.0)
+    # per-node eviction-order prefix sums.  The host loop is DO-while
+    # shaped — it evicts a node's first victim BEFORE the cover check
+    # (preempt.py:151-156 / reclaim.py:106-110), which only matters for an
+    # empty-request preemptor (its request is covered by zero victims, yet
+    # the host still takes exactly one) — so the first admitted candidate
+    # of each node is in the prefix unconditionally.
+    cand_s = cand[o_ev]
+    s2req = jnp.where(cand_s[:, None], c.run_req[o_ev], 0.0)
     sn2 = c.run_node[o_ev]
     cum2 = _seg_cumsum(s2req, seg_ev)
     cum_excl = cum2 - s2req
-    in_prefix_s = cand[o_ev] & ~less_equal(t_req[None, :], cum_excl, c.eps)
+    cand_cnt = _seg_cumsum(cand_s.astype(jnp.int32), seg_ev)
+    first_cand = cand_s & (cand_cnt == 1)
+    in_prefix_s = cand_s & (
+        first_cand | ~less_equal(t_req[None, :], cum_excl, c.eps)
+    )
 
     node_tgt = jnp.where(cand, c.run_node, N)
     node_tot = jax.ops.segment_sum(

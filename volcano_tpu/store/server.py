@@ -274,7 +274,9 @@ class StoreServer:
                     when=decode_fields(kind, when) if when else None,
                 )
             except KeyError as e:
-                return 404, {"error": str(e)}
+                # NotFound: prefix = the structured vanished-object marker
+                # bulk callers match (same contract as Store.bulk)
+                return 404, {"error": f"NotFound: {e}"}
             except PreconditionFailed as e:
                 return 409, {"error": repr(e)}
             self._pump_log()

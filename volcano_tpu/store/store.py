@@ -258,6 +258,11 @@ class Store:
                 else:
                     raise ValueError(f"unknown bulk op {verb!r}")
                 results.append(None)
+            except KeyError as e:
+                # structured marker: callers that treat a vanished object
+                # as success (evict of an already-deleted pod) match this
+                # prefix instead of reverse-engineering exception reprs
+                results.append(f"NotFound: {e}")
             except Exception as e:  # noqa: BLE001 — per-op isolation
                 results.append(repr(e))
         return results
