@@ -86,3 +86,25 @@ assert spent > 0.0
 def test_enable_cache_off_switch(monkeypatch):
     monkeypatch.setenv("VOLCANO_TPU_XLA_CACHE", "off")
     assert enable_persistent_compilation_cache() is None
+
+
+def test_prewarm_queueless_and_empty_cluster_do_not_crash():
+    """Bootstrapping clusters: no queues yet (the fast snapshot builder
+    returns (None, {})) or nothing at all — prewarm must fall back to the
+    object-session shapes without raising (a KeyError here kills the
+    daemon at startup, review r4)."""
+    from volcano_tpu.scheduler.conf import full_conf
+    from volcano_tpu.store import Store
+
+    # no queues, but nodes/pods exist
+    store = make_store(nodes=[build_node("n0")], queues=[],
+                       podgroups=[build_podgroup("pg", min_member=1)],
+                       pods=[build_pod("p0", group="pg", cpu="1")])
+    for q in list(store.items("Queue")):
+        store.delete("Queue", q.meta.key)
+    sched = Scheduler(store, conf=full_conf("tpu"))
+    sched.prewarm(bucket_levels=0)
+
+    # completely empty store
+    sched = Scheduler(Store(), conf=full_conf("tpu"))
+    sched.prewarm(bucket_levels=0)

@@ -29,6 +29,15 @@ _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_PKG_DIR, "solver.cc")
 
 
+def _user_cache_lib() -> str:
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "volcano_tpu", "native",
+    )
+    return os.path.join(cache, "libvtsolver.so")
+
+
 def _lib_path() -> str:
     pkg_lib = os.path.join(_PKG_DIR, "libvtsolver.so")
     if os.access(_PKG_DIR, os.W_OK):
@@ -41,12 +50,7 @@ def _lib_path() -> str:
             return pkg_lib
     except OSError:
         pass
-    cache = os.path.join(
-        os.environ.get("XDG_CACHE_HOME")
-        or os.path.join(os.path.expanduser("~"), ".cache"),
-        "volcano_tpu", "native",
-    )
-    return os.path.join(cache, "libvtsolver.so")
+    return _user_cache_lib()
 
 
 _LIB = _lib_path()
@@ -116,7 +120,7 @@ def _build() -> Optional[str]:
 
 def load() -> Optional[ctypes.CDLL]:
     """The solver library, building it if needed; None if unavailable."""
-    global _lib, _build_error
+    global _lib, _build_error, _LIB
     with _lock:
         if _lib is not None:
             return _lib
@@ -144,10 +148,17 @@ def load() -> Optional[ctypes.CDLL]:
                 # over from another machine), or stale symbols: drop it and
                 # rebuild from source once before degrading to the host path
                 if attempt == 0:
-                    try:
-                        os.unlink(_LIB)
-                    except OSError:
-                        pass
+                    if not os.access(os.path.dirname(_LIB), os.W_OK):
+                        # a read-only prebuilt (e.g. wrong-arch library in
+                        # a root-owned install) can be neither unlinked nor
+                        # rebuilt in place — rebuild at the per-user cache
+                        # path instead of degrading to the host path
+                        _LIB = _user_cache_lib()
+                    else:
+                        try:
+                            os.unlink(_LIB)
+                        except OSError:
+                            pass
                     err = _build()
                     if err is None:
                         continue

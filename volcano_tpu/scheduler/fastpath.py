@@ -484,7 +484,16 @@ class ArrayMirror:
         """Resident-state-dependent predicates the class system cannot
         express (host ports, pod (anti)affinity, volumes) — node selector,
         node affinity, and tolerations are static and factor into classes,
-        exactly as on the object tensor path (snapshot.py:415-426)."""
+        exactly as on the object tensor path (snapshot.py:415-426).
+
+        Intentional over-approximation vs that path: ANY volume marks the
+        pod dynamic here, while the object builder only excludes jobs
+        whose volumes actually constrain node choice
+        (volume_constrains).  Correctness-safe — over-routing sends more
+        jobs through the exact-host residue sub-cycle — at the cost of
+        fast-path coverage for non-constraining volume types; the two
+        paths' partition_unsafe guards can therefore disagree on the same
+        cluster."""
         spec = pod.spec
         aff = spec.affinity
         return bool(

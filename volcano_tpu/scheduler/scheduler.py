@@ -87,6 +87,9 @@ class Scheduler:
             from volcano_tpu.parallel.sharded import resolve_mesh
 
             self.mesh = resolve_mesh(self.conf.mesh)
+        # background prewarm thread (see prewarm); joinable by callers
+        # that want full determinism before the first timed cycle
+        self.prewarm_background = None
         # array-native fast cycle (fastpath.py): used per cycle whenever the
         # cluster/conf is expressible; object path otherwise
         self.fast_cycle = None
@@ -95,137 +98,286 @@ class Scheduler:
 
             self.fast_cycle = FastCycle(self)
 
-    def prewarm(self, bucket_levels: int = 1) -> float:
+    def prewarm(self, bucket_levels: int = 1,
+                background: bool = True) -> float:
         """Compile the cycle's device solves before the first real cycle.
 
-        Builds a tensor snapshot from the current store contents and runs
-        the allocate solve at that bucketed shape, plus ``bucket_levels``
-        task buckets above it (a cluster crossing a bucket boundary mid-day
-        otherwise stalls scheduling for the length of an XLA compile), and
-        the victim solves for every preempt/reclaim mode the conf enables.
-        Decisions are discarded: no session close, no store writes.  With
-        the persistent compilation cache enabled a restart pays cache
-        deserialization here instead of recompilation inside the cycle.
-        Returns wall-clock seconds spent (0.0 when the backend needs no
-        warm-up)."""
+        The BLOCKING part is time-to-schedulable: device/tunnel handshake
+        (overlapped with the watch mirror's full list sync), the mirror
+        sync itself, and the kernel variants the CURRENT cluster state
+        selects — the allocate variant for the live task bucket, plus the
+        contention storm solves only when the reclaim/preempt prechecks
+        say a storm is possible right now.  Everything else (higher task
+        buckets, the object-fallback victim steps, not-yet-possible storm
+        kernels) deserializes in a daemon thread while the scheduler
+        already runs cycles (``background=False`` blocks for all of it —
+        bench/CI determinism).  Shapes come from the fast cycle's watch
+        mirror when available (vectorized snapshot build — no O(cluster)
+        object session), else from an object-session snapshot.  In
+        ``solveMode: auto`` only the allocate variant a bucket can
+        actually select is warmed: a bucket wholly above
+        ``batch_threshold`` pending tasks can never run the exact solve,
+        one wholly below never runs the batch solve.  Decisions are
+        discarded: no session close, no store writes.  Returns blocking
+        wall-clock seconds (0.0 when the backend needs no warm-up); the
+        background thread is joinable via ``prewarm_background``."""
         if self.conf.backend != "tpu":
             return 0.0
-        from volcano_tpu.scheduler.snapshot import pad_task_bucket
-        from volcano_tpu.scheduler.tensor_actions import jax_allocate_solve
+        import threading
+
         from volcano_tpu.scheduler.tensor_backend import TensorBackend
 
         t0 = time.perf_counter()
-        if self.fast_cycle is not None:
+
+        def _touch_device():
+            try:
+                import jax.numpy as jnp
+
+                jnp.zeros((1,), jnp.float32).block_until_ready()
+            except Exception:  # noqa: BLE001 — surfaces on first real use
+                pass
+
+        # device/tunnel handshake overlaps the host-side mirror sync
+        toucher = threading.Thread(target=_touch_device, daemon=True)
+        toucher.start()
+        fc = self.fast_cycle
+        if fc is not None:
             # the mirror's one-time full list sync belongs to startup, not
             # to the first scheduling cycle
-            self.fast_cycle.sync_mirror()
-        ssn = open_session(self.cache, self.conf.tiers)
-        backend = TensorBackend(
-            ssn,
-            solve_mode=self.conf.solve_mode,
-            flavor="tpu",
-            snapshot_cache=self.snapshot_cache,
-            exact_topk=self.conf.exact_topk,
-            mesh=self.mesh,
-        )
-        if not backend.supported:
-            return 0.0
-        ssn.tensor_backend = backend
-        snap = backend.snapshot()
-        t_now = snap.task_req.shape[0]
-        for level in range(0, bucket_levels + 1):
-            shaped = snap if level == 0 else pad_task_bucket(snap, t_now << level)
-            # warm BOTH solve variants at every shape: the variant a real
-            # cycle picks depends on its live pending count (auto mode flips
-            # at batch_threshold), which can land on either side at any
-            # bucket — a missed variant would stall the cycle on a compile
-            jax_allocate_solve(backend, shaped, n_pending=0)
-            if backend.solve_mode != "exact":
-                jax_allocate_solve(
-                    backend, shaped, n_pending=backend.batch_threshold + 1
-                )
-
-        if {"preempt", "reclaim"} & set(self.conf.actions) and not (
-            snap.has_dynamic_predicates
+            fc.sync_mirror()
+        snap = None
+        aux = None
+        backend = None
+        if (
+            fc is not None
+            and fc.conf_ok
+            and fc.mirror is not None
+            and fc.mirror.ineligible_reason() is None
         ):
-            import jax
-            import jax.numpy as jnp
-
-            from volcano_tpu.scheduler.fast_victims import (
-                contention_static_args,
-            )
-            from volcano_tpu.scheduler.victim_kernels import (
-                preempt_rounds, preempt_solve, reclaim_solve, victim_step,
+            from volcano_tpu.scheduler.fastpath import (
+                _TiersOnly, build_fast_snapshot, build_victim_pool,
             )
 
-            # the same static-variant derivation FastContention uses, so
-            # prewarm can never compile a different jit specialization
-            static = contention_static_args(self.conf, backend)
-            consts, state = backend.victim_arrays()
-            t_req = jnp.asarray(snap.task_req[0])
-            T = snap.task_req.shape[0]
-            J = snap.job_queue.shape[0]
-            Q = snap.queue_alloc_init.shape[0]
-            task_req_d = jnp.asarray(snap.task_req)
-            task_class_d = jnp.asarray(snap.task_class)
-            job_i32 = dict(
-                start=jnp.asarray(snap.job_start.astype("int32")),
-                ntasks=jnp.asarray(snap.job_ntasks.astype("int32")),
-                prio=jnp.asarray(snap.job_priority.astype("int32")),
+            snap, aux = build_fast_snapshot(fc.mirror, fc.nodeaffinity_weight)
+            if snap is not None and aux.get("partition_unsafe"):
+                # every real cycle will take the object path (dynamic job
+                # outranks an express contender): its snapshot includes
+                # the dynamic jobs and can bucket differently — warm THAT
+                snap, aux = None, None
+            if snap is not None:
+                if {"preempt", "reclaim"} & set(self.conf.actions):
+                    build_victim_pool(fc.mirror, snap, aux)
+                backend = TensorBackend(
+                    _TiersOnly(self.conf.tiers),
+                    solve_mode=self.conf.solve_mode,
+                    flavor="tpu",
+                    exact_topk=self.conf.exact_topk,
+                    mesh=self.mesh,
+                )
+                backend._snapshot = snap
+        if snap is None:
+            aux = None
+            # fast path off/ineligible: object-session snapshot (same
+            # bucketed shapes, costlier build)
+            ssn = open_session(self.cache, self.conf.tiers)
+            backend = TensorBackend(
+                ssn,
+                solve_mode=self.conf.solve_mode,
+                flavor="tpu",
+                snapshot_cache=self.snapshot_cache,
+                exact_topk=self.conf.exact_topk,
+                mesh=self.mesh,
             )
-            zJ32 = jnp.zeros((J,), jnp.int32)
-            zJb = jnp.zeros((J,), bool)
-            if "preempt" in self.conf.actions:
-                kw = static["kw_preempt"]
-                for mode in ("queue", "job"):
-                    out = victim_step(
-                        consts, state, t_req, 0, 0, 0, mode=mode,
-                        use_prop=False, **kw
-                    )
-                    jax.block_until_ready(out)
-                # the fast cycle's whole-storm solve at the same shapes
-                # (empty work: jit compiles the loop regardless of trips)
-                out = preempt_solve(
-                    consts, state, task_req_d, task_class_d,
-                    jnp.zeros((T,), bool),
-                    job_i32["start"], job_i32["ntasks"], job_i32["prio"],
-                    zJb, zJ32, jnp.int32(0),
-                    jnp.zeros((Q,), jnp.int32), jnp.int32(0), zJ32,
-                    job_key_order=static["job_key_order"],
-                    gang_pipelined=static["gang_pipelined"],
-                    **kw,
-                )
-                jax.block_until_ready(out)
-                if self.conf.solve_mode != "exact":
-                    # solveMode exact can never dispatch the rounds kernel
-                    # (fast_victims gates on batch/auto) — don't compile it
-                    out = preempt_rounds(
-                        consts, state, task_req_d, task_class_d,
-                        jnp.zeros((T,), jnp.int32), zJ32, zJ32,
-                        job_i32["prio"], zJb, zJ32,
-                        job_key_order=static["job_key_order"],
-                        gang_pipelined=static["gang_pipelined"],
-                        **kw,
-                    )
-                    jax.block_until_ready(out)
-            if "reclaim" in self.conf.actions:
-                kw = static["kw_reclaim"]
-                out = victim_step(
-                    consts, state, t_req, 0, 0, 0, mode="reclaim",
-                    use_drf=False, **kw
-                )
-                jax.block_until_ready(out)
-                out = reclaim_solve(
-                    consts, state, task_req_d, task_class_d,
-                    job_i32["start"], job_i32["prio"], zJb,
-                    jnp.zeros((Q,), bool), zJ32,
-                    has_proportion=static["has_proportion"],
-                    job_key_order=static["job_key_order"],
-                    **kw,
-                )
-                jax.block_until_ready(out)
-        backend.invalidate()
+            if not backend.supported:
+                return 0.0
+            ssn.tensor_backend = backend
+            snap = backend.snapshot()
+        toucher.join()
+        critical, later = self._warm_tasks(backend, snap, aux, bucket_levels)
+        self._run_warm_tasks(critical)
+        if background and later:
+            self.prewarm_background = threading.Thread(
+                target=self._run_warm_tasks, args=(later, True), daemon=True
+            )
+            self.prewarm_background.start()
+        elif later:
+            self._run_warm_tasks(later)
         return time.perf_counter() - t0
+
+    def _run_warm_tasks(self, tasks, swallow: bool = False) -> None:
+        """Run warm thunks on a small pool (XLA compiles release the GIL;
+        persistent-cache deserialization largely serializes internally,
+        the pool still overlaps dispatch/upload time)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if not tasks:
+            return
+        with ThreadPoolExecutor(max_workers=min(8, len(tasks))) as ex:
+            futures = [ex.submit(t) for t in tasks]
+            for f in futures:
+                try:
+                    f.result()
+                except Exception:  # noqa: BLE001
+                    if not swallow:
+                        raise
+                    import logging
+
+                    logging.getLogger("volcano_tpu.scheduler").warning(
+                        "background prewarm task failed", exc_info=True
+                    )
+
+    def _warm_tasks(self, backend, snap, aux, bucket_levels: int):
+        """(critical, background) warm thunk lists — critical is what the
+        first cycle can actually dispatch given the live cluster state."""
+        import jax
+        import jax.numpy as jnp
+
+        from volcano_tpu.scheduler.snapshot import _bucket, pad_task_bucket
+        from volcano_tpu.scheduler.tensor_actions import jax_allocate_solve
+
+        solve_mode = backend.solve_mode
+        thr = backend.batch_threshold
+        t_now = snap.task_req.shape[0]
+        n_pending = int(snap.task_valid.sum())
+        min_bucket = _bucket(1)
+        critical = []
+        later = []
+
+        def exact_reachable(T: int) -> bool:
+            if solve_mode == "batch":
+                return False
+            if solve_mode == "exact":
+                return True
+            lo = T // 2 + 1 if T > min_bucket else 0
+            return lo <= thr  # some pending count at this bucket is exact
+
+        def batch_reachable(T: int) -> bool:
+            if solve_mode == "exact":
+                return False
+            return solve_mode == "batch" or T > thr
+
+        use_batch_now = solve_mode == "batch" or (
+            solve_mode == "auto" and n_pending > thr
+        )
+        for level in range(0, bucket_levels + 1):
+            shaped = (
+                snap if level == 0 else pad_task_bucket(snap, t_now << level)
+            )
+            T_lvl = shaped.task_req.shape[0]
+            if exact_reachable(T_lvl):
+                bucket = critical if (
+                    level == 0 and not use_batch_now
+                ) else later
+                bucket.append(lambda s=shaped: jax_allocate_solve(
+                    backend, s, n_pending=0
+                ))
+            if batch_reachable(T_lvl):
+                bucket = critical if (level == 0 and use_batch_now) else later
+                bucket.append(lambda s=shaped: jax_allocate_solve(
+                    backend, s, n_pending=thr + 1
+                ))
+
+        # the fast builder flags dynamic-predicate work through
+        # aux["residue_keys"]/dyn_job rather than has_dynamic_predicates;
+        # either way a dynamic cluster's contention runs the HOST victim
+        # path (no kernels), so storm warming would compile dead weight
+        dynamic = snap.has_dynamic_predicates or bool(
+            aux and aux.get("residue_keys")
+        )
+        if {"preempt", "reclaim"} & set(self.conf.actions) and not dynamic:
+            # storm kernels block startup only when the live state says a
+            # storm can happen in the first cycles (the fast prechecks);
+            # otherwise even their argument UPLOADS defer to background
+            fcyc = self.fast_cycle
+            contention_now = True
+            if aux and fcyc is not None:
+                contention_now = (
+                    ("reclaim" in self.conf.actions
+                     and fcyc._reclaim_possible(snap, aux))
+                    or ("preempt" in self.conf.actions
+                        and fcyc._preempt_possible(snap, aux))
+                )
+
+            def build_storm_tasks():
+                from volcano_tpu.scheduler.fast_victims import (
+                    contention_static_args,
+                )
+                from volcano_tpu.scheduler.victim_kernels import (
+                    preempt_rounds, preempt_solve, reclaim_solve,
+                    victim_step,
+                )
+
+                # the same static-variant derivation FastContention uses,
+                # so prewarm can never compile a different specialization
+                static = contention_static_args(self.conf, backend)
+                consts, state = backend.victim_arrays()
+                t_req = jnp.asarray(snap.task_req[0])
+                T = snap.task_req.shape[0]
+                J = snap.job_queue.shape[0]
+                Q = snap.queue_alloc_init.shape[0]
+                task_req_d = jnp.asarray(snap.task_req)
+                task_class_d = jnp.asarray(snap.task_class)
+                job_i32 = dict(
+                    start=jnp.asarray(snap.job_start.astype("int32")),
+                    ntasks=jnp.asarray(snap.job_ntasks.astype("int32")),
+                    prio=jnp.asarray(snap.job_priority.astype("int32")),
+                )
+                zJ32 = jnp.zeros((J,), jnp.int32)
+                zJb = jnp.zeros((J,), bool)
+                storm, fallback = [], []
+
+                def warm(where, fn, *a, **kw):
+                    where.append(
+                        lambda: jax.block_until_ready(fn(*a, **kw))
+                    )
+
+                if "preempt" in self.conf.actions:
+                    kw = static["kw_preempt"]
+                    for mode in ("queue", "job"):
+                        # victim_step serves the object fallback path —
+                        # never the first fast cycle
+                        warm(fallback, victim_step, consts, state, t_req,
+                             0, 0, 0, mode=mode, use_prop=False, **kw)
+                    # the fast cycle's whole-storm solves at the same
+                    # shapes (empty work: jit compiles the loop anyway)
+                    warm(storm, preempt_solve, consts, state, task_req_d,
+                         task_class_d, jnp.zeros((T,), bool),
+                         job_i32["start"], job_i32["ntasks"],
+                         job_i32["prio"], zJb, zJ32, jnp.int32(0),
+                         jnp.zeros((Q,), jnp.int32), jnp.int32(0), zJ32,
+                         job_key_order=static["job_key_order"],
+                         gang_pipelined=static["gang_pipelined"], **kw)
+                    if self.conf.solve_mode != "exact":
+                        # solveMode exact can never dispatch the rounds
+                        # kernel (fast_victims gates on batch/auto)
+                        warm(storm, preempt_rounds, consts, state,
+                             task_req_d, task_class_d,
+                             jnp.zeros((T,), jnp.int32), zJ32, zJ32,
+                             job_i32["prio"], zJb, zJ32,
+                             job_key_order=static["job_key_order"],
+                             gang_pipelined=static["gang_pipelined"], **kw)
+                if "reclaim" in self.conf.actions:
+                    kw = static["kw_reclaim"]
+                    warm(fallback, victim_step, consts, state, t_req, 0, 0,
+                         0, mode="reclaim", use_drf=False, **kw)
+                    warm(storm, reclaim_solve, consts, state, task_req_d,
+                         task_class_d, job_i32["start"], job_i32["prio"],
+                         zJb, jnp.zeros((Q,), bool), zJ32,
+                         has_proportion=static["has_proportion"],
+                         job_key_order=static["job_key_order"], **kw)
+                return storm, fallback
+
+            if contention_now:
+                storm, fallback = build_storm_tasks()
+                critical.extend(storm)
+                later.extend(fallback)
+            else:
+                def deferred():
+                    storm, fallback = build_storm_tasks()
+                    self._run_warm_tasks(storm + fallback, swallow=True)
+
+                later.append(deferred)
+        return critical, later
 
     @classmethod
     def from_conf_yaml(cls, store: Store, text: str, **kw) -> "Scheduler":
