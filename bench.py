@@ -8,8 +8,8 @@ with vs_baseline = 60 s / seconds (the reference's Go CPU path takes
 >60 s for one allocate cycle at this scale on 16 goroutines; BASELINE.md —
 and that 60 s is the Go path's *solve alone*, not its end-to-end cycle).
 
-`--config N` runs one of the BASELINE configs, `--all` runs all five plus
-the kernel-only cycle (one JSON line each):
+`--config N` runs one of the BASELINE configs, `--all` runs all of them
+plus the kernel-only cycle (one JSON line each):
   1  gang+priority, allocate only (single queue, no fair share)
   2  drf+proportion multi-queue fair share
   3  predicates+nodeorder (per-class node masks + affinity scores)
@@ -17,6 +17,8 @@ the kernel-only cycle (one JSON line each):
   5  end-to-end 5-action pipeline through Scheduler+Store (the default)
   6  contended end-to-end cycle: 100k running x 10k nodes fully occupied
      plus a 2000-task urgent preemption storm through the real Scheduler
+     (a second line, cfg6b, adds one best-effort preemptor to the storm)
+  7  config 5 through the real HTTP apiserver (StoreServer) + RemoteStore
 `--kernel` times the device decision kernel alone over sim arrays.
 
 Configs 1-4 and --kernel are post-compile steady-state kernel solves;
