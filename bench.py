@@ -366,6 +366,7 @@ def config6():
                 "preemptor_tasks": 2000 + n_be,
                 "victims_evicted": evicted,
                 "preemptors_per_sec": int((2000 + n_be) / cycle),
+                "phases_s": _phases_of(sched),
                 "async_drain_s": round(drain, 2),
                 "prewarm_s": round(warm, 1),
                 "prewarm_bg_s": round(warm_bg, 1),
@@ -377,19 +378,19 @@ def config6():
         }))
 
 
-def config5():
-    """THE headline: the full 5-action pipeline (enqueue, reclaim,
-    allocate, backfill, preempt) through the real Scheduler + Store at
-    100k x 10k with best-effort tasks — run_once wall-clock from watch
-    drain through device solve to decision publish (async applier;
-    store-drain time reported separately, the reference's per-bind
-    goroutines have the same asynchrony)."""
-    from volcano_tpu.scheduler.conf import full_conf
+def _phases_of(sched):
+    fc = sched.fast_cycle
+    if fc is None or not fc.phases:
+        return {}
+    return {k: round(v, 4) for k, v in fc.phases.items()}
+
+
+def _e2e_run(store, conf):
+    """One full e2e run: fresh Scheduler on ``store``, prewarm (joined),
+    timed first cycle, async drain, steady cycle.  Returns a stats dict
+    including the fast cycle's per-phase breakdown."""
     from volcano_tpu.scheduler.scheduler import Scheduler
 
-    store = _build_e2e_store()
-    conf = full_conf("tpu")
-    conf.apply_mode = "async"
     sched = Scheduler(store, conf=conf)
     warm = sched.prewarm()
     t1 = time.perf_counter()
@@ -400,6 +401,7 @@ def config5():
     t0 = time.perf_counter()
     sched.run_once()
     publish = time.perf_counter() - t0
+    phases = _phases_of(sched)
     while sched.cache.applier.pending > 0:
         time.sleep(0.005)
     drain = time.perf_counter() - t0 - publish
@@ -410,6 +412,38 @@ def config5():
     t1 = time.perf_counter()
     sched.run_once()
     steady = time.perf_counter() - t1
+    # scalars only: holding the Scheduler (and through it the 100k-pod
+    # store + mirror) across reps would triple the bench's peak memory
+    return {
+        "publish": publish, "phases": phases,
+        "drain": drain, "bound": bound, "steady": steady,
+        "warm": warm, "warm_bg": warm_bg,
+        "fastpath": bool(
+            sched.fast_cycle and sched.fast_cycle.mirror is not None
+        ),
+    }
+
+
+def config5(reps=3):
+    """THE headline: the full 5-action pipeline (enqueue, reclaim,
+    allocate, backfill, preempt) through the real Scheduler + Store at
+    100k x 10k with best-effort tasks — run_once wall-clock from watch
+    drain through device solve to decision publish (async applier;
+    store-drain time reported separately, the reference's per-bind
+    goroutines have the same asynchrony).  Best-of-``reps`` FULL runs
+    (fresh store + fresh Scheduler each; the jit caches persist in
+    process, as they do for a deployed scheduler), same methodology as
+    the kernel configs' min-of-7; the reported phase breakdown is the
+    best run's."""
+    from volcano_tpu.scheduler.conf import full_conf
+
+    conf = full_conf("tpu")
+    conf.apply_mode = "async"
+    runs = []
+    for _ in range(reps):
+        runs.append(_e2e_run(_build_e2e_store(), conf))
+    best = min(runs, key=lambda r: r["publish"])
+    publish = best["publish"]
 
     import jax
 
@@ -419,15 +453,15 @@ def config5():
         "unit": "s",
         "vs_baseline": round(BASELINE_SECONDS / publish, 1),
         "extra": {
-            "pods_bound": bound,
-            "pods_per_sec": int(bound / publish),
-            "async_drain_s": round(drain, 2),
-            "steady_cycle_s": round(steady, 4),
-            "prewarm_s": round(warm, 1),
-            "prewarm_bg_s": round(warm_bg, 1),
-            "path": "fastpath" if (
-                sched.fast_cycle and sched.fast_cycle.mirror is not None
-            ) else "object",
+            "pods_bound": best["bound"],
+            "pods_per_sec": int(best["bound"] / publish),
+            "phases_s": best["phases"],
+            "all_runs_s": [round(r["publish"], 4) for r in runs],
+            "async_drain_s": round(best["drain"], 2),
+            "steady_cycle_s": round(best["steady"], 4),
+            "prewarm_s": round(runs[0]["warm"], 1),
+            "prewarm_bg_s": round(runs[0]["warm_bg"], 1),
+            "path": "fastpath" if best["fastpath"] else "object",
             "actions": ",".join(conf.actions),
             "device": str(jax.devices()[0]),
         },
@@ -491,6 +525,7 @@ def config7():
                 "transport": "http+json (StoreServer / RemoteStore)",
                 "pods_bound": bound,
                 "pods_per_sec": int(bound / publish),
+                "phases_s": _phases_of(sched),
                 "async_drain_s": round(drain, 2),
                 "steady_cycle_s": round(steady, 4),
                 "prewarm_s": round(warm, 1),

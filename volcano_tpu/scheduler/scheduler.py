@@ -486,10 +486,13 @@ class Scheduler:
         ssn = self._open_object_session()
         if residue_keys:
             def in_residue(job):
-                return (
-                    job.pod_group is not None
-                    and job.pod_group.meta.key in residue_keys
-                )
+                if job.pod_group is not None:
+                    return job.pod_group.meta.key in residue_keys
+                # shadow gangs: the session keys them by the same
+                # shadow/{ns}/{owner-or-name} uid the fast mirror uses
+                # (cache.py:542-552), so a plain pod with dynamic
+                # predicates reaches the residue pass too
+                return job.uid in residue_keys
 
             if "allocate" in self.conf.actions:
                 t0 = time.perf_counter()

@@ -183,46 +183,55 @@ class Store:
                         raise PreconditionFailed(
                             f"{kind} {key}: {k} is {got!r}, wanted {expect!r}"
                         )
-            if any("." in k for k in fields):
-                # dotted patches mutate a nested field and republish via
-                # update() (full-clone shadow) — they are control-plane
-                # writes (enqueue admissions, status nudges), never the
-                # 100k-bind hot path the COW fast path below serves
-                for k in fields:
-                    _walk(obj, k)  # validate every path BEFORE mutating
+            # ONE copy-on-write implementation for flat and dotted fields —
+            # a flat name is a one-segment path.  Validate every path
+            # BEFORE mutating: a bad field must not leave earlier fields
+            # silently applied with no event/version.
+            paths = {k: k.split(".") for k in fields}
+            for k in fields:
+                _walk(obj, k)
+            shadow = self._shadow[kind].get(key)
+            if shadow is None or any(p[0] == "meta" for p in paths.values()):
                 for k, v in fields.items():
                     parent, leaf = _walk(obj, k)
                     setattr(parent, leaf, v)
                 return self.update(kind, obj)
-            # validate every name BEFORE mutating: a bad field must not
-            # leave earlier fields silently applied with no event/version
-            for k in fields:
-                if not hasattr(obj, k):
-                    raise AttributeError(f"{kind} has no field {k!r}")
-            shadow = self._shadow[kind].get(key)
-            if shadow is None or "meta" in fields:
-                for k, v in fields.items():
-                    setattr(obj, k, v)
-                return self.update(kind, obj)
+
+            def _leaf(root, parts):
+                for p in parts[:-1]:
+                    root = getattr(root, p)
+                return getattr(root, parts[-1], _MISSING)
+
             if all(
-                getattr(obj, k) == v and getattr(shadow, k, _MISSING) == v
+                _leaf(obj, paths[k]) == v and _leaf(shadow, paths[k]) == v
                 for k, v in fields.items()
             ):
                 return obj  # no-op: quiescence contract (see update())
             from volcano_tpu.api.fastclone import deep_clone
 
             for k, v in fields.items():
-                setattr(obj, k, v)
+                parent, leaf = _walk(obj, k)
+                setattr(parent, leaf, v)
             self._rv += 1
             obj.meta.resource_version = self._rv
-            # copy-on-write shadow: unpatched fields share the old shadow's
-            # (immutable-by-contract) values; the queued Event keeps the old
-            # shadow object untouched as its pre-update view
+            # copy-on-write shadow: path hops are shallow-copied, so
+            # unpatched fields/siblings share the old shadow's
+            # (immutable-by-contract) values; the queued Event keeps the
+            # old shadow object untouched as its pre-update view.  Full
+            # update() here (a deep_clone + recursive __eq__ per write)
+            # measured 75% of drain time at 100k binds/cycle and ~0.2 s of
+            # the timed cycle for a 5k-group bulk enqueue shipping.
             new_shadow = copy.copy(shadow)
             new_shadow.meta = copy.copy(shadow.meta)
             new_shadow.meta.resource_version = self._rv
             for k, v in fields.items():
-                setattr(new_shadow, k, deep_clone(v))
+                parts = paths[k]
+                cur = new_shadow
+                for p in parts[:-1]:
+                    child = copy.copy(getattr(cur, p))
+                    setattr(cur, p, child)
+                    cur = child
+                setattr(cur, parts[-1], deep_clone(v))
             ev = Event(kind, EventType.UPDATED, obj, shadow)
             for q in self._watchers[kind]:
                 q.append(ev)
