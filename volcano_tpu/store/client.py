@@ -152,11 +152,80 @@ class RemoteStore:
             raise RemoteStoreError(self._err(code, body))
         return decode_object(kind, body["object"])
 
+    #: minimum consecutive same-shape patches worth a columnar op
+    _COL_MIN_RUN = 16
+    _COL_SCALARS = (str, int, float, bool, type(None))
+
+    @classmethod
+    def _compress_patch_runs(cls, wire: List[dict]) -> List[dict]:
+        """Collapse runs of same-shape scalar-valued patch ops into ONE
+        columnar ``patch_col`` op — keys + per-field value columns (or a
+        single const for all-equal columns).  A cycle's bind batch
+        ({"node_name": host} x 100k) and the bulk enqueue shipping (5k
+        identical conditional phase flips) shrink to a keys array plus a
+        column/const, cutting both wire bytes and the server's per-op
+        dispatch.  Object-valued patches (whole status writes) stay per-op
+        so the server never shares one decoded object across rows."""
+        out: List[dict] = []
+        i, n = 0, len(wire)
+        while i < n:
+            w = wire[i]
+            fields = w.get("fields")
+            if w["op"] != "patch" or not fields or not all(
+                isinstance(v, cls._COL_SCALARS) for v in fields.values()
+            ):
+                out.append(w)
+                i += 1
+                continue
+            names = tuple(sorted(fields))
+            when = w.get("when")
+            run = [w]
+            j = i + 1
+            while j < n:
+                x = wire[j]
+                xf = x.get("fields")
+                if (
+                    x["op"] != "patch" or x["kind"] != w["kind"]
+                    or not xf or tuple(sorted(xf)) != names
+                    or x.get("when") != when
+                    or not all(
+                        isinstance(v, cls._COL_SCALARS) for v in xf.values()
+                    )
+                ):
+                    break
+                run.append(x)
+                j += 1
+            if len(run) >= cls._COL_MIN_RUN:
+                cols: Dict[str, list] = {}
+                const: Dict[str, Any] = {}
+                for f in names:
+                    vals = [x["fields"][f] for x in run]
+                    if all(v == vals[0] for v in vals):
+                        const[f] = vals[0]
+                    else:
+                        cols[f] = vals
+                cop: Dict[str, Any] = {
+                    "op": "patch_col", "kind": w["kind"],
+                    "keys": [x["key"] for x in run],
+                }
+                if cols:
+                    cop["columns"] = cols
+                if const:
+                    cop["const"] = const
+                if when is not None:
+                    cop["when"] = when
+                out.append(cop)
+            else:
+                out.extend(run)
+            i = j
+        return out
+
     def bulk(self, ops: List[Dict[str, Any]]) -> List[Optional[str]]:
         """Store.bulk over the wire: ONE round trip for N mutations (async
         decision application batches a cycle's binds/evicts through this).
-        Ops carry live objects; they are encoded here. Returns one error
-        string (or None) per op, like Store.bulk."""
+        Ops carry live objects; they are encoded here, and homogeneous
+        patch runs ship columnar (see _compress_patch_runs). Returns one
+        error string (or None) per op, like Store.bulk."""
         wire = []
         for op in ops:
             w = {"op": op["op"], "kind": op["kind"]}
@@ -171,11 +240,24 @@ class RemoteStore:
             if "cas" in op:
                 w["cas"] = op["cas"]
             wire.append(w)
+        wire = self._compress_patch_runs(wire)
         code, body = self._request("POST", "/bulk", {"ops": wire})
         if code != 200:
             raise RemoteStoreError(self._err(code, body))
-        results = body.get("results") or []
-        if len(results) != len(ops):
+        raw = body.get("results") or []
+        results: List[Optional[str]] = []
+        for w, r in zip(wire, raw):
+            if w["op"] == "patch_col":
+                if isinstance(r, list):
+                    results.extend(r)  # per-key result list
+                else:
+                    # op-level failure (malformed const/when): one error
+                    # string for the whole run — replicate per key, never
+                    # iterate the string itself
+                    results.extend([r] * len(w["keys"]))
+            else:
+                results.append(r)
+        if len(raw) != len(wire) or len(results) != len(ops):
             raise RemoteStoreError(
                 f"bulk returned {len(results)} results for {len(ops)} ops"
             )
@@ -216,6 +298,15 @@ class RemoteStore:
         if code != 200:
             raise RemoteStoreError(self._err(code, body))
         return body["next"]
+
+    @property
+    def uid(self) -> Optional[str]:
+        """The backing store's lineage id (Store.uid over the wire) — used
+        by the mirror checkpoint to reject foreign-store restores."""
+        code, body = self._request("GET", "/healthz")
+        if code != 200:
+            raise RemoteStoreError(self._err(code, body))
+        return body.get("uid")
 
     # -- watch -----------------------------------------------------------------
 

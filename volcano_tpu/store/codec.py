@@ -70,17 +70,29 @@ def _hints(cls: type) -> Dict[str, Any]:
 
 # -- encode ------------------------------------------------------------------
 
+#: per-class field-name tuples (dataclasses.fields() re-derives the list on
+#: every call — at 100k objects/cycle through the event log that was the
+#: single hottest line of the whole HTTP path)
+_fields_cache: Dict[type, Tuple[str, ...]] = {}
+#: scalar leaf types that pass through unchanged (str enums are handled
+#: first — their value IS the wire form)
+_SCALARS = (bool, int, float, str)
+
 
 def encode(obj: Any) -> Any:
     """Dataclass tree -> JSON-compatible value. Type-directed on decode, so
-    encode is purely structural."""
-    if obj is None or isinstance(obj, (bool, int, float, str)):
-        # str enums pass through as their value via isinstance(str)
-        if isinstance(obj, enum.Enum):
-            return obj.value
-        return obj
+    encode is purely structural.  Dispatches on exact class via caches —
+    this function dominates the wire path's profile."""
+    if obj is None:
+        return None
+    cls = obj.__class__
+    names = _fields_cache.get(cls)
+    if names is not None:  # cached dataclass: the overwhelmingly common case
+        return {name: encode(getattr(obj, name)) for name in names}
     if isinstance(obj, enum.Enum):
         return obj.value
+    if isinstance(obj, _SCALARS):
+        return obj
     if isinstance(obj, Resource):
         out: Dict[str, Any] = {"cpu": obj.milli_cpu, "mem": obj.memory}
         if obj.scalars:
@@ -89,10 +101,9 @@ def encode(obj: Any) -> Any:
             out["max_task_num"] = obj.max_task_num
         return out
     if dataclasses.is_dataclass(obj):
-        return {
-            f.name: encode(getattr(obj, f.name))
-            for f in dataclasses.fields(obj)
-        }
+        names = tuple(f.name for f in dataclasses.fields(cls))
+        _fields_cache[cls] = names
+        return {name: encode(getattr(obj, name)) for name in names}
     if isinstance(obj, (list, tuple)):
         return [encode(v) for v in obj]
     if isinstance(obj, dict):
@@ -103,51 +114,89 @@ def encode(obj: Any) -> Any:
 # -- decode ------------------------------------------------------------------
 
 
-def decode(tp: Any, data: Any) -> Any:
-    """JSON value -> instance of type hint ``tp``."""
+#: compiled decoder per type hint — decode is the client half of the wire
+#: hot path (a 100k-object list/watch drain calls it per field), so the
+#: origin/args introspection happens once per hint, not once per value
+_decoders: Dict[Any, Any] = {}
+
+
+def _decoder(tp: Any):
+    d = _decoders.get(tp)
+    if d is None:
+        d = _build_decoder(tp)
+        _decoders[tp] = d
+    return d
+
+
+def _build_decoder(tp: Any):
     origin = typing.get_origin(tp)
     if origin is Union:  # Optional[X] and friends
         args = [a for a in typing.get_args(tp) if a is not type(None)]
-        if data is None:
-            return None
-        return decode(args[0], data)
+        inner = _decoder(args[0])
+        return lambda data: None if data is None else inner(data)
     if tp is Any or tp is None:
-        return data
+        return lambda data: data
     if origin in (list, typing.List):
         (item_tp,) = typing.get_args(tp) or (Any,)
-        return [decode(item_tp, v) for v in data or []]
+        item = _decoder(item_tp)
+        return lambda data: [item(v) for v in data or []]
     if origin in (tuple, typing.Tuple):
         args = typing.get_args(tp)
-        if data is None:
-            return None
         if len(args) == 2 and args[1] is Ellipsis:
-            return tuple(decode(args[0], v) for v in data)
+            item = _decoder(args[0])
+            return lambda data: (
+                None if data is None else tuple(item(v) for v in data)
+            )
         if not args:
-            return tuple(data)
-        return tuple(decode(a, v) for a, v in zip(args, data))
+            return lambda data: None if data is None else tuple(data)
+        items = [_decoder(a) for a in args]
+        return lambda data: (
+            None if data is None
+            else tuple(d(v) for d, v in zip(items, data))
+        )
     if origin in (dict, typing.Dict):
         kt, vt = typing.get_args(tp) or (str, Any)
-        return {decode(kt, k): decode(vt, v) for k, v in (data or {}).items()}
+        kd, vd = _decoder(kt), _decoder(vt)
+        return lambda data: {
+            kd(k): vd(v) for k, v in (data or {}).items()
+        }
     if isinstance(tp, type):
         if tp is Resource:
-            return Resource(
+            return lambda data: Resource(
                 milli_cpu=data.get("cpu", 0.0),
                 memory=data.get("mem", 0.0),
                 scalars=data.get("scalars"),
                 max_task_num=data.get("max_task_num"),
             )
         if issubclass(tp, enum.Enum):
-            return tp(data)
+            return tp
         if dataclasses.is_dataclass(tp):
-            hints = _hints(tp)
-            kwargs = {}
-            for f in dataclasses.fields(tp):
-                if f.name in data:
-                    kwargs[f.name] = decode(hints[f.name], data[f.name])
-            return tp(**kwargs)
+            # field plan built lazily on first use so self-referential
+            # dataclass hints cannot recurse during decoder construction
+            plan: list = []
+
+            def dec(data, tp=tp, plan=plan):
+                if not plan:
+                    hints = _hints(tp)
+                    plan.extend(
+                        (f.name, _decoder(hints[f.name]))
+                        for f in dataclasses.fields(tp)
+                    )
+                kwargs = {}
+                for name, d in plan:
+                    if name in data:
+                        kwargs[name] = d(data[name])
+                return tp(**kwargs)
+
+            return dec
         if tp in (int, float, str, bool):
-            return tp(data) if data is not None else data
-    return data
+            return lambda data: tp(data) if data is not None else data
+    return lambda data: data
+
+
+def decode(tp: Any, data: Any) -> Any:
+    """JSON value -> instance of type hint ``tp``."""
+    return _decoder(tp)(data)
 
 
 def encode_object(kind: str, obj: Any) -> Dict[str, Any]:

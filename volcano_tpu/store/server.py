@@ -82,6 +82,17 @@ class StoreServer:
         # re-encode, so steady-state lease renewals don't pay a full-store
         # serialization under the server lock every interval
         self._enc_cache: Dict[str, List[Any]] = {}
+        # per-object encoded cache, maintained by event delta in _pump_log:
+        # list responses and the event log serve from it instead of
+        # re-encoding (memory: one encoded dict per live object, the same
+        # order as the store's own shadow copies)
+        self._obj_enc: Dict[tuple, Dict[str, Any]] = {}
+        # create/update handlers already HOLD the wire encoding of the
+        # object they decoded — they stage it here (meta re-stamped) so
+        # _pump_log seeds the cache without re-encoding; cleared after
+        # every pump (a suppressed no-op write must not leave a stale hint
+        # for the key's next event)
+        self._enc_hints: Dict[tuple, Dict[str, Any]] = {}
         self._saver_stop = threading.Event()
         self._saver: Optional[threading.Thread] = None
         if state_path is not None:
@@ -121,7 +132,9 @@ class StoreServer:
                 q = parse_qs(u.query)
                 parts = [p for p in u.path.split("/") if p]
                 if u.path == "/healthz":
-                    return self._reply(200, {"ok": True})
+                    return self._reply(
+                        200, {"ok": True, "uid": server.store.uid}
+                    )
                 if u.path == "/watch":
                     since = int(q.get("since", ["0"])[0])
                     kinds = set(q.get("kinds", [""])[0].split(",")) - {""}
@@ -130,7 +143,15 @@ class StoreServer:
                 if len(parts) == 2 and parts[0] == "apis":
                     kind = parts[1]
                     with server.lock:
-                        items = [encode(o) for o in server.store.list(kind)]
+                        # drain queued events first: a write that bypassed
+                        # the handlers (direct srv.store seeding) must not
+                        # leave a stale cached encoding in the response
+                        server._pump_log()
+                        enc_of = server._obj_enc
+                        items = [
+                            enc_of.get((kind, o.meta.key)) or encode(o)
+                            for o in server.store.list(kind)
+                        ]
                     return self._reply(200, {"items": items, "seq": server.seq})
                 if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
                     key = q.get("key", [""])[0]
@@ -214,7 +235,8 @@ class StoreServer:
 
     # -- mutations (called from handler threads, locked) ----------------------
 
-    def create(self, kind: str, data: Dict[str, Any], _flush: bool = True):
+    def create(self, kind: str, data: Dict[str, Any], _flush: bool = True,
+               _encode_response: bool = True):
         obj = decode_object(kind, data.get("object", {}))
         if kind == "Job" and self.admission:
             from volcano_tpu.admission import mutate_job, validate_job
@@ -227,13 +249,17 @@ class StoreServer:
             if self.store.get(kind, obj.meta.key) is not None:
                 return 409, {"error": f"{kind} {obj.meta.key} already exists"}
             self.store.create(kind, obj)
+            if kind != "Job":  # admission may have mutated a Job
+                self._stage_enc_hint(kind, obj, data.get("object"))
             self._pump_log()
         if self._sync_persist and _flush:
             # outside self.lock: the saver/shutdown flusher takes
             # _flush_lock before self.lock, so flushing while holding the
             # server lock would be an ABBA deadlock
             self.flush_state()
-        return 201, {"object": encode(obj)}
+        # bulk discards per-op bodies — a full object encode per op was a
+        # third of the server-side cost of a 100k-op batch
+        return 201, {"object": encode(obj)} if _encode_response else {}
 
     def update(self, kind: str, data: Dict[str, Any], expected_rv: Optional[int] = None,
                _flush: bool = True):
@@ -256,13 +282,15 @@ class StoreServer:
                 if not ok:
                     return 422, {"error": msg}
             self.store.update(kind, obj)
+            self._stage_enc_hint(kind, obj, data.get("object"))
             self._pump_log()
         if self._sync_persist and _flush:
             self.flush_state()
         return 200, {"object": encode(obj)}
 
     def patch(self, kind: str, key: str, fields: Dict[str, Any],
-              when: Dict[str, Any] = None, _flush: bool = True):
+              when: Dict[str, Any] = None, _flush: bool = True,
+              _encode_response: bool = True):
         if kind == "Job" and self.admission:
             # spec-freeze admission compares whole objects; field patches
             # would bypass it — Jobs must go through PUT
@@ -282,7 +310,7 @@ class StoreServer:
             self._pump_log()
         if self._sync_persist and _flush:
             self.flush_state()
-        return 200, {"object": encode(obj)}
+        return 200, {"object": encode(obj)} if _encode_response else {}
 
     def bulk(self, ops: List[Dict[str, Any]]) -> List[Optional[str]]:
         """Batched mutations: one HTTP round trip for N ops (the server half
@@ -298,7 +326,8 @@ class StoreServer:
                     kind = op.get("kind", "")
                     if verb == "create":
                         code, payload = self.create(
-                            kind, {"object": op.get("object", {})}, _flush=False
+                            kind, {"object": op.get("object", {})},
+                            _flush=False, _encode_response=False,
                         )
                         ok = code == 201
                     elif verb == "update":
@@ -311,8 +340,14 @@ class StoreServer:
                         code, payload = self.patch(
                             kind, op.get("key", ""), op.get("fields") or {},
                             when=op.get("when"), _flush=False,
+                            _encode_response=False,
                         )
                         ok = code == 200
+                    elif verb == "patch_col":
+                        # columnar patch run (RemoteStore._compress_patch_runs):
+                        # result is a per-key LIST the client re-flattens
+                        results.append(self._patch_col(op))
+                        continue
                     elif verb == "delete":
                         self.store.delete(kind, op.get("key", ""))
                         self._pump_log()
@@ -325,6 +360,44 @@ class StoreServer:
         if self._sync_persist:
             self.flush_state()
         return results
+
+    def _patch_col(self, op: Dict[str, Any]) -> List[Optional[str]]:
+        """Expand one columnar patch op: shared kind/field-shape/when, a
+        keys array, per-field value columns and/or constants.  Field
+        decoders resolve ONCE for the whole run; values are scalars by the
+        client's compression contract (enums decode to immutable members),
+        so no decoded object is ever shared across rows."""
+        from volcano_tpu.store.codec import _decoder, _resolve_hint
+
+        kind = op.get("kind", "")
+        keys = op.get("keys") or []
+        if kind == "Job" and self.admission:
+            return ["patch is not supported on Job; use update"] * len(keys)
+        cols = op.get("columns") or {}
+        const_enc = op.get("const") or {}
+        when = op.get("when")
+        const = decode_fields(kind, const_enc) if const_enc else {}
+        when_dec = decode_fields(kind, when) if when else None
+        cls = KIND_CLASSES.get(kind)
+        col_dec = {}
+        for f in cols:
+            hint = _resolve_hint(cls, f) if cls is not None else None
+            col_dec[f] = _decoder(hint) if hint is not None else (lambda v: v)
+        out: List[Optional[str]] = []
+        with self.lock:
+            for i, key in enumerate(keys):
+                try:
+                    fields = dict(const)
+                    for f, vals in cols.items():
+                        fields[f] = col_dec[f](vals[i])
+                    self.store.patch(kind, key, fields, when=when_dec)
+                    out.append(None)
+                except KeyError as e:
+                    out.append(f"NotFound: {e}")
+                except Exception as e:  # noqa: BLE001 — per-key isolation
+                    out.append(repr(e))
+            self._pump_log()
+        return out
 
     # -- persistence -----------------------------------------------------------
 
@@ -361,6 +434,11 @@ class StoreServer:
         # (leases) and epoch caches stay monotonic across restarts
         self.store._rv = max(self.store._rv, max_rv)
         self.seq = int(data.get("seq", 0))
+        # a restarted server IS the same store lineage: restore the uid so
+        # mirror checkpoints taken before the restart stay valid
+        uid = data.get("store_uid")
+        if uid:
+            self.store.uid = uid
         # note: the reload happens before any watch queue is registered, so
         # the synthetic creations produce no events — clients relist
 
@@ -389,17 +467,89 @@ class StoreServer:
                 for kind in self._dirty_kinds:
                     items = self.store.list(kind)
                     if items:
-                        self._enc_cache[kind] = [encode(o) for o in items]
+                        enc_of = self._obj_enc
+                        self._enc_cache[kind] = [
+                            enc_of.get((kind, o.meta.key)) or encode(o)
+                            for o in items
+                        ]
                     else:
                         self._enc_cache.pop(kind, None)
                 self._dirty_kinds.clear()
-                payload = {"seq": self.seq, "kinds": dict(self._enc_cache)}
+                payload = {"seq": self.seq, "store_uid": self.store.uid,
+                           "kinds": dict(self._enc_cache)}
             import os
 
             tmp = f"{self.state_path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
                 json.dump(payload, f)
             os.replace(tmp, self.state_path)
+
+    def _stage_enc_hint(self, kind: str, obj, wire: Optional[dict]) -> None:
+        """Stage the request's own wire dict as the object's encoding for
+        the imminent pump — the client's encode() output IS the canonical
+        encoding of the decoded object, only the server-stamped meta
+        fields differ.  Must be called under the server lock, after the
+        store verb succeeded and before _pump_log."""
+        if not wire:
+            return
+        enc = dict(wire)
+        meta = dict(enc.get("meta") or {})
+        meta["resource_version"] = obj.meta.resource_version
+        meta["creation_timestamp"] = obj.meta.creation_timestamp
+        meta["uid"] = obj.meta.uid
+        enc["meta"] = meta
+        self._enc_hints[(kind, obj.meta.key)] = enc
+
+    def _encode_event_obj(self, kind: str, ev) -> tuple:
+        """(encoded_obj, encoded_old) for a store event, via the per-object
+        encoded cache.  COW patch events (ev.fields set) apply the field
+        delta onto the cached encoding — path hops shallow-copied, exactly
+        the store's own shadow discipline — instead of re-encoding the full
+        object: the full encode was 70%+ of the server-side cost of a
+        100k-bind drain.  The pre-patch cache entry doubles as the event's
+        ``old`` encoding (it is the shadow's encoding by construction)."""
+        key = ev.obj.meta.key
+        ck = (kind, key)
+        cache = self._obj_enc
+        if ev.type.value == "Deleted":
+            enc = cache.pop(ck, None)
+            if enc is None:
+                enc = encode(ev.obj)
+            return enc, None
+        if ev.fields is not None:
+            enc_old = cache.get(ck)
+            if enc_old is not None:
+                try:
+                    enc = dict(enc_old)
+                    # the patch bumped the resource version on meta
+                    meta = dict(enc["meta"])
+                    meta["resource_version"] = ev.obj.meta.resource_version
+                    enc["meta"] = meta
+                    for k, v in ev.fields.items():
+                        parts = k.split(".")
+                        cur = enc
+                        for p in parts[:-1]:
+                            child = dict(cur[p])
+                            cur[p] = child
+                            cur = child
+                        cur[parts[-1]] = encode(v)
+                except (KeyError, TypeError):
+                    # cached encoding lacks a path hop (e.g. seeded from a
+                    # hand-built client dict omitting an optional subtree):
+                    # fall back to a full re-encode rather than losing the
+                    # event
+                    pass
+                else:
+                    cache[ck] = enc
+                    return enc, enc_old
+        hint = self._enc_hints.pop(ck, None)
+        if hint is not None:
+            enc_old = cache.get(ck)
+            cache[ck] = hint
+            return hint, enc_old
+        enc = encode(ev.obj)
+        cache[ck] = enc
+        return enc, encode(ev.old) if ev.old is not None else None
 
     def _pump_log(self) -> None:
         """Drain the store's watch queues into the global ordered log."""
@@ -409,19 +559,24 @@ class StoreServer:
                 ev = q.popleft()
                 self._dirty_kinds.add(kind)
                 self.seq += 1
+                enc_obj, enc_old = self._encode_event_obj(kind, ev)
                 self.log.append(
                     {
                         "seq": self.seq,
                         "kind": kind,
                         "type": ev.type.value,
-                        "object": encode(ev.obj),
-                        "old": encode(ev.old) if ev.old is not None else None,
+                        "object": enc_obj,
+                        "old": enc_old,
                     }
                 )
                 moved = True
         overflow = len(self.log) - LOG_CAP
         if overflow > 0:
             del self.log[:overflow]
+        # unconsumed hints (a no-op write that produced no event) must not
+        # survive to describe some LATER mutation of the key
+        if self._enc_hints:
+            self._enc_hints.clear()
         if moved:
             self.cond.notify_all()
 

@@ -61,6 +61,10 @@ class Event:
     type: EventType
     obj: Any
     old: Any = None
+    #: for COW patch events: the (possibly dotted) field map that was
+    #: applied — lets the store server maintain its encoded-object cache
+    #: by delta instead of re-encoding the full object per bind/patch
+    fields: Any = None
 
 
 class Store:
@@ -72,7 +76,13 @@ class Store:
 
     def __init__(self):
         import threading
+        import uuid
 
+        #: lineage identity: survives pickling (vtctl state) and the store
+        #: server's durable state file, so a mirror checkpoint can tell
+        #: "same store restarted" from "different store with coincidentally
+        #: aligned resource-version counters"
+        self.uid = uuid.uuid4().hex
         self._objects: Dict[str, Dict[str, Any]] = defaultdict(dict)
         # deep-copied last-notified state per object, so Event.old reflects
         # the pre-update object even though callers mutate in place (the
@@ -232,7 +242,7 @@ class Store:
                     setattr(cur, p, child)
                     cur = child
                 setattr(cur, parts[-1], deep_clone(v))
-            ev = Event(kind, EventType.UPDATED, obj, shadow)
+            ev = Event(kind, EventType.UPDATED, obj, shadow, fields=fields)
             for q in self._watchers[kind]:
                 q.append(ev)
             self._shadow[kind][key] = new_shadow
@@ -301,9 +311,13 @@ class Store:
         self._watchers[kind].append(q)
         return q
 
-    def _notify(self, ev: Event) -> None:
-        from volcano_tpu.api.fastclone import deep_clone
+    #: kinds that skip the shadow copy: fire-and-forget records nobody
+    #: diff-suppresses (their rare count-bump patches take the full
+    #: update() path) — a per-bind Scheduled Event otherwise pays a
+    #: deep_clone per create, 100k per cycle drain
+    SHADOWLESS_KINDS = frozenset({"Event"})
 
+    def _notify(self, ev: Event) -> None:
         for q in self._watchers[ev.kind]:
             q.append(ev)
         # shadow every kind (not just watched ones): update() compares
@@ -311,7 +325,9 @@ class Store:
         # deletions must drop the shadow or deleted objects leak forever
         if ev.type == EventType.DELETED:
             self._shadow[ev.kind].pop(ev.obj.meta.key, None)
-        else:
+        elif ev.kind not in self.SHADOWLESS_KINDS:
+            from volcano_tpu.api.fastclone import deep_clone
+
             self._shadow[ev.kind][ev.obj.meta.key] = deep_clone(ev.obj)
 
     def pending_events(self) -> bool:
