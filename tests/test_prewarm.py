@@ -108,3 +108,102 @@ def test_prewarm_queueless_and_empty_cluster_do_not_crash():
     # completely empty store
     sched = Scheduler(Store(), conf=full_conf("tpu"))
     sched.prewarm(bucket_levels=0)
+
+
+def _bigger_store(n_nodes=12, n_jobs=8, tasks=3):
+    pods, pgs = [], []
+    for j in range(n_jobs):
+        pgs.append(build_podgroup(f"pg{j}", min_member=tasks))
+        pods.extend(
+            build_pod(f"p{j}-{t}", group=f"pg{j}", cpu="500m")
+            for t in range(tasks)
+        )
+    return make_store(
+        nodes=[build_node(f"n{i}") for i in range(n_nodes)],
+        podgroups=pgs, pods=pods,
+    )
+
+
+def test_mirror_checkpoint_restore_reconciles_deltas(tmp_path):
+    """Warm restart (VERDICT r4 next #5): a restored mirror + delta
+    reconcile produces the same snapshot as a full list sync, across
+    binds, deletions, additions, and PodGroup updates that happened while
+    the checkpoint was cold."""
+    import numpy as np
+
+    from volcano_tpu.api.types import PodPhase
+    from volcano_tpu.scheduler.fastpath import ArrayMirror, build_fast_snapshot
+
+    store = _bigger_store()
+    m = ArrayMirror(store, "volcano-tpu", "default")
+    m.drain()
+    ckpt = str(tmp_path / "mirror.ckpt")
+    m.save_checkpoint(ckpt)
+
+    # cold-window mutations: a bind, a delete, a new pod, a pg update
+    store.patch("Pod", "default/p0-0", {"node_name": "n0",
+                                        "phase": PodPhase.RUNNING})
+    store.delete("Pod", "default/p1-0")
+    store.create("Pod", build_pod("late", group="pg2", cpu="250m"))
+    store.patch("PodGroup", "default/pg3", {"min_member": 1})
+
+    restored = ArrayMirror(store, "volcano-tpu", "default")
+    assert restored.try_restore_checkpoint(ckpt)
+    fresh = ArrayMirror(store, "volcano-tpu", "default")
+    fresh.drain()
+
+    s1, a1 = build_fast_snapshot(restored)
+    s2, a2 = build_fast_snapshot(fresh)
+    for field in (
+        "node_used", "node_idle", "node_task_count", "task_req", "task_job",
+        "task_valid", "job_queue", "job_min_available", "job_ready_init",
+        "job_schedulable", "job_start", "job_ntasks", "queue_alloc_init",
+        "queue_request",
+    ):
+        np.testing.assert_array_equal(
+            getattr(s1, field), getattr(s2, field), err_msg=field
+        )
+    assert s1.job_uids == s2.job_uids
+    assert a1["pe_rows"].size == a2["pe_rows"].size
+
+
+def test_mirror_checkpoint_rejects_foreign_lineage(tmp_path):
+    """A checkpoint from a different store (younger resource version) or
+    configuration is refused — the caller falls back to a full sync."""
+    from volcano_tpu.scheduler.fastpath import ArrayMirror
+
+    store = _bigger_store()
+    m = ArrayMirror(store, "volcano-tpu", "default")
+    m.drain()
+    ckpt = str(tmp_path / "mirror.ckpt")
+    m.save_checkpoint(ckpt)
+
+    fresh_store = _bigger_store(n_nodes=2, n_jobs=1)  # far fewer writes
+    m2 = ArrayMirror(fresh_store, "volcano-tpu", "default")
+    assert not m2.try_restore_checkpoint(ckpt)
+    m3 = ArrayMirror(store, "other-scheduler", "default")
+    assert not m3.try_restore_checkpoint(ckpt)
+    m4 = ArrayMirror(store, "volcano-tpu", "default")
+    assert not m4.try_restore_checkpoint(str(tmp_path / "missing.ckpt"))
+
+
+def test_scheduler_checkpoint_roundtrip_schedules_identically(tmp_path):
+    """Scheduler-level: run a cycle, checkpoint, restart with
+    mirrorCheckpoint configured — the restarted scheduler restores (no
+    full ingest), then schedules new work exactly like a fresh one."""
+    conf = full_conf("tpu")
+    conf.mirror_checkpoint = str(tmp_path / "m.ckpt")
+    store = _bigger_store()
+    sched = Scheduler(store, conf=conf)
+    sched.prewarm()
+    sched.run_once()
+    assert sched.save_mirror_checkpoint()
+
+    store.create("PodGroup", build_podgroup("fresh", min_member=1))
+    store.create("Pod", build_pod("fresh-0", group="fresh", cpu="250m"))
+
+    sched2 = Scheduler(store, conf=conf)
+    sched2.prewarm()
+    assert sched2.fast_cycle.restored_from_checkpoint
+    sched2.run_once()
+    assert ("default/fresh-0" in dict(sched2.cache.bind_log))

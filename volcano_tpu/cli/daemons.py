@@ -155,6 +155,12 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
         # binds are one bulk round trip off the critical path (a conf file
         # can still pin applyMode: sync)
         conf.apply_mode = "async"
+    if conf.mirror_checkpoint is None:
+        # env opt-in for deployments without a conf file (the systemd
+        # unit's stable identity makes the path restart-stable)
+        ckpt_env = os.environ.get("VOLCANO_TPU_MIRROR_CKPT")
+        if ckpt_env:
+            conf.mirror_checkpoint = ckpt_env
     ident = identity or f"scheduler-{os.getpid()}"
     if conf.backend == "tpu":
         from volcano_tpu.scheduler.scheduler import (
@@ -188,6 +194,7 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
         announce(f"metrics on http://127.0.0.1:{ms.port}/metrics", flush=True)
     transient = _transient_errors()
     down = False
+    cycles = 0
     while True:
         t0 = time.monotonic()
         try:
@@ -200,6 +207,16 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
                 announce(f"scheduler {ident}: store unavailable ({e}); retrying",
                          flush=True)
                 down = True
+        cycles += 1
+        if sched.conf.mirror_checkpoint and cycles % 30 == 0:
+            # periodic mirror checkpoint (between cycles = consistent
+            # state; skipped internally while async decisions are in
+            # flight) so a crash-restart still delta-reconciles
+            try:
+                sched.save_mirror_checkpoint()
+            except Exception as e:  # noqa: BLE001 — never kill the loop
+                announce(f"scheduler {ident}: mirror checkpoint failed: {e}",
+                         flush=True)
         time.sleep(max(0.0, period - (time.monotonic() - t0)))
 
 

@@ -66,6 +66,12 @@ class SchedulerConf:
     # stays single-device — scalar while-loop steps gain nothing from
     # SPMD — so mesh implies the batched variants wherever they exist.
     mesh: str = "off"
+    # persisted mirror checkpoint path: a restarted scheduler restores
+    # the watch mirror's row tables and delta-reconciles by per-object
+    # resource version instead of re-ingesting the whole cluster — the
+    # warm-restart analogue of resuming an informer cache
+    # (WaitForCacheSync, reference cache.go:303-329).  None = full list.
+    mirror_checkpoint: Optional[str] = None
 
 
 def default_conf(backend: str = "host") -> SchedulerConf:
@@ -150,6 +156,9 @@ def load_conf(text: str) -> SchedulerConf:
                 f"mesh must be 'off', 'auto' or a device count, got {mesh!r}"
             )
         conf.mesh = mesh
+    if "mirrorCheckpoint" in data:
+        raw = data["mirrorCheckpoint"]
+        conf.mirror_checkpoint = str(raw) if raw else None
     if "fastPath" in data:
         mode = str(data["fastPath"])
         if mode not in ("auto", "off"):

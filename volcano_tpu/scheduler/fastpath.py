@@ -193,10 +193,14 @@ class ArrayMirror:
         self.p_best_effort = np.zeros((0,), bool)
         self.p_live = np.zeros((0,), bool)
         self.p_rank = np.zeros((0,), np.int64)          # arrival order
+        self.p_rv = np.zeros((0,), np.int64)            # resource_version
         # resident-state predicates (host ports, pod (anti)affinity,
         # volumes): the pod's JOB is partitioned out of the array solve
-        # and host-solved in the residue sub-cycle
+        # and host-solved in the residue sub-cycle — UNLESS every dynamic
+        # predicate on the job's pending pods is port/selector-expressible
+        # (p_dyn_expr), in which case the device dynamic solve serves it
         self.p_dynamic = np.zeros((0,), bool)
+        self.p_dyn_expr = np.zeros((0,), bool)
         # conformance veto (plugins/conformance.py): False for
         # system-critical / kube-system pods — victim pool input for the
         # fast preempt/reclaim passes (fast_victims.py)
@@ -207,6 +211,7 @@ class ArrayMirror:
         self.n_alloc = np.zeros((0, R), np.float32)
         self.n_max_tasks = np.zeros((0,), np.int32)
         self.n_live = np.zeros((0,), bool)
+        self.n_rv = np.zeros((0,), np.int64)            # resource_version
         self.node_objs: List[Optional[object]] = []  # row -> Node object
 
         # static predicate classes (snapshot.py's factorization): pods
@@ -260,6 +265,28 @@ class ArrayMirror:
         self.unlinked_pods: Set[str] = set()
         self._waiting_on_group: Dict[str, Set[str]] = {}
         self._pod_wait_group: Dict[str, str] = {}
+
+        # -- interned host-ports + pod-(anti)affinity selectors (SURVEY
+        # §7c: label interning + bitset intersections).  Ports and
+        # exact-match selectors intern to bit positions; per-pod bitset
+        # rows and per-(node, bit) resident counts keep the node-level
+        # masks O(changes).  Sound under partial interning: a port/selector
+        # a PENDING pod needs always interns (or the pod stays
+        # residue-dynamic), and any bit shared between a pending pod and a
+        # resident is the same bit.
+        self.PW = 4   # u32 words -> 128 distinct host ports
+        self.SW = 2   # u32 words -> 64 distinct affinity selectors
+        self.port_ids: Dict[int, int] = {}
+        self.sel_ids: Dict[frozenset, int] = {}
+        self.p_ports = np.zeros((0, self.PW), np.uint32)    # own host ports
+        self.p_selmatch = np.zeros((0, self.SW), np.uint32)  # labels satisfy
+        self.p_aff_req = np.zeros((0, self.SW), np.uint32)   # required terms
+        self.p_aff_anti = np.zeros((0, self.SW), np.uint32)  # anti terms
+        #: node row whose resident counts currently include this pod (-1)
+        self.p_contrib_node = np.zeros((0,), np.int32)
+        self.p_labels: List[Optional[dict]] = []   # row -> pod labels
+        self.n_port_cnt = np.zeros((0, 32 * self.PW), np.int16)
+        self.n_sel_cnt = np.zeros((0, 32 * self.SW), np.int16)
 
         self.queues = _Rows()
         self.q_weight = np.zeros((0,), np.float32)
@@ -402,15 +429,25 @@ class ArrayMirror:
 
     def _on_node(self, node) -> None:
         row, new = self.nodes.acquire(node.meta.name)
-        if new:
-            retired = self._retired_node_rows.pop(node.meta.name, None)
-            if retired:
-                stale = np.isin(self.p_node, np.asarray(retired, np.int32))
-                self.p_node[stale & self.p_live] = row
         n = row + 1
         self.n_alloc = _grow(self.n_alloc, n)
         self.n_max_tasks = _grow(self.n_max_tasks, n)
         self.n_live = _grow(self.n_live, n)
+        self.n_rv = _grow(self.n_rv, n)
+        self.n_port_cnt = _grow(self.n_port_cnt, n)
+        self.n_sel_cnt = _grow(self.n_sel_cnt, n)
+        if new:
+            retired = self._retired_node_rows.pop(node.meta.name, None)
+            if retired:
+                stale = np.isin(self.p_node, np.asarray(retired, np.int32))
+                moved = np.nonzero(stale & self.p_live)[0]
+                self.p_node[moved] = row
+                # their port/selector contributions follow them off the
+                # retired row (which is never served again) onto the reborn
+                # node's counters
+                for prow in moved:
+                    self._sub_contrib(int(prow))
+                    self._add_contrib(int(prow), row)
         while len(self.node_objs) < n:
             self.node_objs.append(None)
         self.n_alloc[row] = 0.0  # updates may drop a scalar dim
@@ -423,17 +460,21 @@ class ArrayMirror:
         )
         self.node_objs[row] = node
         self.n_live[row] = True
+        self.n_rv[row] = node.meta.resource_version
         # labels/taints/conditions may have changed: every class's cell for
         # this node recomputes lazily at next build
         if self.cls_valid.shape[1] > row:
             self.cls_valid[:, row] = False
 
     def _del_node(self, node) -> None:
-        row = self.nodes.release(node.meta.name)
+        self._del_node_key(node.meta.name)
+
+    def _del_node_key(self, name: str) -> None:
+        row = self.nodes.release(name)
         if row is not None:
             self.n_live[row] = False
             self.node_objs[row] = None  # retired rows must not pin objects
-            self._retired_node_rows.setdefault(node.meta.name, []).append(row)
+            self._retired_node_rows.setdefault(name, []).append(row)
 
     def _grow_job_arrays(self, n: int) -> None:
         """Grow every job-axis array to cover row ``n - 1`` — the single
@@ -484,7 +525,10 @@ class ArrayMirror:
                 self.unlinked_pods.discard(pod_key)
 
     def _del_podgroup(self, pg) -> None:
-        row = self.jobs.release(pg.meta.key)
+        self._del_podgroup_key(pg.meta.key)
+
+    def _del_podgroup_key(self, pg_key: str) -> None:
+        row = self.jobs.release(pg_key)
         if row is not None:
             self.j_live[row] = False
             # surviving member pods become shadow jobs on the object path;
@@ -496,7 +540,7 @@ class ArrayMirror:
                 if key is not None:
                     self.p_job[prow] = -1
                     self.unlinked_pods.add(key)
-                    self._set_wait(key, pg.meta.key)
+                    self._set_wait(key, pg_key)
 
     # -- shadow gangs (plain pods / PDBs) ------------------------------------
 
@@ -589,6 +633,77 @@ class ArrayMirror:
                 waiting.discard(pod_key)
                 if not waiting:
                     del self._waiting_on_group[group_key]
+
+    # -- port/selector interning (SURVEY §7c) --------------------------------
+
+    def _intern_port(self, port: int) -> Optional[int]:
+        pid = self.port_ids.get(port)
+        if pid is None:
+            if len(self.port_ids) >= 32 * self.PW:
+                return None  # cap: the pod stays residue-dynamic
+            pid = len(self.port_ids)
+            self.port_ids[port] = pid
+        return pid
+
+    def _intern_selector(self, sel: Dict[str, str]) -> Optional[int]:
+        key = frozenset(sel.items())
+        sid = self.sel_ids.get(key)
+        if sid is None:
+            if len(self.sel_ids) >= 32 * self.SW:
+                return None
+            sid = len(self.sel_ids)
+            self.sel_ids[key] = sid
+            # existing pods' label-match bitsets predate this selector:
+            # backfill the new bit (and resident counts) once — O(P) per
+            # DISTINCT selector ever seen, not per pod
+            self._backfill_selector(key, sid)
+        return sid
+
+    def _backfill_selector(self, sel_items, sid: int) -> None:
+        w, b = divmod(sid, 32)
+        bit = np.uint32(1 << b)
+        P = min(len(self.p_labels), self.p_selmatch.shape[0])
+        for row in np.nonzero(self.p_live[:P])[0]:
+            labels = self.p_labels[row]
+            if labels and all(labels.get(k) == v for k, v in sel_items):
+                self.p_selmatch[row, w] |= bit
+                crow = self.p_contrib_node[row]
+                if crow >= 0:
+                    self.n_sel_cnt[crow, sid] += 1
+
+    @staticmethod
+    def _bit_indices(words) -> List[int]:
+        out = []
+        for w in range(words.shape[0]):
+            word = int(words[w])
+            while word:
+                b = (word & -word).bit_length() - 1
+                out.append(w * 32 + b)
+                word &= word - 1
+        return out
+
+    def _sub_contrib(self, row: int) -> None:
+        """Remove this pod's port/selector bits from its node's resident
+        counts (it left the node, changed, or died)."""
+        crow = int(self.p_contrib_node[row])
+        if crow < 0:
+            return
+        pp = self.p_ports[row]
+        if pp.any():
+            self.n_port_cnt[crow, self._bit_indices(pp)] -= 1
+        ps = self.p_selmatch[row]
+        if ps.any():
+            self.n_sel_cnt[crow, self._bit_indices(ps)] -= 1
+        self.p_contrib_node[row] = -1
+
+    def _add_contrib(self, row: int, crow: int) -> None:
+        pp = self.p_ports[row]
+        if pp.any():
+            self.n_port_cnt[crow, self._bit_indices(pp)] += 1
+        ps = self.p_selmatch[row]
+        if ps.any():
+            self.n_sel_cnt[crow, self._bit_indices(ps)] += 1
+        self.p_contrib_node[row] = crow
 
     @staticmethod
     def _pod_dynamic(pod) -> bool:
@@ -716,12 +831,27 @@ class ArrayMirror:
         self.p_best_effort = _grow(self.p_best_effort, n)
         self.p_live = _grow(self.p_live, n)
         self.p_rank = _grow(self.p_rank, n)
+        self.p_rv = _grow(self.p_rv, n)
         self.p_dynamic = _grow(self.p_dynamic, n)
+        self.p_dyn_expr = _grow(self.p_dyn_expr, n)
         self.p_evictable = _grow(self.p_evictable, n)
         self.p_class = _grow(self.p_class, n)
+        self.p_ports = _grow(self.p_ports, n)
+        self.p_selmatch = _grow(self.p_selmatch, n)
+        self.p_aff_req = _grow(self.p_aff_req, n)
+        self.p_aff_anti = _grow(self.p_aff_anti, n)
+        self.p_contrib_node = _grow(self.p_contrib_node, n)
+        while len(self.p_labels) < n:
+            self.p_labels.append(None)
         if new:
             self.p_rank[row] = self._next_rank
             self._next_rank += 1
+            self.p_contrib_node[row] = -1
+        elif self.p_live[row]:
+            # the old row's port/selector bits leave its node's resident
+            # counts before anything is overwritten (re-added below from
+            # the fresh state; early-return paths resync wholesale)
+            self._sub_contrib(row)
         cid = self._class_id(pod)
         if cid is None:
             return  # class-cap resync re-ingested everything incl. this pod
@@ -779,12 +909,56 @@ class ArrayMirror:
             self._shadow_ref(old_j, -1)
         self.p_best_effort[row] = resreq.is_empty()
         self.p_dynamic[row] = self._pod_dynamic(pod)
+        # port/selector bit rows + expressibility (fills p_ports/p_selmatch/
+        # p_aff_*; labels recorded first so selector backfill sees them)
+        labels = pod.meta.labels or {}
+        self.p_labels[row] = labels
+        spec = pod.spec
+        expr_ok = True
+        pw_row = np.zeros(self.PW, np.uint32)
+        for port in spec.host_ports:
+            pid = self._intern_port(port)
+            if pid is None:
+                expr_ok = False
+            else:
+                pw_row[pid // 32] |= np.uint32(1 << (pid % 32))
+        req_row = np.zeros(self.SW, np.uint32)
+        anti_row = np.zeros(self.SW, np.uint32)
+        aff = spec.affinity
+        if aff is not None:
+            for sel, out_row in (
+                [(s, req_row) for s in aff.pod_affinity]
+                + [(s, anti_row) for s in aff.pod_anti_affinity]
+            ):
+                sid = self._intern_selector(sel)
+                if sid is None:
+                    expr_ok = False
+                else:
+                    out_row[sid // 32] |= np.uint32(1 << (sid % 32))
+        sm_row = np.zeros(self.SW, np.uint32)
+        if self.sel_ids and labels:
+            for sel_items, sid in self.sel_ids.items():
+                if all(labels.get(k) == v for k, v in sel_items):
+                    sm_row[sid // 32] |= np.uint32(1 << (sid % 32))
+        self.p_ports[row] = pw_row
+        self.p_selmatch[row] = sm_row
+        self.p_aff_req[row] = req_row
+        self.p_aff_anti[row] = anti_row
+        # expressible-dynamic: ports/affinity interned, no volumes (the
+        # volume_constrains machinery stays host-side)
+        self.p_dyn_expr[row] = (
+            self.p_dynamic[row] and expr_ok and not pod.volumes
+        )
         self.p_evictable[row] = not (
             pod.spec.priority_class
             in ("system-cluster-critical", "system-node-critical")
             or pod.meta.namespace == "kube-system"
         )
         self.p_live[row] = True
+        self.p_rv[row] = pod.meta.resource_version
+        crow = int(self.p_node[row])
+        if crow >= 0:
+            self._add_contrib(row, crow)
 
     def _drop_pod_row(self, key: str) -> None:
         row = self.pods.release(key)
@@ -792,6 +966,8 @@ class ArrayMirror:
         self._clear_wait(key)
         if row is not None and self.p_live[row]:
             self.p_live[row] = False
+            self._sub_contrib(row)
+            self.p_labels[row] = None
             self._shadow_ref(int(self.p_job[row]), -1)
 
     def _del_pod(self, pod) -> None:
@@ -804,6 +980,152 @@ class ArrayMirror:
             self._drop_pod_row(key)
         else:
             self._on_pod(pod)
+
+    # -- checkpoint (warm-restart prewarm, VERDICT r4 next #5) ---------------
+
+    #: checkpoint format version; bump on any row-table layout change
+    _CKPT_VERSION = 1
+    #: attributes that must not serialize (live handles)
+    _CKPT_SKIP = ("store", "_watches")
+
+    def save_checkpoint(self, path: str) -> None:
+        """Persist the full mirror state (row tables, interning maps,
+        cached objects) + the store's resource version, atomically.  A
+        restarted scheduler restores and DELTA-reconciles instead of
+        re-ingesting 100k objects — the warm-restart analogue of
+        WaitForCacheSync resuming from an informer cache (reference
+        cache.go:303-329)."""
+        import os
+        import pickle
+
+        payload = {
+            "version": self._CKPT_VERSION,
+            "scheduler_name": self.scheduler_name,
+            "default_queue": self.default_queue,
+            "store_rv": self.store.resource_version,
+            "store_uid": getattr(self.store, "uid", None),
+            "state": {
+                k: v for k, v in self.__dict__.items()
+                if k not in self._CKPT_SKIP
+            },
+        }
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+
+    def try_restore_checkpoint(self, path: str) -> bool:
+        """Restore a checkpoint and reconcile against the live store by
+        per-object resource version.  False (and untouched state) when
+        the file is unreadable, from another configuration, or from a
+        different store lineage — the caller falls back to a full sync."""
+        import pickle
+
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+        except Exception:  # noqa: BLE001 — unreadable/corrupt: full sync
+            return False
+        if (
+            payload.get("version") != self._CKPT_VERSION
+            or payload.get("scheduler_name") != self.scheduler_name
+            or payload.get("default_queue") != self.default_queue
+        ):
+            return False
+        try:
+            cur_rv = self.store.resource_version
+            cur_uid = getattr(self.store, "uid", None)
+        except Exception:  # noqa: BLE001 — store unreachable
+            return False
+        ck_uid = payload.get("store_uid")
+        if ck_uid is not None and cur_uid is not None and ck_uid != cur_uid:
+            return False  # different store lineage (rv alignment is luck)
+        if cur_rv < payload.get("store_rv", 0):
+            return False  # younger store: different lineage
+        self.__dict__.update(payload["state"])
+        self._reconcile_store()
+        self._synced = True
+        return True
+
+    def _reconcile_store(self) -> None:
+        """Delta-relist: re-ingest only objects whose resource version
+        moved while the checkpoint was cold, drop vanished ones.  Each
+        ingest is idempotent, so watch events that arrive concurrently
+        (the queues subscribed before this ran) re-apply harmlessly."""
+        store = self.store
+        # low-cardinality kinds: any drift forces the cheap full resync
+        qs = store.list("Queue")
+        q_ok = len(qs) == len(self.queues.key_row)
+        for q in qs:
+            r = self.queues.key_row.get(q.meta.name)
+            q_ok = q_ok and r is not None and bool(self.q_live[r]) and (
+                self.q_weight[r] == q.weight
+            )
+        pcs = {pc.meta.name: pc.value for pc in store.items("PriorityClass")}
+        defp = 0
+        for pc in store.items("PriorityClass"):
+            if getattr(pc, "global_default", False):
+                defp = pc.value
+        if (
+            not q_ok or pcs != self.priority_classes
+            or defp != self.default_priority
+        ):
+            self._resync(dims=self.dims)
+            return
+        seen_n = set()
+        for node in store.items("Node"):
+            seen_n.add(node.meta.name)
+            row = self.nodes.key_row.get(node.meta.name)
+            if (
+                row is None or not self.n_live[row]
+                or self.n_rv[row] != node.meta.resource_version
+            ):
+                self._on_node(node)
+        for name in [k for k in self.nodes.key_row if k not in seen_n]:
+            self._del_node_key(name)
+        seen_g = set()
+        for pg in store.items("PodGroup"):
+            seen_g.add(pg.meta.key)
+            row = self.jobs.key_row.get(pg.meta.key)
+            if (
+                row is None or not self.j_live[row]
+                or self.j_rv[row] != pg.meta.resource_version
+            ):
+                self._on_podgroup(pg)
+        for key in [
+            k for k in self.jobs.key_row
+            if not k.startswith("shadow/") and k not in seen_g
+        ]:
+            self._del_podgroup_key(key)
+        # PDBs: re-apply all, demote budget rows whose budget vanished
+        pdb_rows = set()
+        for pdb in store.items("PodDisruptionBudget"):
+            self._on_pdb(pdb)
+            if pdb.meta.owner is not None:
+                r = self.jobs.key_row.get(
+                    f"shadow/{pdb.meta.namespace}/{pdb.meta.owner[1]}"
+                )
+                if r is not None:
+                    pdb_rows.add(r)
+        for r in np.nonzero(self.j_pdb & self.j_live)[0]:
+            if int(r) not in pdb_rows:
+                self.j_min[r] = 1
+                self.j_pdb[r] = False
+                self._shadow_ref(int(r), 0)
+        seen_p = set()
+        for pod in store.items("Pod"):
+            if pod.spec.scheduler_name != self.scheduler_name:
+                continue
+            key = pod.meta.key
+            seen_p.add(key)
+            row = self.pods.key_row.get(key)
+            if (
+                row is None or not self.p_live[row]
+                or self.p_rv[row] != pod.meta.resource_version
+            ):
+                self._on_pod(pod)
+        for key in [k for k in self.pods.key_row if k not in seen_p]:
+            self._drop_pod_row(key)
 
     # -- eligibility ----------------------------------------------------------
 
@@ -963,6 +1285,161 @@ def build_victim_pool(m: ArrayMirror, snap: TensorSnapshot, aux: dict) -> None:
     aux["run_rows"] = rrows
 
 
+def _pack_u32(bits: np.ndarray) -> np.ndarray:
+    """[n, W*32] bool -> [n, W] u32 bitset words."""
+    n, nbits = bits.shape
+    W = nbits // 32
+    weights = (np.uint64(1) << np.arange(32, dtype=np.uint64))
+    return (
+        (bits.reshape(n, W, 32).astype(np.uint64) * weights)
+        .sum(axis=2).astype(np.uint32)
+    )
+
+
+def _unpack_f32(words: np.ndarray) -> np.ndarray:
+    """[n, W] u32 bitset words -> [n, W*32] f32 0/1 vectors."""
+    n, W = words.shape
+    shifts = np.arange(32, dtype=np.uint32)
+    return (
+        ((words[:, :, None] >> shifts) & 1)
+        .astype(np.float32).reshape(n, W * 32)
+    )
+
+
+def build_dyn_solve_inputs(m: ArrayMirror, snap: TensorSnapshot, aux: dict,
+                           nodeaffinity_weight: float,
+                           task_node, task_kind, be_rows, be_nodes,
+                           ready) -> Optional[dict]:
+    """Device inputs for the dynamic (host-ports / pod-affinity) exact
+    solve: the dyn-expr jobs' pending task arrays, the post-express node/
+    job/queue state, and the resident port/selector bitsets — including
+    the labels of pods the express solve and backfill placed THIS cycle
+    (host parity: the residue pass sees published binds via the overlay).
+    Returns None when no dyn-expr job has pending work."""
+    n_jobs = aux["n_jobs"]
+    nJ = max(n_jobs, 1)
+    pod_j = aux["pod_j"]
+    P = aux["codes"].shape[0]
+    dyn_expr = aux["dyn_expr_job"]
+    de_of_pod = (pod_j >= 0) & dyn_expr[np.clip(pod_j, 0, nJ - 1)]
+    pend = (
+        aux["live"] & (aux["codes"] == _PENDING)
+        & ~m.p_best_effort[:P] & de_of_pod
+    )
+    rows = np.nonzero(pend)[0]
+    if not rows.size:
+        return None
+    rows = rows[np.lexsort(
+        (m.p_rank[rows], -m.p_prio[rows], pod_j[rows])
+    )]
+    N = snap.node_idle.shape[0]
+    R = snap.node_idle.shape[1]
+    J = snap.job_queue.shape[0]
+    job_start = np.zeros(J, np.int32)
+    job_ntasks = np.zeros(J, np.int32)
+    ta = _task_arrays(
+        m, rows, pod_j, n_jobs, N, R, aux["node_rows"],
+        aux["n_nodes"], nodeaffinity_weight, job_start, job_ntasks,
+    )
+    T = ta["task_req"].shape[0]
+
+    # port bitsets / selector match vectors for the dyn tasks (zero rows
+    # for the job's plain pending members — they ride the same solve)
+    S = 32 * m.SW
+
+    def pad(arr):
+        out = np.zeros((T,) + arr.shape[1:], arr.dtype)
+        out[: rows.size] = arr
+        return out
+
+    # port/selector payloads stay PACKED u32 words on the wire to the
+    # device (the solve wrapper unpacks them in-jit): the unpacked
+    # [T, bits] f32/bool forms are ~30 MB at bench scale and the tunnel's
+    # host->device bandwidth (~30 MB/s) made the upload — not the solve —
+    # the dynamic pass's dominant cost
+    task_ports_w = pad(m.p_ports[rows])
+    task_aff_w = pad(m.p_aff_req[rows])
+    task_anti_w = pad(m.p_aff_anti[rows])
+    task_self_w = pad(m.p_selmatch[rows])
+
+    # resident port bits / selector match counts per node + this cycle's
+    # express/backfill placements (counts feed both the feasibility
+    # checks and the interpod affinity score, nodeorder.py:61-74)
+    node_rows_arr = aux["node_rows"]
+    n_live_ct = aux["n_nodes"]
+    node_ports_w = np.zeros((N, m.PW), np.uint32)
+    node_selcnt = np.zeros((N, S), np.int32)
+    if n_live_ct:
+        node_ports_w[:n_live_ct] = _pack_u32(m.n_port_cnt[node_rows_arr] > 0)
+        node_selcnt[:n_live_ct] = m.n_sel_cnt[node_rows_arr]
+    placed = np.nonzero(task_kind > 0)[0]
+    if placed.size:
+        # express pods carry no ports (they would be dynamic) but their
+        # labels can satisfy selectors; most match nothing — skip them
+        pm = m.p_selmatch[aux["pe_rows"][placed]]
+        nz = pm.any(axis=1)
+        if nz.any():
+            np.add.at(
+                node_selcnt, task_node[placed[nz]],
+                _unpack_f32(pm[nz]).astype(np.int32),
+            )
+    if be_rows.size:
+        bm = m.p_selmatch[be_rows]
+        nz = bm.any(axis=1)
+        if nz.any():
+            np.add.at(
+                node_selcnt, be_nodes[nz],
+                _unpack_f32(bm[nz]).astype(np.int32),
+            )
+    node_selcnt = node_selcnt.astype(np.uint16)
+
+    # post-express/backfill node + share state (matches the device state
+    # at the express solve's end; backfilled BE pods add task slots only)
+    idle2 = snap.node_idle.copy()
+    rel2 = snap.node_releasing.copy()
+    used2 = snap.node_used.copy()
+    tc2 = snap.node_task_count.copy()
+    job_alloc2 = snap.job_alloc_init.copy()
+    queue_alloc2 = snap.queue_alloc_init.copy()
+    if placed.size:
+        alloc_rows = placed[task_kind[placed] == 1]
+        pipe_rows = placed[task_kind[placed] == 2]
+        np.subtract.at(
+            idle2, task_node[alloc_rows], snap.task_req[alloc_rows]
+        )
+        np.subtract.at(
+            rel2, task_node[pipe_rows], snap.task_req[pipe_rows]
+        )
+        np.add.at(used2, task_node[placed], snap.task_req[placed])
+        np.add.at(tc2, task_node[placed], 1)
+        np.add.at(job_alloc2, snap.task_job[placed], snap.task_req[placed])
+        np.add.at(
+            queue_alloc2, snap.job_queue[snap.task_job[placed]],
+            snap.task_req[placed],
+        )
+    if be_rows.size:
+        np.add.at(tc2, be_nodes, 1)
+
+    sched_mask = np.zeros(J, bool)
+    sched_mask[:n_jobs] = dyn_expr[:n_jobs]
+    return {
+        "rows": rows,
+        "task_req": ta["task_req"], "task_job": ta["task_job"],
+        "task_class": ta["task_class"], "task_valid": ta["task_valid"],
+        "class_mask": ta["class_mask"], "class_score": ta["class_score"],
+        "job_start": job_start, "job_ntasks": job_ntasks,
+        "job_schedulable": snap.job_schedulable & sched_mask,
+        "job_ready_init": ready.astype(np.int32),
+        "job_alloc_init": job_alloc2,
+        "queue_alloc_init": queue_alloc2,
+        "node_idle": idle2, "node_releasing": rel2, "node_used": used2,
+        "node_task_count": tc2,
+        "node_ports_w": node_ports_w, "node_selcnt": node_selcnt,
+        "task_ports_w": task_ports_w, "task_aff_w": task_aff_w,
+        "task_anti_w": task_anti_w, "task_self_w": task_self_w,
+    }
+
+
 def build_fast_snapshot(
     m: ArrayMirror, nodeaffinity_weight: float = 1.0,
 ) -> Tuple[Optional[TensorSnapshot], dict]:
@@ -1111,16 +1588,33 @@ def build_fast_snapshot(
 
     # -- dynamic-job partition (snapshot.py:414-436) -------------------------
     # a job with any live PENDING resident-state pod (host ports, pod
-    # (anti)affinity, volumes) is excluded WHOLE from the array solve; the
-    # residue sub-cycle host-solves it (within-job task order intact, gang
-    # atomicity preserved).  Resident dynamic pods need no exclusion: their
-    # usage is plain resources and express pods carry no resident-state
-    # predicates of their own.
+    # (anti)affinity, volumes) is excluded WHOLE from the array solve.
+    # Jobs whose dynamic pending pods are ALL port/selector-expressible
+    # and non-best-effort run the DEVICE dynamic solve after the express
+    # pass (dyn_expr_job); the rest go to the host residue sub-cycle
+    # (within-job task order intact, gang atomicity preserved).  Resident
+    # dynamic pods need no exclusion: their usage is plain resources and
+    # express pods carry no resident-state predicates of their own.
     nJ = max(n_jobs, 1)
     dyn_job = np.zeros(nJ, bool)
     dyn_rows = np.nonzero(pend_all & m.p_dynamic[:P])[0]
     if dyn_rows.size and n_jobs:
         dyn_job[np.unique(pod_j[dyn_rows])] = True
+    resid_job = np.zeros(nJ, bool)
+    if dyn_rows.size and n_jobs:
+        # non-expressible (volumes / intern-cap overflow) dynamic pods
+        # force the host path for their whole job
+        nonexpr = dyn_rows[~m.p_dyn_expr[dyn_rows]]
+        if nonexpr.size:
+            resid_job[np.unique(pod_j[nonexpr])] = True
+        # so does ANY pending best-effort pod of a dynamic job: its
+        # backfill needs resident-state predicates and the device dynamic
+        # pass has no backfill stage
+        be_pend = np.nonzero(pend_all & m.p_best_effort[:P])[0]
+        if be_pend.size:
+            be_j = np.unique(pod_j[be_pend])
+            resid_job[be_j[dyn_job[be_j]]] = True
+    dyn_expr_job = dyn_job & ~resid_job
     # job-order safety (snapshot.py:581-586): a dynamic job outranking an
     # express job in its queue would be served AFTER it by the device-first
     # partition — priority inversion under contention; the caller must take
@@ -1244,13 +1738,15 @@ def build_fast_snapshot(
         "pend_nonbe_per_job": pend_nonbe_per_job,
         # dynamic-job partition outputs
         "dyn_job": dyn_job,            # [max(n_jobs,1)] bool
+        "dyn_expr_job": dyn_expr_job,  # device-solvable dynamic jobs
         "partition_unsafe": partition_unsafe,
         # shadow gangs have no store PodGroup: status writes skip them
         "shadow_job": m.j_shadow[job_rows],  # [n_jobs] bool
-
+        # only the non-expressible dynamic jobs still need the host
+        # residue sub-cycle
         "residue_keys": {
             m.jobs.row_key[job_rows[j]]
-            for j in np.nonzero(dyn_job[:n_jobs])[0]
+            for j in np.nonzero(resid_job[:n_jobs])[0]
         },
     }
     return snap, aux
@@ -1307,6 +1803,7 @@ class FastCycle:
             if probe.enabled.get("nodeorder") else 0.0
         )
         self.mirror: Optional[ArrayMirror] = None
+        self.restored_from_checkpoint = False
         # wall-clock seconds per phase of the LAST try_run (drain /
         # snapshot / enqueue / reclaim / solve / backfill / preempt /
         # publish) — the self-diagnosing breakdown bench.py reports so a
@@ -1323,13 +1820,25 @@ class FastCycle:
 
     def sync_mirror(self) -> None:
         """Perform the one-time full list sync (Scheduler.prewarm calls
-        this so the first cycle only pays watch deltas)."""
+        this so the first cycle only pays watch deltas).  With
+        ``mirrorCheckpoint`` configured and a restorable file present,
+        the sync becomes a checkpoint restore + per-object-rv delta
+        reconcile instead of a full re-ingest."""
         if not self.conf_ok:
             return
         if self.mirror is None:
             self.mirror = ArrayMirror(
                 self.store, self.cache.scheduler_name, self.cache.default_queue
             )
+            ckpt = self.conf.mirror_checkpoint
+            if ckpt:
+                import os
+
+                if os.path.exists(ckpt) and (
+                    self.mirror.try_restore_checkpoint(ckpt)
+                ):
+                    self.restored_from_checkpoint = True
+                    return
         self.mirror.drain()
 
     def reset_after_abort(self) -> None:
@@ -1389,15 +1898,22 @@ class FastCycle:
             self._ship_enqueue(m, aux, enq_rows)
             ph["enqueue"] = time.perf_counter() - t
 
+        nJ = max(aux["n_jobs"], 1)
+        dyn_any = bool(aux["dyn_expr_job"][:nJ].any())
         cont = None
         if reclaim_work:
             # array-native reclaim (conf order: after enqueue, before
             # allocate).  Kernel-inexpressible reclaimers — dynamic-
-            # predicate (residue) jobs or empty-request tasks — need the
-            # object walk for the WHOLE cycle; nothing is published yet
-            # (the shipped enqueue admissions are idempotent), so the
-            # object path simply re-runs everything from the store.
-            if aux["residue_keys"] or self._pending_best_effort(m, snap, aux):
+            # predicate jobs (residue or device-solvable: the victim
+            # kernels know nothing of port/selector state) or
+            # empty-request tasks — need the object walk for the WHOLE
+            # cycle; nothing is published yet (the shipped enqueue
+            # admissions are idempotent), so the object path simply
+            # re-runs everything from the store.
+            if (
+                aux["residue_keys"] or dyn_any
+                or self._pending_best_effort(m, snap, aux)
+            ):
                 return False
             t0 = time.perf_counter()
             cont = self._make_contention(snap, aux)
@@ -1411,6 +1927,7 @@ class FastCycle:
             ph["reclaim"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
+        backend = None
         if aux["n_tasks"]:
             from volcano_tpu.scheduler.tensor_actions import jax_allocate_solve
             from volcano_tpu.scheduler.tensor_backend import TensorBackend
@@ -1454,13 +1971,75 @@ class FastCycle:
         pe_rows_solve = aux["pe_rows"]
         task_job_solve = snap.task_job
         task_req_solve = snap.task_req
+
+        # device dynamic pass: dyn-expr jobs (host ports / pod affinity)
+        # run the exact solve with the portsel bitset extension over the
+        # post-express/backfill state, replacing the host residue
+        # sub-cycle for this class (VERDICT r4 missing #1 / SURVEY §7c)
+        dyn_unplaced = False
+        if dyn_any:
+            t0 = time.perf_counter()
+            dyn = build_dyn_solve_inputs(
+                m, snap, aux, self.nodeaffinity_weight,
+                task_node, task_kind, be_rows, be_nodes, ready,
+            )
+            if dyn is not None:
+                from volcano_tpu.scheduler.tensor_actions import (
+                    jax_dynamic_solve,
+                )
+
+                if backend is None:  # no express pending this cycle
+                    from volcano_tpu.scheduler.tensor_backend import (
+                        TensorBackend,
+                    )
+
+                    backend = TensorBackend(
+                        _TiersOnly(self.conf.tiers),
+                        solve_mode=self.conf.solve_mode,
+                        flavor="tpu",
+                        exact_topk=self.conf.exact_topk,
+                        mesh=self.sched.mesh,
+                    )
+                    backend._snapshot = snap
+                d_node, d_kind, d_seq, d_ready = jax_dynamic_solve(
+                    backend, snap, dyn
+                )
+                dyn_unplaced = bool(
+                    (dyn["task_valid"] & (d_kind == 0)).any()
+                )
+                # merge into the publish layout (everything downstream —
+                # binds, per-job counts, fit errors — indexes these).
+                # task arrays are bucket-padded while the row maps are
+                # not: pad each region's row map to its task length so a
+                # dyn task index T_e + i maps to the dyn row map at i
+                # (padding rows have task_kind 0, so -1 is never read)
+                pe_pad = np.full(snap.task_req.shape[0], -1, np.int64)
+                pe_pad[: pe_rows_solve.size] = pe_rows_solve
+                dyn_pad = np.full(dyn["task_req"].shape[0], -1, np.int64)
+                dyn_pad[: dyn["rows"].size] = dyn["rows"]
+                task_node = np.concatenate([task_node, d_node])
+                task_kind = np.concatenate([task_kind, d_kind])
+                pe_rows_solve = np.concatenate([pe_pad, dyn_pad])
+                task_job_solve = np.concatenate(
+                    [task_job_solve, dyn["task_job"]]
+                )
+                task_req_solve = np.concatenate(
+                    [task_req_solve, dyn["task_req"]]
+                )
+                dmask = np.zeros(ready.shape[0], bool)
+                dmask[:aux["n_jobs"]] = aux["dyn_expr_job"][:aux["n_jobs"]]
+                ready = np.where(dmask, d_ready, ready)
+            ph["dyn_solve"] = time.perf_counter() - t0
+
         be_left = self._pending_best_effort(m, snap, aux, minus_placed=be_rows)
         obj_preempt = False
-        if preempt_later and (unplaced or residue or be_left):
-            if residue:
-                # dynamic-predicate preemptors: the object preempt
-                # machinery must run — safe only while the fast contention
-                # state holds nothing unpublished
+        if preempt_later and (unplaced or residue or be_left or dyn_unplaced):
+            if residue or dyn_any:
+                # dynamic-predicate preemptors — or any dyn-expr job in
+                # the cycle (the fast contention state folds only the
+                # express task layout): the object preempt machinery must
+                # run — safe only while the fast contention state holds
+                # nothing unpublished
                 if cont is not None and (cont.evictions or cont.pipelines):
                     return False
                 obj_preempt = True

@@ -129,6 +129,13 @@ class AllocState(NamedTuple):
     task_kind: jnp.ndarray     # [T] i32: 0 none, 1 allocated, 2 pipelined
     task_seq: jnp.ndarray      # [T] i32 placement order
     counter: jnp.ndarray       # scalar i32
+    # resident host-port bit vectors [N, PB] bool and affinity-selector
+    # match COUNTS [N, S] f32 per node ([1, 1] dummies when the portsel
+    # extension is off) — placements fold their own ports/labels in so
+    # later tasks see this cycle's pods, exactly like the host predicates
+    # and interpod score walking node.tasks
+    node_ports: jnp.ndarray
+    node_selcnt: jnp.ndarray
 
 
 def _lex_argmin(mask, keys, index):
@@ -184,6 +191,16 @@ def allocate_solve(
     total, eps,
     # score weights (runtime scalars)
     w_least, w_balanced,
+    # optional resident-state predicate extension (the dynamic solve):
+    # (node_ports [N,PB] bool, task_ports [T,PB] bool,
+    #  node_selcnt [N,S] f32, task_aff_vec [T,S] f32,
+    #  task_anti_vec [T,S] f32, task_self_vec [T,S] f32, w_podaff f32) —
+    # host ports must be disjoint from residents (predicates.go:118);
+    # required selectors need a matching resident, anti selectors none
+    # (:190-205); the selector match counts also contribute the interpod
+    # affinity score term (nodeorder.py:61-74, +1/-1 per resident match,
+    # weighted w_podaff); placements fold their own ports/labels in
+    portsel=None,
     # plugin config (static): job_key_order is the tier-ordered tuple of
     # job-order contributors, e.g. ("priority", "gang", "drf") — mirrors
     # Session.job_order_fn's tier traversal with enable flags applied
@@ -266,6 +283,21 @@ def allocate_solve(
         fit_rel = less_equal(req[None, :], s.releasing, eps) & node_valid
         pred = class_mask[cls] & (s.task_count < node_max_tasks)
         feasible = (fit_idle | fit_rel) & pred
+        if portsel is not None:
+            t_ports = portsel[1][t]     # [PB] bool
+            t_aff = portsel[3][t]       # [S] 1.0 per required selector
+            t_anti = portsel[4][t]
+            matched = s.node_selcnt > 0.5          # [N, S]
+            ports_ok = ~jnp.any(
+                s.node_ports & t_ports[None, :], axis=1
+            )
+            req_ok = jnp.all(
+                matched | (t_aff[None, :] == 0), axis=1
+            )
+            anti_ok = jnp.all(
+                ~matched | (t_anti[None, :] == 0), axis=1
+            )
+            feasible = feasible & ports_ok & req_ok & anti_ok
         any_feasible = jnp.any(feasible)
 
         def drop_job(s):
@@ -279,6 +311,12 @@ def allocate_solve(
             score = _score_nodes(
                 req, s.used, node_alloc, class_score[cls], w_least, w_balanced
             )
+            if portsel is not None:
+                # interpod affinity score: +1 per resident matching a
+                # required selector, -1 per anti match (nodeorder.py:66-73)
+                score = score + portsel[6] * (
+                    s.node_selcnt @ (portsel[3][t] - portsel[4][t])
+                )
             masked = jnp.where(feasible, score, NEG_INF)
             n = jnp.argmax(masked).astype(jnp.int32)
             use_idle = fit_idle[n]
@@ -300,7 +338,7 @@ def allocate_solve(
             exhausted = s.cursor[j] + 1 >= job_ntasks[j]
             next_cur = jnp.where(now_ready | exhausted, jnp.int32(-1), j)
 
-            return s._replace(
+            upd = dict(
                 idle=s.idle.at[n].set(idle2),
                 releasing=s.releasing.at[n].set(rel2),
                 used=s.used.at[n].add(req),
@@ -315,6 +353,15 @@ def allocate_solve(
                 task_seq=s.task_seq.at[t].set(s.counter),
                 counter=s.counter + 1,
             )
+            if portsel is not None:
+                # the placed pod is now resident: its own ports and the
+                # selectors its labels satisfy join the node's state
+                # (host parity: NodeInfo.add_task for pipelined too)
+                upd["node_ports"] = s.node_ports.at[n].set(
+                    s.node_ports[n] | portsel[1][t]
+                )
+                upd["node_selcnt"] = s.node_selcnt.at[n].add(portsel[5][t])
+            return s._replace(**upd)
 
         return jax.lax.cond(any_feasible, place, drop_job, s)
 
@@ -337,6 +384,14 @@ def allocate_solve(
         task_kind=jnp.zeros((T,), jnp.int32),
         task_seq=jnp.full((T,), -1, jnp.int32),
         counter=jnp.int32(0),
+        node_ports=(
+            portsel[0] if portsel is not None
+            else jnp.zeros((1, 1), bool)
+        ),
+        node_selcnt=(
+            portsel[2] if portsel is not None
+            else jnp.zeros((1, 1), jnp.float32)
+        ),
     )
     final = jax.lax.while_loop(cond, body, init)
     return (
@@ -374,6 +429,15 @@ def allocate_solve_batch(
     class_mask, class_score,
     total, eps,
     w_least, w_balanced,
+    # optional resident-state predicate extension, same tuple shape as
+    # allocate_solve's: (node_ports [N,PB] bool, task_ports [T,PB] bool,
+    # node_selcnt [N,S] f32, task_aff_vec/task_anti_vec/task_self_vec
+    # [T,S] f32, w_podaff f32).  Head-task feasibility runs as [M,N]
+    # matmuls; intra-round conflicts (two port-sharing or anti-matching
+    # proposals winning the same node) resolve via a segmented exclusive
+    # cumulative-OR over the node-sorted proposal runs — conservative:
+    # over-rejection retries next round, hard predicates never violate.
+    portsel=None,
     job_key_order=("priority", "gang", "drf"),
     use_gang_ready=True, use_proportion=True,
     m_chunk=512, p_chunk=16, exact_topk=False,
@@ -428,6 +492,8 @@ def allocate_solve_batch(
         task_seq: jnp.ndarray
         round_: jnp.ndarray
         progressed: jnp.ndarray
+        node_ports: jnp.ndarray    # [N, PB] bool ([1,1] when portsel off)
+        node_selcnt: jnp.ndarray   # [N, S] f32
 
     def active_mask(s):
         if use_proportion:
@@ -474,11 +540,31 @@ def allocate_solve_batch(
         fit_r = jnp.all(head_req[:, None, :] < s.releasing[None, :, :] + eps, axis=-1)
         pred = class_mask[head_cls] & (s.task_count < node_max_tasks)[None, :] & node_valid[None, :]
         feasible = (fit_i | fit_r) & pred & sel_active[:, None]
+        if portsel is not None:
+            head_ports = portsel[1][head_t].astype(jnp.float32)  # [M, PB]
+            head_aff = portsel[3][head_t]                        # [M, S]
+            head_anti = portsel[4][head_t]
+            head_self = portsel[5][head_t]
+            matched = (s.node_selcnt > 0.5).astype(jnp.float32)  # [N, S]
+            # matmuls, not [M, N, bits] broadcasts — the intermediate
+            # would be gigabytes at bench scale
+            port_overlap = head_ports @ s.node_ports.astype(
+                jnp.float32).T                                   # [M, N]
+            req_missing = head_aff @ (1.0 - matched).T
+            anti_hit = head_anti @ matched.T
+            feasible = feasible & (port_overlap == 0) & (
+                req_missing == 0) & (anti_hit == 0)
 
         # node scores [M, N] from the head task's request
         score = _score_nodes(
             head_req, s.used, node_alloc, class_score[head_cls], w_least, w_balanced
         )
+        if portsel is not None:
+            # interpod affinity score (nodeorder.py:61-74): resident match
+            # counts weighted +1/-1, frozen within the round
+            score = score + portsel[6] * (
+                (head_aff - head_anti) @ s.node_selcnt.T
+            )
         # deterministic per-(job, node) tie-break jitter. The reference
         # randomizes among equal-score nodes (scheduler_helper.go:100-106);
         # without it, homogeneous clusters make every job propose the same
@@ -545,6 +631,15 @@ def allocate_solve_batch(
         cnt = jnp.where(topk_is_idle, jnp.maximum(cnt, 0.0), 0.0)
         # releasing-fit targets can host exactly one pipelined task
         cnt = jnp.where(topk_feasible & ~topk_is_idle, 1.0, cnt)
+        if portsel is not None:
+            # a head with ports (block-mates share its template ports) or
+            # self-matching anti-affinity can place at most ONE task per
+            # node — force per-target spread
+            spread = (
+                jnp.any(portsel[1][head_t], axis=1)
+                | (jnp.sum(head_anti * head_self, axis=1) > 0)
+            )
+            cnt = jnp.where(spread[:, None], jnp.minimum(cnt, 1.0), cnt)
         cum_cnt = jnp.cumsum(cnt, axis=1)                          # [M, K]
         # task offset p goes to the first target whose cumulative count
         # exceeds p; overflow offsets are invalid this round
@@ -565,6 +660,10 @@ def allocate_solve_batch(
         p_job = fr(jnp.broadcast_to(sel[:, None], (M, P)))
         p_t = fr(t_prop_c)
         rank = jnp.arange(F, dtype=jnp.int32)
+        if portsel is not None:
+            p_ports_b = portsel[1][p_t]                   # [F, PB] bool
+            p_self_b = portsel[5][p_t] > 0                # [F, S]
+            p_anti_b = portsel[4][p_t] > 0
 
         # conflict resolution, capacity-aware: proposals sort by (node,
         # rank); within a node the rank-ordered request prefix-sum must fit
@@ -593,6 +692,37 @@ def allocate_solve_batch(
             & (tc_rows + pos_in_seg < cap_rows)
             & (sn < N)
         )
+        if portsel is not None:
+            # intra-round conflicts within a node's proposal run: my ports
+            # must be disjoint from EVERY earlier proposal's in the run,
+            # and my anti selectors must match none of their labels —
+            # a segmented exclusive cumulative-OR in rank order.
+            # Conservative: the OR accumulates rejected proposals too, so
+            # a conflict with a proposal that itself lost only delays the
+            # later one a round; hard predicates never violate.
+            svals = jnp.concatenate(
+                [p_ports_b[order2], p_self_b[order2]], axis=1
+            )
+
+            def comb(a, b):
+                ra, va = a
+                rb, vb = b
+                return (ra | rb, jnp.where(rb[:, None], vb, va | vb))
+
+            _, incl = jax.lax.associative_scan(
+                comb, (seg_start, svals)
+            )
+            excl = jnp.where(
+                seg_start[:, None], False, jnp.roll(incl, 1, axis=0)
+            )
+            PB = p_ports_b.shape[1]
+            # one-directional like the host predicate (pod_affinity_fits
+            # checks only the INCOMING pod's terms against residents)
+            conflict = (
+                jnp.any(excl[:, :PB] & p_ports_b[order2], axis=1)
+                | jnp.any(excl[:, PB:] & p_anti_b[order2], axis=1)
+            )
+            accept_sorted = accept_sorted & ~conflict
         accept_idle = jnp.zeros((F,), bool).at[order2].set(accept_sorted)
 
         # pipeline proposals: best rank per node, gated on the proposal's
@@ -604,6 +734,13 @@ def allocate_solve_batch(
             jnp.all(p_req < s.releasing[p_node_c] + eps, axis=-1)
             & (s.task_count[p_node_c] < node_max_tasks[p_node_c])
         )
+        if portsel is not None:
+            # proposals carrying ports/anti bits skip the pipe path this
+            # round (pipe wins bypass the idle-run conflict scan); they
+            # retry through idle targets as state updates
+            p_is_pipe = p_is_pipe & ~(
+                jnp.any(p_ports_b, axis=1) | jnp.any(p_anti_b, axis=1)
+            )
         pipe_node = jnp.where(p_is_pipe & pipe_fits, p_node, N)
         best_rank_pipe = jnp.full((N + 1,), F, jnp.int32).at[pipe_node].min(rank)
         win_pipe = (best_rank_pipe[pipe_node] == rank) & p_is_pipe & pipe_fits
@@ -654,6 +791,27 @@ def allocate_solve_batch(
         ts2 = jnp.concatenate([s.task_seq, jnp.zeros((1,), jnp.int32)], 0)
         ts2 = ts2.at[t_tgt].set(seq_val)
 
+        if portsel is not None:
+            PB_ = s.node_ports.shape[1]
+            S_ = s.node_selcnt.shape[1]
+            npo2 = jnp.concatenate(
+                [s.node_ports, jnp.zeros((1, PB_), bool)], 0
+            )
+            # .at[].max on bool == scatter-OR: winners' ports join their
+            # node's resident set
+            npo2 = npo2.at[node_tgt].max(
+                jnp.where(win[:, None], p_ports_b, False)
+            )
+            sc2 = jnp.concatenate(
+                [s.node_selcnt, jnp.zeros((1, S_), jnp.float32)], 0
+            )
+            sc2 = sc2.at[node_tgt].add(
+                jnp.where(win[:, None], portsel[5][p_t], 0.0)
+            )
+        else:
+            npo2 = s.node_ports
+            sc2 = s.node_selcnt
+
         # ---- fixpoint eviction + gang rollback: when no proposal won this
         # round, the lowest-ranked active job is dropped; if it never
         # reached gang readiness its session placements return to the pool.
@@ -674,20 +832,24 @@ def allocate_solve_batch(
             # without gang's JobReady, every placement binds — never unwind
             need_rb = jnp.array(False)
 
-        carry = (idle2, rel2, used2, tc2, ja2, ready2, cursor2, qa2, tn2, tk2, ts2)
+        carry = (idle2, rel2, used2, tc2, ja2, ready2, cursor2, qa2, tn2,
+                 tk2, ts2, npo2, sc2)
 
         def no_rollback(carry):
-            idle2, rel2, used2, tc2, ja2, ready2, cursor2, qa2, tn2, tk2, ts2 = carry
+            (idle2, rel2, used2, tc2, ja2, ready2, cursor2, qa2, tn2, tk2,
+             ts2, npo2, sc2) = carry
             return (
                 idle2[:N], rel2[:N], used2[:N], tc2[:N], ja2[:J], ready2[:J],
                 cursor2[:J], qa2[:Q], tn2[:T], tk2[:T], ts2[:T],
+                npo2[:N], sc2[:N],
             )
 
         def rollback(carry):
             # the [T]-sized unwind: full task_req reads + T-indexed scatters.
             # Branch-guarded because it is the round body's most expensive
             # block and fires only when an unready gang is dropped.
-            idle2, rel2, used2, tc2, ja2, ready2, cursor2, qa2, tn2, tk2, ts2 = carry
+            (idle2, rel2, used2, tc2, ja2, ready2, cursor2, qa2, tn2, tk2,
+             ts2, npo2, sc2) = carry
             rb_job = drop_job_mask & (s.ready < job_min)
             tk_cur = tk2[:T]
             rb_task = rb_job[task_job] & (tk_cur > 0) & task_valid
@@ -702,6 +864,17 @@ def allocate_solve_batch(
             q_rb = jax.ops.segment_sum(
                 rb_req, jnp.where(rb_task, q_of_task, Q), num_segments=Q + 1
             )
+            if portsel is not None:
+                # a rolled-back task's port bits on its node are uniquely
+                # its own (a shared bit could never have co-placed), so
+                # scatter-AND with the complement clears them exactly
+                rb_ports = jnp.where(rb_task[:, None], portsel[1], False)
+                npo3 = npo2.at[rb_tgt].min(~rb_ports)
+                sc3 = sc2.at[rb_tgt].add(
+                    -jnp.where(rb_task[:, None], portsel[5], 0.0)
+                )
+            else:
+                npo3, sc3 = npo2, sc2
             return (
                 idle3[:N], rel3[:N], used3[:N], tc3[:N],
                 jnp.where(rb_job[:, None], job_alloc_init, ja2[:J]),
@@ -711,10 +884,12 @@ def allocate_solve_batch(
                 jnp.where(rb_task, -1, tn2[:T]),
                 jnp.where(rb_task, 0, tk_cur),
                 jnp.where(rb_task, -1, ts2[:T]),
+                npo3[:N], sc3[:N],
             )
 
         (
-            idle3, rel3, used3, tc3, ja3, ready3, cursor3, qa3, tn3, tk3, ts3,
+            idle3, rel3, used3, tc3, ja3, ready3, cursor3, qa3, tn3, tk3,
+            ts3, npo3, sc3,
         ) = jax.lax.cond(need_rb, rollback, no_rollback, carry)
 
         progressed = any_win | do_evict
@@ -724,6 +899,7 @@ def allocate_solve_batch(
             dropped=new_dropped, queue_alloc=qa3,
             task_node=tn3, task_kind=tk3, task_seq=ts3,
             round_=s.round_ + 1, progressed=progressed,
+            node_ports=npo3, node_selcnt=sc3,
         )
 
     init = S(
@@ -735,6 +911,14 @@ def allocate_solve_batch(
         task_kind=jnp.zeros((T,), jnp.int32),
         task_seq=jnp.full((T,), -1, jnp.int32),
         round_=jnp.int32(0), progressed=jnp.array(True),
+        node_ports=(
+            portsel[0] if portsel is not None
+            else jnp.zeros((1, 1), bool)
+        ),
+        node_selcnt=(
+            portsel[2] if portsel is not None
+            else jnp.zeros((1, 1), jnp.float32)
+        ),
     )
     final = jax.lax.while_loop(cond, body, init)
     return (

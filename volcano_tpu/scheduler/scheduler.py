@@ -277,12 +277,42 @@ class Scheduler:
                     backend, s, n_pending=thr + 1
                 ))
 
+        # device dynamic solve (ports/affinity): compiles in the critical
+        # set when the live cluster has dyn-expr work NOW — the first
+        # cycle dispatches it
+        dyn_expr_now = bool(
+            aux is not None
+            and aux.get("dyn_expr_job") is not None
+            and aux["dyn_expr_job"].any()
+        )
+        if dyn_expr_now and self.fast_cycle is not None:
+            import numpy as np
+
+            from volcano_tpu.scheduler.fastpath import build_dyn_solve_inputs
+            from volcano_tpu.scheduler.tensor_actions import jax_dynamic_solve
+
+            fc, warm_snap, warm_aux = self.fast_cycle, snap, aux
+
+            def warm_dyn():
+                T = warm_snap.task_req.shape[0]
+                dyn = build_dyn_solve_inputs(
+                    fc.mirror, warm_snap, warm_aux, fc.nodeaffinity_weight,
+                    np.zeros(T, np.int32), np.zeros(T, np.int32),
+                    np.zeros(0, np.int64), np.zeros(0, np.int32),
+                    warm_snap.job_ready_init,
+                )
+                if dyn is not None:
+                    jax_dynamic_solve(backend, warm_snap, dyn)
+
+            critical.append(warm_dyn)
+
         # the fast builder flags dynamic-predicate work through
-        # aux["residue_keys"]/dyn_job rather than has_dynamic_predicates;
-        # either way a dynamic cluster's contention runs the HOST victim
-        # path (no kernels), so storm warming would compile dead weight
+        # aux["residue_keys"]/dyn_expr_job rather than
+        # has_dynamic_predicates; either way a dynamic cluster's
+        # contention runs the HOST victim path (no kernels), so storm
+        # warming would compile dead weight
         dynamic = snap.has_dynamic_predicates or bool(
-            aux and aux.get("residue_keys")
+            aux and (aux.get("residue_keys") or dyn_expr_now)
         )
         if {"preempt", "reclaim"} & set(self.conf.actions) and not dynamic:
             # storm kernels block startup only when the live state says a
@@ -382,6 +412,20 @@ class Scheduler:
     @classmethod
     def from_conf_yaml(cls, store: Store, text: str, **kw) -> "Scheduler":
         return cls(store, conf=load_conf(text), **kw)
+
+    def save_mirror_checkpoint(self) -> bool:
+        """Persist the fast mirror to ``conf.mirror_checkpoint`` so a
+        restart prewarms from a delta reconcile instead of a full list.
+        Skipped (False) while async decisions are still in flight — the
+        mirror's optimistic rows are store-unconfirmed until the drain."""
+        fc = self.fast_cycle
+        path = self.conf.mirror_checkpoint
+        if fc is None or fc.mirror is None or not path:
+            return False
+        if self.cache.applier is not None and self.cache.applier.pending:
+            return False
+        fc.mirror.save_checkpoint(path)
+        return True
 
     def run_once(self) -> None:
         if self.elector is not None and not self.elector.try_acquire():

@@ -528,6 +528,128 @@ def jax_allocate_solve(backend, snap, n_pending=None):
     )
 
 
+def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
+    """The dynamic (host-ports / pod-(anti)affinity) solve: the allocate
+    kernels with the portsel bitset extension, over the dyn-expr jobs'
+    task arrays and the post-express node state
+    (fastpath.build_dyn_solve_inputs).  Picks the exact sequential kernel
+    or the batched-rounds kernel by the same solve-mode/threshold rule as
+    the express path — a 10k-task dynamic wave at 0.3 ms/sequential-step
+    would alone blow the cycle budget (the r4 storm lesson).  Returns
+    numpy (task_node, task_kind, task_seq, ready) in ONE packed fetch,
+    like jax_allocate_solve."""
+    import jax.numpy as jnp
+
+    from volcano_tpu.scheduler.kernels import (
+        allocate_solve, allocate_solve_batch,
+    )
+
+    if n_pending is None:
+        n_pending = int(dyn["task_valid"].sum())
+    use_batch = backend.solve_mode == "batch" or (
+        backend.solve_mode == "auto" and n_pending > backend.batch_threshold
+    )
+    solve = allocate_solve_batch if use_batch else allocate_solve
+    extra = {"exact_topk": backend.exact_topk} if use_batch else {}
+    deserved = backend.deserved()
+    w_least, w_balanced = backend.score_weights()
+    if backend.enabled.get("nodeorder"):
+        from volcano_tpu.scheduler.conf import get_plugin_arg
+
+        w_podaff = get_plugin_arg(
+            backend.nodeorder_args, "podaffinity.weight", 1.0
+        )
+    else:
+        w_podaff = 0.0
+    dev = backend.to_device
+    # conf mesh: the known node-axis fields shard exactly like the express
+    # solve's (the new portsel node arrays have no named spec and place
+    # single-device; GSPMD reshards as needed)
+    devn = backend.placement_fn(use_batch)
+    statics = dict(
+        job_key_order=backend.job_key_order,
+        use_gang_ready=backend.gang_job_ready,
+        use_proportion=backend.proportion_queue_order,
+        **extra,
+    )
+    key = (solve, "dyn_packed", tuple(sorted(statics.items())))
+    packed = _PACKED_SOLVES.get(key)
+    if packed is None:
+        import jax
+
+        def run(node_ports_w, node_selcnt_u16, task_ports_w, aff_w,
+                anti_w, self_w, w_pa, *args):
+            # port/selector payloads arrive as PACKED u32 words / u16
+            # counts (the tunnel's host->device bandwidth made the
+            # unpacked [T, bits] forms the dominant dynamic-pass cost) —
+            # unpack on device, where it is a trivial fused elementwise op
+            shifts = jnp.arange(32, dtype=jnp.uint32)
+
+            def bits(words, dtype):
+                n = words.shape[0]
+                return (
+                    ((words[:, :, None] >> shifts) & 1)
+                    .astype(dtype).reshape(n, -1)
+                )
+
+            portsel = (
+                bits(node_ports_w, bool), bits(task_ports_w, bool),
+                node_selcnt_u16.astype(jnp.float32),
+                bits(aff_w, jnp.float32), bits(anti_w, jnp.float32),
+                bits(self_w, jnp.float32), w_pa,
+            )
+            o = solve(*args, portsel=portsel, **statics)
+            return jnp.concatenate([
+                o[0].astype(jnp.int32), o[1].astype(jnp.int32),
+                o[2].astype(jnp.int32), o[3].astype(jnp.int32),
+            ])
+
+        packed = jax.jit(run)
+        _PACKED_SOLVES[key] = packed
+    out = packed(
+        dev(dyn["node_ports_w"]),
+        dev(dyn["node_selcnt"]),
+        dev(dyn["task_ports_w"]),
+        dev(dyn["task_aff_w"]),
+        dev(dyn["task_anti_w"]),
+        dev(dyn["task_self_w"]),
+        jnp.float32(w_podaff),
+        devn(dyn["node_idle"], "idle"),
+        devn(dyn["node_releasing"], "releasing"),
+        devn(dyn["node_used"], "used"),
+        devn(snap.node_alloc, "node_alloc"),
+        devn(snap.node_max_tasks, "node_max_tasks"),
+        devn(dyn["node_task_count"], "task_count"),
+        devn(snap.node_valid, "node_valid"),
+        dev(dyn["task_req"]),
+        dev(dyn["task_job"]),
+        dev(dyn["task_class"]),
+        dev(dyn["task_valid"]),
+        dev(snap.job_queue),
+        dev(snap.job_min_available),
+        dev(snap.job_priority),
+        dev(dyn["job_ready_init"]),
+        dev(dyn["job_alloc_init"]),
+        dev(dyn["job_schedulable"]),
+        dev(dyn["job_start"]),
+        dev(dyn["job_ntasks"]),
+        dev(dyn["queue_alloc_init"]),
+        deserved,
+        devn(dyn["class_mask"], "class_mask"),
+        devn(dyn["class_score"], "class_score"),
+        dev(snap.total),
+        dev(snap.eps),
+        jnp.float32(w_least),
+        jnp.float32(w_balanced),
+    )
+    flat = np.asarray(out)
+    T = dyn["task_req"].shape[0]
+    J = snap.job_queue.shape[0]
+    return (
+        flat[:T], flat[T:2 * T], flat[2 * T:3 * T], flat[3 * T:3 * T + J],
+    )
+
+
 def _set_fit_error_fns(ssn, snap, task_node, task_kind, placed) -> None:
     """Attach a lazy fit-error histogram producer to every express job the
     solve left with unplaced pending tasks, so gang's close-time condition
