@@ -2,8 +2,9 @@
 # unit-test -> test, e2e-test-kind -> e2e (simulator), images -> native lib.
 
 PY ?= python
+DOCKER ?= docker
 
-.PHONY: test e2e parity bench native examples install clean
+.PHONY: test e2e parity bench native examples install clean images image image-tpu
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -20,6 +21,17 @@ parity:
 
 bench:
 	$(PY) bench.py
+
+# container images (reference Makefile:40-48 / installer/dockerfile/):
+# `image` = CPU-jax control plane, `image-tpu` = jax[tpu]+libtpu wheel
+# baked in (build needs no TPU; running the scheduler on chips does)
+images: image image-tpu
+
+image:
+	$(DOCKER) build -f installer/Dockerfile -t volcano-tpu .
+
+image-tpu:
+	$(DOCKER) build -f installer/Dockerfile.tpu -t volcano-tpu:tpu .
 
 native: volcano_tpu/native/libvtsolver.so
 
