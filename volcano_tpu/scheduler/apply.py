@@ -244,7 +244,15 @@ class AsyncApplier:
         ev_meta: List[Tuple[tuple, object, bool]] = []  # (idx_key, ev, is_new)
         for (verb, key, arg), err in zip(flat, results):
             if verb == "status":
-                if err is not None:
+                if err is not None and not err.startswith(
+                    "PreconditionFailed"
+                ):
+                    # a conditional op's precondition miss is benign by
+                    # construction — the `when` clause exists precisely so
+                    # a concurrent transition turns the write into a skip
+                    # (the fast cycle's enqueue shipping relies on this;
+                    # recording it would trigger a pointless per-key
+                    # mirror refresh every cycle the race recurs)
                     self.cache._record_err("status", key, RuntimeError(err))
                 continue
             if err is not None:

@@ -484,6 +484,14 @@ class Scheduler:
         if self.fast_cycle is not None and self.fast_cycle.try_run():
             metrics.update_e2e_duration(start)
             return
+        if self.fast_cycle is not None and self.cache.applier is not None:
+            # whole-cycle object fallback: previous fast cycles' async
+            # decisions (binds, status patches, conditional enqueue
+            # admissions) must be IN the store before an object session
+            # snapshots it — otherwise the session reads phases/placements
+            # the mirror already moved past.  The flush is proportionate:
+            # a fallback cycle at scale costs far more than the drain.
+            self.cache.applier.flush(timeout=60.0)
         self.run_object_actions(self.conf.actions)
         metrics.update_e2e_duration(start)
 
