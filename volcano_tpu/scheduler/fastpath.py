@@ -2083,31 +2083,38 @@ class FastCycle:
             # the sub-cycle's close_session reads STORE phases: admissions
             # must land first
             self._ship_enqueue_ops(enq_ops)
-        elif enq_ops:
-            # no store-phase reader this cycle: the conditional patches
-            # ride the async applier (a Precondition miss stays the benign
-            # skip; real failures hit err_log and the mirror refresh)
-            applier = self.cache.applier
-            if applier is not None:
-                applier.submit_ops(enq_ops)
-            else:
-                self._ship_enqueue_ops(enq_ops)
         t = time.perf_counter()
-        evicts, ready_status = self._collect_contention(m, snap, aux, cont)
-        pub_binds = self._publish_and_close(
-            m, snap, aux, task_node, task_kind, ready, be_rows, be_nodes,
-            be_per_job,
-            # the object sub-cycle's close_session owns this cycle's
-            # PodGroup statuses (it sees the complete state incl. residue
-            # placements and preempt pipelines); writing them twice could
-            # land out of order through the async applier
-            write_status=not run_sub,
-            evicts=evicts,
-            ready_status=ready_status,
-            pe_rows_solve=pe_rows_solve,
-            task_job_solve=task_job_solve,
-            task_req_solve=task_req_solve,
-        )
+        try:
+            evicts, ready_status = self._collect_contention(m, snap, aux, cont)
+            pub_binds = self._publish_and_close(
+                m, snap, aux, task_node, task_kind, ready, be_rows, be_nodes,
+                be_per_job,
+                # the object sub-cycle's close_session owns this cycle's
+                # PodGroup statuses (it sees the complete state incl. residue
+                # placements and preempt pipelines); writing them twice could
+                # land out of order through the async applier
+                write_status=not run_sub,
+                evicts=evicts,
+                ready_status=ready_status,
+                pe_rows_solve=pe_rows_solve,
+                task_job_solve=task_job_solve,
+                task_req_solve=task_req_solve,
+            )
+        finally:
+            if not run_sub and enq_ops:
+                # no store-phase reader this cycle: the conditional
+                # patches ride the async applier (a Precondition miss
+                # stays the benign skip; real failures hit err_log and
+                # the mirror refresh) — submitted AFTER publish so the
+                # applier thread's first batch doesn't steal the GIL
+                # inside the measured section, in a finally so a publish
+                # failure can't strand the mirror's optimistic j_phase
+                # flips without their store writes
+                applier = self.cache.applier
+                if applier is not None:
+                    applier.submit_ops(enq_ops)
+                else:
+                    self._ship_enqueue_ops(enq_ops)
         ph["publish"] = time.perf_counter() - t
         if run_sub:
             # the sub-cycle's snapshot must see this cycle's published
