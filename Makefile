@@ -4,10 +4,21 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench native examples install clean images image image-tpu
+.PHONY: test e2e parity bench native examples install clean images image image-tpu lint sanitize
 
-test:
+# vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
+# runs it as a preamble so tier-1 runs can't pass with lint findings
+lint:
+	$(PY) -m volcano_tpu.analysis --json
+
+test: lint
 	$(PY) -m pytest tests/ -q
+
+# the daemons suite with the runtime lock-order sanitizer on: every lock
+# acquisition in the multi-process control plane is order-checked against
+# the acyclic graph the static `lock-order` rule proves (analysis/locksan.py)
+sanitize:
+	VOLCANO_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_daemons.py -q
 
 e2e:
 	$(PY) -m pytest tests/test_e2e_policies.py tests/test_e2e_mpi.py \

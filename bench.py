@@ -1,15 +1,23 @@
 """Benchmarks for the five BASELINE.md target configs.
 
-Default (no arguments): config 5, the headline END-TO-END cycle — the
-real Scheduler + Store running the full 5-action pipeline at 100k tasks x
-10k nodes — prints ONE JSON line
+Default (no arguments): the HEADLINE SUITE — all four headline configs,
+one compact JSON line each, in this order:
+  cfg5   e2e_schedule_cycle_100k_tasks_10k_nodes   (best-of-2 full runs)
+  cfg5d  cfg5d_e2e_cycle_10pct_dynamic_predicates  (1 run)
+  cfg6   cfg6_contended_preempt_storm_100k_x_10k   (storm only, no cfg6b)
+  cfg7   e2e_http_schedule_cycle_100k_tasks_10k_nodes
+so one driver invocation captures the plain, dynamic-predicate,
+contended, and HTTP-process-model numbers (~4 min total on a v5e; a
+failed config prints an {"metric": ..., "error": ...} line and the suite
+continues, rc stays 0).  Each line reports
   {"metric": ..., "value": run_once_seconds, "unit": "s", "vs_baseline": x}
 with vs_baseline = 60 s / seconds (the reference's Go CPU path takes
 >60 s for one allocate cycle at this scale on 16 goroutines; BASELINE.md —
 and that 60 s is the Go path's *solve alone*, not its end-to-end cycle).
 
-`--config N` runs one of the BASELINE configs, `--all` runs all of them
-plus the kernel-only cycle (one JSON line each):
+`--config N` runs one of the BASELINE configs (full methodology:
+best-of-3 for cfg5, storm + best-effort-storm lines for cfg6), `--all`
+runs all of them plus the kernel-only cycle (one JSON line each):
   1  gang+priority, allocate only (single queue, no fair share)
   2  drf+proportion multi-queue fair share
   3  predicates+nodeorder (per-class node masks + affinity scores)
@@ -358,20 +366,23 @@ def _build_contended_store(n_best_effort=0):
     return store
 
 
-def config6():
+def config6(include_best_effort=True):
     """Contended cycle (VERDICT r2 weak #1): the preemption storm at
     100k x 10k through the real Scheduler — run_once wall-clock for the
     full pipeline where preempt actually finds work, array-native.  A
     second line re-runs the storm with one best-effort preemptor mixed in
     (VERDICT r3 next #6): the formerly kernel-inexpressible class must
-    stay array-native instead of paying the O(cluster) object session."""
+    stay array-native instead of paying the O(cluster) object session.
+    The default headline suite passes ``include_best_effort=False`` to
+    emit only the base storm line."""
     from volcano_tpu.scheduler.conf import full_conf
     from volcano_tpu.scheduler.scheduler import Scheduler
 
-    for metric, n_be in (
-        ("cfg6_contended_preempt_storm_100k_x_10k", 0),
-        ("cfg6b_contended_storm_with_best_effort_preemptor", 1),
-    ):
+    variants = [("cfg6_contended_preempt_storm_100k_x_10k", 0)]
+    if include_best_effort:
+        variants.append(
+            ("cfg6b_contended_storm_with_best_effort_preemptor", 1))
+    for metric, n_be in variants:
         store = _build_contended_store(n_best_effort=n_be)
         conf = full_conf("tpu")
         conf.apply_mode = "async"
@@ -510,13 +521,13 @@ def config5(reps=3, dynamic_frac=0.0,
     }))
 
 
-def config5_dynamic():
+def config5_dynamic(reps=3):
     """Config 5 with 10% of the jobs carrying resident-state predicates
     (host-port gangs + self-anti-affinity gangs, ~10k dynamic tasks): the
     device dynamic solve — the allocate kernels' interned port/selector
     bitset extension — serves them after the express pass instead of the
     host residue sub-cycle (VERDICT r4 missing #1).  Target: < 1.5 s."""
-    config5(dynamic_frac=0.10,
+    config5(reps=reps, dynamic_frac=0.10,
             metric="cfg5d_e2e_cycle_10pct_dynamic_predicates")
 
 
@@ -620,13 +631,38 @@ CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config5_dynamic}
 
 
+def default_suite():
+    """The four headline lines in one invocation (cfg5, cfg5d, cfg6,
+    cfg7), time-boxed variants: cfg5 best-of-2 (vs best-of-3 under
+    --config 5), cfg5d/cfg6/cfg7 one run each, no cfg6b.  A failing
+    config emits an error line and the suite continues — the driver's
+    capture must always get all four metrics it can."""
+    suite = (
+        ("e2e_schedule_cycle_100k_tasks_10k_nodes",
+         lambda: config5(reps=2)),
+        ("cfg5d_e2e_cycle_10pct_dynamic_predicates",
+         lambda: config5_dynamic(reps=1)),
+        ("cfg6_contended_preempt_storm_100k_x_10k",
+         lambda: config6(include_best_effort=False)),
+        ("e2e_http_schedule_cycle_100k_tasks_10k_nodes",
+         config7),
+    )
+    for metric, fn in suite:
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — per-config isolation
+            print(json.dumps({"metric": metric, "value": None,
+                              "unit": "s", "error": repr(e)}))
+
+
 def main():
     ap = argparse.ArgumentParser()
     group = ap.add_mutually_exclusive_group()
     group.add_argument("--config", type=int, choices=sorted(CONFIGS))
     group.add_argument("--all", action="store_true")
     group.add_argument("--e2e", action="store_true",
-                       help="alias for --config 5 (the default headline)")
+                       help="alias for --config 5 (the cfg5 headline alone, "
+                            "best-of-3)")
     group.add_argument("--kernel", action="store_true",
                        help="kernel-only solve cycle over sim arrays")
     ns = ap.parse_args()
@@ -644,8 +680,10 @@ def main():
         kernel_cycle()
     elif ns.kernel:
         kernel_cycle()
-    else:
+    elif ns.e2e or ns.config is not None:
         CONFIGS[ns.config or 5]()
+    else:
+        default_suite()
 
 
 if __name__ == "__main__":

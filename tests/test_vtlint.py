@@ -1,0 +1,635 @@
+"""vtlint: the analyzer itself, every rule's fires/near-miss pair, the
+suppression contract, and the zero-findings gate over the real tree.
+
+Tier-1: `python -m volcano_tpu.analysis` must exit 0 on the repo — the
+rules encode the hot-path/parity/concurrency disciplines the kernels
+depend on (ANALYSIS.md), so a finding here is a real regression, not
+style.  Each rule is proven live by a fixture that triggers it and honest
+by a near-miss that must stay quiet.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from volcano_tpu.analysis import all_rules, run_paths
+from volcano_tpu.analysis.core import USAGE_RULE
+
+
+def _lint(tmp_path, relname, source, select=None):
+    path = tmp_path / relname
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return run_paths([str(path)], root=str(tmp_path), select=select)
+
+
+def _rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --- the catalog itself ------------------------------------------------------
+
+
+def test_at_least_eight_rules_registered():
+    rules = all_rules()
+    assert len(rules) >= 8, sorted(rules)
+    for rid, r in rules.items():
+        assert r.description, rid
+
+
+def test_clean_tree_has_zero_findings():
+    """THE gate: the analyzer over the real package tree is clean."""
+    import volcano_tpu
+
+    pkg = os.path.dirname(os.path.abspath(volcano_tpu.__file__))
+    findings = run_paths([pkg], root=os.path.dirname(pkg))
+    assert findings == [], "\n".join(f.human() for f in findings)
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    import json as _json
+
+    bad = tmp_path / "scheduler" / "x.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("def f():\n    try:\n        g()\n    except Exception:\n        pass\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis", "--json",
+         "--root", str(tmp_path), str(bad)],
+        capture_output=True, text=True, env=env,
+    )
+    assert r.returncode == 1, r.stderr
+    report = _json.loads(r.stdout)
+    assert report["count"] == 1
+    assert report["findings"][0]["rule"] == "bare-except"
+    # unknown --select is a usage error, not a vacuous pass
+    r2 = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis",
+         "--select", "no-such-rule", str(bad)],
+        capture_output=True, text=True, env=env,
+    )
+    assert r2.returncode == 2
+    assert "no-such-rule" in r2.stderr
+
+
+# --- rule 1: hotpath-python-loop --------------------------------------------
+
+
+def test_hot_loop_fires(tmp_path):
+    findings = _lint(tmp_path, "kernels.py", """
+        def residue(tasks, nodes):
+            for t in tasks:
+                for n in nodes:
+                    if t[0] < n[0]:
+                        return n
+    """, select=["hotpath-python-loop"])
+    assert _rules_of(findings) == ["hotpath-python-loop"]
+
+
+def test_hot_loop_near_miss_hierarchical_and_non_twin(tmp_path):
+    # a job's OWN tasks: linear, not a product
+    assert _lint(tmp_path, "fastpath.py", """
+        def walk(jobs):
+            total = 0
+            for job in jobs:
+                for t in job.tasks:
+                    total += t
+            return total
+    """, select=["hotpath-python-loop"]) == []
+    # identical product loop OUTSIDE a kernel-twin module: out of scope
+    assert _lint(tmp_path, "helpers.py", """
+        def residue(tasks, nodes):
+            for t in tasks:
+                for n in nodes:
+                    pass
+    """, select=["hotpath-python-loop"]) == []
+
+
+# --- rule 2: hotpath-host-sync ----------------------------------------------
+
+
+def test_host_sync_fires(tmp_path):
+    findings = _lint(tmp_path, "fast_victims.py", """
+        def fetch(out):
+            return out.item()
+    """, select=["hotpath-host-sync"])
+    assert _rules_of(findings) == ["hotpath-host-sync"]
+    # float(name) inside a jit body, any module
+    findings = _lint(tmp_path, "anything.py", """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+    """, select=["hotpath-host-sync"])
+    assert _rules_of(findings) == ["hotpath-host-sync"]
+
+
+def test_host_sync_near_miss(tmp_path):
+    assert _lint(tmp_path, "fast_victims.py", """
+        def fetch(out):
+            return out.sum()
+    """, select=["hotpath-host-sync"]) == []
+
+
+# --- rule 3: hotpath-wallclock ----------------------------------------------
+
+
+def test_wallclock_fires(tmp_path):
+    findings = _lint(tmp_path, "victim_kernels.py", """
+        import time
+
+        def stamp():
+            return time.time()
+    """, select=["hotpath-wallclock"])
+    assert _rules_of(findings) == ["hotpath-wallclock"]
+
+
+def test_wallclock_near_miss_perf_counter(tmp_path):
+    assert _lint(tmp_path, "victim_kernels.py", """
+        import time
+
+        def phase():
+            return time.perf_counter()
+    """, select=["hotpath-wallclock"]) == []
+
+
+# --- rule 4: jit-state-mutation ---------------------------------------------
+
+
+def test_jit_mutation_fires(tmp_path):
+    findings = _lint(tmp_path, "solver.py", """
+        import jax
+
+        acc = []
+
+        @jax.jit
+        def f(x):
+            acc.append(x)
+            return x
+    """, select=["jit-state-mutation"])
+    assert _rules_of(findings) == ["jit-state-mutation"]
+
+
+def test_jit_mutation_near_miss_local(tmp_path):
+    assert _lint(tmp_path, "solver.py", """
+        import jax
+
+        @jax.jit
+        def f(x):
+            tmp = []
+            tmp.append(x)
+            return x
+    """, select=["jit-state-mutation"]) == []
+
+
+# --- rule 5: jit-unkeyed-random ---------------------------------------------
+
+
+def test_jit_random_fires(tmp_path):
+    findings = _lint(tmp_path, "solver.py", """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return x + np.random.rand()
+    """, select=["jit-unkeyed-random"])
+    assert _rules_of(findings) == ["jit-unkeyed-random"]
+
+
+def test_jit_random_near_miss_keyed(tmp_path):
+    assert _lint(tmp_path, "solver.py", """
+        import jax
+
+        @jax.jit
+        def f(key, x):
+            return x + jax.random.uniform(key)
+    """, select=["jit-unkeyed-random"]) == []
+
+
+# --- rule 6: resource-raw-compare -------------------------------------------
+
+
+def test_resource_compare_fires(tmp_path):
+    findings = _lint(tmp_path, "someaction.py", """
+        def fits(task, node):
+            return task.resreq <= node.idle
+    """, select=["resource-raw-compare"])
+    assert _rules_of(findings) == ["resource-raw-compare"]
+    # local taint through Resource()/clone()
+    findings = _lint(tmp_path, "other.py", """
+        def covered(victims, need):
+            total = Resource()
+            for v in victims:
+                total.add(v.resreq)
+            return total == need
+    """, select=["resource-raw-compare"])
+    assert _rules_of(findings) == ["resource-raw-compare"]
+
+
+def test_resource_compare_near_miss(tmp_path):
+    assert _lint(tmp_path, "someaction.py", """
+        def fits(task, node):
+            return task.resreq.less_equal(node.idle)
+    """, select=["resource-raw-compare"]) == []
+    # api/resource.py itself defines the semantics
+    assert _lint(tmp_path, "api/resource.py", """
+        def less_equal(a, b):
+            return a.idle <= b.idle
+    """, select=["resource-raw-compare"]) == []
+
+
+# --- rule 7: parity-citation ------------------------------------------------
+
+
+def test_parity_citation_fires(tmp_path):
+    findings = _lint(tmp_path, "actions/myaction.py", '''
+        """An action with no reference citation anywhere."""
+
+        class MyAction(Action):
+            name = "my"
+
+            def execute(self, ssn):
+                return None
+    ''', select=["parity-citation"])
+    assert "parity-citation" in _rules_of(findings)
+
+
+def test_parity_citation_near_miss(tmp_path):
+    assert _lint(tmp_path, "actions/myaction.py", '''
+        """My action.
+
+        Parity: reference KB/pkg/scheduler/actions/my/my.go:42-128.
+        """
+
+        class MyAction(Action):
+            name = "my"
+
+            def execute(self, ssn):
+                return None
+    ''', select=["parity-citation"]) == []
+
+
+# --- rule 8: session-registry -----------------------------------------------
+
+
+def test_session_registry_fires(tmp_path):
+    findings = _lint(tmp_path, "plugins/myplugin.py", """
+        class MyPlugin(Plugin):
+            name = "my"
+
+            def on_session_open(self, ssn):
+                ssn.add_job_oder_fn(self.name, lambda l, r: 0)
+                ssn.add_predicate_fn("other-plugin", lambda t, n: None)
+    """, select=["session-registry"])
+    assert _rules_of(findings) == ["session-registry", "session-registry"]
+    assert "add_job_oder_fn" in findings[0].message
+    assert "other than" in findings[1].message
+
+
+def test_session_registry_near_miss(tmp_path):
+    assert _lint(tmp_path, "plugins/myplugin.py", """
+        class MyPlugin(Plugin):
+            name = "my"
+
+            def on_session_open(self, ssn):
+                ssn.add_job_order_fn(self.name, lambda l, r: 0)
+                ssn.add_predicate_fn(self.name, lambda t, n: None)
+    """, select=["session-registry"]) == []
+
+
+# --- rule 9: lock-order -----------------------------------------------------
+
+
+_ABBA = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def f(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def g(self):
+            with self.b:
+                {body}
+"""
+
+
+def test_lock_order_fires_on_abba(tmp_path):
+    findings = _lint(
+        tmp_path, "server.py",
+        _ABBA.format(body="with self.a:\n                    pass"),
+        select=["lock-order"])
+    assert _rules_of(findings) == ["lock-order"]
+    assert "cycle" in findings[0].message
+    # the ABBA through a CALL while holding the lock is caught too
+    findings = _lint(tmp_path, "server2.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def locks_a(self):
+                with self.a:
+                    pass
+
+            def g(self):
+                with self.b:
+                    self.locks_a()
+    """, select=["lock-order"])
+    assert _rules_of(findings) == ["lock-order"]
+
+
+def test_lock_order_near_miss_consistent(tmp_path):
+    findings = _lint(
+        tmp_path, "server.py",
+        _ABBA.format(body="pass"),
+        select=["lock-order"])
+    assert findings == []
+
+
+def test_lock_order_non_reentrant_self_nesting(tmp_path):
+    findings = _lint(tmp_path, "server.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    with self.a:
+                        pass
+    """, select=["lock-order"])
+    assert _rules_of(findings) == ["lock-order"]
+    assert "non-reentrant" in findings[0].message
+    # the same shape over an RLock is legal
+    findings = _lint(tmp_path, "server2.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.RLock()
+
+            def f(self):
+                with self.a:
+                    with self.a:
+                        pass
+    """, select=["lock-order"])
+    assert findings == []
+
+
+# --- rule 10: lock-guard ----------------------------------------------------
+
+
+def test_lock_guard_fires(tmp_path):
+    findings = _lint(tmp_path, "daemon.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.mu = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self.mu:
+                    self.count += 1
+
+            def reset(self):
+                self.count = 0
+    """, select=["lock-guard"])
+    assert _rules_of(findings) == ["lock-guard"]
+    assert "self.count" in findings[0].message
+
+
+def test_lock_guard_near_miss(tmp_path):
+    assert _lint(tmp_path, "daemon.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.mu = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self.mu:
+                    self.count += 1
+
+            def reset(self):
+                with self.mu:
+                    self.count = 0
+    """, select=["lock-guard"]) == []
+
+
+# --- rule 11: statement-discipline ------------------------------------------
+
+
+def test_statement_discipline_fires(tmp_path):
+    findings = _lint(tmp_path, "act.py", """
+        def act(ssn, jobs):
+            for j in jobs:
+                stmt = Statement(ssn)
+                if j.ok:
+                    stmt.commit()
+    """, select=["statement-discipline"])
+    assert _rules_of(findings) == ["statement-discipline"]
+
+
+def test_statement_discipline_near_miss(tmp_path):
+    assert _lint(tmp_path, "act.py", """
+        def act(ssn, jobs):
+            for j in jobs:
+                stmt = Statement(ssn)
+                if j.ok:
+                    stmt.commit()
+                else:
+                    stmt.discard()
+                    continue
+    """, select=["statement-discipline"]) == []
+    # the real preempt shape: break out of an inner loop, settle after
+    assert _lint(tmp_path, "act2.py", """
+        def act(ssn, jobs):
+            while True:
+                stmt = Statement(ssn)
+                while True:
+                    if done():
+                        break
+                if ok():
+                    stmt.commit()
+                else:
+                    stmt.discard()
+                    continue
+    """, select=["statement-discipline"]) == []
+
+
+# --- rule 12: bare-except ---------------------------------------------------
+
+
+def test_bare_except_fires(tmp_path):
+    findings = _lint(tmp_path, "scheduler/thing.py", """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """, select=["bare-except"])
+    assert _rules_of(findings) == ["bare-except"]
+
+
+def test_bare_except_near_miss(tmp_path):
+    # handled broad catch: fine
+    assert _lint(tmp_path, "scheduler/thing.py", """
+        def f(log):
+            try:
+                g()
+            except Exception as e:
+                log.append(e)
+    """, select=["bare-except"]) == []
+    # silent catch OUTSIDE the hot path trees: out of scope
+    assert _lint(tmp_path, "cli/thing.py", """
+        def teardown():
+            try:
+                g()
+            except Exception:
+                pass
+    """, select=["bare-except"]) == []
+
+
+# --- suppression contract ---------------------------------------------------
+
+
+def test_file_level_suppression(tmp_path):
+    findings = _lint(tmp_path, "scheduler/thing.py", """
+        # vtlint: disable=bare-except
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """, select=["bare-except"])
+    assert findings == []
+
+
+def test_line_level_suppression_only_hits_that_line(tmp_path):
+    findings = _lint(tmp_path, "scheduler/thing.py", """
+        def f():
+            try:
+                g()
+            except Exception:  # vtlint: disable=bare-except
+                pass
+
+        def h():
+            try:
+                g()
+            except Exception:
+                pass
+    """, select=["bare-except"])
+    assert len(findings) == 1  # only the unsuppressed handler
+
+
+def test_unknown_rule_in_suppression_is_an_error(tmp_path):
+    findings = _lint(tmp_path, "scheduler/thing.py", """
+        # vtlint: disable=not-a-real-rule
+        def f():
+            return 1
+    """)
+    assert _rules_of(findings) == [USAGE_RULE]
+    assert "not-a-real-rule" in findings[0].message
+
+
+def test_unknown_select_raises(tmp_path):
+    with pytest.raises(ValueError, match="bogus"):
+        run_paths([str(tmp_path)], select=["bogus"])
+
+
+# --- the runtime lock-order sanitizer ---------------------------------------
+
+
+def test_locksan_disabled_returns_plain_locks(monkeypatch):
+    monkeypatch.delenv("VOLCANO_TPU_LOCK_SANITIZER", raising=False)
+    from volcano_tpu.analysis import locksan
+
+    assert isinstance(locksan.make_lock("x"), type(threading.Lock()))
+    assert not locksan.enabled()
+
+
+def test_locksan_detects_abba(monkeypatch):
+    monkeypatch.setenv("VOLCANO_TPU_LOCK_SANITIZER", "1")
+    from volcano_tpu.analysis import locksan
+
+    locksan.reset_graph()
+    try:
+        a = locksan.make_lock("san-A")
+        b = locksan.make_rlock("san-B")
+        with a:
+            with b:
+                pass
+        with pytest.raises(locksan.LockOrderError, match="san-A"):
+            with b:
+                with a:
+                    pass
+        # the violating acquisition must not leak a held lock
+        with a:
+            pass
+    finally:
+        locksan.reset_graph()
+
+
+def test_locksan_consistent_order_and_reentrancy_ok(monkeypatch):
+    monkeypatch.setenv("VOLCANO_TPU_LOCK_SANITIZER", "1")
+    from volcano_tpu.analysis import locksan
+
+    locksan.reset_graph()
+    try:
+        a = locksan.make_lock("san-C")
+        b = locksan.make_rlock("san-D")
+        for _ in range(3):
+            with a:
+                with b:
+                    with b:  # reentrant hold: no new ordering info
+                        pass
+    finally:
+        locksan.reset_graph()
+
+
+def test_locksan_condition_wait_notify(monkeypatch):
+    monkeypatch.setenv("VOLCANO_TPU_LOCK_SANITIZER", "1")
+    from volcano_tpu.analysis import locksan
+
+    locksan.reset_graph()
+    try:
+        cv = locksan.make_condition("san-CV")
+        seen = []
+
+        def waiter():
+            with cv:
+                seen.append(cv.wait(timeout=5))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time as _time
+
+        _time.sleep(0.1)
+        with cv:
+            cv.notify_all()
+        t.join(timeout=5)
+        assert seen == [True]
+    finally:
+        locksan.reset_graph()

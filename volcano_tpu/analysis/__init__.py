@@ -1,0 +1,25 @@
+"""vtlint: project-native static analysis for volcano-tpu.
+
+Enforces the disciplines the kernels depend on — hot-path purity,
+jit-boundary hygiene, ε-tolerant Resource comparison, parity-citation
+coverage, Session-registry completeness, lock ordering, Statement
+commit/discard totality, and no silent exception swallowing — as
+machine-checked rules that run before every PR (`make lint`, and as the
+preamble of `make test`; `tests/test_vtlint.py` keeps the tree at zero
+findings).  `ANALYSIS.md` documents every rule.
+
+CLI:  python -m volcano_tpu.analysis [--json] [--select RULES] [paths...]
+
+The package is pure stdlib (ast/re/tokenize) — it runs anywhere the
+package installs, jax or not.  The runtime half (the env-gated lock-order
+sanitizer the static `lock-order` rule is cross-checked against) lives in
+`volcano_tpu.analysis.locksan`.
+"""
+
+from volcano_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    all_rules,
+    run_paths,
+)
+
+__all__ = ["Finding", "all_rules", "run_paths"]

@@ -1,0 +1,78 @@
+"""CLI entry: ``python -m volcano_tpu.analysis``.
+
+Exit status: 0 when the analyzed tree is clean, 1 when findings exist,
+2 on usage errors.  ``--json`` emits a machine-readable report (used by
+``make lint`` and the tier-1 test); the default output is one
+``path:line: rule: message`` line per finding, grep/editor friendly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from volcano_tpu.analysis.core import all_rules, run_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m volcano_tpu.analysis",
+        description="vtlint: project-native static analysis for volcano-tpu",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to analyze "
+                         "(default: ./volcano_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="root for relative paths in findings "
+                         "(default: common parent of the inputs)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="JSON report on stdout")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ns = ap.parse_args(argv)
+
+    rules = all_rules()
+    if ns.list_rules:
+        if ns.as_json:
+            print(json.dumps(
+                {rid: r.description for rid, r in sorted(rules.items())},
+                indent=2))
+        else:
+            for rid in sorted(rules):
+                print(f"{rid}: {rules[rid].description}")
+        return 0
+
+    paths = ns.paths or [os.path.join(os.getcwd(), "volcano_tpu")]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"vtlint: no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+    select = [s.strip() for s in ns.select.split(",")] if ns.select else None
+    try:
+        findings = run_paths(paths, root=ns.root, select=select)
+    except ValueError as e:
+        print(f"vtlint: {e}", file=sys.stderr)
+        return 2
+
+    if ns.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "count": len(findings),
+            "rules": sorted(rules if select is None else select),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.human())
+        n_rules = len(rules if select is None else select)
+        print(f"vtlint: {len(findings)} finding(s) "
+              f"({n_rules} rule(s) active)",
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,354 @@
+"""vtlint core: rule registry, suppression handling, file walking, output.
+
+The analyzer is pure stdlib (ast + re) so it can run in any environment the
+package installs into — including CI images without jax.  Rules live in the
+sibling modules and register themselves through :func:`rule`; each rule is a
+function ``(ctx: FileContext) -> Iterable[Finding]`` plus metadata.
+
+Suppression contract (per-file, the only sanctioned escape hatch):
+
+* a comment line ``# vtlint: disable=rule-a,rule-b`` anywhere in a file
+  disables those rules for the whole file;
+* a trailing ``# vtlint: disable=rule-a`` on a code line disables the rule
+  for that line only;
+* unknown rule names in a disable comment are themselves findings (rule
+  ``vtlint-usage``) — a typoed suppression must not silently disable
+  nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+#: rule id -> (description, fn)
+_REGISTRY: Dict[str, "Rule"] = {}
+
+#: pseudo-rule for analyzer-usage errors (bad suppressions); never
+#: suppressible and always active.
+USAGE_RULE = "vtlint-usage"
+
+_DISABLE_RE = re.compile(r"#\s*vtlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # relative to the analysis root
+    line: int
+    message: str
+
+    def human(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclass
+class Rule:
+    id: str
+    description: str
+    fn: Callable[["FileContext"], Iterable[Finding]]
+
+
+def rule(id: str, description: str):
+    """Decorator registering a rule function in the global registry."""
+
+    def deco(fn):
+        if id in _REGISTRY:
+            raise ValueError(f"duplicate vtlint rule id {id!r}")
+        _REGISTRY[id] = Rule(id, description, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    _load_rule_modules()
+    return dict(_REGISTRY)
+
+
+_LOADED = False
+
+
+def _load_rule_modules() -> None:
+    """Import the rule modules exactly once (they self-register)."""
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    from volcano_tpu.analysis import (  # noqa: F401  (import = registration)
+        rules_concurrency,
+        rules_epsilon,
+        rules_excepts,
+        rules_hotpath,
+        rules_parity,
+        rules_registry,
+        rules_statement,
+    )
+
+
+@dataclass
+class FileContext:
+    """Everything a rule needs to know about one file under analysis."""
+
+    path: str  # absolute
+    relpath: str  # relative to the root, forward slashes
+    source: str
+    tree: ast.AST
+    #: rules disabled for the whole file
+    file_disabled: Set[str] = field(default_factory=set)
+    #: line -> rules disabled on that line
+    line_disabled: Dict[int, Set[str]] = field(default_factory=dict)
+    #: findings raised by suppression parsing itself (unknown rule names)
+    usage_findings: List[Finding] = field(default_factory=list)
+    #: per-file memo shared across rules (jit-node sets, lock graphs, ...)
+    cache: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.relpath)
+
+    @property
+    def dir_parts(self) -> Sequence[str]:
+        return tuple(self.relpath.split("/")[:-1])
+
+    def finding(self, rule_id: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(rule_id, self.relpath, int(line), message)
+
+
+def _parse_suppressions(ctx: FileContext, known: Set[str]) -> None:
+    """Populate file/line disable sets from ``# vtlint: disable=`` comments.
+
+    Comment-only lines disable file-wide; trailing comments disable that
+    line.  Comments are found with the tokenizer, not a regex over raw
+    lines, so a disable marker inside a string literal is inert.
+    """
+    import io
+
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(ctx.source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+    # lines that contain any non-comment, non-whitespace token
+    code_lines: Set[int] = set()
+    for tok in tokens:
+        if tok.type in (
+            tokenize.COMMENT,
+            tokenize.NL,
+            tokenize.NEWLINE,
+            tokenize.INDENT,
+            tokenize.DEDENT,
+            tokenize.ENCODING,
+            tokenize.ENDMARKER,
+        ):
+            continue
+        for ln in range(tok.start[0], tok.end[0] + 1):
+            code_lines.add(ln)
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _DISABLE_RE.search(tok.string)
+        if not m:
+            continue
+        names = [n.strip() for n in m.group(1).split(",") if n.strip()]
+        line = tok.start[0]
+        for name in names:
+            if name not in known:
+                ctx.usage_findings.append(
+                    ctx.finding(
+                        USAGE_RULE,
+                        line,
+                        f"unknown rule {name!r} in vtlint disable comment "
+                        f"(known: {', '.join(sorted(known))})",
+                    )
+                )
+                continue
+            if line in code_lines:
+                ctx.line_disabled.setdefault(line, set()).add(name)
+            else:
+                ctx.file_disabled.add(name)
+
+
+def load_file(path: str, root: str) -> Optional[FileContext]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        ctx = FileContext(path=path, relpath=_rel(path, root), source=source,
+                          tree=ast.Module(body=[], type_ignores=[]))
+        ctx.usage_findings.append(
+            ctx.finding(USAGE_RULE, e.lineno or 1, f"syntax error: {e.msg}")
+        )
+        return ctx
+    ctx = FileContext(path=path, relpath=_rel(path, root), source=source, tree=tree)
+    return ctx
+
+
+def _rel(path: str, root: str) -> str:
+    try:
+        rel = os.path.relpath(path, root)
+    except ValueError:  # different drive (windows)
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def run_paths(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    select: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Analyze ``paths`` (files or directories) and return sorted findings.
+
+    ``root`` anchors relative paths in findings (defaults to the common
+    parent).  ``select`` limits the run to the given rule ids; unknown ids
+    raise ValueError (a CI target selecting a typoed rule must fail loudly,
+    not pass vacuously).
+    """
+    rules = all_rules()
+    if select is not None:
+        unknown = [s for s in select if s not in rules]
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(rules))})"
+            )
+        rules = {k: v for k, v in rules.items() if k in set(select)}
+    if root is None:
+        root = os.path.commonpath([os.path.abspath(p) for p in paths]) if paths else "."
+        if os.path.isfile(root):
+            root = os.path.dirname(root)
+    known_ids = set(all_rules())
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        ctx = load_file(path, root)
+        if ctx is None:
+            continue
+        _parse_suppressions(ctx, known_ids)
+        findings.extend(ctx.usage_findings)
+        for r in rules.values():
+            if r.id in ctx.file_disabled:
+                continue
+            for f in r.fn(ctx):
+                if r.id in ctx.line_disabled.get(f.line, ()):
+                    continue
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# --- shared AST helpers used by several rules --------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Name/Attribute chains, else None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_functions(tree: ast.AST):
+    """Yield every FunctionDef/AsyncFunctionDef in the tree."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def is_jit_decorated(fn: ast.AST) -> bool:
+    """True for @jax.jit / @jit / @functools.partial(jax.jit, ...) etc."""
+    for dec in getattr(fn, "decorator_list", []):
+        name = dotted_name(dec)
+        if name in ("jit", "jax.jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            cname = dotted_name(dec.func)
+            if cname in ("jit", "jax.jit"):
+                return True
+            if cname in ("partial", "functools.partial") and dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner in ("jit", "jax.jit"):
+                    return True
+    return False
+
+
+_LAX_HOF = {"while_loop", "cond", "scan", "fori_loop", "switch", "map"}
+
+
+def jit_roots(tree: ast.AST) -> List[ast.AST]:
+    """Function defs that execute under a jax trace: jit-decorated
+    functions, plus any top-level function passed by name into a
+    ``lax.while_loop``/``cond``/``scan``-style higher-order call when the
+    call site itself is not already inside a jit root (nested defs inside a
+    jit root are covered by containment)."""
+    roots = [fn for fn in walk_functions(tree) if is_jit_decorated(fn)]
+    root_set = set(id(r) for r in roots)
+    # functions referenced by name in lax higher-order calls
+    referenced: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname and fname.split(".")[-1] in _LAX_HOF and (
+                fname.startswith("lax.") or fname.startswith("jax.lax.")
+                or fname.split(".")[-2:-1] == ["lax"]
+            ):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        referenced.add(arg.id)
+    if referenced:
+        contained = set()
+        for r in roots:
+            for sub in ast.walk(r):
+                contained.add(id(sub))
+        for fn in walk_functions(tree):
+            if fn.name in referenced and id(fn) not in contained and id(fn) not in root_set:
+                roots.append(fn)
+                root_set.add(id(fn))
+    return roots
+
+
+def nodes_in_jit(tree: ast.AST) -> Set[int]:
+    """id()s of every AST node that executes under a jax trace."""
+    out: Set[int] = set()
+    for root in jit_roots(tree):
+        for sub in ast.walk(root):
+            out.add(id(sub))
+    return out
+
+
+def ctx_nodes_in_jit(ctx: "FileContext") -> Set[int]:
+    """`nodes_in_jit(ctx.tree)`, computed once per file (several rules
+    need it)."""
+    if "nodes_in_jit" not in ctx.cache:
+        ctx.cache["nodes_in_jit"] = nodes_in_jit(ctx.tree)
+    return ctx.cache["nodes_in_jit"]  # type: ignore[return-value]

@@ -1,0 +1,16 @@
+"""Compatibility surface: the lock-order sanitizer is documented with the
+analysis toolkit, but it is RUNTIME code (pure os/threading) imported by
+the store/server/applier/native modules — so it lives at
+``volcano_tpu.locksan``, outside the lint framework's import graph (a
+broken rule module must never take down the production daemons).  This
+shim keeps the ``volcano_tpu.analysis.locksan`` name working."""
+
+from volcano_tpu.locksan import (  # noqa: F401
+    ENV_FLAG,
+    LockOrderError,
+    enabled,
+    make_condition,
+    make_lock,
+    make_rlock,
+    reset_graph,
+)

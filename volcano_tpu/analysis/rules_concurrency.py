@@ -1,0 +1,442 @@
+"""Lock discipline: static lock-order graph + guarded-state writes.
+
+The multi-process daemons (store server, store, leader elector, event
+recorder, scheduler cache/applier) serve concurrent HTTP handler threads,
+a saver thread, and the async applier thread.  Two invariants keep them
+deadlock- and race-free:
+
+* **acyclic acquisition order** — e.g. `StoreServer.flush_state` takes
+  `_flush_lock` BEFORE `lock` (server.py documents the ABBA hazard); any
+  path acquiring them in the opposite order is a latent deadlock.  This
+  rule builds a per-module lock-order graph from `with <lock>:` nesting,
+  propagates acquisitions through same-class/same-module calls to a
+  fixpoint, and flags cycles.  Nested acquisition of a NON-reentrant
+  `threading.Lock` (self-cycle) is flagged too — it self-deadlocks.
+* **guarded writes** — an attribute that is ever written under a class's
+  lock is shared daemon state; writing it in another method without the
+  lock is a data race.  Methods whose every intra-module call site holds
+  the lock count as locked (`_pump_log` style "called-locked" helpers);
+  `__init__`-reachable methods are construction-time and exempt.
+
+The same graph is cross-checked at runtime by the env-gated lock-order
+sanitizer (`volcano_tpu/analysis/locksan.py`, `make sanitize`).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from volcano_tpu.analysis.core import FileContext, Finding, dotted_name, rule
+
+_LOCK_CTORS = {
+    "threading.Lock": False,    # reentrant?
+    "threading.RLock": True,
+    "threading.Condition": True,  # condition shares/wraps a (re-entrant ok) lock
+    "Lock": False,
+    "RLock": True,
+    "Condition": True,
+    "make_lock": False,
+    "make_rlock": True,
+    "make_condition": True,
+    "locksan.make_lock": False,
+    "locksan.make_rlock": True,
+    "locksan.make_condition": True,
+}
+
+
+class _LockDef:
+    def __init__(self, key: str, reentrant: bool, line: int):
+        self.key = key          # "ClassName.attr" or "module:name"
+        self.reentrant = reentrant
+        self.line = line
+        self.alias_of: Optional[str] = None  # Condition(self.lock) aliases
+
+
+class _FnInfo:
+    """Per-function summary from the syntactic walk."""
+
+    def __init__(self, qualname: str):
+        self.qualname = qualname
+        # (held tuple at acquisition, lock key, line)
+        self.acquisitions: List[Tuple[Tuple[str, ...], str, int]] = []
+        # (held tuple at call, callee simple name, receiver is self/module)
+        self.calls: List[Tuple[Tuple[str, ...], str, int]] = []
+
+
+def _collect_lock_defs(tree: ast.AST) -> Dict[str, _LockDef]:
+    """Map attr/global name -> _LockDef, keyed by bare name (qualified key
+    stored inside).  Bare-name keying matches `with self.X` / `with X`
+    sites; collisions across classes are merged conservatively."""
+    defs: Dict[str, _LockDef] = {}
+
+    def record(bare: str, qual: str, ctor: str, node: ast.Call):
+        reentrant = _LOCK_CTORS[ctor]
+        d = _LockDef(qual, reentrant, node.lineno)
+        # Condition(self.other_lock) is an alias for that lock
+        if ctor.endswith("Condition") and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Attribute):
+                d.alias_of = target.attr
+            elif isinstance(target, ast.Name):
+                d.alias_of = target.id
+        if bare in defs:
+            # same bare name in two classes: keep first, both treated as one
+            return
+        defs[bare] = d
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        ctor = dotted_name(node.value.func)
+        if ctor not in _LOCK_CTORS:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                record(t.attr, f"self.{t.attr}", ctor, node.value)
+            elif isinstance(t, ast.Name):
+                record(t.id, f"module:{t.id}", ctor, node.value)
+    return defs
+
+
+def _resolve(defs: Dict[str, _LockDef], bare: str) -> Optional[str]:
+    d = defs.get(bare)
+    if d is None:
+        return None
+    seen = set()
+    while d.alias_of is not None and d.alias_of in defs and d.alias_of not in seen:
+        seen.add(d.alias_of)
+        bare = d.alias_of
+        d = defs[bare]
+    return bare
+
+
+def _with_lock_name(item: ast.withitem, defs: Dict[str, _LockDef]) -> Optional[str]:
+    expr = item.context_expr
+    # `with self.lock:` / `with server.lock:` / `with _lock:`
+    if isinstance(expr, ast.Attribute):
+        return _resolve(defs, expr.attr)
+    if isinstance(expr, ast.Name):
+        return _resolve(defs, expr.id)
+    return None
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    """Simple name of an intra-module callee: `self.f(...)`, `f(...)`, or
+    `<var>.f(...)` where the attr matches a module function/method — the
+    receiver form `<var>.<attr>.f(...)` is treated as external."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.attr
+    return None
+
+
+def _walk_fn(fn: ast.AST, qualname: str, defs: Dict[str, _LockDef]) -> _FnInfo:
+    info = _FnInfo(qualname)
+
+    def visit(node: ast.AST, held: Tuple[str, ...]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            # nested defs analyzed separately (closures run later; a held
+            # lock at definition time is not held at call time)
+            return
+        if isinstance(node, ast.With):
+            new_held = held
+            for item in node.items:
+                lock = _with_lock_name(item, defs)
+                if lock is not None:
+                    info.acquisitions.append((new_held, lock, node.lineno))
+                    new_held = new_held + (lock,)
+            for child in node.body:
+                visit(child, new_held)
+            return
+        if isinstance(node, ast.Call):
+            callee = _callee_name(node)
+            if callee is not None:
+                info.calls.append((held, callee, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, ())
+    return info
+
+
+def _function_index(tree: ast.AST) -> Dict[str, List[Tuple[str, ast.AST]]]:
+    """simple name -> [(qualname, fn node)] for module functions and
+    methods (any class)."""
+    index: Dict[str, List[Tuple[str, ast.AST]]] = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            index.setdefault(node.name, []).append((node.name, node))
+        elif isinstance(node, ast.ClassDef):
+            for item in ast.walk(node):
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{node.name}.{item.name}"
+                    index.setdefault(item.name, []).append((qual, item))
+    return index
+
+
+def _analyze_module(ctx: FileContext):
+    """Shared walk for both concurrency rules (computed once per file,
+    memoized on the FileContext).  Returns
+    (defs, infos by qualname, edges, edge_sites, acq_closure)."""
+    cached = ctx.cache.get("lock_analysis")
+    if cached is not None:
+        return cached
+    result = _analyze_module_uncached(ctx)
+    ctx.cache["lock_analysis"] = result
+    return result
+
+
+def _analyze_module_uncached(ctx: FileContext):
+    defs = _collect_lock_defs(ctx.tree)
+    if not defs:
+        return defs, {}, {}, {}, {}
+    index = _function_index(ctx.tree)
+    infos: Dict[str, _FnInfo] = {}
+    for name, entries in index.items():
+        for qual, fn in entries:
+            if qual not in infos:
+                infos[qual] = _walk_fn(fn, qual, defs)
+
+    # transitive lock-acquisition closure per function (fixpoint)
+    acq: Dict[str, Set[str]] = {q: set(l for _, l, _ in i.acquisitions)
+                                for q, i in infos.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, i in infos.items():
+            for _, callee, _ in i.calls:
+                for cq, _fn in index.get(callee, []):
+                    extra = acq.get(cq, set()) - acq[q]
+                    if extra:
+                        acq[q] |= extra
+                        changed = True
+
+    # order edges: held -> newly acquired (direct + via calls)
+    edges: Dict[str, Set[str]] = {}
+    edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_edge(a: str, b: str, qual: str, line: int):
+        if a == b:
+            return
+        edges.setdefault(a, set()).add(b)
+        edge_sites.setdefault((a, b), (qual, line))
+
+    for q, i in infos.items():
+        for held, lock, line in i.acquisitions:
+            for h in held:
+                add_edge(h, lock, q, line)
+        for held, callee, line in i.calls:
+            if not held:
+                continue
+            for cq, _fn in index.get(callee, []):
+                for lock in acq.get(cq, ()):  # locks callee may acquire
+                    for h in held:
+                        add_edge(h, lock, q, line)
+    return defs, infos, edges, edge_sites, acq
+
+
+@rule(
+    "lock-order",
+    "cycle in the static lock-acquisition-order graph (ABBA deadlock) or "
+    "nested acquisition of a non-reentrant lock",
+)
+def check_lock_order(ctx: FileContext) -> Iterable[Finding]:
+    defs, infos, edges, edge_sites, _acq = _analyze_module(ctx)
+    if not defs:
+        return
+
+    # non-reentrant self-nesting: direct or via calls
+    for q, i in infos.items():
+        for held, lock, line in i.acquisitions:
+            if lock in held and not defs[lock].reentrant:
+                yield ctx.finding(
+                    "lock-order",
+                    line,
+                    f"{q} re-acquires non-reentrant lock "
+                    f"{defs[lock].key!r} while already holding it — "
+                    "self-deadlock (use RLock or restructure)",
+                )
+
+    # cycles via DFS
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+    reported: Set[frozenset] = set()
+
+    def dfs(n: str):
+        color[n] = 1
+        stack.append(n)
+        for m in sorted(edges.get(n, ())):
+            if color.get(m, 0) == 0:
+                yield from dfs(m)
+            elif color.get(m) == 1:
+                cycle = stack[stack.index(m):] + [m]
+                key = frozenset(cycle)
+                if key not in reported:
+                    reported.add(key)
+                    hops = []
+                    for a, b in zip(cycle, cycle[1:]):
+                        qual, line = edge_sites.get((a, b), ("?", 0))
+                        hops.append(f"{a}->{b} ({qual}:{line})")
+                    site = edge_sites.get((cycle[0], cycle[1]), ("?", 1))
+                    yield ctx.finding(
+                        "lock-order",
+                        site[1],
+                        "lock-order cycle (ABBA deadlock): "
+                        + "; ".join(hops)
+                        + " — pick one global order and stick to it",
+                    )
+        stack.pop()
+        color[n] = 2
+
+    for n in sorted(edges):
+        if color.get(n, 0) == 0:
+            yield from dfs(n)
+
+
+_INIT_METHODS = {"__init__", "__setstate__", "__getstate__", "__new__",
+                 "__post_init__"}
+
+
+def _assigned_self_attrs(fn: ast.AST, locked_only: bool,
+                         defs, infos: Dict[str, _FnInfo],
+                         qual: str) -> Set[Tuple[str, int]]:
+    """(attr, line) for writes to self.X (incl. self.X[...] / self.X.y)
+    in fn, filtered by whether the write site is under a with-lock."""
+    out: Set[Tuple[str, int]] = set()
+
+    def visit(node: ast.AST, held: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            return
+        if isinstance(node, ast.With):
+            new_held = held or any(
+                _with_lock_name(item, defs) is not None for item in node.items
+            )
+            for child in node.body:
+                visit(child, new_held)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)) and not (
+                    isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                ):
+                    base = base.value
+                if isinstance(base, ast.Attribute) and isinstance(base.value, ast.Name) \
+                        and base.value.id == "self":
+                    if held == locked_only:
+                        out.add((base.attr, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, False)
+    return out
+
+
+@rule(
+    "lock-guard",
+    "write to lock-guarded shared state outside the lock — attributes "
+    "ever written under a class's lock must always be written under it",
+)
+def check_lock_guard(ctx: FileContext) -> Iterable[Finding]:
+    defs, infos, _edges, _sites, _acq = _analyze_module(ctx)
+    if not defs:
+        return
+
+    # per class: find methods, call sites, locked-effective methods
+    for cls in (n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)):
+        methods = {
+            item.name: item
+            for item in cls.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not methods:
+            continue
+        has_lock = any(
+            d.key == f"self.{bare}" for bare, d in defs.items()
+        )
+        if not has_lock:
+            continue
+
+        # call sites within the class: method -> [(caller, held?)]
+        call_sites: Dict[str, List[Tuple[str, bool]]] = {}
+        for mname in methods:
+            qual = f"{cls.name}.{mname}"
+            info = infos.get(qual)
+            if info is None:
+                continue
+            for held, callee, _line in info.calls:
+                if callee in methods:
+                    call_sites.setdefault(callee, []).append((mname, bool(held)))
+
+        # init-reachable methods (construction context, single-threaded)
+        init_reach: Set[str] = set(m for m in methods if m in _INIT_METHODS)
+        frontier = list(init_reach)
+        while frontier:
+            cur = frontier.pop()
+            info = infos.get(f"{cls.name}.{cur}")
+            if info is None:
+                continue
+            for _held, callee, _line in info.calls:
+                if callee in methods and callee not in init_reach:
+                    # only counts if ALL its call sites are init-reachable
+                    sites = call_sites.get(callee, [])
+                    if sites and all(c in init_reach for c, _h in sites):
+                        init_reach.add(callee)
+                        frontier.append(callee)
+
+        # fixpoint: a method is "effectively locked" if it has >=1 call
+        # site and every non-init call site holds a lock or is itself
+        # effectively locked
+        locked_methods: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for mname in methods:
+                if mname in locked_methods or mname in init_reach:
+                    continue
+                sites = [s for s in call_sites.get(mname, [])
+                         if s[0] not in init_reach]
+                if sites and all(h or c in locked_methods for c, h in sites):
+                    locked_methods.add(mname)
+                    changed = True
+
+        # guarded attrs: written under lock in any non-init context
+        guarded: Set[str] = set()
+        for mname, fn in methods.items():
+            if mname in init_reach:
+                continue
+            qual = f"{cls.name}.{mname}"
+            under = _assigned_self_attrs(fn, True, defs, infos, qual)
+            guarded |= {a for a, _ in under}
+            if mname in locked_methods:
+                # everything it writes is effectively under lock
+                outside = _assigned_self_attrs(fn, False, defs, infos, qual)
+                guarded |= {a for a, _ in outside}
+        # the lock attributes themselves are not data
+        guarded -= set(defs.keys())
+        if not guarded:
+            continue
+
+        for mname, fn in methods.items():
+            if mname in init_reach or mname in locked_methods:
+                continue
+            qual = f"{cls.name}.{mname}"
+            for attr, line in _assigned_self_attrs(fn, False, defs, infos, qual):
+                if attr in guarded:
+                    yield ctx.finding(
+                        "lock-guard",
+                        line,
+                        f"{qual} writes self.{attr} outside the lock, but "
+                        f"self.{attr} is lock-guarded shared state elsewhere "
+                        "in this class — take the lock or move the write to "
+                        "construction",
+                    )

@@ -1,0 +1,141 @@
+"""ε-compare discipline: `Resource` values never compare with raw operators.
+
+`api/resource.py` defines the epsilon-tolerant comparison semantics the
+whole scheduler depends on (MIN_MILLI_CPU / MIN_MEMORY / MIN_SCALAR,
+reference resource_info.go:70-72): `less_equal`, `less`, `approx_equal`,
+`fit_delta`.  A raw `==`/`<`/`<=` between Resource values silently
+reintroduces exact float comparison and breaks parity with both the
+reference and the device kernels (which carry the same epsilons as `eps`
+tensors).  This rule flags comparisons whose operand is
+
+* an attribute known (by project-wide naming convention, see
+  ``RESOURCE_ATTRS``) to hold a ``Resource`` — ``task.resreq``,
+  ``node.idle``, ``attr.deserved``, ... — or
+* a local name assigned from ``Resource(...)`` / ``.clone()`` /
+  ``Resource.min(...)`` / ``Resource.from_resource_list(...)`` in the same
+  function,
+
+everywhere except ``api/resource.py`` itself (the single place allowed to
+define the semantics).  Comparisons inside jit-traced bodies are exempt:
+device code compares float arrays with explicit ``eps`` terms by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    dotted_name,
+    ctx_nodes_in_jit,
+    rule,
+    walk_functions,
+)
+
+#: attribute names that hold Resource values across the model
+#: (api/resource.py, scheduler/model.py, plugins/proportion.py)
+RESOURCE_ATTRS = {
+    "resreq",
+    "init_resreq",
+    "total_request",
+    "allocated",
+    "idle",
+    "used",
+    "releasing",
+    "allocatable",
+    "capability",
+    "idle_deficit",
+    "releasing_deficit",
+    "min_resources",
+    "deserved",
+}
+
+_CONSTRUCTORS = {
+    "Resource",
+    "Resource.min",
+    "Resource.from_resource_list",
+    "resource.Resource",
+}
+
+_ALLOWED_FILES = ("api/resource.py",)
+
+_OP_NAMES = {
+    ast.Eq: "==",
+    ast.NotEq: "!=",
+    ast.Lt: "<",
+    ast.LtE: "<=",
+    ast.Gt: ">",
+    ast.GtE: ">=",
+}
+
+
+def _is_resource_expr(node: ast.AST, tainted: Set[str]) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr in RESOURCE_ATTRS:
+        return True
+    if isinstance(node, ast.Name) and node.id in tainted:
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in _CONSTRUCTORS:
+            return True
+        # fluent chain: x.resreq.clone().add(y) stays a Resource
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "clone", "add", "sub", "multi", "set_max", "fit_delta"
+        ):
+            return _is_resource_expr(node.func.value, tainted)
+    return False
+
+
+def _tainted_names(fn: ast.AST) -> Set[str]:
+    """Local names assigned from Resource constructors/clones within fn."""
+    tainted: Set[str] = set()
+    # two passes so `a = Resource(); b = a.clone()` taints b
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                if _is_resource_expr(node.value, tainted):
+                    tainted.add(node.targets[0].id)
+    return tainted
+
+
+@rule(
+    "resource-raw-compare",
+    "raw ==/!=/</<= between Resource values outside api/resource.py — "
+    "use less/less_equal/approx_equal (epsilon-tolerant) instead",
+)
+def check_resource_compare(ctx: FileContext) -> Iterable[Finding]:
+    if ctx.relpath.endswith(_ALLOWED_FILES):
+        return
+    in_jit = ctx_nodes_in_jit(ctx)
+
+    scopes = [ctx.tree] + list(walk_functions(ctx.tree))
+    seen: Set[int] = set()
+    for scope in scopes:
+        tainted = _tainted_names(scope) if scope is not ctx.tree else set()
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Compare) or id(node) in seen:
+                continue
+            if id(node) in in_jit:
+                seen.add(id(node))
+                continue
+            operands = [node.left] + list(node.comparators)
+            for op, (l, r) in zip(node.ops, zip(operands, operands[1:])):
+                if type(op) not in _OP_NAMES:
+                    continue
+                for side in (l, r):
+                    if _is_resource_expr(side, tainted):
+                        seen.add(id(node))
+                        desc = ast.unparse(side) if hasattr(ast, "unparse") else "operand"
+                        yield ctx.finding(
+                            "resource-raw-compare",
+                            node,
+                            f"raw {_OP_NAMES[type(op)]} comparison on Resource "
+                            f"value {desc!r}; use the epsilon-tolerant API "
+                            "(less/less_equal/approx_equal) from api/resource.py",
+                        )
+                        break
+                if id(node) in seen:
+                    break

@@ -1,0 +1,64 @@
+"""Silent-fallback discipline: no `except Exception: pass` on the hot path.
+
+The scheduler/store/api layers are the scheduling hot path: a swallowed
+exception there silently converts a correctness bug into a scheduling
+anomaly (a task that never binds, a queue that never drains) with no
+err_log entry, no event, no metric.  The project convention for the few
+legitimate broad catches (wire boundaries, per-op isolation in bulk verbs)
+is to HANDLE the error — record it, return it, count it — and tag the
+handler `# noqa: BLE001`; a body of just `pass`/`continue`/`...` is never
+acceptable in these trees.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from volcano_tpu.analysis.core import FileContext, Finding, rule
+
+#: directory prefixes under the package root that count as hot path
+_HOT_PREFIXES = ("scheduler", "store", "api", "parallel")
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    parts = ctx.relpath.split("/")
+    if "volcano_tpu" in parts:
+        parts = parts[parts.index("volcano_tpu") + 1:]
+    return bool(parts) and parts[0] in _HOT_PREFIXES
+
+
+def _is_silent(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / Ellipsis
+        return False
+    return True
+
+
+@rule(
+    "bare-except",
+    "`except [Exception]: pass` on the scheduling hot path swallows "
+    "correctness bugs silently — record, return, or count the error",
+)
+def check_bare_except(ctx: FileContext) -> Iterable[Finding]:
+    if not _in_scope(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is not None:
+            tname = node.type.attr if isinstance(node.type, ast.Attribute) \
+                else getattr(node.type, "id", None)
+            if tname not in ("Exception", "BaseException"):
+                continue
+        if _is_silent(node.body):
+            what = "bare except" if node.type is None else "except Exception"
+            yield ctx.finding(
+                "bare-except",
+                node,
+                f"{what} with a silent body on the scheduling hot path — "
+                "at minimum record to the cache err_log or an Event",
+            )

@@ -1,0 +1,365 @@
+"""Hot-path purity + jit-boundary hygiene rules.
+
+The sub-second 100k x 10k cycle exists because the kernel-twin modules
+(`fastpath.py`, `kernels.py`, `victim_kernels.py`, `fast_victims.py`,
+`tensor_actions.py`) never run O(tasks x nodes) Python and never sync the
+device mid-solve (ANALYSIS.md; BASELINE.md config 5).  These rules make
+that reviewers'-heads discipline machine-checked:
+
+* ``hotpath-python-loop`` — nested Python loops where both levels iterate
+  hot collections (tasks/nodes/pods/jobs/victims): the O(T x N) signature
+  the array mirror exists to avoid (PARITY.md "Scheduler cache" row).
+* ``hotpath-host-sync`` — ``.item()`` anywhere in a kernel twin, and
+  ``.item()``/``device_get``/``np.asarray``/``float(name)`` inside a jit
+  body: each is a device->host sync that serializes the solve against the
+  tunnel's ~0.1 s RTT floor (BASELINE.md cfg4 methodology note).
+* ``hotpath-wallclock`` — ``time.time()``/``time.monotonic()``/
+  ``datetime.now()``/stdlib ``random`` in a kernel twin module
+  (``time.perf_counter`` is allowed outside jit: phase timing).  Inside a
+  jit body ANY ``time.*`` call is flagged — it would burn the trace-time
+  clock into the compiled program.
+* ``jit-state-mutation`` — ``global``/``nonlocal`` declarations or
+  mutation of captured (closure/module) state inside a jit-traced body:
+  the mutation runs once at trace time, not per execution.
+* ``jit-unkeyed-random`` — host randomness (``random.*``/``np.random.*``)
+  or a constant-seeded ``jax.random.PRNGKey`` inside a jit body: the
+  "random" draw is frozen into the compiled artifact.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    dotted_name,
+    jit_roots,
+    ctx_nodes_in_jit,
+    rule,
+)
+
+#: the kernel-twin modules: host mirrors of device programs, where Python
+#: cost is the product the paper optimizes away
+KERNEL_TWIN_BASENAMES = {
+    "fastpath.py",
+    "kernels.py",
+    "victim_kernels.py",
+    "fast_victims.py",
+    "tensor_actions.py",
+}
+
+_HOT_TOKENS = ("task", "node", "pod", "job", "victim", "preemptor")
+
+
+def _is_kernel_twin(ctx: FileContext) -> bool:
+    return ctx.basename in KERNEL_TWIN_BASENAMES
+
+
+def _mentions_hot_collection(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name is None:
+            continue
+        low = name.lower()
+        if any(tok in low for tok in _HOT_TOKENS):
+            return True
+    return False
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for sub in ast.walk(target):
+        if isinstance(sub, ast.Name):
+            names.add(sub.id)
+    return names
+
+
+@rule(
+    "hotpath-python-loop",
+    "nested Python loops over hot collections (tasks x nodes) in a "
+    "kernel-twin module — the O(T x N) interpreter cost the array mirror "
+    "exists to eliminate",
+)
+def check_hot_loops(ctx: FileContext) -> Iterable[Finding]:
+    if not _is_kernel_twin(ctx):
+        return
+    for outer in ast.walk(ctx.tree):
+        if not isinstance(outer, ast.For) or not _mentions_hot_collection(outer.iter):
+            continue
+        outer_targets = _target_names(outer.target)
+        for sub in ast.walk(outer):
+            if sub is outer or not isinstance(sub, ast.For):
+                continue
+            if not _mentions_hot_collection(sub.iter):
+                continue
+            # hierarchical iteration (a job's OWN tasks, a node's OWN
+            # residents) is linear in the total element count, not a
+            # product: skip inner loops whose iterable derives from the
+            # outer loop variable
+            inner_names = {
+                n.id for n in ast.walk(sub.iter) if isinstance(n, ast.Name)
+            }
+            if inner_names & outer_targets:
+                continue
+            yield ctx.finding(
+                "hotpath-python-loop",
+                sub,
+                "nested Python loop over independent hot collections "
+                f"(outer loop at line {outer.lineno}): this is the "
+                "O(tasks x nodes) shape — vectorize it or move it to "
+                "the host residue sub-cycle",
+            )
+
+
+_SYNC_NP_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "jax.device_get", "device_get"}
+
+
+@rule(
+    "hotpath-host-sync",
+    ".item()/device_get/np.asarray host syncs in kernel twins or inside "
+    "jit bodies — each blocks on the device and pays the tunnel RTT floor",
+)
+def check_host_sync(ctx: FileContext) -> Iterable[Finding]:
+    twin = _is_kernel_twin(ctx)
+    if not twin:
+        # outside the twins we still police jit bodies (any module)
+        in_jit = ctx_nodes_in_jit(ctx)
+        if not in_jit:
+            return
+    else:
+        in_jit = ctx_nodes_in_jit(ctx)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        jit_ctx = id(node) in in_jit
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                and not node.args and not node.keywords:
+            if twin or jit_ctx:
+                yield ctx.finding(
+                    "hotpath-host-sync",
+                    node,
+                    ".item() is a device->host sync; fetch results packed, "
+                    "once, after the solve",
+                )
+            continue
+        name = dotted_name(node.func)
+        if jit_ctx and name in _SYNC_NP_CALLS:
+            yield ctx.finding(
+                "hotpath-host-sync",
+                node,
+                f"{name}() inside a jit body materializes the traced value "
+                "on host — keep the computation in jnp",
+            )
+        elif jit_ctx and name in ("float", "int", "bool") and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Name):
+            yield ctx.finding(
+                "hotpath-host-sync",
+                node,
+                f"{name}() on a (possibly traced) value inside a jit body "
+                "forces concretization; use jnp casts",
+            )
+
+
+_WALLCLOCK = {"time.time", "time.monotonic", "datetime.now",
+              "datetime.datetime.now", "datetime.utcnow"}
+
+
+@rule(
+    "hotpath-wallclock",
+    "wall-clock or stdlib randomness in a kernel-twin module (or any "
+    "time.* call inside a jit body) — nondeterminism the parity suites "
+    "cannot replay",
+)
+def check_wallclock(ctx: FileContext) -> Iterable[Finding]:
+    twin = _is_kernel_twin(ctx)
+    in_jit = ctx_nodes_in_jit(ctx)
+    if not twin and not in_jit:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        jit_ctx = id(node) in in_jit
+        if jit_ctx and name.startswith("time."):
+            yield ctx.finding(
+                "hotpath-wallclock",
+                node,
+                f"{name}() inside a jit body runs at trace time only — the "
+                "compiled program keeps the frozen value",
+            )
+        elif twin and name in _WALLCLOCK:
+            yield ctx.finding(
+                "hotpath-wallclock",
+                node,
+                f"{name}() in a kernel-twin module: inject clocks from the "
+                "caller (time.perf_counter is allowed for phase timing)",
+            )
+        elif twin and name.startswith("random."):
+            yield ctx.finding(
+                "hotpath-wallclock",
+                node,
+                f"stdlib {name}() in a kernel-twin module breaks bit-for-bit "
+                "replay; thread explicit seeds/keys instead",
+            )
+
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "write",
+             "appendleft"}
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    """Names bound within one function scope (params + assignments +
+    loop/with/comprehension targets + nested defs), NOT including names from
+    enclosing scopes."""
+    names: Set[str] = set()
+    args = fn.args
+    for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+
+    def collect_target(t):
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect_target(e)
+        elif isinstance(t, ast.Starred):
+            collect_target(t.value)
+
+    for node in ast.walk(fn):
+        if node is fn:
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                collect_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            collect_target(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            collect_target(node.target)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+            collect_target(node.optional_vars)
+        elif isinstance(node, ast.comprehension):
+            collect_target(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            collect_target(node.target)
+    return names
+
+
+def _root_name(node: ast.AST):
+    cur = node
+    while isinstance(cur, (ast.Attribute, ast.Subscript)):
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        return cur.id
+    return None
+
+
+@rule(
+    "jit-state-mutation",
+    "mutation of captured Python state (or global/nonlocal) inside a "
+    "jit/lax body — runs once at trace time, silently absent from the "
+    "compiled program",
+)
+def check_jit_mutation(ctx: FileContext) -> Iterable[Finding]:
+    roots = jit_roots(ctx.tree)
+    if not roots:
+        return
+    # process every function scope contained in a jit root separately, so
+    # a nested body fn mutating ITS enclosing (trace-time) scope is caught
+    for root in roots:
+        scopes: List[ast.AST] = [
+            fn for fn in ast.walk(root)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            locals_ = _local_names(scope)
+            nested = [
+                f for f in ast.walk(scope)
+                if f is not scope
+                and isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            nested_ids = set()
+            for f in nested:
+                for sub in ast.walk(f):
+                    if sub is not f:
+                        nested_ids.add(id(sub))
+            for node in ast.walk(scope):
+                if node is scope or id(node) in nested_ids:
+                    continue
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    yield ctx.finding(
+                        "jit-state-mutation",
+                        node,
+                        f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                        "declaration inside a jit-traced body",
+                    )
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    for t in targets:
+                        if isinstance(t, (ast.Attribute, ast.Subscript)):
+                            root_n = _root_name(t)
+                            if root_n and root_n not in locals_:
+                                yield ctx.finding(
+                                    "jit-state-mutation",
+                                    node,
+                                    f"assignment into captured {root_n!r} inside a "
+                                    "jit-traced body mutates trace-time state",
+                                )
+                elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                    if node.func.attr in _MUTATORS:
+                        root_n = _root_name(node.func.value)
+                        if root_n and root_n not in locals_:
+                            yield ctx.finding(
+                                "jit-state-mutation",
+                                node,
+                                f"{root_n}.{node.func.attr}(...) inside a jit-traced "
+                                "body mutates captured trace-time state",
+                            )
+
+
+@rule(
+    "jit-unkeyed-random",
+    "host randomness (random./np.random.) or constant-seeded PRNGKey "
+    "inside a jit body — the draw is frozen into the compiled program",
+)
+def check_jit_random(ctx: FileContext) -> Iterable[Finding]:
+    in_jit = ctx_nodes_in_jit(ctx)
+    if not in_jit:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or id(node) not in in_jit:
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        if name.startswith("random.") or name.startswith("np.random.") \
+                or name.startswith("numpy.random."):
+            yield ctx.finding(
+                "jit-unkeyed-random",
+                node,
+                f"{name}() inside a jit body draws once at trace time; "
+                "thread a jax.random key through the kernel instead",
+            )
+        elif name.endswith("PRNGKey") and node.args \
+                and isinstance(node.args[0], ast.Constant):
+            yield ctx.finding(
+                "jit-unkeyed-random",
+                node,
+                "constant-seeded PRNGKey inside a jit body yields the same "
+                "stream every call; take the key as an argument",
+            )

@@ -1,0 +1,80 @@
+"""Parity-citation coverage for action/plugin modules.
+
+Every behavior in `scheduler/actions/` and `scheduler/plugins/` is a
+line-for-line reproduction of a reference component (PARITY.md maps them
+all); the project convention is that each module carries a
+``Parity: reference ...<file>.go:<lines>`` citation in its module
+docstring, and every Action/Plugin entrypoint is covered by a citation in
+its own, its class's, or its module's docstring.  A new action or plugin
+without a citation is unreviewable against the reference — exactly the
+drift the parity suites exist to catch late; this rule catches it at lint
+time.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from volcano_tpu.analysis.core import FileContext, Finding, rule
+
+#: "<something>.go:123" or "<something>.go:123-456"
+CITATION_RE = re.compile(r"[\w./-]+\.go:\d+(?:-\d+)?")
+
+_ENTRYPOINTS = {"execute", "on_session_open"}
+_BASES = {"Action", "Plugin"}
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    if ctx.basename == "__init__.py":
+        return False
+    return any(part in ("actions", "plugins") for part in ctx.dir_parts)
+
+
+def _has_citation(doc: Optional[str]) -> bool:
+    return bool(doc and CITATION_RE.search(doc))
+
+
+@rule(
+    "parity-citation",
+    "action/plugin modules and their entrypoints must carry a reference "
+    "file:line citation (the PARITY.md convention)",
+)
+def check_parity_citation(ctx: FileContext) -> Iterable[Finding]:
+    if not _in_scope(ctx):
+        return
+    module_doc = ast.get_docstring(ctx.tree)
+    module_cited = _has_citation(module_doc)
+    if not module_cited:
+        yield ctx.finding(
+            "parity-citation",
+            1,
+            "module docstring lacks a reference citation "
+            "('Parity: reference <file>.go:<lines>'); every action/plugin "
+            "module must name the reference code it reproduces",
+        )
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = {
+            b.attr if isinstance(b, ast.Attribute) else getattr(b, "id", "")
+            for b in node.bases
+        }
+        if not bases & _BASES:
+            continue
+        class_cited = module_cited or _has_citation(ast.get_docstring(node))
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name not in _ENTRYPOINTS:
+                continue
+            if class_cited or _has_citation(ast.get_docstring(item)):
+                continue
+            yield ctx.finding(
+                "parity-citation",
+                item,
+                f"entrypoint {node.name}.{item.name} has no reference "
+                "citation in its own, its class's, or its module's "
+                "docstring",
+            )

@@ -1,0 +1,162 @@
+"""Session registry completeness for plugins.
+
+`Session` (scheduler/session.py) holds 11 callback registries plus
+`add_event_handler`/`add_tensor_fn`; tier dispatch looks callbacks up BY
+PLUGIN NAME from the conf tiers (session.py `_ordered`).  Two silent
+failure modes follow:
+
+* a typoed registration method (``ssn.add_job_oder_fn``) raises only when
+  the plugin first opens a session — or never, if the path is cold;
+* a registration under a name other than the plugin's own ``name`` is
+  dead: ``_ordered`` will never find it for this plugin's tier entry.
+
+This rule validates every ``ssn.add_*``/``session.add_*`` call against the
+real `Session` class (parsed from source, so the rule can never drift from
+the code), and checks that registrations made inside a Plugin class pass
+``self.name`` (or the literal class ``name``) as the registration name.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional, Set
+
+from volcano_tpu.analysis.core import FileContext, Finding, rule
+
+_RECEIVERS = {"ssn", "session"}
+
+_session_names_cache: Optional[Set[str]] = None
+
+
+def _session_registration_names() -> Set[str]:
+    """The `add_*` method names defined on the real Session class, parsed
+    from its SOURCE — located relative to this package, never imported, so
+    the analyzer executes no scheduler code and the set cannot drift from
+    the file on disk."""
+    global _session_names_cache
+    if _session_names_cache is not None:
+        return _session_names_cache
+    names: Set[str] = set()
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scheduler", "session.py",
+    )
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Session":
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef) and item.name.startswith("add_"):
+                        names.add(item.name)
+    except (OSError, SyntaxError):
+        pass
+    if not names:
+        # source not on disk (zip/bundled install): fall back to the known
+        # registry set rather than accepting everything or flooding
+        # findings against nothing
+        names = {
+            "add_job_order_fn", "add_queue_order_fn", "add_task_order_fn",
+            "add_predicate_fn", "add_node_order_fn", "add_preemptable_fn",
+            "add_reclaimable_fn", "add_overused_fn", "add_job_ready_fn",
+            "add_job_pipelined_fn", "add_job_valid_fn",
+            "add_event_handler", "add_tensor_fn",
+        }
+    _session_names_cache = names
+    return names
+
+
+def _class_name_attr(cls: ast.ClassDef) -> Optional[str]:
+    for item in cls.body:
+        if isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                and isinstance(item.targets[0], ast.Name) \
+                and item.targets[0].id == "name" \
+                and isinstance(item.value, ast.Constant):
+            return item.value.value
+    return None
+
+
+def _is_plugin_class(cls: ast.ClassDef) -> bool:
+    for b in cls.bases:
+        base = b.attr if isinstance(b, ast.Attribute) else getattr(b, "id", "")
+        if base == "Plugin":
+            return True
+    return False
+
+
+def _name_arg_ok(arg: ast.AST, class_name_value: Optional[str]) -> bool:
+    if isinstance(arg, ast.Attribute) and arg.attr == "name" \
+            and isinstance(arg.value, ast.Name) and arg.value.id == "self":
+        return True
+    if isinstance(arg, ast.Constant) and class_name_value is not None \
+            and arg.value == class_name_value:
+        return True
+    return False
+
+
+@rule(
+    "session-registry",
+    "plugin registrations must target real Session registries and "
+    "register under the plugin's own name (tier dispatch is name-keyed)",
+)
+def check_session_registry(ctx: FileContext) -> Iterable[Finding]:
+    valid = _session_registration_names()
+
+    # the class each node belongs to (for the self.name check)
+    plugin_classes = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.ClassDef) and _is_plugin_class(node)
+    ]
+    in_plugin = {}
+    for cls in plugin_classes:
+        cname = _class_name_attr(cls)
+        for sub in ast.walk(cls):
+            in_plugin[id(sub)] = (cls.name, cname)
+        if cname is None:
+            yield ctx.finding(
+                "session-registry",
+                cls,
+                f"Plugin subclass {cls.name} has no literal `name` class "
+                "attribute — conf tiers cannot enable it and registrations "
+                "cannot be dispatched",
+            )
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+            continue
+        recv = node.func.value
+        if not (isinstance(recv, ast.Name) and recv.id in _RECEIVERS):
+            continue
+        method = node.func.attr
+        if not method.startswith("add_"):
+            continue
+        if method not in valid:
+            yield ctx.finding(
+                "session-registry",
+                node,
+                f"{recv.id}.{method}(...) does not match any Session "
+                f"registry (known: {', '.join(sorted(valid))}) — the "
+                "registration would raise AttributeError at session open",
+            )
+            continue
+        cls_info = in_plugin.get(id(node))
+        if cls_info is None:
+            continue  # registrations outside Plugin classes: name check n/a
+        cls_name, cname = cls_info
+        # which positional argument carries the registration name
+        name_idx = None
+        if method == "add_tensor_fn":
+            name_idx = 1  # (kind, name, fn)
+        elif method.endswith("_fn"):
+            name_idx = 0  # (name, fn)
+        if name_idx is None or len(node.args) <= name_idx:
+            continue
+        if not _name_arg_ok(node.args[name_idx], cname):
+            yield ctx.finding(
+                "session-registry",
+                node,
+                f"{cls_name} registers {method} under a name other than "
+                "self.name — tier dispatch is keyed by the plugin's conf "
+                "name, so this callback would never fire for this plugin",
+            )

@@ -1,0 +1,269 @@
+"""Statement safety: every `Statement` commits or discards on every path.
+
+`Statement` (scheduler/statement.py, reference statement.go:26-222) makes
+gang preemption atomic: `evict`/`pipeline` mutate session state eagerly
+and append to an op log; `commit` replays the evictions into the cache,
+`discard` rolls everything back in reverse.  A path that drops a Statement
+without either leaves the SESSION mutated but the CACHE untouched — ghost
+evictions that the next snapshot silently resurrects, the exact bug class
+all-or-nothing preemption exists to prevent.
+
+The rule runs a may-leak dataflow over each function that constructs a
+`Statement(...)`: at every exit of the construction's scope (function end,
+`return`, and the end of each iteration of the loop body that created it —
+including `continue`/`break` out of it), the statement must be CLOSED
+(committed or discarded) on every path.  Passing the statement to a helper
+does not close it; returning/storing it transfers ownership and ends
+tracking.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from volcano_tpu.analysis.core import FileContext, Finding, rule, walk_functions
+
+OPEN, CLOSED, ESCAPED = "open", "closed", "escaped"
+
+_CLOSERS = {"commit", "discard"}
+
+
+def _is_statement_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None
+    )
+    return name == "Statement"
+
+
+class _Outcomes:
+    """States flowing out of a statement list along each exit kind."""
+
+    def __init__(self):
+        self.fall: Optional[Dict[str, str]] = None
+        self.breaks: List[Dict[str, str]] = []
+        self.continues: List[Dict[str, str]] = []
+        self.returns: List[Tuple[Dict[str, str], int]] = []
+
+
+def _join(a: Optional[Dict[str, str]], b: Optional[Dict[str, str]]):
+    if a is None:
+        return dict(b) if b is not None else None
+    if b is None:
+        return dict(a)
+    out = dict(a)
+    for k, v in b.items():
+        prev = out.get(k)
+        if prev is None:
+            out[k] = v
+        elif prev != v:
+            # may-open joins win over closed; escaped wins over everything
+            if ESCAPED in (prev, v):
+                out[k] = ESCAPED
+            else:
+                out[k] = OPEN
+    return out
+
+
+class _Analyzer:
+    def __init__(self, ctx: FileContext, fn: ast.AST):
+        self.ctx = ctx
+        self.fn = fn
+        self.findings: List[Finding] = []
+
+    # -- expression effects ---------------------------------------------------
+
+    def _apply_expr(self, expr: ast.AST, state: Dict[str, str]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _CLOSERS \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in state:
+                    state[f.value.id] = CLOSED
+
+    def _escapes(self, value: ast.AST, state: Dict[str, str]) -> None:
+        """A tracked name used as a whole value (returned, stored, yielded)
+        transfers ownership — stop tracking it."""
+        if isinstance(value, ast.Name) and value.id in state:
+            state[value.id] = ESCAPED
+
+    # -- statement walk -------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        out = self._eval(self.fn.body, {})
+        for st in [out.fall] + [s for s, _ in out.returns]:
+            if st:
+                self._check_all_closed(st, self.fn.lineno,
+                                       "function exit")
+        # breaks/continues at function top level are syntax errors; ignore
+        return self.findings
+
+    def _check_all_closed(self, state: Dict[str, str], line: int, where: str):
+        for var, st in state.items():
+            if st == OPEN:
+                self.findings.append(self.ctx.finding(
+                    "statement-discipline",
+                    line,
+                    f"Statement {var!r} may reach {where} neither "
+                    "committed nor discarded — session state would stay "
+                    "mutated with no cache side effects (ghost evictions)",
+                ))
+                state[var] = ESCAPED  # report once
+
+    def _eval(self, stmts: List[ast.stmt], state: Dict[str, str]) -> _Outcomes:
+        out = _Outcomes()
+        cur: Optional[Dict[str, str]] = dict(state)
+        for stmt in stmts:
+            if cur is None:
+                break  # unreachable
+            cur = self._eval_stmt(stmt, cur, out)
+        out.fall = cur
+        return out
+
+    def _eval_stmt(self, stmt: ast.stmt, state: Dict[str, str],
+                   out: _Outcomes) -> Optional[Dict[str, str]]:
+        if isinstance(stmt, ast.Assign):
+            self._apply_expr(stmt.value, state)
+            if _is_statement_ctor(stmt.value) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                var = stmt.targets[0].id
+                if state.get(var) == OPEN:
+                    self.findings.append(self.ctx.finding(
+                        "statement-discipline",
+                        stmt,
+                        f"Statement {var!r} reassigned while a previous "
+                        "instance may be neither committed nor discarded",
+                    ))
+                state[var] = OPEN
+            else:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id in state:
+                        del state[t.id]
+                self._escapes(stmt.value, state)
+            return state
+        if isinstance(stmt, ast.Expr):
+            self._apply_expr(stmt.value, state)
+            return state
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._apply_expr(stmt.value, state)
+                self._escapes(stmt.value, state)
+            out.returns.append((dict(state), stmt.lineno))
+            return None
+        if isinstance(stmt, ast.Break):
+            out.breaks.append(dict(state))
+            return None
+        if isinstance(stmt, ast.Continue):
+            out.continues.append(dict(state))
+            return None
+        if isinstance(stmt, ast.Raise):
+            return None  # abort paths are not required to close
+        if isinstance(stmt, ast.If):
+            self._apply_expr(stmt.test, state)
+            then = self._eval(stmt.body, state)
+            els = self._eval(stmt.orelse, state)
+            self._merge_inner(out, then)
+            self._merge_inner(out, els)
+            return _join(then.fall, els.fall)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._eval_loop(stmt, state, out)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._apply_expr(item.context_expr, state)
+            inner = self._eval(stmt.body, state)
+            self._merge_inner(out, inner)
+            return inner.fall
+        if isinstance(stmt, ast.Try):
+            body = self._eval(stmt.body, state)
+            self._merge_inner(out, body)
+            merged = _join(body.fall, dict(state))
+            for handler in stmt.handlers:
+                h = self._eval(handler.body, merged or state)
+                self._merge_inner(out, h)
+                merged = _join(merged, h.fall)
+            if stmt.orelse:
+                o = self._eval(stmt.orelse, merged or state)
+                self._merge_inner(out, o)
+                merged = _join(merged, o.fall)
+            if stmt.finalbody:
+                f = self._eval(stmt.finalbody, merged or state)
+                self._merge_inner(out, f)
+                merged = f.fall
+            return merged
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return state  # nested scopes analyzed separately
+        # default: scan every contained expression for closer calls
+        self._apply_expr(stmt, state)
+        return state
+
+    def _merge_inner(self, outer: _Outcomes, inner: _Outcomes):
+        outer.breaks.extend(inner.breaks)
+        outer.continues.extend(inner.continues)
+        outer.returns.extend(inner.returns)
+
+    def _eval_loop(self, stmt, state: Dict[str, str],
+                   out: _Outcomes) -> Optional[Dict[str, str]]:
+        if isinstance(stmt, ast.While):
+            self._apply_expr(stmt.test, state)
+            always_true = isinstance(stmt.test, ast.Constant) and bool(stmt.test.value)
+        else:
+            self._apply_expr(stmt.iter, state)
+            always_true = False
+        pre_vars = set(state)
+        inner = self._eval(stmt.body, state)
+        # returns propagate out of the loop
+        out.returns.extend(inner.returns)
+        # end-of-iteration check: statements created INSIDE the loop body
+        # must be closed when the iteration ends (fallthrough or continue) —
+        # the next iteration would overwrite them
+        for st in ([inner.fall] if inner.fall is not None else []) + inner.continues:
+            created = {k: v for k, v in st.items() if k not in pre_vars}
+            if created:
+                self._check_all_closed(created, stmt.lineno,
+                                       f"the end of the loop iteration "
+                                       f"(loop at line {stmt.lineno})")
+        # loop exit state: breaks + (cond-false entry unless while True) +
+        # post-iteration fallthrough (vars created inside escape-checked
+        # already; keep them as escaped/closed)
+        exit_state: Optional[Dict[str, str]] = None
+        for st in inner.breaks:
+            exit_state = _join(exit_state, st)
+        if not always_true:
+            exit_state = _join(exit_state, {k: v for k, v in state.items()})
+        if inner.fall is not None or inner.continues:
+            carried = None
+            for st in ([inner.fall] if inner.fall is not None else []) + inner.continues:
+                kept = {k: (v if k in pre_vars else
+                            (ESCAPED if v == OPEN else v)) for k, v in st.items()}
+                carried = _join(carried, kept)
+            exit_state = _join(exit_state, carried)
+        if exit_state is None and (inner.fall is not None or not always_true):
+            exit_state = dict(state)
+        if stmt.orelse and exit_state is not None:
+            o = self._eval(stmt.orelse, exit_state)
+            self._merge_inner(out, o)
+            exit_state = o.fall
+        return exit_state
+
+
+@rule(
+    "statement-discipline",
+    "a Statement must be committed or discarded on every control-flow "
+    "path — dropping one leaves ghost session mutations",
+)
+def check_statement_discipline(ctx: FileContext) -> Iterable[Finding]:
+    if "Statement" not in ctx.source:
+        return
+    for fn in walk_functions(ctx.tree):
+        creates = any(
+            _is_statement_ctor(node)
+            for node in ast.walk(fn)
+            if isinstance(node, ast.Call)
+        )
+        if not creates:
+            continue
+        yield from _Analyzer(ctx, fn).run()

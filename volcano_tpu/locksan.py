@@ -1,0 +1,215 @@
+"""Runtime lock-order sanitizer — the dynamic twin of the `lock-order` rule.
+
+`vtlint`'s static lock-order graph proves the SOURCE acyclic; this module
+cross-checks the claim against real multi-process execution.  When
+``VOLCANO_TPU_LOCK_SANITIZER=1`` (``make sanitize`` sets it for the
+daemons suite; child daemon processes inherit it), every lock the
+concurrency-sensitive modules create is wrapped in an instrumented proxy
+that maintains a per-thread acquisition stack and a process-global
+happens-before graph over lock NAMES: acquiring B while holding A records
+the edge A->B, and any acquisition that would close a cycle raises
+:class:`LockOrderError` at the exact offending acquisition site — the
+runtime analogue of the static rule's ABBA finding.
+
+When the env flag is off (the default), the factory functions return the
+plain ``threading`` primitives: zero overhead, zero behavior change.
+
+The wrappers implement the private Condition protocol (``_is_owned`` /
+``_release_save`` / ``_acquire_restore``) so ``threading.Condition`` can
+be constructed over a sanitized lock (the store server's
+``Condition(self.lock)`` pattern keeps working, wait/notify included).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Set, Tuple
+
+ENV_FLAG = "VOLCANO_TPU_LOCK_SANITIZER"
+
+
+class LockOrderError(AssertionError):
+    """Two locks were acquired in conflicting orders on different paths."""
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0", "false", "no")
+
+
+class _OrderGraph:
+    """Process-global order graph over lock names (guarded by a RAW lock —
+    the watcher must not watch itself)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._edges: Dict[str, Set[str]] = {}
+        self._sites: Dict[Tuple[str, str], str] = {}
+        self._tls = threading.local()
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = []
+            self._tls.held = held
+        return held
+
+    def _reachable(self, src: str, dst: str) -> List[str]:
+        """A path src -> ... -> dst in the edge graph, or []."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            cur, path = stack.pop()
+            if cur == dst:
+                return path
+            for nxt in self._edges.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return []
+
+    def on_acquired(self, name: str) -> None:
+        held = self._held()
+        if name in held:  # re-entrant: no new ordering information
+            held.append(name)
+            return
+        with self._mu:
+            for prev in dict.fromkeys(held):  # distinct, order kept
+                if prev == name:
+                    continue
+                back = self._reachable(name, prev)
+                if back:
+                    chain = " -> ".join(back)
+                    first = self._sites.get((back[0], back[1]), "?")
+                    raise LockOrderError(
+                        f"lock-order violation: acquiring {name!r} while "
+                        f"holding {prev!r}, but the reverse order "
+                        f"{chain} was already established (first at "
+                        f"{first}); thread={threading.current_thread().name}"
+                    )
+                if name not in self._edges.get(prev, set()):
+                    self._edges.setdefault(prev, set()).add(name)
+                    self._sites[(prev, name)] = _caller_site()
+        held.append(name)
+
+    def on_released(self, name: str) -> None:
+        held = self._held()
+        # release the innermost matching hold (with-blocks unwind LIFO)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def release_all(self, name: str) -> int:
+        """Pop every hold of ``name`` (Condition.wait's outermost release);
+        returns how many were held."""
+        held = self._held()
+        n = held.count(name)
+        self._tls.held = [h for h in held if h != name]
+        return n
+
+    def snapshot_edges(self) -> Dict[str, Set[str]]:
+        with self._mu:
+            return {k: set(v) for k, v in self._edges.items()}
+
+
+def _caller_site() -> str:
+    import traceback
+
+    for frame in reversed(traceback.extract_stack(limit=12)[:-3]):
+        fn = frame.filename
+        if "locksan" not in fn and "threading" not in fn:
+            return f"{os.path.basename(fn)}:{frame.lineno}"
+    return "?"
+
+
+_GRAPH = _OrderGraph()
+
+
+def reset_graph() -> None:
+    """Drop all recorded ordering (test isolation)."""
+    global _GRAPH
+    _GRAPH = _OrderGraph()
+
+
+class _SanitizedLock:
+    """Instrumented proxy over a threading lock; Condition-compatible."""
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self._name = name
+
+    # -- core lock protocol ---------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            try:
+                _GRAPH.on_acquired(self._name)
+            except LockOrderError:
+                self._inner.release()
+                raise
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _GRAPH.on_released(self._name)
+
+    def __enter__(self) -> "_SanitizedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked() if hasattr(self._inner, "locked") else False
+
+    # -- Condition protocol (threading.Condition over this lock) --------------
+
+    def _is_owned(self) -> bool:
+        f = getattr(self._inner, "_is_owned", None)
+        if f is not None:
+            return f()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        f = getattr(self._inner, "_release_save", None)
+        state = f() if f is not None else self._inner.release()
+        count = _GRAPH.release_all(self._name)
+        return (state, count)
+
+    def _acquire_restore(self, saved) -> None:
+        state, count = saved
+        f = getattr(self._inner, "_acquire_restore", None)
+        if f is not None:
+            f(state)
+        else:
+            self._inner.acquire()
+        for _ in range(max(count, 1)):
+            _GRAPH.on_acquired(self._name)
+
+    def __repr__(self) -> str:
+        return f"<SanitizedLock {self._name!r} over {self._inner!r}>"
+
+
+def make_lock(name: str):
+    """A non-reentrant lock, sanitized when the env flag is set."""
+    if not enabled():
+        return threading.Lock()
+    return _SanitizedLock(threading.Lock(), name)
+
+
+def make_rlock(name: str):
+    """A reentrant lock, sanitized when the env flag is set."""
+    if not enabled():
+        return threading.RLock()
+    return _SanitizedLock(threading.RLock(), name)
+
+
+def make_condition(name: str):
+    """A Condition over its own (sanitized) reentrant lock."""
+    return threading.Condition(make_rlock(name))

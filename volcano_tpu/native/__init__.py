@@ -21,6 +21,8 @@ from typing import Optional
 
 import numpy as np
 
+from volcano_tpu.locksan import make_lock
+
 # the source ships inside the package so an installed wheel
 # (`pip install .`) carries it; the on-demand build compiles next to the
 # source when the directory is writable, else under a per-user cache dir
@@ -55,7 +57,7 @@ def _lib_path() -> str:
 
 _LIB = _lib_path()
 
-_lock = threading.Lock()
+_lock = make_lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 

@@ -87,10 +87,15 @@ class PreemptAction(Action):
                         assigned = True
 
                     if ssn.job_pipelined(preemptor_job):
-                        stmt.commit()
                         break
 
-                if not ssn.job_pipelined(preemptor_job):
+                # settle the statement on EVERY path out of the task loop
+                # (the reference commits inside the loop, preempt.go:132;
+                # equivalent — nothing runs between its commit and the
+                # break — and this shape is provably commit-or-discard)
+                if ssn.job_pipelined(preemptor_job):
+                    stmt.commit()
+                else:
                     stmt.discard()
                     continue
 

@@ -22,6 +22,7 @@ from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 from volcano_tpu import events
+from volcano_tpu.locksan import make_condition
 
 #: cap on the event-aggregation index (pod keys churn in a long-lived
 #: daemon; entries beyond this fall back to fresh Event objects)
@@ -33,7 +34,7 @@ class AsyncApplier:
         self.cache = cache
         self.store = cache.store
         self.batch_max = batch_max
-        self._cv = threading.Condition()
+        self._cv = make_condition("AsyncApplier._cv")
         self._q: deque = deque()  # ("bind", key, hostname) | ("evict", key, reason)
         #: decisions submitted but not yet confirmed — read by snapshot().
         #: _pending counts queued+applying ops per (verb, key): a marker is

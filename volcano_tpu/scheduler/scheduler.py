@@ -60,6 +60,12 @@ class Scheduler:
         elector=None,  # optional LeaderElector; HA analogue of server.go:107-138
     ):
         self.conf = conf or default_conf()
+        # written by prewarm's device toucher on a failed handshake as a
+        # (generation, repr) record; read through the prewarm_device_error
+        # property, which filters stale generations — a toucher from an
+        # earlier prewarm can never clobber the current call's verdict
+        self._prewarm_err_rec = None
+        self._prewarm_gen = 0
         self.cache = SchedulerCache(
             store,
             scheduler_name=scheduler_name,
@@ -98,6 +104,15 @@ class Scheduler:
 
             self.fast_cycle = FastCycle(self)
 
+    @property
+    def prewarm_device_error(self):
+        """repr of the CURRENT prewarm's device-handshake failure, or None.
+        Records from superseded prewarm calls are filtered by generation."""
+        rec = self._prewarm_err_rec
+        if rec is not None and rec[0] == self._prewarm_gen:
+            return rec[1]
+        return None
+
     def prewarm(self, bucket_levels: int = 1,
                 background: bool = True) -> float:
         """Compile the cycle's device solves before the first real cycle.
@@ -121,6 +136,9 @@ class Scheduler:
         discarded: no session close, no store writes.  Returns blocking
         wall-clock seconds (0.0 when the backend needs no warm-up); the
         background thread is joinable via ``prewarm_background``."""
+        # bumping the generation invalidates any earlier toucher's record
+        # (prewarm_device_error filters by current generation at read time)
+        self._prewarm_gen += 1
         if self.conf.backend != "tpu":
             return 0.0
         import threading
@@ -129,13 +147,20 @@ class Scheduler:
 
         t0 = time.perf_counter()
 
+        gen = self._prewarm_gen
+
         def _touch_device():
             try:
                 import jax.numpy as jnp
 
                 jnp.zeros((1,), jnp.float32).block_until_ready()
-            except Exception:  # noqa: BLE001 — surfaces on first real use
-                pass
+            except Exception as e:  # noqa: BLE001 — surfaces on first real use
+                # recorded, not swallowed: lets an operator distinguish
+                # "device handshake failed at startup" from "first cycle
+                # is slow" without waiting for the first real dispatch
+                # (single atomic assignment; stale generations are
+                # filtered by the reader, so no check-then-write race)
+                self._prewarm_err_rec = (gen, repr(e))
 
         # device/tunnel handshake overlaps the host-side mirror sync
         toucher = threading.Thread(target=_touch_device, daemon=True)

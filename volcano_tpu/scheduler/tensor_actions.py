@@ -250,9 +250,13 @@ def preempt(ssn) -> None:
                 if ok:
                     assigned = True
                 if ssn.job_pipelined(preemptor_job):
-                    stmt.commit()
                     break
-            if not ssn.job_pipelined(preemptor_job):
+            # settle the statement on EVERY path out of the task loop (the
+            # reference commits inside the loop; equivalent, and provably
+            # commit-or-discard — see actions/preempt.py)
+            if ssn.job_pipelined(preemptor_job):
+                stmt.commit()
+            else:
                 stmt.discard()
                 driver.restore(ckpt)
                 continue

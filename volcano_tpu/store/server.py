@@ -30,6 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from volcano_tpu.locksan import make_lock, make_rlock
 from volcano_tpu.store.codec import (
     KIND_CLASSES,
     decode_fields,
@@ -55,7 +56,10 @@ class StoreServer:
     ):
         self.store = store or Store()
         self.admission = admission
-        self.lock = threading.RLock()
+        # lock-order contract (enforced statically by vtlint `lock-order`
+        # and at runtime by the env-gated sanitizer, `make sanitize`):
+        # _flush_lock is always taken BEFORE lock, never the reverse
+        self.lock = make_rlock("StoreServer.lock")
         self.cond = threading.Condition(self.lock)
         self.log: List[Dict[str, Any]] = []
         self.seq = 0
@@ -77,7 +81,7 @@ class StoreServer:
         # shutdown flush): encode+write happen under this lock so a stale
         # snapshot can never overwrite a fresher one, and the shared tmp
         # path is never written by two threads at once
-        self._flush_lock = threading.Lock()
+        self._flush_lock = make_lock("StoreServer._flush_lock")
         # per-kind encoded cache: only kinds dirtied since the last flush
         # re-encode, so steady-state lease renewals don't pay a full-store
         # serialization under the server lock every interval
@@ -176,6 +180,8 @@ class StoreServer:
                 if len(parts) == 2 and parts[0] == "apis":
                     try:
                         code, payload = server.create(parts[1], self._body())
+                        if code < 400:  # failed verbs wrote nothing
+                            server._maybe_flush()
                     except Exception as e:  # noqa: BLE001 — wire boundary
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
@@ -193,6 +199,8 @@ class StoreServer:
                             parts[1], key, body.get("fields") or {},
                             when=body.get("when"),
                         )
+                        if code < 400:
+                            server._maybe_flush()
                     except Exception as e:  # noqa: BLE001
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
@@ -209,6 +217,8 @@ class StoreServer:
                             parts[1], self._body(),
                             expected_rv=int(cas) if cas is not None else None,
                         )
+                        if code < 400:
+                            server._maybe_flush()
                     except Exception as e:  # noqa: BLE001
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
@@ -223,8 +233,7 @@ class StoreServer:
                     with server.lock:
                         obj = server.store.delete(parts[1], key)
                         server._pump_log()
-                    if server._sync_persist:
-                        server.flush_state()
+                    server._maybe_flush()
                     return self._reply(200, {"deleted": obj is not None})
                 return self._reply(404, {"error": "no route"})
 
@@ -235,7 +244,18 @@ class StoreServer:
 
     # -- mutations (called from handler threads, locked) ----------------------
 
-    def create(self, kind: str, data: Dict[str, Any], _flush: bool = True,
+    def _maybe_flush(self) -> None:
+        """Sync-persist flush, called by the HTTP handlers (and bulk) AFTER
+        the mutation verb returns — never from inside the verbs, so no code
+        path can hold ``self.lock`` while taking ``_flush_lock``.  The
+        saver/shutdown flusher takes ``_flush_lock`` BEFORE ``self.lock``;
+        flushing under the server lock would be an ABBA deadlock, and the
+        vtlint ``lock-order`` rule now proves the order structurally
+        instead of by caller convention."""
+        if self._sync_persist:
+            self.flush_state()
+
+    def create(self, kind: str, data: Dict[str, Any],
                _encode_response: bool = True):
         obj = decode_object(kind, data.get("object", {}))
         if kind == "Job" and self.admission:
@@ -252,17 +272,12 @@ class StoreServer:
             if kind != "Job":  # admission may have mutated a Job
                 self._stage_enc_hint(kind, obj, data.get("object"))
             self._pump_log()
-        if self._sync_persist and _flush:
-            # outside self.lock: the saver/shutdown flusher takes
-            # _flush_lock before self.lock, so flushing while holding the
-            # server lock would be an ABBA deadlock
-            self.flush_state()
         # bulk discards per-op bodies — a full object encode per op was a
         # third of the server-side cost of a 100k-op batch
         return 201, {"object": encode(obj)} if _encode_response else {}
 
-    def update(self, kind: str, data: Dict[str, Any], expected_rv: Optional[int] = None,
-               _flush: bool = True):
+    def update(self, kind: str, data: Dict[str, Any],
+               expected_rv: Optional[int] = None):
         obj = decode_object(kind, data.get("object", {}))
         with self.lock:
             old = self.store.get(kind, obj.meta.key)
@@ -284,12 +299,10 @@ class StoreServer:
             self.store.update(kind, obj)
             self._stage_enc_hint(kind, obj, data.get("object"))
             self._pump_log()
-        if self._sync_persist and _flush:
-            self.flush_state()
         return 200, {"object": encode(obj)}
 
     def patch(self, kind: str, key: str, fields: Dict[str, Any],
-              when: Dict[str, Any] = None, _flush: bool = True,
+              when: Dict[str, Any] = None,
               _encode_response: bool = True):
         if kind == "Job" and self.admission:
             # spec-freeze admission compares whole objects; field patches
@@ -308,8 +321,6 @@ class StoreServer:
             except PreconditionFailed as e:
                 return 409, {"error": repr(e)}
             self._pump_log()
-        if self._sync_persist and _flush:
-            self.flush_state()
         return 200, {"object": encode(obj)} if _encode_response else {}
 
     def bulk(self, ops: List[Dict[str, Any]]) -> List[Optional[str]]:
@@ -327,19 +338,19 @@ class StoreServer:
                     if verb == "create":
                         code, payload = self.create(
                             kind, {"object": op.get("object", {})},
-                            _flush=False, _encode_response=False,
+                            _encode_response=False,
                         )
                         ok = code == 201
                     elif verb == "update":
                         code, payload = self.update(
                             kind, {"object": op.get("object", {})},
-                            expected_rv=op.get("cas"), _flush=False,
+                            expected_rv=op.get("cas"),
                         )
                         ok = code == 200
                     elif verb == "patch":
                         code, payload = self.patch(
                             kind, op.get("key", ""), op.get("fields") or {},
-                            when=op.get("when"), _flush=False,
+                            when=op.get("when"),
                             _encode_response=False,
                         )
                         ok = code == 200
@@ -357,8 +368,7 @@ class StoreServer:
                     results.append(None if ok else payload.get("error", "failed"))
                 except Exception as e:  # noqa: BLE001 — per-op isolation
                     results.append(repr(e))
-        if self._sync_persist:
-            self.flush_state()
+        self._maybe_flush()
         return results
 
     def _patch_col(self, op: Dict[str, Any]) -> List[Optional[str]]:

@@ -75,8 +75,9 @@ class Store:
     """
 
     def __init__(self):
-        import threading
         import uuid
+
+        from volcano_tpu.locksan import make_rlock
 
         #: lineage identity: survives pickling (vtctl state) and the store
         #: server's durable state file, so a mirror checkpoint can tell
@@ -92,8 +93,10 @@ class Store:
         self._rv = 0
         # mutation lock: the async applier writes from its own thread while
         # the owning thread reads/writes (StoreServer adds its own RLock on
-        # top for multi-client HTTP, which nests fine)
-        self._mu = threading.RLock()
+        # top for multi-client HTTP, which nests fine: server.lock is
+        # always taken before _mu, never the reverse — the store never
+        # calls back into the server)
+        self._mu = make_rlock("Store._mu")
 
     def __getstate__(self):
         # the mutation lock is process-local (vtctl pickles the simulated
@@ -103,10 +106,10 @@ class Store:
         return state
 
     def __setstate__(self, state):
-        import threading
+        from volcano_tpu.locksan import make_rlock
 
         self.__dict__.update(state)
-        self._mu = threading.RLock()
+        self._mu = make_rlock("Store._mu")
 
     def _watched(self, kind: str) -> bool:
         return bool(self._watchers[kind])
