@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench native examples install clean images image image-tpu lint sanitize
+.PHONY: test e2e parity bench native examples install clean images image image-tpu lint sanitize chaos
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -13,6 +13,14 @@ lint:
 
 test: lint
 	$(PY) -m pytest tests/ -q
+
+# seeded chaos soak (volcano_tpu/chaos.py + tests/test_chaos_soak.py):
+# deterministic fault plans on the store bus — 5xx bursts, mid-body cuts,
+# watch-log truncation, lease clock skew — each must converge to the same
+# final placements as a fault-free run, invariants intact.  The smoke
+# variant is slow-exempt and runs in tier-1; this target runs every plan.
+chaos:
+	$(PY) -m pytest tests/test_chaos_soak.py -q
 
 # the daemons suite with the runtime lock-order sanitizer on: every lock
 # acquisition in the multi-process control plane is order-checked against

@@ -14,6 +14,9 @@ from volcano_tpu.api import (
 from volcano_tpu.api.objects import Metadata, Node, Pod, PodSpec
 from volcano_tpu.api.types import PodPhase
 from volcano_tpu.store import Store
+# the shared deadline-bounded readiness probe for server-backed tests —
+# use this instead of ad-hoc /healthz polling loops
+from volcano_tpu.store.client import wait_healthy  # noqa: F401
 
 
 def build_node(name: str, cpu="4", memory="8Gi", pods: int = 110, labels=None, **scalars) -> Node:

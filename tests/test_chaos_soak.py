@@ -1,0 +1,670 @@
+"""Chaos soak: seeded fault schedules on the store bus, convergence asserted.
+
+The recovery machinery this suite tortures already exists — daemon outage
+guards, StaleWatch relists, lease CAS, gang all-or-nothing — but the plain
+suite only ever exercises it with *clean* failures (whole-process restarts
+in test_e2e_recovery.py).  Here a deterministic FaultPlan
+(volcano_tpu/chaos.py) injects the messy ones: 5xx bursts, responses cut
+mid-body, watch-log truncation below live cursors, dropped flushes, and
+lease clock skew — and after every storm the system must converge to the
+SAME final placements a fault-free run produces, with every invariant the
+system promises still holding:
+
+  * no double-bind / node oversubscription (capacity conserved),
+  * gang all-or-nothing (a job is fully placed or holds nothing),
+  * no orphaned pods (every pod's job exists),
+  * every Statement settled (runtime twin of the statement-discipline rule),
+  * exactly one leader per component after lease churn.
+
+``make chaos`` runs the whole file; the smoke variant is tier-1 (not
+``slow``) so every CI run exercises the injection layer end to end.
+"""
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from volcano_tpu.api.job import JOB_NAME_KEY, Job, JobSpec, TaskSpec
+from volcano_tpu.api.objects import Metadata, Node, PodSpec, Queue
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobPhase, PodPhase
+from volcano_tpu.backoff import Backoff
+from volcano_tpu.chaos import FaultPlan, chaos_clock
+from volcano_tpu.controller import JobController
+from volcano_tpu.leader import LeaderElector
+from volcano_tpu.scheduler import statement
+from volcano_tpu.scheduler.conf import full_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.store.client import (
+    RemoteStore,
+    RemoteStoreError,
+    StaleWatch,
+    wait_healthy,
+)
+from volcano_tpu.store.server import StoreServer
+
+TRANSIENT = (RemoteStoreError, OSError, http.client.HTTPException)
+
+#: the three acceptance fault plans — seeded, bounded (every storm ends),
+#: and aimed at different layers: the request plane, the watch/event
+#: plane, and the leader-election plane
+PLAN_5XX_BURST = {
+    "seed": 101,
+    "rules": [
+        # every 3rd API request 503s for a while: outage guards + backoff
+        {"point": "server.request", "action": "http_500",
+         "every": 3, "count": 40},
+    ],
+}
+PLAN_CUT_AND_TRUNCATE = {
+    "seed": 202,
+    "rules": [
+        # responses cut mid-body: IncompleteRead on the client, absorbed
+        # by the idempotent-GET retry or surfaced to the outage guards
+        {"point": "server.request", "action": "cut_body",
+         "after": 5, "every": 7, "count": 15},
+        # watch log truncated under live cursors: StaleWatch relists
+        {"point": "server.request", "action": "truncate_log",
+         "match": {"path": "/watch"}, "after": 3, "every": 11, "count": 5},
+    ],
+}
+#: applied to ONE candidate's clock via chaos_clock, in alternating
+#: multi-read BURSTS: a +40s burst makes the healthy holder's lease look
+#: expired to the skewed candidate (steal), a -40s burst makes the skewed
+#: holder write stale renew timestamps until the healthy candidate steals
+#: it back — at least two real lease transitions whichever candidate wins
+#: the initial create race, then the plan exhausts and one leader remains
+PLAN_LEASE_FLAP = {
+    "seed": 303,
+    "rules": [
+        {"point": "leader.clock", "action": "skew", "arg": 40.0,
+         "after": 2, "every": 1, "count": 6},
+        {"point": "leader.clock", "action": "skew", "arg": -40.0,
+         "after": 12, "every": 1, "count": 6},
+        {"point": "leader.clock", "action": "skew", "arg": 40.0,
+         "after": 22, "every": 1, "count": 6},
+        {"point": "leader.clock", "action": "skew", "arg": -40.0,
+         "after": 32, "every": 1, "count": 6},
+    ],
+}
+
+
+def _arm(url: str, plan):
+    data = json.dumps(plan).encode() if plan is not None else None
+    req = urllib.request.Request(
+        url + "/chaos", data=data,
+        method="POST" if plan is not None else "DELETE",
+    )
+    return json.load(urllib.request.urlopen(req, timeout=10))
+
+
+def _mk_job(name, replicas, cpu="1", queue="default"):
+    return Job(
+        meta=Metadata(name=name, namespace="soak"),
+        spec=JobSpec(
+            min_available=replicas,  # strict gang: all-or-nothing
+            queue=queue,
+            tasks=[TaskSpec(name="w", replicas=replicas,
+                            template=PodSpec(
+                                image="busybox",
+                                resources=Resource.from_resource_list(
+                                    {"cpu": cpu, "memory": "1Gi"})))],
+        ),
+    )
+
+
+class ControlPlane:
+    """Controller + scheduler(s) + kubelet as threads over real HTTP, each
+    with the daemon-grade outage discipline (backoff on transients,
+    rebuild on StaleWatch) from cli/daemons.py — same wire path as the
+    subprocess daemons, but fast and with the electors inspectable."""
+
+    def __init__(self, url, elect=False, flap_plan=None):
+        self.url = url
+        self.stop = threading.Event()
+        self.threads = []
+        self.electors = {"vk-scheduler": [], "vk-controllers": []}
+        self.crashes = []  # unexpected (non-transient) loop deaths
+        self._elect = elect
+        self._flap_plan = flap_plan
+
+    def _elector(self, store, component, ident, flapped):
+        if not self._elect:
+            return None
+        clock = None
+        if flapped and self._flap_plan is not None:
+            clock = chaos_clock(self._flap_plan)
+        # tight candidate pacing so standbys observe even short skew
+        # windows; production keeps the 5 s default cap
+        e = LeaderElector(store, component, ident, clock=clock,
+                          backoff=Backoff(base=0.01, cap=0.05, seed=5))
+        self.electors[component].append(e)
+        return e
+
+    def _controller_loop(self, ident, flapped):
+        retry = Backoff(base=0.02, cap=0.3, seed=21)
+        ctl = None
+        while not self.stop.is_set():
+            try:
+                if ctl is None:
+                    store = RemoteStore(self.url)
+                    ctl = JobController(store, elector=self._elector(
+                        store, "vk-controllers", ident, flapped))
+                ctl.pump()
+                retry.reset()
+            except StaleWatch:
+                ctl = None  # relist via a fresh build, as the daemon does
+                continue
+            except TRANSIENT:
+                ctl = None
+                retry.sleep()
+                continue
+            self.stop.wait(0.02)
+
+    def _scheduler_loop(self, ident, flapped):
+        retry = Backoff(base=0.02, cap=0.3, seed=22)
+        sched = None
+        while not self.stop.is_set():
+            try:
+                if sched is None:
+                    store = RemoteStore(self.url)
+                    sched = Scheduler(store, conf=full_conf(),
+                                      elector=self._elector(
+                                          store, "vk-scheduler", ident,
+                                          flapped))
+                sched.run_once()
+                retry.reset()
+            except TRANSIENT:
+                retry.sleep()
+                continue
+            self.stop.wait(0.02)
+
+    def _kubelet_loop(self):
+        from volcano_tpu.store.store import Conflict
+
+        store = RemoteStore(self.url)
+        retry = Backoff(base=0.02, cap=0.3, seed=23)
+        while not self.stop.is_set():
+            try:
+                for pod in store.list("Pod"):
+                    if pod.deleting:
+                        store.delete("Pod", pod.meta.key)
+                    elif pod.node_name and pod.phase == PodPhase.PENDING:
+                        rv = pod.meta.resource_version
+                        pod.phase = PodPhase.RUNNING
+                        try:
+                            store.update_cas("Pod", pod, rv)
+                        except (Conflict, KeyError):
+                            pass
+                retry.reset()
+            except TRANSIENT:
+                retry.sleep()
+                continue
+            self.stop.wait(0.02)
+
+    def _guard(self, fn, *args):
+        def run():
+            try:
+                fn(*args)
+            except Exception as e:  # noqa: BLE001 — surfaced in teardown
+                self.crashes.append(repr(e))
+        return run
+
+    def start(self, schedulers=1, controllers=1, flap_component=""):
+        specs = []
+        for i in range(controllers):
+            flapped = flap_component == "vk-controllers" and i == 1
+            specs.append((self._controller_loop, f"ctl-{i}", flapped))
+        for i in range(schedulers):
+            flapped = flap_component == "vk-scheduler" and i == 1
+            specs.append((self._scheduler_loop, f"sched-{i}", flapped))
+        for fn, ident, flapped in specs:
+            t = threading.Thread(target=self._guard(fn, ident, flapped),
+                                 daemon=True)
+            t.start()
+            self.threads.append(t)
+        t = threading.Thread(target=self._guard(self._kubelet_loop),
+                             daemon=True)
+        t.start()
+        self.threads.append(t)
+        return self
+
+    def shutdown(self):
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=20)
+        assert not self.crashes, f"control-plane loop crashed: {self.crashes}"
+
+
+def _submit(client, obj, deadline=60.0, kind="Job"):
+    """Create through the storm: transient failures retry with backoff; a
+    409 means an earlier attempt actually committed (success)."""
+    retry = Backoff(base=0.02, cap=0.3, seed=31)
+    end = time.monotonic() + deadline
+    while True:
+        try:
+            client.create(kind, obj)
+            return
+        except KeyError:
+            return
+        except TRANSIENT:
+            if time.monotonic() > end:
+                raise
+            retry.sleep()
+
+
+def _wait_running(client, key, deadline=90.0):
+    retry = Backoff(base=0.02, cap=0.3, seed=32)
+    end = time.monotonic() + deadline
+    job = None
+    while time.monotonic() < end:
+        try:
+            job = client.get("Job", key)
+            if job is not None and job.status.state.phase == JobPhase.RUNNING:
+                return job
+            retry.reset()
+        except TRANSIENT:
+            pass
+        retry.sleep()
+    raise AssertionError(
+        f"{key} never reached Running; last status: {job and job.status}")
+
+
+def _placements(client):
+    return sorted(
+        (p.meta.key, p.node_name)
+        for p in client.list("Pod") if p.phase == PodPhase.RUNNING
+    )
+
+
+def _check_invariants(client):
+    nodes = {n.meta.name: n for n in client.list("Node")}
+    pods = client.list("Pod")
+    jobs = client.list("Job")
+
+    # no orphaned pods: every pod belongs to a live job
+    job_names = {j.meta.name for j in jobs}
+    for p in pods:
+        assert p.meta.annotations.get(JOB_NAME_KEY) in job_names, (
+            f"orphaned pod {p.meta.key}")
+
+    # no double-bind / oversubscription: resident requests fit every node
+    used = {name: Resource() for name in nodes}
+    for p in pods:
+        if p.node_name and p.phase in (PodPhase.PENDING, PodPhase.RUNNING):
+            assert p.node_name in nodes, f"{p.meta.key} bound to ghost node"
+            used[p.node_name].add(p.spec.resources)
+    for name, u in used.items():
+        assert u.less_equal(nodes[name].allocatable), (
+            f"node {name} oversubscribed")
+
+    # gang all-or-nothing: a Running job holds its full gang; any other
+    # phase holds nothing
+    for j in jobs:
+        bound = [p for p in pods
+                 if p.meta.annotations.get(JOB_NAME_KEY) == j.meta.name
+                 and p.node_name]
+        if j.status.state.phase == JobPhase.RUNNING:
+            assert len(bound) >= j.spec.min_available, (
+                f"{j.meta.name}: partial gang {len(bound)}"
+                f"/{j.spec.min_available}")
+        else:
+            assert not bound, (
+                f"{j.meta.name} is {j.status.state.phase} but holds "
+                f"{len(bound)} bound pods")
+
+    # every Statement settled (in-process schedulers share the counter)
+    assert statement.outstanding() == 0, "unsettled scheduler Statements"
+
+
+def _soak(plan, n_jobs=3, replicas=2, elect=False, flap_component="",
+          schedulers=1, controllers=1, queues=("default",)):
+    """One seeded storm: bring up the control plane, arm the plan, drive
+    the workload through it, disarm, converge, check invariants.  Returns
+    the final placements for parity against a fault-free run."""
+    srv = StoreServer().start()
+    flap_plan = FaultPlan.from_dict(PLAN_LEASE_FLAP) if flap_component else None
+    cp = ControlPlane(srv.url, elect=elect, flap_plan=flap_plan)
+    try:
+        assert wait_healthy(srv.url, timeout=10)
+        for i, qname in enumerate(queues):
+            srv.store.create("Queue", Queue(
+                meta=Metadata(name=qname, namespace=""), weight=i + 1))
+        for i in range(3):
+            srv.store.create("Node", Node(
+                meta=Metadata(name=f"n{i}", namespace=""),
+                allocatable=Resource.from_resource_list(
+                    {"cpu": "4", "memory": "8Gi", "pods": 110})))
+        cp.start(schedulers=schedulers, controllers=controllers,
+                 flap_component=flap_component)
+        if plan is not None:
+            _arm(srv.url, plan)
+
+        client = RemoteStore(srv.url)
+        # sequential gang submission: placement is deterministic, so a
+        # faulted run must land exactly where the fault-free run does
+        for i in range(n_jobs):
+            job = _mk_job(f"cj{i}", replicas,
+                          queue=queues[i % len(queues)])
+            _submit(client, job)
+            _wait_running(client, f"soak/cj{i}")
+
+        # storm over (plans are bounded); disarm and let the plane settle
+        _arm(srv.url, None)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(client.get("Job", f"soak/cj{i}").status.state.phase
+                   == JobPhase.RUNNING for i in range(n_jobs)):
+                break
+            time.sleep(0.1)
+
+        if flap_plan is not None:
+            # the clock-skew bursts are indexed by the flapped candidate's
+            # clock READS, which keep accruing while the loops run — hold
+            # the plane under churn until every burst has played out, then
+            # give the final takeover a moment to land
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                if all(r.hits >= r.after + r.count * r.every
+                       for r in flap_plan.rules):
+                    break
+                time.sleep(0.1)
+            time.sleep(1.0)
+
+        _check_invariants(client)
+
+        leases = {}
+        if elect:
+            # exactly one leader per component survives the churn
+            for component, electors in cp.electors.items():
+                if not electors:
+                    continue
+                leaders = [e.identity for e in electors if e.is_leader()]
+                assert len(set(leaders)) == 1, (
+                    f"{component}: leaders after churn = {leaders}")
+                leases[component] = client.get("Lease", f"/{component}")
+        placements = _placements(client)
+        if plan is not None:
+            status = json.load(urllib.request.urlopen(
+                srv.url + "/chaos", timeout=10))
+            assert not status["armed"]
+        return placements, leases
+    finally:
+        cp.shutdown()
+        srv.stop()
+
+
+# -- chaos primitives (tier-1) -------------------------------------------------
+
+
+def test_fault_plan_is_deterministic():
+    """Two plans with the same seed fire on exactly the same hits — the
+    whole determinism contract (counters + per-rule seeded streams)."""
+    spec = {"seed": 42, "rules": [
+        {"point": "server.request", "action": "http_500",
+         "after": 3, "every": 2, "count": 10, "prob": 0.5},
+    ]}
+    a, b = FaultPlan.from_dict(spec), FaultPlan.from_dict(spec)
+    fires_a = [a.fire("server.request", "GET", "/apis/Pod") is not None
+               for _ in range(100)]
+    fires_b = [b.fire("server.request", "GET", "/apis/Pod") is not None
+               for _ in range(100)]
+    assert fires_a == fires_b
+    assert 1 <= sum(fires_a) <= 10  # count cap respected, prob thinned
+    assert not any(fires_a[:3])  # `after` skipped the first hits
+    # a different seed shifts the prob draws
+    c = FaultPlan.from_dict({**spec, "seed": 43})
+    fires_c = [c.fire("server.request", "GET", "/apis/Pod") is not None
+               for _ in range(100)]
+    assert fires_a != fires_c
+
+
+def test_fault_plan_overlapping_rules_keep_independent_budgets():
+    """A hit consumed by an earlier rule must not burn a later rule's
+    fire/count budget — stats stay honest and the later rule still
+    delivers its full schedule once the earlier one exhausts."""
+    plan = FaultPlan.from_dict({"seed": 1, "rules": [
+        {"point": "server.request", "action": "http_500", "count": 2},
+        {"point": "server.request", "action": "delay", "count": 3},
+    ]})
+    actions = [r.action for r in
+               (plan.fire("server.request") for _ in range(10)) if r]
+    # rule 0 wins its first 2 hits, then rule 1 delivers ALL 3 of its own
+    assert actions == ["http_500", "http_500", "delay", "delay", "delay"]
+    st = plan.stats()
+    assert st[0]["fires"] == 2 and st[1]["fires"] == 3
+    assert st[0]["hits"] == st[1]["hits"] == 10
+
+
+def test_fault_plan_rejects_unknown_points_and_actions():
+    from volcano_tpu.chaos import ChaosPlanError
+
+    with pytest.raises(ChaosPlanError):
+        FaultPlan.from_dict({"rules": [{"point": "nope", "action": "delay"}]})
+    with pytest.raises(ChaosPlanError):
+        FaultPlan.from_dict(
+            {"rules": [{"point": "server.flush", "action": "http_500"}]})
+
+
+def test_chaos_endpoint_arm_status_disarm():
+    srv = StoreServer().start()
+    try:
+        status = json.load(urllib.request.urlopen(srv.url + "/chaos"))
+        assert status == {"armed": False, "plan": None, "stats": []}
+        out = _arm(srv.url, PLAN_5XX_BURST)
+        assert out["armed"] and out["plan"]["seed"] == 101
+        # a malformed plan is rejected and the old plan stays armed
+        req = urllib.request.Request(
+            srv.url + "/chaos",
+            data=json.dumps({"rules": [{"point": "bogus"}]}).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 422
+        assert json.load(urllib.request.urlopen(srv.url + "/chaos"))["armed"]
+        assert not _arm(srv.url, None)["armed"]
+    finally:
+        srv.stop()
+
+
+def test_idempotent_get_retries_connection_cut_once():
+    """A single injected reset/cut on a GET is absorbed; two surface; a
+    cut POST is never retried (it may have committed server-side)."""
+    srv = StoreServer().start()
+    try:
+        seed = RemoteStore(srv.url)
+        seed.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+
+        one = RemoteStore(srv.url, chaos=FaultPlan.from_dict(
+            {"rules": [{"point": "client.request", "action": "os_error",
+                        "count": 1}]}))
+        assert [q.meta.name for q in one.list("Queue")] == ["q"]
+
+        two = RemoteStore(srv.url, chaos=FaultPlan.from_dict(
+            {"rules": [{"point": "client.request", "action": "os_error",
+                        "count": 2}]}))
+        with pytest.raises(ConnectionResetError):
+            two.list("Queue")
+
+        post = RemoteStore(srv.url, chaos=FaultPlan.from_dict(
+            {"rules": [{"point": "client.request", "action": "os_error",
+                        "match": {"method": "POST"}, "count": 1}]}))
+        with pytest.raises(ConnectionResetError):
+            post.create("Queue", Queue(meta=Metadata(name="x", namespace="")))
+        assert seed.get("Queue", "/x") is None  # nothing committed
+    finally:
+        srv.stop()
+
+
+def test_drop_flush_injects_durability_gap(tmp_path):
+    """server.flush drop: the acked write is missing from the state file
+    until the NEXT flush — the documented crash window, on demand."""
+    state = str(tmp_path / "state.json")
+    # never started: flushes driven by hand, no HTTP traffic needed
+    srv = StoreServer(state_path=state, save_interval=0)
+    srv.arm_chaos(FaultPlan.from_dict(
+        {"rules": [{"point": "server.flush", "action": "drop_flush",
+                    "count": 1}]}))
+    srv.store.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    srv.flush_state()  # dropped
+    import os
+    assert not os.path.exists(state)
+    srv.flush_state()  # next flush catches up (kinds stayed dirty)
+    assert json.load(open(state))["kinds"]["Queue"]
+
+
+def test_wait_healthy_deadline_and_recovery():
+    assert not wait_healthy("http://127.0.0.1:9", timeout=0.5)
+    srv = StoreServer().start()
+    try:
+        assert wait_healthy(srv.url, timeout=5)
+    finally:
+        srv.stop()
+
+
+def test_fastpath_mirror_relists_after_log_truncation():
+    """Satellite: the scheduler fastpath mirror's StaleWatch recovery —
+    truncate the server log under an ACTIVE mirror (not just a raw
+    client) and assert it relists and converges to store truth."""
+    from volcano_tpu.scheduler.fastpath import ArrayMirror
+    from tests.helpers import build_node, build_pod, build_podgroup
+
+    srv = StoreServer().start()
+    try:
+        writer = RemoteStore(srv.url)
+        writer.create("Queue", Queue(meta=Metadata(name="default",
+                                                   namespace="")))
+        writer.create("Node", build_node("n0"))
+        writer.create("PodGroup", build_podgroup("pg", min_member=1))
+        writer.create("Pod", build_pod("p0", group="pg"))
+
+        mirror_store = RemoteStore(srv.url)
+        m = ArrayMirror(mirror_store, "volcano-tpu", "default")
+        m.drain()  # full sync
+        assert int(m.p_live.sum()) == 1 and m.stale_relists == 0
+
+        # mutate while the mirror's cursor lags, then truncate the log
+        # under it via the armed faultpoint: the next poll must relist
+        writer.create("Pod", build_pod("p1", group="pg"))
+        writer.delete("Pod", "default/p0")
+        _arm(srv.url, {"seed": 9, "rules": [
+            {"point": "server.request", "action": "truncate_log",
+             "match": {"path": "/watch"}, "count": 1}]})
+        m.drain()
+        assert m.stale_relists == 1
+        # post-relist state is store truth: p0 gone, p1 live
+        assert int(m.p_live.sum()) == 1
+        assert "default/p1" in m.pods.key_row
+        assert "default/p0" not in m.pods.key_row
+        # and the mirror keeps working incrementally afterwards
+        writer.create("Pod", build_pod("p2", group="pg"))
+        m.drain()
+        assert int(m.p_live.sum()) == 2 and m.stale_relists == 1
+    finally:
+        srv.stop()
+
+
+# -- tier-1 smoke (slow-exempt): the injection layer end to end ---------------
+
+
+def test_chaos_smoke_5xx_burst_converges_to_fault_free_placements():
+    baseline, _ = _soak(None, n_jobs=2)
+    stormy, _ = _soak(PLAN_5XX_BURST, n_jobs=2)
+    assert stormy == baseline
+    assert len(stormy) == 4  # 2 gangs x 2 replicas, all Running
+
+
+# -- the full seeded storms (make chaos) --------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_5xx_burst_full():
+    baseline, _ = _soak(None, n_jobs=4, queues=("default", "batch"))
+    stormy, _ = _soak(PLAN_5XX_BURST, n_jobs=4, queues=("default", "batch"))
+    assert stormy == baseline
+
+
+@pytest.mark.slow
+def test_chaos_soak_cut_body_and_log_truncation():
+    baseline, _ = _soak(None, n_jobs=4)
+    stormy, _ = _soak(PLAN_CUT_AND_TRUNCATE, n_jobs=4)
+    assert stormy == baseline
+
+
+@pytest.mark.slow
+def test_real_daemons_survive_env_armed_chaos():
+    """The real multi-process model under VOLCANO_TPU_CHAOS: every spawned
+    daemon's RemoteStore injects connection resets from the env plan,
+    while the apiserver serves a 5xx burst armed over /chaos — the gang
+    still reaches Running through the storms."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    env_plan = {"seed": 17, "rules": [
+        {"point": "client.request", "action": "os_error",
+         "after": 10, "every": 9, "count": 30},
+    ]}
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "VOLCANO_TPU_CHAOS": json.dumps(env_plan)}
+    entry = [sys.executable, "-m", "volcano_tpu.cli"]
+    procs = []
+    try:
+        api = subprocess.Popen(entry + ["apiserver", "--port", "0"],
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True, env=env)
+        procs.append(api)
+        url = api.stdout.readline().strip().rsplit(" ", 1)[-1]
+        assert wait_healthy(url, timeout=30)
+        for comp in ("controller", "scheduler", "kubelet"):
+            extra = (["--period", "0.1", "--metrics-port", "-1"]
+                     if comp == "scheduler" else ["--period", "0.05"])
+            p = subprocess.Popen(entry + [comp, "--server", url] + extra,
+                                 stdout=subprocess.DEVNULL,
+                                 stderr=subprocess.STDOUT, env=env)
+            procs.append(p)
+        _arm(url, {"seed": 18, "rules": [
+            {"point": "server.request", "action": "http_500",
+             "every": 4, "count": 30},
+        ]})
+
+        client = RemoteStore(url)  # this process: no env plan, clean client
+        _submit(client, Queue(meta=Metadata(name="default", namespace="")),
+                kind="Queue")
+        for i in range(2):
+            _submit(client, Node(
+                meta=Metadata(name=f"n{i}", namespace=""),
+                allocatable=Resource.from_resource_list(
+                    {"cpu": "4", "memory": "8Gi", "pods": 110})), kind="Node")
+        _submit(client, _mk_job("envjob", 2))
+        _wait_running(client, "soak/envjob", deadline=120)
+        _arm(url, None)
+        _check_invariants(client)
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.slow
+def test_chaos_soak_lease_flap_single_leader():
+    baseline, _ = _soak(None, n_jobs=3, elect=True,
+                        schedulers=2, controllers=2)
+    stormy, leases = _soak(PLAN_LEASE_FLAP, n_jobs=3, elect=True,
+                           schedulers=2, controllers=2,
+                           flap_component="vk-scheduler")
+    assert stormy == baseline
+    # the skewed candidate really did flap the lease back and forth
+    lease = leases.get("vk-scheduler")
+    assert lease is not None and lease.transitions >= 2, (
+        f"lease never churned: {lease}")

@@ -54,6 +54,46 @@ def cluster():
     return c
 
 
+def test_restarted_controller_finishes_partial_gang(cluster):
+    """Regression (found by the chaos soak's mid-body-cut plan): a
+    controller that crashed after the PodGroup went Inqueue but before the
+    gang's pods were all created must FINISH the gang on restart — the
+    Pending->Inqueue transition event is gone, so the rebuilt controller's
+    list+watch seed has to drive the enqueue-sync from the PodGroup's
+    current phase."""
+    from volcano_tpu.controller import JobController
+
+    job = mk_job("partial", [("main", 2, {"cpu": "1", "memory": "1Gi"}, None)])
+    cluster.store.create("Job", job)
+    cluster.pump_controller()      # create_job: PodGroup appears
+    cluster.scheduler.run_once()   # enqueue action: PodGroup -> Inqueue
+
+    # the next pump creates the gang's pods; cut the bus after the FIRST
+    # pod commits (what a mid-body response cut does over HTTP) — the
+    # sync aborts with half a gang and the job still Pending
+    real_create = cluster.store.create
+
+    def cut_after_commit(kind, obj):
+        out = real_create(kind, obj)
+        if kind == "Pod":
+            raise ConnectionResetError("chaos: response cut after commit")
+        return out
+
+    cluster.store.create = cut_after_commit
+    with pytest.raises(ConnectionResetError):
+        cluster.pump_controller()
+    cluster.store.create = real_create
+    assert len(cluster.store.list("Pod")) == 1
+    assert cluster.store.get("Job", "test/partial").status.state.phase \
+        == JobPhase.PENDING
+
+    cluster.controller = JobController(cluster.store)  # fresh process
+    cluster.run_until_idle()
+    job = cluster.store.get("Job", "test/partial")
+    assert job.status.state.phase == JobPhase.RUNNING
+    assert job.status.running == 2
+
+
 def test_job_reaches_running(cluster):
     job = mk_job("j1", [("main", 3, {"cpu": "1", "memory": "1Gi"}, None)])
     cluster.store.create("Job", job)

@@ -590,7 +590,11 @@ def test_leadership_loss_resyncs_mirror():
     resync from the store so re-election schedules those pods again."""
     from volcano_tpu.leader import LeaderElector
 
-    clock = lambda: 0.0  # takeovers use delete/release, never expiry
+    # takeovers use delete/release, never expiry; the clock still ADVANCES
+    # (in sub-lease-duration hops) so the deposed leader's candidate-retry
+    # backoff window (leader.py) elapses between elections
+    now = [0.0]
+    clock = lambda: now[0]
     store = make_store(
         nodes=[build_node("n0")],
         podgroups=[build_podgroup("pg", min_member=2)],
@@ -620,6 +624,7 @@ def test_leadership_loss_resyncs_mirror():
 
     # lease released -> re-election -> pods scheduled again
     other.release()
+    now[0] += 10.0  # past the deposed leader's retry backoff, below expiry
     sched.cache.applier = None  # dead thread; bind synchronously now
     sched.run_once()
     assert sorted(k for k, _ in sched.cache.bind_log[2:]) == [

@@ -512,6 +512,113 @@ def test_bare_except_near_miss(tmp_path):
     """, select=["bare-except"]) == []
 
 
+# --- rule 13: retry-backoff --------------------------------------------------
+
+
+def test_retry_backoff_fires_on_fixed_sleep_in_handler(tmp_path):
+    findings = _lint(tmp_path, "cli/daemons.py", """
+        import time
+
+        def run(store):
+            while True:
+                try:
+                    store.pump()
+                except OSError:
+                    time.sleep(1.0)
+    """, select=["retry-backoff"])
+    assert _rules_of(findings) == ["retry-backoff"]
+
+
+def test_retry_backoff_fires_on_fallthrough_to_loop_sleep(tmp_path):
+    # the pre-backoff daemons.py shape: handler sets a flag and falls
+    # through, so the healthy pump sleep doubles as the retry delay
+    findings = _lint(tmp_path, "cli/daemons.py", """
+        import time
+
+        def run(store, period, transient):
+            down = False
+            while True:
+                try:
+                    store.pump()
+                except transient:
+                    down = True
+                time.sleep(period)
+    """, select=["retry-backoff"])
+    assert _rules_of(findings) == ["retry-backoff"]
+
+
+def test_retry_backoff_near_misses(tmp_path):
+    # backoff-paced retry + fixed HEALTHY-path period: the sanctioned shape
+    assert _lint(tmp_path, "cli/daemons.py", """
+        import time
+        from volcano_tpu.backoff import Backoff
+
+        def run(store, period):
+            retry = Backoff()
+            while True:
+                try:
+                    store.pump()
+                    retry.reset()
+                except OSError:
+                    retry.sleep()
+                    continue
+                time.sleep(period)
+    """, select=["retry-backoff"]) == []
+    # time.sleep fed from the backoff stream is equally fine
+    assert _lint(tmp_path, "cli/daemons.py", """
+        import time
+        from volcano_tpu.backoff import Backoff
+
+        def probe(store, deadline):
+            retry = Backoff()
+            while True:
+                try:
+                    return store.ping()
+                except OSError:
+                    time.sleep(min(retry.next(), deadline))
+    """, select=["retry-backoff"]) == []
+    # non-transient handler falling through: not a retry loop
+    assert _lint(tmp_path, "cli/daemons.py", """
+        import time
+
+        def run(pids, period):
+            while True:
+                try:
+                    check(pids)
+                except ProcessLookupError:
+                    pids.clear()
+                time.sleep(period)
+    """, select=["retry-backoff"]) == []
+    # a fixed sleep inside a NON-transient handler is that handler's
+    # business — the fall-through pass must not misreport it as the
+    # loop-tail retry delay of the (escaping-by-backoff) transient handler
+    assert _lint(tmp_path, "cli/daemons.py", """
+        import time
+        from volcano_tpu.backoff import Backoff
+
+        def run(store):
+            retry = Backoff()
+            while True:
+                try:
+                    store.pump()
+                except OSError:
+                    retry.sleep()
+                except ValueError:
+                    time.sleep(0.01)
+    """, select=["retry-backoff"]) == []
+    # identical offending shape OUTSIDE daemon modules: out of scope
+    assert _lint(tmp_path, "scheduler/thing.py", """
+        import time
+
+        def run(store):
+            while True:
+                try:
+                    store.pump()
+                except OSError:
+                    time.sleep(1.0)
+    """, select=["retry-backoff"]) == []
+
+
 # --- suppression contract ---------------------------------------------------
 
 

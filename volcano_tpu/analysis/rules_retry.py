@@ -1,0 +1,162 @@
+"""Retry pacing discipline for daemon code: no fixed-sleep transient retries.
+
+The control-plane daemons (cli/daemons.py), the leader elector, and the
+store client all run retry-on-transient loops against the store bus.  The
+shared pacing primitive is ``volcano_tpu/backoff.py`` (decorrelated-jitter
+exponential backoff): a fixed ``time.sleep(period)`` on the retry path
+synchronizes every replica in a deployment onto the same beat — after an
+apiserver restart the whole fleet reconnects simultaneously, the
+thundering herd the reference avoids with client-go's wait.Backoff.
+
+The ``retry-backoff`` rule flags, in daemon modules only:
+
+* a ``time.sleep(<fixed>)`` inside an except handler that catches
+  transient store errors (OSError/RemoteStoreError/StaleWatch/…) within a
+  retry loop — the sleep must derive from a backoff (``retry.sleep()`` or
+  ``time.sleep(bo.next())``);
+* a transient handler that falls through (no continue/break/return/raise)
+  to a fixed loop-level ``time.sleep`` — the pre-backoff daemons.py shape,
+  where the healthy-path pump sleep silently doubled as the retry delay.
+
+A fixed sleep on the HEALTHY path (reached only after the handler
+``continue``\\ s) stays legal: the pump period is deliberately fixed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from volcano_tpu.analysis.core import FileContext, Finding, dotted_name, rule
+
+#: exception names that mark a handler as catching store-bus transients
+_TRANSIENT_NAMES = {
+    "OSError", "ConnectionError", "ConnectionResetError",
+    "ConnectionRefusedError", "BrokenPipeError", "TimeoutError",
+    "URLError", "HTTPException", "RemoteStoreError", "StaleWatch",
+}
+
+#: daemon modules the discipline applies to
+_SCOPED_BASENAMES = {"daemons.py", "leader.py", "client.py"}
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    return "cli" in ctx.dir_parts or ctx.basename in _SCOPED_BASENAMES
+
+
+def _exc_names(node: Optional[ast.AST]) -> List[str]:
+    """Leaf exception names of an except clause's type expression."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Tuple):
+        out: List[str] = []
+        for elt in node.elts:
+            out.extend(_exc_names(elt))
+        return out
+    name = dotted_name(node)
+    if name is not None:
+        return [name.split(".")[-1]]
+    return []
+
+
+def _is_transient_handler(handler: ast.ExceptHandler) -> bool:
+    for name in _exc_names(handler.type):
+        if name in _TRANSIENT_NAMES or "transient" in name.lower():
+            return True
+    return False
+
+
+def _is_fixed_sleep(call: ast.Call) -> bool:
+    """``time.sleep(X)`` where X does not derive from a backoff — no
+    ``.next()``/``.sleep()`` call anywhere in the argument expression."""
+    if dotted_name(call.func) != "time.sleep" or not call.args:
+        return False
+    for sub in ast.walk(call.args[0]):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in ("next", "sleep"):
+            return False
+    return True
+
+
+def _escapes(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body leave the loop iteration (continue/break/
+    return/raise) rather than falling through to the loop tail?"""
+    for sub in ast.walk(handler):
+        if isinstance(sub, (ast.Continue, ast.Break, ast.Return, ast.Raise)):
+            return True
+    return False
+
+
+def _loop_level_nodes(loop: ast.AST) -> Iterable[ast.AST]:
+    """The loop's subtree, excluding nested loops and function defs (their
+    sleeps belong to their own construct)."""
+
+    def walk(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.While, ast.For, ast.AsyncFor,
+                                  ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from walk(child)
+
+    yield from walk(loop)
+
+
+@rule(
+    "retry-backoff",
+    "fixed-sleep retry of a transient store error in daemon code — pace "
+    "retries through volcano_tpu.backoff (decorrelated jitter), not "
+    "time.sleep(period)",
+)
+def check_retry_backoff(ctx: FileContext) -> Iterable[Finding]:
+    if not _in_scope(ctx):
+        return
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.While, ast.For)):
+            continue
+        fallthrough = False
+        handler_spans: List[tuple] = []
+        for node in _loop_level_nodes(loop):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                # record EVERY handler's span: a fixed sleep inside a
+                # non-transient handler (e.g. `except Conflict:`) is that
+                # handler's business, not the loop-tail retry delay the
+                # fall-through pass below is hunting
+                handler_spans.append(
+                    (handler.lineno, handler.end_lineno or handler.lineno)
+                )
+                if not _is_transient_handler(handler):
+                    continue
+                for sub in ast.walk(handler):
+                    if isinstance(sub, ast.Call) and _is_fixed_sleep(sub):
+                        yield ctx.finding(
+                            "retry-backoff",
+                            sub,
+                            "transient-error handler retries on a fixed "
+                            "time.sleep — use a Backoff "
+                            "(volcano_tpu/backoff.py): retry.sleep() / "
+                            "time.sleep(retry.next())",
+                        )
+                if not _escapes(handler):
+                    fallthrough = True
+        if not fallthrough:
+            continue
+        # a transient handler falls through: the loop-tail sleep IS the
+        # retry delay, and it must not be fixed
+        for node in _loop_level_nodes(loop):
+            if isinstance(node, ast.Call) and _is_fixed_sleep(node):
+                line = node.lineno
+                if any(a <= line <= b for a, b in handler_spans):
+                    continue  # already reported above
+                yield ctx.finding(
+                    "retry-backoff",
+                    node,
+                    "a transient-error handler in this loop falls through "
+                    "to this fixed time.sleep, making it the retry delay — "
+                    "back off with jitter in the handler "
+                    "(volcano_tpu/backoff.py) and `continue`, keeping the "
+                    "healthy-path period fixed",
+                )

@@ -1,0 +1,250 @@
+"""chaosd: deterministic fault injection on the store bus.
+
+The reference's resilience story is "rebuild from the bus" — every binary
+relists from the API server after any outage (client-go reflectors, leader
+leases).  The recovery paths that promise this (daemon outage guards,
+StaleWatch relists, lease takeover) only ever see *clean* failures in the
+plain test suite; this module injects the messy ones real deployments see,
+deterministically, so the chaos soak (tests/test_chaos_soak.py, ``make
+chaos``) can assert the convergence invariants under seeded fault
+schedules.
+
+A :class:`FaultPlan` is a seeded list of :class:`FaultRule`\\ s bound to
+named **faultpoints**:
+
+==================  ==========================================================
+faultpoint          where it fires
+==================  ==========================================================
+``server.request``  every StoreServer API request (``/chaos`` itself exempt)
+``server.flush``    StoreServer.flush_state entry (durability layer)
+``client.request``  every RemoteStore._request attempt (retries re-fire it)
+``leader.clock``    every LeaderElector clock read (via :func:`chaos_clock`)
+==================  ==========================================================
+
+and **actions**:
+
+==================  ==========================================================
+action              effect (valid faultpoints)
+==================  ==========================================================
+``http_500``        reply 503 instead of serving (server.request)
+``cut_body``        advertise the full Content-Length, write half, close the
+                    connection — the client sees a mid-body cut
+                    (server.request)
+``delay``           sleep ``arg`` seconds before serving (server.request,
+                    client.request)
+``truncate_log``    drop the whole buffered event log (seq preserved), so
+                    every behind-cursor watcher is forced into the StaleWatch
+                    relist path (server.request)
+``drop_flush``      skip one state flush (server.flush)
+``os_error``        raise ConnectionResetError from the client before the
+                    request leaves the process (client.request)
+``skew``            add ``arg`` seconds to the clock reading — stale-lease /
+                    lease-flap injection (leader.clock)
+==================  ==========================================================
+
+Determinism contract: rule selection is pure counter + seeded-RNG state.
+Each rule keeps a per-rule hit counter (``after`` skips the first N
+matching hits, ``every`` fires each k-th thereafter, ``count`` caps total
+fires) and an independent ``random.Random((seed, rule_index))`` stream for
+``prob`` — so two runs of the same plan against the same request sequence
+inject exactly the same faults.  Counters are process-local; a plan armed
+through the ``VOLCANO_TPU_CHAOS`` env var is parsed once per process
+(:func:`env_plan`), so "the Nth request" means the Nth of that process.
+
+When no plan is armed the middleware in server/client is one attribute
+check (``self.chaos is None``) — the 100k-object hot cycle pays nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from volcano_tpu.locksan import make_lock
+
+#: faultpoint -> actions valid there (plan validation fails fast on typos)
+FAULTPOINTS: Dict[str, tuple] = {
+    "server.request": ("http_500", "cut_body", "delay", "truncate_log"),
+    "server.flush": ("drop_flush",),
+    "client.request": ("os_error", "delay"),
+    "leader.clock": ("skew",),
+}
+
+ENV_VAR = "VOLCANO_TPU_CHAOS"
+
+
+class ChaosPlanError(ValueError):
+    """A fault plan names an unknown faultpoint/action or is malformed."""
+
+
+class FaultRule:
+    """One injection rule: a faultpoint, a match filter, firing schedule,
+    and an action.  See the module docstring for the vocabulary."""
+
+    def __init__(self, spec: Dict[str, Any], index: int, seed: int):
+        self.point = spec.get("point", "")
+        self.action = spec.get("action", "")
+        if self.point not in FAULTPOINTS:
+            raise ChaosPlanError(
+                f"rule {index}: unknown faultpoint {self.point!r} "
+                f"(known: {', '.join(sorted(FAULTPOINTS))})"
+            )
+        if self.action not in FAULTPOINTS[self.point]:
+            raise ChaosPlanError(
+                f"rule {index}: action {self.action!r} is not valid at "
+                f"{self.point!r} (valid: {', '.join(FAULTPOINTS[self.point])})"
+            )
+        match = spec.get("match") or {}
+        self.match_method = str(match.get("method", "")).upper()
+        self.match_path = str(match.get("path", ""))
+        self.after = int(spec.get("after", 0))
+        self.count = int(spec.get("count", -1))
+        self.every = max(1, int(spec.get("every", 1)))
+        self.prob = float(spec.get("prob", 1.0))
+        self.arg = float(spec.get("arg", 0.0))
+        # independent per-rule stream: rule order in the plan, not hit
+        # interleaving across rules, decides what each rule's RNG yields
+        # (int-mixed — tuple seeding is deprecated and unstable)
+        self._rng = random.Random(seed * 1_000_003 + index)
+        self.hits = 0
+        self.fires = 0
+        self._spec = dict(spec)
+
+    def matches(self, method: str, path: str) -> bool:
+        if self.match_method and method.upper() != self.match_method:
+            return False
+        if self.match_path and self.match_path not in path:
+            return False
+        return True
+
+    def should_fire(self, suppressed: bool = False) -> bool:
+        """Advance this rule's counter state for one matching hit and
+        decide whether it fires.  ``suppressed`` marks a hit an earlier
+        rule already consumed: the hit counter still advances (the
+        ``after``/``every`` phasing is hit-indexed), but no fire, count
+        budget, or probability draw is spent on an action that will never
+        run.  Called under the plan lock."""
+        self.hits += 1
+        if suppressed:
+            return False
+        if self.hits <= self.after:
+            return False
+        if self.count >= 0 and self.fires >= self.count:
+            return False
+        if (self.hits - self.after - 1) % self.every != 0:
+            return False
+        # consume the stream even for prob=1.0 so adding prob to a plan
+        # later doesn't shift this rule's own draws
+        draw = self._rng.random()
+        if self.prob < 1.0 and draw >= self.prob:
+            return False
+        self.fires += 1
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {"point": self.point, "action": self.action,
+                "hits": self.hits, "fires": self.fires}
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of fault injections.
+
+    Wire/dict form::
+
+        {"seed": 1234,
+         "rules": [{"point": "server.request", "action": "http_500",
+                    "match": {"method": "GET", "path": "/apis"},
+                    "after": 2, "count": 5, "every": 1, "prob": 1.0}]}
+    """
+
+    def __init__(self, rules: List[Dict[str, Any]], seed: int = 0):
+        self.seed = int(seed)
+        self._lock = make_lock("FaultPlan._lock")
+        self.rules = [FaultRule(spec, i, self.seed)
+                      for i, spec in enumerate(rules)]
+        # faultpoints with no rule short-circuit without taking the lock
+        self._points = frozenset(r.point for r in self.rules)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "FaultPlan":
+        if not isinstance(data, dict) or not isinstance(
+                data.get("rules", []), list):
+            raise ChaosPlanError("plan must be {'seed': int, 'rules': [...]}")
+        return cls(data.get("rules", []), seed=data.get("seed", 0))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "rules": [r._spec for r in self.rules]}
+
+    def has_point(self, point: str) -> bool:
+        return point in self._points
+
+    def fire(self, point: str, method: str = "", path: str = "") -> Optional[FaultRule]:
+        """Record one hit at ``point``; return the first rule that fires,
+        or None.  Every matching rule's HIT counter advances on every hit
+        (the after/every phasing is hit-indexed), but only the winning
+        rule spends a fire — a later rule whose action is discarded keeps
+        its count budget and stats honest."""
+        if point not in self._points:
+            return None
+        fired: Optional[FaultRule] = None
+        with self._lock:
+            for rule in self.rules:
+                if rule.point != point or not rule.matches(method, path):
+                    continue
+                if rule.should_fire(suppressed=fired is not None):
+                    fired = rule
+        return fired
+
+    def stats(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.stats() for r in self.rules]
+
+
+def parse_plan(text: str) -> FaultPlan:
+    """Parse a plan from JSON text or an ``@/path/to/plan.json`` reference
+    (the two forms ``VOLCANO_TPU_CHAOS`` accepts)."""
+    text = text.strip()
+    if text.startswith("@"):
+        with open(text[1:], "r", encoding="utf-8") as f:
+            text = f.read()
+    try:
+        data = json.loads(text)
+    except ValueError as e:
+        raise ChaosPlanError(f"unparseable chaos plan: {e}") from e
+    return FaultPlan.from_dict(data)
+
+
+_env_plan_cache: List[Optional[FaultPlan]] = []
+
+
+def env_plan() -> Optional[FaultPlan]:
+    """The process-wide plan armed through ``VOLCANO_TPU_CHAOS`` (JSON or
+    ``@path``), parsed once so every client in the process shares one set
+    of rule counters; None when the var is unset/empty."""
+    if not _env_plan_cache:
+        raw = os.environ.get(ENV_VAR, "")
+        _env_plan_cache.append(parse_plan(raw) if raw else None)
+    return _env_plan_cache[0]
+
+
+def chaos_clock(plan: FaultPlan,
+                base: Optional[Callable[[], float]] = None) -> Callable[[], float]:
+    """A clock for LeaderElector's injectable ``clock`` parameter: reads
+    ``base`` (default ``time.monotonic``) and, when a ``leader.clock``
+    rule fires, skews the reading by ``arg`` seconds — a positive skew
+    makes every OTHER holder's lease look expired to this candidate
+    (takeover storm), a negative one makes this candidate renew with
+    timestamps in the past (its own lease flaps)."""
+    base = base or time.monotonic
+
+    def clock() -> float:
+        now = base()
+        rule = plan.fire("leader.clock")
+        if rule is not None and rule.action == "skew":
+            return now + rule.arg
+        return now
+
+    return clock

@@ -39,9 +39,18 @@ def _transient_errors():
 def _elector(store, component: str, identity: str, enabled: bool):
     if not enabled:
         return None
+    from volcano_tpu import chaos
     from volcano_tpu.leader import LeaderElector
 
-    return LeaderElector(store, name=component, identity=identity)
+    # lease clock-skew injection rides the elector's injectable clock: a
+    # VOLCANO_TPU_CHAOS plan with leader.clock rules makes this candidate
+    # see skewed time (chaos.chaos_clock), flapping real leases in real
+    # daemon processes without touching election logic
+    plan = chaos.env_plan()
+    clock = None
+    if plan is not None and plan.has_point("leader.clock"):
+        clock = chaos.chaos_clock(plan)
+    return LeaderElector(store, name=component, identity=identity, clock=clock)
 
 
 def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = True,
@@ -82,24 +91,29 @@ def run_controller(server: str, identity: str = "", leader_elect: bool = True,
             store, elector=_elector(store, "vk-controllers", ident, leader_elect)
         )
 
+    from volcano_tpu.backoff import Backoff
+
     transient = _transient_errors()
-    ctl = build()
     announce(f"controller {ident} watching {server}", flush=True)
     down = False
-    need_rebuild = False
+    ctl = None
+    retry = Backoff(base=min(max(period, 0.01), 0.2))
     while True:
         try:
-            if need_rebuild:
+            if ctl is None:
                 # build() lists every kind over the wire — it must sit
-                # inside the outage guard too, or a flapping server kills
-                # the controller during the very recovery it relists for
+                # inside the outage guard too (INCLUDING the very first
+                # build: a 5xx at boot must not kill the daemon, the
+                # chaos env-plan test boots into exactly that), or a
+                # flapping server kills the controller during the very
+                # recovery it relists for
                 ctl = build()
-                need_rebuild = False
             ctl.pump()
+            retry.reset()
             if down:
                 announce(f"controller {ident}: store back, relisting", flush=True)
                 down = False
-                need_rebuild = True  # full relist after an apiserver outage
+                ctl = None  # full relist after an apiserver outage
                 continue
         except StaleWatch:
             # fell off the server's event log (e.g. long standby) or the
@@ -107,15 +121,20 @@ def run_controller(server: str, identity: str = "", leader_elect: bool = True,
             # post-outage relist, so clear ``down`` or the next successful
             # pump would trigger a redundant second rebuild
             announce(f"controller {ident}: stale watch, relisting", flush=True)
-            need_rebuild = True
+            ctl = None
             down = False
             continue
         except transient as e:
-            # apiserver outage: keep retrying, as client-go reflectors do
+            # apiserver outage: keep retrying as client-go reflectors do,
+            # but on a decorrelated-jitter backoff, not the pump period —
+            # a restarting apiserver must not be met by every daemon in
+            # the deployment on the same fixed beat
             if not down:
                 announce(f"controller {ident}: store unavailable ({e}); retrying",
                          flush=True)
                 down = True
+            retry.sleep()
+            continue
         time.sleep(period)
 
 
@@ -129,7 +148,6 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
     from volcano_tpu.scheduler.scheduler import Scheduler
     from volcano_tpu.store.client import RemoteStore
 
-    store = RemoteStore(server)
     # deployed default: the fully-loaded 5-action conf on the tpu backend
     # (VOLCANO_TPU_BACKEND=host opts out — e.g. deployments without jax;
     # the test suite sets it to keep daemon subprocesses light)
@@ -175,8 +193,26 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
         if cache_dir:
             announce(f"scheduler {ident}: XLA compilation cache at {cache_dir}",
                      flush=True)
-    sched = Scheduler(store, conf=conf,
-                      elector=_elector(store, "vk-scheduler", ident, leader_elect))
+    from volcano_tpu.backoff import Backoff
+
+    boot = Backoff(base=min(max(period, 0.01), 0.5))
+    while True:
+        try:
+            # construction subscribes the fast mirror's watches over the
+            # wire (tpu/native backends) — a 5xx or reset at boot must
+            # retry, not kill the unit before its first cycle.  The store
+            # is rebuilt per attempt: a failed construction would leave
+            # orphaned watch queues on a shared client, buffering every
+            # event forever
+            store = RemoteStore(server)
+            sched = Scheduler(store, conf=conf,
+                              elector=_elector(store, "vk-scheduler", ident,
+                                               leader_elect))
+            break
+        except _transient_errors() as e:
+            announce(f"scheduler {ident}: store unavailable at boot ({e}); "
+                     "retrying", flush=True)
+            boot.sleep()
     announce(f"scheduler {ident} cycling every {period}s against {server}", flush=True)
     try:
         warm = sched.prewarm()
@@ -195,10 +231,12 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
     transient = _transient_errors()
     down = False
     cycles = 0
+    retry = Backoff(base=min(max(period, 0.01), 0.5))
     while True:
         t0 = time.monotonic()
         try:
             sched.run_once()
+            retry.reset()
             if down:
                 announce(f"scheduler {ident}: store back", flush=True)
                 down = False
@@ -207,6 +245,10 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
                 announce(f"scheduler {ident}: store unavailable ({e}); retrying",
                          flush=True)
                 down = True
+            # outage retry on jittered backoff; the healthy cycle cadence
+            # below stays the reference's fixed schedule-period
+            retry.sleep()
+            continue
         cycles += 1
         if sched.conf.mirror_checkpoint and cycles % 30 == 0:
             # periodic mirror checkpoint (between cycles = consistent
@@ -228,10 +270,13 @@ def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
     from volcano_tpu.store.client import RemoteStore
     from volcano_tpu.store.store import Conflict
 
+    from volcano_tpu.backoff import Backoff
+
     store = RemoteStore(server)
     announce(f"kubelet simulating against {server}", flush=True)
     transient = _transient_errors()
     down = False
+    retry = Backoff(base=min(max(period, 0.01), 0.2))
     while True:
         try:
             for pod in store.list("Pod"):
@@ -247,6 +292,7 @@ def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
                         store.update_cas("Pod", pod, rv)
                     except (Conflict, KeyError):
                         pass  # changed under us; reconcile next period
+            retry.reset()
             if down:
                 announce("kubelet: store back", flush=True)
                 down = False
@@ -254,6 +300,8 @@ def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
             if not down:
                 announce(f"kubelet: store unavailable ({e}); retrying", flush=True)
                 down = True
+            retry.sleep()
+            continue
         time.sleep(period)
 
 
@@ -274,18 +322,9 @@ def _free_port() -> int:
 
 
 def _wait_http(url: str, timeout: float = 30.0) -> bool:
-    from volcano_tpu.store.client import RemoteStore
+    from volcano_tpu.store.client import wait_healthy
 
-    deadline = time.monotonic() + timeout
-    transient = _transient_errors()
-    store = RemoteStore(url, timeout=2.0)
-    while time.monotonic() < deadline:
-        try:
-            store.resource_version
-            return True
-        except transient:
-            time.sleep(0.1)
-    return False
+    return wait_healthy(url, timeout=timeout)
 
 
 def run_up(port: int = 8443, state: str = "", conf_path: str = "",

@@ -211,9 +211,24 @@ class JobController:
             )
 
     def _on_pg_event(self, ev) -> None:
+        pg: PodGroup = ev.obj
+        if ev.type == EventType.ADDED:
+            # first observation (fresh watch, or the list+watch seed after
+            # a rebuild/relist): the Pending->Inqueue transition may have
+            # fired before this controller was watching, and a controller
+            # that crashed after creating only PART of a gang would
+            # otherwise never be asked to finish it — nothing else
+            # re-triggers pod creation (the chaos soak's mid-body-cut plan
+            # wedged exactly here).  Re-issuing EnqueueJob is idempotent:
+            # sync_job diffs desired vs existing pods.
+            if pg.status.phase == PodGroupPhase.INQUEUE:
+                self.queue.append(
+                    Request(pg.meta.namespace, pg.meta.name,
+                            action=JobAction.ENQUEUE_JOB)
+                )
+            return
         if ev.type != EventType.UPDATED:
             return
-        pg: PodGroup = ev.obj
         old_phase = ev.old.status.phase if ev.old is not None else None
         if pg.status.phase == old_phase:
             return

@@ -17,9 +17,11 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from volcano_tpu.api.objects import Metadata
+from volcano_tpu.backoff import Backoff
 
 DEFAULT_LEASE_DURATION = 15.0  # leaseDuration, server.go:115
 DEFAULT_RENEW_DEADLINE = 10.0  # renewDeadline (informational)
+DEFAULT_RETRY_PERIOD = 5.0     # retryPeriod, server.go:117 (backoff cap)
 
 
 @dataclass
@@ -39,12 +41,20 @@ class LeaderElector:
         identity: str,
         lease_duration: float = DEFAULT_LEASE_DURATION,
         clock: Optional[Callable[[], float]] = None,
+        backoff: Optional[Backoff] = None,
     ):
         self.store = store
         self.name = name
         self.identity = identity
         self.lease_duration = lease_duration
         self.clock = clock or time.monotonic
+        # candidate retry pacing (reference retryPeriod, server.go:117,
+        # jittered): a LOST acquisition — create/CAS race, someone else's
+        # live lease — backs off before the next store round trip, so N
+        # hot standbys don't hammer the lease key in lockstep after every
+        # leadership change.  Any successful acquire/renew resets it.
+        self.backoff = backoff or Backoff(base=0.1, cap=DEFAULT_RETRY_PERIOD)
+        self._retry_at = -float("inf")
 
     @property
     def _key(self) -> str:
@@ -62,6 +72,8 @@ class LeaderElector:
         from volcano_tpu.store.store import Conflict
 
         now = self.clock()
+        if now < self._retry_at:
+            return False  # lost a recent race; still pacing the retry
         lease = self.store.get("Lease", self._key)
         if lease is None:
             lease = Lease(
@@ -73,8 +85,8 @@ class LeaderElector:
             try:
                 self.store.create("Lease", lease)
             except KeyError:  # another candidate created it first
-                return False
-            return True
+                return self._lost(now)
+            return self._won()
         rv = lease.meta.resource_version
         if lease.holder == self.identity:
             lease.renewed_at = now
@@ -85,12 +97,21 @@ class LeaderElector:
             lease.duration = self.lease_duration  # new holder's window
             lease.transitions += 1
         else:
-            return False
+            return self._lost(now)
         try:
             self.store.update_cas("Lease", lease, rv)
         except (Conflict, KeyError):  # lost the renew/takeover race
-            return False
+            return self._lost(now)
+        return self._won()
+
+    def _won(self) -> bool:
+        self.backoff.reset()
+        self._retry_at = -float("inf")
         return True
+
+    def _lost(self, now: float) -> bool:
+        self._retry_at = now + self.backoff.next()
+        return False
 
     def is_leader(self) -> bool:
         lease = self.store.get("Lease", self._key)

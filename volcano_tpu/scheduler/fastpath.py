@@ -174,6 +174,9 @@ class ArrayMirror:
         ]
         self._synced = False
         self._resyncing = False
+        #: StaleWatch recoveries performed by drain() — the chaos soak
+        #: asserts the relist path actually ran under log truncation
+        self.stale_relists = 0
         self._reset_tables(["cpu", "memory"])
 
     def _reset_tables(self, dims: List[str]) -> None:
@@ -354,6 +357,7 @@ class ArrayMirror:
             # re-ingest it forever), then relist to recover the drop.
             for _, q in self._watches:
                 getattr(q, "_buf", q).clear()
+            self.stale_relists += 1
             self._resync(dims=self.dims)
 
     def _drain_events(self) -> None:

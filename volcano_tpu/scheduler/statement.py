@@ -8,17 +8,41 @@ rolls back in reverse order (unevict to Running, unpipeline to Pending).
 
 from __future__ import annotations
 
+import threading
 from typing import List, Tuple
 
 from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.scheduler.model import TaskInfo
 from volcano_tpu.scheduler.session import Event, Session
 
+#: guards the module-level settlement counter (schedulers are
+#: single-threaded, but the chaos soak runs several in one process)
+_settle_mu = threading.Lock()
+_open_statements = 0
+
+
+def outstanding() -> int:
+    """Statements opened but neither committed nor discarded — the
+    runtime twin of the static ``statement-discipline`` rule; the chaos
+    soak asserts this returns to zero after every converged workload."""
+    return _open_statements
+
 
 class Statement:
     def __init__(self, ssn: Session):
+        global _open_statements
         self.ssn = ssn
         self.operations: List[Tuple[str, TaskInfo, str]] = []
+        self._settled = False
+        with _settle_mu:
+            _open_statements += 1
+
+    def _settle(self) -> None:
+        global _open_statements
+        if not self._settled:
+            self._settled = True
+            with _settle_mu:
+                _open_statements -= 1
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         ssn = self.ssn
@@ -63,9 +87,11 @@ class Statement:
             else:
                 self._unpipeline(task)
         self.operations.clear()
+        self._settle()
 
     def commit(self) -> None:
         for name, task, reason in self.operations:
             if name == "evict":
                 self.ssn.cache.evict(task, reason)
         self.operations.clear()
+        self._settle()

@@ -16,7 +16,9 @@ callers relist, the reference's "resourceVersion too old" recovery.
 
 from __future__ import annotations
 
+import http.client
 import json
+import time
 import urllib.error
 import urllib.request
 from collections import deque
@@ -24,6 +26,7 @@ from typing import Any, Dict, List, Optional
 from urllib.parse import quote
 
 from volcano_tpu.admission import AdmissionError
+from volcano_tpu.chaos import FaultPlan, env_plan
 from volcano_tpu.store.codec import decode_object, encode, encode_fields
 from volcano_tpu.store.store import Conflict, Event, EventType
 
@@ -61,10 +64,32 @@ class _RemoteWatchQueue:
         self._buf.append(ev)
 
 
+def _connection_cut(e: BaseException) -> bool:
+    """A connection-level transient — the request either never reached the
+    server (refused/reset on connect) or the reply was cut mid-body — for
+    which re-issuing an idempotent GET is always safe."""
+    if isinstance(e, urllib.error.URLError) and not isinstance(
+            e, urllib.error.HTTPError):
+        reason = e.reason
+        if isinstance(reason, BaseException):
+            e = reason
+    return isinstance(e, (
+        ConnectionResetError, ConnectionRefusedError, BrokenPipeError,
+        http.client.RemoteDisconnected, http.client.IncompleteRead,
+        http.client.BadStatusLine,
+    ))
+
+
 class RemoteStore:
-    def __init__(self, url: str, timeout: float = 30.0):
+    def __init__(self, url: str, timeout: float = 30.0,
+                 chaos: Optional[FaultPlan] = None):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        # client-side fault injection (volcano_tpu/chaos.py): defaults to
+        # the process-wide VOLCANO_TPU_CHAOS plan so daemon subprocesses
+        # are torturable; None (the ambient case) costs one attribute
+        # check per request
+        self.chaos = chaos if chaos is not None else env_plan()
         self._watches: Dict[str, List[_RemoteWatchQueue]] = {}
         self._cursor = 0
 
@@ -72,21 +97,39 @@ class RemoteStore:
 
     def _request(self, method: str, path: str, payload: Optional[dict] = None):
         data = json.dumps(payload).encode() if payload is not None else None
-        req = urllib.request.Request(
-            self.url + path,
-            data=data,
-            method=method,
-            headers={"Content-Type": "application/json"} if data else {},
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.status, json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
+        headers = {"Content-Type": "application/json"} if data else {}
+        # idempotent verbs (GET: get/list/watch poll) retry ONCE on a
+        # connection cut before surfacing the transient — the reference's
+        # client-go does the same for safe verbs.  Mutations never retry
+        # here: a cut PUT/POST may have committed server-side, and blind
+        # re-issue would double-apply; their callers own that decision.
+        attempts = 2 if method == "GET" else 1
+        for attempt in range(attempts):
             try:
-                body = json.loads(e.read() or b"{}")
-            except Exception:  # noqa: BLE001
-                body = {"error": str(e)}
-            return e.code, body
+                if self.chaos is not None:
+                    rule = self.chaos.fire("client.request", method=method,
+                                           path=path)
+                    if rule is not None:
+                        if rule.action == "os_error":
+                            raise ConnectionResetError(
+                                "chaos: injected connection reset")
+                        if rule.action == "delay":
+                            time.sleep(rule.arg)
+                req = urllib.request.Request(
+                    self.url + path, data=data, method=method, headers=headers,
+                )
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return resp.status, json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                try:
+                    body = json.loads(e.read() or b"{}")
+                except Exception:  # noqa: BLE001
+                    body = {"error": str(e)}
+                return e.code, body
+            except (OSError, http.client.HTTPException) as e:
+                if attempt + 1 < attempts and _connection_cut(e):
+                    continue
+                raise
 
     @staticmethod
     def _err(code: int, body: dict) -> str:
@@ -353,3 +396,25 @@ class RemoteStore:
     def pending_events(self) -> bool:
         self.poll()
         return any(q._buf for qs in self._watches.values() for q in qs)
+
+
+def wait_healthy(url: str, timeout: float = 30.0,
+                 request_timeout: float = 2.0) -> bool:
+    """Deadline-bounded readiness probe: poll ``GET /healthz`` with
+    jittered backoff until the server answers or ``timeout`` passes.
+    Returns whether the server came up — the one health-wait the daemons
+    and tests share instead of ad-hoc polling loops."""
+    from volcano_tpu.backoff import Backoff
+
+    store = RemoteStore(url, timeout=request_timeout)
+    deadline = time.monotonic() + timeout
+    bo = Backoff(base=0.05, cap=1.0)
+    while True:
+        try:
+            store.uid  # a /healthz round trip
+            return True
+        except (RemoteStoreError, OSError, http.client.HTTPException):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            time.sleep(min(bo.next(), remaining))

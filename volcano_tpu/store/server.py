@@ -30,6 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from volcano_tpu.chaos import ChaosPlanError, FaultPlan, env_plan
 from volcano_tpu.locksan import make_lock, make_rlock
 from volcano_tpu.store.codec import (
     KIND_CLASSES,
@@ -97,6 +98,11 @@ class StoreServer:
         # every pump (a suppressed no-op write must not leave a stale hint
         # for the key's next event)
         self._enc_hints: Dict[tuple, Dict[str, Any]] = {}
+        # chaos middleware (volcano_tpu/chaos.py): None = disarmed, and
+        # every faultpoint below is a single attribute check — the hot
+        # cycle pays nothing.  Armed at boot from VOLCANO_TPU_CHAOS (so
+        # subprocess daemons can be tortured) or at runtime via /chaos.
+        self.chaos: Optional[FaultPlan] = env_plan()
         self._saver_stop = threading.Event()
         self._saver: Optional[threading.Thread] = None
         if state_path is not None:
@@ -131,10 +137,59 @@ class StoreServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n) or b"{}")
 
+            def _chaos_request(self, plan) -> bool:
+                """server.request faultpoint: returns True when the fault
+                consumed the request (a reply was already written).  The
+                caller snapshots ``server.chaos`` ONCE and passes it in, so
+                a concurrent disarm can never turn the armed check into a
+                None dereference mid-request."""
+                rule = plan.fire(
+                    "server.request", method=self.command, path=self.path
+                )
+                if rule is None:
+                    return False
+                if rule.action == "delay":
+                    time.sleep(rule.arg)
+                    return False
+                if rule.action == "truncate_log":
+                    # drop the whole buffered log (seq preserved): every
+                    # watcher whose cursor is behind head now falls off the
+                    # buffer and must relist — the "resourceVersion too
+                    # old" event compaction the reference gets from etcd
+                    with server.lock:
+                        del server.log[:]
+                    return False
+                if rule.action == "http_500":
+                    # an unread request body would corrupt the next
+                    # keep-alive request on this connection; just drop it
+                    self.close_connection = True
+                    self._reply(503, {"error": "chaos: injected 5xx"})
+                    return True
+                if rule.action == "cut_body":
+                    # advertise the full length, send half, slam the
+                    # connection: the client's read raises IncompleteRead
+                    payload = json.dumps(
+                        {"error": "chaos: response cut mid-body"}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload) * 2))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    self.wfile.flush()
+                    self.close_connection = True
+                    return True
+                return False
+
             def do_GET(self):
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
                 parts = [p for p in u.path.split("/") if p]
+                if u.path == "/chaos":  # admin: always exempt from injection
+                    return self._reply(200, server.chaos_status())
+                chaos_plan = server.chaos
+                if chaos_plan is not None and self._chaos_request(chaos_plan):
+                    return
                 if u.path == "/healthz":
                     return self._reply(
                         200, {"ok": True, "uid": server.store.uid}
@@ -169,6 +224,16 @@ class StoreServer:
             def do_POST(self):
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
+                if u.path == "/chaos":  # arm/replace the fault plan
+                    try:
+                        plan = FaultPlan.from_dict(self._body())
+                    except (ChaosPlanError, ValueError) as e:
+                        return self._reply(422, {"error": str(e)})
+                    server.arm_chaos(plan)
+                    return self._reply(200, server.chaos_status())
+                chaos_plan = server.chaos
+                if chaos_plan is not None and self._chaos_request(chaos_plan):
+                    return
                 if u.path == "/bulk":
                     try:
                         body = self._body()
@@ -191,6 +256,9 @@ class StoreServer:
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 q = parse_qs(u.query)
+                chaos_plan = server.chaos
+                if chaos_plan is not None and self._chaos_request(chaos_plan):
+                    return
                 if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
                     key = q.get("key", [""])[0]
                     try:
@@ -210,6 +278,9 @@ class StoreServer:
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 q = parse_qs(u.query)
+                chaos_plan = server.chaos
+                if chaos_plan is not None and self._chaos_request(chaos_plan):
+                    return
                 if len(parts) == 2 and parts[0] == "apis":
                     cas = q.get("cas", [None])[0]
                     try:
@@ -228,6 +299,12 @@ class StoreServer:
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 q = parse_qs(u.query)
+                if u.path == "/chaos":  # disarm
+                    server.arm_chaos(None)
+                    return self._reply(200, server.chaos_status())
+                chaos_plan = server.chaos
+                if chaos_plan is not None and self._chaos_request(chaos_plan):
+                    return
                 if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
                     key = q.get("key", [""])[0]
                     with server.lock:
@@ -241,6 +318,22 @@ class StoreServer:
         self.port = self.httpd.server_address[1]
         self.url = f"http://{host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
+
+    # -- chaos admin (volcano_tpu/chaos.py) ------------------------------------
+
+    def arm_chaos(self, plan: Optional[FaultPlan]) -> None:
+        """Arm (or, with None, disarm) a fault plan.  Counters restart
+        with the new plan; the middleware reads ``self.chaos`` once per
+        faultpoint, so in-flight requests finish under whichever plan they
+        started with."""
+        with self.lock:
+            self.chaos = plan
+
+    def chaos_status(self) -> Dict[str, Any]:
+        plan = self.chaos
+        if plan is None:
+            return {"armed": False, "plan": None, "stats": []}
+        return {"armed": True, "plan": plan.to_dict(), "stats": plan.stats()}
 
     # -- mutations (called from handler threads, locked) ----------------------
 
@@ -465,6 +558,14 @@ class StoreServer:
         overwrite a fresher snapshot with a staler one."""
         if self.state_path is None:
             return
+        chaos = self.chaos
+        if chaos is not None:
+            rule = chaos.fire("server.flush")
+            if rule is not None and rule.action == "drop_flush":
+                # injected durability gap: acked writes stay dirty until
+                # the next interval — the crash window the state-file
+                # contract already documents, now testable on demand
+                return
         with self._flush_lock:
             with self.lock:
                 # drain any watch events queued by writes that bypassed the
