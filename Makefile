@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench native examples install clean images image image-tpu lint sanitize chaos
+.PHONY: test e2e parity bench native examples install clean images image image-tpu lint sanitize chaos elastic
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -21,6 +21,14 @@ test: lint
 # variant is slow-exempt and runs in tier-1; this target runs every plan.
 chaos:
 	$(PY) -m pytest tests/test_chaos_soak.py -q
+
+# elastic capacity (volcano_tpu/elastic/ + tests/test_elastic.py): the
+# demand estimator, the cordon/drain lifecycle, the elasticd daemon, the
+# fastpath churn-parity storm, and the chaos-soak elastic storm.  The
+# fast smoke (scale-up -> placement parity -> drain-back) is tier-1.
+elastic:
+	$(PY) -m pytest tests/test_elastic.py \
+	  tests/test_chaos_soak.py::test_chaos_soak_elastic_provision_failures -q
 
 # the daemons suite with the runtime lock-order sanitizer on: every lock
 # acquisition in the multi-process control plane is order-checked against

@@ -94,6 +94,22 @@ PLAN_LEASE_FLAP = {
 }
 
 
+#: the fourth seeded storm: elastic scale-up under provisioning failures —
+#: the first attempts fail outright, later ones are delay-injected; demand
+#: persists so elasticd retries, and the pool must still converge to the
+#: same placements as a fault-free pre-provisioned run with no orphan
+#: Provisioning nodes and the size bounds held throughout
+PLAN_PROVISION_FAIL = {
+    "seed": 404,
+    "rules": [
+        {"point": "elastic.provision", "action": "fail",
+         "every": 1, "count": 5},
+        {"point": "elastic.provision", "action": "delay", "arg": 0.3,
+         "after": 5, "every": 2, "count": 4},
+    ],
+}
+
+
 def _arm(url: str, plan):
     data = json.dumps(plan).encode() if plan is not None else None
     req = urllib.request.Request(
@@ -185,6 +201,7 @@ class ControlPlane:
             self.stop.wait(0.02)
 
     def _kubelet_loop(self):
+        from volcano_tpu.elastic import kubelet_provisioning_step
         from volcano_tpu.store.store import Conflict
 
         store = RemoteStore(self.url)
@@ -201,8 +218,39 @@ class ControlPlane:
                             store.update_cas("Pod", pod, rv)
                         except (Conflict, KeyError):
                             pass
+                kubelet_provisioning_step(store, time.time())
                 retry.reset()
             except TRANSIENT:
+                retry.sleep()
+                continue
+            self.stop.wait(0.02)
+
+    def _elastic_loop(self, fault_plan):
+        """elasticd with the daemon-grade outage discipline, sampling the
+        pool-size invariant every pump (``min_size <= size <= max_size``
+        must hold THROUGHOUT the storm, not just at the end)."""
+        from volcano_tpu.elastic import ElasticController, pool_nodes
+
+        retry = Backoff(base=0.02, cap=0.3, seed=24)
+        ctl = None
+        while not self.stop.is_set():
+            try:
+                if ctl is None:
+                    store = RemoteStore(self.url)
+                    ctl = ElasticController(store, chaos=fault_plan)
+                ctl.pump()
+                for pool in store.list("NodePool"):
+                    size = len(pool_nodes(store, pool.meta.name))
+                    if not pool.min_size <= size <= pool.max_size:
+                        self.crashes.append(
+                            f"pool {pool.meta.name} size {size} outside "
+                            f"[{pool.min_size}, {pool.max_size}]")
+                retry.reset()
+            except StaleWatch:
+                ctl = None
+                continue
+            except TRANSIENT:
+                ctl = None
                 retry.sleep()
                 continue
             self.stop.wait(0.02)
@@ -215,7 +263,8 @@ class ControlPlane:
                 self.crashes.append(repr(e))
         return run
 
-    def start(self, schedulers=1, controllers=1, flap_component=""):
+    def start(self, schedulers=1, controllers=1, flap_component="",
+              elastic_plan=False):
         specs = []
         for i in range(controllers):
             flapped = flap_component == "vk-controllers" and i == 1
@@ -232,6 +281,12 @@ class ControlPlane:
                              daemon=True)
         t.start()
         self.threads.append(t)
+        if elastic_plan is not False:
+            t = threading.Thread(
+                target=self._guard(self._elastic_loop, elastic_plan),
+                daemon=True)
+            t.start()
+            self.threads.append(t)
         return self
 
     def shutdown(self):
@@ -654,6 +709,106 @@ def test_real_daemons_survive_env_armed_chaos():
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def _elastic_soak(provision_plan, n_jobs=3):
+    """Elastic scale-up soak: a NodePool at min_size=0 absorbs a gang
+    burst through (possibly fault-injected) provisioning.  Returns the
+    final placements for parity against a fault-free PRE-PROVISIONED run
+    (``_preprovisioned_soak``) — each pod fills a whole template node, so
+    gradual arrival and up-front provisioning must land identically."""
+    from volcano_tpu.api.objects import NodePool
+    from volcano_tpu.chaos import FaultPlan
+    from volcano_tpu.elastic import POOL_LABEL, READY, node_state
+
+    srv = StoreServer().start()
+    plan = (FaultPlan.from_dict(provision_plan)
+            if provision_plan is not None else None)
+    cp = ControlPlane(srv.url)
+    try:
+        assert wait_healthy(srv.url, timeout=10)
+        srv.store.create("Queue", Queue(
+            meta=Metadata(name="default", namespace="")))
+        srv.store.create("NodePool", NodePool(
+            meta=Metadata(name="bp", namespace=""),
+            resources=Resource.from_resource_list(
+                {"cpu": "2", "memory": "8Gi", "pods": 110}),
+            min_size=0, max_size=2 * n_jobs,
+            provision_delay=0.1, hysteresis=600.0,
+        ))
+        cp.start(elastic_plan=plan)
+
+        client = RemoteStore(srv.url)
+        for i in range(n_jobs):
+            _submit(client, _mk_job(f"cj{i}", 2, cpu="2"))
+            _wait_running(client, f"soak/cj{i}", deadline=120)
+
+        # every member must settle Ready: an orphan Provisioning node
+        # would mean capacity nobody asked for survived the storm
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            members = [n for n in client.list("Node")
+                       if n.labels.get(POOL_LABEL) == "bp"]
+            if members and all(node_state(n) == READY and n.ready()
+                               for n in members):
+                break
+            time.sleep(0.1)
+        members = [n for n in client.list("Node")
+                   if n.labels.get(POOL_LABEL) == "bp"]
+        assert members and all(node_state(n) == READY for n in members), (
+            f"orphan Provisioning nodes: "
+            f"{[(n.meta.name, node_state(n)) for n in members]}")
+        assert len(members) == 2 * n_jobs  # the bin-pack minimum, exactly
+        _check_invariants(client)
+        if plan is not None:
+            assert any(r["fires"] > 0 for r in plan.stats()), (
+                "the provisioning faults never fired")
+        return _placements(client)
+    finally:
+        cp.shutdown()
+        srv.stop()
+
+
+def _preprovisioned_soak(n_jobs=3):
+    """The comparator: the same workload against the pool's final shape
+    created up front — no NodePool object, no elasticd."""
+    from volcano_tpu.elastic import POOL_LABEL
+
+    srv = StoreServer().start()
+    cp = ControlPlane(srv.url)
+    try:
+        assert wait_healthy(srv.url, timeout=10)
+        srv.store.create("Queue", Queue(
+            meta=Metadata(name="default", namespace="")))
+        for i in range(2 * n_jobs):
+            srv.store.create("Node", Node(
+                meta=Metadata(name=f"bp-{i}", namespace=""),
+                allocatable=Resource.from_resource_list(
+                    {"cpu": "2", "memory": "8Gi", "pods": 110}),
+                labels={POOL_LABEL: "bp"}))
+        cp.start()
+        client = RemoteStore(srv.url)
+        for i in range(n_jobs):
+            _submit(client, _mk_job(f"cj{i}", 2, cpu="2"))
+            _wait_running(client, f"soak/cj{i}")
+        _check_invariants(client)
+        return _placements(client)
+    finally:
+        cp.shutdown()
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_chaos_soak_elastic_provision_failures():
+    """Fourth seeded storm: scale-up under elastic.provision failures
+    converges to the same final placements as a fault-free
+    pre-provisioned run."""
+    baseline = _preprovisioned_soak()
+    faultfree = _elastic_soak(None)
+    stormy = _elastic_soak(PLAN_PROVISION_FAIL)
+    assert faultfree == baseline
+    assert stormy == baseline
+    assert len(stormy) == 6  # 3 gangs x 2 full-node replicas, all Running
 
 
 @pytest.mark.slow

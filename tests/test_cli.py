@@ -86,3 +86,80 @@ def test_main_entry_roundtrip(tmp_path):
     assert main(["--state", state, "job", "suspend", "--name", "m1"]) == 0
     assert main(["--state", state, "job", "resume", "--name", "m1"]) == 0
     assert main(["--state", state, "job", "suspend", "--name", "ghost"]) == 1
+
+
+# -- node cordon/uncordon/drain + pool list (elastic capacity) ----------------
+
+
+def test_node_cordon_shows_scheduling_disabled_and_masks(cluster):
+    from volcano_tpu.cli import cmd_cordon, cmd_node_list, cmd_uncordon
+
+    cluster.add_node("n1", {"cpu": "8", "memory": "16Gi", "pods": 110})
+    cmd_cordon(cluster.store, "n0")
+    text = cmd_node_list(cluster.store)
+    row = [ln for ln in text.splitlines() if ln.startswith("n0")][0]
+    assert "Ready,SchedulingDisabled" in row
+    # new work lands on the remaining schedulable node only
+    cmd_run(cluster.store, name="after", replicas=2, min_available=2)
+    cluster.run_until_idle()
+    assert {p.node_name for p in cluster.store.list("Pod")} == {"n1"}
+    cmd_uncordon(cluster.store, "n0")
+    assert "SchedulingDisabled" not in cmd_node_list(cluster.store)
+
+
+def test_node_drain_is_cordon_plus_evict(cluster):
+    from volcano_tpu.cli import cmd_drain, cmd_node_list
+
+    cluster.add_node("n1", {"cpu": "8", "memory": "16Gi", "pods": 110})
+    cmd_run(cluster.store, name="d1", replicas=2, min_available=2)
+    cluster.run_until_idle()
+    victims = [p for p in cluster.store.list("Pod") if p.node_name == "n0"]
+    evicted = cmd_drain(cluster.store, "n0")
+    assert sorted(evicted) == sorted(p.meta.key for p in victims)
+    assert all(cluster.store.get("Pod", k).deleting for k in evicted)
+    assert "SchedulingDisabled" in [
+        ln for ln in cmd_node_list(cluster.store).splitlines()
+        if ln.startswith("n0")][0]
+    cluster.run_until_idle()
+    # the job recovered entirely off the drained node
+    pods = [p for p in cluster.store.list("Pod") if p.node_name]
+    assert pods and all(p.node_name == "n1" for p in pods)
+
+
+def test_node_verbs_unknown_node(cluster):
+    from volcano_tpu.cli import cmd_cordon, cmd_drain
+
+    with pytest.raises(KeyError):
+        cmd_cordon(cluster.store, "ghost")
+    with pytest.raises(KeyError):
+        cmd_drain(cluster.store, "ghost")
+
+
+def test_pool_list_table(cluster):
+    from volcano_tpu.cli import cmd_pool_list
+
+    assert "No resources found" in cmd_pool_list(cluster.store)
+    cluster.add_node_pool("tp", {"cpu": "2", "memory": "4Gi"}, min_size=1,
+                          max_size=4)
+    cluster.run_until_idle()
+    text = cmd_pool_list(cluster.store)
+    assert text.splitlines()[0].split()[:5] == [
+        "Name", "Min", "Max", "Size", "Ready"]
+    row = [ln for ln in text.splitlines() if ln.startswith("tp")][0].split()
+    assert row[1:5] == ["1", "4", "1", "1"]
+
+
+def test_main_entry_node_and_pool_verbs(tmp_path, capsys):
+    from volcano_tpu.cli.vtctl import main
+
+    state = str(tmp_path / "state.pkl")
+    assert main(["--state", state, "cluster", "init", "--nodes", "2"]) == 0
+    assert main(["--state", state, "node", "cordon", "node-0"]) == 0
+    assert main(["--state", state, "node", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "SchedulingDisabled" in out
+    assert main(["--state", state, "node", "uncordon", "node-0"]) == 0
+    assert main(["--state", state, "node", "drain", "node-1"]) == 0
+    assert main(["--state", state, "pool", "list"]) == 0
+    assert "No resources found" in capsys.readouterr().out
+    assert main(["--state", state, "node", "cordon", "ghost"]) == 1

@@ -1,0 +1,654 @@
+"""Elastic capacity: demand estimator, node lifecycle, the elastic soak
+acceptance, and cordon/drain churn parity between the fastpath mirror and
+a fresh host-backend run.
+
+The acceptance soak (tier-1): a 3-gang burst against a pool at min_size
+scales up to exactly the estimator's bin-pack minimum, converges to the
+same final placements as a run started fully provisioned, then drains back
+to min_size after the hysteresis window — with zero non-drain evictions of
+Running pods and no oversubscription at any step.
+"""
+
+import pytest
+
+from volcano_tpu.api.job import JOB_NAME_KEY, Job, JobSpec, TaskSpec
+from volcano_tpu.api.objects import Metadata, NodePool, PodSpec, Taint, Toleration
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobPhase, PodPhase
+from volcano_tpu.elastic import (
+    DRAINING,
+    POOL_LABEL,
+    PROVISIONING,
+    READY,
+    ElasticController,
+    node_state,
+    plan_pools,
+    pool_nodes,
+    unschedulable_gangs,
+)
+from volcano_tpu.elastic.demand import GangDemand
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.conf import default_conf, full_conf
+from volcano_tpu.sim import Cluster
+
+from helpers import build_node, build_pod, build_podgroup, build_queue, make_store
+
+
+def _pool(name="tp", cpu="2", mem="4Gi", min_size=0, max_size=8, **kw):
+    return NodePool(
+        meta=Metadata(name=name, namespace=""),
+        resources=Resource.from_resource_list(
+            {"cpu": cpu, "memory": mem, "pods": 110}),
+        min_size=min_size,
+        max_size=max_size,
+        **kw,
+    )
+
+
+def _gang(key, n, cpu=2000, mem=4 << 30, queue="default", priority=0,
+          selector=None, tolerations=None):
+    return GangDemand(
+        key=key, queue=queue, priority=priority,
+        requests=[Resource(milli_cpu=cpu, memory=mem) for _ in range(n)],
+        selector=dict(selector or {}), tolerations=list(tolerations or []),
+    )
+
+
+def mk_job(name, replicas=2, cpu="2", mem="4Gi", namespace="el",
+           queue="default"):
+    return Job(
+        meta=Metadata(name=name, namespace=namespace),
+        spec=JobSpec(
+            min_available=replicas, queue=queue,
+            tasks=[TaskSpec(name="w", replicas=replicas,
+                            template=PodSpec(
+                                image="busybox",
+                                resources=Resource.from_resource_list(
+                                    {"cpu": cpu, "memory": mem})))],
+        ),
+    )
+
+
+# -- demand estimator ---------------------------------------------------------
+
+
+def _plan_store(pools, queues=("default",)):
+    store = make_store(nodes=[], queues=[build_queue(q) for q in queues])
+    for p in pools:
+        store.create("NodePool", p)
+    return store
+
+
+def test_estimator_binpacks_whole_gangs():
+    """Two 2-pod full-node gangs need 4 nodes; a gang that cannot fully
+    fit under max_size contributes NOTHING (never half a gang)."""
+    pool = _pool(max_size=5)
+    store = _plan_store([pool])
+    plans = plan_pools(store, [pool],
+                       gangs=[_gang("a/g1", 2), _gang("a/g2", 2),
+                              _gang("a/g3", 2)])
+    plan = plans["tp"]
+    assert plan.demand_nodes == 6          # unclipped bin-pack minimum
+    assert plan.new_nodes == 4             # g3 would need 2 > remaining 1
+    assert plan.admitted == ["a/g1", "a/g2"]
+
+
+def test_estimator_uses_existing_free_capacity_first():
+    """Free capacity on Ready members (and full Provisioning templates)
+    absorbs demand before new bins open."""
+    pool = _pool()
+    store = _plan_store([pool])
+    ready = build_node("tp-0", cpu="2", memory="4Gi",
+                       labels={POOL_LABEL: "tp"})
+    store.create("Node", ready)
+    plans = plan_pools(store, [pool], gangs=[_gang("a/g1", 2)])
+    assert plans["tp"].demand_nodes == 1  # one pod rides the free node
+
+
+def test_estimator_skips_unservable_gangs():
+    """A request larger than the template can never be served — no nodes
+    are provisioned for it (they could only host a forever-partial gang)."""
+    pool = _pool(cpu="2")
+    store = _plan_store([pool])
+    plans = plan_pools(store, [pool], gangs=[_gang("a/big", 2, cpu=4000)])
+    assert plans["tp"].demand_nodes == 0
+    assert plans["tp"].new_nodes == 0
+
+
+def test_estimator_respects_selector_and_taints():
+    pool = _pool()
+    pool.labels = {"zone": "z1"}
+    pool.taints = [Taint(key="tpu", value="v5e", effect="NoSchedule")]
+    store = _plan_store([pool])
+    # wrong selector: not eligible
+    plans = plan_pools(store, [pool],
+                       gangs=[_gang("a/g", 2, selector={"zone": "z2"})])
+    assert plans["tp"].new_nodes == 0
+    # matching selector but untolerated taint: not eligible
+    plans = plan_pools(store, [pool],
+                       gangs=[_gang("a/g", 2, selector={"zone": "z1"})])
+    assert plans["tp"].new_nodes == 0
+    # selector + toleration: served
+    plans = plan_pools(store, [pool], gangs=[
+        _gang("a/g", 2, selector={"zone": "z1"},
+              tolerations=[Toleration(key="tpu", operator="Exists")])])
+    assert plans["tp"].new_nodes == 2
+
+
+def test_estimator_queue_clip_loans_idle_quota():
+    """Aryl-style: a lone demanding queue takes the whole pool (idle quota
+    is loaned); under contention each queue is clipped to its weighted
+    share of the headroom, whole gangs at a time."""
+    pool = _pool(max_size=4)
+    store = _plan_store([pool], queues=("qa", "qb"))
+    # qa alone: loan lets it take all 4 nodes despite qb's idle quota
+    plans = plan_pools(store, [pool], gangs=[
+        _gang("a/g1", 2, queue="qa"), _gang("a/g2", 2, queue="qa")])
+    assert plans["tp"].new_nodes == 4
+    # contention (demand 8 > headroom 4): equal weights -> 2 nodes each,
+    # one whole gang per queue
+    plans = plan_pools(store, [pool], gangs=[
+        _gang("a/g1", 2, queue="qa"), _gang("a/g2", 2, queue="qa"),
+        _gang("b/g1", 2, queue="qb"), _gang("b/g2", 2, queue="qb")])
+    plan = plans["tp"]
+    assert plan.demand_nodes == 8
+    assert plan.new_nodes == 4
+    assert sorted(plan.admitted) == ["a/g1", "b/g1"]
+
+
+def test_estimator_pools_absorb_by_priority():
+    hi = _pool("fast", priority=10, max_size=2)
+    lo = _pool("slow", priority=0, max_size=8)
+    store = _plan_store([hi, lo])
+    plans = plan_pools(store, [lo, hi],
+                       gangs=[_gang("a/g1", 2), _gang("a/g2", 2)])
+    assert plans["fast"].new_nodes == 2   # g1 lands on the priority pool
+    assert plans["slow"].new_nodes == 2   # g2 overflows to the next pool
+
+
+def test_gang_signal_from_unschedulable_condition():
+    """unschedulable_gangs reads the PodGroup condition the gang plugin
+    publishes — including the from-zero case where the enqueue gate held
+    the group Pending and no pods exist (requests derived from the Job)."""
+    c = Cluster(scheduler_conf=full_conf("host"))
+    c.add_queue("default")
+    c.store.create("Job", mk_job("cj0", replicas=2))
+    for _ in range(2):
+        c.step()
+    gangs = unschedulable_gangs(c.store)
+    assert [g.key for g in gangs] == ["el/cj0"]
+    assert len(gangs[0].requests) == 2
+    assert gangs[0].requests[0].milli_cpu == 2000.0
+
+
+# -- lifecycle ----------------------------------------------------------------
+
+
+def test_provisioning_node_turns_ready_after_delay():
+    c = Cluster(with_scheduler=False, with_controller=False)
+    c.add_node_pool("tp", {"cpu": "2", "memory": "4Gi"}, min_size=1,
+                    provision_delay=3)
+    c.pump_elastic()
+    (node,) = pool_nodes(c.store, "tp")
+    assert node_state(node) == PROVISIONING and not node.ready()
+    for _ in range(2):
+        c.step()
+    assert not c.store.get("Node", "/tp-0").ready()
+    for _ in range(2):
+        c.step()
+    node = c.store.get("Node", "/tp-0")
+    assert node.ready() and node_state(node) == READY
+
+
+def test_cordoned_and_provisioning_nodes_masked_from_placement():
+    """A cordoned node and a Provisioning node both reject placement on
+    the next cycle — existing predicate masks, no scheduler changes."""
+    from volcano_tpu.cli import cmd_cordon, cmd_uncordon
+
+    c = Cluster(scheduler_conf=full_conf("host"))
+    c.add_queue("default")
+    c.add_node("n0", {"cpu": "4", "memory": "8Gi", "pods": 110})
+    c.add_node("n1", {"cpu": "4", "memory": "8Gi", "pods": 110})
+    cmd_cordon(c.store, "n0")
+    c.store.create("Job", mk_job("cj0", replicas=2, cpu="1", mem="1Gi"))
+    c.run_until_idle()
+    placements = {p.node_name for p in c.store.list("Pod") if p.node_name}
+    assert placements == {"n1"}
+    cmd_uncordon(c.store, "n0")
+    c.store.create("Job", mk_job("cj1", replicas=2, cpu="2", mem="2Gi"))
+    c.run_until_idle()
+    assert any(p.node_name == "n0" for p in c.store.list("Pod"))
+
+
+def test_drain_evicts_through_releasing_and_node_empties():
+    from volcano_tpu.cli import cmd_drain
+
+    c = Cluster(scheduler_conf=full_conf("host"))
+    c.add_queue("default")
+    c.add_node("n0", {"cpu": "4", "memory": "8Gi", "pods": 110})
+    c.add_node("n1", {"cpu": "4", "memory": "8Gi", "pods": 110})
+    c.store.create("Job", mk_job("cj0", replicas=1, cpu="1", mem="1Gi"))
+    c.run_until_idle()
+    (pod,) = [p for p in c.store.list("Pod")]
+    victim = pod.node_name
+    evicted = cmd_drain(c.store, victim)
+    assert evicted == [pod.meta.key]
+    assert c.store.get("Node", f"/{victim}").unschedulable
+    c.run_until_idle()
+    # the evicted pod was reaped and the controller recreated it on the
+    # OTHER node (drain = cordon + the existing eviction/Releasing path)
+    pods = [p for p in c.store.list("Pod")
+            if p.phase in (PodPhase.PENDING, PodPhase.RUNNING)]
+    assert pods and all(p.node_name != victim for p in pods)
+
+
+# -- the elastic soak (tier-1 acceptance) -------------------------------------
+
+
+def _soak_invariants(c: Cluster, pool_name: str):
+    nodes = {n.meta.name: n for n in c.store.list("Node")}
+    used = {name: Resource() for name in nodes}
+    for pod in c.store.list("Pod"):
+        if pod.node_name and pod.phase in (PodPhase.PENDING, PodPhase.RUNNING):
+            if pod.node_name in used:
+                used[pod.node_name].add(pod.spec.resources)
+    for name, u in used.items():
+        assert u.less_equal(nodes[name].allocatable), f"{name} oversubscribed"
+    pool = c.store.get("NodePool", f"/{pool_name}")
+    size = len(pool_nodes(c.store, pool_name))
+    assert pool.min_size <= size <= pool.max_size, (
+        f"pool size {size} outside [{pool.min_size}, {pool.max_size}]")
+
+
+def test_elastic_soak_burst_scales_converges_and_drains():
+    """The acceptance scenario end to end, invariants checked every step."""
+    metrics.reset()
+    c = Cluster(scheduler_conf=full_conf("host"))
+    c.add_queue("default")
+    c.add_node_pool("tp", {"cpu": "2", "memory": "4Gi", "pods": 110},
+                    min_size=1, max_size=8, provision_delay=2, hysteresis=3)
+    for _ in range(3):
+        c.step()
+        _soak_invariants(c, "tp")
+    assert [n.meta.name for n in pool_nodes(c.store, "tp")] == ["tp-0"]
+
+    # 3-gang burst; each pod fills a template node -> bin-pack minimum 6
+    for i in range(3):
+        c.store.create("Job", mk_job(f"cj{i}"))
+    deleting_seen = []
+    for _ in range(25):
+        c.step()
+        _soak_invariants(c, "tp")
+        deleting_seen.extend(
+            p.meta.key for p in c.store.list("Pod")
+            if p.deleting and p.phase == PodPhase.RUNNING
+        )
+    assert all(j.status.state.phase == JobPhase.RUNNING
+               for j in c.store.list("Job"))
+    pool = c.store.get("NodePool", "/tp")
+    assert pool.status.size == 6, "scaled to exactly the bin-pack minimum"
+    assert pool.status.ready == 6 and pool.status.provisioning == 0
+    assert pool.status.scale_ups == 6
+    elastic_placements = sorted(
+        (p.meta.key, p.node_name) for p in c.store.list("Pod") if p.node_name)
+
+    # a run started fully provisioned lands the same placements
+    b = Cluster(scheduler_conf=full_conf("host"))
+    b.add_queue("default")
+    for i in range(6):
+        b.add_node(f"tp-{i}", {"cpu": "2", "memory": "4Gi", "pods": 110},
+                   labels={POOL_LABEL: "tp"})
+    for i in range(3):
+        b.store.create("Job", mk_job(f"cj{i}"))
+    b.run_until_idle()
+    baseline = sorted(
+        (p.meta.key, p.node_name) for p in b.store.list("Pod") if p.node_name)
+    assert elastic_placements == baseline
+
+    # workloads finish; after the hysteresis window the pool drains back
+    # to min_size with zero non-drain evictions of Running pods
+    for p in c.store.list("Pod"):
+        if p.phase == PodPhase.RUNNING:
+            c.complete_pod(p.meta.key)
+    for _ in range(15):
+        c.step()
+        _soak_invariants(c, "tp")
+        deleting_seen.extend(
+            p.meta.key for p in c.store.list("Pod")
+            if p.deleting and p.phase == PodPhase.RUNNING
+        )
+    assert sorted(n.meta.name for n in c.store.list("Node")) == ["tp-0"]
+    pool = c.store.get("NodePool", "/tp")
+    assert pool.status.size == 1 and pool.status.scale_downs == 5
+    assert deleting_seen == [], "a Running pod was evicted outside a drain"
+    assert c.scheduler.cache.evict_log == []
+    assert metrics.get_counter(
+        "volcano_elastic_scale_events_total", pool="tp", direction="up") == 6
+    assert metrics.get_counter(
+        "volcano_elastic_scale_events_total", pool="tp", direction="down") == 5
+    assert metrics.get_counter(
+        "volcano_elastic_drain_evictions_total", pool="tp") == 0
+
+
+def test_elastic_provision_chaos_fail_retries_and_converges():
+    """elastic.provision 'fail' rules starve early attempts; demand
+    persists, the controller retries, and the pool still converges with
+    no orphan Provisioning nodes and size within bounds throughout."""
+    from volcano_tpu.chaos import FaultPlan
+
+    c = Cluster(scheduler_conf=full_conf("host"))
+    c.add_queue("default")
+    c.add_node_pool("tp", {"cpu": "2", "memory": "4Gi", "pods": 110},
+                    min_size=0, max_size=4, provision_delay=1, hysteresis=50)
+    c.elastic.chaos = FaultPlan.from_dict({"seed": 7, "rules": [
+        {"point": "elastic.provision", "action": "fail", "count": 3},
+        {"point": "elastic.provision", "action": "delay", "arg": 2.0,
+         "count": 1},
+    ]})
+    c.store.create("Job", mk_job("cj0"))
+    for _ in range(20):
+        c.step()
+        _soak_invariants(c, "tp")
+    assert c.store.get("Job", "el/cj0").status.state.phase == JobPhase.RUNNING
+    members = pool_nodes(c.store, "tp")
+    assert len(members) == 2
+    assert all(node_state(n) == READY for n in members), "orphan Provisioning"
+    plan = c.elastic.chaos.stats()
+    assert plan[0]["fires"] == 3  # the injected failures really happened
+
+
+def test_estimator_ignores_demand_unservable_at_cap():
+    """A gang whose remainder alone needs more bins than max_size can
+    never run in the pool — it must not count as demand, or it would pin
+    the scale-down hysteresis clock forever while idle nodes leak."""
+    pool = _pool(max_size=4)
+    store = _plan_store([pool])
+    plans = plan_pools(store, [pool], gangs=[_gang("a/huge", 6)])
+    assert plans["tp"].demand_nodes == 0
+    assert plans["tp"].eligible_gangs == 0
+    # end to end: idle nodes above min_size still drain back with the
+    # unservable gang pending
+    c = Cluster(scheduler_conf=full_conf("host"))
+    c.add_queue("default")
+    c.add_node_pool("tp", {"cpu": "2", "memory": "4Gi", "pods": 110},
+                    min_size=1, max_size=4, provision_delay=0, hysteresis=2)
+    c.store.create("Job", mk_job("fit", replicas=2))
+    c.run_until_idle()
+    assert len(pool_nodes(c.store, "tp")) == 2
+    c.store.create("Job", mk_job("huge", replicas=6))  # > max_size forever
+    for p in c.store.list("Pod"):
+        if p.phase == PodPhase.RUNNING:
+            c.complete_pod(p.meta.key)
+    for _ in range(10):
+        c.step()
+    assert len(pool_nodes(c.store, "tp")) == 1, (
+        "unservable demand pinned the hysteresis clock")
+
+
+def test_uncordon_cancels_autoscaler_drain():
+    """`vtctl node uncordon` of a Draining member returns it to service:
+    the lifecycle state clears in the same write, so the controller stops
+    treating it as Draining (no eviction fight, no surprise deletion)."""
+    from volcano_tpu.cli import cmd_uncordon
+    from volcano_tpu.elastic import begin_drain
+
+    c = Cluster(with_scheduler=False, with_controller=False)
+    c.add_node_pool("tp", {"cpu": "2", "memory": "4Gi"}, min_size=1,
+                    max_size=4, hysteresis=50)
+    c.pump_elastic()
+    node = c.store.get("Node", "/tp-0")
+    begin_drain(c.store, node)
+    assert node_state(c.store.get("Node", "/tp-0")) == DRAINING
+    cmd_uncordon(c.store, "tp-0")
+    fresh = c.store.get("Node", "/tp-0")
+    assert not fresh.unschedulable and node_state(fresh) == READY
+    c.pump_elastic()
+    assert c.store.get("Node", "/tp-0") is not None, (
+        "controller deleted an uncordoned node")
+
+
+def test_fresh_controller_finishes_persisted_drain():
+    """Leader failover mid-drain: a node atomically marked Draining
+    (begin_drain's single write) is finished — emptied and deleted — by a
+    REPLACEMENT controller that never saw the original decision."""
+    from volcano_tpu.elastic import ElasticController, begin_drain
+
+    c = Cluster(with_scheduler=False, with_controller=False)
+    c.add_node_pool("tp", {"cpu": "2", "memory": "4Gi"}, min_size=0,
+                    max_size=4, hysteresis=0)
+    # two members; one goes Draining, then the old leader "crashes"
+    c.store.create("Job", mk_job("seed", replicas=2))  # no scheduler: ignored
+    c.elastic.pump()  # nothing yet (min_size 0, no demand signal)
+    from volcano_tpu.elastic.lifecycle import make_pool_node
+
+    pool = c.store.get("NodePool", "/tp")
+    for i in range(2):
+        n = make_pool_node(pool, i, ready_at=0.0)
+        c.store.create("Node", n)
+    from volcano_tpu.elastic import kubelet_provisioning_step
+
+    kubelet_provisioning_step(c.store, 1.0)
+    begin_drain(c.store, c.store.get("Node", "/tp-1"))
+    takeover = ElasticController(c.store, clock=lambda: 100.0)
+    takeover.pump()
+    assert c.store.get("Node", "/tp-1") is None, (
+        "replacement leader never finished the persisted drain")
+    assert c.store.get("Node", "/tp-0") is not None
+
+
+def test_run_until_idle_waits_out_provision_delay():
+    """A wait-only step (clock ticking toward a Provisioning node's
+    ready-at) counts as movement: run_until_idle must not report
+    quiescence with a gang pending on nodes mid-provision."""
+    c = Cluster(scheduler_conf=full_conf("host"))
+    c.add_queue("default")
+    c.add_node_pool("tp", {"cpu": "2", "memory": "4Gi", "pods": 110},
+                    min_size=0, max_size=4, provision_delay=3, hysteresis=50)
+    c.store.create("Job", mk_job("cj0", replicas=2))
+    c.run_until_idle(max_steps=64)
+    assert c.store.get("Job", "el/cj0").status.state.phase == JobPhase.RUNNING
+
+
+def test_status_patch_preserves_concurrent_spec_edits():
+    """_publish_status patches status only: a spec edit (max_size bump)
+    an operator commits between elasticd's pump-start list and its status
+    write must survive.  Driven over RemoteStore — the wire path where
+    the controller holds decoded COPIES and a full-object write-back
+    would really clobber."""
+    from volcano_tpu.store.client import RemoteStore, wait_healthy
+    from volcano_tpu.store.server import StoreServer
+
+    srv = StoreServer().start()
+    try:
+        assert wait_healthy(srv.url, timeout=10)
+        admin = RemoteStore(srv.url)
+        admin.create("NodePool", _pool("tp", min_size=1, max_size=2))
+        client = RemoteStore(srv.url)
+        ctl = ElasticController(client)
+        orig = client.patch
+
+        def racing_patch(kind, key, fields, **kw):
+            if kind == "NodePool":
+                # the operator's edit lands mid-pump, before the
+                # controller's status write
+                live = admin.get("NodePool", key)
+                if live is not None and live.max_size == 2:
+                    live.max_size = 6
+                    admin.update("NodePool", live)
+            return orig(kind, key, fields, **kw)
+
+        client.patch = racing_patch
+        ctl.pump()
+        pool = admin.get("NodePool", "/tp")
+        assert pool.max_size == 6, "status write clobbered the spec edit"
+        assert pool.status.size == 1  # and the status still landed
+    finally:
+        srv.stop()
+
+
+# -- cordon/drain churn parity: fastpath mirror vs fresh host run -------------
+
+
+def _storm_ops(seed):
+    """A seeded storm of node cordons/uncordons/deletes/re-adds and gang
+    arrivals — pure data, so both backends replay the identical tape."""
+    import random
+
+    rng = random.Random(seed)
+    ops = []
+    for step in range(14):
+        r = rng.random()
+        if r < 0.3:
+            ops.append(("job", f"j{step}", rng.randint(1, 2),
+                        rng.choice(["500m", "1"])))
+        elif r < 0.5:
+            ops.append(("cordon", rng.randrange(4)))
+        elif r < 0.65:
+            ops.append(("uncordon", rng.randrange(4)))
+        elif r < 0.8:
+            ops.append(("delete", rng.randrange(4)))
+        else:
+            ops.append(("readd", rng.randrange(4)))
+    return ops
+
+
+def _run_storm(backend, ops, fast_off=False):
+    conf = default_conf(backend)
+    if fast_off:
+        conf.fast_path = "off"
+    store = make_store(
+        nodes=[build_node(f"n{i}", cpu="4", memory="8Gi") for i in range(4)],
+        queues=[build_queue("default")],
+    )
+    from volcano_tpu.scheduler.scheduler import Scheduler
+
+    sched = Scheduler(store, conf=conf)
+    fast_calls = []
+    if sched.fast_cycle is not None:
+        orig = sched.fast_cycle.try_run
+
+        def spy():
+            r = orig()
+            fast_calls.append(r)
+            return r
+
+        sched.fast_cycle.try_run = spy
+    sched.fast_calls = fast_calls
+    history = []
+    jobs = 0
+    for op in ops:
+        kind = op[0]
+        if kind == "job":
+            _, name, replicas, cpu = op
+            store.create("PodGroup", build_podgroup(name, min_member=replicas))
+            for t in range(replicas):
+                store.create("Pod", build_pod(f"{name}-{t}", group=name,
+                                              cpu=cpu, memory="512Mi"))
+            jobs += 1
+        elif kind == "cordon":
+            node = store.get("Node", f"/n{op[1]}")
+            if node is not None and not node.unschedulable:
+                store.patch("Node", f"/n{op[1]}", {"unschedulable": True})
+        elif kind == "uncordon":
+            node = store.get("Node", f"/n{op[1]}")
+            if node is not None and node.unschedulable:
+                store.patch("Node", f"/n{op[1]}", {"unschedulable": False})
+        elif kind == "delete":
+            store.delete("Node", f"/n{op[1]}")
+        elif kind == "readd":
+            if store.get("Node", f"/n{op[1]}") is None:
+                store.create("Node", build_node(f"n{op[1]}", cpu="4",
+                                                memory="8Gi"))
+        sched.run_once()
+        # sim kubelet: bound pods start Running before the next cycle
+        for pod in store.list("Pod"):
+            if pod.node_name and pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                store.update("Pod", pod)
+        history.append(sorted(
+            (p.meta.key, p.node_name)
+            for p in store.list("Pod") if p.node_name))
+    return sched, history
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_cordon_churn_parity_fastpath_vs_host(seed):
+    """Seeded cordon/uncordon/delete/re-add storm mid-cycles: the fastpath
+    mirror's placements match a fresh host-backend run bit-for-bit after
+    EVERY cycle — _on_node row retire/rebirth and cls_valid invalidation
+    under unschedulable flips."""
+    ops = _storm_ops(seed)
+    fast_sched, fast_hist = _run_storm("tpu", ops)
+    assert fast_sched.fast_cycle is not None
+    assert fast_sched.fast_cycle.mirror is not None
+    # the mirror really served every cycle — a silent object-path fallback
+    # would make this parity check vacuous
+    assert fast_sched.fast_calls and all(fast_sched.fast_calls)
+    _, host_hist = _run_storm("host", ops)
+    assert fast_hist == host_hist
+
+
+# -- elasticd daemon (real processes) -----------------------------------------
+
+
+@pytest.mark.slow
+def test_elasticd_daemon_scales_pool_over_http():
+    import json
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from volcano_tpu.store.client import RemoteStore, wait_healthy
+
+    entry = [sys.executable, "-m", "volcano_tpu.cli"]
+    import os
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "VOLCANO_TPU_BACKEND": "host"}
+    procs = []
+    try:
+        api = subprocess.Popen(entry + ["apiserver", "--port", "0"],
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True, env=env)
+        procs.append(api)
+        url = api.stdout.readline().strip().rsplit(" ", 1)[-1]
+        assert wait_healthy(url, timeout=30)
+        for comp, extra in (("controller", []), ("scheduler", ["--period", "0.1",
+                                                               "--metrics-port", "-1"]),
+                            ("kubelet", ["--period", "0.05"]),
+                            ("elastic", ["--period", "0.05",
+                                         "--metrics-port", "-1"])):
+            procs.append(subprocess.Popen(
+                entry + [comp, "--server", url] + extra,
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env))
+        client = RemoteStore(url)  # the apiserver creates the default queue
+        client.create("NodePool", _pool("tp", min_size=1, max_size=4,
+                                        provision_delay=0.1, hysteresis=60))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            nodes = [n for n in client.list("Node")
+                     if n.labels.get(POOL_LABEL) == "tp"]
+            if nodes and all(n.ready() for n in nodes):
+                break
+            time.sleep(0.2)
+        assert nodes and nodes[0].meta.name == "tp-0" and nodes[0].ready()
+
+        client.create("Job", mk_job("cj0", replicas=2))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            job = client.get("Job", "el/cj0")
+            if job is not None and job.status.state.phase == JobPhase.RUNNING:
+                break
+            time.sleep(0.2)
+        assert client.get("Job", "el/cj0").status.state.phase == JobPhase.RUNNING
+        pool = client.get("NodePool", "/tp")
+        assert 2 <= pool.status.size <= 4
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -107,3 +107,14 @@ def test_native_parity_random(seed):
 
 def test_native_threads_reported():
     assert native.num_threads() >= 1
+
+
+def test_native_parity_cordoned_and_provisioning_mix():
+    """Elastic capacity: cordoned and Provisioning nodes must be masked
+    from the native solver's decisions exactly as from the host path."""
+    from test_tensor_parity import _elastic_mix_store
+
+    host = run_backend(_elastic_mix_store, "host")
+    nat = run_backend(_elastic_mix_store, "native")
+    assert host == nat
+    assert host and set(host.values()) == {"n0", "n2"}

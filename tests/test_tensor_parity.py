@@ -129,3 +129,43 @@ def test_parity_oversubscribed():
         return binder.binds
 
     assert run("tpu") == run("host")
+
+
+def _elastic_mix_store():
+    """Cordoned + Provisioning + schedulable node mix (elastic capacity):
+    both masked states must be excluded from placement identically by
+    every backend — cordons via the unschedulable predicate, Provisioning
+    via the Ready condition — with enough pending work to overflow onto
+    the masked nodes if a backend ever leaked them into its mask."""
+    from volcano_tpu.api.objects import Metadata, NodePool
+    from volcano_tpu.api.resource import Resource
+    from volcano_tpu.elastic.lifecycle import make_pool_node
+
+    nodes = [build_node(f"n{i}", cpu="4", memory="8Gi") for i in range(3)]
+    nodes[1].unschedulable = True  # cordoned mid-drain
+    pool = NodePool(
+        meta=Metadata(name="tp", namespace=""),
+        resources=Resource.from_resource_list({"cpu": "4", "memory": "8Gi"}),
+    )
+    provisioning = make_pool_node(pool, 0, ready_at=1e18)  # never flips here
+    pgs, pods = [], []
+    for j in range(4):
+        pgs.append(build_podgroup(f"g{j}", min_member=2))
+        for t in range(2):
+            pods.append(build_pod(f"g{j}-{t}", group=f"g{j}",
+                                  cpu="2", memory="2Gi"))
+    return make_store(nodes=nodes + [provisioning], podgroups=pgs, pods=pods)
+
+
+def test_parity_cordoned_and_provisioning_mix():
+    def run(backend):
+        sched = Scheduler(_elastic_mix_store(), conf=default_conf(backend))
+        binder = FakeBinder()
+        sched.cache.binder = binder
+        sched.run_once()
+        return binder.binds
+
+    host, tpu = run("host"), run("tpu")
+    assert tpu == host
+    # the masked nodes took nothing; the two schedulable nodes filled up
+    assert host and set(host.values()) == {"n0", "n2"}

@@ -619,6 +619,100 @@ def test_retry_backoff_near_misses(tmp_path):
     """, select=["retry-backoff"]) == []
 
 
+# --- elastic scope: the daemon-module set and the scan roots include
+# --- volcano_tpu/elastic/ (elasticd's reconciler retries against the
+# --- store bus exactly like the cli daemons)
+
+
+def test_retry_backoff_fires_in_elastic_modules(tmp_path):
+    findings = _lint(tmp_path, "elastic/controller.py", """
+        import time
+
+        def reconcile_loop(store):
+            while True:
+                try:
+                    store.list("NodePool")
+                except OSError:
+                    time.sleep(0.5)
+    """, select=["retry-backoff"])
+    assert _rules_of(findings) == ["retry-backoff"]
+
+
+def test_retry_backoff_elastic_near_miss(tmp_path):
+    # backoff-paced retry in an elastic module: the sanctioned shape
+    assert _lint(tmp_path, "elastic/controller.py", """
+        import time
+        from volcano_tpu.backoff import Backoff
+
+        def reconcile_loop(store, period):
+            retry = Backoff()
+            while True:
+                try:
+                    store.list("NodePool")
+                    retry.reset()
+                except OSError:
+                    retry.sleep()
+                    continue
+                time.sleep(period)
+    """, select=["retry-backoff"]) == []
+
+
+def test_session_registry_scans_elastic_modules(tmp_path):
+    # a (hypothetical) elastic plugin registering a typoed Session
+    # callback must fire exactly as it would in scheduler/plugins/
+    findings = _lint(tmp_path, "elastic/plugin.py", """
+        def on_session_open(ssn):
+            ssn.add_pool_order_fn("elastic", lambda l, r: 0)
+    """, select=["session-registry"])
+    assert _rules_of(findings) == ["session-registry"]
+    assert _lint(tmp_path, "elastic/plugin.py", """
+        def on_session_open(ssn):
+            ssn.add_job_order_fn("elastic", lambda l, r: 0)
+    """, select=["session-registry"]) == []
+
+
+def test_lock_rules_scan_elastic_modules(tmp_path):
+    # an ABBA pair in an elastic module is flagged like anywhere else
+    findings = _lint(tmp_path, "elastic/state.py", """
+        import threading
+
+        class PoolState:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def grow(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def shrink(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """, select=["lock-order"])
+    assert _rules_of(findings) == ["lock-order"]
+    # consistent order: quiet
+    assert _lint(tmp_path, "elastic/state.py", """
+        import threading
+
+        class PoolState:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def grow(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def shrink(self):
+                with self.a:
+                    with self.b:
+                        pass
+    """, select=["lock-order"]) == []
+
+
 # --- suppression contract ---------------------------------------------------
 
 

@@ -1,7 +1,8 @@
 """Retry pacing discipline for daemon code: no fixed-sleep transient retries.
 
-The control-plane daemons (cli/daemons.py), the leader elector, and the
-store client all run retry-on-transient loops against the store bus.  The
+The control-plane daemons (cli/daemons.py), the elastic autoscaler
+(volcano_tpu/elastic/), the leader elector, and the store client all run
+retry-on-transient loops against the store bus.  The
 shared pacing primitive is ``volcano_tpu/backoff.py`` (decorrelated-jitter
 exponential backoff): a fixed ``time.sleep(period)`` on the retry path
 synchronizes every replica in a deployment onto the same beat — after an
@@ -39,9 +40,15 @@ _TRANSIENT_NAMES = {
 #: daemon modules the discipline applies to
 _SCOPED_BASENAMES = {"daemons.py", "leader.py", "client.py"}
 
+#: daemon PACKAGES the discipline applies to wholesale: every module under
+#: cli/ (the daemon entrypoints) and elastic/ (elasticd's reconciler —
+#: its pump loops retry against the store bus exactly like the daemons)
+_SCOPED_DIRS = {"cli", "elastic"}
+
 
 def _in_scope(ctx: FileContext) -> bool:
-    return "cli" in ctx.dir_parts or ctx.basename in _SCOPED_BASENAMES
+    return bool(_SCOPED_DIRS.intersection(ctx.dir_parts)) \
+        or ctx.basename in _SCOPED_BASENAMES
 
 
 def _exc_names(node: Optional[ast.AST]) -> List[str]:

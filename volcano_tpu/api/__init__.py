@@ -23,6 +23,8 @@ from volcano_tpu.api.job import (
 from volcano_tpu.api.objects import (
     Command,
     Node,
+    NodePool,
+    NodePoolStatus,
     PersistentVolume,
     PersistentVolumeClaim,
     Pod,

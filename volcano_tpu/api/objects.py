@@ -194,6 +194,47 @@ class Node:
 
 
 @dataclass
+class NodePoolStatus:
+    """Observed lifecycle counts, written by the elastic controller."""
+
+    size: int = 0           # owned nodes in any lifecycle state
+    ready: int = 0
+    provisioning: int = 0
+    draining: int = 0
+    pending_demand: int = 0  # unclipped bin-pack node demand last reconcile
+    scale_ups: int = 0
+    scale_downs: int = 0
+
+
+@dataclass
+class NodePool:
+    """Elastic node pool: a homogeneous template the autoscaler grows and
+    shrinks between ``min_size`` and ``max_size`` against gang demand
+    (volcano_tpu/elastic/; Aryl's pool-scaling https://arxiv.org/pdf/2202.07896,
+    heterogeneous pools as first-class sizing units per Gavel
+    https://arxiv.org/pdf/2008.09213).
+
+    ``resources``/``labels``/``taints`` describe every member node; members
+    carry the ``volcano.tpu/pool`` label back to the pool.  ``provision_delay``
+    is the (sim-clock) seconds a scale-up node spends Provisioning (Ready
+    condition False) before the kubelet flips it Ready; ``hysteresis`` is how
+    long demand must stay at zero before scale-down may cordon+drain.
+    ``priority`` orders pools for demand absorption (higher first).
+    """
+
+    meta: Metadata
+    resources: Resource = field(default_factory=Resource)  # template allocatable
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    min_size: int = 0
+    max_size: int = 8
+    provision_delay: float = 0.0
+    hysteresis: float = 0.0
+    priority: int = 0
+    status: NodePoolStatus = field(default_factory=NodePoolStatus)
+
+
+@dataclass
 class PodGroupCondition:
     kind: str
     status: str

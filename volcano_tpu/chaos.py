@@ -19,6 +19,10 @@ faultpoint          where it fires
 ``server.flush``    StoreServer.flush_state entry (durability layer)
 ``client.request``  every RemoteStore._request attempt (retries re-fire it)
 ``leader.clock``    every LeaderElector clock read (via :func:`chaos_clock`)
+``elastic.provision``  every node-provision attempt of the elastic
+                    autoscaler (ElasticController._provision; ``path`` is
+                    the would-be node name, so ``match.path`` can target
+                    one pool or member)
 ==================  ==========================================================
 
 and **actions**:
@@ -40,6 +44,13 @@ action              effect (valid faultpoints)
                     request leaves the process (client.request)
 ``skew``            add ``arg`` seconds to the clock reading — stale-lease /
                     lease-flap injection (leader.clock)
+``fail``            abort this provision attempt AND the rest of the
+                    pump's batch — provisioning is strictly index-ordered,
+                    so a faulted run creates the same member names in the
+                    same order as a fault-free one; demand persists and
+                    the autoscaler retries next pump (elastic.provision)
+``delay``           push the node's Provisioning->Ready flip ``arg``
+                    seconds later (elastic.provision)
 ==================  ==========================================================
 
 Determinism contract: rule selection is pure counter + seeded-RNG state.
@@ -71,6 +82,7 @@ FAULTPOINTS: Dict[str, tuple] = {
     "server.flush": ("drop_flush",),
     "client.request": ("os_error", "delay"),
     "leader.clock": ("skew",),
+    "elastic.provision": ("fail", "delay"),
 }
 
 ENV_VAR = "VOLCANO_TPU_CHAOS"

@@ -6,18 +6,28 @@ command functions also operate on any live Store for embedding.
 
 from volcano_tpu.cli.vtctl import (
     build_job_from_flags,
+    cmd_cordon,
+    cmd_drain,
     cmd_list,
+    cmd_node_list,
+    cmd_pool_list,
     cmd_resume,
     cmd_run,
     cmd_suspend,
+    cmd_uncordon,
     main,
 )
 
 __all__ = [
     "build_job_from_flags",
+    "cmd_cordon",
+    "cmd_drain",
     "cmd_list",
+    "cmd_node_list",
+    "cmd_pool_list",
     "cmd_resume",
     "cmd_run",
     "cmd_suspend",
+    "cmd_uncordon",
     "main",
 ]

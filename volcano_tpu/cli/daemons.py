@@ -265,8 +265,13 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
 def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
     """Simulated kubelets over the remote store: bound pending pods start
     Running; pods marked deleting are reaped (the Releasing window the
-    pipelined tasks wait on, SURVEY.md §3.5)."""
+    pipelined tasks wait on, SURVEY.md §3.5); Provisioning elastic nodes
+    flip Ready once wall time passes their provision delay
+    (elastic/lifecycle.py — elasticd stamps ready-at with time.time)."""
+    import time as _time
+
     from volcano_tpu.api.types import PodPhase
+    from volcano_tpu.elastic.lifecycle import kubelet_provisioning_step
     from volcano_tpu.store.client import RemoteStore
     from volcano_tpu.store.store import Conflict
 
@@ -292,6 +297,7 @@ def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
                         store.update_cas("Pod", pod, rv)
                     except (Conflict, KeyError):
                         pass  # changed under us; reconcile next period
+            kubelet_provisioning_step(store, _time.time())
             retry.reset()
             if down:
                 announce("kubelet: store back", flush=True)
@@ -299,6 +305,74 @@ def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
         except transient as e:
             if not down:
                 announce(f"kubelet: store unavailable ({e}); retrying", flush=True)
+                down = True
+            retry.sleep()
+            continue
+        time.sleep(period)
+
+
+def run_elastic(server: str, identity: str = "", leader_elect: bool = True,
+                period: float = 0.2, metrics_port: int = 8081,
+                announce=print) -> None:
+    """elasticd: the node-pool autoscaler daemon (volcano_tpu/elastic/).
+    Leader-elected like the controller/scheduler; the VOLCANO_TPU_CHAOS
+    env plan's ``elastic.provision`` rules inject provisioning
+    failures/delays; outage retries pace through the shared Backoff.
+    ``volcano_elastic_*`` series expose on /metrics at ``metrics_port``
+    (default :8081 — the scheduler owns :8080; <0 disables, 0 = free
+    port)."""
+    from volcano_tpu import chaos
+    from volcano_tpu.elastic import ElasticController
+    from volcano_tpu.store.client import RemoteStore, StaleWatch
+
+    from volcano_tpu.backoff import Backoff
+
+    ident = identity or f"elastic-{os.getpid()}"
+    plan = chaos.env_plan()
+    fault = plan if plan is not None and plan.has_point("elastic.provision") \
+        else None
+
+    def build():
+        store = RemoteStore(server)
+        return ElasticController(
+            store,
+            elector=_elector(store, "vk-elastic", ident, leader_elect),
+            chaos=fault,
+        )
+
+    if metrics_port >= 0:
+        from volcano_tpu.scheduler.metrics_server import MetricsServer
+
+        ms = MetricsServer(port=metrics_port).start()
+        announce(f"metrics on http://127.0.0.1:{ms.port}/metrics", flush=True)
+    transient = _transient_errors()
+    announce(f"elastic {ident} watching {server}", flush=True)
+    down = False
+    ctl = None
+    retry = Backoff(base=min(max(period, 0.01), 0.2))
+    while True:
+        try:
+            if ctl is None:
+                # construction subscribes watches over the wire — build
+                # inside the outage guard so a 5xx at boot retries instead
+                # of killing the unit (same shape as run_controller)
+                ctl = build()
+            ctl.pump()
+            retry.reset()
+            if down:
+                announce(f"elastic {ident}: store back, relisting", flush=True)
+                down = False
+                ctl = None  # full relist after an apiserver outage
+                continue
+        except StaleWatch:
+            announce(f"elastic {ident}: stale watch, relisting", flush=True)
+            ctl = None
+            down = False
+            continue
+        except transient as e:
+            if not down:
+                announce(f"elastic {ident}: store unavailable ({e}); retrying",
+                         flush=True)
                 down = True
             retry.sleep()
             continue
@@ -329,7 +403,7 @@ def _wait_http(url: str, timeout: float = 30.0) -> bool:
 
 def run_up(port: int = 8443, state: str = "", conf_path: str = "",
            pidfile: str = ".vt-up.json", detach: bool = False,
-           schedulers: int = 1, controllers: int = 1,
+           schedulers: int = 1, controllers: int = 1, elastic: int = 0,
            host: str = "127.0.0.1", announce=print) -> int:
     """Bring up the whole control plane — apiserver (+durable state),
     scheduler(s), controller(s), kubelet — as real OS processes with
@@ -428,6 +502,9 @@ def run_up(port: int = 8443, state: str = "", conf_path: str = "",
         spawn(*argv)
     for i in range(controllers):
         spawn("controller", "--server", url, "--identity", f"ctl-{i}")
+    for i in range(elastic):
+        spawn("elastic", "--server", url, "--identity", f"elastic-{i}",
+              "--metrics-port", "-1")
     spawn("kubelet", "--server", url)
 
     time.sleep(0.3)

@@ -123,6 +123,87 @@ def cmd_list(store, namespace: str = "default", out: Optional[io.TextIOBase] = N
     return text
 
 
+# -- node / pool verbs (elastic capacity; kubectl cordon/drain analogues) -----
+
+
+def cmd_cordon(store, name: str):
+    """Mark the node unschedulable (kubectl cordon)."""
+    from volcano_tpu.elastic import cordon
+
+    return cordon(store, name)
+
+
+def cmd_uncordon(store, name: str):
+    from volcano_tpu.elastic import uncordon
+
+    return uncordon(store, name)
+
+
+def cmd_drain(store, name: str):
+    """Cordon + evict resident pods through the existing eviction path
+    (pods marked deleting; the kubelet reaps them — the Releasing window).
+    Returns the evicted pod keys."""
+    from volcano_tpu.elastic import drain
+
+    _, evicted = drain(store, name)
+    return evicted
+
+
+def cmd_node_list(store, out: Optional[io.TextIOBase] = None) -> str:
+    """Node table: kubectl-style STATUS including SchedulingDisabled for
+    cordoned nodes, plus the elastic lifecycle state and owning pool."""
+    from volcano_tpu.elastic import POOL_LABEL, node_state
+
+    nodes = sorted(store.list("Node"), key=lambda n: n.meta.name)
+    buf = io.StringIO()
+    if not nodes:
+        buf.write("No resources found\n")
+    else:
+        pods_on = {}
+        for p in store.list("Pod"):
+            if p.node_name and not p.deleting:
+                pods_on[p.node_name] = pods_on.get(p.node_name, 0) + 1
+        name_w = max([len("Name")] + [len(n.meta.name) for n in nodes]) + 3
+        row = f"%-{name_w}s%-28s%-15s%-12s%-6s\n"
+        buf.write(row % ("Name", "Status", "State", "Pool", "Pods"))
+        for n in nodes:
+            status = "Ready" if n.ready() else "NotReady"
+            if n.unschedulable:
+                status += ",SchedulingDisabled"
+            buf.write(row % (
+                n.meta.name, status, node_state(n),
+                n.labels.get(POOL_LABEL, "<none>"),
+                pods_on.get(n.meta.name, 0),
+            ))
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def cmd_pool_list(store, out: Optional[io.TextIOBase] = None) -> str:
+    """NodePool table: size bounds + observed lifecycle counts."""
+    pools = sorted(store.list("NodePool"), key=lambda p: p.meta.name)
+    buf = io.StringIO()
+    if not pools:
+        buf.write("No resources found\n")
+    else:
+        name_w = max([len("Name")] + [len(p.meta.name) for p in pools]) + 3
+        row = f"%-{name_w}s%-6s%-6s%-7s%-7s%-14s%-10s%-8s\n"
+        buf.write(row % ("Name", "Min", "Max", "Size", "Ready",
+                         "Provisioning", "Draining", "Demand"))
+        for p in pools:
+            st = p.status
+            buf.write(row % (
+                p.meta.name, p.min_size, p.max_size, st.size, st.ready,
+                st.provisioning, st.draining, st.pending_demand,
+            ))
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
 def _issue_command(store, namespace: str, name: str, action: JobAction) -> Command:
     from volcano_tpu.api.objects import new_uid
 
@@ -177,6 +258,12 @@ def _main_remote(args) -> int:
             print("error: cluster step is local-only (daemons drive the "
                   "remote cluster)", file=sys.stderr)
             return 1
+        elif args.group == "node":
+            rc = _node_dispatch(store, args)
+            if rc is not None:
+                return rc
+        elif args.group == "pool":
+            cmd_pool_list(store, out=sys.stdout)
         elif args.cmd == "run":
             # server-side admission mutates/validates (the webhook path)
             store.create("Job", build_job_from_flags(
@@ -196,6 +283,22 @@ def _main_remote(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     return 0
+
+
+def _node_dispatch(store, args) -> Optional[int]:
+    """Shared node-verb dispatch for the remote and local entries."""
+    if args.cmd == "cordon":
+        cmd_cordon(store, args.name)
+        print(f"node/{args.name} cordoned")
+    elif args.cmd == "uncordon":
+        cmd_uncordon(store, args.name)
+        print(f"node/{args.name} uncordoned")
+    elif args.cmd == "drain":
+        evicted = cmd_drain(store, args.name)
+        print(f"node/{args.name} cordoned, evicting {len(evicted)} pod(s)")
+    elif args.cmd == "list":
+        cmd_node_list(store, out=sys.stdout)
+    return None
 
 
 # -- standalone entry over a pickled simulated cluster ------------------------
@@ -254,6 +357,17 @@ def main(argv=None) -> int:
         p.add_argument("--name", "-n", required=True)
         p.add_argument("--namespace", "-N", default="default")
 
+    node_p = sub.add_parser("node", help="node lifecycle (cordon/drain)")
+    node_sub = node_p.add_subparsers(dest="cmd", required=True)
+    for verb in ("cordon", "uncordon", "drain"):
+        p = node_sub.add_parser(verb, parents=[common])
+        p.add_argument("name")
+    node_sub.add_parser("list", parents=[common])
+
+    pool_p = sub.add_parser("pool", help="elastic node pools")
+    pool_sub = pool_p.add_subparsers(dest="cmd", required=True)
+    pool_sub.add_parser("list", parents=[common])
+
     cl_p = sub.add_parser("cluster", help="simulated cluster management")
     cl_sub = cl_p.add_subparsers(dest="cmd", required=True)
     init_p = cl_sub.add_parser("init", parents=[common])
@@ -279,6 +393,8 @@ def main(argv=None) -> int:
     up_p.add_argument("--pidfile", default=".vt-up.json")
     up_p.add_argument("--schedulers", type=int, default=1)
     up_p.add_argument("--controllers", type=int, default=1)
+    up_p.add_argument("--elastic", type=int, default=0,
+                      help="elasticd (node-pool autoscaler) replicas")
     down_p = sub.add_parser("down", parents=[common],
                             help="stop a detached 'vtctl up' control plane")
     down_p.add_argument("--pidfile", default=".vt-up.json")
@@ -290,7 +406,7 @@ def main(argv=None) -> int:
     api_p.add_argument("--state", default="",
                        help="persist objects to this JSON file (etcd analogue); "
                             "a restart resumes with all CRDs")
-    for comp in ("controller", "scheduler", "kubelet"):
+    for comp in ("controller", "scheduler", "kubelet", "elastic"):
         p = sub.add_parser(comp, parents=[common], help=f"run the {comp} against --server")
         p.add_argument("--identity", default="")
         p.add_argument("--period", type=float,
@@ -300,6 +416,9 @@ def main(argv=None) -> int:
         if comp == "scheduler":
             p.add_argument("--conf", default="", help="scheduler-conf YAML path")
             p.add_argument("--metrics-port", type=int, default=8080,
+                           help="/metrics port (0 = free port, <0 = disabled)")
+        if comp == "elastic":
+            p.add_argument("--metrics-port", type=int, default=8081,
                            help="/metrics port (0 = free port, <0 = disabled)")
 
     args = parser.parse_args(argv)
@@ -312,13 +431,15 @@ def main(argv=None) -> int:
                               detach=args.detach,
                               schedulers=args.schedulers,
                               controllers=args.controllers,
+                              elastic=args.elastic,
                               host=args.host)
     if args.group == "down":
         from volcano_tpu.cli import daemons
 
         return daemons.run_down(pidfile=args.pidfile)
 
-    if args.group in ("apiserver", "controller", "scheduler", "kubelet"):
+    if args.group in ("apiserver", "controller", "scheduler", "kubelet",
+                      "elastic"):
         if args.group != "apiserver" and not args.server:
             print("error: --server is required", file=sys.stderr)
             return 1
@@ -339,6 +460,11 @@ def main(argv=None) -> int:
                                       leader_elect=not args.no_leader_elect,
                                       period=args.period,
                                       metrics_port=args.metrics_port)
+            elif args.group == "elastic":
+                daemons.run_elastic(args.server, identity=args.identity,
+                                    leader_elect=not args.no_leader_elect,
+                                    period=args.period,
+                                    metrics_port=args.metrics_port)
             else:
                 daemons.run_kubelet(args.server, period=args.period)
         except KeyboardInterrupt:
@@ -365,6 +491,12 @@ def main(argv=None) -> int:
         elif args.group == "cluster" and args.cmd == "step":
             steps = cluster.run_until_idle()
             print(f"quiesced in {steps} steps")
+        elif args.group == "node":
+            _node_dispatch(cluster.store, args)
+            if args.cmd != "list":
+                cluster.run_until_idle()
+        elif args.group == "pool":
+            cmd_pool_list(cluster.store, out=sys.stdout)
         elif args.cmd == "run":
             cmd_run(
                 cluster.store,

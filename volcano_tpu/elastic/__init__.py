@@ -1,0 +1,49 @@
+"""Elastic capacity: demand-driven node-pool autoscaling with a
+cordon/drain lifecycle (see elastic/controller.py for the reconcile loop,
+elastic/demand.py for the gang-aware estimator, elastic/lifecycle.py for
+the node state machine and the vtctl cordon/drain primitives)."""
+
+from volcano_tpu.api.objects import NodePool, NodePoolStatus  # noqa: F401
+from volcano_tpu.elastic.controller import ElasticController  # noqa: F401
+from volcano_tpu.elastic.demand import (  # noqa: F401
+    GangDemand,
+    PoolPlan,
+    plan_pools,
+    unschedulable_gangs,
+)
+from volcano_tpu.elastic.lifecycle import (  # noqa: F401
+    DRAINING,
+    POOL_LABEL,
+    PROVISIONING,
+    READY,
+    begin_drain,
+    cordon,
+    drain,
+    kubelet_provisioning_step,
+    node_state,
+    pods_by_node,
+    pool_nodes,
+    uncordon,
+)
+
+__all__ = [
+    "DRAINING",
+    "ElasticController",
+    "GangDemand",
+    "NodePool",
+    "NodePoolStatus",
+    "POOL_LABEL",
+    "PROVISIONING",
+    "PoolPlan",
+    "READY",
+    "begin_drain",
+    "cordon",
+    "drain",
+    "kubelet_provisioning_step",
+    "node_state",
+    "plan_pools",
+    "pods_by_node",
+    "pool_nodes",
+    "uncordon",
+    "unschedulable_gangs",
+]

@@ -1,0 +1,310 @@
+"""elasticd: the demand-driven node-pool autoscaler.
+
+Watch-driven reconciler in the same mold as the job controller
+(controller/controller.py): ``pump()`` drains watch queues for a wake-up
+signal, then reconciles every ``NodePool`` against live store state.  Off
+by default — a cluster with no NodePool objects never constructs one, and
+a pump over zero pools is a single empty list call, so the scheduler's hot
+cycle pays nothing (acceptance: bench cfg5 is autoscaler-free).
+
+Per reconcile, for each pool (priority desc):
+
+1. **inventory** — members by the ``volcano.tpu/pool`` label, bucketed by
+   lifecycle state (elastic/lifecycle.py).
+2. **drain progress** — Draining members whose resident pods are gone are
+   deleted (scale_events_total{direction=down}); stragglers get their pods
+   re-marked ``deleting`` (idempotent — the eviction/Releasing path).
+3. **scale up** — the gang-aware bin-pack plan (elastic/demand.py) says
+   how many template nodes the Unschedulable gangs need; each is created
+   Provisioning through the ``elastic.provision`` chaos faultpoint
+   (fail/delay injectable), named ``<pool>-<lowest free index>`` so two
+   runs of the same demand produce the same node names.
+4. **floor** — a pool below ``min_size`` grows back to it regardless of
+   demand.
+5. **scale down** — after ``hysteresis`` seconds of zero demand, the
+   emptiest Ready members above ``min_size`` are cordoned and drained;
+   surplus still-Provisioning members (demand evaporated mid-provision)
+   are deleted outright so no orphan Provisioning node outlives the storm.
+
+The clock is injectable: the simulator passes its step clock (so
+provision delays and hysteresis are deterministic in tests), daemons use
+wall time.  Leader election gates the whole pump exactly like the job
+controller's.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+from volcano_tpu import events
+from volcano_tpu.api.objects import NodePool
+from volcano_tpu.elastic import demand as demand_mod
+from volcano_tpu.elastic.lifecycle import (
+    DRAINING,
+    PROVISIONING,
+    READY,
+    begin_drain,
+    make_pool_node,
+    member_index,
+    node_state,
+    pods_by_node,
+    pool_nodes,
+    resident_pods,
+)
+from volcano_tpu.scheduler import metrics
+
+
+class ElasticController:
+    def __init__(self, store, elector=None, clock=None, chaos=None):
+        self.store = store
+        self.elector = elector  # optional LeaderElector (HA analogue)
+        self.clock = clock or time.time
+        self.chaos = chaos  # optional FaultPlan with elastic.provision rules
+        self.events: List[str] = []  # human-readable log, controller-style
+        # pool -> clock reading when demand was first observed at zero
+        # (hysteresis anchor); reset on any nonzero-demand reconcile
+        self._zero_demand_since: Dict[str, float] = {}
+        # watch-driven off state: once a reconcile has seen zero pools,
+        # later pumps skip even the NodePool list until a watch event
+        # arrives (the NodePool watch is the wake-up for pool creation)
+        self._synced = False
+        self._pools_seen = False
+        self._pool_w = store.watch("NodePool")
+        self._node_w = store.watch("Node")
+        self._pod_w = store.watch("Pod")
+        self._pg_w = store.watch("PodGroup")
+
+    # -- pump -----------------------------------------------------------------
+
+    def pump(self) -> bool:
+        """Drain watches, reconcile every pool; True if anything changed.
+        Quiescent when the cluster matches demand — the simulator's
+        run_until_idle contract.  While pools EXIST the reconcile is
+        unconditional (hysteresis/provision timers fire without store
+        events); while none exist the pump sleeps on the watches."""
+        if self.elector is not None and not self.elector.try_acquire():
+            return False  # standby replica: events stay queued for takeover
+        drained = False
+        for q in (self._pool_w, self._node_w, self._pod_w, self._pg_w):
+            while q:
+                q.popleft()  # wake-up signal only; reconcile lists fresh
+                drained = True
+        if self._synced and not drained and not self._pools_seen:
+            return False  # no pools, no events: the autoscaler is off
+        self._synced = True
+        pools = self.store.list("NodePool")
+        self._pools_seen = bool(pools)
+        if not pools:
+            return False
+        now = self.clock()
+        residents = pods_by_node(self.store)
+        plans = demand_mod.plan_pools(self.store, pools, residents=residents)
+        changed = False
+        for pool in sorted(pools, key=lambda p: (-p.priority, p.meta.name)):
+            changed |= self._reconcile(pool, plans[pool.meta.name], now,
+                                       residents)
+        return changed
+
+    # -- reconcile ------------------------------------------------------------
+
+    def _reconcile(self, pool: NodePool, plan, now: float,
+                   residents: Dict[str, List]) -> bool:
+        name = pool.meta.name
+        changed = False
+        members = pool_nodes(self.store, name)
+        by_state: Dict[str, List] = {PROVISIONING: [], READY: [], DRAINING: []}
+        for n in members:
+            by_state.setdefault(node_state(n), []).append(n)
+
+        changed |= self._drain_progress(pool, by_state[DRAINING], residents)
+        members = pool_nodes(self.store, name)  # drains may have deleted
+        size = len(members)
+
+        # scale up: demand plan first, then the min_size floor
+        want = plan.new_nodes
+        floor = max(0, pool.min_size - size)
+        want = max(want, floor)
+        want = min(want, pool.max_size - size)
+        if want > 0:
+            created = self._provision(pool, members, want, now)
+            size += created
+            changed |= created > 0
+
+        if plan.demand_nodes > 0 or plan.eligible_gangs > 0 or floor > 0:
+            # live demand — including demand covered by in-flight
+            # Provisioning bins — holds the scale-down hysteresis clock
+            self._zero_demand_since.pop(name, None)
+        else:
+            changed |= self._maybe_scale_down(pool, by_state, size, now,
+                                              residents)
+
+        self._publish_status(pool, plan)
+        return changed
+
+    def _drain_progress(self, pool: NodePool, draining: List,
+                        index: Dict[str, List]) -> bool:
+        """Finish drains: delete empty Draining members, re-evict
+        stragglers (idempotent)."""
+        changed = False
+        for node in draining:
+            residents = resident_pods(self.store, node.meta.name, index)
+            if not residents:
+                # the index is pump-start state; re-check fresh before the
+                # irreversible delete (deletions are rare, the scan is not)
+                if resident_pods(self.store, node.meta.name):
+                    continue
+                if self.store.delete("Node", f"/{node.meta.name}") is not None:
+                    metrics.register_scale_event(pool.meta.name, "down")
+                    pool.status.scale_downs += 1
+                    self.events.append(
+                        f"ScaleDown {pool.meta.name} -{node.meta.name}")
+                    events.record(
+                        self.store, "NodePool", f"/{pool.meta.name}",
+                        "ScaleDown", f"removed drained node {node.meta.name}",
+                    )
+                    changed = True
+                continue
+            for pod in residents:
+                if not pod.deleting:
+                    self.store.patch("Pod", pod.meta.key, {"deleting": True})
+                    metrics.register_drain_eviction(pool.meta.name)
+                    changed = True
+        return changed
+
+    def _provision(self, pool: NodePool, members: List, count: int,
+                   now: float) -> int:
+        """Create ``count`` Provisioning members on the lowest free
+        indices.  The ``elastic.provision`` faultpoint can fail (skip —
+        demand persists, the next pump retries) or delay (push ready-at)
+        each attempt."""
+        taken = {
+            member_index(pool.meta.name, n.meta.name) for n in members
+        }
+        created = 0
+        index = 0
+        while created < count:
+            while index in taken:
+                index += 1
+            taken.add(index)
+            ready_at = now + pool.provision_delay
+            if self.chaos is not None:
+                rule = self.chaos.fire(
+                    "elastic.provision", path=f"{pool.meta.name}-{index}")
+                if rule is not None and rule.action == "fail":
+                    self.events.append(
+                        f"ProvisionFailed {pool.meta.name}-{index} (injected)")
+                    events.record(
+                        self.store, "NodePool", f"/{pool.meta.name}",
+                        "ProvisionFailed",
+                        f"provisioning {pool.meta.name}-{index} failed",
+                        type=events.WARNING,
+                    )
+                    # a failure aborts the REST of this pump's batch, not
+                    # just the attempt: provisioning stays strictly
+                    # index-ordered (never create <pool>-1 while <pool>-0's
+                    # creation is outstanding), which is what keeps faulted
+                    # and fault-free runs placement-identical — member
+                    # creation order is snapshot iteration order.  The
+                    # index frees for the retry; demand persists, so the
+                    # next pump re-plans and re-attempts from index 0.
+                    taken.discard(index)
+                    return created
+                if rule is not None and rule.action == "delay":
+                    ready_at += rule.arg
+            node = make_pool_node(pool, index, ready_at)
+            try:
+                self.store.create("Node", node)
+            except KeyError:
+                continue  # name collision (non-member squatter): retry later
+            created += 1
+            metrics.register_scale_event(pool.meta.name, "up")
+            pool.status.scale_ups += 1
+            self.events.append(f"ScaleUp {pool.meta.name} +{node.meta.name}")
+            events.record(
+                self.store, "NodePool", f"/{pool.meta.name}", "ScaleUp",
+                f"provisioning node {node.meta.name}",
+            )
+        return created
+
+    def _maybe_scale_down(self, pool: NodePool, by_state: Dict[str, List],
+                          size: int, now: float,
+                          residents_index: Dict[str, List]) -> bool:
+        """Zero demand: after the hysteresis window, drain the emptiest
+        Ready members down to min_size; surplus Provisioning members are
+        deleted outright — they hold no pods, and leaving them would
+        orphan capacity nobody asked for.  Only EMPTY nodes are eligible:
+        evicting a resident gang member would break all-or-nothing
+        placement, and reclaim — not the autoscaler — is the enforcement
+        path for occupied capacity.  (The drain machinery still evicts
+        the rare pod that binds into the cordon window — see
+        ``_drain_progress``.)"""
+        name = pool.meta.name
+        since = self._zero_demand_since.setdefault(name, now)
+        if now - since < pool.hysteresis:
+            return False
+        excess = size - pool.min_size
+        if excess <= 0:
+            return False
+        changed = False
+        # surplus Provisioning nodes first: empty by construction — but
+        # re-check LIVE state before each delete: in daemon deployments
+        # the kubelet may have CAS-flipped the node Ready (and the
+        # scheduler bound onto it) since this pump's node list
+        for node in reversed(by_state[PROVISIONING]):
+            if excess <= 0:
+                break
+            live = self.store.get("Node", f"/{node.meta.name}")
+            if live is None or node_state(live) != PROVISIONING:
+                continue
+            if resident_pods(self.store, node.meta.name):
+                continue
+            if self.store.delete("Node", f"/{node.meta.name}") is not None:
+                metrics.register_scale_event(name, "down")
+                pool.status.scale_downs += 1
+                self.events.append(f"ScaleDown {name} -{node.meta.name}")
+                excess -= 1
+                changed = True
+        ready = [
+            n for n in by_state[READY]
+            if not n.unschedulable
+            and not resident_pods(self.store, n.meta.name, residents_index)
+        ]
+        # highest member index first: the pool shrinks from the top, so
+        # the surviving floor keeps the low, stable names
+        ready.sort(key=lambda n: -(member_index(name, n.meta.name) or 0))
+        for node in ready[:max(0, excess)]:
+            # cordon + Draining in ONE write (begin_drain): a crash
+            # between separate writes would leak a cordoned-but-not-
+            # Draining node no later reconcile would ever finish off.
+            # Selected nodes are empty; any pod that binds into the
+            # cordon window is evicted by _drain_progress next pump.
+            begin_drain(self.store, node)
+            self.events.append(f"Drain {name} {node.meta.name}")
+            events.record(
+                self.store, "NodePool", f"/{name}", "Drain",
+                f"cordoned and draining {node.meta.name}",
+            )
+            changed = True
+        return changed
+
+    def _publish_status(self, pool: NodePool, plan) -> None:
+        members = pool_nodes(self.store, pool.meta.name)
+        st = pool.status
+        st.size = len(members)
+        st.ready = sum(1 for n in members if node_state(n) == READY)
+        st.provisioning = sum(
+            1 for n in members if node_state(n) == PROVISIONING)
+        st.draining = sum(1 for n in members if node_state(n) == DRAINING)
+        st.pending_demand = plan.demand_nodes
+        metrics.update_pool_size(pool.meta.name, st.size)
+        metrics.update_pending_demand(pool.meta.name, plan.demand_nodes)
+        try:
+            # PATCH status only — a full-object update would clobber any
+            # spec edit (max_size bump, hysteresis change) an operator
+            # committed while this pump was reconciling from its
+            # pump-start snapshot.  No-op patches are suppressed by the
+            # store's shadow compare, so a quiescent pool writes nothing.
+            self.store.patch("NodePool", pool.meta.key, {"status": st})
+        except KeyError:
+            pass  # pool deleted mid-pump; nothing to report against

@@ -1,0 +1,348 @@
+"""Gang-aware demand estimation: Unschedulable PodGroups -> node counts.
+
+The signal is the ``Unschedulable`` PodGroup condition the gang plugin (and
+the fastpath mirror's status twin) already publish every cycle a gang
+cannot be fully placed — the estimator never second-guesses the scheduler,
+it only answers "how many template nodes would let the scheduler place
+what it just said it could not".
+
+Three properties drive the design:
+
+* **gang atomicity** — a gang's pending requests are first-fit-decreasing
+  bin-packed as a unit; if the pool cannot absorb the WHOLE remainder of a
+  gang (template too small, or the pool would exceed ``max_size``), the
+  gang contributes nothing — never provision half a gang's worth of nodes
+  that can only host a forever-partial placement.
+* **deserved-share clipping, loanable when idle (Aryl,
+  https://arxiv.org/pdf/2202.07896)** — when the aggregate demand exceeds
+  the pool's headroom, each queue's grant is clipped to its weighted share
+  of the headroom; while other queues are idle their quota is loaned
+  freely (a single demanding queue may take the whole pool).  Reclaim
+  remains the enforcement path once a lender wakes up — the estimator
+  only shapes GROWTH, it never evicts.
+* **determinism** — pools order by (priority desc, name), gangs by
+  (priority desc, key), requests by (cpu, memory) descending; two
+  reconciles over the same store state produce the same plan.
+
+Pending requests come from the gang's still-pending pods when they exist;
+for a gang parked at the enqueue gate (no capacity -> PodGroup never
+Inqueue -> the controller never created pods) they are derived from the
+owning Job's task templates — the from-zero pool bootstrap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from volcano_tpu.api.job import POD_GROUP_KEY
+from volcano_tpu.api.objects import NodePool
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import PodGroupPhase, PodPhase
+
+from volcano_tpu.elastic.lifecycle import (
+    DRAINING,
+    PROVISIONING,
+    node_state,
+    pods_by_node,
+    pool_nodes,
+    resident_pods,
+)
+
+
+@dataclass
+class GangDemand:
+    """One Unschedulable gang's outstanding placement need."""
+
+    key: str                 # PodGroup namespace/name
+    queue: str
+    priority: int
+    requests: List[Resource]  # pending per-pod requests, unplaced portion
+    selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List = field(default_factory=list)
+
+
+@dataclass
+class PoolPlan:
+    """The reconcile decision for one pool."""
+
+    pool: str
+    new_nodes: int = 0        # clipped scale-up this reconcile
+    demand_nodes: int = 0     # unclipped bin-pack minimum (pending_demand)
+    #: gangs this pool can serve at all — nonzero means live demand even
+    #: when demand_nodes is 0 (covered by in-flight Provisioning bins), so
+    #: the scale-down hysteresis clock must NOT start
+    eligible_gangs: int = 0
+    admitted: List[str] = field(default_factory=list)  # gang keys served
+
+
+class _Bin:
+    """One node's free capacity during the FFD walk."""
+
+    __slots__ = ("free", "slots")
+
+    def __init__(self, free: Resource, slots: Optional[int]):
+        self.free = free
+        self.slots = slots
+
+    def fits(self, req: Resource) -> bool:
+        if self.slots is not None and self.slots < 1:
+            return False
+        return req.less_equal(self.free)
+
+    def take(self, req: Resource) -> None:
+        self.free.sub(req)
+        if self.slots is not None:
+            self.slots -= 1
+
+
+def _req_key(r: Resource) -> Tuple[float, float]:
+    return (-r.milli_cpu, -r.memory)
+
+
+def _template_bin(pool: NodePool) -> _Bin:
+    res = pool.resources.clone()
+    return _Bin(res, res.max_task_num)
+
+
+def _ffd(requests: List[Resource], pool: NodePool,
+         free_bins: List[_Bin]) -> Optional[Tuple[List[_Bin], int]]:
+    """First-fit-decreasing ``requests`` into copies of ``free_bins`` and
+    as many fresh template bins as needed.  Returns (bins after packing,
+    new-bin count), or None when some request cannot fit even an EMPTY
+    template node (the pool can never serve this gang)."""
+    bins = [_Bin(b.free.clone(), b.slots) for b in free_bins]
+    n_existing = len(bins)
+    for req in sorted(requests, key=_req_key):
+        placed = False
+        for b in bins:
+            if b.fits(req):
+                b.take(req)
+                placed = True
+                break
+        if not placed:
+            fresh = _template_bin(pool)
+            if not fresh.fits(req):
+                return None  # request larger than the template: unservable
+            fresh.take(req)
+            bins.append(fresh)
+    return bins, len(bins) - n_existing
+
+
+def gang_fits_pool(gang: GangDemand, pool: NodePool) -> bool:
+    """Template-level predicate agreement: the gang's selector must match
+    the pool labels (+ the pool membership label) and the pool taints must
+    be tolerated — the same node_selector/taints semantics the scheduler's
+    predicate chain applies to member nodes."""
+    from volcano_tpu.elastic.lifecycle import POOL_LABEL
+
+    labels = dict(pool.labels)
+    labels[POOL_LABEL] = pool.meta.name
+    labels.setdefault("kubernetes.io/hostname", pool.meta.name)
+    for k, v in gang.selector.items():
+        if labels.get(k) != v:
+            return False
+    for taint in pool.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(t.tolerates(taint) for t in gang.tolerations):
+            return False
+    return True
+
+
+def unschedulable_gangs(store) -> List[GangDemand]:
+    """Collect demand from every PodGroup carrying a true ``Unschedulable``
+    condition, sorted (priority desc, key) for deterministic admission."""
+    priority_classes = {
+        pc.meta.name: pc.value for pc in store.items("PriorityClass")
+    }
+    jobs = {j.meta.key: j for j in store.items("Job")}
+    pods_by_group: Dict[str, List] = {}
+    for p in store.items("Pod"):
+        group = p.meta.annotations.get(POD_GROUP_KEY, "")
+        if group:
+            pods_by_group.setdefault(f"{p.meta.namespace}/{group}", []).append(p)
+
+    out: List[GangDemand] = []
+    for pg in store.items("PodGroup"):
+        if not any(
+            c.kind == "Unschedulable" and c.status == "True"
+            for c in pg.status.conditions
+        ):
+            continue
+        requests: List[Resource] = []
+        selector: Dict[str, str] = {}
+        tolerations: List = []
+        placed = 0
+        members = sorted(pods_by_group.get(pg.meta.key, ()),
+                         key=lambda p: p.meta.uid)
+        for p in members:
+            if p.deleting:
+                continue
+            if p.node_name or p.phase != PodPhase.PENDING:
+                placed += 1
+                continue
+            req = p.spec.init_resreq()
+            if req.is_empty():
+                continue  # best-effort: backfill places it anywhere
+            requests.append(req)
+            selector = p.spec.node_selector
+            tolerations = p.spec.tolerations
+        if not members and pg.status.phase == PodGroupPhase.PENDING:
+            # parked at the enqueue gate (a from-zero pool: no capacity ->
+            # never Inqueue -> the controller never created pods): derive
+            # the per-replica requests from the owning Job's task
+            # templates.  Gated on phase PENDING so a finished job whose
+            # pods were reaped can never resurrect demand.
+            job = jobs.get(pg.meta.key)
+            if job is not None:
+                for task in job.spec.tasks:
+                    req = task.template.init_resreq()
+                    if req.is_empty():
+                        continue
+                    requests.extend(req.clone() for _ in range(task.replicas))
+                    selector = task.template.node_selector
+                    tolerations = task.template.tolerations
+        # the gang needs min_member placements; demand only the unplaced
+        # remainder (largest-first keeps FFD consistent with the packing)
+        needed = max(0, pg.min_member - placed)
+        if needed <= 0 or not requests:
+            continue
+        requests.sort(key=_req_key)
+        requests = requests[:needed] if len(requests) > needed else requests
+        out.append(GangDemand(
+            key=pg.meta.key,
+            queue=pg.queue or "default",
+            priority=priority_classes.get(pg.priority_class_name, 0),
+            requests=requests,
+            selector=dict(selector),
+            tolerations=list(tolerations),
+        ))
+    out.sort(key=lambda g: (-g.priority, g.key))
+    return out
+
+
+def free_bins(store, pool: NodePool,
+              residents: Optional[dict] = None) -> Tuple[List[_Bin], int]:
+    """(free capacity of each schedulable member, TOTAL member count).
+    Ready members contribute allocatable minus resident requests;
+    Provisioning members contribute their full template (they will be Ready
+    before any newly provisioned node); Draining/cordoned members
+    contribute no bins but still count toward the size bound — headroom is
+    ``max_size - total``, so a pool mid-drain can never overshoot its cap.
+    ``residents`` is an optional ``pods_by_node`` index (built once per
+    reconcile) replacing the per-node Pod scan."""
+    from volcano_tpu.scheduler.model import _sub_clamped
+
+    bins: List[_Bin] = []
+    total = 0
+    for node in pool_nodes(store, pool.meta.name):
+        total += 1
+        state = node_state(node)
+        if state == DRAINING or node.unschedulable:
+            continue
+        if state == PROVISIONING:
+            bins.append(_template_bin(pool))
+            continue
+        free = node.allocatable.clone()
+        slots = node.allocatable.max_task_num
+        for p in resident_pods(store, node.meta.name, residents):
+            _sub_clamped(free, p.spec.resreq(), Resource())
+            if slots is not None:
+                slots -= 1
+        bins.append(_Bin(free, slots))
+    return bins, total
+
+
+def _weighted_split(total: int, weights: Dict[str, int]) -> Dict[str, int]:
+    """Integer split of ``total`` by weight, largest-remainder rounding,
+    name-ordered ties — deterministic."""
+    wsum = sum(weights.values()) or 1
+    shares = {q: (total * w) / wsum for q, w in weights.items()}
+    out = {q: int(s) for q, s in shares.items()}
+    leftover = total - sum(out.values())
+    for q in sorted(weights, key=lambda q: (-(shares[q] - out[q]), q)):
+        if leftover <= 0:
+            break
+        out[q] += 1
+        leftover -= 1
+    return out
+
+
+def plan_pools(store, pools: List[NodePool],
+               gangs: Optional[List[GangDemand]] = None,
+               residents: Optional[dict] = None) -> Dict[str, PoolPlan]:
+    """The whole-cluster scale-up plan: gangs (priority desc) are absorbed
+    by the first pool (priority desc) whose template serves them, whole
+    gangs at a time, clipped per queue by deserved share under contention
+    (see module docstring)."""
+    if gangs is None:
+        gangs = unschedulable_gangs(store)
+    if residents is None:
+        residents = pods_by_node(store)
+    queues = {q.meta.name: max(1, q.weight) for q in store.items("Queue")}
+    plans: Dict[str, PoolPlan] = {}
+    remaining = list(gangs)
+    for pool in sorted(pools, key=lambda p: (-p.priority, p.meta.name)):
+        plan = PoolPlan(pool=pool.meta.name)
+        plans[pool.meta.name] = plan
+        bins, active = free_bins(store, pool, residents)
+        headroom = max(0, pool.max_size - active)
+
+        # unclipped pass: every eligible gang's new-bin need against a
+        # private copy of the free bins (pending_demand metric + the
+        # contention decision)
+        eligible: List[Tuple[GangDemand, int]] = []
+        trial_bins = bins
+        for gang in remaining:
+            if not gang_fits_pool(gang, pool):
+                continue
+            # unservable AT THE CAP: a gang whose remainder alone needs
+            # more template bins than max_size can never run here even
+            # with every member node free — it must not count as demand
+            # (it would pin the scale-down hysteresis clock forever while
+            # idle nodes leak above min_size)
+            alone = _ffd(gang.requests, pool, [])
+            if alone is None or alone[1] > pool.max_size:
+                continue
+            packed = _ffd(gang.requests, pool, trial_bins)
+            if packed is None:
+                continue
+            trial_bins, new = packed
+            eligible.append((gang, new))
+            plan.demand_nodes += new
+        plan.eligible_gangs = len(eligible)
+        if not eligible:
+            continue
+
+        contention = plan.demand_nodes > headroom
+        budget: Dict[str, int] = {}
+        if contention:
+            budget = _weighted_split(
+                headroom,
+                {g.queue: queues.get(g.queue, 1) for g, _ in eligible},
+            )
+
+        # clipped admission, whole gangs only
+        used_q: Dict[str, int] = {}
+        committed = bins
+        total_new = 0
+        admitted_keys = set()
+        for gang, _unclipped in eligible:
+            packed = _ffd(gang.requests, pool, committed)
+            if packed is None:
+                continue
+            new_bins, new = packed
+            if total_new + new > headroom:
+                continue  # half-gang growth is worse than none
+            if contention and used_q.get(gang.queue, 0) + new > budget.get(
+                    gang.queue, 0):
+                continue  # over deserved share while others contend
+            committed = new_bins
+            total_new += new
+            used_q[gang.queue] = used_q.get(gang.queue, 0) + new
+            plan.admitted.append(gang.key)
+            admitted_keys.add(gang.key)
+        plan.new_nodes = total_new
+        remaining = [g for g in remaining if g.key not in admitted_keys]
+    return plans

@@ -1,0 +1,215 @@
+"""Node lifecycle primitives for elastic capacity: provision, cordon,
+drain, delete.
+
+A pool member moves through a four-state machine, every transition a plain
+store write so all watchers (scheduler mirror, controller, kubelet) see the
+same event stream:
+
+  Provisioning --(kubelet, after provision_delay)--> Ready
+  Ready --(scale-down: cordon)--> Draining --(empty)--> deleted
+
+State is carried on the Node itself — the ``volcano.tpu/pool`` label names
+the owning pool, the ``volcano.tpu/elastic-state`` annotation holds the
+lifecycle state, and ``volcano.tpu/ready-at`` the clock reading at which
+the kubelet may flip the Ready condition.  Scheduling exclusion needs NO
+scheduler changes: a Provisioning node fails the existing ``Ready``
+condition predicate and a Draining node is ``unschedulable`` (cordoned) —
+both are masked identically by the host predicate chain
+(plugins/predicates.py), the tensor snapshot's static-predicate classes
+(snapshot.py ``_static_predicate``), and the fastpath mirror's lazily
+recomputed class cells (fastpath.py ``_on_node`` invalidates the node's
+``cls_valid`` column on every update).
+
+Draining reuses the existing eviction/Releasing machinery: resident pods
+are marked ``deleting`` (the Evictor's write) and the kubelet reaps them —
+the same Releasing window pipelined tasks wait on.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from volcano_tpu.api.objects import Metadata, Node, NodeCondition, NodePool
+from volcano_tpu.api.types import PodPhase
+
+#: node label naming the owning pool (also usable in selectors/affinity)
+POOL_LABEL = "volcano.tpu/pool"
+#: annotation carrying the lifecycle state of an elastic node
+STATE_ANNOTATION = "volcano.tpu/elastic-state"
+#: annotation with the clock reading at which Provisioning flips Ready
+READY_AT_ANNOTATION = "volcano.tpu/ready-at"
+
+PROVISIONING = "Provisioning"
+READY = "Ready"
+DRAINING = "Draining"
+
+
+def member_name(pool: str, index: int) -> str:
+    return f"{pool}-{index}"
+
+
+def member_index(pool: str, node_name: str) -> Optional[int]:
+    """Index of a member node name, or None if not of this pool's form."""
+    prefix = f"{pool}-"
+    if not node_name.startswith(prefix):
+        return None
+    tail = node_name[len(prefix):]
+    return int(tail) if tail.isdigit() else None
+
+
+def node_state(node: Node) -> str:
+    """Lifecycle state of a node; non-elastic nodes read as Ready."""
+    return node.meta.annotations.get(STATE_ANNOTATION, READY)
+
+
+def make_pool_node(pool: NodePool, index: int, ready_at: float) -> Node:
+    """A Provisioning member from the pool's template.  Ready condition
+    False keeps it out of every backend's placement mask until the kubelet
+    flips it at ``ready_at``."""
+    name = member_name(pool.meta.name, index)
+    labels = dict(pool.labels)
+    labels[POOL_LABEL] = pool.meta.name
+    return Node(
+        meta=Metadata(
+            name=name,
+            namespace="",
+            annotations={
+                STATE_ANNOTATION: PROVISIONING,
+                READY_AT_ANNOTATION: repr(float(ready_at)),
+            },
+            owner=("NodePool", pool.meta.name),
+        ),
+        allocatable=pool.resources.clone(),
+        labels=labels,
+        taints=[t for t in pool.taints],
+        conditions=[NodeCondition("Ready", "False")],
+    )
+
+
+def pool_nodes(store, pool: str) -> List[Node]:
+    """Members of ``pool``, sorted by (member index, name) so scale
+    decisions are deterministic."""
+    out = [
+        n for n in store.items("Node")
+        if n.labels.get(POOL_LABEL) == pool
+    ]
+    out.sort(key=lambda n: (member_index(pool, n.meta.name)
+                            if member_index(pool, n.meta.name) is not None
+                            else 1 << 30, n.meta.name))
+    return out
+
+
+def pods_by_node(store) -> dict:
+    """One pass over Pods -> node name -> resident (Pending/Running,
+    non-deleting) pods.  The shared index that keeps a whole reconcile
+    O(pods) instead of O(nodes x pods) — build once per pump and pass it
+    wherever residency is consulted."""
+    out: dict = {}
+    for p in store.items("Pod"):
+        if p.node_name and not p.deleting and p.phase in (
+                PodPhase.PENDING, PodPhase.RUNNING):
+            out.setdefault(p.node_name, []).append(p)
+    return out
+
+
+def resident_pods(store, node_name: str, residents: Optional[dict] = None) -> List:
+    """Pods occupying the node: bound, not yet reaped, not best-effort
+    leftovers — the set a drain must evict before deletion.  Pass a
+    ``pods_by_node`` index to avoid the per-call Pod scan."""
+    if residents is not None:
+        return list(residents.get(node_name, ()))
+    return [
+        p for p in store.items("Pod")
+        if p.node_name == node_name and not p.deleting
+        and p.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+    ]
+
+
+def cordon(store, name: str) -> Node:
+    """Mark the node unschedulable (kubectl cordon).  Every backend masks
+    it from placement on the next cycle; resident pods keep running."""
+    node = store.get("Node", f"/{name}")
+    if node is None:
+        raise KeyError(f"node {name} not found")
+    if not node.unschedulable:
+        store.patch("Node", f"/{name}", {"unschedulable": True})
+    return node
+
+
+def uncordon(store, name: str) -> Node:
+    """Return the node to service.  Clears the Draining lifecycle state
+    too (in the SAME write): an operator cancelling an autoscaler drain
+    must not leave a node that is schedulable yet still read as DRAINING
+    — the controller would keep evicting its pods and delete it the
+    moment it is briefly empty."""
+    node = store.get("Node", f"/{name}")
+    if node is None:
+        raise KeyError(f"node {name} not found")
+    fields = {}
+    if node.unschedulable:
+        fields["unschedulable"] = False
+    if node.meta.annotations.get(STATE_ANNOTATION) == DRAINING:
+        ann = dict(node.meta.annotations)
+        ann[STATE_ANNOTATION] = READY
+        fields["meta.annotations"] = ann
+    if fields:
+        store.patch("Node", f"/{name}", fields)
+    return node
+
+
+def begin_drain(store, node: Node) -> None:
+    """Atomically cordon AND mark Draining in one store write — a crash
+    between two separate writes would leak a permanently cordoned node
+    the replacement leader reads as plain Ready (neither drained nor
+    schedulable)."""
+    ann = dict(node.meta.annotations)
+    ann[STATE_ANNOTATION] = DRAINING
+    store.patch("Node", f"/{node.meta.name}",
+                {"unschedulable": True, "meta.annotations": ann})
+
+
+def drain(store, name: str) -> Tuple[Node, List[str]]:
+    """Cordon + evict resident pods through the existing eviction path
+    (``deleting=True``; the kubelet reaps them — the Releasing window).
+    Returns the node and the evicted pod keys."""
+    node = cordon(store, name)
+    evicted = []
+    for pod in resident_pods(store, name):
+        store.patch("Pod", pod.meta.key, {"deleting": True})
+        evicted.append(pod.meta.key)
+    return node, evicted
+
+
+def kubelet_provisioning_step(store, now: float) -> bool:
+    """One kubelet pass over Provisioning nodes: flip the Ready condition
+    once ``now`` passes the node's ready-at stamp.  Shared by the sim
+    kubelet (Cluster.kubelet_step, sim clock) and the kubelet daemon
+    (cli/daemons.py, wall clock).  Returns whether anything changed."""
+    from volcano_tpu.store.store import Conflict
+
+    changed = False
+    for node in store.items("Node"):
+        if node.meta.annotations.get(STATE_ANNOTATION) != PROVISIONING:
+            continue
+        try:
+            ready_at = float(node.meta.annotations.get(READY_AT_ANNOTATION, "0"))
+        except ValueError:
+            ready_at = 0.0
+        if now < ready_at:
+            continue
+        rv = node.meta.resource_version
+        node.conditions = [
+            NodeCondition("Ready", "True") if c.kind == "Ready" else c
+            for c in node.conditions
+        ]
+        if not any(c.kind == "Ready" for c in node.conditions):
+            node.conditions.append(NodeCondition("Ready", "True"))
+        node.meta.annotations[STATE_ANNOTATION] = READY
+        try:
+            # CAS: the elastic controller may cordon/delete this node
+            # concurrently (daemon deployments); never resurrect stale state
+            store.update_cas("Node", node, rv)
+        except (Conflict, KeyError):
+            continue  # changed under us; reconcile next period
+        changed = True
+    return changed

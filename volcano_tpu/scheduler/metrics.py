@@ -90,6 +90,24 @@ def register_job_retry(job: str) -> None:
     inc("volcano_job_retry_counts", job_id=job)
 
 
+# -- elastic autoscaler series (volcano_tpu/elastic/) -------------------------
+
+def update_pool_size(pool: str, size: int) -> None:
+    set_gauge("volcano_elastic_pool_size", size, pool=pool)
+
+
+def update_pending_demand(pool: str, nodes: int) -> None:
+    set_gauge("volcano_elastic_pending_demand_nodes", nodes, pool=pool)
+
+
+def register_scale_event(pool: str, direction: str) -> None:
+    inc("volcano_elastic_scale_events_total", pool=pool, direction=direction)
+
+
+def register_drain_eviction(pool: str) -> None:
+    inc("volcano_elastic_drain_evictions_total", pool=pool)
+
+
 def expose_text() -> str:
     """Prometheus text exposition of all recorded series."""
     lines = []

@@ -31,6 +31,17 @@ from volcano_tpu.scheduler.scheduler import Scheduler
 from volcano_tpu.store import Store
 
 
+class _SimClock:
+    """Picklable view of the cluster's step clock (vtctl pickles the
+    simulated cluster between invocations; a lambda would not survive)."""
+
+    def __init__(self, cluster: "Cluster"):
+        self.cluster = cluster
+
+    def __call__(self) -> float:
+        return self.cluster.now
+
+
 class Cluster:
     def __init__(
         self,
@@ -45,6 +56,12 @@ class Cluster:
         self.scheduler: Optional[Scheduler] = None
         if with_scheduler:
             self.scheduler = Scheduler(self.store, conf=scheduler_conf or full_conf())
+        # sim clock: one tick per step(); provision delays / hysteresis
+        # windows are measured in steps.  The elastic autoscaler is OFF by
+        # default — constructed lazily by the first add_node_pool, so a
+        # pool-less cluster never pays a pump (zero hot-path change).
+        self.now = 0.0
+        self.elastic = None
 
     # -- topology -------------------------------------------------------------
 
@@ -95,6 +112,46 @@ class Cluster:
             ),
         )
 
+    def add_node_pool(
+        self,
+        name: str,
+        resources=None,
+        labels=None,
+        taints=None,
+        min_size: int = 0,
+        max_size: int = 8,
+        provision_delay: float = 0.0,
+        hysteresis: float = 0.0,
+        priority: int = 0,
+    ):
+        """Declare an elastic NodePool and switch on the autoscaler pump
+        (volcano_tpu/elastic/).  Delays/hysteresis are in sim steps."""
+        from volcano_tpu.api.objects import NodePool
+        from volcano_tpu.elastic import ElasticController
+
+        alloc = (
+            resources
+            if isinstance(resources, Resource)
+            else Resource.from_resource_list(resources or {"cpu": "4", "memory": "8Gi"})
+        )
+        pool = self.store.create(
+            "NodePool",
+            NodePool(
+                meta=Metadata(name=name, namespace=""),
+                resources=alloc,
+                labels=dict(labels or {}),
+                taints=list(taints or []),
+                min_size=min_size,
+                max_size=max_size,
+                provision_delay=provision_delay,
+                hysteresis=hysteresis,
+                priority=priority,
+            ),
+        )
+        if self.elastic is None:
+            self.elastic = ElasticController(self.store, clock=_SimClock(self))
+        return pool
+
     def add_priority_class(self, name: str, value: int, global_default=False):
         return self.store.create(
             "PriorityClass",
@@ -117,7 +174,9 @@ class Cluster:
     # -- kubelet --------------------------------------------------------------
 
     def kubelet_step(self) -> bool:
-        """One pass of the simulated kubelets over all pods."""
+        """One pass of the simulated kubelets over all pods — and over
+        Provisioning elastic nodes, which flip Ready once the sim clock
+        passes their provision delay (elastic/lifecycle.py)."""
         changed = False
         for pod in self.store.items("Pod"):
             if pod.deleting:
@@ -127,6 +186,10 @@ class Cluster:
                 pod.phase = PodPhase.RUNNING
                 self.store.update("Pod", pod)
                 changed = True
+        if self.elastic is not None:
+            from volcano_tpu.elastic import kubelet_provisioning_step
+
+            changed |= kubelet_provisioning_step(self.store, self.now)
         return changed
 
     # -- fault injection ------------------------------------------------------
@@ -152,6 +215,9 @@ class Cluster:
     def pump_controller(self) -> bool:
         return self.controller.pump() if self.controller else False
 
+    def pump_elastic(self) -> bool:
+        return self.elastic.pump() if self.elastic else False
+
     def schedule_once(self) -> bool:
         if self.scheduler is None:
             return False
@@ -160,12 +226,28 @@ class Cluster:
         return self.store.resource_version != rv
 
     def step(self) -> bool:
-        """controller pump -> scheduler cycle -> kubelet; True if anything
-        moved."""
+        """controller pump -> elastic pump -> scheduler cycle -> kubelet;
+        True if anything moved.  The sim clock advances one tick per step
+        (provision delays and hysteresis windows count steps).
+
+        A step that only waits out a provision delay still counts as
+        movement: the clock tick IS the progress, and run_until_idle must
+        not report quiescence while nodes are Provisioning and gangs wait
+        on them.  (A pending scale-DOWN hysteresis window is NOT movement
+        — the cluster is in a stable, fully schedulable state.)"""
+        self.now += 1.0
         moved = self.pump_controller()
+        moved |= self.pump_elastic()
         moved |= self.schedule_once()
         moved |= self.kubelet_step()
         moved |= self.pump_controller()
+        if not moved and self.elastic is not None:
+            from volcano_tpu.elastic import PROVISIONING, node_state
+
+            moved = any(
+                node_state(n) == PROVISIONING
+                for n in self.store.items("Node")
+            )
         return moved
 
     def run_until_idle(self, max_steps: int = 64) -> int:

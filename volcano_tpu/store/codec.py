@@ -24,6 +24,7 @@ from volcano_tpu.api.objects import (
     Command,
     ConfigMap,
     Node,
+    NodePool,
     PersistentVolume,
     PersistentVolumeClaim,
     PodDisruptionBudget,
@@ -45,6 +46,7 @@ KIND_CLASSES: Dict[str, type] = {
     "PodGroup": PodGroup,
     "Queue": Queue,
     "Node": Node,
+    "NodePool": NodePool,
     "Command": Command,
     "ConfigMap": ConfigMap,
     "Service": Service,
