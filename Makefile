@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench native examples install clean images image image-tpu lint sanitize chaos elastic
+.PHONY: test e2e parity bench native examples install clean images image image-tpu lint sanitize chaos elastic trace
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -35,6 +35,14 @@ elastic:
 # the acyclic graph the static `lock-order` rule proves (analysis/locksan.py)
 sanitize:
 	VOLCANO_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_daemons.py -q
+
+# vtrace (volcano_tpu/trace.py + tests/test_trace.py): the span runtime,
+# flight recorder, cross-daemon propagation, the armed-vs-disarmed
+# placement-neutrality + zero-overhead smokes, the describe/events/trace
+# CLI, and the traced chaos storm (one trace id across three daemons).
+trace:
+	$(PY) -m pytest tests/test_trace.py tests/test_cli.py \
+	  tests/test_chaos_soak.py::test_chaos_smoke_traced_storm_neutral_and_reconstructs_gang -q
 
 e2e:
 	$(PY) -m pytest tests/test_e2e_policies.py tests/test_e2e_mpi.py \

@@ -29,6 +29,7 @@ import urllib.request
 
 import pytest
 
+from volcano_tpu import trace
 from volcano_tpu.api.job import JOB_NAME_KEY, Job, JobSpec, TaskSpec
 from volcano_tpu.api.objects import Metadata, Node, PodSpec, Queue
 from volcano_tpu.api.resource import Resource
@@ -163,6 +164,7 @@ class ControlPlane:
         return e
 
     def _controller_loop(self, ident, flapped):
+        trace.set_component("controller")
         retry = Backoff(base=0.02, cap=0.3, seed=21)
         ctl = None
         while not self.stop.is_set():
@@ -183,6 +185,7 @@ class ControlPlane:
             self.stop.wait(0.02)
 
     def _scheduler_loop(self, ident, flapped):
+        trace.set_component("scheduler")
         retry = Backoff(base=0.02, cap=0.3, seed=22)
         sched = None
         while not self.stop.is_set():
@@ -201,24 +204,17 @@ class ControlPlane:
             self.stop.wait(0.02)
 
     def _kubelet_loop(self):
-        from volcano_tpu.elastic import kubelet_provisioning_step
-        from volcano_tpu.store.store import Conflict
+        # same pass as the subprocess daemon (cli/daemons.kubelet_step):
+        # reap deleting pods, flip bound Pending pods Running (the traced
+        # Ready flip), advance Provisioning nodes
+        from volcano_tpu.cli.daemons import kubelet_step
 
+        trace.set_component("kubelet")
         store = RemoteStore(self.url)
         retry = Backoff(base=0.02, cap=0.3, seed=23)
         while not self.stop.is_set():
             try:
-                for pod in store.list("Pod"):
-                    if pod.deleting:
-                        store.delete("Pod", pod.meta.key)
-                    elif pod.node_name and pod.phase == PodPhase.PENDING:
-                        rv = pod.meta.resource_version
-                        pod.phase = PodPhase.RUNNING
-                        try:
-                            store.update_cas("Pod", pod, rv)
-                        except (Conflict, KeyError):
-                            pass
-                kubelet_provisioning_step(store, time.time())
+                kubelet_step(store, time.time())
                 retry.reset()
             except TRANSIENT:
                 retry.sleep()
@@ -260,6 +256,9 @@ class ControlPlane:
             try:
                 fn(*args)
             except Exception as e:  # noqa: BLE001 — surfaced in teardown
+                # failure forensics: the flight recorder's last spans
+                # become an artifact before the loop dies (no-op disarmed)
+                trace.crash_dump("control-plane-loop")
                 self.crashes.append(repr(e))
         return run
 
@@ -338,6 +337,16 @@ def _placements(client):
 
 
 def _check_invariants(client):
+    try:
+        _check_invariants_inner(client)
+    except AssertionError:
+        # the flight-recorder contract: an invariant violation dumps the
+        # last N spans as a JSON artifact before the storm fails the test
+        trace.crash_dump("invariant-violation")
+        raise
+
+
+def _check_invariants_inner(client):
     nodes = {n.meta.name: n for n in client.list("Node")}
     pods = client.list("Pod")
     jobs = client.list("Job")
@@ -378,10 +387,14 @@ def _check_invariants(client):
 
 
 def _soak(plan, n_jobs=3, replicas=2, elect=False, flap_component="",
-          schedulers=1, controllers=1, queues=("default",)):
+          schedulers=1, controllers=1, queues=("default",),
+          trace_ids_out=None):
     """One seeded storm: bring up the control plane, arm the plan, drive
     the workload through it, disarm, converge, check invariants.  Returns
-    the final placements for parity against a fault-free run."""
+    the final placements for parity against a fault-free run.
+    ``trace_ids_out``: a dict — when given, each submission roots a
+    vtrace span (the ``vtctl job run`` shape), stamps the gang, and
+    records job name -> trace id there."""
     srv = StoreServer().start()
     flap_plan = FaultPlan.from_dict(PLAN_LEASE_FLAP) if flap_component else None
     cp = ControlPlane(srv.url, elect=elect, flap_plan=flap_plan)
@@ -406,7 +419,14 @@ def _soak(plan, n_jobs=3, replicas=2, elect=False, flap_component="",
         for i in range(n_jobs):
             job = _mk_job(f"cj{i}", replicas,
                           queue=queues[i % len(queues)])
-            _submit(client, job)
+            if trace_ids_out is not None:
+                trace.set_component("vtctl")
+                with trace.span("vtctl.job.run", job=job.meta.key) as sp:
+                    trace.stamp(job.meta)
+                    trace_ids_out[f"cj{i}"] = sp.trace_id
+                    _submit(client, job)
+            else:
+                _submit(client, job)
             _wait_running(client, f"soak/cj{i}")
 
         # storm over (plans are bounded); disarm and let the plane settle
@@ -632,6 +652,45 @@ def test_chaos_smoke_5xx_burst_converges_to_fault_free_placements():
     stormy, _ = _soak(PLAN_5XX_BURST, n_jobs=2)
     assert stormy == baseline
     assert len(stormy) == 4  # 2 gangs x 2 replicas, all Running
+
+
+def test_chaos_smoke_traced_storm_neutral_and_reconstructs_gang(tmp_path):
+    """The 5xx storm re-run with vtrace ARMED: (a) final placements are
+    bit-for-bit the fault-free DISARMED run's — tracing is
+    placement-neutral even mid-storm; (b) the flight-recorder dump
+    reconstructs one gang's full lifecycle (submit -> controller ->
+    scheduler cycle/bind -> kubelet Ready) across all three daemons under
+    the single trace id stamped at submission."""
+    baseline, _ = _soak(None, n_jobs=2)  # fault-free, disarmed
+    tids = {}
+    tracer = trace.arm(trace.Tracer(ring=65536, dump_dir=str(tmp_path)))
+    try:
+        stormy, _ = _soak(PLAN_5XX_BURST, n_jobs=2, trace_ids_out=tids)
+        dump = tracer.dump("soak")
+    finally:
+        trace.disarm()
+    assert stormy == baseline
+
+    tid = tids["cj0"]
+    sel = trace.spans_for_trace(dump["spans"], tid)
+    comps = {r["component"] for r in sel}
+    assert {"controller", "scheduler", "kubelet"} <= comps, comps
+    names = {r["name"] for r in sel}
+    assert "vtctl.job.run" in names
+    assert any(n.startswith("controller.") for n in names), names
+    assert "scheduler.bind" in names
+    assert "kubelet.ready" in names
+    # the linked scheduler cycle reconstructs with its internals: at
+    # least one action and one plugin callback inside the cycle tree
+    assert "scheduler.cycle" in names
+    assert "action" in names and "plugin" in names
+    # every bind of the gang carries the trace and names a real node
+    binds = [r for r in sel if r["name"] == "scheduler.bind"]
+    assert {r["attrs"]["task"] for r in binds} == {
+        "soak/cj0-w-0", "soak/cj0-w-1"}
+    ready = [r for r in sel if r["name"] == "kubelet.ready"]
+    assert {r["attrs"]["pod"] for r in ready} == {
+        "soak/cj0-w-0", "soak/cj0-w-1"}
 
 
 # -- the full seeded storms (make chaos) --------------------------------------

@@ -163,3 +163,145 @@ def test_main_entry_node_and_pool_verbs(tmp_path, capsys):
     assert main(["--state", state, "pool", "list"]) == 0
     assert "No resources found" in capsys.readouterr().out
     assert main(["--state", state, "node", "cordon", "ghost"]) == 1
+
+
+# -- describe / events / trace (vtrace explainability) -------------------------
+
+
+@pytest.fixture
+def traced():
+    from volcano_tpu import trace
+
+    tr = trace.arm(trace.Tracer(ring=8192))
+    try:
+        yield tr
+    finally:
+        trace.disarm()
+
+
+def test_describe_job_pending_why_verdict(cluster):
+    """The "why is this gang pending" round-trip on the local store: the
+    scheduler's Unschedulable verdict surfaces through describe."""
+    from volcano_tpu.cli import cmd_describe_job, cmd_describe_pod
+
+    # admitted past the enqueue gate (pods exist) but the gang can never
+    # place both 4.5-cpu replicas on one 8-cpu node
+    cmd_run(cluster.store, name="pend", replicas=2, min_available=2,
+            requests="cpu=4500m,memory=1Gi")
+    cluster.run_until_idle()
+    text = cmd_describe_job(cluster.store, "default", "pend")
+    assert "Conditions (why):" in text
+    assert "Unschedulable" in text
+    assert "0/1 nodes are available, 1 insufficient cpu" in text
+    # per-pod view names the owning gang's verdict
+    pod = sorted(p.meta.name for p in cluster.store.list("Pod"))[0]
+    ptext = cmd_describe_pod(cluster.store, "default", pod)
+    assert "Pending because (gang verdict):" in ptext
+    assert "Unschedulable" in ptext
+
+
+def test_describe_running_job_and_events_table(cluster):
+    from volcano_tpu.cli import cmd_describe_job, cmd_events
+
+    cmd_run(cluster.store, name="ok", replicas=2, min_available=2)
+    cluster.run_until_idle()
+    text = cmd_describe_job(cluster.store, "default", "ok")
+    assert "Phase:     Running" in text
+    assert "n0" in text
+    ev = cmd_events(cluster.store)
+    assert "Scheduled" in ev
+    assert "Successfully assigned" in ev
+    # namespace filter
+    assert "Scheduled" not in cmd_events(cluster.store, namespace="other")
+
+
+def test_describe_unknown_object_errors(cluster):
+    from volcano_tpu.cli import cmd_describe_job, cmd_describe_pod
+
+    with pytest.raises(KeyError):
+        cmd_describe_job(cluster.store, "default", "ghost")
+    with pytest.raises(KeyError):
+        cmd_describe_pod(cluster.store, "default", "ghost")
+
+
+def test_main_entry_local_trace_roundtrip(tmp_path, capsys, traced):
+    """Local mode: an armed `job run` persists the flight recorder next
+    to --state; `trace last` in a later invocation renders the tree and
+    `describe job` shows the trace id."""
+    from volcano_tpu.cli.vtctl import main
+
+    state = str(tmp_path / "state.pkl")
+    assert main(["--state", state, "cluster", "init", "--nodes", "1"]) == 0
+    assert main(["--state", state, "job", "run", "--name", "tr1",
+                 "--replicas", "2", "--min", "2"]) == 0
+    import os
+
+    assert os.path.exists(state + ".trace.json")
+    assert main(["--state", state, "describe", "job", "--name", "tr1"]) == 0
+    out = capsys.readouterr().out
+    assert "Trace:     t-" in out
+    # a fresh "process": drop the live ring, read the sidecar dump
+    from volcano_tpu import trace
+
+    trace.arm(trace.Tracer())  # empty ring; falls through to the file
+    # an armed read-only command with an empty ring must NOT clobber the
+    # sidecar recorder the job run wrote
+    assert main(["--state", state, "describe", "job", "--name", "tr1"]) == 0
+    capsys.readouterr()
+    assert main(["--state", state, "trace", "last"]) == 0
+    out = capsys.readouterr().out
+    assert "vtctl.job.run" in out
+    assert "scheduler.cycle" in out
+    assert "kubelet.ready" in out
+    assert main(["--state", state, "trace", "dump"]) == 0
+    import json
+
+    spans = json.loads(capsys.readouterr().out)
+    assert any(s["name"] == "scheduler.bind" for s in spans)
+    assert main(["--state", state, "events"]) == 0
+    assert "Scheduled" in capsys.readouterr().out
+
+
+def test_remote_describe_events_trace_roundtrip(tmp_path, capsys, traced):
+    """Remote store coverage: pending-gang why verdict + events + the
+    /debug/trace flight recorder, all through `vtctl --server`."""
+    from volcano_tpu.cli.vtctl import main
+    from volcano_tpu.controller import JobController
+    from volcano_tpu.scheduler.conf import default_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+    from volcano_tpu.store.client import RemoteStore
+    from volcano_tpu.store.server import StoreServer
+
+    srv = StoreServer().start()
+    try:
+        url = srv.url
+        assert main(["--server", url, "cluster", "init", "--nodes", "1",
+                     "--cpu", "2"]) == 0
+        # an unschedulable gang: 4x2cpu on one 2-cpu node
+        assert main(["--server", url, "job", "run", "--name", "big",
+                     "--replicas", "4", "--min", "4",
+                     "--requests", "cpu=2000m,memory=1Gi"]) == 0
+        capsys.readouterr()
+        # drive controller + scheduler in-process over the wire
+        ctl = JobController(RemoteStore(url))
+        sched = Scheduler(RemoteStore(url), conf=default_conf())
+        for _ in range(4):
+            ctl.pump()
+            sched.run_once()
+        assert main(["--server", url, "describe", "job",
+                     "--name", "big"]) == 0
+        out = capsys.readouterr().out
+        assert "Conditions (why):" in out and "Unschedulable" in out
+        assert "Trace:     t-" in out  # the run stamped the job
+        assert main(["--server", url, "events"]) == 0
+        assert "Unschedulable" in capsys.readouterr().out
+        # the apiserver's flight recorder saw the traced writes
+        assert main(["--server", url, "trace", "last"]) == 0
+        assert "store." in capsys.readouterr().out
+        assert main(["--server", url, "trace", "dump"]) == 0
+        import json
+
+        spans = json.loads(capsys.readouterr().out)
+        assert any(s["name"].startswith("store.") for s in spans)
+    finally:
+        srv.stop()

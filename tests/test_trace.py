@@ -1,0 +1,452 @@
+"""vtrace: span runtime, flight recorder, propagation, neutrality.
+
+Covers the tentpole contracts of volcano_tpu/trace.py:
+
+* span ids / nesting / explicit trace joins / links, and the
+  ``spans_for_trace`` reconstruction used by ``vtctl trace``;
+* the bounded ring (flight recorder) + crash-dump artifacts;
+* cross-daemon propagation: the X-Volcano-Trace header continues a
+  client's context into the store server's request span;
+* the arming discipline: a DISARMED run performs zero span-runtime work
+  (spied), and an ARMED run is placement-neutral — bit-for-bit the same
+  placements as a disarmed run, with the fast cycle's phase set
+  unchanged (bench.py's breakdown gains no phase);
+* the e2e scheduling-latency parity series emitted from bind spans.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from volcano_tpu import trace
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.sim import Cluster
+
+
+@pytest.fixture
+def armed():
+    tr = trace.arm(trace.Tracer(ring=8192))
+    try:
+        yield tr
+    finally:
+        trace.disarm()
+
+
+def _gang_cluster(conf=None):
+    c = Cluster(scheduler_conf=conf)
+    c.add_queue("default")
+    c.add_node("n0", {"cpu": "8", "memory": "16Gi", "pods": 110})
+    return c
+
+
+# -- span runtime --------------------------------------------------------------
+
+
+def test_span_nesting_and_ids(armed):
+    with trace.span("outer", kind="test") as outer:
+        assert trace.current() == (outer.trace_id, outer.span_id)
+        with trace.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert trace.current() == ("", "")
+    recs = armed.records()
+    assert [r["name"] for r in recs] == ["inner", "outer"]  # exit order
+    assert recs[0]["parent"] == recs[1]["span"]
+    assert recs[1]["parent"] == ""
+    assert recs[1]["attrs"] == {"kind": "test"}
+
+
+def test_explicit_trace_join_and_link_reconstruction(armed):
+    with trace.span("gang.root") as root:
+        gang = root.trace_id
+    # a cycle in its OWN trace links the gang; its children stay in the
+    # cycle's trace but must be reconstructable from the gang's id
+    with trace.span("cycle") as cyc:
+        cyc.link(gang)
+        with trace.span("action", action="allocate"):
+            pass
+    # an explicit join records directly in the gang's trace
+    with trace.span("bind", trace_id=gang):
+        pass
+    sel = trace.spans_for_trace(armed.records(), gang)
+    assert sorted(r["name"] for r in sel) == [
+        "action", "bind", "cycle", "gang.root"]
+    assert trace.render_tree(armed.records(), gang).count("~linked") == 1
+
+
+def test_span_records_error_attr(armed):
+    with pytest.raises(ValueError):
+        with trace.span("boom"):
+            raise ValueError("x")
+    (rec,) = armed.records()
+    assert rec["attrs"]["error"] == "ValueError"
+    assert trace.current() == ("", "")  # context unwound
+
+
+def test_ring_is_bounded():
+    tr = trace.arm(trace.Tracer(ring=8))
+    try:
+        for i in range(20):
+            with trace.span(f"s{i}"):
+                pass
+        names = [r["name"] for r in tr.records()]
+        assert names == [f"s{i}" for i in range(12, 20)]
+    finally:
+        trace.disarm()
+
+
+def test_env_parsing():
+    assert trace._tracer_from_env("") is None
+    assert trace._tracer_from_env("0") is None
+    assert trace._tracer_from_env("off") is None
+    assert trace._tracer_from_env("1").ring_size == trace.DEFAULT_RING
+    tr = trace._tracer_from_env('{"ring": 16, "dir": "/tmp/x"}')
+    assert tr.ring_size == 16 and tr.dump_dir == "/tmp/x"
+
+
+def test_header_roundtrip():
+    assert trace.parse_header(trace.format_header("t-1", "s-2")) == \
+        ("t-1", "s-2")
+    assert trace.parse_header("") == ("", "")
+    assert trace.parse_header("t-only") == ("t-only", "")
+
+
+def test_crash_dump_artifact(tmp_path, armed):
+    armed.dump_dir = str(tmp_path)
+    with trace.span("pre-crash"):
+        pass
+    path = trace.crash_dump("unit")
+    assert path is not None
+    data = json.load(open(path))
+    assert data["reason"] == "unit"
+    assert [s["name"] for s in data["spans"]] == ["pre-crash"]
+    trace.disarm()
+    assert trace.crash_dump("disarmed") is None
+
+
+# -- arming discipline ---------------------------------------------------------
+
+
+def test_disarmed_lifecycle_touches_span_runtime_zero_times(monkeypatch):
+    """The overhead smoke: with tracing disarmed, a full gang lifecycle
+    (submit -> schedule -> bind -> Running) constructs zero Span objects
+    and records nothing — the hot path crosses only the ``TRACER is
+    None`` guard."""
+    assert trace.TRACER is None
+
+    def explode(*a, **kw):
+        raise AssertionError("span runtime touched while disarmed")
+
+    monkeypatch.setattr(trace, "Span", explode)
+    monkeypatch.setattr(trace.Tracer, "record", explode)
+    c = _gang_cluster()
+    from volcano_tpu.cli import cmd_run
+
+    cmd_run(c.store, name="quiet", replicas=2, min_available=2)
+    c.run_until_idle()
+    from volcano_tpu.api.types import JobPhase
+
+    assert c.store.get("Job", "default/quiet").status.state.phase == \
+        JobPhase.RUNNING
+
+
+def test_armed_run_is_placement_neutral_and_phase_set_unchanged():
+    """Acceptance: armed vs disarmed runs produce bit-for-bit identical
+    placements, and the fast cycle's phase breakdown (what bench.py
+    reports) gains no new phase from tracing."""
+    from volcano_tpu.scheduler.conf import full_conf
+
+    known_phases = {"drain", "snapshot", "enqueue", "reclaim", "solve",
+                    "backfill", "dyn_solve", "preempt", "publish",
+                    "subcycle"}
+
+    def run(arm):
+        if arm:
+            trace.arm(trace.Tracer())
+        try:
+            c = _gang_cluster(conf=full_conf("tpu"))
+            from volcano_tpu.cli import cmd_run
+
+            for i in range(3):
+                cmd_run(c.store, name=f"j{i}", replicas=2, min_available=2,
+                        requests="cpu=1000m,memory=1Gi")
+            c.run_until_idle()
+            placements = sorted(
+                (p.meta.key, p.node_name) for p in c.store.list("Pod"))
+            phases = dict(getattr(c.scheduler.fast_cycle, "phases", None)
+                          or {})
+            return placements, phases
+        finally:
+            trace.disarm()
+
+    base, base_phases = run(arm=False)
+    armed_p, armed_phases = run(arm=True)
+    assert armed_p == base
+    assert set(armed_phases) == set(base_phases)
+    assert set(armed_phases) <= known_phases
+
+
+# -- cross-process propagation -------------------------------------------------
+
+
+def test_header_continues_trace_into_store_server(armed):
+    from volcano_tpu.api.objects import Metadata, Queue
+    from volcano_tpu.store.client import RemoteStore
+    from volcano_tpu.store.server import StoreServer
+
+    srv = StoreServer().start()
+    try:
+        client = RemoteStore(srv.url)
+        with trace.span("client.op") as s:
+            client.create("Queue", Queue(meta=Metadata(name="q",
+                                                       namespace="")))
+            tid, sid = s.trace_id, s.span_id
+        # the handler thread records its span just after writing the
+        # reply — give it a beat
+        import time
+
+        deadline = time.monotonic() + 5
+        stored = []
+        while time.monotonic() < deadline and not stored:
+            stored = [r for r in armed.records()
+                      if r["name"] == "store.POST"]
+            if not stored:
+                time.sleep(0.01)
+        assert stored, "server recorded no request span"
+        assert stored[0]["trace"] == tid
+        assert stored[0]["parent"] == sid  # continued across the wire
+        assert stored[0]["attrs"]["path"] == "/apis/Queue"
+    finally:
+        srv.stop()
+
+
+def test_debug_trace_endpoint_serves_ring_and_is_chaos_exempt(armed):
+    from volcano_tpu.store.server import StoreServer
+
+    srv = StoreServer().start()
+    try:
+        with trace.span("visible"):
+            pass
+        payload = json.load(urllib.request.urlopen(
+            srv.url + "/debug/trace", timeout=10))
+        assert payload["armed"]
+        assert any(s["name"] == "visible" for s in payload["spans"])
+        # arm an everything-5xx plan: the admin endpoint must still serve
+        req = urllib.request.Request(
+            srv.url + "/chaos",
+            data=json.dumps({"seed": 1, "rules": [
+                {"point": "server.request", "action": "http_500"}]}).encode(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=10)
+        again = json.load(urllib.request.urlopen(
+            srv.url + "/debug/trace", timeout=10))
+        assert again["armed"]
+        # serving the recorder never writes to it (no store.GET span for
+        # the /debug/trace reads themselves)
+        assert not any(
+            s["attrs"].get("path", "").startswith("/debug/trace")
+            for s in again["spans"])
+    finally:
+        srv.stop()
+
+
+def test_metrics_server_serves_debug_trace(armed):
+    from volcano_tpu.scheduler.metrics_server import MetricsServer
+
+    with trace.span("daemon.work"):
+        pass
+    srv = MetricsServer(port=0).start()
+    try:
+        payload = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/trace", timeout=10))
+        assert payload["armed"]
+        assert any(s["name"] == "daemon.work" for s in payload["spans"])
+    finally:
+        srv.stop()
+
+
+# -- lifecycle reconstruction + decision data ----------------------------------
+
+
+def test_one_trace_id_covers_the_full_gang_lifecycle(armed):
+    """The local-mode acceptance shape: one trace id stamped at job run
+    is reconstructable into a tree spanning controller enqueue, the
+    scheduler cycle (actions, plugins, session close), bind, and the
+    kubelet Ready flip."""
+    from volcano_tpu.cli import cmd_run
+
+    c = _gang_cluster()
+    job = cmd_run(c.store, name="lc", replicas=2, min_available=2)
+    tid = trace.gang_trace(job.meta)
+    assert tid
+    c.run_until_idle()
+    sel = trace.spans_for_trace(armed.records(), tid)
+    names = {r["name"] for r in sel}
+    assert "vtctl.job.run" in names
+    assert "controller.EnqueueJob" in names
+    assert "scheduler.cycle" in names
+    assert "scheduler.bind" in names
+    assert "kubelet.ready" in names
+    actions = {r["attrs"].get("action") for r in sel if r["name"] == "action"}
+    assert {"enqueue", "allocate"} <= actions
+    plugins = {r["attrs"].get("plugin") for r in sel if r["name"] == "plugin"}
+    assert {"gang", "proportion", "predicates"} <= plugins
+    # the pods carried the annotation the whole way
+    for pod in c.store.list("Pod"):
+        assert trace.gang_trace(pod.meta) == tid
+
+
+def test_statement_commit_span_in_preempt_storm(armed):
+    """Contention path: a preempt storm's Statement settlement shows up
+    as statement.commit spans inside the cycle's action span."""
+    from volcano_tpu.api.objects import Metadata, PriorityClass
+    from volcano_tpu.api.types import PodPhase
+    from volcano_tpu.scheduler.conf import default_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+
+    from helpers import build_node, build_pod, build_podgroup, make_store
+
+    pg_low = build_podgroup("pg-low", min_member=1)
+    pg_low.priority_class_name = "low-pri"
+    pg_high = build_podgroup("pg-high", min_member=1)
+    pg_high.priority_class_name = "high-pri"
+    store = make_store(
+        nodes=[build_node("n0", cpu="2", memory="4Gi")],
+        podgroups=[pg_low, pg_high],
+        pods=[
+            build_pod("low-0", group="pg-low", cpu="1",
+                      phase=PodPhase.RUNNING, node_name="n0", priority=1),
+            build_pod("low-1", group="pg-low", cpu="1",
+                      phase=PodPhase.RUNNING, node_name="n0", priority=1),
+            build_pod("high-0", group="pg-high", cpu="1", priority=100),
+        ],
+    )
+    store.create("PriorityClass", PriorityClass(
+        Metadata(name="low-pri", namespace=""), value=1))
+    store.create("PriorityClass", PriorityClass(
+        Metadata(name="high-pri", namespace=""), value=100))
+    conf = default_conf()
+    conf.actions = ["preempt"]
+    Scheduler(store, conf=conf).run_once()
+    recs = armed.records()
+    commits = [r for r in recs if r["name"] == "statement.commit"]
+    assert commits, [r["name"] for r in recs]
+    assert commits[0]["attrs"]["ops"] >= 1
+    # nested inside the preempt action span of the cycle tree
+    parents = {r["span"]: r for r in recs}
+    parent = parents[commits[0]["parent"]]
+    assert parent["name"] == "action" and \
+        parent["attrs"]["action"] == "preempt"
+
+
+@pytest.mark.slow
+def test_real_daemons_expose_one_trace_on_all_debug_endpoints():
+    """Acceptance, real process model: VOLCANO_TPU_TRACE=1 daemons, one
+    trace id submitted at `vtctl job run`, recovered from /debug/trace on
+    the controller, scheduler, kubelet AND the apiserver."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from volcano_tpu.store.client import RemoteStore, wait_healthy
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "VOLCANO_TPU_BACKEND": "host", "VOLCANO_TPU_TRACE": "1"}
+    entry = [sys.executable, "-m", "volcano_tpu.cli"]
+    procs = []
+
+    def spawn(args):
+        p = subprocess.Popen(entry + args, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True, env=env)
+        procs.append(p)
+        return p
+
+    try:
+        api = spawn(["apiserver", "--port", "0"])
+        url = api.stdout.readline().strip().rsplit(" ", 1)[-1]
+        assert wait_healthy(url, timeout=30)
+        ctl = spawn(["controller", "--server", url, "--debug-port", "0",
+                     "--period", "0.05"])
+        ctl_port = ctl.stdout.readline().strip().rsplit(":", 2)[-1].split("/")[0]
+        kub = spawn(["kubelet", "--server", url, "--debug-port", "0",
+                     "--period", "0.05"])
+        kub_port = kub.stdout.readline().strip().rsplit(":", 2)[-1].split("/")[0]
+        sched = spawn(["scheduler", "--server", url, "--period", "0.1",
+                       "--metrics-port", "0"])
+        sched_port = None
+        deadline = time.time() + 60
+        while time.time() < deadline and sched_port is None:
+            line = sched.stdout.readline()
+            if "metrics on" in line:
+                sched_port = line.strip().rsplit(":", 1)[-1].split("/")[0]
+        assert sched_port, "scheduler never announced its metrics port"
+
+        subprocess.run(entry + ["--server", url, "cluster", "init",
+                                "--nodes", "2"], env=env, check=True,
+                       capture_output=True)
+        subprocess.run(entry + ["--server", url, "job", "run", "--name",
+                                "g1", "--replicas", "2", "--min", "2"],
+                       env=env, check=True, capture_output=True)
+        client = RemoteStore(url)
+        deadline = time.time() + 90
+        job = None
+        while time.time() < deadline:
+            job = client.get("Job", "default/g1")
+            if job is not None and job.status.state.phase.value == "Running":
+                break
+            time.sleep(0.2)
+        assert job is not None and job.status.state.phase.value == "Running"
+        tid = trace.gang_trace(job.meta)
+        assert tid
+        time.sleep(1.0)  # let the last Ready-flip spans land in the rings
+
+        def ring(port):
+            return json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/trace", timeout=10))["spans"]
+
+        expectations = {
+            ctl_port: {"controller.EnqueueJob"},
+            sched_port: {"scheduler.cycle", "scheduler.bind", "action",
+                         "plugin"},
+            kub_port: {"kubelet.ready"},
+            url.rsplit(":", 1)[-1]: {"store.POST"},
+        }
+        for port, expect in expectations.items():
+            names = {s["name"]
+                     for s in trace.spans_for_trace(ring(port), tid)}
+            assert expect <= names, (port, expect, names)
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_pod_e2e_latency_metric_exposition_and_monotonicity(armed):
+    """Satellite: the reference-parity first-seen->bind series, emitted
+    from bind spans — exposition format + monotone count/sum."""
+    from volcano_tpu.cli import cmd_run
+
+    metrics.reset()
+    c = _gang_cluster()
+    cmd_run(c.store, name="m1", replicas=2, min_available=2)
+    c.run_until_idle()
+    vals = list(metrics.get_histogram(
+        "volcano_e2e_job_scheduling_latency_milliseconds"))
+    assert len(vals) == 2 and all(v >= 0 for v in vals)
+    text = metrics.expose_text()
+    assert "volcano_e2e_job_scheduling_latency_milliseconds_count 2" in text
+    assert "volcano_e2e_job_scheduling_latency_milliseconds_sum" in text
+    cmd_run(c.store, name="m2", replicas=1, min_available=1)
+    c.run_until_idle()
+    vals2 = metrics.get_histogram("volcano_e2e_job_scheduling_latency_milliseconds")
+    assert len(vals2) == 3  # monotone: observations only accumulate
+    assert vals2[:2] == vals
+    assert "volcano_e2e_job_scheduling_latency_milliseconds_count 3" \
+        in metrics.expose_text()

@@ -713,6 +713,89 @@ def test_lock_rules_scan_elastic_modules(tmp_path):
     """, select=["lock-order"]) == []
 
 
+# --- rule: trace-span-discipline --------------------------------------------
+
+
+def test_trace_span_fires_outside_with(tmp_path):
+    # a bare span() call and an assigned span are both manual pairing:
+    # an exception between begin and end leaks the ambient context
+    findings = _lint(tmp_path, "scheduler/x.py", """
+        from volcano_tpu import trace
+
+        def cycle():
+            span = trace.span
+            trace.span("cycle")
+            s = trace.span("action")
+            s.__enter__()
+    """, select=["trace-span-discipline"])
+    assert _rules_of(findings) == ["trace-span-discipline"] * 2
+
+
+def test_trace_span_fires_on_manual_begin_end(tmp_path):
+    findings = _lint(tmp_path, "scheduler/x.py", """
+        def cycle(tr):
+            tr.begin_span("cycle")
+            work()
+            tr.end_span()
+    """, select=["trace-span-discipline"])
+    assert _rules_of(findings) == ["trace-span-discipline"] * 2
+
+
+def test_trace_time_in_jit_fires_in_trace_aware_module(tmp_path):
+    findings = _lint(tmp_path, "scheduler/x.py", """
+        import time
+
+        import jax
+        from volcano_tpu import trace
+
+        @jax.jit
+        def solve(x):
+            t0 = time.perf_counter()
+            return x + t0
+    """, select=["trace-span-discipline"])
+    assert _rules_of(findings) == ["trace-span-discipline"]
+
+
+def test_trace_span_in_jit_fires_even_without_import(tmp_path):
+    findings = _lint(tmp_path, "scheduler/x.py", """
+        import jax
+        from volcano_tpu.trace import span
+
+        @jax.jit
+        def solve(x):
+            with span("inner"):
+                return x
+    """, select=["trace-span-discipline"])
+    assert _rules_of(findings) == ["trace-span-discipline"]
+
+
+def test_trace_span_near_misses(tmp_path):
+    # with-scoped spans, annotate on the bound name, time.* outside jit
+    # in a trace-aware module, and time-in-jit in a NON-trace module
+    # (the generic hot-path rules own that tree) all stay quiet
+    assert _lint(tmp_path, "scheduler/x.py", """
+        import time
+
+        from volcano_tpu import trace
+
+        def cycle():
+            t0 = time.perf_counter()
+            with trace.span("cycle") as cyc:
+                cyc.annotate(t0=t0)
+                with trace.span("action", action="allocate"):
+                    work()
+    """, select=["trace-span-discipline"]) == []
+    assert _lint(tmp_path, "scheduler/y.py", """
+        import time
+
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x  # time imported but never read under the trace
+    """, select=["trace-span-discipline"]) == []
+
+
 # --- suppression contract ---------------------------------------------------
 
 

@@ -96,6 +96,7 @@ def _load_rule_modules() -> None:
         rules_registry,
         rules_retry,
         rules_statement,
+        rules_trace,
     )
 
 

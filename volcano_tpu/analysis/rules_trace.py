@@ -1,0 +1,123 @@
+"""Span discipline for the vtrace runtime (volcano_tpu/trace.py).
+
+Two invariants keep the tracing layer placement-neutral and crash-safe:
+
+* **Spans are scoped, not paired.**  A span opened with ``with
+  span(...):`` is recorded even when the body raises, and the ambient
+  context always unwinds.  A manual begin/end pair (calling ``span(...)``
+  outside a ``with`` item, entering it by hand, or calling a
+  ``begin_span``/``end_span`` method) leaks the context on any exception
+  — every later span in the thread silently joins the wrong trace.
+* **No clock reads under a jax trace.**  ``time.*`` inside a jit-traced
+  body executes once at trace time and bakes a constant into the
+  compiled kernel — the span would "measure" compilation, not execution,
+  and the timing call itself can force a host sync.  Trace-aware modules
+  (anything importing ``volcano_tpu.trace``) must time device work only
+  at block-until-ready boundaries, outside jit roots.  The generic
+  hot-path rules stay the enforcers for kernels; this rule closes the
+  gap for instrumentation added to modules they don't scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    ctx_nodes_in_jit,
+    dotted_name,
+    rule,
+)
+
+#: call names that open a span (the factory and its qualified forms)
+_SPAN_CALLS = {"span", "trace.span", "volcano_tpu.trace.span"}
+#: manual pairing methods — must not exist, with or without a with
+_MANUAL_ATTRS = {"begin_span", "end_span"}
+
+
+def _imports_trace(ctx: FileContext) -> bool:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "volcano_tpu.trace" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "volcano_tpu.trace":
+                return True
+            if node.module == "volcano_tpu" and any(
+                a.name == "trace" for a in node.names
+            ):
+                return True
+    return False
+
+
+def _with_context_calls(tree: ast.AST) -> Set[int]:
+    """id()s of Call nodes that are directly a with-item's context
+    expression (``with span(...):`` / ``with span(...) as s:``)."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    out.add(id(item.context_expr))
+    return out
+
+
+@rule(
+    "trace-span-discipline",
+    "spans must be opened via `with span(...)` (no manual begin/end "
+    "pairs) and trace-aware modules may not read time.* or open spans "
+    "inside jit-traced bodies",
+)
+def check_trace_span_discipline(ctx: FileContext) -> Iterable[Finding]:
+    with_calls = _with_context_calls(ctx.tree)
+    in_jit = ctx_nodes_in_jit(ctx)
+    trace_module = _imports_trace(ctx) or ctx.basename == "trace.py"
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        leaf = name.split(".")[-1]
+        if leaf in _MANUAL_ATTRS:
+            yield ctx.finding(
+                "trace-span-discipline",
+                node,
+                f"manual span pairing via {leaf}() leaks the trace "
+                "context on exceptions — open spans with `with "
+                "span(...)` only",
+            )
+            continue
+        if name in _SPAN_CALLS:
+            if id(node) in in_jit:
+                yield ctx.finding(
+                    "trace-span-discipline",
+                    node,
+                    "span opened inside a jit-traced body: it would time "
+                    "trace-time, not execution — instrument at the "
+                    "block-until-ready boundary outside the jit root",
+                )
+            elif id(node) not in with_calls:
+                yield ctx.finding(
+                    "trace-span-discipline",
+                    node,
+                    "span(...) result not used as a `with` context: a "
+                    "raised exception would leak the span and its "
+                    "ambient context — write `with span(...):`",
+                )
+            continue
+        if (
+            trace_module
+            and name.startswith("time.")
+            and id(node) in in_jit
+        ):
+            yield ctx.finding(
+                "trace-span-discipline",
+                node,
+                f"{name}() inside a jit-traced body of a trace-aware "
+                "module: the read happens once at trace time (and can "
+                "force a host sync) — time device work only at "
+                "block-until-ready boundaries",
+            )

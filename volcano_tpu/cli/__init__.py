@@ -7,13 +7,17 @@ command functions also operate on any live Store for embedding.
 from volcano_tpu.cli.vtctl import (
     build_job_from_flags,
     cmd_cordon,
+    cmd_describe_job,
+    cmd_describe_pod,
     cmd_drain,
+    cmd_events,
     cmd_list,
     cmd_node_list,
     cmd_pool_list,
     cmd_resume,
     cmd_run,
     cmd_suspend,
+    cmd_trace_render,
     cmd_uncordon,
     main,
 )
@@ -21,13 +25,17 @@ from volcano_tpu.cli.vtctl import (
 __all__ = [
     "build_job_from_flags",
     "cmd_cordon",
+    "cmd_describe_job",
+    "cmd_describe_pod",
     "cmd_drain",
+    "cmd_events",
     "cmd_list",
     "cmd_node_list",
     "cmd_pool_list",
     "cmd_resume",
     "cmd_run",
     "cmd_suspend",
+    "cmd_trace_render",
     "cmd_uncordon",
     "main",
 ]

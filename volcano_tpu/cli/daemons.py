@@ -36,6 +36,18 @@ def _transient_errors():
     return (RemoteStoreError, OSError, http.client.HTTPException)
 
 
+def _maybe_debug_server(port: int, announce) -> None:
+    """Serve /debug/trace (+ /metrics, /healthz) when ``port >= 0`` — the
+    flight-recorder endpoint for daemons without their own metrics server
+    (controller, kubelet).  0 picks a free port."""
+    if port < 0:
+        return
+    from volcano_tpu.scheduler.metrics_server import MetricsServer
+
+    srv = MetricsServer(port=port).start()
+    announce(f"debug on http://127.0.0.1:{srv.port}/debug/trace", flush=True)
+
+
 def _elector(store, component: str, identity: str, enabled: bool):
     if not enabled:
         return None
@@ -58,9 +70,11 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
     """``state`` names a JSON file the server persists all objects to (the
     etcd analogue): a restarted apiserver resumes with every CRD, and
     clients behind the restart relist."""
+    from volcano_tpu import trace
     from volcano_tpu.api.objects import Metadata, Queue
     from volcano_tpu.store.server import StoreServer
 
+    trace.set_component("apiserver")
     srv = StoreServer(host=host, port=port, state_path=state or None)
     if default_queue and srv.store.get("Queue", "/default") is None:
         srv.store.create("Queue", Queue(meta=Metadata(name="default", namespace="")))
@@ -79,10 +93,14 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
 
 
 def run_controller(server: str, identity: str = "", leader_elect: bool = True,
-                   period: float = 0.2, announce=print) -> None:
+                   period: float = 0.2, announce=print,
+                   debug_port: int = -1) -> None:
+    from volcano_tpu import trace
     from volcano_tpu.controller import JobController
     from volcano_tpu.store.client import RemoteStore, StaleWatch
 
+    trace.set_component("controller")
+    _maybe_debug_server(debug_port, announce)
     ident = identity or f"controller-{os.getpid()}"
 
     def build():
@@ -144,10 +162,12 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
     """schedule-period defaults to the reference's 1s and /metrics to :8080,
     as the reference binary (options.go:28,63; server.go:86-89). Pass
     metrics_port<0 to disable the endpoint, 0 for a free port."""
+    from volcano_tpu import trace
     from volcano_tpu.scheduler.conf import full_conf, load_conf
     from volcano_tpu.scheduler.scheduler import Scheduler
     from volcano_tpu.store.client import RemoteStore
 
+    trace.set_component("scheduler")
     # deployed default: the fully-loaded 5-action conf on the tpu backend
     # (VOLCANO_TPU_BACKEND=host opts out — e.g. deployments without jax;
     # the test suite sets it to keep daemon subprocesses light)
@@ -262,21 +282,58 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
         time.sleep(max(0.0, period - (time.monotonic() - t0)))
 
 
-def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
+def kubelet_step(store, now: float) -> None:
+    """One pass of the simulated kubelet over the store: reap deleting
+    pods, flip bound Pending pods Running (the Ready flip — a traced
+    gang's pods join their trace here), and advance Provisioning elastic
+    nodes.  Shared by ``run_kubelet`` and the in-process control planes
+    in the chaos soak, so both paths carry identical semantics."""
+    from volcano_tpu import trace
+    from volcano_tpu.api.types import PodPhase
+    from volcano_tpu.elastic.lifecycle import kubelet_provisioning_step
+    from volcano_tpu.store.store import Conflict
+
+    for pod in store.list("Pod"):
+        if pod.deleting:
+            store.delete("Pod", pod.meta.key)
+        elif pod.node_name and pod.phase == PodPhase.PENDING:
+            rv = pod.meta.resource_version
+            pod.phase = PodPhase.RUNNING
+            try:
+                # CAS: the controller may have marked this pod
+                # deleting since the list; never resurrect it with
+                # a stale write
+                store.update_cas("Pod", pod, rv)
+            except (Conflict, KeyError):
+                continue  # changed under us; reconcile next period
+            if trace.TRACER is not None:
+                tid = trace.gang_trace(pod.meta)
+                if tid:
+                    # the lifecycle's last leg: pod observed Running
+                    with trace.span("kubelet.ready", trace_id=tid,
+                                    pod=pod.meta.key, node=pod.node_name):
+                        pass
+    kubelet_provisioning_step(store, now)
+
+
+def run_kubelet(server: str, period: float = 0.2, announce=print,
+                debug_port: int = -1) -> None:
     """Simulated kubelets over the remote store: bound pending pods start
     Running; pods marked deleting are reaped (the Releasing window the
     pipelined tasks wait on, SURVEY.md §3.5); Provisioning elastic nodes
     flip Ready once wall time passes their provision delay
-    (elastic/lifecycle.py — elasticd stamps ready-at with time.time)."""
+    (elastic/lifecycle.py — elasticd stamps ready-at with time.time).
+    ``debug_port>=0`` serves /debug/trace (+ /metrics) for the flight
+    recorder."""
     import time as _time
 
-    from volcano_tpu.api.types import PodPhase
-    from volcano_tpu.elastic.lifecycle import kubelet_provisioning_step
+    from volcano_tpu import trace
     from volcano_tpu.store.client import RemoteStore
-    from volcano_tpu.store.store import Conflict
 
     from volcano_tpu.backoff import Backoff
 
+    trace.set_component("kubelet")
+    _maybe_debug_server(debug_port, announce)
     store = RemoteStore(server)
     announce(f"kubelet simulating against {server}", flush=True)
     transient = _transient_errors()
@@ -284,20 +341,7 @@ def run_kubelet(server: str, period: float = 0.2, announce=print) -> None:
     retry = Backoff(base=min(max(period, 0.01), 0.2))
     while True:
         try:
-            for pod in store.list("Pod"):
-                if pod.deleting:
-                    store.delete("Pod", pod.meta.key)
-                elif pod.node_name and pod.phase == PodPhase.PENDING:
-                    rv = pod.meta.resource_version
-                    pod.phase = PodPhase.RUNNING
-                    try:
-                        # CAS: the controller may have marked this pod
-                        # deleting since the list; never resurrect it with
-                        # a stale write
-                        store.update_cas("Pod", pod, rv)
-                    except (Conflict, KeyError):
-                        pass  # changed under us; reconcile next period
-            kubelet_provisioning_step(store, _time.time())
+            kubelet_step(store, _time.time())
             retry.reset()
             if down:
                 announce("kubelet: store back", flush=True)
@@ -321,12 +365,13 @@ def run_elastic(server: str, identity: str = "", leader_elect: bool = True,
     ``volcano_elastic_*`` series expose on /metrics at ``metrics_port``
     (default :8081 — the scheduler owns :8080; <0 disables, 0 = free
     port)."""
-    from volcano_tpu import chaos
+    from volcano_tpu import chaos, trace
     from volcano_tpu.elastic import ElasticController
     from volcano_tpu.store.client import RemoteStore, StaleWatch
 
     from volcano_tpu.backoff import Backoff
 
+    trace.set_component("elastic")
     ident = identity or f"elastic-{os.getpid()}"
     plan = chaos.env_plan()
     fault = plan if plan is not None and plan.has_point("elastic.provision") \

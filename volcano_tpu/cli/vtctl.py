@@ -22,6 +22,7 @@ import pickle
 import sys
 from typing import Optional
 
+from volcano_tpu import trace
 from volcano_tpu.api.job import Job, JobSpec, TaskSpec
 from volcano_tpu.api.objects import Command, Metadata, PodSpec
 from volcano_tpu.api.resource import Resource
@@ -69,11 +70,24 @@ def build_job_from_flags(
     )
 
 
+def _traced_job_create(job: Job, create):
+    """The trace ROOT shared by the local and remote submission paths:
+    with tracing armed (VOLCANO_TPU_TRACE) the span's trace id is stamped
+    into the Job annotation and follows the gang through controller ->
+    scheduler -> bind -> kubelet Ready flip."""
+    with trace.span("vtctl.job.run", job=job.meta.key):
+        trace.stamp(job.meta)
+        return create(job)
+
+
 def cmd_run(store, **flags) -> Job:
     """Create a job from flags, through the shared admission gate."""
     from volcano_tpu.admission import admit_and_create
 
-    return admit_and_create(store, build_job_from_flags(**flags))
+    return _traced_job_create(
+        build_job_from_flags(**flags),
+        lambda job: admit_and_create(store, job),
+    )
 
 
 _COLUMNS = (
@@ -204,6 +218,179 @@ def cmd_pool_list(store, out: Optional[io.TextIOBase] = None) -> str:
     return text
 
 
+# -- describe / events / trace (decision-level explainability) ----------------
+
+
+def _why_lines(pg) -> list:
+    """The "why is this gang not running" verdict: the True conditions the
+    scheduler cycle wrote on the PodGroup (gang/predicate/proportion
+    reasons, e.g. "0/3 nodes are available, 3 insufficient cpu.")."""
+    return [
+        f"  {c.kind:<16}{c.reason:<22}{c.message}"
+        for c in pg.status.conditions
+        if c.status == "True"
+    ]
+
+
+def _event_lines(evs) -> list:
+    return [
+        f"  {e.type:<9}{e.reason:<16}x{e.count:<4}{e.message}"
+        for e in sorted(evs, key=lambda e: e.meta.uid)
+    ]
+
+
+def cmd_describe_job(store, namespace: str = "default", name: str = "",
+                     out: Optional[io.TextIOBase] = None) -> str:
+    """kubectl-describe analogue for a Job: status, the gang's
+    Unschedulable verdict, per-pod placement, and the event stream."""
+    from volcano_tpu import events as cluster_events
+    from volcano_tpu.api.job import JOB_NAME_KEY
+
+    key = f"{namespace}/{name}"
+    job = store.get("Job", key)
+    if job is None:
+        raise KeyError(f"job {key} not found")
+    pg = store.get("PodGroup", key)
+    pods = [
+        p for p in store.list("Pod")
+        if p.meta.namespace == namespace
+        and p.meta.annotations.get(JOB_NAME_KEY) == name
+    ]
+    buf = io.StringIO()
+    st = job.status
+    buf.write(f"Name:      {key}\n")
+    buf.write(f"Phase:     {st.state.phase.value}\n")
+    buf.write(f"Queue:     {job.spec.queue or 'default'}\n")
+    buf.write(f"Min/Total: {job.spec.min_available}"
+              f"/{job.spec.total_replicas()}\n")
+    tid = trace.gang_trace(job.meta)
+    if tid:
+        buf.write(f"Trace:     {tid}\n")
+    if pg is not None:
+        buf.write(f"PodGroup:  {pg.status.phase.value}\n")
+        why = _why_lines(pg)
+        if why:
+            buf.write("Conditions (why):\n")
+            buf.write("\n".join(why) + "\n")
+    if pods:
+        buf.write("Pods:\n")
+        for p in sorted(pods, key=lambda p: p.meta.name):
+            buf.write(f"  {p.meta.name:<30}{p.phase.value:<12}"
+                      f"{p.node_name or '<none>'}\n")
+    evs = (cluster_events.events_for(store, "Job", key)
+           + cluster_events.events_for(store, "PodGroup", key))
+    if evs:
+        buf.write("Events:\n")
+        buf.write("\n".join(_event_lines(evs)) + "\n")
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def cmd_describe_pod(store, namespace: str = "default", name: str = "",
+                     out: Optional[io.TextIOBase] = None) -> str:
+    """Per-pod view: phase/placement, its events, and — for a pending
+    unbound pod — the owning gang's "why" verdict."""
+    from volcano_tpu import events as cluster_events
+    from volcano_tpu.api.job import POD_GROUP_KEY
+    from volcano_tpu.api.types import PodPhase
+
+    key = f"{namespace}/{name}"
+    pod = store.get("Pod", key)
+    if pod is None:
+        raise KeyError(f"pod {key} not found")
+    buf = io.StringIO()
+    buf.write(f"Name:   {key}\n")
+    buf.write(f"Phase:  {pod.phase.value}\n")
+    buf.write(f"Node:   {pod.node_name or '<none>'}\n")
+    tid = trace.gang_trace(pod.meta)
+    if tid:
+        buf.write(f"Trace:  {tid}\n")
+    if pod.phase == PodPhase.PENDING and not pod.node_name:
+        group = pod.meta.annotations.get(POD_GROUP_KEY, "")
+        pg = store.get("PodGroup", f"{namespace}/{group}") if group else None
+        if pg is not None:
+            why = _why_lines(pg)
+            if why:
+                buf.write("Pending because (gang verdict):\n")
+                buf.write("\n".join(why) + "\n")
+    evs = cluster_events.events_for(store, "Pod", key)
+    if evs:
+        buf.write("Events:\n")
+        buf.write("\n".join(_event_lines(evs)) + "\n")
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def cmd_events(store, namespace: str = "",
+               out: Optional[io.TextIOBase] = None) -> str:
+    """The cluster event stream (kubectl get events), oldest first."""
+    evs = sorted(store.list("Event"), key=lambda e: e.meta.uid)
+    if namespace:
+        evs = [e for e in evs if e.involved[1].startswith(namespace + "/")]
+    buf = io.StringIO()
+    if not evs:
+        buf.write("No resources found\n")
+    else:
+        row = "%-9s%-16s%-7s%-36s%s\n"
+        buf.write(row % ("Type", "Reason", "Count", "Object", "Message"))
+        for e in evs:
+            buf.write(row % (e.type, e.reason, e.count,
+                             f"{e.involved[0]}/{e.involved[1]}", e.message))
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def cmd_trace_render(records, trace_id: str = "",
+                     out: Optional[io.TextIOBase] = None) -> str:
+    """Span tree for one trace — the given id, or the most recent trace
+    in the flight recorder (``vtctl trace last``)."""
+    buf = io.StringIO()
+    if not records:
+        buf.write("no spans recorded (arm tracing with "
+                  "VOLCANO_TPU_TRACE=1)\n")
+    else:
+        buf.write(trace.render_tree(
+            records, trace_id or trace.latest_trace(records)))
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def _fetch_debug_trace(server_url: str) -> list:
+    """The remote flight recorder: GET <server>/debug/trace."""
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(
+        server_url.rstrip("/") + "/debug/trace", timeout=10
+    ) as r:
+        return _json.load(r).get("spans") or []
+
+
+def _local_trace_records(state_path: str) -> list:
+    """Local-mode flight recorder: the live in-process ring when armed
+    (embedders/tests), else the sidecar dump the previous armed
+    invocation wrote next to the --state file."""
+    import json as _json
+
+    if trace.TRACER is not None:
+        recs = trace.TRACER.records()
+        if recs:
+            return recs
+    try:
+        with open(state_path + ".trace.json", encoding="utf-8") as f:
+            return _json.load(f).get("spans") or []
+    except (OSError, ValueError):
+        return []
+
+
 def _issue_command(store, namespace: str, name: str, action: JobAction) -> Command:
     from volcano_tpu.api.objects import new_uid
 
@@ -264,12 +451,34 @@ def _main_remote(args) -> int:
                 return rc
         elif args.group == "pool":
             cmd_pool_list(store, out=sys.stdout)
+        elif args.group == "describe":
+            if args.cmd == "job":
+                cmd_describe_job(store, args.namespace, args.name,
+                                 out=sys.stdout)
+            else:
+                cmd_describe_pod(store, args.namespace, args.name,
+                                 out=sys.stdout)
+        elif args.group == "events":
+            cmd_events(store, namespace=args.namespace, out=sys.stdout)
+        elif args.group == "trace":
+            records = _fetch_debug_trace(args.server)
+            if args.cmd == "dump":
+                import json as _json
+
+                print(_json.dumps(records))
+            else:
+                cmd_trace_render(records, trace_id=args.trace,
+                                 out=sys.stdout)
         elif args.cmd == "run":
             # server-side admission mutates/validates (the webhook path)
-            store.create("Job", build_job_from_flags(
-                name=args.name, namespace=args.namespace, image=args.image,
-                min_available=args.min_available, replicas=args.replicas,
-                requests=args.requests, queue=args.queue))
+            _traced_job_create(
+                build_job_from_flags(
+                    name=args.name, namespace=args.namespace,
+                    image=args.image, min_available=args.min_available,
+                    replicas=args.replicas, requests=args.requests,
+                    queue=args.queue),
+                lambda job: store.create("Job", job),
+            )
             print(f"job {args.namespace}/{args.name} created")
         elif args.cmd == "list":
             cmd_list(store, namespace=args.namespace, out=sys.stdout)
@@ -368,6 +577,25 @@ def main(argv=None) -> int:
     pool_sub = pool_p.add_subparsers(dest="cmd", required=True)
     pool_sub.add_parser("list", parents=[common])
 
+    # explainability verbs (vtrace; volcano_tpu/trace.py + events.py)
+    desc_p = sub.add_parser("describe",
+                            help="why-focused object detail (job|pod)")
+    desc_sub = desc_p.add_subparsers(dest="cmd", required=True)
+    for what in ("job", "pod"):
+        p = desc_sub.add_parser(what, parents=[common])
+        p.add_argument("--name", "-n", required=True)
+        p.add_argument("--namespace", "-N", default="default")
+    ev_p = sub.add_parser("events", parents=[common],
+                          help="cluster event stream")
+    ev_p.add_argument("--namespace", "-N", default="")
+    tr_p = sub.add_parser("trace", help="scheduling traces "
+                                        "(flight recorder)")
+    tr_sub = tr_p.add_subparsers(dest="cmd", required=True)
+    last_p = tr_sub.add_parser("last", parents=[common])
+    last_p.add_argument("--trace", "-t", default="",
+                        help="trace id (default: most recent)")
+    tr_sub.add_parser("dump", parents=[common])
+
     cl_p = sub.add_parser("cluster", help="simulated cluster management")
     cl_sub = cl_p.add_subparsers(dest="cmd", required=True)
     init_p = cl_sub.add_parser("init", parents=[common])
@@ -420,6 +648,10 @@ def main(argv=None) -> int:
         if comp == "elastic":
             p.add_argument("--metrics-port", type=int, default=8081,
                            help="/metrics port (0 = free port, <0 = disabled)")
+        if comp in ("controller", "kubelet"):
+            p.add_argument("--debug-port", type=int, default=-1,
+                           help="/debug/trace port (flight recorder; "
+                                "0 = free port, <0 = disabled)")
 
     args = parser.parse_args(argv)
 
@@ -453,7 +685,8 @@ def main(argv=None) -> int:
             elif args.group == "controller":
                 daemons.run_controller(args.server, identity=args.identity,
                                        leader_elect=not args.no_leader_elect,
-                                       period=args.period)
+                                       period=args.period,
+                                       debug_port=args.debug_port)
             elif args.group == "scheduler":
                 daemons.run_scheduler(args.server, conf_path=args.conf,
                                       identity=args.identity,
@@ -466,9 +699,15 @@ def main(argv=None) -> int:
                                     period=args.period,
                                     metrics_port=args.metrics_port)
             else:
-                daemons.run_kubelet(args.server, period=args.period)
+                daemons.run_kubelet(args.server, period=args.period,
+                                    debug_port=args.debug_port)
         except KeyboardInterrupt:
             pass
+        except Exception:
+            # failure forensics: the flight recorder's last N spans become
+            # a JSON artifact before the daemon dies (no-op disarmed)
+            trace.crash_dump(f"{args.group}-crash")
+            raise
         return 0
 
     if args.server:
@@ -497,6 +736,25 @@ def main(argv=None) -> int:
                 cluster.run_until_idle()
         elif args.group == "pool":
             cmd_pool_list(cluster.store, out=sys.stdout)
+        elif args.group == "describe":
+            if args.cmd == "job":
+                cmd_describe_job(cluster.store, args.namespace, args.name,
+                                 out=sys.stdout)
+            else:
+                cmd_describe_pod(cluster.store, args.namespace, args.name,
+                                 out=sys.stdout)
+        elif args.group == "events":
+            cmd_events(cluster.store, namespace=args.namespace,
+                       out=sys.stdout)
+        elif args.group == "trace":
+            records = _local_trace_records(args.state)
+            if args.cmd == "dump":
+                import json as _json
+
+                print(_json.dumps(records))
+            else:
+                cmd_trace_render(records, trace_id=args.trace,
+                                 out=sys.stdout)
         elif args.cmd == "run":
             cmd_run(
                 cluster.store,
@@ -520,6 +778,14 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
 
+    if trace.TRACER is not None and args.group != "trace" \
+            and trace.TRACER.records():
+        # local mode runs the whole control plane in-process: persist the
+        # flight recorder beside the cluster state so a later
+        # `vtctl trace last|dump` (a fresh process) can read it.  Only a
+        # non-empty ring writes — an armed read-only command (describe,
+        # list) must not clobber the previous invocation's recorder
+        trace.TRACER.dump_to(args.state + ".trace.json")
     _save_cluster(cluster, args.state)
     return 0
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Deque, Dict, List, Optional
 
+from volcano_tpu import trace
 from volcano_tpu.api.job import (
     JOB_NAME_KEY,
     JOB_VERSION_KEY,
@@ -281,6 +282,16 @@ class JobController:
         if info is None or info.job is None:
             return
         action = apply_policies(info.job, req)
+        if trace.TRACER is not None:
+            # a traced gang's reconcile joins its trace: one span per
+            # controller action (EnqueueJob creates the pods — the
+            # "controller enqueue" leg of the lifecycle)
+            tid = trace.gang_trace(info.job.meta)
+            if tid:
+                with trace.span(f"controller.{action.value}", trace_id=tid,
+                                job=req.job_key, event=str(req.event or "")):
+                    new_state(self, info).execute(action)
+                return
         new_state(self, info).execute(action)
 
     # -- primitives (create/sync/kill) ----------------------------------------
@@ -303,11 +314,18 @@ class JobController:
             plugin.on_job_add(job, self.store)
 
         if self.store.get("PodGroup", job.meta.key) is None:
+            # the gang's trace id (stamped at `vtctl job run`) rides the
+            # PodGroup so the scheduler cycle can link the trace
+            pg_ann = {}
+            tid = trace.gang_trace(job.meta)
+            if tid:
+                pg_ann[trace.TRACE_ID_KEY] = tid
             pg = PodGroup(
                 meta=Metadata(
                     name=job.meta.name,
                     namespace=job.meta.namespace,
                     owner=("Job", job.meta.name),
+                    annotations=pg_ann,
                 ),
                 min_member=job.spec.min_available,
                 queue=job.spec.queue,
@@ -350,17 +368,23 @@ class JobController:
 
         spec = copy.deepcopy(task.template)
         spec.scheduler_name = job.spec.scheduler_name
+        annotations = {
+            TASK_SPEC_KEY: task.name,
+            JOB_NAME_KEY: job.meta.name,
+            JOB_VERSION_KEY: str(job.status.version),
+            POD_GROUP_KEY: job.meta.name,
+        }
+        tid = trace.gang_trace(job.meta)
+        if tid:
+            # the pod carries the gang trace so bind (scheduler) and the
+            # Ready flip (kubelet) can join it
+            annotations[trace.TRACE_ID_KEY] = tid
         pod = Pod(
             meta=Metadata(
                 name=make_pod_name(job.meta.name, task.name, index),
                 namespace=job.meta.namespace,
                 owner=("Job", job.meta.name),
-                annotations={
-                    TASK_SPEC_KEY: task.name,
-                    JOB_NAME_KEY: job.meta.name,
-                    JOB_VERSION_KEY: str(job.status.version),
-                    POD_GROUP_KEY: job.meta.name,
-                },
+                annotations=annotations,
                 labels={
                     TASK_SPEC_KEY: task.name,
                     JOB_NAME_KEY: job.meta.name,

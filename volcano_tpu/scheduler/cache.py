@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from volcano_tpu import trace
 from volcano_tpu.api.job import POD_GROUP_KEY
 from volcano_tpu.api.objects import Pod, PodGroup, Metadata
 from volcano_tpu.api.types import PodGroupPhase
@@ -553,6 +554,41 @@ class SchedulerCache:
 
     # -- side effects --------------------------------------------------------
 
+    def _trace_bind(self, key: str, hostname: str, pod=None,
+                    published: bool = False) -> None:
+        """Armed-only forensics at the bind decision: a zero-duration
+        ``scheduler.bind`` span joining the pod's gang trace (the
+        ``volcano.sh/trace-id`` annotation stamped at ``vtctl job run``),
+        plus the reference-parity first-seen→bind latency series.
+        ``published=True`` marks the async-applier paths, where the span
+        records the DECISION at publish time (the same semantics as
+        bind_log) — the store write may still fail and retry.  Callers
+        guard with ``trace.TRACER is not None`` so the disarmed hot path
+        never reaches this; armed bulk paths pay one store read per bind
+        (the pod annotations are not in the decision arrays)."""
+        import time as _time
+
+        from volcano_tpu.scheduler import metrics
+
+        if pod is None:
+            try:
+                pod = self.store.get("Pod", key)
+            except Exception:  # noqa: BLE001 — forensics never breaks a bind
+                pod = None
+        if pod is None:
+            return
+        created = pod.meta.creation_timestamp
+        if created:
+            metrics.update_pod_e2e_latency((_time.time() - created) * 1e3)
+        tid = pod.meta.annotations.get(trace.TRACE_ID_KEY, "")
+        if tid:
+            # marker span: the decision instant, in the gang's own trace
+            attrs = {"task": key, "node": hostname}
+            if published:
+                attrs["published"] = True
+            with trace.span("scheduler.bind", trace_id=tid, **attrs):
+                pass
+
     def bind(self, task: TaskInfo, hostname: str) -> None:
         from volcano_tpu import events
 
@@ -563,6 +599,9 @@ class SchedulerCache:
             # cycle via the fresh snapshot.
             self.applier.submit_bind(task.key, hostname)
             self.bind_log.append((task.key, hostname))
+            if trace.TRACER is not None:
+                self._trace_bind(task.key, hostname,
+                                 getattr(task, "pod", None), published=True)
             return
         try:
             self.binder.bind(task, hostname)
@@ -573,6 +612,8 @@ class SchedulerCache:
             self._record_err("bind", task.key, e)
             return
         self.bind_log.append((task.key, hostname))
+        if trace.TRACER is not None:
+            self._trace_bind(task.key, hostname, getattr(task, "pod", None))
         # "Scheduled" event, cache.go:443 — the bind itself succeeded, so
         # an event-write failure must not unwind the cycle either
         try:
@@ -595,6 +636,9 @@ class SchedulerCache:
         if self.applier is not None:
             self.applier.submit_binds(binds)
             self.bind_log.extend(binds)
+            if trace.TRACER is not None:
+                for key, hostname in binds:
+                    self._trace_bind(key, hostname, published=True)
             return
         bulk = getattr(self.binder, "bind_bulk", None)
         if bulk is None:
@@ -612,6 +656,8 @@ class SchedulerCache:
                 self._record_err("bind", key, RuntimeError(err))
                 continue
             self.bind_log.append((key, hostname))
+            if trace.TRACER is not None:
+                self._trace_bind(key, hostname)
             try:
                 events.record(
                     self.store, "Pod", key, "Scheduled",

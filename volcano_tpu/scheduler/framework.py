@@ -10,6 +10,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional
 
+from volcano_tpu import trace
 from volcano_tpu.api.objects import PodGroupCondition
 from volcano_tpu.api.types import (
     PodGroupPhase,
@@ -80,7 +81,8 @@ def open_session(cache, tiers: List[Tier]) -> Session:
     clear_volumes = getattr(cache, "clear_session_volumes", None)
     if clear_volumes is not None:
         clear_volumes()
-    cluster = cache.snapshot()
+    with trace.span("session.snapshot"):
+        cluster = cache.snapshot()
     ssn = Session(cache, tiers, cluster)
 
     # JobValid gate (session.go:89-108): invalid jobs get an Unschedulable
@@ -113,7 +115,9 @@ def open_session(cache, tiers: List[Tier]) -> Session:
 
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
-        plugin.on_session_open(ssn)
+        with trace.span("plugin", plugin=plugin.name,
+                        callback="OnSessionOpen"):
+            plugin.on_session_open(ssn)
         metrics.update_plugin_duration(plugin.name, "OnSessionOpen", start)
 
     return ssn
@@ -127,14 +131,17 @@ def close_session(ssn: Session) -> None:
         clear_volumes()
     for plugin in ssn.plugins.values():
         start = time.perf_counter()
-        plugin.on_session_close(ssn)
+        with trace.span("plugin", plugin=plugin.name,
+                        callback="OnSessionClose"):
+            plugin.on_session_close(ssn)
         metrics.update_plugin_duration(plugin.name, "OnSessionClose", start)
 
-    for job in ssn.jobs.values():
-        if job.pod_group is None:
-            continue
-        _update_pod_group_status(ssn, job)
-        ssn.cache.update_job_status(job)
+    with trace.span("session.close"):
+        for job in ssn.jobs.values():
+            if job.pod_group is None:
+                continue
+            _update_pod_group_status(ssn, job)
+            ssn.cache.update_job_status(job)
 
 
 def _update_pod_group_status(ssn: Session, job) -> None:

@@ -66,6 +66,15 @@ def update_task_schedule_duration(duration_s: float) -> None:
     observe("volcano_task_scheduling_latency_microseconds", duration_s * 1e6)
 
 
+def update_pod_e2e_latency(ms: float) -> None:
+    """Reference-parity per-pod e2e latency (metrics.go E2eSchedulingLatency
+    family): pod first seen on the bus (creation) -> bind decision, in
+    milliseconds.  Emitted from the vtrace bind spans (volcano_tpu/trace.py)
+    — populated only while tracing is armed, so the disarmed hot path stays
+    untouched."""
+    observe("volcano_e2e_job_scheduling_latency_milliseconds", ms)
+
+
 def register_schedule_attempt(succeeded: bool) -> None:
     inc("volcano_schedule_attempts_total", result="scheduled" if succeeded else "unschedulable")
 

@@ -8,10 +8,12 @@ reference's collector names) plus /healthz.
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from volcano_tpu import trace
 from volcano_tpu.scheduler import metrics
 
 
@@ -21,6 +23,12 @@ class _Handler(BaseHTTPRequestHandler):
             body = metrics.expose_text().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path.startswith("/debug/trace"):
+            # the daemon's live flight recorder (volcano_tpu/trace.py) —
+            # every component carrying a MetricsServer serves its ring
+            body = json.dumps(trace.debug_payload()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
         elif self.path == "/healthz":
             body = b"ok\n"
             self.send_response(200)

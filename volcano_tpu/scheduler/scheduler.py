@@ -12,6 +12,7 @@ from typing import Optional
 
 import volcano_tpu.scheduler.actions  # noqa: F401  (registers actions)
 import volcano_tpu.scheduler.plugins  # noqa: F401  (registers plugins)
+from volcano_tpu import trace
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.scheduler.cache import SchedulerCache
 from volcano_tpu.scheduler.conf import SchedulerConf, default_conf, load_conf
@@ -48,6 +49,21 @@ def enable_persistent_compilation_cache(
         return path
     except Exception:  # jax absent or too old: schedule without the cache
         return None
+
+
+def _session_traces(ssn) -> list:
+    """Trace ids carried by the session's gangs (PodGroup annotations,
+    stamped at ``vtctl job run`` and propagated by the controller) —
+    the cycle span links them so one gang's trace can reconstruct the
+    whole cycle that scheduled it.  Armed-only; callers guard."""
+    out = set()
+    for job in ssn.jobs.values():
+        pg = job.pod_group
+        if pg is not None:
+            tid = pg.meta.annotations.get(trace.TRACE_ID_KEY, "")
+            if tid:
+                out.add(tid)
+    return sorted(out)
 
 
 class Scheduler:
@@ -506,9 +522,32 @@ class Scheduler:
 
     def _run_once_inner(self) -> None:
         start = time.perf_counter()
-        if self.fast_cycle is not None and self.fast_cycle.try_run():
-            metrics.update_e2e_duration(start)
-            return
+        if self.fast_cycle is not None:
+            with trace.span("scheduler.cycle", path="fast") as cyc:
+                ran = self.fast_cycle.try_run()
+                if trace.TRACER is not None:
+                    # the fast cycle's own phase breakdown (bench.py's
+                    # per-phase keys), folded into the span for forensics
+                    cyc.annotate(completed=ran, **{
+                        f"phase.{k}": round(v, 6)
+                        for k, v in (self.fast_cycle.phases or {}).items()
+                    })
+                    if ran:
+                        # armed-only gang linking: the mirror keeps arrays,
+                        # not annotations, so read the (few) PodGroups back
+                        try:
+                            cyc.link(*sorted(
+                                tid for tid in (
+                                    pg.meta.annotations.get(
+                                        trace.TRACE_ID_KEY, "")
+                                    for pg in self.cache.store.list("PodGroup")
+                                ) if tid
+                            ))
+                        except Exception as e:  # noqa: BLE001 — forensics
+                            cyc.annotate(link_error=repr(e))
+            if ran:
+                metrics.update_e2e_duration(start)
+                return
         if self.fast_cycle is not None and self.cache.applier is not None:
             # whole-cycle object fallback: previous fast cycles' async
             # decisions (binds, status patches, conditional enqueue
@@ -541,15 +580,21 @@ class Scheduler:
         """One object-path pass: open a session (with the configured tensor
         backend attached), execute ``names`` in order, close. Used for the
         full cycle."""
-        ssn = self._open_object_session()
-        for name in names:
-            action = get_action(name)
-            if action is None:
-                continue
-            action_start = time.perf_counter()
-            action.execute(ssn)
-            metrics.update_action_duration(name, action_start)
-        close_session(ssn)
+        with trace.span("scheduler.cycle", path="object") as cyc:
+            ssn = self._open_object_session()
+            if trace.TRACER is not None:
+                # the cycle serves every gang at once; LINK each traced
+                # gang so its trace can reconstruct this cycle's span tree
+                cyc.link(*_session_traces(ssn))
+            for name in names:
+                action = get_action(name)
+                if action is None:
+                    continue
+                action_start = time.perf_counter()
+                with trace.span("action", action=name):
+                    action.execute(ssn)
+                metrics.update_action_duration(name, action_start)
+            close_session(ssn)
 
     def run_object_residue(self, residue_keys, run_preempt: bool) -> None:
         """The fast cycle's object sub-cycle: host allocate+backfill scoped
@@ -557,10 +602,16 @@ class Scheduler:
         then optionally the full preempt action, in one session that sees
         the fast cycle's published binds through the in-flight overlay.
         close_session owns this cycle's PodGroup status writes."""
+        with trace.span("scheduler.residue") as sub:
+            self._run_object_residue(sub, residue_keys, run_preempt)
+
+    def _run_object_residue(self, sub, residue_keys, run_preempt) -> None:
         from volcano_tpu.scheduler.actions.allocate import AllocateAction
         from volcano_tpu.scheduler.actions.backfill import BackfillAction
 
         ssn = self._open_object_session()
+        if trace.TRACER is not None:
+            sub.link(*_session_traces(ssn))
         if residue_keys:
             def in_residue(job):
                 if job.pod_group is not None:
@@ -573,16 +624,19 @@ class Scheduler:
 
             if "allocate" in self.conf.actions:
                 t0 = time.perf_counter()
-                AllocateAction()._execute_host(ssn, job_filter=in_residue)
+                with trace.span("action", action="allocate", residue=True):
+                    AllocateAction()._execute_host(ssn, job_filter=in_residue)
                 metrics.update_action_duration("allocate", t0)
             if "backfill" in self.conf.actions:
                 t0 = time.perf_counter()
-                BackfillAction().execute(ssn, job_filter=in_residue)
+                with trace.span("action", action="backfill", residue=True):
+                    BackfillAction().execute(ssn, job_filter=in_residue)
                 metrics.update_action_duration("backfill", t0)
         if run_preempt:
             action = get_action("preempt")
             if action is not None:
                 t0 = time.perf_counter()
-                action.execute(ssn)
+                with trace.span("action", action="preempt"):
+                    action.execute(ssn)
                 metrics.update_action_duration("preempt", t0)
         close_session(ssn)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 from typing import List, Tuple
 
+from volcano_tpu import trace
 from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.scheduler.model import TaskInfo
 from volcano_tpu.scheduler.session import Event, Session
@@ -81,17 +82,19 @@ class Statement:
                 eh.deallocate_func(Event(task))
 
     def discard(self) -> None:
-        for name, task, _ in reversed(self.operations):
-            if name == "evict":
-                self._unevict(task)
-            else:
-                self._unpipeline(task)
+        with trace.span("statement.discard", ops=len(self.operations)):
+            for name, task, _ in reversed(self.operations):
+                if name == "evict":
+                    self._unevict(task)
+                else:
+                    self._unpipeline(task)
         self.operations.clear()
         self._settle()
 
     def commit(self) -> None:
-        for name, task, reason in self.operations:
-            if name == "evict":
-                self.ssn.cache.evict(task, reason)
+        with trace.span("statement.commit", ops=len(self.operations)):
+            for name, task, reason in self.operations:
+                if name == "evict":
+                    self.ssn.cache.evict(task, reason)
         self.operations.clear()
         self._settle()

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from volcano_tpu import trace
 from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.scheduler.cache import VolumeBindingError
 
@@ -524,7 +525,10 @@ def jax_allocate_solve(backend, snap, n_pending=None):
         jnp.float32(w_least),
         jnp.float32(w_balanced),
     )
-    flat = np.asarray(out)  # ONE device->host fetch for all four outputs
+    # device phase timed at the ONE block-until-ready boundary — never
+    # inside the jit body (the vtlint trace-span-discipline contract)
+    with trace.span("device.allocate_solve", batch=use_batch):
+        flat = np.asarray(out)  # ONE device->host fetch for all four outputs
     T = snap.task_req.shape[0]
     J = snap.job_queue.shape[0]
     return (
@@ -646,7 +650,9 @@ def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
         jnp.float32(w_least),
         jnp.float32(w_balanced),
     )
-    flat = np.asarray(out)
+    # same block-until-ready boundary discipline as the express solve
+    with trace.span("device.dynamic_solve", batch=use_batch):
+        flat = np.asarray(out)
     T = dyn["task_req"].shape[0]
     J = snap.job_queue.shape[0]
     return (

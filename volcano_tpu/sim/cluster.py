@@ -177,6 +177,8 @@ class Cluster:
         """One pass of the simulated kubelets over all pods — and over
         Provisioning elastic nodes, which flip Ready once the sim clock
         passes their provision delay (elastic/lifecycle.py)."""
+        from volcano_tpu import trace
+
         changed = False
         for pod in self.store.items("Pod"):
             if pod.deleting:
@@ -186,6 +188,15 @@ class Cluster:
                 pod.phase = PodPhase.RUNNING
                 self.store.update("Pod", pod)
                 changed = True
+                if trace.TRACER is not None:
+                    tid = trace.gang_trace(pod.meta)
+                    if tid:
+                        # the sim IS the kubelet in local mode: the Ready
+                        # flip joins the gang's trace here too
+                        with trace.span("kubelet.ready", trace_id=tid,
+                                        pod=pod.meta.key,
+                                        node=pod.node_name):
+                            pass
         if self.elastic is not None:
             from volcano_tpu.elastic import kubelet_provisioning_step
 

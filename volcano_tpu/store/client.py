@@ -25,6 +25,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 from urllib.parse import quote
 
+from volcano_tpu import trace
 from volcano_tpu.admission import AdmissionError
 from volcano_tpu.chaos import FaultPlan, env_plan
 from volcano_tpu.store.codec import decode_object, encode, encode_fields
@@ -98,6 +99,12 @@ class RemoteStore:
     def _request(self, method: str, path: str, payload: Optional[dict] = None):
         data = json.dumps(payload).encode() if payload is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
+        if trace.TRACER is not None:
+            # cross-daemon propagation: the active span context rides the
+            # request so the server's request span continues this trace
+            tid, sid = trace.current()
+            if tid:
+                headers[trace.HEADER] = trace.format_header(tid, sid)
         # idempotent verbs (GET: get/list/watch poll) retry ONCE on a
         # connection cut before surfacing the transient — the reference's
         # client-go does the same for safe verbs.  Mutations never retry

@@ -30,6 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
+from volcano_tpu import trace
 from volcano_tpu.chaos import ChaosPlanError, FaultPlan, env_plan
 from volcano_tpu.locksan import make_lock, make_rlock
 from volcano_tpu.store.codec import (
@@ -43,6 +44,38 @@ from volcano_tpu.store.store import PreconditionFailed, Store
 #: cap on buffered events; a client further behind than this must relist
 #: (the reference's "resourceVersion too old" watch error)
 LOG_CAP = 100_000
+
+
+def _traced(verb: str):
+    """Continue the client's ``X-Volcano-Trace`` context around one
+    request verb: the request span parents to the caller's span across
+    the process boundary.  Disarmed = one attribute check per request
+    (the chaos-guard discipline); the ``/chaos`` and ``/debug/trace``
+    admin endpoints are never traced (reading the flight recorder must
+    not write to it)."""
+
+    def deco(fn):
+        def handler(self):
+            if trace.TRACER is None:
+                return fn(self)
+            path = self.path
+            if path.startswith("/chaos") or path.startswith("/debug/trace"):
+                return fn(self)
+            header = self.headers.get(trace.HEADER, "")
+            if not header:
+                # an uncontexted request (steady-state polling) would root
+                # a pointless single-span trace per poll and churn the
+                # ring out from under the gang spans operators care about
+                return fn(self)
+            trace.set_component("apiserver")
+            with trace.request_context(
+                header, f"store.{verb}", path=path.split("?", 1)[0],
+            ):
+                return fn(self)
+
+        return handler
+
+    return deco
 
 
 class StoreServer:
@@ -181,12 +214,17 @@ class StoreServer:
                     return True
                 return False
 
+            @_traced("GET")
             def do_GET(self):
                 u = urlparse(self.path)
                 q = parse_qs(u.query)
                 parts = [p for p in u.path.split("/") if p]
                 if u.path == "/chaos":  # admin: always exempt from injection
                     return self._reply(200, server.chaos_status())
+                if u.path == "/debug/trace":
+                    # flight-recorder admin endpoint: exempt from chaos
+                    # (forensics must work mid-storm) and never traced
+                    return self._reply(200, trace.debug_payload())
                 chaos_plan = server.chaos
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
                     return
@@ -221,6 +259,7 @@ class StoreServer:
                     return self._reply(200, {"object": encode(obj)})
                 return self._reply(404, {"error": f"no route {u.path}"})
 
+            @_traced("POST")
             def do_POST(self):
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
@@ -252,6 +291,7 @@ class StoreServer:
                     return self._reply(code, payload)
                 return self._reply(404, {"error": "no route"})
 
+            @_traced("PATCH")
             def do_PATCH(self):
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
@@ -274,6 +314,7 @@ class StoreServer:
                     return self._reply(code, payload)
                 return self._reply(404, {"error": "no route"})
 
+            @_traced("PUT")
             def do_PUT(self):
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
@@ -295,6 +336,7 @@ class StoreServer:
                     return self._reply(code, payload)
                 return self._reply(404, {"error": "no route"})
 
+            @_traced("DELETE")
             def do_DELETE(self):
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
