@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench native examples install clean images image image-tpu lint sanitize chaos elastic trace
+.PHONY: test e2e parity bench bench-residue native examples install clean images image image-tpu lint sanitize chaos elastic trace
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -56,6 +56,13 @@ parity:
 
 bench:
 	$(PY) bench.py
+
+# the host-residue cliff (BASELINE.md r5: 64.6 s / 500 volume tasks):
+# cfg5v runs config 5 + 500/2000 volume-constrained gangs through the
+# device volume solve (volsolve.py) with the vectorized residue engine
+# (scheduler/residue.py) behind it; parity in tests/test_volume_parity.py
+bench-residue:
+	$(PY) bench.py --config 9
 
 # container images (reference Makefile:40-48 / installer/dockerfile/):
 # `image` = CPU-jax control plane, `image-tpu` = jax[tpu]+libtpu wheel

@@ -1,13 +1,15 @@
-"""Benchmarks for the five BASELINE.md target configs.
+"""Benchmarks for the BASELINE.md target configs.
 
-Default (no arguments): the HEADLINE SUITE — all four headline configs,
+Default (no arguments): the HEADLINE SUITE — the five headline configs,
 one compact JSON line each, in this order:
   cfg5   e2e_schedule_cycle_100k_tasks_10k_nodes   (best-of-2 full runs)
   cfg5d  cfg5d_e2e_cycle_10pct_dynamic_predicates  (1 run)
+  cfg5v  cfg5v_e2e_cycle_volume_constrained        (500 + 2000 vol tasks)
   cfg6   cfg6_contended_preempt_storm_100k_x_10k   (storm only, no cfg6b)
   cfg7   e2e_http_schedule_cycle_100k_tasks_10k_nodes
 so one driver invocation captures the plain, dynamic-predicate,
-contended, and HTTP-process-model numbers (~4 min total on a v5e; a
+volume-constrained, contended, and HTTP-process-model numbers (~5 min
+total on a v5e; a
 failed config prints an {"metric": ..., "error": ...} line and the suite
 continues, rc stays 0).  Each line reports
   {"metric": ..., "value": run_once_seconds, "unit": "s", "vs_baseline": x}
@@ -220,7 +222,7 @@ def kernel_cycle():
           int((np.asarray(out[1]) > 0).sum()))
 
 
-def _build_e2e_store(n_best_effort=2000, dynamic_frac=0.0):
+def _build_e2e_store(n_best_effort=2000, dynamic_frac=0.0, volume_tasks=0):
     """Real Store at bench scale: 10k nodes, 5k gang jobs x 20 tasks
     (100k), plus best-effort tasks for backfill. Capacity covers demand so
     the pipeline's preempt/reclaim passes correctly find no starving work
@@ -231,10 +233,21 @@ def _build_e2e_store(n_best_effort=2000, dynamic_frac=0.0):
     self-anti-affinity gangs (48 shared labels) — exercising the device
     dynamic solve at scale (VERDICT r4 missing #1).  Best-effort pods
     attach only to non-dynamic jobs (a BE pod of a dynamic job routes
-    the job through the host residue path by design)."""
+    the job through the host residue path by design).
+
+    ``volume_tasks``: that many extra VOLUME-CONSTRAINED tasks (20-task
+    gangs, tiny requests) — alternating bound-PVC-pinned gangs (the
+    claim's PV carries single-node affinity, so the gang must colocate)
+    and static-class gangs (one shared WaitForFirstConsumer claim per
+    gang drawing from a node-pinned PV pool, exercising the attach-
+    capacity tensor).  The r5 residue path paid ~0.13 s/task x nodes for
+    exactly this class (BASELINE.md host-residue cost curve)."""
     from volcano_tpu.api import POD_GROUP_KEY, Resource
     from volcano_tpu.api.objects import (
         Affinity, Metadata, Node, Pod, PodGroup, PodSpec, Queue,
+    )
+    from volcano_tpu.api.objects import (
+        PersistentVolume, PersistentVolumeClaim, StorageClass,
     )
     from volcano_tpu.api.types import PodGroupPhase
     from volcano_tpu.store import Store
@@ -290,6 +303,47 @@ def _build_e2e_store(n_best_effort=2000, dynamic_frac=0.0):
                 meta=Metadata(name=f"be{j:05d}", namespace="default",
                               annotations=dict(ann)),
                 spec=PodSpec(image="bench", resources=Resource())))
+    n_vol_jobs = volume_tasks // tasks_per_job
+    if n_vol_jobs:
+        store.create("StorageClass", StorageClass(
+            meta=Metadata(name="volb", namespace=""), provisioner=""))
+        for v in range(n_vol_jobs):
+            pin = f"n{(v * 97) % N_NODES:05d}"
+            if v % 2 == 0:
+                # bound-PVC gang: the claim's PV pins the whole gang
+                store.create("PV", PersistentVolume(
+                    meta=Metadata(name=f"vpv{v:04d}", namespace=""),
+                    capacity="50Gi", storage_class="net",
+                    node_affinity={"kubernetes.io/hostname": pin},
+                    claim_ref=f"default/vc{v:04d}"))
+                store.create("PVC", PersistentVolumeClaim(
+                    meta=Metadata(name=f"vc{v:04d}", namespace="default"),
+                    size="5Gi", storage_class="net",
+                    volume_name=f"vpv{v:04d}", phase="Bound"))
+            else:
+                # static-class gang: one pending claim per gang, drawing
+                # from the shared node-pinned pool (attach-capacity tensor)
+                store.create("PV", PersistentVolume(
+                    meta=Metadata(name=f"vpv{v:04d}", namespace=""),
+                    capacity="50Gi", storage_class="volb",
+                    node_affinity={"kubernetes.io/hostname": pin}))
+                store.create("PVC", PersistentVolumeClaim(
+                    meta=Metadata(name=f"vc{v:04d}", namespace="default"),
+                    size="5Gi", storage_class="volb"))
+            pg = PodGroup(
+                meta=Metadata(name=f"vol{v:04d}", namespace="default"),
+                min_member=tasks_per_job, queue=f"q{v % N_QUEUES}")
+            pg.status.phase = PodGroupPhase.PENDING
+            store.create("PodGroup", pg)
+            ann = {POD_GROUP_KEY: f"vol{v:04d}"}
+            for t in range(tasks_per_job):
+                pod = Pod(
+                    meta=Metadata(name=f"v{v:04d}-{t}", namespace="default",
+                                  annotations=dict(ann)),
+                    spec=PodSpec(image="bench",
+                                 resources=Resource(100.0, 64 * (1 << 20))))
+                pod.volumes = [f"vc{v:04d}"]
+                store.create("Pod", pod)
     return store
 
 
@@ -521,6 +575,57 @@ def config5(reps=3, dynamic_frac=0.0,
     }))
 
 
+def config5_volumes(sizes=(500, 2000)):
+    """cfg5v: config 5 plus volume-constrained gangs — the r5 host-
+    residue cost cliff (64.6 s for 500 volume-constrained tasks, 316.6 s
+    for 2,000; BASELINE.md).  The device volume solve (claim feasible-
+    node bitsets + the attach-capacity tensor in the exact allocate
+    kernel, volsolve.py) now serves the count-expressible shapes after
+    the express pass, with publish-time allocate/bind as validation.
+    Targets: 500 tasks < 2 s, 2,000 < 5 s, placements bit-for-bit equal
+    to the host oracle (tests/test_volume_parity.py).  One headline line:
+    value = the 500-task cycle; the 2,000-task cycle and both phase
+    breakdowns (incl. the vol_solve phase) ride in extra."""
+    from volcano_tpu.scheduler.conf import full_conf
+
+    conf = full_conf("tpu")
+    conf.apply_mode = "async"
+    runs = {}
+    for n_vol in sizes:
+        runs[n_vol] = _e2e_run(
+            _build_e2e_store(volume_tasks=n_vol), conf
+        )
+    import jax
+
+    head = runs[sizes[0]]
+    payload = {
+        "metric": "cfg5v_e2e_cycle_volume_constrained",
+        "value": round(head["publish"], 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SECONDS / head["publish"], 1),
+        "extra": {
+            "vol_tasks": sizes[0],
+            "pods_bound": head["bound"],
+            "phases_s": head["phases"],
+            "async_drain_s": round(head["drain"], 2),
+            "steady_cycle_s": round(head["steady"], 4),
+            "prewarm_s": round(head["warm"], 1),
+            "path": "fastpath" if head["fastpath"] else "object",
+            "r5_host_residue_s": {"500": 64.6, "2000": 316.6},
+            "device": str(jax.devices()[0]),
+            **{
+                f"cycle_{n}_s": round(r["publish"], 4)
+                for n, r in runs.items() if n != sizes[0]
+            },
+            **{
+                f"phases_{n}_s": r["phases"]
+                for n, r in runs.items() if n != sizes[0]
+            },
+        },
+    }
+    print(json.dumps(payload))
+
+
 def config5_dynamic(reps=3):
     """Config 5 with 10% of the jobs carrying resident-state predicates
     (host-port gangs + self-anti-affinity gangs, ~10k dynamic tasks): the
@@ -628,7 +733,7 @@ def config7():
 
 
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config5_dynamic}
+           6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes}
 
 
 def default_suite():
@@ -642,6 +747,8 @@ def default_suite():
          lambda: config5(reps=2)),
         ("cfg5d_e2e_cycle_10pct_dynamic_predicates",
          lambda: config5_dynamic(reps=1)),
+        ("cfg5v_e2e_cycle_volume_constrained",
+         config5_volumes),
         ("cfg6_contended_preempt_storm_100k_x_10k",
          lambda: config6(include_best_effort=False)),
         ("e2e_http_schedule_cycle_100k_tasks_10k_nodes",

@@ -97,7 +97,10 @@ def test_ports_affinity_parity_randomized(seed):
 
 def test_expressible_jobs_skip_residue_subcycle(monkeypatch):
     """A ports/affinity job no longer pays the object residue sub-cycle
-    (the device solve serves it); a volume-carrying job still does."""
+    (the device solve serves it), and since r6 neither does a
+    non-constraining volume (no PVC object — emptyDir-style); only a
+    count-INEXPRESSIBLE claim shape (here a static class whose pool
+    mixes a node-pinned and a network PV) still does."""
     calls = []
 
     def spy(self, residue_keys, run_preempt):
@@ -116,11 +119,35 @@ def test_expressible_jobs_skip_residue_subcycle(monkeypatch):
 
     store2 = _random_store(3)
     v = build_pod("vol", group="pg-vol", cpu="1", memory="1Gi")
-    v.volumes = ["claim-a"]
+    v.volumes = ["claim-a"]  # no PVC object: non-constraining, express
     store2.create("PodGroup", build_podgroup("pg-vol", min_member=1))
     store2.create("Pod", v)
     _run(store2, "tpu")
-    assert calls and "default/pg-vol" in calls[0]
+    assert calls == []
+
+    from volcano_tpu.api.objects import (
+        Metadata, PersistentVolume, PersistentVolumeClaim, StorageClass,
+    )
+
+    store3 = _random_store(3)
+    store3.create("StorageClass", StorageClass(
+        meta=Metadata(name="local", namespace=""), provisioner=""))
+    store3.create("PV", PersistentVolume(
+        meta=Metadata(name="pinned", namespace=""), capacity="20Gi",
+        storage_class="local",
+        node_affinity={"kubernetes.io/hostname": "n01"}))
+    store3.create("PV", PersistentVolume(
+        meta=Metadata(name="floating", namespace=""), capacity="20Gi",
+        storage_class="local"))
+    store3.create("PVC", PersistentVolumeClaim(
+        meta=Metadata(name="claim-b", namespace="default"), size="5Gi",
+        storage_class="local"))
+    w = build_pod("vol2", group="pg-vol2", cpu="1", memory="1Gi")
+    w.volumes = ["claim-b"]
+    store3.create("PodGroup", build_podgroup("pg-vol2", min_member=1))
+    store3.create("Pod", w)
+    _run(store3, "tpu")
+    assert calls and "default/pg-vol2" in calls[0]
 
 
 def test_self_anti_affinity_spreads_within_cycle():

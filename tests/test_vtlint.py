@@ -713,6 +713,68 @@ def test_lock_rules_scan_elastic_modules(tmp_path):
     """, select=["lock-order"]) == []
 
 
+# --- rule: residue-vectorized ------------------------------------------------
+
+
+def test_residue_vectorized_fires_on_per_task_node_scan(tmp_path):
+    findings = _lint(tmp_path, "residue.py", """
+        def host_allocate(tasks, nodes):
+            for t in tasks:
+                for n in nodes:
+                    if fits(t, n):
+                        place(t, n)
+                        break
+    """, select=["residue-vectorized"])
+    assert _rules_of(findings) == ["residue-vectorized"]
+
+
+def test_residue_vectorized_fires_through_wrappers_and_while(tmp_path):
+    # enumerate(all_nodes) under a while loop is still the per-task scan
+    findings = _lint(tmp_path, "tensor_actions.py", """
+        def residue(queue, all_nodes):
+            while queue:
+                t = queue.pop()
+                for i, n in enumerate(all_nodes):
+                    score(t, n)
+    """, select=["residue-vectorized"])
+    assert _rules_of(findings) == ["residue-vectorized"]
+    # ssn.nodes.values() inside a task loop too
+    findings = _lint(tmp_path, "residue.py", """
+        def walk(ssn, tasks):
+            for t in tasks:
+                for n in ssn.nodes.values():
+                    probe(t, n)
+    """, select=["residue-vectorized"])
+    assert _rules_of(findings) == ["residue-vectorized"]
+
+
+def test_residue_vectorized_near_misses_stay_quiet(tmp_path):
+    # a single depth-zero node sweep is the engine's amortized setup
+    assert _lint(tmp_path, "residue.py", """
+        def build_masks(nodes):
+            out = []
+            for n in nodes:
+                out.append(n.labels)
+            return out
+    """, select=["residue-vectorized"]) == []
+    # hierarchical residents walk: outer over nodes, inner over that
+    # node's OWN tasks — linear, and the inner iter is not node-ish
+    assert _lint(tmp_path, "residue.py", """
+        def sweep(nodes):
+            for n in nodes:
+                for t in n.tasks.values():
+                    note(t)
+    """, select=["residue-vectorized"]) == []
+    # identical per-task scan OUTSIDE the module set (the oracle loop in
+    # actions/allocate.py) is deliberately exempt
+    assert _lint(tmp_path, "allocate.py", """
+        def oracle(tasks, nodes):
+            for t in tasks:
+                for n in nodes:
+                    fits(t, n)
+    """, select=["residue-vectorized"]) == []
+
+
 # --- rule: trace-span-discipline --------------------------------------------
 
 

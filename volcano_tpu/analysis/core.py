@@ -94,6 +94,7 @@ def _load_rule_modules() -> None:
         rules_hotpath,
         rules_parity,
         rules_registry,
+        rules_residue,
         rules_retry,
         rules_statement,
         rules_trace,

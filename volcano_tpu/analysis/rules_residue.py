@@ -1,0 +1,111 @@
+"""residue-vectorized: the host-residue cliff must not regress.
+
+BASELINE.md r5 measured the old residue sub-cycle at ~0.13 s/task — a
+per-task Python scan over every node (64.6 s for 500 volume-constrained
+tasks at 10k nodes).  r6 replaced it with the vectorized engine
+(scheduler/residue.py: one batched numpy step per task) and the device
+volume solve; this rule keeps the cliff from silently coming back.
+
+In the residue module set (``residue.py``, ``tensor_actions.py``) a
+``for`` loop over a node collection (``nodes``/``all_nodes``/
+``node_list``/``feasible``/``ssn.nodes``/``get_node_list(...)`` —
+including through ``enumerate``/``list``/``sorted`` wrappers) may appear
+only at loop-nesting depth zero: a single O(N) sweep (mask building,
+array assembly) is the vectorized engine's amortized setup, but the same
+loop nested inside ANY enclosing ``for``/``while`` is the per-task node
+scan — O(tasks x nodes) interpreter time on the path whose entire reason
+to exist is not paying it.  The oracle per-task loop lives in
+``actions/allocate.py``, deliberately outside this set: parity suites
+need an unvectorized reference to measure against.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from volcano_tpu.analysis.core import FileContext, Finding, dotted_name, rule
+
+_SCOPED_BASENAMES = {"residue.py", "tensor_actions.py"}
+
+_NODEISH_NAMES = {"nodes", "all_nodes", "node_list", "feasible",
+                  "feasible_nodes"}
+_WRAPPERS = {"enumerate", "list", "sorted", "reversed", "tuple"}
+
+
+def _nodeish(expr: ast.AST) -> Optional[str]:
+    """The node-collection spelling an iterable expression resolves to,
+    or None.  Sees through enumerate()/list()/sorted() wrappers and
+    ``.values()``/``.items()`` calls; matches bare names, ``*.nodes``
+    attributes, and ``get_node_list(...)`` calls."""
+    cur = expr
+    while isinstance(cur, ast.Call):
+        fname = dotted_name(cur.func)
+        if fname in _WRAPPERS and cur.args:
+            cur = cur.args[0]
+            continue
+        if fname is not None and fname.split(".")[-1] == "get_node_list":
+            return fname
+        if isinstance(cur.func, ast.Attribute) and cur.func.attr in (
+            "values", "items", "keys",
+        ):
+            cur = cur.func.value
+            continue
+        return None
+    if isinstance(cur, ast.Name) and cur.id in _NODEISH_NAMES:
+        return cur.id
+    if isinstance(cur, ast.Attribute) and cur.attr in _NODEISH_NAMES:
+        return dotted_name(cur) or cur.attr
+    return None
+
+
+@rule(
+    "residue-vectorized",
+    "per-task `for ... in nodes` Python loop in the residue/tensor-action "
+    "module set — the O(tasks x nodes) host-residue cliff (0.13 s/task at "
+    "10k nodes, BASELINE.md r5) these modules exist to eliminate; "
+    "vectorize over the node axis or hoist the sweep to depth zero",
+)
+def check_residue_vectorized(ctx: FileContext) -> Iterable[Finding]:
+    if ctx.basename not in _SCOPED_BASENAMES:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # walk this function's own statements (nested defs get their own
+        # visit), tracking loop depth: a node-ish For at depth > 0 is the
+        # per-task scan
+        nested = {
+            id(sub)
+            for f in ast.walk(fn)
+            if f is not fn
+            and isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            for sub in ast.walk(f)
+        }
+
+        def visit(node: ast.AST, depth: int):
+            for child in ast.iter_child_nodes(node):
+                if id(child) in nested:
+                    continue
+                if isinstance(child, (ast.For, ast.AsyncFor)):
+                    spelled = _nodeish(child.iter)
+                    if spelled is not None and depth > 0:
+                        yield ctx.finding(
+                            "residue-vectorized",
+                            child,
+                            f"loop over {spelled!r} nested inside another "
+                            "loop: this is the per-task node scan the "
+                            "vectorized residue engine replaces — batch "
+                            "the node axis with numpy instead",
+                        )
+                    yield from visit(child, depth + 1)
+                elif isinstance(child, ast.While):
+                    yield from visit(child, depth + 1)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                else:
+                    yield from visit(child, depth)
+
+        yield from visit(fn, 0)

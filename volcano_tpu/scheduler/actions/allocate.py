@@ -44,6 +44,124 @@ def _fit_failure_reason(task, node) -> str:
     return "; ".join(dims) or "insufficient resources"
 
 
+def fit_first_predicate_fn(ssn):
+    """Allocate's per-node check: resource fit first — idle OR releasing
+    (allocate.go:78-93) — then the session predicate chain.  ONE
+    definition shared by the per-task oracle loop below and the
+    vectorized residue engine (scheduler/residue.py), so the two paths'
+    unschedulable-head reason histograms can never drift apart."""
+
+    def predicate_fn(task, node):
+        if not (
+            task.init_resreq.less_equal(node.idle)
+            or task.init_resreq.less_equal(node.releasing)
+        ):
+            return _fit_failure_reason(task, node)
+        return ssn.predicate_fn(task, node)
+
+    return predicate_fn
+
+
+def allocate_loop(ssn: Session, job_filter, inner) -> None:
+    """The allocate action's queue/job/task selection skeleton
+    (allocate.go:44-193) — ONE definition shared by the per-task oracle
+    loop below and the vectorized residue engine (scheduler/residue.py),
+    so a loop-shape change can never silently break their bit-for-bit
+    parity contract; only the per-task ``inner`` step differs.
+
+    Ordering note: the reference holds queues/jobs in lazy binary heaps
+    whose comparisons see mutating DRF/proportion shares only at sift
+    time, so its pop order is a stale approximation of the share
+    ordering.  Both inner steps here re-select the exact best queue/job
+    each iteration instead — same loop, exact ordering (first-minimum on
+    ties, matching the kernel's argmin).
+
+    ``inner(job, task) -> bool``: place one task with every session side
+    effect (allocate/pipeline/fit-delta/fit-error bookkeeping); False
+    means the head task had no feasible node — the job drops for this
+    cycle (allocate.go:151)."""
+    jobs_by_queue = {}
+
+    for job in sorted(ssn.jobs.values(), key=lambda j: j.creation_order):
+        if (
+            job.pod_group is not None
+            and job.pod_group.status.phase == PodGroupPhase.PENDING
+        ):
+            continue
+        if job_filter is not None and not job_filter(job):
+            continue
+        queue = ssn.queues.get(job.queue)
+        if queue is None:
+            continue
+        jobs_by_queue.setdefault(queue.uid, []).append(job)
+
+    pending_tasks = {}
+    dropped_queues = set()
+    queue_order = sorted(ssn.queues.values(), key=lambda q: q.uid)
+
+    def job_tasks(job):
+        if job.uid not in pending_tasks:
+            tasks = PriorityQueue(ssn.task_order_fn)
+            for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+                if task.resreq.is_empty():
+                    continue  # BestEffort handled by backfill
+                tasks.push(task)
+            pending_tasks[job.uid] = tasks
+        return pending_tasks[job.uid]
+
+    def first_min(items, less):
+        best = None
+        for x in items:
+            if best is None or less(x, best):
+                best = x
+        return best
+
+    # drained jobs are pruned from jobs_by_queue as they're discovered so
+    # re-selection cost shrinks as the cycle progresses
+    cur_job = None
+    while True:
+        if cur_job is None:
+            for q_uid, jobs in list(jobs_by_queue.items()):
+                live = [j for j in jobs if not job_tasks(j).empty()]
+                if live:
+                    jobs_by_queue[q_uid] = live
+                else:
+                    del jobs_by_queue[q_uid]
+            candidates = [
+                q
+                for q in queue_order
+                if q.uid not in dropped_queues and jobs_by_queue.get(q.uid)
+            ]
+            if not candidates:
+                break
+            queue = first_min(candidates, ssn.queue_order_fn)
+            if ssn.overused(queue):
+                dropped_queues.add(queue.uid)
+                continue
+            cur_job = first_min(jobs_by_queue[queue.uid], ssn.job_order_fn)
+            continue
+
+        job = cur_job
+        tasks = job_tasks(job)
+        task = tasks.pop()
+
+        if job.nodes_fit_delta:
+            job.nodes_fit_delta = {}
+
+        if not inner(job, task):
+            # head task unschedulable: drop the job for this cycle
+            jobs_by_queue[job.queue] = [
+                j for j in jobs_by_queue.get(job.queue, ()) if j.uid != job.uid
+            ]
+            if not jobs_by_queue[job.queue]:
+                del jobs_by_queue[job.queue]
+            cur_job = None
+            continue
+
+        if ssn.job_ready(job) or tasks.empty():
+            cur_job = None
+
+
 class AllocateAction(Action):
     name = "allocate"
 
@@ -55,107 +173,37 @@ class AllocateAction(Action):
             return
         self._execute_host(ssn)
 
-    def _execute_host(self, ssn: Session, job_filter=None) -> None:
-        # Ordering note: the reference holds queues/jobs in lazy binary heaps
-        # whose comparisons see mutating DRF/proportion shares only at sift
-        # time, so its pop order is a stale approximation of the share
-        # ordering. Both backends here re-select the exact best queue/job
-        # each iteration instead — same loop, exact ordering (first-minimum
-        # on ties, matching the kernel's argmin).
+    def _execute_host(self, ssn: Session, job_filter=None,
+                      vectorized=None, stats=None) -> None:
         # ``job_filter`` restricts the pass to a job subset — the dynamic-
         # predicate residue after a device solve (tensor_actions.allocate).
-        jobs_by_queue = {}
+        # Residue passes take the VECTORIZED engine (scheduler/residue.py:
+        # the same allocate_loop, batched numpy inner step, bit-for-bit
+        # placements — the r6 fix for the 0.13 s/task host-residue
+        # cliff); the UNFILTERED pass keeps this per-task inner step as
+        # the parity oracle.  ``vectorized`` forces the choice (tests);
+        # ``stats`` collects {"tasks", "seconds"} from the engine for the
+        # residue_vec phase.
+        if vectorized is None:
+            vectorized = job_filter is not None
+        if vectorized:
+            from volcano_tpu.scheduler import residue
 
-        for job in sorted(ssn.jobs.values(), key=lambda j: j.creation_order):
-            if (
-                job.pod_group is not None
-                and job.pod_group.status.phase == PodGroupPhase.PENDING
-            ):
-                continue
-            if job_filter is not None and not job_filter(job):
-                continue
-            queue = ssn.queues.get(job.queue)
-            if queue is None:
-                continue
-            jobs_by_queue.setdefault(queue.uid, []).append(job)
-
-        pending_tasks = {}
+            if residue.vector_allocate(ssn, job_filter, stats=stats):
+                return
         all_nodes = util.get_node_list(ssn.nodes)
-        dropped_queues = set()
-        queue_order = sorted(ssn.queues.values(), key=lambda q: q.uid)
+        predicate_fn = fit_first_predicate_fn(ssn)
 
-        def predicate_fn(task, node):
-            # resource fit first (allocate.go:78-93): idle OR releasing
-            if not (
-                task.init_resreq.less_equal(node.idle)
-                or task.init_resreq.less_equal(node.releasing)
-            ):
-                return _fit_failure_reason(task, node)
-            return ssn.predicate_fn(task, node)
-
-        def job_tasks(job):
-            if job.uid not in pending_tasks:
-                tasks = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
-                    if task.resreq.is_empty():
-                        continue  # BestEffort handled by backfill
-                    tasks.push(task)
-                pending_tasks[job.uid] = tasks
-            return pending_tasks[job.uid]
-
-        def first_min(items, less):
-            best = None
-            for x in items:
-                if best is None or less(x, best):
-                    best = x
-            return best
-
-        # drained jobs are pruned from jobs_by_queue as they're discovered so
-        # re-selection cost shrinks as the cycle progresses
-        cur_job = None
-        while True:
-            if cur_job is None:
-                for q_uid, jobs in list(jobs_by_queue.items()):
-                    live = [j for j in jobs if not job_tasks(j).empty()]
-                    if live:
-                        jobs_by_queue[q_uid] = live
-                    else:
-                        del jobs_by_queue[q_uid]
-                candidates = [
-                    q
-                    for q in queue_order
-                    if q.uid not in dropped_queues and jobs_by_queue.get(q.uid)
-                ]
-                if not candidates:
-                    break
-                queue = first_min(candidates, ssn.queue_order_fn)
-                if ssn.overused(queue):
-                    dropped_queues.add(queue.uid)
-                    continue
-                cur_job = first_min(jobs_by_queue[queue.uid], ssn.job_order_fn)
-                continue
-
-            job = cur_job
-            tasks = job_tasks(job)
-            task = tasks.pop()
-
-            if job.nodes_fit_delta:
-                job.nodes_fit_delta = {}
-
+        def inner(job, task):
             reasons: dict = {}
-            feasible = util.predicate_nodes(task, all_nodes, predicate_fn, reasons)
+            feasible = util.predicate_nodes(
+                task, all_nodes, predicate_fn, reasons
+            )
             if not feasible:
-                # head task unschedulable: record the reason histogram for
-                # fit_error() reporting and drop the job for this cycle
+                # record the reason histogram for fit_error() reporting
                 job.fit_errors = reasons
                 job.fit_total_nodes = len(all_nodes)
-                jobs_by_queue[job.queue] = [
-                    j for j in jobs_by_queue.get(job.queue, ()) if j.uid != job.uid
-                ]
-                if not jobs_by_queue[job.queue]:
-                    del jobs_by_queue[job.queue]
-                cur_job = None
-                continue
+                return False
 
             scores = util.prioritize_nodes(task, feasible, ssn.node_order_fn)
             node = util.select_best_node(scores)
@@ -176,6 +224,6 @@ class AllocateAction(Action):
                 job.fit_total_nodes = len(all_nodes)
                 if task.init_resreq.less_equal(node.releasing):
                     ssn.pipeline(task, node.name)
+            return True
 
-            if ssn.job_ready(job) or tasks.empty():
-                cur_job = None
+        allocate_loop(ssn, job_filter, inner)

@@ -204,6 +204,16 @@ class ArrayMirror:
         # (p_dyn_expr), in which case the device dynamic solve serves it
         self.p_dynamic = np.zeros((0,), bool)
         self.p_dyn_expr = np.zeros((0,), bool)
+        # claim-referencing pods (pod.volumes non-empty): their volume
+        # verdict — express / device volume solve / residue — is computed
+        # once per CYCLE from store PVC/PV/StorageClass state
+        # (volsolve.py), not per event: volume objects carry no watch
+        # handlers here, so an ingest-time verdict could go stale
+        self.p_has_vol = np.zeros((0,), bool)
+        #: row -> pod object, kept only for claim-referencing pods: the
+        #: cycle classifier and publish-time allocate/bind validation need
+        #: pod.volumes + metadata without a per-pod store round trip
+        self.vol_pod_objs: Dict[int, object] = {}
         # conformance veto (plugins/conformance.py): False for
         # system-critical / kube-system pods — victim pool input for the
         # fast preempt/reclaim passes (fast_victims.py)
@@ -712,24 +722,22 @@ class ArrayMirror:
     @staticmethod
     def _pod_dynamic(pod) -> bool:
         """Resident-state-dependent predicates the class system cannot
-        express (host ports, pod (anti)affinity, volumes) — node selector,
-        node affinity, and tolerations are static and factor into classes,
+        express (host ports, pod (anti)affinity) — node selector, node
+        affinity, and tolerations are static and factor into classes,
         exactly as on the object tensor path (snapshot.py:415-426).
 
-        Intentional over-approximation vs that path: ANY volume marks the
-        pod dynamic here, while the object builder only excludes jobs
-        whose volumes actually constrain node choice
-        (volume_constrains).  Correctness-safe — over-routing sends more
-        jobs through the exact-host residue sub-cycle — at the cost of
-        fast-path coverage for non-constraining volume types; the two
-        paths' partition_unsafe guards can therefore disagree on the same
-        cluster."""
+        Volumes are NOT a dynamic marker here anymore: claim-referencing
+        pods flag ``p_has_vol`` instead, and build_fast_snapshot resolves
+        their verdict once per cycle through volsolve.py — only pods whose
+        claims actually constrain node choice (the object builder's
+        ``volume_constrains`` discipline) leave the express path, so
+        emptyDir/configMap-style and dynamic-class volumes no longer
+        forfeit it."""
         spec = pod.spec
         aff = spec.affinity
         return bool(
             spec.host_ports
             or (aff is not None and (aff.pod_affinity or aff.pod_anti_affinity))
-            or pod.volumes
         )
 
     #: class-count backstop: key churn from long-gone pods eventually
@@ -838,6 +846,7 @@ class ArrayMirror:
         self.p_rv = _grow(self.p_rv, n)
         self.p_dynamic = _grow(self.p_dynamic, n)
         self.p_dyn_expr = _grow(self.p_dyn_expr, n)
+        self.p_has_vol = _grow(self.p_has_vol, n)
         self.p_evictable = _grow(self.p_evictable, n)
         self.p_class = _grow(self.p_class, n)
         self.p_ports = _grow(self.p_ports, n)
@@ -913,6 +922,11 @@ class ArrayMirror:
             self._shadow_ref(old_j, -1)
         self.p_best_effort[row] = resreq.is_empty()
         self.p_dynamic[row] = self._pod_dynamic(pod)
+        self.p_has_vol[row] = bool(pod.volumes)
+        # a reused row's previous occupant must not leak its pod object
+        self.vol_pod_objs.pop(row, None)
+        if pod.volumes:
+            self.vol_pod_objs[row] = pod
         # port/selector bit rows + expressibility (fills p_ports/p_selmatch/
         # p_aff_*; labels recorded first so selector backfill sees them)
         labels = pod.meta.labels or {}
@@ -948,11 +962,11 @@ class ArrayMirror:
         self.p_selmatch[row] = sm_row
         self.p_aff_req[row] = req_row
         self.p_aff_anti[row] = anti_row
-        # expressible-dynamic: ports/affinity interned, no volumes (the
-        # volume_constrains machinery stays host-side)
-        self.p_dyn_expr[row] = (
-            self.p_dynamic[row] and expr_ok and not pod.volumes
-        )
+        # expressible-dynamic: ports/affinity interned.  Volume
+        # expressibility is orthogonal and per-cycle (volsolve.py) — a
+        # claim-referencing pod's verdict joins the partition at snapshot
+        # build, not here
+        self.p_dyn_expr[row] = self.p_dynamic[row] and expr_ok
         self.p_evictable[row] = not (
             pod.spec.priority_class
             in ("system-cluster-critical", "system-node-critical")
@@ -972,6 +986,7 @@ class ArrayMirror:
             self.p_live[row] = False
             self._sub_contrib(row)
             self.p_labels[row] = None
+            self.vol_pod_objs.pop(row, None)
             self._shadow_ref(int(self.p_job[row]), -1)
 
     def _del_pod(self, pod) -> None:
@@ -988,7 +1003,7 @@ class ArrayMirror:
     # -- checkpoint (warm-restart prewarm, VERDICT r4 next #5) ---------------
 
     #: checkpoint format version; bump on any row-table layout change
-    _CKPT_VERSION = 1
+    _CKPT_VERSION = 2  # r6: p_has_vol column + vol_pod_objs map
     #: attributes that must not serialize (live handles)
     _CKPT_SKIP = ("store", "_watches")
 
@@ -1426,8 +1441,17 @@ def build_dyn_solve_inputs(m: ArrayMirror, snap: TensorSnapshot, aux: dict,
 
     sched_mask = np.zeros(J, bool)
     sched_mask[:n_jobs] = dyn_expr[:n_jobs]
+    # volume payload (volsolve.py): packed feasible-node bitsets + the
+    # attach-capacity tensor for the routed tasks; None when no routed
+    # task carries device volume state, so port/affinity-only waves keep
+    # their existing (volsel-free) kernel specialization
+    volsel = None
+    vp = aux.get("volume_partition")
+    if vp is not None:
+        volsel = vp.payload(rows, ta["task_req"].shape[0], N)
     return {
         "rows": rows,
+        "volsel": volsel,
         "task_req": ta["task_req"], "task_job": ta["task_job"],
         "task_class": ta["task_class"], "task_valid": ta["task_valid"],
         "class_mask": ta["class_mask"], "class_score": ta["class_score"],
@@ -1444,8 +1468,20 @@ def build_dyn_solve_inputs(m: ArrayMirror, snap: TensorSnapshot, aux: dict,
     }
 
 
+def _residue_counts(residue_reason_job: Dict[int, str],
+                    pend_any_per_job: np.ndarray, n_jobs: int) -> Dict[str, int]:
+    """Pending-task totals per residue reason class (the
+    volcano_residue_tasks_total increments for this cycle)."""
+    counts: Dict[str, int] = {}
+    for j, reason in residue_reason_job.items():
+        if j < n_jobs:
+            counts[reason] = counts.get(reason, 0) + int(pend_any_per_job[j])
+    return counts
+
+
 def build_fast_snapshot(
     m: ArrayMirror, nodeaffinity_weight: float = 1.0,
+    dyn_batch: Optional[Tuple[str, int]] = None,
 ) -> Tuple[Optional[TensorSnapshot], dict]:
     """Vectorized TensorSnapshot from the mirror — semantics identical to
     snapshot.build_tensor_snapshot on the same store (asserted by
@@ -1590,26 +1626,81 @@ def build_fast_snapshot(
             pod_j[rd_rows], minlength=n_jobs
         ).astype(np.int32)[:n_jobs]
 
+    # -- volume verdicts (volsolve.py) ---------------------------------------
+    # once per cycle, and only when claim-referencing pending pods exist
+    # (volume-free clusters do zero work here and grow no vol_solve
+    # phase): each referenced claim interns to a feasible-node bitset +
+    # attach-capacity group, each pod to express / device / residue
+    vol_dev = None
+    vol_res_mask = None
+    vol_res_reason: Dict[int, str] = {}
+    volume_partition = None
+    vol_solve_s = 0.0
+    vol_rows = np.nonzero(pend_all & m.p_has_vol[:P])[0]
+    if vol_rows.size:
+        t0v = time.perf_counter()
+        from volcano_tpu.scheduler.volsolve import (
+            RESIDUE as _VOL_RESIDUE, VolumeCycleIndex, VolumePartition,
+        )
+
+        vidx = VolumeCycleIndex(
+            m.store, [m.node_objs[r] for r in node_rows], n_live_ct
+        )
+        volume_partition = VolumePartition(vidx)
+        for r in vol_rows:
+            pod = m.vol_pod_objs.get(int(r))
+            if pod is None:
+                continue
+            ns = pod.meta.namespace
+            volume_partition.classify_task(
+                int(r), [f"{ns}/{name}" for name in pod.volumes]
+            )
+        vol_dev = np.zeros(P, bool)
+        vol_res_mask = np.zeros(P, bool)
+        for r in vol_rows:
+            tv = volume_partition.task_volumes.get(int(r))
+            if tv is None:
+                continue
+            if tv.verdict == "device":
+                vol_dev[r] = True
+            elif tv.verdict == _VOL_RESIDUE:
+                vol_res_mask[r] = True
+                vol_res_reason[int(r)] = tv.reason
+        vol_solve_s = time.perf_counter() - t0v
+
     # -- dynamic-job partition (snapshot.py:414-436) -------------------------
     # a job with any live PENDING resident-state pod (host ports, pod
-    # (anti)affinity, volumes) is excluded WHOLE from the array solve.
-    # Jobs whose dynamic pending pods are ALL port/selector-expressible
-    # and non-best-effort run the DEVICE dynamic solve after the express
-    # pass (dyn_expr_job); the rest go to the host residue sub-cycle
-    # (within-job task order intact, gang atomicity preserved).  Resident
-    # dynamic pods need no exclusion: their usage is plain resources and
-    # express pods carry no resident-state predicates of their own.
+    # (anti)affinity, constraining volumes) is excluded WHOLE from the
+    # array solve.  Jobs whose dynamic pending pods are ALL
+    # port/selector/volume-expressible and non-best-effort run the DEVICE
+    # dynamic solve after the express pass (dyn_expr_job); the rest go to
+    # the host residue sub-cycle (within-job task order intact, gang
+    # atomicity preserved).  Resident dynamic pods need no exclusion:
+    # their usage is plain resources and express pods carry no
+    # resident-state predicates of their own.
     nJ = max(n_jobs, 1)
     dyn_job = np.zeros(nJ, bool)
-    dyn_rows = np.nonzero(pend_all & m.p_dynamic[:P])[0]
+    dyn_pod_mask = pend_all & m.p_dynamic[:P]
+    if vol_dev is not None:
+        dyn_pod_mask = dyn_pod_mask | (pend_all & (vol_dev | vol_res_mask))
+    dyn_rows = np.nonzero(dyn_pod_mask)[0]
     if dyn_rows.size and n_jobs:
         dyn_job[np.unique(pod_j[dyn_rows])] = True
     resid_job = np.zeros(nJ, bool)
+    residue_reason_job: Dict[int, str] = {}
     if dyn_rows.size and n_jobs:
-        # non-expressible (volumes / intern-cap overflow) dynamic pods
-        # force the host path for their whole job
-        nonexpr = dyn_rows[~m.p_dyn_expr[dyn_rows]]
+        # non-expressible dynamic pods (inexpressible volume shapes /
+        # intern-cap overflow) force the host path for their whole job
+        nonexpr_row = m.p_dynamic[:P] & ~m.p_dyn_expr[:P]
+        if vol_res_mask is not None:
+            nonexpr_row = nonexpr_row | vol_res_mask
+        nonexpr = dyn_rows[nonexpr_row[dyn_rows]]
         if nonexpr.size:
+            for r in nonexpr:
+                j = int(pod_j[r])
+                residue_reason_job.setdefault(
+                    j, vol_res_reason.get(int(r), "intern-overflow")
+                )
             resid_job[np.unique(pod_j[nonexpr])] = True
         # so does ANY pending best-effort pod of a dynamic job: its
         # backfill needs resident-state predicates and the device dynamic
@@ -1617,8 +1708,49 @@ def build_fast_snapshot(
         be_pend = np.nonzero(pend_all & m.p_best_effort[:P])[0]
         if be_pend.size:
             be_j = np.unique(pod_j[be_pend])
+            for j in be_j[dyn_job[be_j]]:
+                residue_reason_job.setdefault(int(j), "best-effort")
             resid_job[be_j[dyn_job[be_j]]] = True
+    if volume_partition is not None:
+        # claim-group contention closure (volsolve.py owns the
+        # invariant): jobs sharing a capacity group with any residue-
+        # classed claimant join the residue transitively
+        row_job = {
+            int(r): int(pod_j[r])
+            for r in vol_rows if 0 <= int(pod_j[r]) < nJ
+        }
+        resid_set = set(np.nonzero(resid_job)[0].tolist())
+        for j, why in volume_partition.demote_contended_jobs(
+            row_job, resid_set
+        ).items():
+            resid_job[j] = True
+            residue_reason_job.setdefault(j, why)
     dyn_expr_job = dyn_job & ~resid_job
+    # batch-wave demotion: volume state (volsel) forces the dynamic solve
+    # onto the exact sequential kernel, so a batch-scale port/affinity
+    # wave sharing the cycle with volume gangs would regress from the
+    # batched-rounds kernel (~0.1 s at 10k tasks) to ~0.3 ms/step — the
+    # r4 storm lesson.  When the dyn-expr wave would pick the batched
+    # variant (``dyn_batch`` = (solve_mode, batch_threshold)), the
+    # volume-device jobs step aside to the VECTORIZED residue engine
+    # (low-ms/task) and the wave keeps its kernel.
+    if (
+        dyn_batch is not None and vol_dev is not None
+        and dyn_batch[0] != "exact"
+    ):
+        vol_dev_job = np.zeros(nJ, bool)
+        vd_rows = np.nonzero(pend_all & vol_dev)[0]
+        if vd_rows.size and n_jobs:
+            vol_dev_job[np.unique(pod_j[vd_rows])] = True
+        cand = vol_dev_job & dyn_expr_job
+        if cand.any():
+            nbr = np.nonzero(pend_all & ~m.p_best_effort[:P])[0]
+            wave = int(dyn_expr_job[pod_j[nbr]].sum()) if nbr.size else 0
+            if dyn_batch[0] == "batch" or wave > dyn_batch[1]:
+                for j in np.nonzero(cand)[0]:
+                    resid_job[j] = True
+                    residue_reason_job.setdefault(int(j), "batch-wave")
+                dyn_expr_job = dyn_job & ~resid_job
     # job-order safety (snapshot.py:581-586): a dynamic job outranking an
     # express job in its queue would be served AFTER it by the device-first
     # partition — priority inversion under contention; the caller must take
@@ -1752,6 +1884,22 @@ def build_fast_snapshot(
             m.jobs.row_key[job_rows[j]]
             for j in np.nonzero(resid_job[:n_jobs])[0]
         },
+        # why each residue job took the slow class (feeds the
+        # volcano_residue_tasks_total counter + the cycle span annotation)
+        "residue_reasons": {
+            m.jobs.row_key[job_rows[j]]: reason
+            for j, reason in residue_reason_job.items()
+            if j < n_jobs
+        },
+        # pending tasks entering the slow class this cycle, by reason
+        "residue_task_counts": _residue_counts(
+            residue_reason_job, pend_any_per_job, n_jobs
+        ),
+        # per-cycle volume interning (volsolve.py): the dyn-solve payload
+        # builder and publish validation read it; None on volume-free
+        # cycles so they pay nothing
+        "volume_partition": volume_partition,
+        "vol_solve_s": vol_solve_s,
     }
     return snap, aux
 
@@ -1815,6 +1963,13 @@ class FastCycle:
         self.phases: Dict[str, float] = {}
         self._err_seen = 0
         self._last_unsched: Dict[str, str] = {}
+        # pg key -> reason class for jobs the LAST cycle routed to the
+        # residue (trace annotation + explainability surface)
+        self.last_residue_reasons: Dict[str, str] = {}
+        # filled by scheduler.run_object_residue when the vectorized
+        # residue engine served the sub-cycle: {"tasks": n, "seconds": s}
+        self.residue_stats: Dict[str, float] = {}
+        self._vol_session_cleared = False
         # pg key -> (phase, running, failed, succeeded, unsched msg): the
         # last status this scheduler wrote, to suppress no-op patches
         self._status_fp: Dict[str, tuple] = {}
@@ -1864,6 +2019,8 @@ class FastCycle:
             )
         m = self.mirror
         ph = self.phases = {}
+        self.residue_stats = {}
+        self._vol_session_cleared = False
         t = time.perf_counter()
         m.drain()
         self._reconcile_failures(m)
@@ -1871,10 +2028,20 @@ class FastCycle:
         if m.ineligible_reason() is not None:
             return False
         t = time.perf_counter()
-        snap, aux = build_fast_snapshot(m, self.nodeaffinity_weight)
+        snap, aux = build_fast_snapshot(
+            m, self.nodeaffinity_weight,
+            dyn_batch=(self.conf.solve_mode, self.probe.batch_threshold),
+        )
         ph["snapshot"] = time.perf_counter() - t
         if snap is None:
             return False
+        if aux.get("vol_solve_s"):
+            # claim interning + verdicts (volsolve.py), carved out of the
+            # snapshot figure so a volume-heavy cycle self-localizes; the
+            # phase only appears when volume pods were actually pending
+            ph["vol_solve"] = aux["vol_solve_s"]
+            ph["snapshot"] -= aux["vol_solve_s"]
+        self.last_residue_reasons = dict(aux.get("residue_reasons", {}))
         if aux["partition_unsafe"]:
             # a dynamic job outranks an express contender in its queue:
             # device-first residue would invert priority under contention
@@ -2087,6 +2254,8 @@ class FastCycle:
             # the sub-cycle's close_session reads STORE phases: admissions
             # must land first
             self._ship_enqueue_ops(enq_ops)
+            for cls_name, n in aux.get("residue_task_counts", {}).items():
+                metrics.register_residue_tasks(cls_name, n)
         t = time.perf_counter()
         try:
             evicts, ready_status = self._collect_contention(m, snap, aux, cont)
@@ -2130,6 +2299,10 @@ class FastCycle:
             finally:
                 self.cache.cycle_overlay = {}
                 ph["subcycle"] = time.perf_counter() - t
+                # the vectorized residue engine's share of the sub-cycle
+                # (scheduler.run_object_residue records it on us)
+                if self.residue_stats.get("seconds"):
+                    ph["residue_vec"] = self.residue_stats["seconds"]
         return True
 
     def _make_contention(self, snap, aux):
@@ -2554,6 +2727,7 @@ class FastCycle:
         if pub_express.size:
             prows = pe_rows[pub_express]
             nidx = task_node[pub_express]
+            prows, nidx = self._volume_bind_filter(m, prows, nidx, names)
             m.p_status[prows] = _BOUND
             m.p_node[prows] = node_rows[nidx]
             binds.extend(
@@ -2563,6 +2737,10 @@ class FastCycle:
         if be_rows.size:
             keep = gang_ready[pod_j[be_rows]]
             pub_be, pub_be_nodes = be_rows[keep], be_nodes[keep]
+            if pub_be.size:
+                pub_be, pub_be_nodes = self._volume_bind_filter(
+                    m, pub_be, pub_be_nodes, names
+                )
             if pub_be.size:
                 m.p_status[pub_be] = _BOUND
                 m.p_node[pub_be] = node_rows[pub_be_nodes]
@@ -2720,6 +2898,44 @@ class FastCycle:
                                 RuntimeError(err),
                             )
         return binds
+
+    def _volume_bind_filter(self, m, prows, nidx, names):
+        """allocate_volumes + bind_volumes for published binds of claim-
+        referencing pods — VALIDATION, not placement: the solve already
+        chose the nodes (device volume bitsets / express non-constraining
+        claims), so this is where dynamic-class claims provision their PV
+        and static assumptions commit.  A concurrent store writer (PV
+        vanished, claim re-bound under the solve) surfaces as the
+        existing ``VolumeBindingError`` race: the bind is dropped, the
+        pod stays pending in mirror and store, and next cycle retries —
+        the same handling as the object paths' replay/bulk apply.
+        Volume-free cycles exit on one vectorized check."""
+        hasv = m.p_has_vol[prows]
+        if not hasv.any():
+            return prows, nidx
+        from volcano_tpu.scheduler.cache import VolumeBindingError
+        from volcano_tpu.scheduler.model import TaskInfo
+
+        if not self._vol_session_cleared:
+            # fresh per-cycle binder view (claims/PV lists are
+            # session-cached); the flag resets each try_run
+            self.cache.clear_session_volumes()
+            self._vol_session_cleared = True
+        keep = np.ones(prows.size, bool)
+        for i in np.nonzero(hasv)[0]:
+            pod = m.vol_pod_objs.get(int(prows[i]))
+            if pod is None or not pod.volumes:
+                continue
+            task = TaskInfo(pod)
+            try:
+                self.cache.allocate_volumes(task, names[int(nidx[i])])
+                self.cache.bind_volumes(task)
+            except VolumeBindingError as e:
+                self.cache._record_err("bind_volumes", pod.meta.key, e)
+                keep[i] = False
+        if keep.all():
+            return prows, nidx
+        return prows[keep], nidx[keep]
 
     def _fit_errors(self, snap, aux, task_node, task_kind, unready,
                     task_req_solve=None):

@@ -136,6 +136,13 @@ class AllocState(NamedTuple):
     # and interpod score walking node.tasks
     node_ports: jnp.ndarray
     node_selcnt: jnp.ndarray
+    # volume solve state ([1]/[1, 1] dummies when the volsel extension is
+    # off): per-claim assumed node (-1 = unassumed — the device analogue
+    # of the VolumeBinder assume-cache) and the per-(storageclass, node)
+    # attach-capacity tensor, decremented as claims assume volumes so
+    # claim contention resolves in-solve like the host's _assumed_pvs
+    claim_node: jnp.ndarray    # [C] i32
+    vol_cap: jnp.ndarray       # [G, N] i32
 
 
 def _lex_argmin(mask, keys, index):
@@ -201,6 +208,21 @@ def allocate_solve(
     # affinity score term (nodeorder.py:61-74, +1/-1 per resident match,
     # weighted w_podaff); placements fold their own ports/labels in
     portsel=None,
+    # optional volume extension (volsolve.py): (task_volmask_w [T, NW] u32
+    # packed feasible-node bitsets — bound-PV reachability, unpacked
+    # per-task on device; task_claims [T, C] bool membership in interned
+    # pending-static claims; claim_group [C] i32 -> capacity row;
+    # group_cap [G, N] i32 Available-un-assumed PV counts per node;
+    # group_global [G] bool — affinity-free pools decrement every node's
+    # count, single-node-pinned pools only the taken node's).  Feasibility
+    # ANDs the bitset and, per claim: un-assumed -> capacity > 0 at the
+    # node; assumed -> the assumed node only (single-node pools) or
+    # anywhere (global pools) — the host _resolve_claim rule.  Placement
+    # records first assumptions in claim_node and decrements group_cap.
+    # Sequential solve only: the count state is inherently ordered, and
+    # volume waves are residue-scale (the batched-rounds path never
+    # carries volsel — jax_dynamic_solve forces the exact kernel).
+    volsel=None,
     # plugin config (static): job_key_order is the tier-ordered tuple of
     # job-order contributors, e.g. ("priority", "gang", "drf") — mirrors
     # Session.job_order_fn's tier traversal with enable flags applied
@@ -298,6 +320,26 @@ def allocate_solve(
                 ~matched | (t_anti[None, :] == 0), axis=1
             )
             feasible = feasible & ports_ok & req_ok & anti_ok
+        if volsel is not None:
+            shifts32 = jnp.arange(32, dtype=jnp.uint32)
+            vm_words = volsel[0][t]                       # [NW] u32
+            vmask = (
+                ((vm_words[:, None] >> shifts32) & 1)
+                .astype(bool).reshape(-1)[:N]
+            )
+            claims_t = volsel[1][t]                       # [C] bool
+            grp = volsel[2]                               # [C] i32
+            gglob = volsel[4][grp]                        # [C] bool
+            assumed = s.claim_node >= 0
+            cap_ok = s.vol_cap[grp] > 0                   # [C, N]
+            nidx = jnp.arange(N, dtype=jnp.int32)
+            claim_ok = jnp.where(
+                assumed[:, None],
+                gglob[:, None] | (nidx[None, :] == s.claim_node[:, None]),
+                cap_ok,
+            )
+            vol_ok = ~jnp.any(claims_t[:, None] & ~claim_ok, axis=0)
+            feasible = feasible & vmask & vol_ok
         any_feasible = jnp.any(feasible)
 
         def drop_job(s):
@@ -361,6 +403,24 @@ def allocate_solve(
                     s.node_ports[n] | portsel[1][t]
                 )
                 upd["node_selcnt"] = s.node_selcnt.at[n].add(portsel[5][t])
+            if volsel is not None:
+                # first ALLOCATE of each claim assumes a volume here: the
+                # claim pins to this node (single-node pools) and the
+                # class's capacity row decrements — globally for network
+                # pools, at this node for pinned ones.  PIPELINED
+                # (releasing-fit) placements assume NOTHING: the host
+                # oracle's ssn.pipeline never calls allocate_volumes
+                # (session.py), so neither may the device state
+                newly = volsel[1][t] & (s.claim_node < 0) & use_idle
+                Gn = s.vol_cap.shape[0]
+                cnt = jax.ops.segment_sum(
+                    newly.astype(jnp.int32), volsel[2], num_segments=Gn
+                )
+                glob = volsel[4]
+                cap2 = s.vol_cap - jnp.where(glob[:, None], cnt[:, None], 0)
+                cap2 = cap2.at[:, n].add(-jnp.where(glob, 0, cnt))
+                upd["claim_node"] = jnp.where(newly, n, s.claim_node)
+                upd["vol_cap"] = cap2
             return s._replace(**upd)
 
         return jax.lax.cond(any_feasible, place, drop_job, s)
@@ -391,6 +451,14 @@ def allocate_solve(
         node_selcnt=(
             portsel[2] if portsel is not None
             else jnp.zeros((1, 1), jnp.float32)
+        ),
+        claim_node=(
+            jnp.full((volsel[1].shape[1],), -1, jnp.int32)
+            if volsel is not None else jnp.zeros((1,), jnp.int32)
+        ),
+        vol_cap=(
+            volsel[3] if volsel is not None
+            else jnp.zeros((1, 1), jnp.int32)
         ),
     )
     final = jax.lax.while_loop(cond, body, init)

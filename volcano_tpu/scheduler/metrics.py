@@ -99,6 +99,20 @@ def register_job_retry(job: str) -> None:
     inc("volcano_job_retry_counts", job_id=job)
 
 
+def register_residue_tasks(cls: str, count: int) -> None:
+    """Tasks the fast cycle routed to the host residue (slow) class this
+    cycle, labeled by WHY: ``volume-shape`` (count-inexpressible claim
+    pools), ``volume-claim-cap`` (claim intern overflow),
+    ``intern-overflow`` (port/selector bitset caps), ``best-effort``
+    (empty-request pods of dynamic jobs), ``contended-claims`` (capacity
+    group shared with a residue job), ``batch-wave`` (volume jobs
+    stepping aside so a batch-scale port/affinity wave keeps the
+    batched-rounds kernel).  Monotone counter — `vtctl
+    describe job` / operators read it to explain why a pod took the slow
+    path."""
+    inc("volcano_residue_tasks_total", float(count), **{"class": cls})
+
+
 # -- elastic autoscaler series (volcano_tpu/elastic/) -------------------------
 
 def update_pool_size(pool: str, size: int) -> None:

@@ -199,7 +199,10 @@ class Scheduler:
                 _TiersOnly, build_fast_snapshot, build_victim_pool,
             )
 
-            snap, aux = build_fast_snapshot(fc.mirror, fc.nodeaffinity_weight)
+            snap, aux = build_fast_snapshot(
+                fc.mirror, fc.nodeaffinity_weight,
+                dyn_batch=(self.conf.solve_mode, fc.probe.batch_threshold),
+            )
             if snap is not None and aux.get("partition_unsafe"):
                 # every real cycle will take the object path (dynamic job
                 # outranks an express contender): its snapshot includes
@@ -532,6 +535,16 @@ class Scheduler:
                         f"phase.{k}": round(v, 6)
                         for k, v in (self.fast_cycle.phases or {}).items()
                     })
+                    reasons = self.fast_cycle.last_residue_reasons
+                    if reasons:
+                        # which gangs took the slow class and why — the
+                        # span-side twin of volcano_residue_tasks_total
+                        cyc.annotate(
+                            residue_jobs=len(reasons),
+                            residue_classes=",".join(
+                                sorted(set(reasons.values()))
+                            ),
+                        )
                     if ran:
                         # armed-only gang linking: the mirror keeps arrays,
                         # not annotations, so read the (few) PodGroups back
@@ -624,8 +637,17 @@ class Scheduler:
 
             if "allocate" in self.conf.actions:
                 t0 = time.perf_counter()
+                stats = (
+                    self.fast_cycle.residue_stats
+                    if self.fast_cycle is not None else None
+                )
                 with trace.span("action", action="allocate", residue=True):
-                    AllocateAction()._execute_host(ssn, job_filter=in_residue)
+                    # residue allocate runs the vectorized engine
+                    # (scheduler/residue.py); its share of the sub-cycle
+                    # surfaces as the cycle's residue_vec phase
+                    AllocateAction()._execute_host(
+                        ssn, job_filter=in_residue, stats=stats
+                    )
                 metrics.update_action_duration("allocate", t0)
             if "backfill" in self.conf.actions:
                 t0 = time.perf_counter()

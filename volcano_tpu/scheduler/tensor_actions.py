@@ -554,8 +554,16 @@ def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
 
     if n_pending is None:
         n_pending = int(dyn["task_valid"].sum())
-    use_batch = backend.solve_mode == "batch" or (
-        backend.solve_mode == "auto" and n_pending > backend.batch_threshold
+    # volume state (volsel) is inherently ordered — claim assumptions and
+    # capacity decrements replay the host binder's sequential
+    # assume-cache — so it always takes the exact kernel; volume waves
+    # are residue-scale (hundreds to low thousands), not storm-scale
+    has_vol = dyn.get("volsel") is not None
+    use_batch = not has_vol and (
+        backend.solve_mode == "batch" or (
+            backend.solve_mode == "auto"
+            and n_pending > backend.batch_threshold
+        )
     )
     solve = allocate_solve_batch if use_batch else allocate_solve
     extra = {"exact_topk": backend.exact_topk} if use_batch else {}
@@ -580,13 +588,13 @@ def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
         use_proportion=backend.proportion_queue_order,
         **extra,
     )
-    key = (solve, "dyn_packed", tuple(sorted(statics.items())))
+    key = (solve, "dyn_packed", has_vol, tuple(sorted(statics.items())))
     packed = _PACKED_SOLVES.get(key)
     if packed is None:
         import jax
 
-        def run(node_ports_w, node_selcnt_u16, task_ports_w, aff_w,
-                anti_w, self_w, w_pa, *args):
+        def run(vol_args, node_ports_w, node_selcnt_u16, task_ports_w,
+                aff_w, anti_w, self_w, w_pa, *args):
             # port/selector payloads arrive as PACKED u32 words / u16
             # counts (the tunnel's host->device bandwidth made the
             # unpacked [T, bits] forms the dominant dynamic-pass cost) —
@@ -606,7 +614,16 @@ def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
                 bits(aff_w, jnp.float32), bits(anti_w, jnp.float32),
                 bits(self_w, jnp.float32), w_pa,
             )
-            o = solve(*args, portsel=portsel, **statics)
+            if vol_args:
+                # volume extension: masks stay PACKED u32 on the wire
+                # (the kernel unpacks one task row per step); only the
+                # exact kernel ever receives volsel (has_vol forces it)
+                o = solve(
+                    *args, portsel=portsel, volsel=tuple(vol_args),
+                    **statics,
+                )
+            else:
+                o = solve(*args, portsel=portsel, **statics)
             return jnp.concatenate([
                 o[0].astype(jnp.int32), o[1].astype(jnp.int32),
                 o[2].astype(jnp.int32), o[3].astype(jnp.int32),
@@ -614,7 +631,16 @@ def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
 
         packed = jax.jit(run)
         _PACKED_SOLVES[key] = packed
+    vol_args = ()
+    if has_vol:
+        v = dyn["volsel"]
+        vol_args = (
+            dev(v["task_volmask_w"]), dev(v["task_claims"]),
+            dev(v["claim_group"]), dev(v["group_cap"]),
+            dev(v["group_global"]),
+        )
     out = packed(
+        vol_args,
         dev(dyn["node_ports_w"]),
         dev(dyn["node_selcnt"]),
         dev(dyn["task_ports_w"]),
