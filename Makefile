@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue native examples install clean images image image-tpu lint sanitize chaos elastic trace
+.PHONY: test e2e parity bench bench-residue bench-wire native examples install clean images image image-tpu lint sanitize chaos elastic trace
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -63,6 +63,15 @@ bench:
 # (scheduler/residue.py) behind it; parity in tests/test_volume_parity.py
 bench-residue:
 	$(PY) bench.py --config 9
+
+# the columnar store wire (store/segment.py): cfg7 runs config 5 against
+# the HTTP apiserver in its own OS process — publish + off-cycle drain of
+# 102k binds/Events as ONE segment per cycle, with the per-kind drain
+# breakdown (drain_binds_s / drain_events_s / drain_pg_s) in extra;
+# parity in tests/test_columnar_wire.py, fenced by the columnar-publish
+# lint rule
+bench-wire:
+	$(PY) bench.py --config 7
 
 # container images (reference Makefile:40-48 / installer/dockerfile/):
 # `image` = CPU-jax control plane, `image-tpu` = jax[tpu]+libtpu wheel

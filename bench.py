@@ -695,6 +695,9 @@ def config7():
         while sched.cache.applier.pending > 0:
             time.sleep(0.005)
         drain = time.perf_counter() - t0 - publish
+        # per-kind drain attribution (server-measured segment sections +
+        # client-side op batches) so a wire regression localizes by kind
+        drain_kinds = dict(sched.cache.applier.drain_stats)
         bound = sum(1 for p in remote.items("Pod") if p.node_name)
         sched.run_once()
         t1 = time.perf_counter()
@@ -711,12 +714,18 @@ def config7():
             "extra": {
                 "transport": (
                     "http+json, apiserver in its own OS process "
-                    "(StoreServer / RemoteStore)"
+                    "(StoreServer / RemoteStore); columnar segment "
+                    "publish (store/segment.py)"
                 ),
                 "pods_bound": bound,
                 "pods_per_sec": int(bound / publish),
                 "phases_s": phases,
                 "async_drain_s": round(drain, 2),
+                "drain_binds_s": round(drain_kinds.get("binds_s", 0.0), 3),
+                "drain_events_s": round(drain_kinds.get("events_s", 0.0), 3),
+                "drain_evicts_s": round(drain_kinds.get("evicts_s", 0.0), 3),
+                "drain_pg_s": round(drain_kinds.get("pg_s", 0.0), 3),
+                "drain_wire_s": round(drain_kinds.get("wire_s", 0.0), 3),
                 "steady_cycle_s": round(steady, 4),
                 "prewarm_s": round(warm, 1),
                 "prewarm_bg_s": round(warm_bg, 1),

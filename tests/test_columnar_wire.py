@@ -1,0 +1,593 @@
+"""Columnar store wire (store/segment.py): parity, laziness, atomicity.
+
+The r6 publish path ships a whole cycle as ONE columnar segment and the
+server applies it lazily; everything observable must stay EXACTLY what
+the r5 per-object path produced:
+
+  * segment decode == per-object encode for every kind the segment
+    carries (Pod patch rows, Event rows) — byte-for-byte;
+  * a watch client replaying a columnar-fed log sees byte-identical
+    events to the per-object log (modulo generated event uids,
+    normalized — both runs are otherwise fully controlled);
+  * chaos storms on the segment request (cut_body, truncate_log)
+    converge to fault-free placements with no half-applied segment;
+  * the in-process path still works with columnar publish disabled
+    (``columnarPublish: false`` — the fallback flag smoke).
+"""
+
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from tests.helpers import build_node, build_pod, build_podgroup, make_store
+from volcano_tpu.api import objects as api_objects
+from volcano_tpu.api.objects import Metadata, Queue
+from volcano_tpu.api.types import PodGroupPhase
+from volcano_tpu.events import events_for
+from volcano_tpu.scheduler.cache import SchedulerCache
+from volcano_tpu.scheduler.conf import full_conf
+from volcano_tpu.store import Store
+from volcano_tpu.store.client import RemoteStore
+from volcano_tpu.store.codec import encode
+from volcano_tpu.store.segment import (
+    DecisionSegment,
+    encode_event_row,
+    event_name,
+    materialize_event,
+)
+from volcano_tpu.store.server import StoreServer
+
+
+def _seg(bind_pairs, evicts=(), node_table=None):
+    """Segment from (key, host) pairs — interns hosts like the fast
+    cycle's publish tail does with snap.node_names."""
+    table = list(node_table) if node_table else sorted(
+        {h for _, h in bind_pairs}
+    )
+    idx = {h: i for i, h in enumerate(table)}
+    return DecisionSegment.build(
+        [k for k, _ in bind_pairs], [idx[h] for _, h in bind_pairs],
+        table, list(evicts),
+    )
+
+
+def _seed_pods(create, n, nodes=("n0", "n1")):
+    for name in nodes:
+        create("Node", build_node(name, cpu="64", memory="64Gi"))
+    pg = build_podgroup("pg1", min_member=1)
+    pg.status.phase = PodGroupPhase.INQUEUE
+    create("PodGroup", pg)
+    for i in range(n):
+        create("Pod", build_pod(f"p{i}", group="pg1", cpu="1"))
+
+
+# -- wire format parity -------------------------------------------------------
+
+
+def test_segment_wire_roundtrip():
+    seg = _seg([("default/p0", "n1"), ("default/p1", "n0")],
+               evicts=[("default/p2", "preempt"), ("default/p3", "preempt")])
+    back = DecisionSegment.from_wire(json.loads(json.dumps(seg.to_wire())))
+    assert back.bind_keys == seg.bind_keys
+    assert back.bind_hosts == seg.bind_hosts
+    assert back.evict_pairs() == seg.evict_pairs()
+    assert (back.ev_token, back.ev_start) == (seg.ev_token, seg.ev_start)
+    # reason interning: one table entry for the repeated reason
+    assert seg.reason_table == ["preempt"]
+
+
+def test_segment_event_encoding_matches_codec_byte_for_byte():
+    """The hand-built Event row encoding IS codec.encode of the
+    materialized ClusterEvent — key order and values, via json bytes."""
+    name = event_name("tok", 7)
+    args = (name, "default/p0", "Scheduled",
+            "Successfully assigned default/p0 to n1", "Normal", 42, 1234.5)
+    assert json.dumps(encode_event_row(*args)) == json.dumps(
+        encode(materialize_event(*args))
+    )
+    args = (event_name("tok", 8), "default/p1", "Evict",
+            "Evicted for preempt", "Warning", 43, 1234.5)
+    assert json.dumps(encode_event_row(*args)) == json.dumps(
+        encode(materialize_event(*args))
+    )
+
+
+def test_segment_pod_rows_decode_equal_per_object_encode():
+    """Watch-expanded Pod rows from a lazy segment == codec.encode of the
+    materialized store objects (segment decode == per-object encode)."""
+    srv = StoreServer().start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 3)
+        cursor = rs.resource_version
+        rs.apply_segment(_seg(
+            [("default/p0", "n0"), ("default/p1", "n1")],
+            evicts=[("default/p2", "too-hot")],
+        ))
+        rows = srv.watch_since(cursor, {"Pod"}, 0)["events"]
+        assert [e["type"] for e in rows] == ["Updated"] * 3
+        for e in rows:
+            obj = srv.store.get("Pod", e["object"]["meta"]["name"] and
+                                f"default/{e['object']['meta']['name']}")
+            assert json.dumps(e["object"]) == json.dumps(encode(obj))
+        # Event rows decode to the exact materialized objects too
+        ev_rows = srv.watch_since(cursor, {"Event"}, 0)["events"]
+        evs = {e.meta.key: e for e in srv.store.list("Event")}
+        assert len(ev_rows) == 3 and len(evs) == 3
+        for e in ev_rows:
+            key = f"/{e['object']['meta']['name']}"
+            assert json.dumps(e["object"]) == json.dumps(encode(evs[key]))
+    finally:
+        srv.stop()
+
+
+# -- watch-stream equivalence vs the per-object path --------------------------
+
+_EV_ID = re.compile(r"event-t0-\d{8}(?:-t0-\d{8})?")
+
+
+def _normalize(stream) -> str:
+    """json bytes of an event stream with generated Event identities
+    (name + uid — pure opaque ids: the per-object path draws a second
+    counter slot for the uid, the segment path reuses the name) replaced
+    by first-appearance ordinals.  The ONLY tolerated difference between
+    the per-object and columnar paths — both runs are otherwise fully
+    controlled: same uid counter, same frozen clock."""
+    out = json.loads(json.dumps(stream))
+    for e in out:
+        if e["kind"] == "Event":
+            for side in ("object", "old"):
+                o = e.get(side)
+                if o:
+                    o["meta"]["uid"] = o["meta"]["name"]
+    seen = {}
+
+    def sub(m):
+        return seen.setdefault(m.group(0), f"EV{len(seen)}")
+
+    return _EV_ID.sub(sub, json.dumps(out))
+
+
+def _run_publish(monkeypatch, columnar: bool):
+    """One controlled publish of 24 binds + 6 evicts through the REAL
+    applier against a fresh server; returns the server's full log."""
+    monkeypatch.setattr(api_objects, "_uid_token", "t0")
+    monkeypatch.setattr(api_objects, "_uid_next", 1000)
+    monkeypatch.setattr(time, "time", lambda: 1234.5)
+    srv = StoreServer().start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 30, nodes=("n0", "n1", "n2"))
+        cache = SchedulerCache(rs, async_apply=True)
+        binds = [(f"default/p{i}", f"n{i % 3}") for i in range(24)]
+        evicts = [(f"default/p{24 + i}", "preempt") for i in range(6)]
+        applier = cache.applier
+        try:
+            # drive the drain synchronously (no thread race) so both
+            # paths apply as ONE batch, like a cycle's queue drain does
+            if columnar:
+                applier._apply([("segment", _seg(binds, evicts), None)])
+            else:
+                applier._apply(
+                    [("bind", k, h) for k, h in binds]
+                    + [("evict", k, r) for k, r in evicts]
+                )
+        finally:
+            applier.stop(flush=False)
+        assert cache.err_log == []
+        return srv.watch_since(0, set(), 0)["events"]
+    finally:
+        srv.stop()
+
+
+def test_watch_stream_byte_identical_to_per_object_path(monkeypatch):
+    per_object = _run_publish(monkeypatch, columnar=False)
+    columnar = _run_publish(monkeypatch, columnar=True)
+    assert _normalize(columnar) == _normalize(per_object)
+    # and the streams actually carried the workload: seeds + 30 pod
+    # patches + 30 events
+    kinds = [e["kind"] for e in columnar]
+    assert kinds.count("Event") == 30
+    assert sum(1 for e in columnar
+               if e["kind"] == "Pod" and e["type"] == "Updated") == 30
+
+
+def test_remote_watch_client_decodes_segment_rows(monkeypatch):
+    """A RemoteStore watcher drains a columnar-fed log into ordinary
+    per-object Events — the mirror/controller contract."""
+    srv = StoreServer().start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 2)
+        watcher = RemoteStore(srv.url)
+        q = watcher.watch("Pod")
+        qe = watcher.watch("Event")
+        rs.apply_segment(_seg([("default/p0", "n1"), ("default/p1", "n0")]))
+        got = []
+        while q:
+            got.append(q.popleft())
+        assert [(e.obj.meta.name, e.obj.node_name) for e in got] == [
+            ("p0", "n1"), ("p1", "n0")]
+        assert all(e.old is not None and not e.old.node_name for e in got)
+        evs = []
+        while qe:
+            evs.append(qe.popleft())
+        assert [e.obj.reason for e in evs] == ["Scheduled", "Scheduled"]
+        assert evs[0].obj.message.endswith("assigned default/p0 to n1")
+    finally:
+        srv.stop()
+
+
+# -- lazy materialization semantics ------------------------------------------
+
+
+def test_lazy_apply_defers_object_writes_until_read():
+    srv = StoreServer().start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 2)
+        store = srv.store
+        rv_before = store.resource_version
+        rs.apply_segment(_seg([("default/p0", "n1"), ("default/p1", "n0")]))
+        # rv advanced at ACK (2 patches + 2 events), but the live objects
+        # are untouched until a read materializes them
+        assert store.resource_version == rv_before + 4
+        assert store._objects["Pod"]["default/p0"].node_name == ""
+        assert len(store._lazy_patch["Pod"]) == 2
+        p0 = store.get("Pod", "default/p0")
+        assert p0.node_name == "n1"
+        assert p0.meta.resource_version == rv_before + 1
+        assert "default/p0" not in store._lazy_patch["Pod"]
+        # the no-op-suppression shadow materialized too: re-patching the
+        # same value stays quiescent (no event, no rv bump)
+        rv = store.resource_version
+        store.patch("Pod", "default/p0", {"node_name": "n1"})
+        assert store.resource_version == rv
+    finally:
+        srv.stop()
+
+
+def test_lazy_events_never_materialize_unless_listed():
+    srv = StoreServer().start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 2)
+        rs.apply_segment(_seg([("default/p0", "n1"), ("default/p1", "n0")]))
+        store = srv.store
+        assert store._objects["Event"] == {}
+        assert len(store._lazy_create["Event"]) == 2
+        evs = events_for(store, "Pod", "default/p0")  # lists -> materializes
+        assert [e.reason for e in evs] == ["Scheduled"]
+        assert store._lazy_create["Event"] == {}
+        # uid ordering == creation order across the whole block
+        ordered = sorted(store.list("Event"), key=lambda e: e.meta.uid)
+        assert [e.involved[1] for e in ordered] == [
+            "default/p0", "default/p1"]
+    finally:
+        srv.stop()
+
+
+def test_later_patch_stacks_on_lazy_row_and_noop_binds_event_only():
+    srv = StoreServer().start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 1)
+        cursor = rs.resource_version
+        rs.apply_segment(_seg([("default/p0", "n1")]))
+        # a normal per-object patch lands on top of the lazy row: the
+        # delta chain must keep the segment's node_name
+        rs.patch("Pod", "default/p0", {"deleting": True})
+        rows = srv.watch_since(cursor, {"Pod"}, 0)["events"]
+        assert [e["object"]["node_name"] for e in rows] == ["n1", "n1"]
+        assert rows[1]["object"]["deleting"] is True
+        assert rows[1]["old"]["node_name"] == "n1"
+        # re-binding to the same node is a no-op write: Event, no patch row
+        seq = srv.seq
+        res = rs.apply_segment(_seg([("default/p0", "n1")]))
+        assert res["binds"] == []
+        rows = srv.watch_since(seq, set(), 0)["events"]
+        assert [e["kind"] for e in rows] == ["Event"]
+    finally:
+        srv.stop()
+
+
+def test_segment_row_errors_surface_and_pods_vanish_cleanly():
+    srv = StoreServer().start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 1)
+        res = rs.apply_segment(_seg(
+            [("default/p0", "n1"), ("default/ghost", "n0")],
+            evicts=[("default/gone", "preempt")],
+        ))
+        assert [row for row, _ in res["binds"]] == [1]
+        assert "NotFound" in res["binds"][0][1]
+        assert [row for row, _ in res["evicts"]] == [0]
+        assert rs.get("Pod", "default/p0").node_name == "n1"
+        # only the successful rows produced events
+        assert [e.reason for e in srv.store.list("Event")] == ["Scheduled"]
+    finally:
+        srv.stop()
+
+
+def test_flush_state_persists_lazy_rows(tmp_path):
+    state = str(tmp_path / "state.json")
+    srv = StoreServer(state_path=state, save_interval=3600).start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 1)
+        rs.apply_segment(_seg([("default/p0", "n1")]))
+        srv.flush_state()
+        data = json.load(open(state))
+        pods = {p["meta"]["name"]: p for p in data["kinds"]["Pod"]}
+        assert pods["p0"]["node_name"] == "n1"
+        assert len(data["kinds"]["Event"]) == 1
+    finally:
+        srv.stop()
+
+
+def test_log_blocks_trim_partially_and_relist_horizon_holds(monkeypatch):
+    from volcano_tpu.store import server as server_mod
+
+    monkeypatch.setattr(server_mod, "LOG_CAP", 10)
+    srv = StoreServer().start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 8)  # 11 seed events: already over cap
+        cursor = rs.resource_version
+        rs.apply_segment(_seg(
+            [(f"default/p{i}", f"n{i % 2}") for i in range(8)]
+        ))  # 8 patch rows + 8 event rows; cap 10 -> patch block trimmed
+        assert srv._log_rows == 10
+        horizon = srv.seq - srv._log_rows
+        assert horizon > cursor  # the trim ate into the patch block
+        # a cursor inside the trimmed range must relist
+        out = srv.watch_since(horizon - 1, set(), 0)
+        assert out.get("relist")
+        # a cursor mid-block gets exactly the tail rows, seqs contiguous
+        out = srv.watch_since(horizon + 1, set(), 0)
+        seqs = [e["seq"] for e in out["events"]]
+        assert seqs == list(range(horizon + 2, srv.seq + 1))
+        kinds = [e["kind"] for e in out["events"]]
+        assert kinds == ["Pod"] * 1 + ["Event"] * 8
+    finally:
+        srv.stop()
+
+
+# -- applier integration ------------------------------------------------------
+
+
+def test_applier_segment_overlay_and_error_retry_semantics():
+    store = make_store([])
+    _seed_pods(store.create, 2)
+    store.delete("Pod", "default/p1")  # vanishes before the drain
+    cache = SchedulerCache(store, async_apply=True)
+    gate = threading.Event()
+    orig = store.apply_segment
+    store.apply_segment = lambda seg: (gate.wait(10), orig(seg))[1]
+    try:
+        seg = _seg([("default/p0", "n0"), ("default/p1", "n1")],
+                   evicts=[("default/p0", "late-evict")])
+        assert cache.publish_segment(seg)
+        # in flight: every key overlaid (bind wins over the queued evict
+        # marker for the same key only if the evict came first — here the
+        # evict rides the same segment, so both markers show)
+        binds, evicts = cache.applier.inflight_view()
+        assert binds == {"default/p0": "n0", "default/p1": "n1"}
+        assert evicts == {"default/p0": "late-evict"}
+    finally:
+        gate.set()
+    assert cache.applier.flush(10)
+    # confirmed: markers gone, failure recorded for the vanished pod only
+    assert cache.applier.inflight_view() == ({}, {})
+    assert [(op, key) for op, key, _ in cache.err_log] == [
+        ("bind", "default/p1")]
+    assert store.get("Pod", "default/p0").node_name == "n0"
+    assert cache.bind_log == [("default/p0", "n0"), ("default/p1", "n1")]
+    assert cache.evict_log == [("default/p0", "late-evict")]
+
+
+def test_abort_pending_purges_queued_segment_markers():
+    store = make_store([])
+    _seed_pods(store.create, 2)
+    cache = SchedulerCache(store, async_apply=True)
+    applier = cache.applier
+    gate = threading.Event()
+    # first, a blocking op batch occupies the applier thread so the
+    # segment stays QUEUED (not applying) when the purge hits
+    orig_bulk = store.bulk
+    store.bulk = lambda ops: (gate.wait(10), orig_bulk(ops))[1]
+    try:
+        applier.submit_ops([{"op": "patch", "kind": "Pod",
+                             "key": "default/p0", "fields": {}}])
+        time.sleep(0.05)  # let the thread pick up the ops batch
+        cache.publish_segment(_seg([("default/p0", "n0")]))
+        assert applier.inflight_binds == {"default/p0": "n0"}
+        dropped = applier.abort_pending()
+        assert dropped == 1
+        assert applier.inflight_binds == {}
+    finally:
+        gate.set()
+    assert applier.flush(10)
+    assert store.get("Pod", "default/p0").node_name == ""  # never applied
+
+
+def test_repeat_evicts_aggregate_instead_of_duplicating_events():
+    """Evict rows keep the r5 count-aggregation semantics: a repeated
+    (pod, Evict, message) across segments bumps ONE Event's count, it
+    does not mint duplicates forever in a long-lived daemon."""
+    store = make_store([])
+    _seed_pods(store.create, 1)
+    cache = SchedulerCache(store, async_apply=True)
+    cache.publish_segment(_seg([], evicts=[("default/p0", "too-hot")]))
+    assert cache.applier.flush(10)
+    # the pod resurfaces (store writer clears deleting), same verdict
+    store.patch("Pod", "default/p0", {"deleting": False})
+    cache.publish_segment(_seg([], evicts=[("default/p0", "too-hot")]))
+    assert cache.applier.flush(10)
+    evs = events_for(store, "Pod", "default/p0")
+    assert [(e.reason, e.count) for e in evs] == [("Evict", 2)]
+    assert store.get("Pod", "default/p0").deleting is True
+    assert cache.err_log == []
+
+
+def test_restart_seeds_obj_enc_for_segment_delta_bases(tmp_path):
+    """A restarted server must not pay a full per-object encode under
+    the lock for the first post-restart segment: _load_state seeds the
+    per-object cache the segment's delta capture reads."""
+    state = str(tmp_path / "state.json")
+    srv = StoreServer(state_path=state, save_interval=0.0).start()
+    rs = RemoteStore(srv.url)
+    _seed_pods(rs.create, 2)
+    srv.stop()
+    srv2 = StoreServer(state_path=state, save_interval=0.0).start()
+    try:
+        assert ("Pod", "default/p0") in srv2._obj_enc
+        rs2 = RemoteStore(srv2.url)
+        cursor = rs2.resource_version
+        rs2.apply_segment(_seg([("default/p0", "n1")]))
+        rows = srv2.watch_since(cursor, {"Pod"}, 0)["events"]
+        assert rows[0]["object"]["node_name"] == "n1"
+        assert rows[0]["old"]["node_name"] == ""
+        assert json.dumps(rows[0]["object"]) == json.dumps(
+            encode(srv2.store.get("Pod", "default/p0"))
+        )
+    finally:
+        srv2.stop()
+
+
+# -- fallback flag + end-to-end smoke (tier-1) --------------------------------
+
+
+def _fast_async_run(columnar: bool, store=None):
+    from volcano_tpu.scheduler.scheduler import Scheduler
+
+    store = store or make_store([])
+    store.create("Node", build_node("n1", cpu="16", memory="32Gi"))
+    pg = build_podgroup("pg1", min_member=3)
+    pg.status.phase = PodGroupPhase.INQUEUE
+    store.create("PodGroup", pg)
+    for i in range(3):
+        store.create("Pod", build_pod(f"p{i}", group="pg1", cpu="1"))
+    conf = full_conf("tpu")
+    conf.apply_mode = "async"
+    conf.columnar_publish = columnar
+    sched = Scheduler(store, conf=conf)
+    sched.run_once()
+    assert sched.cache.applier.flush(10)
+    assert sched.fast_cycle is not None and sched.fast_cycle.mirror is not None
+    placements = sorted(
+        (p.meta.key, p.node_name) for p in store.list("Pod")
+    )
+    events = sorted(
+        (e.involved[1], e.reason) for e in store.list("Event")
+    )
+    assert sched.cache.err_log == []
+    sched.cache.applier.stop()
+    return placements, events
+
+
+def test_in_process_fallback_flag_matches_columnar_run():
+    """Tier-1 smoke: with ``columnarPublish: false`` the in-process fast
+    cycle publishes through the r5 per-object bulk path and produces the
+    same placements AND the same event stream as the columnar default."""
+    col_p, col_e = _fast_async_run(columnar=True)
+    old_p, old_e = _fast_async_run(columnar=False)
+    assert col_p == old_p
+    assert [p for p, n in col_p if n] != []  # something actually bound
+    assert col_e == old_e
+
+
+def test_conf_loads_columnar_publish_flag():
+    from volcano_tpu.scheduler.conf import load_conf
+
+    assert load_conf("applyMode: async\n").columnar_publish is True
+    assert load_conf("columnarPublish: false\n").columnar_publish is False
+
+
+# -- chaos: segment atomicity under storms ------------------------------------
+
+
+def _storm_run(plan):
+    """A fastpath scheduler on RemoteStore publishing columnar segments
+    while the server chaos plan fires; returns converged placements."""
+    from volcano_tpu.scheduler.scheduler import Scheduler
+
+    srv = StoreServer().start()
+    try:
+        seeder = RemoteStore(srv.url)
+        seeder.create("Queue", Queue(meta=Metadata(name="default",
+                                                   namespace="")))
+        for n in range(3):
+            seeder.create("Node", build_node(f"n{n}", cpu="8",
+                                             memory="16Gi"))
+        for g in range(4):
+            pg = build_podgroup(f"pg{g}", min_member=3)
+            pg.status.phase = PodGroupPhase.INQUEUE
+            seeder.create("PodGroup", pg)
+            for i in range(3):
+                seeder.create("Pod", build_pod(f"g{g}-{i}", group=f"pg{g}",
+                                               cpu="1"))
+        if plan is not None:
+            from volcano_tpu.chaos import FaultPlan
+
+            srv.arm_chaos(FaultPlan.from_dict(plan))
+        conf = full_conf("tpu")
+        conf.apply_mode = "async"
+        sched = Scheduler(RemoteStore(srv.url), conf=conf)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                sched.run_once()
+            except Exception:  # noqa: BLE001 — storm-side transient
+                time.sleep(0.05)
+                continue
+            sched.cache.applier.flush(10)
+            pods = srv.store.list("Pod")
+            if all(p.node_name for p in pods):
+                break
+            time.sleep(0.02)
+        srv.arm_chaos(None)
+        sched.run_once()
+        sched.cache.applier.flush(10)
+        pods = srv.store.list("Pod")
+        placements = sorted((p.meta.key, p.node_name) for p in pods)
+        scheduled = {e.involved[1] for e in srv.store.list("Event")
+                     if e.reason == "Scheduled"}
+        sched.cache.applier.stop()
+        return placements, scheduled
+    finally:
+        srv.stop()
+
+
+PLAN_SEGMENT_STORM = {
+    "seed": 77,
+    "rules": [
+        # cut the segment publish's reply mid-body: the segment has
+        # APPLIED (atomic under the server lock); the client records
+        # errors, and the next cycle's mirror shows the truth
+        {"point": "server.request", "action": "cut_body",
+         "match": {"path": "/bulk"}, "every": 2, "count": 4},
+        # and 5xx some too: consumed BEFORE dispatch — nothing applied,
+        # the cycle republishes
+        {"point": "server.request", "action": "http_500",
+         "match": {"path": "/bulk"}, "after": 8, "every": 2, "count": 3},
+        # truncate the watch log under the mirror: StaleWatch relist
+        {"point": "server.request", "action": "truncate_log",
+         "match": {"path": "/watch"}, "after": 4, "every": 9, "count": 2},
+    ],
+}
+
+
+def test_chaos_segment_storm_converges_with_no_half_applied_segment():
+    clean_placements, clean_scheduled = _storm_run(None)
+    storm_placements, storm_scheduled = _storm_run(PLAN_SEGMENT_STORM)
+    assert [k for k, n in clean_placements if n] != []
+    assert storm_placements == clean_placements
+    # no half-applied segment: every bound pod has its Scheduled Event
+    # and no Event names an unbound pod
+    bound = {k for k, n in storm_placements if n}
+    assert storm_scheduled == bound == clean_scheduled

@@ -775,6 +775,74 @@ def test_residue_vectorized_near_misses_stay_quiet(tmp_path):
     """, select=["residue-vectorized"]) == []
 
 
+# --- rule: columnar-publish --------------------------------------------------
+
+
+def test_columnar_publish_fires_on_per_object_encode_loop(tmp_path):
+    findings = _lint(tmp_path, "store/client.py", """
+        def publish(self, binds):
+            wire = []
+            for key, host in binds:
+                wire.append(encode({"key": key, "node_name": host}))
+            return wire
+    """, select=["columnar-publish"])
+    assert _rules_of(findings) == ["columnar-publish"]
+
+
+def test_columnar_publish_fires_in_comprehension_and_dumps(tmp_path):
+    findings = _lint(tmp_path, "scheduler/apply.py", """
+        def drain(self, ops):
+            return [json.dumps(op) for op in ops]
+    """, select=["columnar-publish"])
+    assert _rules_of(findings) == ["columnar-publish"]
+    # .items() over a decision map in a server bulk handler
+    findings = _lint(tmp_path, "store/server.py", """
+        def bulk(self, evicts):
+            out = []
+            for key, reason in evicts.items():
+                out.append(encode_fields({"deleting": True}))
+            return out
+    """, select=["columnar-publish"])
+    assert _rules_of(findings) == ["columnar-publish"]
+
+
+def test_columnar_publish_near_misses_stay_quiet(tmp_path):
+    # one whole-payload dumps OUTSIDE any loop is the segment path itself
+    assert _lint(tmp_path, "store/client.py", """
+        def apply_segment(self, seg):
+            return json.dumps(seg.to_wire())
+    """, select=["columnar-publish"]) == []
+    # a loop over a NON-decision collection (per-field delta apply)
+    assert _lint(tmp_path, "store/server.py", """
+        def delta(self, enc, fields):
+            for k, v in fields.items():
+                enc[k] = encode(v)
+    """, select=["columnar-publish"]) == []
+    # the identical per-op encode loop outside the wire module set
+    assert _lint(tmp_path, "scheduler/other.py", """
+        def ship(ops):
+            return [encode(op) for op in ops]
+    """, select=["columnar-publish"]) == []
+    # column assembly without any encode stays quiet
+    assert _lint(tmp_path, "scheduler/apply.py", """
+        def columns(self, binds):
+            return [key for key, _ in binds]
+    """, select=["columnar-publish"]) == []
+
+
+def test_columnar_publish_suppressions_carry_justification():
+    """The surviving per-op encode sites (client generic bulk, the state-
+    flush cache-miss fallback) are suppressed LINE-BY-LINE — the rule
+    still fires on any new decision loop in those files."""
+    import volcano_tpu
+
+    pkg = os.path.dirname(os.path.abspath(volcano_tpu.__file__))
+    client = open(os.path.join(pkg, "store", "client.py")).read()
+    assert client.count("vtlint: disable=columnar-publish") >= 3
+    server = open(os.path.join(pkg, "store", "server.py")).read()
+    assert server.count("vtlint: disable=columnar-publish") == 1
+
+
 # --- rule: trace-span-discipline --------------------------------------------
 
 

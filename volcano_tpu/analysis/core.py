@@ -98,6 +98,7 @@ def _load_rule_modules() -> None:
         rules_retry,
         rules_statement,
         rules_trace,
+        rules_wire,
     )
 
 
@@ -278,6 +279,45 @@ def dotted_name(node: ast.AST) -> Optional[str]:
     if isinstance(cur, ast.Name):
         parts.append(cur.id)
         return ".".join(reversed(parts))
+    return None
+
+
+def resolve_iterable(
+    expr: ast.AST,
+    names: Set[str],
+    wrappers: Set[str],
+    call_suffixes: Sequence[str] = (),
+) -> Optional[str]:
+    """The collection spelling an iterable expression resolves to, or
+    None.  Sees through wrapper calls (``enumerate``/``list``/``zip``/…,
+    every positional argument considered) and ``.items()``/``.values()``/
+    ``.keys()`` methods; matches bare names and attribute tails against
+    ``names``, and calls whose last dotted segment is in
+    ``call_suffixes``.  Shared by the loop-shape rules
+    (``residue-vectorized``, ``columnar-publish``) so the wrapper-peeling
+    logic cannot drift between them."""
+    stack = [expr]
+    while stack:
+        cur = stack.pop()
+        while isinstance(cur, ast.Call):
+            fname = dotted_name(cur.func)
+            if fname in wrappers and cur.args:
+                stack.extend(cur.args[1:])
+                cur = cur.args[0]
+                continue
+            if fname is not None and fname.split(".")[-1] in call_suffixes:
+                return fname
+            if isinstance(cur.func, ast.Attribute) and cur.func.attr in (
+                "items", "values", "keys",
+            ):
+                cur = cur.func.value
+                continue
+            cur = None
+            break
+        if isinstance(cur, ast.Name) and cur.id in names:
+            return cur.id
+        if isinstance(cur, ast.Attribute) and cur.attr in names:
+            return dotted_name(cur) or cur.attr
     return None
 
 

@@ -24,7 +24,12 @@ from __future__ import annotations
 import ast
 from typing import Iterable, Optional
 
-from volcano_tpu.analysis.core import FileContext, Finding, dotted_name, rule
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    resolve_iterable,
+    rule,
+)
 
 _SCOPED_BASENAMES = {"residue.py", "tensor_actions.py"}
 
@@ -35,28 +40,10 @@ _WRAPPERS = {"enumerate", "list", "sorted", "reversed", "tuple"}
 
 def _nodeish(expr: ast.AST) -> Optional[str]:
     """The node-collection spelling an iterable expression resolves to,
-    or None.  Sees through enumerate()/list()/sorted() wrappers and
-    ``.values()``/``.items()`` calls; matches bare names, ``*.nodes``
-    attributes, and ``get_node_list(...)`` calls."""
-    cur = expr
-    while isinstance(cur, ast.Call):
-        fname = dotted_name(cur.func)
-        if fname in _WRAPPERS and cur.args:
-            cur = cur.args[0]
-            continue
-        if fname is not None and fname.split(".")[-1] == "get_node_list":
-            return fname
-        if isinstance(cur.func, ast.Attribute) and cur.func.attr in (
-            "values", "items", "keys",
-        ):
-            cur = cur.func.value
-            continue
-        return None
-    if isinstance(cur, ast.Name) and cur.id in _NODEISH_NAMES:
-        return cur.id
-    if isinstance(cur, ast.Attribute) and cur.attr in _NODEISH_NAMES:
-        return dotted_name(cur) or cur.attr
-    return None
+    or None (core.resolve_iterable with this rule's name/wrapper sets;
+    ``get_node_list(...)`` calls match by suffix)."""
+    return resolve_iterable(expr, _NODEISH_NAMES, _WRAPPERS,
+                            ("get_node_list",))
 
 
 @rule(

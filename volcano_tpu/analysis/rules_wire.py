@@ -1,0 +1,112 @@
+"""columnar-publish: the publish/drain path must stay columnar.
+
+r6 made the cycle's output ONE columnar segment end to end
+(store/segment.py): binds/evicts/Events ride parallel columns over
+interned string tables, the server applies them lazily under one lock,
+and the watch log holds block references instead of per-object
+encodings.  That deleted the 14.9 s cfg7 drain (BASELINE.md r5) whose
+cost was per-object ``encode(...)`` dict loops.  This rule fences the
+regression: in the wire module set (``scheduler/apply.py``,
+``store/client.py``, ``store/server.py``, ``store/segment.py``) a call
+to ``encode``/``encode_fields``/``encode_object``/``json.dumps`` may
+not sit inside a loop or comprehension over a decision/op collection
+(``ops``/``binds``/``evicts``/``events``/``keys``/``items``/...) —
+that is the per-object wire encode the columnar path exists to avoid.
+
+The generic per-op verbs that legitimately survive for NON-decision
+traffic (client ``bulk``'s object encode, the state-flush fallback)
+carry explicit line suppressions with their justification — new
+per-object encode loops must either go columnar or argue their case in
+review the same way.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    dotted_name,
+    resolve_iterable,
+    rule,
+)
+
+_SCOPED_SUFFIXES = (
+    "scheduler/apply.py",
+    "store/client.py",
+    "store/server.py",
+    "store/segment.py",
+)
+
+#: iterable spellings that mean "one element per decision/op/object"
+_PLURAL_NAMES = {
+    "ops", "wire", "binds", "evicts", "events", "ev_ops", "batch",
+    "items", "keys", "rows", "decisions", "objs", "pods",
+}
+_WRAPPERS = {"enumerate", "list", "sorted", "reversed", "tuple", "zip"}
+_ENCODERS = {"encode", "encode_fields", "encode_object", "json.dumps",
+             "dumps"}
+
+
+def _pluralish(expr: ast.AST) -> Optional[str]:
+    """The decision-plural spelling an iterable resolves to, or None
+    (core.resolve_iterable with the wire rule's name/wrapper sets)."""
+    return resolve_iterable(expr, _PLURAL_NAMES, _WRAPPERS)
+
+
+def _encoder_calls(node: ast.AST) -> Iterable[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fname = dotted_name(sub.func)
+            if fname is not None and (
+                fname in _ENCODERS or fname.split(".")[-1] in _ENCODERS
+            ):
+                yield sub
+
+
+@rule(
+    "columnar-publish",
+    "per-object encode()/json.dumps loop over a decision/op collection in "
+    "the wire module set — the per-object publish/drain cost the columnar "
+    "segment path (store/segment.py) deleted (14.9 s cfg7 drain, "
+    "BASELINE.md r5); ship a segment, or suppress with the justification "
+    "on the line",
+)
+def check_columnar_publish(ctx: FileContext) -> Iterable[Finding]:
+    if not any(ctx.relpath.endswith(s) for s in _SCOPED_SUFFIXES):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            spelled = _pluralish(node.iter)
+            if spelled is None:
+                continue
+            # the loop body only — a same-line else/orelse is not the loop
+            for stmt in node.body:
+                for call in _encoder_calls(stmt):
+                    yield ctx.finding(
+                        "columnar-publish",
+                        call,
+                        f"per-object encode inside `for ... in {spelled}`: "
+                        "this re-grows the per-object wire the columnar "
+                        "segment path replaced — carry the run as segment "
+                        "columns instead",
+                    )
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            spelled = None
+            for gen in node.generators:
+                spelled = _pluralish(gen.iter)
+                if spelled is not None:
+                    break
+            if spelled is None:
+                continue
+            for call in _encoder_calls(node):
+                yield ctx.finding(
+                    "columnar-publish",
+                    call,
+                    f"per-object encode in a comprehension over "
+                    f"{spelled!r} — carry the run as segment columns "
+                    "instead",
+                )

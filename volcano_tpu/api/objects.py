@@ -10,24 +10,43 @@ the fields the scheduler/controller/admission paths actually consume:
 
 from __future__ import annotations
 
-import itertools
 import os
 import secrets
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from volcano_tpu.api.resource import Resource
 from volcano_tpu.api.types import PodGroupPhase, PodPhase
 
-_uid_counter = itertools.count(1)
+_uid_lock = threading.Lock()
+_uid_next = 1
 # process-unique token: daemons on separate RemoteStores each run their own
 # counter, so uids (and Event object names built from them) must not collide
 # across processes
 _uid_token = f"{os.getpid():x}{secrets.token_hex(2)}"
 
 
+def _advance_uids(n: int) -> int:
+    global _uid_next
+    with _uid_lock:
+        start = _uid_next
+        _uid_next += n
+    return start
+
+
 def new_uid(prefix: str = "obj") -> str:
-    return f"{prefix}-{_uid_token}-{next(_uid_counter):08d}"
+    return f"{prefix}-{_uid_token}-{_advance_uids(1):08d}"
+
+
+def reserve_uids(prefix: str, n: int) -> Tuple[str, int]:
+    """Reserve ``n`` consecutive uid-counter slots in one lock hold and
+    return ``(token, start)``: slot ``start + i`` names the uid
+    ``f"{prefix}-{token}-{start + i:08d}"``.  A decision segment reserves
+    its whole Event block this way, so the server can derive every Event
+    name without a per-row uid round trip (store/segment.py)."""
+    del prefix  # part of the derived name, not the reservation
+    return _uid_token, _advance_uids(n)
 
 
 @dataclass

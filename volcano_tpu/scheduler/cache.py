@@ -666,6 +666,26 @@ class SchedulerCache:
             except Exception as e:  # noqa: BLE001
                 self._record_err("event", key, e)
 
+    def publish_segment(self, seg) -> bool:
+        """Publish a whole cycle's decisions as ONE columnar segment
+        (store/segment.py) through the async applier — the zero-per-object
+        publish path.  Returns False when the columnar path is unavailable
+        (sync apply mode: the Binder/Evictor seams own per-decision
+        semantics there), so the caller falls back to bind_bulk/evict_bulk.
+        bind_log/evict_log record the decisions at publish time, exactly
+        like the bulk submits."""
+        if self.applier is None:
+            return False
+        if seg.empty:
+            return True
+        self.applier.submit_segment(seg)
+        self.bind_log.extend(zip(seg.bind_keys, seg.bind_hosts))
+        self.evict_log.extend(zip(seg.evict_keys, seg.evict_reason_strs))
+        if trace.TRACER is not None:
+            for key, hostname in zip(seg.bind_keys, seg.bind_hosts):
+                self._trace_bind(key, hostname, published=True)
+        return True
+
     def evict_bulk(self, evicts) -> None:
         """Evict a whole cycle's victims: async -> one applier submit;
         sync -> the Evictor's bulk verb (or the per-evict seam for custom

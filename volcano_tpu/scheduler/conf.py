@@ -51,6 +51,12 @@ class SchedulerConf:
     # inline, deterministic. None = unset: library/simulator use resolves
     # to sync; the deployed daemon resolves to async.
     apply_mode: Optional[str] = None
+    # columnar publish (store/segment.py): the fast cycle ships each
+    # cycle's binds/evicts as ONE columnar segment through the async
+    # applier instead of per-object ops.  False = the r5 per-object bulk
+    # path (the fallback the columnar-publish tier-1 smoke exercises);
+    # sync apply mode ignores the flag (seams are per-decision there).
+    columnar_publish: bool = True
     # exact (layout-independent) top-k spill targets in the batch solve:
     # multi-chip == single-chip bit-for-bit, at some solve-speed cost
     exact_topk: bool = False
@@ -140,6 +146,8 @@ def load_conf(text: str) -> SchedulerConf:
                 f"applyMode must be 'sync' or 'async', got {mode!r}"
             )
         conf.apply_mode = mode
+    if "columnarPublish" in data:
+        conf.columnar_publish = bool(data["columnarPublish"])
     if "schedulePeriod" in data:
         conf.schedule_period = float(data["schedulePeriod"])
     if "exactTopK" in data:

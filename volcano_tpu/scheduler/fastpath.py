@@ -1948,6 +1948,10 @@ class FastCycle:
         )
         self.probe = probe
         self.gang_on = probe.gang_job_ready
+        # columnar publish (conf.columnar_publish): ship each cycle's
+        # decisions as ONE segment through the async applier; the
+        # per-object bulk path survives as the flagged-off fallback
+        self.columnar_on = getattr(self.conf, "columnar_publish", True)
         from volcano_tpu.scheduler.conf import get_plugin_arg
 
         self.nodeaffinity_weight = (
@@ -2718,22 +2722,23 @@ class FastCycle:
             gang_ready = np.ones(J, bool)
 
         # -- binds (vectorized: row indices all the way) ---------------------
+        # columns only — key strings come out in ONE fancy-indexed sweep
+        # and node ids stay interned indices into snap.node_names, so the
+        # columnar segment builds straight from the solve outputs with no
+        # per-bind tuple/dict encode inside the timed publish phase
         node_rows = aux["node_rows"]
         pe_rows = pe_rows_solve
         pub_express = express[gang_ready[task_job_solve[express]]] if express.size else express
         row_key = m.pods.row_key
         names = snap.node_names
-        binds: List[Tuple[str, str]] = []
+        bind_cols: List[Tuple[np.ndarray, np.ndarray]] = []
         if pub_express.size:
             prows = pe_rows[pub_express]
             nidx = task_node[pub_express]
             prows, nidx = self._volume_bind_filter(m, prows, nidx, names)
             m.p_status[prows] = _BOUND
             m.p_node[prows] = node_rows[nidx]
-            binds.extend(
-                (row_key[r], names[n])
-                for r, n in zip(prows.tolist(), nidx.tolist())
-            )
+            bind_cols.append((prows, nidx))
         if be_rows.size:
             keep = gang_ready[pod_j[be_rows]]
             pub_be, pub_be_nodes = be_rows[keep], be_nodes[keep]
@@ -2744,10 +2749,18 @@ class FastCycle:
             if pub_be.size:
                 m.p_status[pub_be] = _BOUND
                 m.p_node[pub_be] = node_rows[pub_be_nodes]
-                binds.extend(
-                    (row_key[r], names[n])
-                    for r, n in zip(pub_be.tolist(), pub_be_nodes.tolist())
-                )
+                bind_cols.append((pub_be, pub_be_nodes))
+        if bind_cols:
+            rows_all = np.concatenate([p for p, _ in bind_cols])
+            nidx_all = np.concatenate([n for _, n in bind_cols])
+            bind_keys = [row_key[r] for r in rows_all.tolist()]
+            # intern only the REFERENCED node names: a steady trickle
+            # cycle ships a table of its few touched nodes, not all 10k
+            uniq, inv = np.unique(nidx_all, return_inverse=True)
+            bind_table = [names[i] for i in uniq.tolist()]
+            bind_nodes = inv.tolist()
+        else:
+            bind_keys, bind_nodes, bind_table = [], [], []
 
         # -- per-job status (framework._update_pod_group_status parity) -----
         codes = aux["codes"]
@@ -2875,9 +2888,26 @@ class FastCycle:
             metrics.update_unschedule_job_count(n_unsched_jobs)
 
         # -- ship -----------------------------------------------------------
-        self.cache.bind_bulk(binds)
-        if evicts:
-            self.cache.evict_bulk(evicts)
+        binds: List[Tuple[str, str]] = []
+        shipped = False
+        if self.columnar_on and self.cache.applier is not None:
+            from volcano_tpu.store.segment import DecisionSegment
+
+            seg = DecisionSegment.build(
+                bind_keys, bind_nodes, bind_table, evicts
+            )
+            shipped = self.cache.publish_segment(seg)
+            if shipped:
+                binds = seg.bind_pairs()
+        if not shipped:
+            # per-object bulk fallback (columnarPublish: false, or sync
+            # apply mode where the Binder/Evictor seams own the writes)
+            binds = list(zip(
+                bind_keys, (bind_table[n] for n in bind_nodes)
+            ))
+            self.cache.bind_bulk(binds)
+            if evicts:
+                self.cache.evict_bulk(evicts)
         if ops:
             applier = self.cache.applier
             if applier is not None:

@@ -95,7 +95,16 @@ class StoreServer:
         # _flush_lock is always taken BEFORE lock, never the reverse
         self.lock = make_rlock("StoreServer.lock")
         self.cond = threading.Condition(self.lock)
+        # ordered event log: plain per-event dict entries, or columnar
+        # block entries {"seq": <last row's seq>, "n": rows, "kind": K,
+        # "block": PatchLogBlock|EventLogBlock, "start": first block row}
+        # appended by the segment verb — one entry per segment section, so
+        # log append cost scales with segments, not objects; watch_since
+        # expands block rows lazily (memoized once, shared by watchers)
         self.log: List[Dict[str, Any]] = []
+        #: total event rows currently buffered (block entries count their
+        #: rows) — the relist horizon is ``seq - _log_rows``
+        self._log_rows = 0
         self.seq = 0
         # durability (the etcd analogue): objects + sequence persist to
         # ``state_path`` so a restarted server resumes with all CRDs; the
@@ -125,6 +134,12 @@ class StoreServer:
         # re-encoding (memory: one encoded dict per live object, the same
         # order as the store's own shadow copies)
         self._obj_enc: Dict[tuple, Dict[str, Any]] = {}
+        # lazy half of the encoded cache: (kind, key) -> (log block, row)
+        # for objects whose newest state lives in an unexpanded columnar
+        # segment — the segment IS the cache entry until a read resolves
+        # it through _enc_of (first read materializes, memoized on the
+        # block)
+        self._enc_pending: Dict[tuple, tuple] = {}
         # create/update handlers already HOLD the wire encoding of the
         # object they decoded — they stage it here (meta re-stamped) so
         # _pump_log seeds the cache without re-encoding; cleared after
@@ -191,6 +206,7 @@ class StoreServer:
                     # old" event compaction the reference gets from etcd
                     with server.lock:
                         del server.log[:]
+                        server._log_rows = 0
                     return False
                 if rule.action == "http_500":
                     # an unread request body would corrupt the next
@@ -244,9 +260,9 @@ class StoreServer:
                         # the handlers (direct srv.store seeding) must not
                         # leave a stale cached encoding in the response
                         server._pump_log()
-                        enc_of = server._obj_enc
+                        enc_of = server._enc_of
                         items = [
-                            enc_of.get((kind, o.meta.key)) or encode(o)
+                            enc_of(kind, o.meta.key) or encode(o)
                             for o in server.store.list(kind)
                         ]
                     return self._reply(200, {"items": items, "seq": server.seq})
@@ -494,6 +510,11 @@ class StoreServer:
                         # result is a per-key LIST the client re-flattens
                         results.append(self._patch_col(op))
                         continue
+                    elif verb == "segment":
+                        # columnar decision segment (store/segment.py):
+                        # result is the sparse per-row error dict
+                        results.append(self._apply_segment(op))
+                        continue
                     elif verb == "delete":
                         self.store.delete(kind, op.get("key", ""))
                         self._pump_log()
@@ -544,6 +565,115 @@ class StoreServer:
             self._pump_log()
         return out
 
+    def _apply_segment(self, op: Dict[str, Any]) -> Dict[str, Any]:
+        """Apply one columnar decision segment: the whole cycle's binds,
+        evicts, and their Events land under ONE lock acquisition, with no
+        per-object store write, object encode, or log entry.  The store
+        stages the rows lazily (Store.apply_segment_lazy); this side
+        appends one log BLOCK per segment section — the block is both the
+        watch encoding (expanded lazily, shared by all watchers) and the
+        encoded-object cache entry for every key it covers (_enc_of).
+        Atomicity: the segment applies entirely inside the lock or not at
+        all — chaos faults on the request fire before dispatch, so a cut
+        reply can never leave a half-applied segment.  Never flushes
+        inline (the bulk wrapper's _maybe_flush runs outside the lock,
+        preserving the _flush_lock-before-lock order)."""
+        from volcano_tpu.store.segment import DecisionSegment, PatchLogBlock
+
+        seg = DecisionSegment.from_wire(op)
+        with self.lock:
+            # queued per-object events must keep their place in the order
+            self._pump_log()
+            res = self.store.apply_segment_lazy(seg)
+            bkeys, bvals, rv_b0 = res.pop("bind_block")
+            ekeys, rv_e0 = res.pop("evict_block")
+            ebind, eevict = res.pop("event_blocks")
+            pend = self._enc_pending
+            if bkeys:
+                pre = [self._enc_pre("Pod", k) for k in bkeys]
+                blk = PatchLogBlock("node_name", bkeys, bvals, pre, rv_b0)
+                self._append_block(blk)
+                for i, k in enumerate(bkeys):
+                    pend[("Pod", k)] = (blk, i)
+                self._dirty_kinds.add("Pod")
+            if ekeys:
+                pre = [self._enc_pre("Pod", k) for k in ekeys]
+                blk = PatchLogBlock(
+                    "deleting", ekeys, [True] * len(ekeys), pre, rv_e0
+                )
+                self._append_block(blk)
+                for i, k in enumerate(ekeys):
+                    pend[("Pod", k)] = (blk, i)
+                self._dirty_kinds.add("Pod")
+            for blk in (ebind, eevict):
+                if len(blk):
+                    self._append_block(blk)
+                    for i in range(len(blk)):
+                        pend[("Event", blk.key(i))] = (blk, i)
+                    self._dirty_kinds.add("Event")
+            self._trim_log()
+            self.cond.notify_all()
+        return res
+
+    def _append_block(self, blk) -> None:
+        """One log entry for a whole columnar block; rows occupy the seq
+        range (blk.seq0 .. entry["seq"])."""
+        n = len(blk)
+        blk.seq0 = self.seq + 1
+        self.seq += n
+        self._log_rows += n
+        self.log.append({"seq": self.seq, "n": n, "kind": blk.kind,
+                         "block": blk, "start": 0})
+
+    def _enc_of(self, kind: str, key: str) -> Optional[Dict[str, Any]]:
+        """The object's current encoding, resolving the lazy columnar half
+        of the cache on first read (memoized on the block, so N readers
+        pay one materialization)."""
+        ck = (kind, key)
+        p = self._enc_pending.pop(ck, None)
+        if p is not None:
+            blk, i = p
+            self._obj_enc[ck] = blk.materialize_enc(i)
+        return self._obj_enc.get(ck)
+
+    def _enc_pre(self, kind: str, key: str) -> Dict[str, Any]:
+        """Pre-segment encoding of ``key`` — the delta basis (and the
+        ``old`` reference) for a block row about to cover it.  Reads the
+        raw store object on a cache miss (never Store.get: that would
+        fold the very rows this segment just staged)."""
+        enc = self._enc_of(kind, key)
+        if enc is None:
+            enc = encode(self.store._objects[kind][key])
+            self._obj_enc[(kind, key)] = enc
+        return enc
+
+    def _trim_log(self) -> None:
+        """Evict the oldest rows past LOG_CAP.  A block straddling the
+        horizon is kept with its ``start``/``n`` advanced (a shallow copy
+        of the entry — the block itself is shared with any slower
+        reader mid-expansion)."""
+        overflow = self._log_rows - LOG_CAP
+        if overflow <= 0:
+            return
+        k = 0
+        log = self.log
+        while overflow > 0 and k < len(log):
+            e = log[k]
+            n = e.get("n", 1)
+            if n <= overflow:
+                overflow -= n
+                self._log_rows -= n
+                k += 1
+            else:
+                e2 = dict(e)
+                e2["n"] = n - overflow
+                e2["start"] = e.get("start", 0) + overflow
+                log[k] = e2
+                self._log_rows -= overflow
+                overflow = 0
+        if k:
+            del log[:k]
+
     # -- persistence -----------------------------------------------------------
 
     def _load_state(self) -> None:
@@ -564,6 +694,12 @@ class StoreServer:
             self._enc_cache[kind] = list(items)
             for enc in items:
                 obj = decode_object(kind, enc)
+                # seed the per-object cache too: the first post-restart
+                # segment captures its delta bases here (_enc_pre) — an
+                # unseeded key would pay a full encode() per object under
+                # the server lock, the per-object cliff the segment path
+                # exists to avoid
+                self._obj_enc[(kind, obj.meta.key)] = enc
                 rv = obj.meta.resource_version
                 self.store.create(kind, obj)
                 # create stamps a fresh rv; restore the persisted one on
@@ -618,11 +754,14 @@ class StoreServer:
                 if not self._dirty_kinds:
                     return
                 for kind in self._dirty_kinds:
-                    items = self.store.list(kind)
+                    items = self.store.list(kind)  # materializes lazy rows
                     if items:
-                        enc_of = self._obj_enc
+                        enc_of = self._enc_of
+                        # encode(o) is the cache-MISS fallback only
+                        # (direct-seeded objects); wire-fed objects all
+                        # resolve through the columnar/encoded cache
                         self._enc_cache[kind] = [
-                            enc_of.get((kind, o.meta.key)) or encode(o)
+                            enc_of(kind, o.meta.key) or encode(o)  # vtlint: disable=columnar-publish
                             for o in items
                         ]
                     else:
@@ -665,12 +804,13 @@ class StoreServer:
         ck = (kind, key)
         cache = self._obj_enc
         if ev.type.value == "Deleted":
+            self._enc_of(kind, key)  # resolve any lazy half first
             enc = cache.pop(ck, None)
             if enc is None:
                 enc = encode(ev.obj)
             return enc, None
         if ev.fields is not None:
-            enc_old = cache.get(ck)
+            enc_old = self._enc_of(kind, key)
             if enc_old is not None:
                 try:
                     enc = dict(enc_old)
@@ -697,10 +837,11 @@ class StoreServer:
                     return enc, enc_old
         hint = self._enc_hints.pop(ck, None)
         if hint is not None:
-            enc_old = cache.get(ck)
+            enc_old = self._enc_of(kind, key)
             cache[ck] = hint
             return hint, enc_old
         enc = encode(ev.obj)
+        self._enc_pending.pop(ck, None)  # full re-encode supersedes lazy
         cache[ck] = enc
         return enc, encode(ev.old) if ev.old is not None else None
 
@@ -712,6 +853,7 @@ class StoreServer:
                 ev = q.popleft()
                 self._dirty_kinds.add(kind)
                 self.seq += 1
+                self._log_rows += 1
                 enc_obj, enc_old = self._encode_event_obj(kind, ev)
                 self.log.append(
                     {
@@ -723,9 +865,7 @@ class StoreServer:
                     }
                 )
                 moved = True
-        overflow = len(self.log) - LOG_CAP
-        if overflow > 0:
-            del self.log[:overflow]
+        self._trim_log()
         # unconsumed hints (a no-op write that produced no event) must not
         # survive to describe some LATER mutation of the key
         if self._enc_hints:
@@ -736,19 +876,39 @@ class StoreServer:
     def watch_since(self, since: int, kinds, timeout: float) -> Dict[str, Any]:
         deadline = time.monotonic() + timeout
         with self.lock:
-            if since < self.seq - len(self.log) or since > self.seq:
+            if since < self.seq - self._log_rows or since > self.seq:
                 # fell off the buffer — or the client's cursor is from
                 # before a server restart: tell it to relist
                 return {"events": None, "next": self.seq, "relist": True}
             while True:
-                # seqs are contiguous (one append per seq), so the events
-                # after `since` start at a computable offset — no log scan
-                start = max(0, len(self.log) - (self.seq - since))
-                evs = [
-                    e
-                    for e in self.log[start:]
-                    if not kinds or e["kind"] in kinds
-                ]
+                log = self.log
+                # entries' seq fields (a block entry carries its LAST
+                # row's seq) are strictly increasing: binary-search the
+                # first entry past the cursor instead of scanning
+                lo, hi = 0, len(log)
+                while lo < hi:
+                    mid = (lo + hi) // 2
+                    if log[mid]["seq"] > since:
+                        hi = mid
+                    else:
+                        lo = mid + 1
+                evs = []
+                for e in log[lo:]:
+                    blk = e.get("block")
+                    if blk is None:
+                        if not kinds or e["kind"] in kinds:
+                            evs.append(e)
+                        continue
+                    if kinds and e["kind"] not in kinds:
+                        continue
+                    # columnar block: expand only the rows past the
+                    # cursor (the expansion itself is memoized on the
+                    # block — N watchers share one materialization)
+                    n = e["n"]
+                    first_seq = e["seq"] - n + 1
+                    skip = since - first_seq + 1 if since >= first_seq else 0
+                    start = e["start"]
+                    evs.extend(blk.wire_rows(start + skip, start + n))
                 if evs or timeout <= 0:
                     return {"events": evs, "next": self.seq}
                 remaining = deadline - time.monotonic()

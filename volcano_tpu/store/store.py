@@ -90,6 +90,13 @@ class Store:
         # informer local-cache pattern); populated only for watched kinds.
         self._shadow: Dict[str, Dict[str, Any]] = defaultdict(dict)
         self._watchers: Dict[str, List[Deque[Event]]] = defaultdict(list)
+        # lazy columnar overlay (apply_segment_lazy): per-kind field
+        # patches and object creates already ACKed but not yet applied to
+        # the live objects — key -> (fields dict, rv) / (block, row).
+        # Every read/write verb materializes the touched keys first, so
+        # per-object work is paid on first read, not at segment apply.
+        self._lazy_patch: Dict[str, Dict[str, Any]] = defaultdict(dict)
+        self._lazy_create: Dict[str, Dict[str, Any]] = defaultdict(dict)
         self._rv = 0
         # mutation lock: the async applier writes from its own thread while
         # the owning thread reads/writes (StoreServer adds its own RLock on
@@ -100,7 +107,9 @@ class Store:
 
     def __getstate__(self):
         # the mutation lock is process-local (vtctl pickles the simulated
-        # cluster's store for persisted state)
+        # cluster's store for persisted state); lazily pending segment
+        # rows materialize first so the pickle is plain objects
+        self.materialize_all()
         state = self.__dict__.copy()
         del state["_mu"]
         return state
@@ -109,6 +118,10 @@ class Store:
         from volcano_tpu.locksan import make_rlock
 
         self.__dict__.update(state)
+        # state pickled before the columnar wire lacks the (always-empty-
+        # at-pickle) lazy overlays
+        self.__dict__.setdefault("_lazy_patch", defaultdict(dict))
+        self.__dict__.setdefault("_lazy_create", defaultdict(dict))
         self._mu = make_rlock("Store._mu")
 
     def _watched(self, kind: str) -> bool:
@@ -119,12 +132,62 @@ class Store:
         """Monotonic global version; bumps on every create/update."""
         return self._rv
 
+    # -- lazy segment overlay -------------------------------------------------
+
+    def _materialize(self, kind: str, key: str) -> None:
+        """Fold any pending segment rows for ``key`` into the live object
+        (and its no-op-suppression shadow) — called by every verb that
+        reads or writes the key.  Must run under ``_mu``."""
+        lp = self._lazy_patch.get(kind)
+        if lp:
+            entry = lp.pop(key, None)
+            if entry is not None:
+                fields, rv = entry
+                obj = self._objects[kind][key]
+                for name, v in fields.items():
+                    setattr(obj, name, v)
+                obj.meta.resource_version = rv
+                shadow = self._shadow[kind].get(key)
+                if shadow is not None:
+                    from volcano_tpu.api.fastclone import deep_clone
+
+                    new_shadow = copy.copy(shadow)
+                    new_shadow.meta = copy.copy(shadow.meta)
+                    new_shadow.meta.resource_version = rv
+                    for name, v in fields.items():
+                        setattr(new_shadow, name, deep_clone(v))
+                    self._shadow[kind][key] = new_shadow
+        lc = self._lazy_create.get(kind)
+        if lc:
+            entry = lc.pop(key, None)
+            if entry is not None:
+                block, i = entry
+                self._objects[kind][key] = block.materialize(i)
+
+    def _materialize_kind(self, kind: str) -> None:
+        lp = self._lazy_patch.get(kind)
+        lc = self._lazy_create.get(kind)
+        if not lp and not lc:
+            return
+        for key in list(lp or ()):
+            self._materialize(kind, key)
+        for key in list(lc or ()):
+            self._materialize(kind, key)
+
+    def materialize_all(self) -> None:
+        """Materialize every lazily pending segment row (pickling, state
+        flushes)."""
+        with self._mu:
+            for kind in list(self._lazy_patch) + list(self._lazy_create):
+                self._materialize_kind(kind)
+
     # -- CRUD ---------------------------------------------------------------
 
     def create(self, kind: str, obj: Any) -> Any:
         with self._mu:
             key = obj.meta.key
-            if key in self._objects[kind]:
+            lc = self._lazy_create.get(kind)
+            if key in self._objects[kind] or (lc and key in lc):
                 raise KeyError(f"{kind} {key} already exists")
             self._rv += 1
             obj.meta.resource_version = self._rv
@@ -139,6 +202,7 @@ class Store:
     def update(self, kind: str, obj: Any) -> Any:
         with self._mu:
             key = obj.meta.key
+            self._materialize(kind, key)
             if key not in self._objects[kind]:
                 raise KeyError(f"{kind} {key} not found")
             old = self._shadow[kind].get(key)
@@ -158,6 +222,7 @@ class Store:
         resource_version still equals ``expected_rv`` (read-modify-write
         safety for concurrent writers, e.g. leader leases and kubelets)."""
         with self._mu:
+            self._materialize(kind, obj.meta.key)
             current = self._objects[kind].get(obj.meta.key)
             if current is None:
                 raise KeyError(f"{kind} {obj.meta.key} not found")
@@ -185,6 +250,7 @@ class Store:
         full-object deep_clone was 75% of drain time at 100k binds/cycle.
         """
         with self._mu:
+            self._materialize(kind, key)
             obj = self._objects[kind].get(key)
             if obj is None:
                 raise KeyError(f"{kind} {key} not found")
@@ -289,18 +355,212 @@ class Store:
                 results.append(repr(e))
         return results
 
+    # -- columnar segments ---------------------------------------------------
+
+    def apply_segment(self, seg) -> Dict[str, Any]:
+        """Eagerly apply one decision segment (store/segment.py): bind
+        patches, evict patches, then one Scheduled/Evict Event per
+        successful row — the same store mutations (and watch events) the
+        per-object bulk path produced, minus the per-op dict plumbing.
+        This is the IN-PROCESS transport: direct watchers (the scheduler's
+        mirror, controllers) keep seeing ordinary per-object events.  The
+        server's lazy transport is ``apply_segment_lazy``.  Returns
+        ``{"binds": [[row, err], ...], "evicts": [...], "timings": {...}}``
+        with sparse per-row errors, mirroring the bulk verb's isolation.
+        """
+        import time as _time
+
+        from volcano_tpu.store import segment as segmod
+
+        hosts = seg.bind_hosts
+        reasons = seg.evict_reason_strs
+        errs_b: List[List[Any]] = []
+        errs_e: List[List[Any]] = []
+        ev_rows: List[tuple] = []  # (uid slot, involved key, reason, message, type)
+        # per-row locking, like Store.bulk: concurrent readers interleave
+        # between rows exactly as they did with the per-op path
+        t0 = _time.perf_counter()
+        for i, key in enumerate(seg.bind_keys):
+            try:
+                self.patch("Pod", key, {"node_name": hosts[i]})
+            except KeyError as e:
+                errs_b.append([i, f"NotFound: {e}"])
+                continue
+            except Exception as e:  # noqa: BLE001 — per-row isolation
+                errs_b.append([i, repr(e)])
+                continue
+            ev_rows.append((seg.ev_start + i, key, segmod.BIND_REASON,
+                            segmod.scheduled_message(key, hosts[i]),
+                            segmod.NORMAL))
+        t1 = _time.perf_counter()
+        n_b = len(seg.bind_keys)
+        for j, key in enumerate(seg.evict_keys):
+            try:
+                self.patch("Pod", key, {"deleting": True})
+            except KeyError as e:
+                errs_e.append([j, f"NotFound: {e}"])
+                continue
+            except Exception as e:  # noqa: BLE001
+                errs_e.append([j, repr(e)])
+                continue
+            ev_rows.append((seg.ev_start + n_b + j, key,
+                            segmod.EVICT_REASON,
+                            segmod.evicted_message(reasons[j]),
+                            segmod.WARNING))
+        t2 = _time.perf_counter()
+        for slot, key, reason, message, type_ in ev_rows:
+            ev = segmod.materialize_event(
+                segmod.event_name(seg.ev_token, slot), key, reason,
+                message, type_, rv=0, stamp=0.0,
+            )
+            ev.meta.creation_timestamp = 0.0  # create() stamps it
+            self.create("Event", ev)
+        t3 = _time.perf_counter()
+        return {
+            "binds": errs_b, "evicts": errs_e,
+            "timings": {"binds_s": t1 - t0, "evicts_s": t2 - t1,
+                        "events_s": t3 - t2},
+        }
+
+    def _stage_lazy_rows(self, keys: List[str], field: str,
+                         values: Optional[List[Any]]):
+        """Stage one segment section's scalar patches into the lazy
+        overlay: per-row existence + pending-aware no-op check, a
+        contiguous rv block for the changed rows, last-wins merge into
+        any pending entry.  ``values`` is the per-row column, or None for
+        the constant ``True`` (evict rows).  Returns
+        ``(sparse errs, changed row idxs, event row idxs, rv0)``.
+        Must run under ``_mu``."""
+        pods = self._objects["Pod"]
+        pend = self._lazy_patch["Pod"]
+        errs: List[List[Any]] = []
+        changed: List[int] = []
+        ev_rows: List[int] = []
+        for i, key in enumerate(keys):
+            obj = pods.get(key)
+            if obj is None:
+                errs.append([i, "NotFound: " + repr(f"Pod {key} not found")])
+                continue
+            p = pend.get(key)
+            cur = p[0].get(field, _MISSING) if p else _MISSING
+            if cur is _MISSING:
+                cur = getattr(obj, field)
+            ev_rows.append(i)
+            if cur == (True if values is None else values[i]):
+                continue  # no-op write: Event only, no patch row
+            changed.append(i)
+        rv0 = self._rv + 1
+        self._rv += len(changed)
+        for j, i in enumerate(changed):
+            key = keys[i]
+            value = True if values is None else values[i]
+            p = pend.get(key)
+            if p is None:
+                pend[key] = ({field: value}, rv0 + j)
+            else:
+                f = dict(p[0])
+                f[field] = value
+                pend[key] = (f, rv0 + j)
+        return errs, changed, ev_rows, rv0
+
+    def apply_segment_lazy(self, seg) -> Dict[str, Any]:
+        """The server-side half of the columnar wire: ACK a whole decision
+        segment under ONE lock acquisition without touching a single live
+        object.  Bind/evict rows stage into the lazy-patch overlay
+        (resource versions assigned now, fields folded in on first read by
+        ``_materialize``); Event rows stage as columnar
+        ``EventLogBlock`` references that never become ClusterEvent
+        objects unless an Event read asks (``_materialize``/``list``).
+        No watcher events fan out — the StoreServer appends the blocks to
+        its own log directly (columnar watch cache).  Returns the sparse
+        per-row errors plus the block descriptions the server logs:
+
+          bind_block:  (keys, hostnames, rv0) for rows that CHANGED state
+          evict_block: (keys, rv0)
+          event_blocks: (bind EventLogBlock, evict EventLogBlock)
+
+        Rows whose write is a no-op (already bound to that node / already
+        deleting) produce an Event but no patch row — exactly the per-
+        object path's patch-quiescence + event behavior.
+        """
+        import time as _time
+
+        from volcano_tpu.store import segment as segmod
+
+        with self._mu:
+            t0 = _time.perf_counter()
+            stamp = _time.time()
+            hosts = seg.bind_hosts
+            errs_b, changed_b, ev_b, rv_b0 = self._stage_lazy_rows(
+                seg.bind_keys, "node_name", hosts
+            )
+            t1 = _time.perf_counter()
+            errs_e, changed_e, ev_e, rv_e0 = self._stage_lazy_rows(
+                seg.evict_keys, "deleting", None
+            )
+            t2 = _time.perf_counter()
+
+            # Event rows: rv block after every patch, the bulk-then-bulk
+            # order of the per-object path
+            rv_ev0 = self._rv + 1
+            self._rv += len(ev_b) + len(ev_e)
+            n_b = len(seg.bind_keys)
+            ebind = segmod.EventLogBlock(
+                segmod.BIND_REASON, seg.ev_token,
+                [seg.ev_start + i for i in ev_b],
+                [seg.bind_keys[i] for i in ev_b],
+                [hosts[i] for i in ev_b],
+                rv_ev0, stamp,
+            )
+            reasons = seg.evict_reason_strs
+            eevict = segmod.EventLogBlock(
+                segmod.EVICT_REASON, seg.ev_token,
+                [seg.ev_start + n_b + j for j in ev_e],
+                [seg.evict_keys[j] for j in ev_e],
+                [reasons[j] for j in ev_e],
+                rv_ev0 + len(ev_b), stamp,
+            )
+            lc = self._lazy_create["Event"]
+            for blk in (ebind, eevict):
+                for r in range(len(blk)):
+                    lc[blk.key(r)] = (blk, r)
+            t3 = _time.perf_counter()
+            return {
+                "binds": errs_b, "evicts": errs_e,
+                "bind_block": (
+                    [seg.bind_keys[i] for i in changed_b],
+                    [hosts[i] for i in changed_b], rv_b0,
+                ),
+                "evict_block": (
+                    [seg.evict_keys[j] for j in changed_e], rv_e0,
+                ),
+                "event_blocks": (ebind, eevict),
+                "timings": {"binds_s": t1 - t0, "evicts_s": t2 - t1,
+                            "events_s": t3 - t2},
+            }
+
     def delete(self, kind: str, key: str) -> Optional[Any]:
         with self._mu:
+            self._materialize(kind, key)
             obj = self._objects[kind].pop(key, None)
             if obj is not None:
                 self._notify(Event(kind, EventType.DELETED, obj))  # drops the shadow too
             return obj
 
     def get(self, kind: str, key: str) -> Optional[Any]:
+        lp = self._lazy_patch.get(kind)
+        lc = self._lazy_create.get(kind)
+        if (lp and key in lp) or (lc and key in lc):
+            with self._mu:
+                self._materialize(kind, key)
         return self._objects[kind].get(key)
 
     def list(self, kind: str) -> List[Any]:
         with self._mu:
+            # lazily created objects (segment Events) materialize only
+            # here — the "never exist unless listed" half of the lazy-
+            # apply contract
+            self._materialize_kind(kind)
             return list(self._objects[kind].values())
 
     def items(self, kind: str) -> Iterator[Any]:
