@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire native examples install clean images image image-tpu lint sanitize chaos elastic trace
+.PHONY: test e2e parity bench bench-residue bench-wire native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -21,6 +21,17 @@ test: lint
 # variant is slow-exempt and runs in tier-1; this target runs every plan.
 chaos:
 	$(PY) -m pytest tests/test_chaos_soak.py -q
+
+# crash-kill chaos + the zero-acked-loss gate (store/wal.py +
+# tests/test_crash_recovery.py): WAL framing/torn-tail/group-commit
+# units, acked-durability-after-kill, segment atomicity + idempotent
+# resubmit, and the seeded crash.* storms — in-process InjectedCrash
+# aborts run in tier-1; this target adds the real-subprocess SIGKILL
+# storms (server pre/post-fsync, scheduler mid-drain, controller
+# mid-gang), each asserting placements bit-for-bit equal a fault-free
+# run after recovery.
+crash-soak:
+	$(PY) -m pytest tests/test_crash_recovery.py -q
 
 # elastic capacity (volcano_tpu/elastic/ + tests/test_elastic.py): the
 # demand estimator, the cordon/drain lifecycle, the elasticd daemon, the

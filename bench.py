@@ -38,6 +38,7 @@ decision publish (async drain reported separately).
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -636,13 +637,19 @@ def config5_dynamic(reps=3):
             metric="cfg5d_e2e_cycle_10pct_dynamic_predicates")
 
 
-def _apiserver_proc(q):
-    """Child-process entry: a StoreServer on a free port, url via queue."""
+def _apiserver_proc(q, state="", wal=False, save_interval=0.25):
+    """Child-process entry: a StoreServer on a free port, url via queue.
+    ``state``/``wal`` arm the durable tier (segment WAL, store/wal.py)
+    for the WAL-on drain comparison; the comparison passes a long
+    ``save_interval`` so it measures the ACK path's fsync overhead, not
+    background snapshot serialization (the WAL alone already guarantees
+    zero acked loss — checkpoints only bound replay length)."""
     import time as _time
 
     from volcano_tpu.store.server import StoreServer
 
-    srv = StoreServer().start()
+    srv = StoreServer(state_path=state or None, wal=wal,
+                      save_interval=save_interval).start()
     q.put(srv.url)
     while True:
         _time.sleep(3600)
@@ -663,82 +670,148 @@ def config7():
     from volcano_tpu.store.client import RemoteStore
 
     ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    srv_proc = ctx.Process(target=_apiserver_proc, args=(q,), daemon=True)
-    srv_proc.start()
-    try:
-        url = q.get(timeout=60)
-        remote = RemoteStore(url)
-        local = _build_e2e_store()
-        t0 = time.perf_counter()
-        ops = []
-        for kind in ("Queue", "PriorityClass", "Node", "PodGroup", "Pod"):
-            for obj in local.items(kind):
-                ops.append({"op": "create", "kind": kind, "object": obj})
-        for i in range(0, len(ops), 4000):
-            errs = [e for e in remote.bulk(ops[i:i + 4000]) if e]
-            assert not errs, errs[:3]
-        load_s = time.perf_counter() - t0
 
-        conf = full_conf("tpu")
-        conf.apply_mode = "async"
-        sched = Scheduler(remote, conf=conf)
-        warm = sched.prewarm()
-        t1 = time.perf_counter()
-        if sched.prewarm_background is not None:
-            sched.prewarm_background.join()
-        warm_bg = time.perf_counter() - t1
-        t0 = time.perf_counter()
-        sched.run_once()
-        publish = time.perf_counter() - t0
-        phases = _phases_of(sched)
-        while sched.cache.applier.pending > 0:
-            time.sleep(0.005)
-        drain = time.perf_counter() - t0 - publish
-        # per-kind drain attribution (server-measured segment sections +
-        # client-side op batches) so a wire regression localizes by kind
-        drain_kinds = dict(sched.cache.applier.drain_stats)
-        bound = sum(1 for p in remote.items("Pod") if p.node_name)
-        sched.run_once()
-        t1 = time.perf_counter()
-        sched.run_once()
-        steady = time.perf_counter() - t1
+    def one_run(state="", wal=False, prewarm=True, steady_cycles=True,
+                save_interval=0.25):
+        """One full cfg7 pass against a fresh apiserver process; returns
+        the measurements as plain data (the server dies on return)."""
+        import urllib.request as _rq
 
-        import jax
+        q = ctx.Queue()
+        srv_proc = ctx.Process(target=_apiserver_proc,
+                               args=(q, state, wal, save_interval),
+                               daemon=True)
+        srv_proc.start()
+        try:
+            url = q.get(timeout=60)
+            remote = RemoteStore(url)
+            local = _build_e2e_store()
+            t0 = time.perf_counter()
+            ops = []
+            for kind in ("Queue", "PriorityClass", "Node", "PodGroup", "Pod"):
+                for obj in local.items(kind):
+                    ops.append({"op": "create", "kind": kind, "object": obj})
+            for i in range(0, len(ops), 4000):
+                errs = [e for e in remote.bulk(ops[i:i + 4000]) if e]
+                assert not errs, errs[:3]
+            load_s = time.perf_counter() - t0
 
-        print(json.dumps({
-            "metric": "e2e_http_schedule_cycle_100k_tasks_10k_nodes",
-            "value": round(publish, 4),
-            "unit": "s",
-            "vs_baseline": round(BASELINE_SECONDS / publish, 1),
-            "extra": {
-                "transport": (
-                    "http+json, apiserver in its own OS process "
-                    "(StoreServer / RemoteStore); columnar segment "
-                    "publish (store/segment.py)"
-                ),
-                "pods_bound": bound,
-                "pods_per_sec": int(bound / publish),
-                "phases_s": phases,
-                "async_drain_s": round(drain, 2),
-                "drain_binds_s": round(drain_kinds.get("binds_s", 0.0), 3),
-                "drain_events_s": round(drain_kinds.get("events_s", 0.0), 3),
-                "drain_evicts_s": round(drain_kinds.get("evicts_s", 0.0), 3),
-                "drain_pg_s": round(drain_kinds.get("pg_s", 0.0), 3),
-                "drain_wire_s": round(drain_kinds.get("wire_s", 0.0), 3),
-                "steady_cycle_s": round(steady, 4),
-                "prewarm_s": round(warm, 1),
-                "prewarm_bg_s": round(warm_bg, 1),
-                "store_load_s": round(load_s, 1),
-                "path": "fastpath" if (
-                    sched.fast_cycle and sched.fast_cycle.mirror is not None
-                ) else "object",
-                "device": str(jax.devices()[0]),
-            },
-        }))
-    finally:
-        srv_proc.terminate()
-        srv_proc.join(timeout=5)
+            conf = full_conf("tpu")
+            conf.apply_mode = "async"
+            sched = Scheduler(remote, conf=conf)
+            warm = warm_bg = 0.0
+            if prewarm:
+                warm = sched.prewarm()
+                t1 = time.perf_counter()
+                if sched.prewarm_background is not None:
+                    sched.prewarm_background.join()
+                warm_bg = time.perf_counter() - t1
+            t0 = time.perf_counter()
+            sched.run_once()
+            publish = time.perf_counter() - t0
+            phases = _phases_of(sched)
+            while sched.cache.applier.pending > 0:
+                time.sleep(0.005)
+            drain = time.perf_counter() - t0 - publish
+            # per-kind drain attribution (server-measured segment
+            # sections + client-side op batches) so a wire regression
+            # localizes by kind
+            drain_kinds = dict(sched.cache.applier.drain_stats)
+            bound = sum(1 for p in remote.items("Pod") if p.node_name)
+            steady = 0.0
+            if steady_cycles:
+                sched.run_once()
+                t1 = time.perf_counter()
+                sched.run_once()
+                steady = time.perf_counter() - t1
+            wal_stats = None
+            if wal:
+                with _rq.urlopen(url + "/healthz", timeout=10) as resp:
+                    wal_stats = json.load(resp).get("wal")
+            return {
+                "publish": publish, "drain": drain, "phases": phases,
+                "drain_kinds": drain_kinds, "bound": bound,
+                "steady": steady, "warm": warm, "warm_bg": warm_bg,
+                "load_s": load_s, "wal": wal_stats,
+                "fastpath": bool(sched.fast_cycle
+                                 and sched.fast_cycle.mirror is not None),
+            }
+        finally:
+            srv_proc.terminate()
+            srv_proc.join(timeout=5)
+
+    run = one_run()
+    publish, drain = run["publish"], run["drain"]
+    drain_kinds, phases = run["drain_kinds"], run["phases"]
+    bound = run["bound"]
+
+    # WAL-on comparison: the SAME workload against an apiserver with
+    # the segment write-ahead log armed (store/wal.py) — every ACK
+    # waits on a group-committed fsync, the whole cycle is one WAL
+    # record, and the drain delta IS the durability overhead the
+    # 25%-band acceptance tracks.  The prewarm runs again on purpose:
+    # skipping it pushes an inline recompile into run_once (~20 s of
+    # "publish" that is really XLA), corrupting the comparison.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as wal_dir:
+        wal_run = one_run(state=os.path.join(wal_dir, "state.json"),
+                          wal=True, steady_cycles=False,
+                          save_interval=3600.0)
+
+    import jax
+
+    print(json.dumps({
+        "metric": "e2e_http_schedule_cycle_100k_tasks_10k_nodes",
+        "value": round(publish, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SECONDS / publish, 1),
+        "extra": {
+            "transport": (
+                "http+json, apiserver in its own OS process "
+                "(StoreServer / RemoteStore); columnar segment "
+                "publish (store/segment.py)"
+            ),
+            "pods_bound": bound,
+            "pods_per_sec": int(bound / publish),
+            "phases_s": phases,
+            "async_drain_s": round(drain, 2),
+            "drain_binds_s": round(drain_kinds.get("binds_s", 0.0), 3),
+            "drain_events_s": round(drain_kinds.get("events_s", 0.0), 3),
+            "drain_evicts_s": round(drain_kinds.get("evicts_s", 0.0), 3),
+            "drain_pg_s": round(drain_kinds.get("pg_s", 0.0), 3),
+            "drain_wire_s": round(drain_kinds.get("wire_s", 0.0), 3),
+            "steady_cycle_s": round(run["steady"], 4),
+            "prewarm_s": round(run["warm"], 1),
+            "prewarm_bg_s": round(run["warm_bg"], 1),
+            "store_load_s": round(run["load_s"], 1),
+            "path": "fastpath" if run["fastpath"] else "object",
+            "device": str(jax.devices()[0]),
+            # durability overhead (segment WAL armed): the off-cycle
+            # drain re-measured with ACK-after-fsync, plus the
+            # server's own fsync accounting — wal_records shows the
+            # whole 102k-bind cycle was a handful of records
+            "wal_drain_s": round(wal_run["drain"], 2),
+            "wal_publish_s": round(wal_run["publish"], 4),
+            "wal_fsync_s": (wal_run["wal"] or {}).get("fsync_s"),
+            "wal_fsync_total": (wal_run["wal"] or {}).get("fsync_total"),
+            "wal_records": (wal_run["wal"] or {}).get("records"),
+        },
+    }))
+    # the WAL-on vs WAL-off comparison line: ratio > 1.25 breaks the
+    # acceptance band (group commit must amortize fsync per segment)
+    print(json.dumps({
+        "metric": "cfg7_wal_on_vs_off_drain",
+        "value": round(wal_run["drain"], 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SECONDS / max(
+            wal_run["publish"], 1e-9), 1),
+        "extra": {
+            "wal_off_drain_s": round(drain, 4),
+            "ratio": round(wal_run["drain"] / max(drain, 1e-9), 3),
+            "wal": wal_run["wal"],
+        },
+    }))
 
 
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,

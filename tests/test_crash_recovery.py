@@ -1,0 +1,1042 @@
+"""Crash-kill chaos + the zero-acked-loss recovery gate (store/wal.py).
+
+PR 2's chaosd injected transient faults (5xx, cuts, truncation) but never
+killed a process; the durability story under real crashes was untested —
+and with interval snapshots it was actually WRONG (acked writes died with
+the process).  This suite is the gate for the segment WAL:
+
+  * WAL primitives: CRC framing, torn-tail tolerance, group-commit
+    amortization, checkpoint rotation + truncation.
+  * Zero acked loss: every 2xx-replied mutation is present after a
+    kill+recover — including a whole decision segment as ONE record.
+  * Segment atomicity: a crash can never leave an observable
+    half-applied segment, and re-submitting a segment (cut reply,
+    crash retry) is idempotent via its reserved-uid block.
+  * Seeded crash-kill storms (``crash.*`` faultpoints): the control
+    plane is killed at the server's pre/post-fsync windows, the
+    scheduler mid-drain, the controller mid-gang-create, the kubelet
+    mid-ready-flip — and every storm must converge to placements
+    bit-for-bit equal to a fault-free run.  Tier-1 runs the in-process
+    storms (InjectedCrash aborts); ``make crash-soak`` adds the real
+    SIGKILL subprocess storms.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from volcano_tpu import chaos, trace
+from volcano_tpu.api.objects import Metadata, Node, Queue
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobPhase
+from volcano_tpu.backoff import Backoff
+from volcano_tpu.chaos import FaultPlan, InjectedCrash
+from volcano_tpu.controller import JobController
+from volcano_tpu.scheduler import statement
+from volcano_tpu.scheduler.conf import full_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.store.client import RemoteStore, StaleWatch, wait_healthy
+from volcano_tpu.store.segment import DecisionSegment
+from volcano_tpu.store.server import StoreServer
+from volcano_tpu.store.wal import WriteAheadLog, frame_record, read_records
+
+from tests.helpers import build_pod
+from tests.test_chaos_soak import (
+    TRANSIENT,
+    _check_invariants,
+    _mk_job,
+    _placements,
+    _submit,
+    _wait_running,
+)
+
+
+# -- WAL primitives (tier-1) ---------------------------------------------------
+
+
+def test_wal_framing_roundtrip(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    recs = [{"op": "patch", "kind": "Pod", "key": f"/p{i}",
+             "fields": {"node_name": f"n{i}"}} for i in range(10)]
+    for r in recs:
+        wal.append(r)
+    wal.commit()
+    wal.sync_close()
+    wal2 = WriteAheadLog(str(tmp_path / "wal"))
+    assert list(wal2.replay(0)) == recs
+    assert wal2.torn_tails == 0
+    wal2.sync_close()
+
+
+def test_wal_torn_tail_truncated_and_crc_corrupt_discarded(tmp_path):
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)
+    for i in range(5):
+        wal.append({"i": i})
+    wal.commit()
+    wal.sync_close()
+    seg = sorted(glob.glob(os.path.join(d, "*.wal")))[0]
+
+    # physically truncate mid-record: the final record is discarded, the
+    # prefix survives, nothing raises
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:
+        f.truncate(size - 3)
+    recs, torn = read_records(seg)
+    assert torn and [r["i"] for r in recs] == [0, 1, 2, 3]
+
+    # flip a byte inside the last INTACT record's payload: CRC kills it
+    # (and everything after it stays discarded)
+    rec_size = len(frame_record({"i": 0}))
+    with open(seg, "r+b") as f:
+        f.seek(4 * rec_size - 2)
+        b = f.read(1)
+        f.seek(4 * rec_size - 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    recs, torn = read_records(seg)
+    assert torn and [r["i"] for r in recs] == [0, 1, 2]
+
+    w2 = WriteAheadLog(d)
+    assert [r["i"] for r in w2.replay(0)] == [0, 1, 2]
+    assert w2.torn_tails == 1
+    w2.sync_close()
+
+
+def test_wal_torn_mid_log_segment_does_not_drop_later_segments(tmp_path):
+    """A torn tail in an EARLIER segment (life A crashed mid-append, life
+    B appended a whole new segment on top of the repaired prefix) must
+    not discard life B's acked records — torn bytes were never ACKed,
+    later segments were."""
+    d = str(tmp_path / "wal")
+    a = WriteAheadLog(d)
+    a.append({"life": "A", "i": 0})
+    a.append({"life": "A", "i": 1})
+    a.commit()
+    a.kill()  # crash
+    seg_a = sorted(glob.glob(os.path.join(d, "*.wal")))[0]
+    with open(seg_a, "r+b") as f:
+        f.truncate(os.path.getsize(seg_a) - 2)  # tear A's last record
+
+    b = WriteAheadLog(d)
+    assert [r.get("i") for r in b.replay(0)] == [0]
+    b.append({"life": "B", "i": 2})
+    b.commit()
+    b.kill()
+
+    c = WriteAheadLog(d)
+    recs = list(c.replay(0))
+    assert [(r["life"], r["i"]) for r in recs] == [("A", 0), ("B", 2)]
+    c.sync_close()
+
+
+def test_wal_group_commit_amortizes_fsync(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    # N appends, one commit: exactly one fsync covers them all
+    for i in range(100):
+        wal.append({"i": i})
+    wal.commit()
+    assert wal.fsync_total == 1 and wal.appended_records == 100
+
+    # concurrent committers: every commit() returns only once its record
+    # is synced, but the leader batches — far fewer fsyncs than commits
+    def worker(k):
+        for i in range(20):
+            wal.commit(wal.append({"w": k, "i": i}))
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert wal.appended_records == 100 + 160
+    assert wal.fsync_total < 1 + 160, wal.fsync_total
+    wal.sync_close()
+    w2 = WriteAheadLog(str(tmp_path / "wal"))
+    assert sum(1 for _ in w2.replay(0)) == 260
+    w2.sync_close()
+
+
+def test_wal_checkpoint_rotates_and_drops_covered_segments(tmp_path):
+    state = str(tmp_path / "state.json")
+    srv = StoreServer(state_path=state, save_interval=3600, wal=True)
+    srv.store.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    srv.flush_state()  # pumps, rotates, snapshots, truncates
+    data = json.load(open(state))
+    assert data["wal_floor"] == 2  # records live in seg 1, floor moved past
+    assert data["rv"] == srv.store._rv
+    # covered segment gone; only the fresh live segment remains
+    assert srv.wal.segment_indices() == [2]
+    srv.wal.sync_close()
+
+    # recovery from snapshot alone replays nothing
+    srv2 = StoreServer(state_path=state, save_interval=3600, wal=True)
+    assert srv2.store.get("Queue", "/q") is not None
+    assert srv2.wal.replayed_records == 0
+    srv2.wal.sync_close()
+
+
+# -- zero acked loss + atomicity (tier-1) --------------------------------------
+
+
+def _boot(tmp_path, port=0, save_interval=3600):
+    return StoreServer(
+        port=port, state_path=str(tmp_path / "state.json"),
+        save_interval=save_interval, wal=True,
+    ).start()
+
+
+def test_acked_mutations_survive_kill_bit_for_bit(tmp_path):
+    """The gate, distilled: a sequential client ACKs creates, updates,
+    patches, bulk ops, and a whole decision segment; the server is killed
+    with NO flush; the recovered server must show every 2xx-replied
+    mutation with the exact rvs the client saw."""
+    srv = _boot(tmp_path)
+    rs = RemoteStore(srv.url)
+    rs.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    for i in range(6):
+        rs.create("Pod", build_pod(f"p{i}"))
+    node = Node(meta=Metadata(name="n0", namespace=""),
+                allocatable=Resource.from_resource_list(
+                    {"cpu": "4", "memory": "8Gi"}))
+    rs.create("Node", node)
+    n2 = rs.get("Node", "/n0")
+    n2.labels["zone"] = "z1"
+    rs.update("Node", n2)
+    rs.patch("Pod", "default/p5", {"node_name": "n0"})
+    assert rs.bulk(
+        [{"op": "patch", "kind": "Pod", "key": f"default/p{i}",
+          "fields": {"node_name": "n0"}} for i in range(3)]
+    ) == [None] * 3
+    seg = DecisionSegment.build(
+        ["default/p3", "default/p4"], [0, 0], ["n0"],
+        evicts=[("default/p0", "Preempted")])
+    res = rs.apply_segment(seg)
+    assert not res["binds"] and not res["evicts"]
+    rs.delete("Pod", "default/p1")
+    acked = {p.meta.key: (p.node_name, p.deleting, p.meta.resource_version)
+             for p in rs.list("Pod")}
+    acked_events = {e.meta.name for e in rs.list("Event")}
+    seq, rv = srv.seq, srv.store._rv
+    srv.kill()
+
+    srv2 = _boot(tmp_path, port=srv.port)
+    try:
+        rs2 = RemoteStore(srv2.url)
+        after = {p.meta.key: (p.node_name, p.deleting,
+                              p.meta.resource_version)
+                 for p in rs2.list("Pod")}
+        assert after == acked
+        assert {e.meta.name for e in rs2.list("Event")} == acked_events
+        assert rs2.get("Node", "/n0").labels["zone"] == "z1"
+        assert srv2.seq == seq and srv2.store._rv == rv
+        # CAS continuity: an update against the pre-crash rv still works
+        n3 = rs2.get("Node", "/n0")
+        rs2.update_cas("Node", n3, n3.meta.resource_version)
+    finally:
+        srv2.stop()
+
+
+def test_no_observable_half_applied_segment_across_crash(tmp_path):
+    """Atomicity both ways: a segment whose WAL record survived recovers
+    FULLY (every bind, every Event); one whose record was lost recovers
+    NOT AT ALL — no prefix of binds, no stray Events."""
+    srv = _boot(tmp_path)
+    rs = RemoteStore(srv.url)
+    for i in range(8):
+        rs.create("Pod", build_pod(f"p{i}"))
+    seg = DecisionSegment.build(
+        [f"default/p{i}" for i in range(8)], [0] * 8, ["n0"])
+    assert not rs.apply_segment(seg)["binds"]
+    srv.kill()
+
+    # record survived (it was written before the ACK): fully applied
+    srv2 = _boot(tmp_path, port=srv.port)
+    rs2 = RemoteStore(srv2.url)
+    assert all(p.node_name == "n0" for p in rs2.list("Pod"))
+    assert len(rs2.list("Event")) == 8
+
+    # now ship a second segment and physically lose its record (the
+    # pre-fsync crash where the page cache dies too, e.g. power loss):
+    # recovery must show NO trace of it
+    seg2 = DecisionSegment.build(
+        [f"default/p{i}" for i in range(8)], [0] * 8, ["m1"],
+        evicts=[("default/p7", "Preempted")])
+    assert not rs2.apply_segment(seg2)["binds"]
+    srv2.kill()
+    live = sorted(glob.glob(str(tmp_path / "state.json.wal" / "*.wal")))[-1]
+    records, _ = read_records(live)
+    assert records, "segment record should be in the newest live segment"
+    with open(live, "r+b") as f:
+        f.truncate(os.path.getsize(live) - 10)  # tear the segment record
+
+    srv3 = _boot(tmp_path, port=srv.port)
+    try:
+        rs3 = RemoteStore(srv3.url)
+        pods = rs3.list("Pod")
+        # all-or-nothing: every pod still shows segment 1's world
+        assert all(p.node_name == "n0" and not p.deleting for p in pods)
+        assert len(rs3.list("Event")) == 8
+    finally:
+        srv3.stop()
+
+
+def test_segment_resubmit_is_idempotent_on_uid_block(tmp_path):
+    """A cut reply leaves a shipped segment's outcome unknown; the
+    applier re-ships the SAME segment (same reserved-uid block) — the
+    server must dedupe: no duplicate Events, no extra patch events, and
+    the final state identical to a single apply."""
+    srv = _boot(tmp_path)
+    try:
+        rs = RemoteStore(srv.url)
+        for i in range(4):
+            rs.create("Pod", build_pod(f"p{i}"))
+        seg = DecisionSegment.build(
+            [f"default/p{i}" for i in range(4)], [0] * 4, ["n0"],
+            evicts=[("default/p3", "Overcommit")])
+        watcher = RemoteStore(srv.url)
+        q = watcher.watch("Event")
+        assert not rs.apply_segment(seg)["binds"]
+        watcher.poll()
+        first = len(q)
+        assert first == 5
+        once = {(p.meta.key, p.node_name, p.deleting,
+                 p.meta.resource_version) for p in rs.list("Pod")}
+        events_once = sorted(e.meta.name for e in rs.list("Event"))
+
+        res = rs.apply_segment(seg)  # the retry
+        assert not res["binds"] and not res["evicts"]
+        watcher.poll()
+        assert len(q) == first, "resubmit fanned out duplicate events"
+        assert {(p.meta.key, p.node_name, p.deleting,
+                 p.meta.resource_version)
+                for p in rs.list("Pod")} == once
+        assert sorted(e.meta.name for e in rs.list("Event")) == events_once
+    finally:
+        srv.stop()
+
+
+def test_applier_reships_segment_through_one_connection_cut():
+    """The scheduler half of idempotent resubmission: a connection-level
+    cut during the segment ship triggers ONE re-ship of the same segment
+    instead of dropping the cycle's decisions to the err_log."""
+    from volcano_tpu.scheduler.apply import AsyncApplier
+
+    class _Cache:
+        def __init__(self, store):
+            self.store = store
+            self.errs = []
+
+        def _record_err(self, verb, key, e):
+            self.errs.append((verb, key, repr(e)))
+
+    class _CutOnceStore:
+        """Store façade whose first apply_segment dies mid-connection."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.calls = 0
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def apply_segment(self, seg):
+            self.calls += 1
+            if self.calls == 1:
+                raise ConnectionResetError("cut mid-request")
+            return self._inner.apply_segment(seg)
+
+    from volcano_tpu.store.store import Store
+
+    inner = Store()
+    for i in range(3):
+        inner.create("Pod", build_pod(f"p{i}"))
+    store = _CutOnceStore(inner)
+    cache = _Cache(store)
+    applier = AsyncApplier(cache)
+    try:
+        seg = DecisionSegment.build(
+            [f"default/p{i}" for i in range(3)], [0] * 3, ["n0"])
+        applier.submit_segment(seg)
+        assert applier.flush(timeout=10)
+        assert store.calls == 2
+        assert cache.errs == []
+        assert all(p.node_name == "n0" for p in inner.list("Pod"))
+        assert len(inner.list("Event")) == 3  # no dup events either
+    finally:
+        applier.stop()
+
+
+def test_restarted_server_relists_watchers_and_mirror_converges(tmp_path):
+    """Satellite: the restart twin of the chaos truncation test — an
+    ACTIVE ArrayMirror behind a crash/restart must StaleWatch-relist and
+    converge to store truth, then keep working incrementally."""
+    from volcano_tpu.scheduler.fastpath import ArrayMirror
+    from tests.helpers import build_node, build_podgroup
+
+    srv = _boot(tmp_path)
+    writer = RemoteStore(srv.url)
+    writer.create("Queue", Queue(meta=Metadata(name="default",
+                                               namespace="")))
+    writer.create("Node", build_node("n0"))
+    writer.create("PodGroup", build_podgroup("pg", min_member=1))
+    writer.create("Pod", build_pod("p0", group="pg"))
+
+    mirror_store = RemoteStore(srv.url)
+    m = ArrayMirror(mirror_store, "volcano-tpu", "default")
+    m.drain()
+    assert int(m.p_live.sum()) == 1 and m.stale_relists == 0
+
+    # mutate while the mirror's cursor lags, then kill + recover
+    writer.create("Pod", build_pod("p1", group="pg"))
+    writer.delete("Pod", "default/p0")
+    srv.kill()
+    srv2 = _boot(tmp_path, port=srv.port)
+    try:
+        m.drain()
+        assert m.stale_relists == 1
+        assert int(m.p_live.sum()) == 1
+        assert "default/p1" in m.pods.key_row
+        assert "default/p0" not in m.pods.key_row
+        w2 = RemoteStore(srv2.url)
+        w2.create("Pod", build_pod("p2", group="pg"))
+        m.drain()
+        assert int(m.p_live.sum()) == 2 and m.stale_relists == 1
+    finally:
+        srv2.stop()
+
+
+# -- observability satellites (tier-1) -----------------------------------------
+
+
+def test_wal_metrics_monotonic_and_exposed(tmp_path):
+    from volcano_tpu.scheduler import metrics
+
+    a0 = metrics.get_counter("volcano_store_wal_appended_records_total")
+    f0 = metrics.get_counter("volcano_store_wal_fsync_total")
+    r0 = metrics.get_counter("volcano_store_wal_recovery_replayed_records")
+    srv = _boot(tmp_path)
+    rs = RemoteStore(srv.url)
+    rs.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    rs.create("Queue", Queue(meta=Metadata(name="r", namespace="")))
+    a1 = metrics.get_counter("volcano_store_wal_appended_records_total")
+    f1 = metrics.get_counter("volcano_store_wal_fsync_total")
+    assert a1 >= a0 + 2 and f1 >= f0 + 1
+    srv.kill()
+    srv2 = _boot(tmp_path, port=srv.port)
+    try:
+        r1 = metrics.get_counter(
+            "volcano_store_wal_recovery_replayed_records")
+        assert r1 >= r0 + 2
+        # counters only ever grow
+        assert metrics.get_counter(
+            "volcano_store_wal_appended_records_total") >= a1
+        text = metrics.expose_text()
+        for name in ("volcano_store_wal_appended_records_total",
+                     "volcano_store_wal_fsync_total",
+                     "volcano_store_wal_recovery_replayed_records"):
+            assert name in text
+    finally:
+        srv2.stop()
+
+
+def test_recovery_emits_store_recover_span(tmp_path):
+    srv = _boot(tmp_path)
+    rs = RemoteStore(srv.url)
+    rs.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    rs.create("Queue", Queue(meta=Metadata(name="r", namespace="")))
+    srv.kill()
+    tracer = trace.arm(trace.Tracer(ring=1024))
+    try:
+        srv2 = _boot(tmp_path, port=srv.port)
+        srv2.stop()
+        spans = [r for r in tracer.records()
+                 if r.get("name") == "store.recover"]
+        assert spans, "recovery did not trace store.recover"
+        attrs = spans[-1]["attrs"]
+        assert attrs["replayed"] == 2 and attrs["torn_tails"] == 0
+    finally:
+        trace.disarm()
+
+
+# -- graceful shutdown satellite (real subprocess) -----------------------------
+
+
+def test_sigterm_flushes_state_and_wal_before_exit(tmp_path):
+    """Satellite regression: run_apiserver must flush state (and fsync
+    the WAL tail) on SIGTERM, not only on clean ``vtctl down`` — and a
+    write ACKed moments before the signal must be in the state file."""
+    import signal
+    import subprocess
+    import sys
+
+    state = str(tmp_path / "state.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    p = subprocess.Popen(
+        [sys.executable, "-m", "volcano_tpu.cli", "apiserver",
+         "--port", "0", "--state", state, "--wal"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        url = p.stdout.readline().strip().rsplit(" ", 1)[-1]
+        assert wait_healthy(url, timeout=30)
+        rs = RemoteStore(url)
+        rs.create("Queue", Queue(meta=Metadata(name="sigterm-q",
+                                               namespace="")))
+        p.send_signal(signal.SIGTERM)
+        assert p.wait(timeout=30) == 0
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    data = json.load(open(state))
+    names = [q["meta"]["name"] for q in data["kinds"]["Queue"]]
+    assert "sigterm-q" in names
+    # the shutdown checkpoint truncated the WAL: recovery replays nothing
+    srv = StoreServer(state_path=state, wal=True)
+    assert srv.store.get("Queue", "/sigterm-q") is not None
+    assert srv.wal.replayed_records == 0
+    srv.wal.sync_close()
+
+
+# -- the seeded in-process crash-kill storms (tier-1 gate) ---------------------
+
+
+def _raise_injected(point, rule):
+    raise InjectedCrash(f"chaos abort at {point}")
+
+
+@pytest.fixture
+def injected_aborts():
+    chaos.set_abort_handler(_raise_injected)
+    try:
+        yield
+    finally:
+        chaos.set_abort_handler(None)
+        chaos.arm_crash_plan(None)
+
+
+class CrashPlane:
+    """Controller + scheduler + kubelet threads over real HTTP with the
+    daemon outage discipline, PLUS crash-kill semantics: a component that
+    dies of InjectedCrash is rebuilt from scratch (fresh RemoteStore,
+    full relist) — the in-process analogue of systemd restarting a
+    SIGKILLed unit."""
+
+    def __init__(self, url):
+        self.url = url
+        self.stop = threading.Event()
+        self.threads = []
+        self.crashes = []  # unexpected deaths (fail the test)
+        self.restarts = {"controller": 0, "scheduler": 0, "kubelet": 0}
+
+    def _controller_loop(self):
+        retry = Backoff(base=0.02, cap=0.3, seed=41)
+        ctl = None
+        while not self.stop.is_set():
+            try:
+                if ctl is None:
+                    ctl = JobController(RemoteStore(self.url))
+                ctl.pump()
+                retry.reset()
+            except InjectedCrash:
+                ctl = None  # killed mid-gang: restart and relist
+                self.restarts["controller"] += 1
+                continue
+            except StaleWatch:
+                ctl = None
+                continue
+            except TRANSIENT:
+                ctl = None
+                retry.sleep()
+                continue
+            self.stop.wait(0.02)
+
+    def _scheduler_loop(self):
+        retry = Backoff(base=0.02, cap=0.3, seed=42)
+        sched = None
+        while not self.stop.is_set():
+            try:
+                if sched is None:
+                    conf = full_conf()
+                    # deployed default (run_scheduler): async batched
+                    # application — the drain crash point lives in the
+                    # applier thread
+                    conf.apply_mode = "async"
+                    sched = Scheduler(RemoteStore(self.url), conf=conf)
+                sched.run_once()
+                retry.reset()
+                # the drain crash kills the APPLIER thread (the
+                # scheduler's in-process "process"): treat a dead applier
+                # as a dead scheduler and restart the whole unit, exactly
+                # what systemd does to the real daemon
+                applier = getattr(sched.cache, "applier", None)
+                if applier is not None and not applier._thread.is_alive():
+                    sched = None
+                    self.restarts["scheduler"] += 1
+                    continue
+            except InjectedCrash:
+                sched = None
+                self.restarts["scheduler"] += 1
+                continue
+            except TRANSIENT:
+                retry.sleep()
+                continue
+            self.stop.wait(0.02)
+
+    def _kubelet_loop(self):
+        from volcano_tpu.cli.daemons import kubelet_step
+
+        retry = Backoff(base=0.02, cap=0.3, seed=43)
+        store = None
+        while not self.stop.is_set():
+            try:
+                if store is None:
+                    store = RemoteStore(self.url)
+                kubelet_step(store, time.time())
+                retry.reset()
+            except InjectedCrash:
+                store = None  # killed mid-ready-flip: restart
+                self.restarts["kubelet"] += 1
+                continue
+            except TRANSIENT:
+                retry.sleep()
+                continue
+            self.stop.wait(0.02)
+
+    def _guard(self, fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — surfaced in teardown
+                trace.crash_dump("crash-plane-loop")
+                self.crashes.append(repr(e))
+        return run
+
+    def start(self):
+        for fn in (self._controller_loop, self._scheduler_loop,
+                   self._kubelet_loop):
+            t = threading.Thread(target=self._guard(fn), daemon=True)
+            t.start()
+            self.threads.append(t)
+        return self
+
+    def shutdown(self):
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=30)
+        assert not self.crashes, f"crash-plane loop died: {self.crashes}"
+
+
+def _crash_storm(tmp_path, server_plan=None, process_plan=None,
+                 expect_fire=None, n_jobs=2):
+    """One seeded crash-kill storm over a WAL-backed apiserver.
+
+    ``server_plan``: crash.server.* rules armed ON the server — when one
+    fires (the handler thread dies of InjectedCrash), the harness kills
+    the server process-style and boots a replacement on the same
+    port/state/WAL.  ``process_plan``: crash.{scheduler,controller,
+    kubelet}.* rules armed in-process — the component dies and the
+    CrashPlane restarts it.  Returns final placements.
+    """
+    state = str(tmp_path / "state.json")
+    srv = StoreServer(state_path=state, save_interval=0.25,
+                      wal=True).start()
+    port = srv.port
+    plan = None
+    if server_plan is not None:
+        plan = FaultPlan.from_dict(server_plan)
+        srv.arm_chaos(plan)
+    if process_plan is not None:
+        plan = chaos.arm_crash_plan(FaultPlan.from_dict(process_plan))
+    cp = CrashPlane(srv.url)
+    try:
+        assert wait_healthy(srv.url, timeout=10)
+        seed_rs = RemoteStore(srv.url)
+        _submit(seed_rs, Queue(meta=Metadata(name="default",
+                                             namespace="")), kind="Queue")
+        for i in range(3):
+            _submit(seed_rs, Node(
+                meta=Metadata(name=f"n{i}", namespace=""),
+                allocatable=Resource.from_resource_list(
+                    {"cpu": "4", "memory": "8Gi", "pods": 110})),
+                kind="Node")
+        cp.start()
+
+        client = RemoteStore(srv.url)
+        acked_jobs = []
+        for i in range(n_jobs):
+            job = _mk_job(f"cj{i}", 2)
+            _submit(client, job)
+            acked_jobs.append(f"soak/cj{i}")
+            if server_plan is not None and plan is not None:
+                # the seeded server kill may land while this gang is in
+                # flight: poll for the fire and crash-restart the server
+                deadline = time.monotonic() + 30
+                while (time.monotonic() < deadline
+                       and srv is not None
+                       and not any(r["fires"] for r in plan.stats())):
+                    if _job_running(client, f"soak/cj{i}"):
+                        break
+                    time.sleep(0.02)
+                if srv is not None and any(
+                        r["fires"] for r in plan.stats()):
+                    srv.kill()
+                    srv = StoreServer(port=port, state_path=state,
+                                      save_interval=0.25, wal=True).start()
+                    assert wait_healthy(srv.url, timeout=10)
+            _wait_running(client, f"soak/cj{i}", deadline=120)
+
+        if expect_fire:
+            assert plan is not None and any(
+                r["fires"] for r in plan.stats()), (
+                "the seeded crash never fired: " + repr(plan.stats()))
+
+        # every acked submission survived the storm
+        for key in acked_jobs:
+            job = client.get("Job", key)
+            assert job is not None
+            assert job.status.state.phase == JobPhase.RUNNING
+        _check_invariants(client)
+        assert statement.outstanding() == 0
+        return _placements(client)
+    finally:
+        cp.shutdown()
+        if srv is not None:
+            srv.stop()
+        chaos.arm_crash_plan(None)
+
+
+def _job_running(client, key):
+    try:
+        job = client.get("Job", key)
+    except TRANSIENT:
+        return False
+    return job is not None and job.status.state.phase == JobPhase.RUNNING
+
+
+PLAN_SERVER_PRE_FSYNC = {
+    "seed": 701,
+    "rules": [{"point": "crash.server.pre_fsync", "action": "abort",
+               "after": 6, "count": 1}],
+}
+PLAN_SERVER_POST_FSYNC = {
+    "seed": 702,
+    "rules": [{"point": "crash.server.post_fsync", "action": "abort",
+               "after": 9, "count": 1}],
+}
+PLAN_SCHED_DRAIN = {
+    "seed": 703,
+    "rules": [{"point": "crash.scheduler.drain", "action": "abort",
+               "count": 1}],
+}
+PLAN_CTL_GANG = {
+    "seed": 704,
+    "rules": [{"point": "crash.controller.gang_create", "action": "abort",
+               "after": 1, "count": 1}],
+}
+PLAN_KUBELET_READY = {
+    "seed": 705,
+    "rules": [{"point": "crash.kubelet.ready", "action": "abort",
+               "after": 1, "count": 1}],
+}
+
+
+#: the aborted thread dying of InjectedCrash IS the storm's mechanism —
+#: pytest's thread-exception watcher would report it as noise
+_expected_thread_death = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+
+@_expected_thread_death
+def test_crash_storm_server_pre_and_post_fsync(tmp_path, injected_aborts):
+    baseline = _crash_storm(tmp_path / "base")
+    pre = _crash_storm(tmp_path / "pre",
+                       server_plan=PLAN_SERVER_PRE_FSYNC, expect_fire=True)
+    post = _crash_storm(tmp_path / "post",
+                        server_plan=PLAN_SERVER_POST_FSYNC, expect_fire=True)
+    assert pre == baseline
+    assert post == baseline
+    assert len(baseline) == 4  # 2 gangs x 2 replicas, all Running
+
+
+@_expected_thread_death
+def test_crash_storm_scheduler_mid_drain(tmp_path, injected_aborts):
+    baseline = _crash_storm(tmp_path / "base")
+    stormy = _crash_storm(tmp_path / "storm",
+                          process_plan=PLAN_SCHED_DRAIN, expect_fire=True)
+    assert stormy == baseline
+
+
+@_expected_thread_death
+def test_crash_storm_controller_mid_gang_and_kubelet_mid_ready(
+        tmp_path, injected_aborts):
+    baseline = _crash_storm(tmp_path / "base")
+    gang = _crash_storm(tmp_path / "gang",
+                        process_plan=PLAN_CTL_GANG, expect_fire=True)
+    ready = _crash_storm(tmp_path / "ready",
+                         process_plan=PLAN_KUBELET_READY, expect_fire=True)
+    assert gang == baseline
+    assert ready == baseline
+
+
+# -- the real-subprocess SIGKILL storms (make crash-soak) ----------------------
+
+
+def _spawn_daemon(entry, comp, url, env, extra=()):
+    import subprocess
+
+    args = {"controller": ["--period", "0.05"],
+            "scheduler": ["--period", "0.1", "--metrics-port", "-1"],
+            "kubelet": ["--period", "0.05"]}[comp]
+    return subprocess.Popen(
+        entry + [comp, "--server", url] + args + list(extra),
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env)
+
+
+def _sigkill_storm(tmp_path, crash_env_for=None, crash_plan=None,
+                   n_jobs=2):
+    """Real OS processes, real SIGKILL: the component named by
+    ``crash_env_for`` boots with a ``crash.*`` abort plan in
+    VOLCANO_TPU_CHAOS (default abort handler = SIGKILL self); the
+    harness restarts any dead component, server included, and the
+    workload must converge.  Returns final placements."""
+    import signal
+    import subprocess
+    import sys
+
+    state = str(tmp_path / "state.json")
+    base_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "VOLCANO_TPU_BACKEND": "host"}
+    base_env.pop("VOLCANO_TPU_CHAOS", None)
+    entry = [sys.executable, "-m", "volcano_tpu.cli"]
+
+    def env_for(comp):
+        if comp == crash_env_for and crash_plan is not None:
+            return {**base_env, "VOLCANO_TPU_CHAOS": json.dumps(crash_plan)}
+        return dict(base_env)
+
+    def start_api(port):
+        p = subprocess.Popen(
+            entry + ["apiserver", "--port", str(port), "--state", state,
+                     "--wal"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env_for("apiserver"))
+        url = p.stdout.readline().strip().rsplit(" ", 1)[-1]
+        assert wait_healthy(url, timeout=30)
+        return p, url
+
+    procs = {}
+    api, url = start_api(0)
+    port = int(url.rsplit(":", 1)[1])
+    procs["apiserver"] = api
+    try:
+        for comp in ("controller", "scheduler", "kubelet"):
+            procs[comp] = _spawn_daemon(entry, comp, url, env_for(comp))
+
+        client = RemoteStore(url)
+        _submit(client, Queue(meta=Metadata(name="default", namespace="")),
+                kind="Queue")
+        for i in range(3):
+            _submit(client, Node(
+                meta=Metadata(name=f"n{i}", namespace=""),
+                allocatable=Resource.from_resource_list(
+                    {"cpu": "4", "memory": "8Gi", "pods": 110})),
+                kind="Node")
+
+        kills = 0
+        acked = []
+        for i in range(n_jobs):
+            _submit(client, _mk_job(f"kj{i}", 2))
+            acked.append(f"soak/kj{i}")
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                # restart anything the seeded abort SIGKILLed — the
+                # harness IS the process supervisor here
+                for comp, p in list(procs.items()):
+                    if p.poll() is not None:
+                        kills += 1
+                        if comp == "apiserver":
+                            # post-SIGKILL recovery on the same state+WAL
+                            procs[comp], url2 = start_api(port)
+                            assert url2 == url
+                        else:
+                            procs[comp] = _spawn_daemon(
+                                entry, comp, url, dict(base_env))
+                if _job_running(client, f"soak/kj{i}"):
+                    break
+                time.sleep(0.1)
+            _wait_running(client, f"soak/kj{i}", deadline=60)
+
+        if crash_plan is not None:
+            assert kills >= 1, "the seeded SIGKILL never landed"
+        for key in acked:
+            job = client.get("Job", key)
+            assert job is not None
+            assert job.status.state.phase == JobPhase.RUNNING
+        _check_invariants(client)
+        return _placements(client)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+SIGKILL_SERVER_PLAN = {
+    "seed": 801,
+    "rules": [
+        {"point": "crash.server.pre_fsync", "action": "abort",
+         "after": 10, "count": 1},
+        {"point": "crash.server.post_fsync", "action": "abort",
+         "after": 25, "count": 1},
+    ],
+}
+SIGKILL_SCHED_PLAN = {
+    "seed": 802,
+    "rules": [{"point": "crash.scheduler.drain", "action": "abort",
+               "count": 1}],
+}
+SIGKILL_CTL_PLAN = {
+    "seed": 803,
+    "rules": [{"point": "crash.controller.gang_create", "action": "abort",
+               "after": 1, "count": 1}],
+}
+
+
+@pytest.mark.slow
+def test_sigkill_storm_server_pre_and_post_fsync(tmp_path):
+    baseline = _sigkill_storm(tmp_path / "base")
+    stormy = _sigkill_storm(tmp_path / "storm",
+                            crash_env_for="apiserver",
+                            crash_plan=SIGKILL_SERVER_PLAN)
+    assert stormy == baseline
+    assert len(baseline) == 4
+
+
+@pytest.mark.slow
+def test_sigkill_storm_scheduler_mid_drain(tmp_path):
+    baseline = _sigkill_storm(tmp_path / "base")
+    stormy = _sigkill_storm(tmp_path / "storm",
+                            crash_env_for="scheduler",
+                            crash_plan=SIGKILL_SCHED_PLAN)
+    assert stormy == baseline
+
+
+@pytest.mark.slow
+def test_sigkill_storm_controller_mid_gang(tmp_path):
+    baseline = _sigkill_storm(tmp_path / "base")
+    stormy = _sigkill_storm(tmp_path / "storm",
+                            crash_env_for="controller",
+                            crash_plan=SIGKILL_CTL_PLAN)
+    assert stormy == baseline
+
+
+# -- review-hardening regressions ----------------------------------------------
+
+
+def test_failed_fsync_does_not_mark_records_synced(tmp_path, monkeypatch):
+    """A failed group-commit fsync must NOT advance the synced watermark:
+    the leader's caller sees the error, and a follower (or a retry)
+    re-fsyncs the range instead of treating it as durable."""
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    t = wal.append({"i": 0})
+    real_fsync = os.fsync
+    calls = {"n": 0}
+
+    def flaky(fd):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(5, "injected EIO")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", flaky)
+    with pytest.raises(OSError):
+        wal.commit(t)
+    assert wal.fsync_total == 0  # nothing durable yet
+    wal.commit(t)  # retry succeeds and covers the range
+    assert wal.fsync_total == 1
+    monkeypatch.setattr(os, "fsync", real_fsync)
+    wal.sync_close()
+    w2 = WriteAheadLog(str(tmp_path / "wal"))
+    assert [r["i"] for r in w2.replay(0)] == [0]
+    w2.sync_close()
+
+
+def test_wal_off_boot_absorbs_acked_tail_and_retires_segments(tmp_path):
+    """Dropping back to interval persistence must not silently lose the
+    acked WAL tail of a crashed WAL-on life: a WAL-OFF boot replays the
+    leftover segments, snapshots immediately, and retires them; a later
+    WAL-on boot starts clean and stamps a floored checkpoint before
+    serving (so a floorless snapshot + segments can only ever mean
+    already-absorbed staleness — safe to drop)."""
+    state = str(tmp_path / "state.json")
+    # life 1: WAL-on, ACKs a create, crashes without ever checkpointing
+    srv1 = StoreServer(state_path=state, save_interval=3600, wal=True)
+    srv1.store.create("Queue", Queue(meta=Metadata(name="acked",
+                                                   namespace="")))
+    with srv1.lock:
+        srv1._pump_log()
+        srv1._wal_append({
+            "op": "create", "kind": "Queue",
+            "object": {"meta": {"name": "acked", "namespace": "",
+                                "resource_version": 1}},
+        })
+    srv1.wal.commit()
+    srv1.kill()
+    assert not os.path.exists(state)  # nothing but the WAL survived
+    # life 2: WAL-off — the acked tail is absorbed, made durable, and
+    # the segments retired
+    srv2 = StoreServer(state_path=state, save_interval=3600)
+    assert srv2.store.get("Queue", "/acked") is not None
+    assert json.load(open(state))["kinds"]["Queue"]
+    assert glob.glob(str(tmp_path / "state.json.wal" / "*.wal")) == []
+    srv2.store.create("Queue", Queue(meta=Metadata(name="newer",
+                                                   namespace="")))
+    srv2.flush_state()
+    srv2._killed = True  # abandon
+    # life 3: WAL-on again — clean directory, nothing to replay, and the
+    # boot stamps a floored checkpoint before serving
+    srv3 = StoreServer(state_path=state, save_interval=3600, wal=True)
+    assert srv3.store.get("Queue", "/acked") is not None
+    assert srv3.store.get("Queue", "/newer") is not None
+    assert srv3.wal.replayed_records == 0
+    assert "wal_floor" in json.load(open(state))
+    srv3.wal.sync_close()
+
+
+def test_drop_below_never_unlinks_the_live_segment(tmp_path):
+    """A snapshot restored from backup can carry a wal_floor ABOVE a
+    rebuilt directory's indices: recovery must not unlink its own live
+    segment, or every later acked append lands in an anonymous inode."""
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog(d)  # live segment index 1
+    wal.drop_below(7)  # floor far beyond this life's index
+    assert wal.segment_indices() == [1]
+    wal.append({"i": 0})
+    wal.commit()
+    wal.sync_close()
+    w2 = WriteAheadLog(d)
+    assert [r["i"] for r in w2.replay(0)] == [0]
+    w2.sync_close()
+
+
+def test_floor_stamp_written_even_for_empty_inherited_snapshot(tmp_path):
+    """The boot-time wal_floor stamp must not be skipped when the
+    inherited floorless snapshot has no objects at all — the floor, not
+    the kinds, is what makes the next crash recoverable."""
+    state = str(tmp_path / "state.json")
+    with open(state, "w") as f:
+        json.dump({"seq": 5, "rv": 5, "store_uid": "u", "kinds": {}}, f)
+    srv = StoreServer(state_path=state, save_interval=3600, wal=True)
+    data = json.load(open(state))
+    assert "wal_floor" in data and data["seq"] == 5
+    srv.wal.sync_close()

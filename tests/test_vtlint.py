@@ -1047,3 +1047,95 @@ def test_locksan_condition_wait_notify(monkeypatch):
         assert seen == [True]
     finally:
         locksan.reset_graph()
+
+
+# --- rule: crash-safe-io -----------------------------------------------------
+
+
+def test_crash_safe_io_fires_on_bare_state_write(tmp_path):
+    findings = _lint(tmp_path, "store/server.py", """
+        def flush(self, path, payload):
+            with open(path, "w") as f:
+                json.dump(payload, f)
+    """, select=["crash-safe-io"])
+    assert _rules_of(findings) == ["crash-safe-io"]
+    assert "os.fsync and os.replace" in findings[0].message
+
+
+def test_crash_safe_io_fires_on_rename_without_fsync(tmp_path):
+    # the exact pre-PR-7 flush_state shape: atomic rename, no fsync —
+    # a crash can still publish a file whose blocks never hit disk
+    findings = _lint(tmp_path, "store/server.py", """
+        def flush(self, path, payload):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+    """, select=["crash-safe-io"])
+    assert _rules_of(findings) == ["crash-safe-io"]
+    assert "os.fsync" in findings[0].message
+    assert "os.replace" not in findings[0].message.split("without ")[1].split(" in")[0]
+
+
+def test_crash_safe_io_near_misses_stay_quiet(tmp_path):
+    # the full protocol: temp write + fsync + atomic rename
+    assert _lint(tmp_path, "store/server.py", """
+        def flush(self, path, payload):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+    """, select=["crash-safe-io"]) == []
+    # append-only WAL segments (per-record CRC protocol) are exempt
+    assert _lint(tmp_path, "store/wal.py", """
+        def open_segment(self, path):
+            self._f = open(path, "ab", buffering=0)
+    """, select=["crash-safe-io"]) == []
+    # reads are not writes
+    assert _lint(tmp_path, "store/server.py", """
+        def load(self, path):
+            with open(path) as f:
+                return json.load(f)
+    """, select=["crash-safe-io"]) == []
+    # the identical bare write OUTSIDE the store persistence modules
+    assert _lint(tmp_path, "scheduler/metrics.py", """
+        def dump(path, payload):
+            with open(path, "w") as f:
+                json.dump(payload, f)
+    """, select=["crash-safe-io"]) == []
+    # non-literal mode stays quiet (the rule targets bare "w" rewrites)
+    assert _lint(tmp_path, "store/server.py", """
+        def write(self, path, mode, data):
+            with open(path, mode) as f:
+                f.write(data)
+    """, select=["crash-safe-io"]) == []
+
+
+def test_crash_safe_io_module_scope_and_suppression(tmp_path):
+    # module-level bare write fires too
+    findings = _lint(tmp_path, "store/seed.py", """
+        with open("state.json", "w") as f:
+            f.write("{}")
+    """, select=["crash-safe-io"])
+    assert _rules_of(findings) == ["crash-safe-io"]
+    # ... and a compliant FUNCTION elsewhere in the file must not excuse
+    # the module-level write (tails are scoped per level)
+    findings = _lint(tmp_path, "store/seed.py", """
+        def good(path, payload):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+        with open("state.json", "w") as f:
+            f.write("{}")
+    """, select=["crash-safe-io"])
+    assert _rules_of(findings) == ["crash-safe-io"]
+    # a justified line suppression is honored
+    assert _lint(tmp_path, "store/seed.py", """
+        with open("state.json", "w") as f:  # vtlint: disable=crash-safe-io
+            f.write("{}")
+    """, select=["crash-safe-io"]) == []

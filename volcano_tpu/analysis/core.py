@@ -92,6 +92,7 @@ def _load_rule_modules() -> None:
         rules_epsilon,
         rules_excepts,
         rules_hotpath,
+        rules_io,
         rules_parity,
         rules_registry,
         rules_residue,

@@ -1,0 +1,109 @@
+"""crash-safe-io: state-file writes in the store must be crash-atomic.
+
+The store's durability layer (PR 7) promises that a process killed at ANY
+instant leaves either the old state file or the new one — never a torn
+half-written JSON that recovery chokes on.  The protocol is the standard
+one: write to a temp path, ``os.fsync`` the descriptor, then
+``os.replace`` onto the real path (the WAL's own segment files are
+append-only with per-record CRCs, a different protocol, and are exempt by
+mode).  This rule fences the regression in the store persistence modules
+(``volcano_tpu/store/``): a bare ``open(path, "w")`` in a function that
+never fsyncs or never atomically renames is a silent crash-consistency
+hole — exactly the shape ``flush_state`` had before the WAL PR fixed it.
+
+Scope is the enclosing function: the write, its fsync, and its rename
+belong together (that is the protocol), so a helper that only opens is
+flagged until it carries the whole discipline or a justified line
+suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    dotted_name,
+    rule,
+    walk_functions,
+)
+
+
+def _open_write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string when ``call`` is a truncating file write
+    (``open(..., "w"/"wb"/...)``), else None.  Append/read modes and
+    non-literal modes stay quiet — the rule targets bare state rewrites."""
+    if not (isinstance(call.func, ast.Name) and call.func.id == "open"):
+        return None
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if isinstance(mode, str) and mode.startswith("w"):
+        return mode
+    return None
+
+
+def _call_tails(node: ast.AST, exclude=None) -> set:
+    """Last dotted segments of every call in ``node``'s subtree.
+    ``exclude`` (node-id set) drops subtrees — the module scope must not
+    be excused by an fsync/replace living inside some function's body."""
+    tails = set()
+    for sub in ast.walk(node):
+        if exclude is not None and id(sub) in exclude:
+            continue
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func)
+            if name:
+                tails.add(name.split(".")[-1])
+    return tails
+
+
+@rule(
+    "crash-safe-io",
+    "bare open(..., 'w') state write in a store persistence module without "
+    "the write-temp -> os.fsync -> os.replace protocol in the same "
+    "function — a crash mid-write leaves a torn state file recovery "
+    "cannot parse; fsync+atomically-rename (flush_state is the model), or "
+    "suppress with the justification on the line",
+)
+def check_crash_safe_io(ctx: FileContext) -> Iterable[Finding]:
+    if "store" not in ctx.dir_parts:
+        return
+    fns = list(walk_functions(ctx.tree))
+    in_fn = set()
+    for fn in fns:
+        for sub in ast.walk(fn):
+            in_fn.add(id(sub))
+    for scope in fns + [ctx.tree]:
+        tails = None
+        for sub in ast.walk(scope):
+            if scope is ctx.tree and id(sub) in in_fn:
+                continue  # module scope covers only top-level statements
+            if not isinstance(sub, ast.Call):
+                continue
+            mode = _open_write_mode(sub)
+            if mode is None:
+                continue
+            if tails is None:
+                tails = _call_tails(
+                    scope, exclude=in_fn if scope is ctx.tree else None)
+            missing = []
+            if "fsync" not in tails:
+                missing.append("os.fsync")
+            if not ({"replace", "rename"} & tails):
+                missing.append("os.replace")
+            if not missing:
+                continue
+            human = " and ".join(missing)
+            yield ctx.finding(
+                "crash-safe-io",
+                sub,
+                f"open(..., {mode!r}) state write without {human} in the "
+                "same function: a crash mid-write tears the file — use "
+                "write-temp -> fsync -> atomic-rename",
+            )

@@ -23,6 +23,11 @@ faultpoint          where it fires
                     autoscaler (ElasticController._provision; ``path`` is
                     the would-be node name, so ``match.path`` can target
                     one pool or member)
+``crash.*``         seeded process aborts (see FAULTPOINTS): the store
+                    server around its WAL fsync and mid-segment-apply,
+                    the scheduler's applier mid-drain, the controller
+                    mid-gang-create, the kubelet mid-ready-flip — the
+                    crash-kill storms in tests/test_crash_recovery.py
 ==================  ==========================================================
 
 and **actions**:
@@ -51,6 +56,12 @@ action              effect (valid faultpoints)
                     the autoscaler retries next pump (elastic.provision)
 ``delay``           push the node's Provisioning->Ready flip ``arg``
                     seconds later (elastic.provision)
+``abort``           kill the process AT the faultpoint: SIGKILL-self by
+                    default (real-subprocess crash storms), or raise
+                    :class:`InjectedCrash` when a test installed an abort
+                    handler (:func:`set_abort_handler`) — the in-process
+                    tier-1 storms restart just the aborted component
+                    (crash.*)
 ==================  ==========================================================
 
 Determinism contract: rule selection is pure counter + seeded-RNG state.
@@ -83,6 +94,18 @@ FAULTPOINTS: Dict[str, tuple] = {
     "client.request": ("os_error", "delay"),
     "leader.clock": ("skew",),
     "elastic.provision": ("fail", "delay"),
+    # crash-kill family: seeded process aborts at the moments a crash is
+    # most likely to expose a durability/atomicity hole.  The only valid
+    # action is ``abort`` — SIGKILL-self by default (real-subprocess
+    # storms), or whatever the installed abort handler does (the
+    # in-process tier-1 storms raise InjectedCrash so the harness can
+    # restart just that component).
+    "crash.server.pre_fsync": ("abort",),     # WAL record written, not synced
+    "crash.server.post_fsync": ("abort",),    # synced, 2xx not yet sent
+    "crash.server.segment_apply": ("abort",),  # store applied, log not yet
+    "crash.scheduler.drain": ("abort",),      # applier mid-drain, pre-ship
+    "crash.controller.gang_create": ("abort",),  # gang partially created
+    "crash.kubelet.ready": ("abort",),        # mid Pending->Running flip
 }
 
 ENV_VAR = "VOLCANO_TPU_CHAOS"
@@ -240,6 +263,70 @@ def env_plan() -> Optional[FaultPlan]:
         raw = os.environ.get(ENV_VAR, "")
         _env_plan_cache.append(parse_plan(raw) if raw else None)
     return _env_plan_cache[0]
+
+
+class InjectedCrash(SystemExit):
+    """An in-process stand-in for SIGKILL, raised by the test abort
+    handler.  Derives from SystemExit on purpose: the broad ``except
+    Exception`` wire-boundary guards cannot swallow it (a crash must not
+    turn into a 500 reply), with-blocks still unwind their locks on the
+    way out (the one thing a thread-level "kill" cannot avoid), and a
+    thread dying of SystemExit is silent."""
+
+
+#: process-wide abort behavior for crash.* faultpoints: None = the real
+#: thing (SIGKILL self — subprocess storm mode); tests install a handler
+#: that raises InjectedCrash so the harness can restart one component
+_abort_handler: Optional[Callable[[str, FaultRule], None]] = None
+
+#: in-process crash plan (tests/harness): checked by crash_point alongside
+#: the env plan, so tier-1 storms can arm crash rules without env churn
+_crash_plan: Optional[FaultPlan] = None
+
+
+def set_abort_handler(fn: Optional[Callable[[str, FaultRule], None]]) -> None:
+    global _abort_handler
+    _abort_handler = fn
+
+
+def arm_crash_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Arm (None: disarm) an in-process crash plan for this process's
+    crash.* faultpoints.  Returns the plan so callers can poll its
+    counters (``plan.stats()``) to see the kill land."""
+    global _crash_plan
+    _crash_plan = plan
+    return plan
+
+
+def do_abort(point: str, rule: FaultRule) -> None:
+    """Execute one fired crash rule: the installed handler, or the real
+    SIGKILL.  Never returns normally under the default handler."""
+    if _abort_handler is not None:
+        _abort_handler(point, rule)
+        return
+    import signal
+
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def fire_crash(plan: Optional[FaultPlan], point: str,
+               method: str = "", path: str = "") -> None:
+    """Fire ``point`` on an explicit plan (e.g. the StoreServer's
+    /chaos-armed plan) and abort if a rule matches.  Disarmed cost: one
+    None check."""
+    if plan is None or not plan.has_point(point):
+        return
+    rule = plan.fire(point, method=method, path=path)
+    if rule is not None and rule.action == "abort":
+        do_abort(point, rule)
+
+
+def crash_point(point: str, method: str = "", path: str = "") -> None:
+    """Fire ``point`` on the ambient plans — the in-process crash plan
+    (tests) and the process-wide env plan (subprocess daemons).  One
+    attribute check each when disarmed, the chaos-guard discipline."""
+    fire_crash(_crash_plan, point, method=method, path=path)
+    fire_crash(env_plan(), point, method=method, path=path)
 
 
 def chaos_clock(plan: FaultPlan,

@@ -66,16 +66,20 @@ def _elector(store, component: str, identity: str, enabled: bool):
 
 
 def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = True,
-                  state: str = "", announce=print) -> None:
+                  state: str = "", wal: bool = False, announce=print) -> None:
     """``state`` names a JSON file the server persists all objects to (the
     etcd analogue): a restarted apiserver resumes with every CRD, and
-    clients behind the restart relist."""
+    clients behind the restart relist.  ``wal=True`` adds the segment
+    write-ahead log beside it (``<state>.wal/``): every ACKed mutation is
+    fsynced before its 2xx, so a SIGKILLed apiserver recovers with zero
+    acked loss (store/wal.py)."""
     from volcano_tpu import trace
     from volcano_tpu.api.objects import Metadata, Queue
     from volcano_tpu.store.server import StoreServer
 
     trace.set_component("apiserver")
-    srv = StoreServer(host=host, port=port, state_path=state or None)
+    srv = StoreServer(host=host, port=port, state_path=state or None,
+                      wal=wal)
     if default_queue and srv.store.get("Queue", "/default") is None:
         srv.store.create("Queue", Queue(meta=Metadata(name="default", namespace="")))
     announce(f"apiserver listening on {srv.url}", flush=True)
@@ -88,7 +92,12 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
         srv.serve_forever()
     finally:
         # serve_forever has returned, so stop() is safe here: it joins the
-        # saver thread and performs the final state flush in one place
+        # saver thread, flushes state, and fsyncs the WAL tail in one
+        # place.  A SECOND SIGTERM during that final flush would raise
+        # SystemExit inside it and abort the very write that makes the
+        # shutdown graceful — mask the signal for the flush (SIGKILL
+        # still works; that is what the WAL recovers from).
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
         srv.stop()
 
 
@@ -297,6 +306,12 @@ def kubelet_step(store, now: float) -> None:
         if pod.deleting:
             store.delete("Pod", pod.meta.key)
         elif pod.node_name and pod.phase == PodPhase.PENDING:
+            from volcano_tpu import chaos
+
+            # seeded mid-ready-flip kill (crash.kubelet.ready): some pods
+            # of a gang Running, the rest still Pending — a restarted
+            # kubelet must finish the flips idempotently
+            chaos.crash_point("crash.kubelet.ready", path=pod.meta.key)
             rv = pod.meta.resource_version
             pod.phase = PodPhase.RUNNING
             try:
@@ -449,7 +464,7 @@ def _wait_http(url: str, timeout: float = 30.0) -> bool:
 def run_up(port: int = 8443, state: str = "", conf_path: str = "",
            pidfile: str = ".vt-up.json", detach: bool = False,
            schedulers: int = 1, controllers: int = 1, elastic: int = 0,
-           host: str = "127.0.0.1", announce=print) -> int:
+           host: str = "127.0.0.1", wal: bool = False, announce=print) -> int:
     """Bring up the whole control plane — apiserver (+durable state),
     scheduler(s), controller(s), kubelet — as real OS processes with
     health checks: the reference's helm-chart/3-image deployment collapsed
@@ -462,6 +477,14 @@ def run_up(port: int = 8443, state: str = "", conf_path: str = "",
     """
     import json
     import subprocess
+
+    if wal and not state:
+        # fail fast with the real constraint: the child apiserver would
+        # die instantly on StoreServer's ValueError, burning the whole
+        # 30 s health-check wait to report an unrelated-looking error
+        announce("error: --wal requires --state (the WAL checkpoints "
+                 "into the state file)", flush=True)
+        return 1
 
     # refuse to orphan a previous detached control plane — every recorded
     # pid is checked (a crashed apiserver must not hide live schedulers)
@@ -513,6 +536,8 @@ def run_up(port: int = 8443, state: str = "", conf_path: str = "",
         args = ["apiserver", "--port", str(port), "--host", host]
         if state:
             args += ["--state", state]
+        if wal:
+            args += ["--wal"]
         spawn(*args)
         return _wait_http(url)
 

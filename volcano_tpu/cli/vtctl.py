@@ -615,6 +615,10 @@ def main(argv=None) -> int:
                       help="apiserver bind address (0.0.0.0 in containers)")
     up_p.add_argument("--state", default="",
                       help="durable apiserver state file (etcd analogue)")
+    up_p.add_argument("--wal", action="store_true",
+                      help="segment write-ahead log beside --state: every "
+                           "ACKed mutation is fsynced before its 2xx "
+                           "(zero acked loss on crash)")
     up_p.add_argument("--conf", default="", help="scheduler-conf YAML path")
     up_p.add_argument("--detach", "-d", action="store_true",
                       help="return after startup; tear down with 'vtctl down'")
@@ -634,6 +638,10 @@ def main(argv=None) -> int:
     api_p.add_argument("--state", default="",
                        help="persist objects to this JSON file (etcd analogue); "
                             "a restart resumes with all CRDs")
+    api_p.add_argument("--wal", action="store_true",
+                       help="segment write-ahead log beside --state "
+                            "(store/wal.py): ACK-after-fsync, crash "
+                            "recovery = snapshot + replay, zero acked loss")
     for comp in ("controller", "scheduler", "kubelet", "elastic"):
         p = sub.add_parser(comp, parents=[common], help=f"run the {comp} against --server")
         p.add_argument("--identity", default="")
@@ -664,7 +672,7 @@ def main(argv=None) -> int:
                               schedulers=args.schedulers,
                               controllers=args.controllers,
                               elastic=args.elastic,
-                              host=args.host)
+                              host=args.host, wal=args.wal)
     if args.group == "down":
         from volcano_tpu.cli import daemons
 
@@ -681,7 +689,7 @@ def main(argv=None) -> int:
         try:
             if args.group == "apiserver":
                 daemons.run_apiserver(port=args.port, host=args.host,
-                                      state=args.state)
+                                      state=args.state, wal=args.wal)
             elif args.group == "controller":
                 daemons.run_controller(args.server, identity=args.identity,
                                        leader_elect=not args.no_leader_elect,

@@ -17,7 +17,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Deque, Dict, List, Optional
 
-from volcano_tpu import trace
+from volcano_tpu import chaos, trace
 from volcano_tpu.api.job import (
     JOB_NAME_KEY,
     JOB_VERSION_KEY,
@@ -429,6 +429,13 @@ class JobController:
         for task, i in to_create:
             pod = self._create_job_pod(job, task, i)
             if self.store.get("Pod", pod.meta.key) is None:
+                # seeded mid-gang kill (crash.controller.gang_create): a
+                # controller dying with the gang half-created is exactly
+                # the partial-gang wedge PR 2 fixed — the crash storms
+                # prove a restarted controller finishes the gang from
+                # first-observation state (tests/test_crash_recovery.py)
+                chaos.crash_point("crash.controller.gang_create",
+                                  path=pod.meta.key)
                 self.store.create("Pod", pod)
             pending += 1
         for pod in to_delete:

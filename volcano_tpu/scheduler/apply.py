@@ -272,6 +272,13 @@ class AsyncApplier:
         """Apply one drained batch in order.  Segment entries ship whole
         through the store's columnar verb; everything between them rides
         the per-op bulk path unchanged."""
+        from volcano_tpu import chaos
+
+        # seeded mid-drain kill (crash.scheduler.drain): decisions are
+        # dequeued, overlay markers set, nothing shipped yet — the crash
+        # storms assert a restarted scheduler relists and re-publishes
+        # exactly the fault-free placements (tests/test_crash_recovery.py)
+        chaos.crash_point("crash.scheduler.drain")
         run: list = []
         for entry in batch:
             if entry[0] == "segment":
@@ -313,7 +320,7 @@ class AsyncApplier:
         if not ship.empty:
             t0 = time.perf_counter()
             try:
-                res = apply_fn(ship)
+                res = self._ship_segment(apply_fn, ship)
             except Exception as e:  # noqa: BLE001 — outage: retry next cycle
                 for task_key in ship.bind_keys:
                     self.cache._record_err("bind", task_key, e)
@@ -344,6 +351,25 @@ class AsyncApplier:
             # segment, preserving the per-object stream's binds-then-
             # evicts cycle order
             self._apply_ops([("evict", k, r) for k, r in hit_pairs])
+
+    def _ship_segment(self, apply_fn, ship):
+        """One segment ship with a single unknown-outcome retry: a
+        connection-level cut (server crashed mid-request, reply cut
+        mid-body) leaves the apply in doubt — unlike blind mutation
+        retry, RE-SHIPPING THE SAME SEGMENT is safe because the server
+        dedupes on its reserved-uid block (Store._note_segment): bind and
+        evict rows no-op-suppress, Event rows that already landed are
+        skipped.  Anything else (including a second cut — likely a real
+        outage riding restart backoff) propagates to the caller's
+        record-err path and the next cycle re-solves."""
+        try:
+            return apply_fn(ship)
+        except Exception as e:  # noqa: BLE001 — classified just below
+            from volcano_tpu.store.client import _connection_cut
+
+            if not _connection_cut(e):
+                raise
+        return apply_fn(ship)
 
     def _split_indexed_evicts(self, seg):
         """Partition a segment's evict rows into (reduced segment to

@@ -113,6 +113,25 @@ def register_residue_tasks(cls: str, count: int) -> None:
     inc("volcano_residue_tasks_total", float(count), **{"class": cls})
 
 
+# -- store WAL durability series (volcano_tpu/store/wal.py) -------------------
+
+def register_wal_append(n: int = 1) -> None:
+    """Records appended to the store's write-ahead log (one per mutation
+    request/op; a whole decision segment is ONE record)."""
+    inc("volcano_store_wal_appended_records_total", float(n))
+
+
+def register_wal_fsync(n: int = 1) -> None:
+    """Group-commit fsyncs of the WAL tail — the ACK barrier.  The ratio
+    to appended_records shows how well group commit amortizes."""
+    inc("volcano_store_wal_fsync_total", float(n))
+
+
+def register_wal_recovery(n: int) -> None:
+    """Records replayed from the WAL tail during crash recovery."""
+    inc("volcano_store_wal_recovery_replayed_records", float(n))
+
+
 # -- elastic autoscaler series (volcano_tpu/elastic/) -------------------------
 
 def update_pool_size(pool: str, size: int) -> None:

@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from volcano_tpu import trace
-from volcano_tpu.chaos import ChaosPlanError, FaultPlan, env_plan
+from volcano_tpu.chaos import ChaosPlanError, FaultPlan, env_plan, fire_crash
 from volcano_tpu.locksan import make_lock, make_rlock
 from volcano_tpu.store.codec import (
     KIND_CLASSES,
@@ -87,6 +87,7 @@ class StoreServer:
         admission: bool = True,
         state_path: Optional[str] = None,
         save_interval: float = 0.25,
+        wal=None,
     ):
         self.store = store or Store()
         self.admission = admission
@@ -117,8 +118,27 @@ class StoreServer:
         # lost on a crash (weaker than etcd, which fsyncs before acking;
         # watchers relist on restart either way). Pass save_interval <= 0
         # for sync-on-mutate: every ACKed mutation is flushed to the state
-        # file first, the etcd contract, at per-request fsync cost.
-        self._sync_persist = state_path is not None and save_interval <= 0
+        # file first, the etcd contract, at per-request full-store cost.
+        # Segment WAL (store/wal.py): ``wal`` truthy turns on the etcd
+        # contract at group-commit cost — every mutation appends its wire
+        # form to an append-only CRC-framed log and the 2xx waits on an
+        # fsync shared by every request in flight (a decision segment is
+        # ONE record, so a 102k-bind cycle pays one fsync, not 102k).
+        # The state file becomes the CHECKPOINT: flush_state rotates the
+        # log, snapshots, and truncates the covered segments; recovery =
+        # snapshot + torn-tail-tolerant replay (_load_state).
+        self.wal = None
+        if wal:
+            from volcano_tpu.store.wal import WriteAheadLog
+
+            if state_path is None:
+                raise ValueError(
+                    "wal requires state_path (the WAL checkpoints into "
+                    "the state file)")
+            self.wal = WriteAheadLog(
+                wal if isinstance(wal, str) else state_path + ".wal")
+        self._sync_persist = (state_path is not None and save_interval <= 0
+                              and self.wal is None)
         self._dirty_kinds: set = set()
         # serializes concurrent flushes end-to-end (saver thread vs the
         # shutdown flush): encode+write happen under this lock so a stale
@@ -152,7 +172,14 @@ class StoreServer:
         # subprocess daemons can be tortured) or at runtime via /chaos.
         self.chaos: Optional[FaultPlan] = env_plan()
         self._saver_stop = threading.Event()
+        #: set by kill(): refuse further flushes — a crashed process
+        #: cannot checkpoint, and its saver must not overwrite the state
+        #: a successor is recovering from
+        self._killed = False
         self._saver: Optional[threading.Thread] = None
+        # placeholder until the real watch queues register below: recovery
+        # may checkpoint (the wal_floor stamp) and flush pumps this map
+        self._queues: Dict[str, Any] = {}
         if state_path is not None:
             self._load_state()
             # background saver: snapshots are encoded under the lock but
@@ -245,9 +272,13 @@ class StoreServer:
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
                     return
                 if u.path == "/healthz":
-                    return self._reply(
-                        200, {"ok": True, "uid": server.store.uid}
-                    )
+                    payload = {"ok": True, "uid": server.store.uid}
+                    if server.wal is not None:
+                        # durability observability for operators/bench:
+                        # record/fsync totals, cumulative fsync seconds,
+                        # recovery replay counts
+                        payload["wal"] = server.wal.stats()
+                    return self._reply(200, payload)
                 if u.path == "/watch":
                     since = int(q.get("since", ["0"])[0])
                     kinds = set(q.get("kinds", [""])[0].split(",")) - {""}
@@ -301,7 +332,7 @@ class StoreServer:
                     try:
                         code, payload = server.create(parts[1], self._body())
                         if code < 400:  # failed verbs wrote nothing
-                            server._maybe_flush()
+                            server._commit_ack()
                     except Exception as e:  # noqa: BLE001 — wire boundary
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
@@ -324,7 +355,7 @@ class StoreServer:
                             when=body.get("when"),
                         )
                         if code < 400:
-                            server._maybe_flush()
+                            server._commit_ack()
                     except Exception as e:  # noqa: BLE001
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
@@ -346,7 +377,7 @@ class StoreServer:
                             expected_rv=int(cas) if cas is not None else None,
                         )
                         if code < 400:
-                            server._maybe_flush()
+                            server._commit_ack()
                     except Exception as e:  # noqa: BLE001
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
@@ -368,7 +399,11 @@ class StoreServer:
                     with server.lock:
                         obj = server.store.delete(parts[1], key)
                         server._pump_log()
-                    server._maybe_flush()
+                        if obj is not None and server.wal is not None:
+                            server._wal_append({"op": "delete",
+                                                "kind": parts[1],
+                                                "key": key})
+                    server._commit_ack()
                     return self._reply(200, {"deleted": obj is not None})
                 return self._reply(404, {"error": "no route"})
 
@@ -406,6 +441,36 @@ class StoreServer:
         if self._sync_persist:
             self.flush_state()
 
+    def _wal_append(self, rec: Dict[str, Any]) -> None:
+        """Append one mutation record (wire form) to the WAL, stamped with
+        the post-op seq/rv so recovery resumes the exact continuity line.
+        Must be called under the server lock AFTER the op's ``_pump_log``
+        (so the stamps reflect the op) — append order is then apply
+        order.  The fsync happens later, in ``_commit_ack``, outside the
+        lock."""
+        rec["seq"] = self.seq
+        rec["rv"] = self.store._rv
+        self.wal.append(rec)
+        from volcano_tpu.scheduler import metrics
+
+        metrics.register_wal_append()
+
+    def _commit_ack(self) -> None:
+        """The durability barrier between a successful mutation and its
+        2xx reply: group-commit fsync the WAL tail (ACK-after-append —
+        the etcd contract), then any sync-persist snapshot flush.  The
+        ``crash.server.{pre,post}_fsync`` faultpoints bracket the fsync:
+        a pre-fsync kill may lose the (never-ACKed) record, a post-fsync
+        kill must lose nothing."""
+        if self.wal is not None:
+            plan = self.chaos
+            if plan is not None:
+                fire_crash(plan, "crash.server.pre_fsync")
+            self.wal.commit()
+            if plan is not None:
+                fire_crash(plan, "crash.server.post_fsync")
+        self._maybe_flush()
+
     def create(self, kind: str, data: Dict[str, Any],
                _encode_response: bool = True):
         obj = decode_object(kind, data.get("object", {}))
@@ -423,6 +488,12 @@ class StoreServer:
             if kind != "Job":  # admission may have mutated a Job
                 self._stage_enc_hint(kind, obj, data.get("object"))
             self._pump_log()
+            if self.wal is not None:
+                self._wal_append({
+                    "op": "create", "kind": kind,
+                    "object": self._restamped_enc(
+                        obj, data.get("object") if kind != "Job" else None),
+                })
         # bulk discards per-op bodies — a full object encode per op was a
         # third of the server-side cost of a 100k-op batch
         return 201, {"object": encode(obj)} if _encode_response else {}
@@ -450,6 +521,11 @@ class StoreServer:
             self.store.update(kind, obj)
             self._stage_enc_hint(kind, obj, data.get("object"))
             self._pump_log()
+            if self.wal is not None:
+                self._wal_append({
+                    "op": "update", "kind": kind,
+                    "object": self._restamped_enc(obj, data.get("object")),
+                })
         return 200, {"object": encode(obj)}
 
     def patch(self, kind: str, key: str, fields: Dict[str, Any],
@@ -472,6 +548,12 @@ class StoreServer:
             except PreconditionFailed as e:
                 return 409, {"error": repr(e)}
             self._pump_log()
+            if self.wal is not None:
+                rec = {"op": "patch", "kind": kind, "key": key,
+                       "fields": fields}
+                if when:
+                    rec["when"] = when
+                self._wal_append(rec)
         return 200, {"object": encode(obj)} if _encode_response else {}
 
     def bulk(self, ops: List[Dict[str, Any]]) -> List[Optional[str]]:
@@ -516,15 +598,18 @@ class StoreServer:
                         results.append(self._apply_segment(op))
                         continue
                     elif verb == "delete":
-                        self.store.delete(kind, op.get("key", ""))
+                        deleted = self.store.delete(kind, op.get("key", ""))
                         self._pump_log()
+                        if deleted is not None and self.wal is not None:
+                            self._wal_append({"op": "delete", "kind": kind,
+                                              "key": op.get("key", "")})
                         ok, payload = True, {}
                     else:
                         ok, payload = False, {"error": f"unknown bulk op {verb!r}"}
                     results.append(None if ok else payload.get("error", "failed"))
                 except Exception as e:  # noqa: BLE001 — per-op isolation
                     results.append(repr(e))
-        self._maybe_flush()
+        self._commit_ack()
         return results
 
     def _patch_col(self, op: Dict[str, Any]) -> List[Optional[str]]:
@@ -533,8 +618,6 @@ class StoreServer:
         decoders resolve ONCE for the whole run; values are scalars by the
         client's compression contract (enums decode to immutable members),
         so no decoded object is ever shared across rows."""
-        from volcano_tpu.store.codec import _decoder, _resolve_hint
-
         kind = op.get("kind", "")
         keys = op.get("keys") or []
         if kind == "Job" and self.admission:
@@ -544,11 +627,7 @@ class StoreServer:
         when = op.get("when")
         const = decode_fields(kind, const_enc) if const_enc else {}
         when_dec = decode_fields(kind, when) if when else None
-        cls = KIND_CLASSES.get(kind)
-        col_dec = {}
-        for f in cols:
-            hint = _resolve_hint(cls, f) if cls is not None else None
-            col_dec[f] = _decoder(hint) if hint is not None else (lambda v: v)
+        col_dec = self._col_decoders(kind, cols)
         out: List[Optional[str]] = []
         with self.lock:
             for i, key in enumerate(keys):
@@ -563,7 +642,28 @@ class StoreServer:
                 except Exception as e:  # noqa: BLE001 — per-key isolation
                     out.append(repr(e))
             self._pump_log()
+            if self.wal is not None:
+                # ONE record for the whole columnar run, wire-form
+                # verbatim; per-key failures replay to the same outcome
+                self._wal_append({
+                    k: op[k]
+                    for k in ("op", "kind", "keys", "columns", "const",
+                              "when") if k in op
+                })
         return out
+
+    @staticmethod
+    def _col_decoders(kind: str, cols) -> Dict[str, Any]:
+        """Per-field decoders for a columnar patch run, resolved once
+        (shared by the live ``patch_col`` verb and WAL replay)."""
+        from volcano_tpu.store.codec import _decoder, _resolve_hint
+
+        cls = KIND_CLASSES.get(kind)
+        col_dec: Dict[str, Any] = {}
+        for f in cols:
+            hint = _resolve_hint(cls, f) if cls is not None else None
+            col_dec[f] = _decoder(hint) if hint is not None else (lambda v: v)
+        return col_dec
 
     def _apply_segment(self, op: Dict[str, Any]) -> Dict[str, Any]:
         """Apply one columnar decision segment: the whole cycle's binds,
@@ -584,7 +684,15 @@ class StoreServer:
         with self.lock:
             # queued per-object events must keep their place in the order
             self._pump_log()
-            res = self.store.apply_segment_lazy(seg)
+            stamp = time.time()
+            res = self.store.apply_segment_lazy(seg, stamp=stamp)
+            plan = self.chaos
+            if plan is not None:
+                # seeded kill between store apply and log/WAL append: the
+                # in-memory half dies with the process, the WAL never saw
+                # the record, the client never saw a reply — recovery must
+                # show NO trace of the segment (atomicity under crash)
+                fire_crash(plan, "crash.server.segment_apply")
             bkeys, bvals, rv_b0 = res.pop("bind_block")
             ekeys, rv_e0 = res.pop("evict_block")
             ebind, eevict = res.pop("event_blocks")
@@ -612,6 +720,14 @@ class StoreServer:
                         pend[("Event", blk.key(i))] = (blk, i)
                     self._dirty_kinds.add("Event")
             self._trim_log()
+            if self.wal is not None:
+                # the WHOLE cycle is one WAL record — the wire op verbatim
+                # plus the Event stamp, so replay reproduces the exact
+                # lazy apply (group commit then amortizes one fsync over
+                # 100k binds in _commit_ack)
+                rec = dict(op)
+                rec["stamp"] = stamp
+                self._wal_append(rec)
             self.cond.notify_all()
         return res
 
@@ -677,12 +793,108 @@ class StoreServer:
     # -- persistence -----------------------------------------------------------
 
     def _load_state(self) -> None:
+        """Recovery: load the snapshot, then replay the WAL tail on top
+        (torn-tail tolerant — see store/wal.py).  Emits a ``store.recover``
+        span when tracing is armed so crash_dump artifacts carry the
+        recovery timeline."""
+        if trace.TRACER is None:
+            self._recover()
+            return
+        with trace.span("store.recover", path=self.state_path) as sp:
+            replayed, skipped = self._recover()
+            sp.annotate(
+                replayed=replayed, skipped=skipped,
+                torn_tails=self.wal.torn_tails if self.wal else 0,
+            )
+
+    def _recover(self):
         import os
 
-        if not os.path.exists(self.state_path):
-            return
-        with open(self.state_path) as f:
-            data = json.load(f)
+        data = {}
+        if os.path.exists(self.state_path):
+            with open(self.state_path) as f:
+                data = json.load(f)
+        self._load_snapshot(data)
+        replayed = skipped = 0
+        if self.wal is not None:
+            if data and "wal_floor" not in data:
+                # lineage guard: a WAL-ON life always stamps a floored
+                # checkpoint before serving (below), so a snapshot
+                # WITHOUT a floor was written by a WAL-OFF life — any
+                # leftover segments predate it, and replaying them would
+                # resurrect old field values and deleted objects on top
+                # of the newer state
+                self.wal.drop_all()
+            else:
+                replayed, skipped = self._replay_wal(
+                    int(data.get("wal_floor", 0)))
+                if replayed:
+                    from volcano_tpu.scheduler import metrics
+
+                    metrics.register_wal_recovery(replayed)
+            if data and "wal_floor" not in data:
+                # stamp the floor NOW, before any request is served, so
+                # "snapshot without wal_floor + segments present" stays a
+                # definitive staleness signal even if this life crashes
+                # before its first interval flush (forced: an inherited
+                # snapshot whose kinds are all empty still needs the
+                # floor, or its crash window would drop_all acked
+                # segments on the next boot)
+                self._dirty_kinds.update(data.get("kinds", {}))
+                self.flush_state(force=True)
+        elif self.state_path is not None:
+            replayed, skipped = self._absorb_leftover_wal(data)
+        return replayed, skipped
+
+    def _absorb_leftover_wal(self, data):
+        """WAL-OFF boot with leftover WAL segments beside the state file:
+        a previous WAL-on life crashed with acked-but-uncheckpointed
+        records in its tail, and dropping to interval persistence must
+        not silently lose them.  Replay the tail (same torn-tail
+        semantics), snapshot immediately so the absorbed records are
+        durable again, then retire the segments — a later WAL-on boot
+        starts from a clean directory."""
+        import os
+
+        from volcano_tpu.store import wal as walmod
+
+        wal_dir = self.state_path + ".wal"
+        indices = walmod.list_segment_indices(wal_dir)
+        if not indices:
+            return 0, 0
+        floor = int(data.get("wal_floor", 0))
+        replayed = skipped = 0
+        for idx in indices:
+            if idx < floor:
+                continue
+            records, _torn = walmod.read_records(
+                os.path.join(wal_dir, f"{idx:08d}.wal"))
+            for rec in records:
+                replayed += 1
+                try:
+                    self._replay_record(rec)
+                except Exception:  # noqa: BLE001 — recovery must not die
+                    skipped += 1
+                if "seq" in rec:
+                    self.seq = max(self.seq, int(rec["seq"]))
+                if "rv" in rec:
+                    self.store._rv = max(self.store._rv, int(rec["rv"]))
+        if replayed:
+            from volcano_tpu.scheduler import metrics
+
+            metrics.register_wal_recovery(replayed)
+        # make the absorbed tail durable BEFORE the segments die; a crash
+        # in between re-absorbs idempotently on the next boot
+        self.flush_state(force=True)
+        for idx in indices:
+            try:
+                os.unlink(os.path.join(wal_dir, f"{idx:08d}.wal"))
+            except OSError:
+                pass
+        walmod.fsync_dir(wal_dir)
+        return replayed, skipped
+
+    def _load_snapshot(self, data) -> None:
         max_rv = 0
         for kind, items in data.get("kinds", {}).items():
             if kind not in KIND_CLASSES:
@@ -712,8 +924,10 @@ class StoreServer:
                     shadow.meta.resource_version = rv
                 max_rv = max(max_rv, rv)
         # future writes continue the persisted version sequence so CAS
-        # (leases) and epoch caches stay monotonic across restarts
-        self.store._rv = max(self.store._rv, max_rv)
+        # (leases) and epoch caches stay monotonic across restarts; the
+        # explicit "rv" stamp (newer snapshots) is exact even when deleted
+        # objects consumed the highest versions
+        self.store._rv = max(self.store._rv, max_rv, int(data.get("rv", 0)))
         self.seq = int(data.get("seq", 0))
         # a restarted server IS the same store lineage: restore the uid so
         # mirror checkpoints taken before the restart stay valid
@@ -723,18 +937,129 @@ class StoreServer:
         # note: the reload happens before any watch queue is registered, so
         # the synthetic creations produce no events — clients relist
 
+    def _replay_wal(self, floor: int):
+        """Replay the WAL tail (segments >= the snapshot's floor) through
+        the store verbs.  Runs before any watch queue exists, so like the
+        snapshot load it produces no events — clients behind the crash
+        relist.  Returns (replayed, skipped): a record that cannot apply
+        (version-drift field, vanished key) is skipped and counted, never
+        fatal — recovery must always come up."""
+        replayed = skipped = 0
+        for rec in self.wal.replay(floor):
+            replayed += 1
+            try:
+                self._replay_record(rec)
+            except Exception:  # noqa: BLE001 — recovery must never crash
+                skipped += 1
+            # continuity stamps: the recovered server resumes the exact
+            # seq/rv line the record was ACKed under, so pre-crash watch
+            # cursors relist (seq moved past them -> empty-log relist)
+            # and CAS holders keep working
+            if "seq" in rec:
+                self.seq = max(self.seq, int(rec["seq"]))
+            if "rv" in rec:
+                self.store._rv = max(self.store._rv, int(rec["rv"]))
+        return replayed, skipped
+
+    def _replay_record(self, rec: Dict[str, Any]) -> None:
+        """Apply one WAL record — the wire form of the op, replayed with
+        the recorded server-stamped meta (same dance as the snapshot
+        load: rv restored on the object AND its no-op-suppression
+        shadow)."""
+        op = rec.get("op")
+        kind = rec.get("kind", "")
+        store = self.store
+        if op in ("create", "update"):
+            enc = rec["object"]
+            obj = decode_object(kind, enc)
+            rv = obj.meta.resource_version
+            try:
+                if op == "create":
+                    store.create(kind, obj)
+                else:
+                    store.update(kind, obj)
+            except KeyError:
+                # a create landing on an existing key (or update on a
+                # vanished one) can only mean the snapshot already
+                # reflects a later life of this key; converge on the
+                # record's object either way
+                if op == "create":
+                    store.update(kind, obj)
+                else:
+                    store.create(kind, obj)
+            obj.meta.resource_version = rv
+            shadow = store._shadow[kind].get(obj.meta.key)
+            if shadow is not None:
+                shadow.meta.resource_version = rv
+            self._obj_enc[(kind, obj.meta.key)] = enc
+            self._dirty_kinds.add(kind)
+        elif op == "patch":
+            when = rec.get("when")
+            try:
+                store.patch(
+                    kind, rec["key"],
+                    decode_fields(kind, rec.get("fields") or {}),
+                    when=decode_fields(kind, when) if when else None,
+                )
+            except (KeyError, PreconditionFailed):
+                pass  # replays exactly as it resolved live
+            self._obj_enc.pop((kind, rec["key"]), None)
+            self._dirty_kinds.add(kind)
+        elif op == "patch_col":
+            cols = rec.get("columns") or {}
+            const_enc = rec.get("const") or {}
+            when = rec.get("when")
+            const = decode_fields(kind, const_enc) if const_enc else {}
+            when_dec = decode_fields(kind, when) if when else None
+            col_dec = self._col_decoders(kind, cols)
+            for i, key in enumerate(rec.get("keys") or []):
+                fields = dict(const)
+                for f, vals in cols.items():
+                    fields[f] = col_dec[f](vals[i])
+                try:
+                    store.patch(kind, key, fields, when=when_dec)
+                except (KeyError, PreconditionFailed):
+                    pass
+                self._obj_enc.pop((kind, key), None)
+            self._dirty_kinds.add(kind)
+        elif op == "delete":
+            store.delete(kind, rec["key"])
+            self._obj_enc.pop((kind, rec["key"]), None)
+            self._dirty_kinds.add(kind)
+        elif op == "segment":
+            from volcano_tpu.store.segment import DecisionSegment
+
+            seg = DecisionSegment.from_wire(rec)
+            store.apply_segment_lazy(seg, stamp=rec.get("stamp"))
+            # snapshot-seeded encodings for the touched keys are now
+            # stale: drop them so reads re-encode post-segment truth
+            for k in seg.bind_keys:
+                self._obj_enc.pop(("Pod", k), None)
+            for k in seg.evict_keys:
+                self._obj_enc.pop(("Pod", k), None)
+            self._dirty_kinds.update(("Pod", "Event"))
+
     def _saver_loop(self) -> None:
         interval = max(self.save_interval, 0.05)
         while not self._saver_stop.wait(interval):
-            self.flush_state()
+            try:
+                self.flush_state()
+            except (OSError, ValueError):
+                # a flush racing kill() (closed WAL/descriptor) or a
+                # transient IO failure: the next interval retries — the
+                # saver must not die and silently stop checkpointing
+                continue
 
-    def flush_state(self) -> None:
+    def flush_state(self, force: bool = False) -> None:
         """Persist the store if dirty. Only kinds dirtied since the last
         flush re-encode (under the server lock); the file write happens
         outside it. The flush lock serializes whole flushes so concurrent
         saver/shutdown calls can neither interleave on the tmp file nor
-        overwrite a fresher snapshot with a staler one."""
-        if self.state_path is None:
+        overwrite a fresher snapshot with a staler one.  ``force`` writes
+        the snapshot even with nothing dirty — recovery uses it to stamp
+        a ``wal_floor`` onto an inherited floorless (possibly empty)
+        snapshot before any request is served."""
+        if self.state_path is None or self._killed:
             return
         chaos = self.chaos
         if chaos is not None:
@@ -751,8 +1076,15 @@ class StoreServer:
                 # default Queue at startup) so their kinds are dirtied and
                 # persisted too
                 self._pump_log()
-                if not self._dirty_kinds:
+                if not self._dirty_kinds and not force:
                     return
+                # WAL checkpoint: rotate to a fresh segment INSIDE the
+                # lock — every record appended so far lives below the
+                # returned floor and is covered by the snapshot encoded
+                # in this same critical section; records racing in after
+                # the lock drops land at/above the floor and replay on
+                # top of it
+                floor = self.wal.rotate() if self.wal is not None else None
                 for kind in self._dirty_kinds:
                     items = self.store.list(kind)  # materializes lazy rows
                     if items:
@@ -767,14 +1099,30 @@ class StoreServer:
                     else:
                         self._enc_cache.pop(kind, None)
                 self._dirty_kinds.clear()
-                payload = {"seq": self.seq, "store_uid": self.store.uid,
+                payload = {"seq": self.seq, "rv": self.store._rv,
+                           "store_uid": self.store.uid,
                            "kinds": dict(self._enc_cache)}
+                if floor is not None:
+                    payload["wal_floor"] = floor
             import os
 
+            # crash-safe state write: temp file, fsync, atomic rename —
+            # a crash at any instant leaves either the old snapshot or
+            # the new one, never a torn file (vtlint: crash-safe-io)
             tmp = f"{self.state_path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
                 json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self.state_path)
+            if floor is not None:
+                from volcano_tpu.store.wal import fsync_dir
+
+                # the rename itself must be durable before the covered
+                # WAL segments die — a power loss must find either the
+                # old snapshot + old segments or the new snapshot
+                fsync_dir(os.path.dirname(os.path.abspath(self.state_path)))
+                self.wal.drop_below(floor)
 
     def _stage_enc_hint(self, kind: str, obj, wire: Optional[dict]) -> None:
         """Stage the request's own wire dict as the object's encoding for
@@ -784,13 +1132,24 @@ class StoreServer:
         store verb succeeded and before _pump_log."""
         if not wire:
             return
+        self._enc_hints[(kind, obj.meta.key)] = self._restamped_enc(obj, wire)
+
+    @staticmethod
+    def _restamped_enc(obj, wire: Optional[dict]) -> Dict[str, Any]:
+        """The post-verb canonical encoding of ``obj``: the request's own
+        wire dict with the server-stamped meta fields overlaid, or a
+        fresh encode when no wire dict applies (admission-mutated Jobs,
+        direct-seeded objects).  Shared by the encoded-cache hints and
+        the WAL create/update records."""
+        if not wire:
+            return encode(obj)
         enc = dict(wire)
         meta = dict(enc.get("meta") or {})
         meta["resource_version"] = obj.meta.resource_version
         meta["creation_timestamp"] = obj.meta.creation_timestamp
         meta["uid"] = obj.meta.uid
         enc["meta"] = meta
-        self._enc_hints[(kind, obj.meta.key)] = enc
+        return enc
 
     def _encode_event_obj(self, kind: str, ev) -> tuple:
         """(encoded_obj, encoded_old) for a store event, via the per-object
@@ -932,6 +1291,35 @@ class StoreServer:
         if self._saver is not None:
             self._saver.join(timeout=5)
         self.flush_state()
+        if self.wal is not None:
+            # graceful shutdown fsyncs the tail even though the flush
+            # above already checkpointed: a flush skipped by drop_flush
+            # chaos (or an all-no-op dirty set) must still leave every
+            # ACKed record durable
+            self.wal.sync_close()
+
+    def kill(self) -> None:
+        """Crash-harness hook: die like SIGKILL.  Stop serving and drop
+        every in-memory structure with NO final flush, NO saver drain,
+        NO WAL fsync — what the next boot recovers is exactly what a
+        killed process leaves behind: the last durable snapshot plus the
+        synced WAL tail.  (The in-process crash storms in
+        tests/test_crash_recovery.py pair this with a fresh StoreServer
+        on the same state/wal paths and port.)"""
+        self._killed = True
+        self._saver_stop.set()
+        # drain any flush already past the _killed guard: its os.replace
+        # must land BEFORE a successor boots on these paths, or a dead
+        # life's older snapshot (older wal_floor) could clobber the
+        # successor's checkpoint after it dropped the covered segments
+        with self._flush_lock:
+            pass
+        if self._thread is not None:
+            self.httpd.shutdown()
+            self._thread.join(timeout=5)
+        self.httpd.server_close()
+        if self.wal is not None:
+            self.wal.kill()
 
     def serve_forever(self) -> None:
         self.httpd.serve_forever()

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import copy
 import enum
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from dataclasses import dataclass
 from typing import Any, Callable, Deque, Dict, Iterator, List, Optional
 
@@ -98,6 +98,13 @@ class Store:
         self._lazy_patch: Dict[str, Dict[str, Any]] = defaultdict(dict)
         self._lazy_create: Dict[str, Dict[str, Any]] = defaultdict(dict)
         self._rv = 0
+        # (ev_token, ev_start) of recently applied decision segments: the
+        # reserved-uid block identifies a segment, so a RESUBMIT (the
+        # applier re-ships the same segment after a cut reply / a crash
+        # whose WAL record survived) is recognized and its Event rows
+        # dedupe against what already landed — resubmission is idempotent
+        # (bind/evict rows are idempotent already via no-op suppression)
+        self._applied_segments: OrderedDict = OrderedDict()
         # mutation lock: the async applier writes from its own thread while
         # the owning thread reads/writes (StoreServer adds its own RLock on
         # top for multi-client HTTP, which nests fine: server.lock is
@@ -122,6 +129,7 @@ class Store:
         # at-pickle) lazy overlays
         self.__dict__.setdefault("_lazy_patch", defaultdict(dict))
         self.__dict__.setdefault("_lazy_create", defaultdict(dict))
+        self.__dict__.setdefault("_applied_segments", OrderedDict())
         self._mu = make_rlock("Store._mu")
 
     def _watched(self, kind: str) -> bool:
@@ -357,6 +365,22 @@ class Store:
 
     # -- columnar segments ---------------------------------------------------
 
+    #: recently-applied-segment memory (resubmit dedupe); far above the
+    #: retry window's needs, far below anything that matters for memory
+    SEGMENT_DEDUP_CAP = 1024
+
+    def _note_segment(self, seg) -> bool:
+        """Record ``seg``'s reserved-uid block as applied; returns whether
+        this is a RESUBMIT (the block was seen before).  Must run under
+        ``_mu``."""
+        key = (seg.ev_token, seg.ev_start)
+        resubmit = key in self._applied_segments
+        self._applied_segments[key] = True
+        self._applied_segments.move_to_end(key)
+        while len(self._applied_segments) > self.SEGMENT_DEDUP_CAP:
+            self._applied_segments.popitem(last=False)
+        return resubmit
+
     def apply_segment(self, seg) -> Dict[str, Any]:
         """Eagerly apply one decision segment (store/segment.py): bind
         patches, evict patches, then one Scheduled/Evict Event per
@@ -377,6 +401,8 @@ class Store:
         errs_b: List[List[Any]] = []
         errs_e: List[List[Any]] = []
         ev_rows: List[tuple] = []  # (uid slot, involved key, reason, message, type)
+        with self._mu:
+            resubmit = self._note_segment(seg)
         # per-row locking, like Store.bulk: concurrent readers interleave
         # between rows exactly as they did with the per-op path
         t0 = _time.perf_counter()
@@ -408,13 +434,22 @@ class Store:
                             segmod.evicted_message(reasons[j]),
                             segmod.WARNING))
         t2 = _time.perf_counter()
+        events = self._objects["Event"]
         for slot, key, reason, message, type_ in ev_rows:
+            name = segmod.event_name(seg.ev_token, slot)
+            if resubmit and f"/{name}" in events:
+                continue  # idempotent resubmit: this row already landed
             ev = segmod.materialize_event(
-                segmod.event_name(seg.ev_token, slot), key, reason,
-                message, type_, rv=0, stamp=0.0,
+                name, key, reason, message, type_, rv=0, stamp=0.0,
             )
             ev.meta.creation_timestamp = 0.0  # create() stamps it
-            self.create("Event", ev)
+            try:
+                self.create("Event", ev)
+            except KeyError:
+                # uid-block collision outside the dedupe window (e.g. a
+                # pickle-restored store): the row already exists — skip,
+                # same outcome as the resubmit check above
+                continue
         t3 = _time.perf_counter()
         return {
             "binds": errs_b, "evicts": errs_e,
@@ -463,7 +498,8 @@ class Store:
                 pend[key] = (f, rv0 + j)
         return errs, changed, ev_rows, rv0
 
-    def apply_segment_lazy(self, seg) -> Dict[str, Any]:
+    def apply_segment_lazy(self, seg, stamp: Optional[float] = None
+                           ) -> Dict[str, Any]:
         """The server-side half of the columnar wire: ACK a whole decision
         segment under ONE lock acquisition without touching a single live
         object.  Bind/evict rows stage into the lazy-patch overlay
@@ -482,6 +518,13 @@ class Store:
         Rows whose write is a no-op (already bound to that node / already
         deleting) produce an Event but no patch row — exactly the per-
         object path's patch-quiescence + event behavior.
+
+        ``stamp`` pins the Event creation timestamp (WAL replay passes the
+        original apply time so a recovered store matches the live one);
+        None = now.  A RESUBMIT of an already-applied segment — same
+        reserved-uid block — is idempotent: its Event rows dedupe against
+        the rows that already landed, so a cut reply or a crash-restart
+        retry can never double-publish a cycle's Events.
         """
         import time as _time
 
@@ -489,7 +532,9 @@ class Store:
 
         with self._mu:
             t0 = _time.perf_counter()
-            stamp = _time.time()
+            if stamp is None:
+                stamp = _time.time()
+            resubmit = self._note_segment(seg)
             hosts = seg.bind_hosts
             errs_b, changed_b, ev_b, rv_b0 = self._stage_lazy_rows(
                 seg.bind_keys, "node_name", hosts
@@ -499,6 +544,20 @@ class Store:
                 seg.evict_keys, "deleting", None
             )
             t2 = _time.perf_counter()
+            if resubmit:
+                # drop Event rows the first submission already staged or
+                # materialized (slot -> Metadata.key via the uid block);
+                # rare path, never on the first-ship hot drain
+                lc0 = self._lazy_create.get("Event") or {}
+                events = self._objects["Event"]
+                nb = len(seg.bind_keys)
+
+                def _fresh(slot: int) -> bool:
+                    k = f"/{segmod.event_name(seg.ev_token, slot)}"
+                    return k not in lc0 and k not in events
+
+                ev_b = [i for i in ev_b if _fresh(seg.ev_start + i)]
+                ev_e = [j for j in ev_e if _fresh(seg.ev_start + nb + j)]
 
             # Event rows: rv block after every patch, the bulk-then-bulk
             # order of the per-object path
