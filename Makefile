@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace
+.PHONY: test e2e parity bench bench-residue bench-wire loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -74,6 +74,16 @@ bench:
 # (scheduler/residue.py) behind it; parity in tests/test_volume_parity.py
 bench-residue:
 	$(PY) bench.py --config 9
+
+# vtload (volcano_tpu/loadgen/): cfg8 sustains a seeded open-loop
+# arrival process (Poisson gang arrivals, resource/queue mix, dwell
+# churn) through the real Scheduler + Store, reports p50/p99/p999 pod
+# first-seen→bind latency from the bounded metric histograms, then
+# doubles QPS on fresh clusters until p99 breaches the band (saturation
+# search).  The tier-1 smoke + SLO chaos gate live in
+# tests/test_loadgen.py; `vtctl top` renders the per-cycle time series.
+loadtest:
+	$(PY) bench.py --open-loop
 
 # the columnar store wire (store/segment.py): cfg7 runs config 5 against
 # the HTTP apiserver in its own OS process — publish + off-cycle drain of

@@ -7,8 +7,10 @@ one compact JSON line each, in this order:
   cfg5v  cfg5v_e2e_cycle_volume_constrained        (500 + 2000 vol tasks)
   cfg6   cfg6_contended_preempt_storm_100k_x_10k   (storm only, no cfg6b)
   cfg7   e2e_http_schedule_cycle_100k_tasks_10k_nodes
+  cfg8   cfg8_open_loop_first_seen_to_bind         (short open-loop run)
 so one driver invocation captures the plain, dynamic-predicate,
-volume-constrained, contended, and HTTP-process-model numbers (~5 min
+volume-constrained, contended, HTTP-process-model, and open-loop-SLO
+numbers (~5 min
 total on a v5e; a
 failed config prints an {"metric": ..., "error": ...} line and the suite
 continues, rc stays 0).  Each line reports
@@ -30,6 +32,11 @@ runs all of them plus the kernel-only cycle (one JSON line each):
      (a second line, cfg6b, adds one best-effort preemptor to the storm)
   7  config 5 through the real HTTP apiserver (StoreServer) + RemoteStore
 `--kernel` times the device decision kernel alone over sim arrays.
+`--open-loop` (also `--config 10`) runs cfg8: the vtload open-loop SLO
+harness — seeded Poisson gang arrivals at a target QPS through the real
+Scheduler + Store, p50/p99/p999 pod first-seen→bind latency from the
+bounded metric histograms, then a saturation search raising QPS until
+p99 breaches the band (`make loadtest`).
 
 Configs 1-4 and --kernel are post-compile steady-state kernel solves;
 config 5 pays the real cycle: watch drain, array snapshot, device solve,
@@ -814,8 +821,101 @@ def config7():
     }))
 
 
+def _build_open_loop_store(n_nodes=200):
+    """Small-but-real cluster for the open-loop SLO runs: latency under
+    sustained arrivals is a cycle-cadence property, not a 10k-node one
+    (cfg5/cfg7 own the scale axis)."""
+    from volcano_tpu.api import Resource
+    from volcano_tpu.api.objects import Metadata, Node, Queue
+    from volcano_tpu.store import Store
+
+    store = Store()
+    store.create("Queue", Queue(meta=Metadata(name="default", namespace=""),
+                                weight=1))
+    for i in range(n_nodes):
+        store.create("Node", Node(
+            meta=Metadata(name=f"n{i:04d}", namespace=""),
+            allocatable=Resource(8000.0, 16.0 * (1 << 30),
+                                 max_task_num=110)))
+    return store
+
+
+def config8_open_loop(duration_s=8.0, qps=25.0, band_p99_ms=1000.0,
+                      max_doublings=3):
+    """cfg8: the OPEN-LOOP SLO harness (volcano_tpu/loadgen/) — a seeded
+    Poisson arrival process (gang-size/resource mix, exponential dwell
+    churn) sustained at ``qps`` gang arrivals/s against the real
+    Scheduler + Store, reporting p50/p99/p999 pod first-seen→bind
+    latency from the bounded metric histograms, then a saturation
+    search: double QPS on a fresh cluster until p99 breaches the band.
+    This is the measurement half of ROADMAP item 2 — the gate the
+    incremental-scheduler work will be judged against."""
+    import jax
+
+    from volcano_tpu.loadgen import LoadSpec, run_open_loop, saturation_search
+    from volcano_tpu.scheduler.conf import full_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+
+    def run_at(q, dur):
+        store = _build_open_loop_store()
+        conf = full_conf("tpu")
+        conf.apply_mode = "async"
+        sched = Scheduler(store, conf=conf)
+        sched.prewarm()
+        if sched.prewarm_background is not None:
+            sched.prewarm_background.join()
+        # prewarm compiles against the EMPTY store (zero pending → no
+        # solve shapes); an unmeasured warmup burst populates the
+        # pending-task bucket compiles the way `_time_cycle`'s warm-up
+        # reps do — otherwise every arrival during the first ~1.5 s CPU
+        # compile stalls behind it and the tail measures XLA, not the
+        # scheduler (post-compile steady state, the configs-1–4 rule)
+        warm = LoadSpec(qps=300.0, duration_s=0.15, seed=1,
+                        gang_sizes=((1, 5.0), (2, 3.0), (4, 2.0)),
+                        cpu_millis=(250, 500), mem_mb=(256, 512),
+                        dwell_s=0.05, namespace="warm", prefix="wm")
+        run_open_loop(store, warm, sched.run_once, settle_s=30.0)
+        spec = LoadSpec(
+            qps=q, duration_s=dur, seed=8,
+            gang_sizes=((1, 5.0), (2, 3.0), (4, 2.0)),
+            cpu_millis=(250, 500), mem_mb=(256, 512),
+            dwell_s=6.0, namespace="load",
+        )
+        return run_open_loop(store, spec, sched.run_once, settle_s=30.0)
+
+    # best-of-2 full runs, the cfg5 methodology: the first run in a
+    # fresh process still amortizes storm-kernel/bucket compiles that
+    # later runs reuse (in-process jit caches persist, as they do for a
+    # deployed scheduler); the reported percentiles are the best run's
+    base = min((run_at(qps, duration_s) for _ in range(2)),
+               key=lambda r: r.p99_ms)
+    sat = saturation_search(
+        lambda q: run_at(q, max(duration_s / 2.0, 3.0)),
+        base_qps=qps * 2, band_p99_ms=band_p99_ms,
+        max_doublings=max_doublings,
+    )
+    print(json.dumps({
+        "metric": "cfg8_open_loop_first_seen_to_bind",
+        "value": round(base.p50_ms / 1e3, 4),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "target_qps_gangs": qps,
+            "p50_ms": round(base.p50_ms, 2),
+            "p99_ms": round(base.p99_ms, 2),
+            "p999_ms": round(base.p999_ms, 2),
+            "report": base.as_dict(),
+            "band_p99_ms": band_p99_ms,
+            "saturation": sat.as_dict(),
+            "series": "volcano_e2e_job_scheduling_latency_milliseconds",
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes}
+           6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes,
+           10: config8_open_loop}
 
 
 def default_suite():
@@ -835,6 +935,8 @@ def default_suite():
          lambda: config6(include_best_effort=False)),
         ("e2e_http_schedule_cycle_100k_tasks_10k_nodes",
          config7),
+        ("cfg8_open_loop_first_seen_to_bind",
+         lambda: config8_open_loop(duration_s=5.0, max_doublings=2)),
     )
     for metric, fn in suite:
         try:
@@ -854,6 +956,10 @@ def main():
                             "best-of-3)")
     group.add_argument("--kernel", action="store_true",
                        help="kernel-only solve cycle over sim arrays")
+    group.add_argument("--open-loop", action="store_true",
+                       help="cfg8: sustained open-loop QPS with "
+                            "p50/p99/p999 first-seen->bind latency + "
+                            "saturation search (volcano_tpu/loadgen)")
     ns = ap.parse_args()
     # amortize XLA compiles across bench invocations
     from volcano_tpu.scheduler.scheduler import (
@@ -869,6 +975,8 @@ def main():
         kernel_cycle()
     elif ns.kernel:
         kernel_cycle()
+    elif ns.open_loop:
+        config8_open_loop()
     elif ns.e2e or ns.config is not None:
         CONFIGS[ns.config or 5]()
     else:

@@ -417,7 +417,7 @@ def test_wal_metrics_monotonic_and_exposed(tmp_path):
 
     a0 = metrics.get_counter("volcano_store_wal_appended_records_total")
     f0 = metrics.get_counter("volcano_store_wal_fsync_total")
-    r0 = metrics.get_counter("volcano_store_wal_recovery_replayed_records")
+    r0 = metrics.get_counter("volcano_store_wal_recovery_replayed_records_total")
     srv = _boot(tmp_path)
     rs = RemoteStore(srv.url)
     rs.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
@@ -429,7 +429,7 @@ def test_wal_metrics_monotonic_and_exposed(tmp_path):
     srv2 = _boot(tmp_path, port=srv.port)
     try:
         r1 = metrics.get_counter(
-            "volcano_store_wal_recovery_replayed_records")
+            "volcano_store_wal_recovery_replayed_records_total")
         assert r1 >= r0 + 2
         # counters only ever grow
         assert metrics.get_counter(
@@ -437,7 +437,7 @@ def test_wal_metrics_monotonic_and_exposed(tmp_path):
         text = metrics.expose_text()
         for name in ("volcano_store_wal_appended_records_total",
                      "volcano_store_wal_fsync_total",
-                     "volcano_store_wal_recovery_replayed_records"):
+                     "volcano_store_wal_recovery_replayed_records_total"):
             assert name in text
     finally:
         srv2.stop()

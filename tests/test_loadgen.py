@@ -1,0 +1,524 @@
+"""vtload: the open-loop load harness, the per-cycle time-series
+recorder, `vtctl top`, and the SLO chaos gate.
+
+Coverage map (ISSUE 9):
+
+* loadgen determinism — same seed, same schedule and same submitted
+  objects, byte for byte (the chaosd determinism contract);
+* the tier-1 open-loop smoke — a sub-second run through the real
+  Scheduler + Store that must sustain its QPS and report percentiles
+  (the fast twin of ``bench.py --open-loop`` / ``make loadtest``);
+* the time-series recorder — armed cycles sample phases/backlog/binds,
+  disarmed cycles record nothing AND leave the cfg5 phase set unchanged;
+  ``/debug/timeseries`` serves the ring on both servers, chaos-exempt;
+  ``trace.crash_dump`` artifacts carry the ring; ``vtctl top`` renders;
+* THE SLO CHAOS GATE — a lockstep open-loop run through a real
+  StoreServer under a seeded 5xx/cut storm must keep a bounded p99 and
+  converge to placements bit-for-bit equal to a fault-free run.
+"""
+
+import http.client
+import json
+import urllib.request
+
+import pytest
+
+from volcano_tpu import timeseries, trace
+from volcano_tpu.api import Resource
+from volcano_tpu.api.objects import Metadata, Node, Queue
+from volcano_tpu.backoff import Backoff
+from volcano_tpu.loadgen import (
+    LoadGen,
+    LoadSpec,
+    build_schedule,
+    run_open_loop,
+    saturation_search,
+)
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.conf import default_conf, full_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.store import Store
+from volcano_tpu.store.client import (
+    RemoteStore,
+    RemoteStoreError,
+    wait_healthy,
+)
+from volcano_tpu.store.server import StoreServer
+
+TRANSIENT = (RemoteStoreError, OSError, http.client.HTTPException)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    metrics.reset()
+    timeseries.disarm()
+    yield
+    timeseries.disarm()
+    metrics.reset()
+
+
+def _mk_store(n_nodes=6, cpu=8000.0):
+    store = Store()
+    store.create("Queue", Queue(
+        meta=Metadata(name="default", namespace=""), weight=1))
+    for i in range(n_nodes):
+        store.create("Node", Node(
+            meta=Metadata(name=f"n{i}", namespace=""),
+            allocatable=Resource(cpu, 16.0 * (1 << 30), max_task_num=110)))
+    return store
+
+
+# --- loadgen determinism -----------------------------------------------------
+
+
+def test_schedule_deterministic_per_seed():
+    spec = LoadSpec(qps=80, duration_s=1.0, seed=5, dwell_s=2.0)
+    s1 = build_schedule(spec)
+    s2 = build_schedule(spec)
+    assert s1 == s2 and len(s1) > 20
+    assert build_schedule(LoadSpec(qps=80, duration_s=1.0, seed=6)) != s1
+    # arrivals are time-ordered with materialized shapes
+    assert all(a.t <= b.t for a, b in zip(s1, s1[1:]))
+    assert all(a.size == len(a.mem_bytes) for a in s1)
+
+
+def test_generator_submits_identical_objects_per_seed():
+    spec = LoadSpec(qps=60, duration_s=0.5, seed=9)
+
+    def submitted(store):
+        gen = LoadGen(store, spec)
+        gen.submit_due(spec.duration_s)
+        return sorted(
+            (p.meta.key, p.spec.resources.milli_cpu,
+             p.spec.resources.memory)
+            for p in store.list("Pod")
+        ), sorted(
+            (g.meta.key, g.min_member, g.queue)
+            for g in store.list("PodGroup")
+        )
+
+    assert submitted(Store()) == submitted(Store())
+
+
+def test_resubmit_after_partial_failure_is_idempotent():
+    spec = LoadSpec(qps=200, duration_s=0.05, seed=2)
+    store = Store()
+    gen = LoadGen(store, spec)
+    arr = gen.due(1.0)[0]
+    # simulate an earlier cut attempt that committed half the gang
+    gen.submit(arr)
+    n_pods = len(store.list("Pod"))
+    gen._next -= 1  # roll the cursor back as a failed submit would leave it
+    del gen.gangs[arr.name]
+    gen.submit(arr)  # must not raise, must not duplicate
+    assert len(store.list("Pod")) == n_pods
+
+
+# --- the tier-1 open-loop smoke ---------------------------------------------
+
+
+def test_open_loop_smoke_sustains_qps_and_reports_percentiles():
+    """The seconds-scale twin of `bench.py --open-loop` (make loadtest):
+    sustain the arrival process, drain the tail, read p50/p99/p999 from
+    the bounded histograms — and route the samples through the PR-4
+    reference series."""
+    store = _mk_store()
+    sched = Scheduler(store, conf=full_conf("host"))
+    spec = LoadSpec(qps=60, duration_s=0.5, seed=1,
+                    cpu_millis=(100,), mem_mb=(64,), dwell_s=0.4)
+    report = run_open_loop(store, spec, sched.run_once, settle_s=20.0)
+    assert report.sustained, report.as_dict()
+    assert report.submitted_pods > 10
+    assert report.bound_pods == report.submitted_pods
+    assert 0.0 <= report.p50_ms <= report.p99_ms <= report.p999_ms
+    assert report.departed_gangs > 0  # churn ran
+    # the samples ALSO landed in the reference first-seen→bind series
+    series = metrics.get_histogram(
+        "volcano_e2e_job_scheduling_latency_milliseconds")
+    assert series.count == report.submitted_pods
+    assert metrics.quantile(
+        "volcano_e2e_job_scheduling_latency_milliseconds", 0.99) >= 0.0
+
+
+def test_saturation_search_escalates_until_band_breach():
+    calls = []
+
+    def run_at(qps):
+        calls.append(qps)
+        from volcano_tpu.loadgen.harness import SLOReport
+
+        # synthetic latency curve: p99 grows with qps, breaches at 40
+        return SLOReport(
+            qps=qps, duration_s=1.0, submitted_pods=10, bound_pods=10,
+            unbound_pods=0, p50_ms=qps, p99_ms=qps * 10, p999_ms=qps * 12,
+            max_ms=qps * 15, backlog_peak=0, departed_gangs=0, cycles=5,
+            wall_s=1.0, sustained=True)
+
+    out = saturation_search(run_at, base_qps=10, band_p99_ms=350.0,
+                            max_doublings=4)
+    assert calls == [10, 20, 40]
+    assert out.sustained_qps == 20 and out.breach_qps == 40
+    assert [r.qps for r in out.steps] == calls
+
+
+# --- the per-cycle time-series recorder --------------------------------------
+
+
+def _cycle_workload(store, n=4):
+    from volcano_tpu.api import POD_GROUP_KEY
+    from volcano_tpu.api.objects import Pod, PodGroup, PodSpec
+    from volcano_tpu.api.types import PodGroupPhase
+
+    for i in range(n):
+        pg = PodGroup(meta=Metadata(name=f"g{i}", namespace="default"),
+                      min_member=1, queue="default")
+        # default_conf has no enqueue action: admit directly
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.create("PodGroup", pg)
+        store.create("Pod", Pod(
+            meta=Metadata(name=f"p{i}", namespace="default",
+                          annotations={POD_GROUP_KEY: f"g{i}"}),
+            spec=PodSpec(image="x", resources=Resource(100.0, 1 << 20))))
+
+
+def test_recorder_samples_fast_cycles_and_disarmed_records_nothing():
+    # disarmed: no samples, no stats stash
+    store = _mk_store(n_nodes=2)
+    _cycle_workload(store)
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    sched.run_once()
+    assert timeseries.samples() == []
+    assert sched.fast_cycle.last_cycle_stats == {}
+
+    # armed: every cycle lands one sample with the fast-path fields
+    rec = timeseries.arm()
+    store2 = _mk_store(n_nodes=2)
+    _cycle_workload(store2)
+    sched2 = Scheduler(store2, conf=default_conf("tpu"))
+    sched2.run_once()
+    sched2.run_once()
+    samples = rec.samples()
+    cycles = [s for s in samples if s["kind"] == "cycle"]
+    assert len(cycles) == 2
+    first = cycles[0]
+    assert first["path"] == "fast"
+    assert first["binds"] == 4 and first["backlog"] >= 4
+    assert "drain" in first["phases"] and "publish" in first["phases"]
+    assert cycles[1]["cycle"] == first["cycle"] + 1
+    assert cycles[1]["binds"] == 0  # steady cycle: nothing pending
+
+
+def test_recorder_arming_leaves_phase_set_unchanged():
+    """Acceptance: arming the recorder must not add/remove cycle phases
+    (it observes the cycle, never reshapes it)."""
+    def phases_with(armed):
+        timeseries.disarm()
+        if armed:
+            timeseries.arm()
+        store = _mk_store(n_nodes=2)
+        _cycle_workload(store)
+        sched = Scheduler(store, conf=default_conf("tpu"))
+        sched.run_once()
+        sched.run_once()
+        return set(sched.fast_cycle.phases)
+
+    assert phases_with(armed=False) == phases_with(armed=True)
+
+
+def test_object_cycle_binds_delta_survives_fast_cycles():
+    """Regression: fast cycles ALSO append to cache.bind_log, so the
+    object-path binds delta must not bill a fast->object transition for
+    every fast bind since the last object cycle."""
+    import time as _time
+
+    rec = timeseries.arm()
+    store = _mk_store(n_nodes=2)
+    sched = Scheduler(store, conf=default_conf("tpu"))
+    # a fast cycle that published 3 binds (bind_log grew underneath)
+    sched.cache.bind_log.extend(
+        [("default/a", "n0"), ("default/b", "n0"), ("default/c", "n1")])
+    sched.fast_cycle.last_cycle_stats = {"binds": 3, "backlog": 3,
+                                         "evictions": 0, "residue_jobs": 0}
+    sched._record_cycle(_time.perf_counter(), "fast")
+    # next cycle falls back to the object path and binds 1 pod
+    sched.cache.bind_log.append(("default/d", "n1"))
+    sched._record_cycle(_time.perf_counter(), "object")
+    cycles = [s for s in rec.samples() if s["kind"] == "cycle"]
+    assert cycles[0]["binds"] == 3
+    assert cycles[1]["binds"] == 1  # NOT 4: the watermark advanced
+
+
+def test_store_server_records_flush_samples(tmp_path):
+    rec = timeseries.arm()
+    srv = StoreServer(state_path=str(tmp_path / "state.json"),
+                      wal=True, save_interval=3600.0).start()
+    try:
+        client = RemoteStore(srv.url)
+        client.create("Queue", Queue(
+            meta=Metadata(name="q", namespace=""), weight=1))
+        srv.flush_state(force=True)
+    finally:
+        srv.stop()
+    stores = [s for s in rec.samples() if s["kind"] == "store"]
+    assert stores, rec.samples()
+    last = stores[-1]
+    assert last["log_seq"] >= 1
+    assert last["wal"] is not None and last["wal"]["records"] >= 1
+
+
+def test_debug_timeseries_endpoint_on_both_servers_and_chaos_exempt():
+    from volcano_tpu.chaos import FaultPlan
+    from volcano_tpu.scheduler.metrics_server import MetricsServer
+
+    rec = timeseries.arm()
+    rec.record("cycle", dur_s=0.01, path="fast", cycle=0)
+    srv = StoreServer().start()
+    ms = MetricsServer(port=0).start()
+    try:
+        # every request 5xxs — the debug endpoints must still answer
+        srv.arm_chaos(FaultPlan.from_dict({
+            "seed": 1,
+            "rules": [{"point": "server.request", "action": "http_500",
+                       "every": 1, "count": 1000}],
+        }))
+        for url in (srv.url, f"http://127.0.0.1:{ms.port}"):
+            with urllib.request.urlopen(
+                url + "/debug/timeseries", timeout=10
+            ) as r:
+                payload = json.load(r)
+            assert payload["armed"] is True
+            assert payload["samples"][0]["kind"] == "cycle"
+        # disarmed recorder still serves a well-formed (empty) payload
+        timeseries.disarm()
+        with urllib.request.urlopen(
+            srv.url + "/debug/timeseries", timeout=10
+        ) as r:
+            payload = json.load(r)
+        assert payload == {"armed": False, "pid": payload["pid"],
+                           "samples": []}
+    finally:
+        srv.stop()
+        ms.stop()
+
+
+def test_crash_dump_carries_timeseries(tmp_path):
+    rec = timeseries.arm()
+    rec.record("cycle", dur_s=0.02, path="fast", cycle=7)
+    trace.arm(trace.Tracer(dump_dir=str(tmp_path)))
+    try:
+        with trace.span("scheduler.cycle"):
+            pass
+        path = trace.crash_dump("unit")
+        with open(path, encoding="utf-8") as f:
+            dump = json.load(f)
+        assert dump["spans"]
+        assert dump["timeseries"][0]["cycle"] == 7
+    finally:
+        trace.disarm()
+
+
+def test_vtctl_top_renders_ring_and_remote_fetch(capsys):
+    from volcano_tpu.cli import cmd_top, main
+    from volcano_tpu.scheduler.metrics_server import MetricsServer
+
+    samples = [
+        {"seq": 1, "kind": "cycle", "ts": 100.0, "cycle": 3,
+         "dur_s": 0.048, "path": "fast", "backlog": 12, "binds": 12,
+         "evictions": 0, "drain_pending": 2,
+         "phases": {"drain": 0.01, "solve": 0.02, "publish": 0.004}},
+        {"seq": 2, "kind": "store", "ts": 100.2, "log_seq": 42,
+         "log_rows": 10,
+         "wal": {"records": 9, "fsync_total": 3, "fsync_s": 0.01}},
+    ]
+    text = cmd_top(samples, now=101.0)
+    assert "Cycle" in text and "Backlog" in text
+    assert "48.0" in text and "solve=0.020" in text
+    assert "seq=42" in text and "fsyncs=3" in text
+    assert "dur p50" in text
+    assert "no time-series samples" in cmd_top([])
+
+    # remote: `vtctl --server ... top` renders the served ring
+    rec = timeseries.arm()
+    rec.record("cycle", dur_s=0.031, path="fast", cycle=11, backlog=1,
+               binds=1, evictions=0, drain_pending=0, phases={})
+    ms = MetricsServer(port=0).start()
+    try:
+        rc = main(["--server", f"http://127.0.0.1:{ms.port}", "top"])
+    finally:
+        ms.stop()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "31.0" in out and "11" in out
+
+
+# --- subprocess mode ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_open_loop_against_real_daemon_processes():
+    """Subprocess mode: the SAME generator drives real OS-process
+    daemons over HTTP (apiserver + scheduler with the time-series
+    recorder armed), and `vtctl top --server` renders the scheduler's
+    live /debug/timeseries ring."""
+    import os
+    import subprocess
+    import sys
+
+    ENTRY = [sys.executable, "-m", "volcano_tpu.cli"]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "VOLCANO_TPU_TIMESERIES": "1"}
+
+    def spawn(args):
+        return subprocess.Popen(
+            ENTRY + args, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+
+    procs = []
+    try:
+        api = spawn(["apiserver", "--port", "0"])
+        procs.append(api)
+        line = api.stdout.readline().strip()
+        assert "listening on" in line, line
+        url = line.rsplit(" ", 1)[-1]
+        sch = spawn(["scheduler", "--server", url, "--period", "0.05",
+                     "--metrics-port", "0", "--no-leader-elect"])
+        procs.append(sch)
+        metrics_url = ""
+        for _ in range(10):
+            line = sch.stdout.readline()
+            if "/metrics" in line:
+                metrics_url = line.rsplit(" ", 1)[-1].strip()
+                metrics_url = metrics_url.rsplit("/metrics", 1)[0]
+                break
+        assert metrics_url, "scheduler never announced its metrics port"
+
+        client = RemoteStore(url)  # run_apiserver already seeded "default"
+        for i in range(4):
+            client.create("Node", Node(
+                meta=Metadata(name=f"n{i}", namespace=""),
+                allocatable=Resource(8000.0, 16.0 * (1 << 30),
+                                     max_task_num=110)))
+        spec = LoadSpec(qps=30, duration_s=1.0, seed=3,
+                        cpu_millis=(100,), mem_mb=(64,), namespace="sub")
+        report = run_open_loop(client, spec, lambda: None, settle_s=60.0,
+                               idle_sleep_s=0.02)
+        assert report.sustained, report.as_dict()
+        assert report.bound_pods == report.submitted_pods > 10
+
+        # the daemon's recorder sampled its cycles; vtctl top renders it
+        from volcano_tpu.cli import cmd_top
+        from volcano_tpu.cli.vtctl import _fetch_debug_timeseries
+
+        samples = _fetch_debug_timeseries(metrics_url)
+        cycles = [s for s in samples if s["kind"] == "cycle"]
+        assert cycles and any(s.get("binds", 0) > 0 for s in cycles)
+        text = cmd_top(samples)
+        assert "Cycle" in text and "dur p50" in text
+    finally:
+        for p in procs:
+            p.send_signal(__import__("signal").SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+# --- THE SLO CHAOS GATE ------------------------------------------------------
+
+#: seeded, bounded request-plane storm: 5xx bursts + mid-body cuts while
+#: the open-loop run is live (counts are generous enough to span it)
+GATE_PLAN = {
+    "seed": 11,
+    "rules": [
+        {"point": "server.request", "action": "http_500",
+         "every": 5, "count": 25},
+        {"point": "server.request", "action": "cut_body",
+         "after": 7, "every": 9, "count": 8},
+    ],
+}
+
+
+def _arm(url, plan):
+    data = json.dumps(plan).encode() if plan is not None else None
+    req = urllib.request.Request(
+        url + "/chaos", data=data,
+        method="POST" if plan is not None else "DELETE")
+    return json.load(urllib.request.urlopen(req, timeout=10))
+
+
+def _slo_gate_run(plan, seed=7):
+    """One lockstep open-loop run over real HTTP: submit-with-retry per
+    virtual tick, pump-with-retry, observe binds.  Returns (placements,
+    generator) after convergence."""
+    srv = StoreServer().start()
+    try:
+        assert wait_healthy(srv.url, timeout=10)
+        srv.store.create("Queue", Queue(
+            meta=Metadata(name="default", namespace=""), weight=1))
+        for i in range(6):
+            srv.store.create("Node", Node(
+                meta=Metadata(name=f"n{i}", namespace=""),
+                allocatable=Resource(8000.0, 16.0 * (1 << 30),
+                                     max_task_num=110)))
+        client = RemoteStore(srv.url)
+        sched = Scheduler(client, conf=full_conf("host"))
+        if plan is not None:
+            _arm(srv.url, plan)
+        spec = LoadSpec(qps=40, duration_s=0.8, seed=seed,
+                        cpu_millis=(100,), mem_mb=(64,), namespace="slo")
+        gen = LoadGen(client, spec)
+        retry = Backoff(base=0.01, cap=0.2, seed=41)
+        import time as _time
+
+        deadline = _time.monotonic() + 120
+        vnow = 0.0
+        while not gen.done:
+            assert _time.monotonic() < deadline, "gate never converged"
+            for arr in gen.due(vnow):
+                while True:
+                    try:
+                        gen.submit(arr)
+                        break
+                    except TRANSIENT:
+                        retry.sleep()
+            while True:
+                try:
+                    sched.run_once()
+                    break
+                except TRANSIENT:
+                    retry.sleep()
+            try:
+                gen.observe()
+            except TRANSIENT:
+                retry.sleep()
+            vnow += 0.05
+        if plan is not None:
+            # read the storm stats BEFORE disarming (disarm clears them)
+            status = json.load(urllib.request.urlopen(
+                srv.url + "/chaos", timeout=10))
+            assert any(s["fires"] > 0 for s in status["stats"]), (
+                "the storm never actually fired")
+            _arm(srv.url, None)
+        return gen.placements(), gen
+    finally:
+        srv.stop()
+
+
+def test_slo_chaos_gate_bounded_p99_and_fault_free_placements():
+    """ISSUE 9 acceptance: an open-loop run under a seeded chaosd storm
+    keeps a bounded p99 first-seen→bind latency and converges to
+    placements bit-for-bit equal to a fault-free run — the r2 chaos
+    discipline tied to latency, not only convergence."""
+    placed_chaos, gen_chaos = _slo_gate_run(GATE_PLAN)
+    placed_clean, gen_clean = _slo_gate_run(None)
+
+    assert gen_chaos.submitted_pods == gen_clean.submitted_pods > 20
+    assert gen_chaos.bound_pods == gen_chaos.submitted_pods
+    # placements: bit-for-bit equal to the fault-free run
+    assert placed_chaos == placed_clean
+    # bounded latency tail: the storm inflates it but the histogram
+    # percentile stays finite and inside the gate band
+    p99 = gen_chaos.quantile_ms(0.99)
+    assert 0.0 < p99 < 5000.0, p99
+    assert gen_chaos.quantile_ms(0.999) < 10000.0

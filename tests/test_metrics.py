@@ -1,0 +1,248 @@
+"""vtload metrics core: bounded histograms + Prometheus text conformance.
+
+The r8 rebuild replaced the unbounded per-sample lists behind
+``metrics.observe()`` with fixed-universe log-linear bucket histograms
+and a proper text exposition.  This suite holds the three contracts:
+
+* **conformance** — a mini Prometheus text-format parser asserts
+  HELP/TYPE presence, ascending ``le`` with monotone cumulative counts,
+  ``le="+Inf"`` == ``_count``, and byte-stable output ordering;
+* **boundedness** — a series with 10^6 observations occupies the same
+  fixed bucket state as one with 10^2 (ISSUE 9 acceptance), and the
+  label-cardinality guard caps per-name series with a dropped counter;
+* **readout** — p50/p99/p999 quantiles land within one sub-bucket width
+  of the exact answer.
+"""
+
+import math
+import re
+
+import pytest
+
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.metrics import (
+    MAX_BUCKETS,
+    MAX_SERIES_PER_METRIC,
+    SUBBUCKETS,
+    Histogram,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# --- mini Prometheus text-format parser --------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+
+
+def parse_prometheus(text: str):
+    """(families, samples): family name -> {"help": str, "type": str};
+    samples = list of (name, labels dict, float value) in file order.
+    Raises AssertionError on malformed lines — the parser IS the
+    conformance check."""
+    families = {}
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            families.setdefault(name, {})["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            assert mtype in ("counter", "gauge", "histogram"), line
+            families.setdefault(name, {})["type"] = mtype
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            for part in m.group("labels").split(","):
+                k, _, v = part.partition("=")
+                assert v.startswith('"') and v.endswith('"'), line
+                labels[k] = v[1:-1]
+        samples.append((m.group("name"), labels, m.group("value")))
+    return families, samples
+
+
+def _family_of(sample_name: str, families) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        base = sample_name[: -len(suffix)] if sample_name.endswith(suffix) \
+            else None
+        if base and families.get(base, {}).get("type") == "histogram":
+            return base
+    return sample_name
+
+
+def test_exposition_conformance_help_type_and_bucket_invariants():
+    metrics.inc("volcano_conf_total", 3)
+    metrics.set_gauge("volcano_conf_gauge", 1.25, pool="a")
+    for v in (0.4, 1.0, 8.0, 8.0, 120.0):
+        metrics.observe("volcano_conf_latency_seconds", v, op="x")
+    text = metrics.expose_text()
+    families, samples = parse_prometheus(text)
+
+    # every sample's family carries HELP and TYPE
+    for name, _, _ in samples:
+        fam = _family_of(name, families)
+        assert "help" in families[fam], fam
+        assert "type" in families[fam], fam
+    assert families["volcano_conf_total"]["type"] == "counter"
+    assert families["volcano_conf_gauge"]["type"] == "gauge"
+    assert families["volcano_conf_latency_seconds"]["type"] == "histogram"
+
+    # histogram: le ascending, cumulative monotone, +Inf == _count
+    buckets = [(ls["le"], float(v)) for n, ls, v in samples
+               if n == "volcano_conf_latency_seconds_bucket"]
+    les = [math.inf if le == "+Inf" else float(le) for le, _ in buckets]
+    counts = [c for _, c in buckets]
+    assert les == sorted(les) and len(set(les)) == len(les)
+    assert counts == sorted(counts)
+    assert les[-1] == math.inf
+    count_v = next(float(v) for n, ls, v in samples
+                   if n == "volcano_conf_latency_seconds_count")
+    sum_v = next(float(v) for n, ls, v in samples
+                 if n == "volcano_conf_latency_seconds_sum")
+    assert counts[-1] == count_v == 5
+    assert sum_v == pytest.approx(137.4)
+    # every observation sits at or below its bucket's le
+    assert all(c >= 1 for c in counts)
+
+
+def test_exposition_byte_stable_ordering():
+    def record(order):
+        metrics.reset()
+        for name, kind in order:
+            if kind == "c":
+                metrics.inc(name, 1, q=name[-1])
+            elif kind == "g":
+                metrics.set_gauge(name, 2.0)
+            else:
+                metrics.observe(name, 0.5)
+        return metrics.expose_text()
+
+    series = [("volcano_b_total", "c"), ("volcano_a_seconds", "h"),
+              ("volcano_c_gauge", "g"), ("volcano_b_total", "c")]
+    t1 = record(series)
+    t2 = record(list(reversed(series)))
+    assert t1 == t2  # insertion order never leaks into the exposition
+    assert metrics.expose_text() == metrics.expose_text()  # and stable
+
+
+def test_histogram_state_is_bounded_by_buckets_not_observations():
+    """THE memory-leak fix: 10^6 observations occupy the same fixed
+    bucket state as 10^2 (ISSUE 9 acceptance criterion)."""
+    vals = [0.001 * (i % 97 + 1) for i in range(100)]
+    small = Histogram()
+    for v in vals:
+        small.observe(v)
+    big = Histogram()
+    for i in range(10 ** 6):
+        big.observe(vals[i % 100])
+    assert len(big.buckets) == len(small.buckets)
+    assert len(big.buckets) <= MAX_BUCKETS
+    assert big.count == 10 ** 6 and small.count == 100
+    # and through the module API: same series, a million more samples,
+    # identical bucket-universe bound
+    for i in range(1000):
+        metrics.observe("volcano_bounded_seconds", vals[i % 100])
+    snap = metrics.get_histogram("volcano_bounded_seconds")
+    assert snap.count == 1000
+    assert len(snap.buckets) <= MAX_BUCKETS
+
+
+def test_quantile_within_one_subbucket():
+    h = Histogram()
+    for i in range(1, 10001):
+        h.observe(i / 1000.0)  # 1ms .. 10s uniform
+    rel = 9.0 / SUBBUCKETS
+    for q, exact in ((0.5, 5.0), (0.99, 9.9), (0.999, 9.99)):
+        got = h.quantile(q)
+        assert exact * (1 - 1e-9) <= got <= exact * (1 + rel + 0.01), (q, got)
+    assert h.quantile(1.0) <= h.vmax * (1 + rel)
+
+
+def test_label_cardinality_guard_caps_series_and_counts_drops():
+    for i in range(MAX_SERIES_PER_METRIC + 40):
+        metrics.register_job_retry(f"default/job-{i:04d}")
+    # the cap held: exactly MAX series exist, the overflow was counted
+    text = metrics.expose_text()
+    n_series = text.count("volcano_job_retry_counts{")
+    assert n_series == MAX_SERIES_PER_METRIC
+    assert metrics.get_counter(
+        "volcano_metrics_dropped_series_total",
+        metric="volcano_job_retry_counts") == 40
+    # dropped observations are silent: admitted series keep counting
+    metrics.register_job_retry("default/job-0000")
+    assert metrics.get_counter("volcano_job_retry_counts",
+                               job_id="default/job-0000") == 2
+    # histograms are guarded too
+    for i in range(MAX_SERIES_PER_METRIC + 5):
+        metrics.observe("volcano_guarded_seconds", 0.1, job=f"j{i}")
+    assert metrics.get_counter("volcano_metrics_dropped_series_total",
+                               metric="volcano_guarded_seconds") == 5
+
+
+def test_snapshot_list_compat_and_empty_series():
+    empty = metrics.get_histogram("volcano_never_observed_seconds")
+    assert len(empty) == 0 and list(empty) == [] and not empty
+    assert empty.quantile(0.99) == 0.0
+    metrics.observe("volcano_compat_seconds", 0.25)
+    metrics.observe("volcano_compat_seconds", 0.5)
+    snap = metrics.get_histogram("volcano_compat_seconds")
+    assert len(snap) == 2
+    vals = list(snap)
+    assert len(vals) == 2 and all(v >= 0.25 for v in vals)
+    assert metrics.quantile("volcano_compat_seconds", 0.5) >= 0.25
+
+
+def test_wal_fsync_seconds_histogram_exposed(tmp_path):
+    """Satellite: group-commit fsync latency is a histogram on /metrics
+    (the ``_total`` counters only ever showed volume)."""
+    from volcano_tpu.store.wal import WriteAheadLog
+
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    wal.append_commit({"op": "delete", "kind": "Pod", "key": "a/b"})
+    wal.append_commit({"op": "delete", "kind": "Pod", "key": "a/c"})
+    wal.sync_close()
+    snap = metrics.get_histogram("volcano_store_wal_fsync_seconds")
+    assert snap.count >= 2
+    assert snap.sum >= 0.0
+    text = metrics.expose_text()
+    assert 'volcano_store_wal_fsync_seconds_bucket{le="+Inf"}' in text
+    assert "volcano_store_wal_fsync_seconds_count" in text
+    families, _ = parse_prometheus(text)
+    assert families["volcano_store_wal_fsync_seconds"]["type"] == "histogram"
+    # fsync volume counter still rides alongside, with the new-name
+    # recovery counter family registered under the _total discipline
+    assert metrics.get_counter("volcano_store_wal_fsync_total") >= 2
+
+
+def test_counter_and_histogram_monotone_under_interleaving():
+    """Monotonicity across the histogram encoding: count/sum/buckets
+    only ever grow (the shape the e2e-latency/WAL/residue tests rely
+    on)."""
+    for i in range(5):
+        metrics.observe("volcano_mono_latency_seconds", 0.01 * (i + 1))
+    s1 = metrics.get_histogram("volcano_mono_latency_seconds")
+    for i in range(5):
+        metrics.observe("volcano_mono_latency_seconds", 0.02 * (i + 1))
+    s2 = metrics.get_histogram("volcano_mono_latency_seconds")
+    assert s2.count == s1.count + 5
+    assert s2.sum > s1.sum
+    c1 = dict((le, c) for le, c in s1.buckets)
+    c2 = dict((le, c) for le, c in s2.buckets)
+    for le, c in c1.items():
+        assert c2.get(le, 0) >= c  # cumulative counts never shrink

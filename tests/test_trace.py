@@ -430,23 +430,33 @@ def test_real_daemons_expose_one_trace_on_all_debug_endpoints():
 
 def test_pod_e2e_latency_metric_exposition_and_monotonicity(armed):
     """Satellite: the reference-parity first-seen->bind series, emitted
-    from bind spans — exposition format + monotone count/sum."""
+    from bind spans — histogram exposition format (r8 bounded-bucket
+    encoding: _bucket/_sum/_count lines) + monotone count/sum."""
     from volcano_tpu.cli import cmd_run
 
     metrics.reset()
     c = _gang_cluster()
     cmd_run(c.store, name="m1", replicas=2, min_available=2)
     c.run_until_idle()
-    vals = list(metrics.get_histogram(
-        "volcano_e2e_job_scheduling_latency_milliseconds"))
-    assert len(vals) == 2 and all(v >= 0 for v in vals)
+    snap = metrics.get_histogram(
+        "volcano_e2e_job_scheduling_latency_milliseconds")
+    assert len(snap) == 2 and all(v >= 0 for v in snap)
     text = metrics.expose_text()
     assert "volcano_e2e_job_scheduling_latency_milliseconds_count 2" in text
     assert "volcano_e2e_job_scheduling_latency_milliseconds_sum" in text
+    assert ('volcano_e2e_job_scheduling_latency_milliseconds_bucket'
+            '{le="+Inf"} 2') in text
+    assert "# TYPE volcano_e2e_job_scheduling_latency_milliseconds " \
+           "histogram" in text
     cmd_run(c.store, name="m2", replicas=1, min_available=1)
     c.run_until_idle()
-    vals2 = metrics.get_histogram("volcano_e2e_job_scheduling_latency_milliseconds")
-    assert len(vals2) == 3  # monotone: observations only accumulate
-    assert vals2[:2] == vals
+    snap2 = metrics.get_histogram(
+        "volcano_e2e_job_scheduling_latency_milliseconds")
+    assert len(snap2) == 3  # monotone: observations only accumulate
+    assert snap2.sum >= snap.sum
+    # cumulative bucket counts never shrink across the encoding
+    before = dict(snap.buckets)
+    after = dict(snap2.buckets)
+    assert all(after.get(le, 0) >= c for le, c in before.items())
     assert "volcano_e2e_job_scheduling_latency_milliseconds_count 3" \
         in metrics.expose_text()

@@ -926,6 +926,81 @@ def test_trace_span_near_misses(tmp_path):
     """, select=["trace-span-discipline"]) == []
 
 
+# --- rule: metric-discipline -------------------------------------------------
+
+
+def test_metric_discipline_fires_on_unsuffixed_counter(tmp_path):
+    findings = _lint(tmp_path, "scheduler/x.py", """
+        from volcano_tpu.scheduler import metrics
+
+        def record():
+            metrics.inc("volcano_retries")
+    """, select=["metric-discipline"])
+    assert _rules_of(findings) == ["metric-discipline"]
+    assert "_total" in findings[0].message
+
+
+def test_metric_discipline_fires_on_unitless_duration(tmp_path):
+    findings = _lint(tmp_path, "scheduler/x.py", """
+        from volcano_tpu.scheduler import metrics
+
+        def record(dur):
+            metrics.observe("volcano_bind_latency", dur)
+    """, select=["metric-discipline"])
+    assert _rules_of(findings) == ["metric-discipline"]
+    assert "unit suffix" in findings[0].message
+
+
+def test_metric_discipline_fires_on_wall_clock_value(tmp_path):
+    # time.time() feeding the recorded value — both through the module
+    # verbs and through the metrics.* helper wrappers
+    findings = _lint(tmp_path, "scheduler/x.py", """
+        import time
+
+        from volcano_tpu.scheduler import metrics
+
+        def record(t0):
+            metrics.observe("volcano_x_seconds", time.time() - t0)
+            metrics.update_pod_e2e_latency((time.time() - t0) * 1e3)
+    """, select=["metric-discipline"])
+    assert _rules_of(findings) == ["metric-discipline"] * 2
+    assert "monotonic" in findings[0].message
+
+
+def test_metric_discipline_near_misses_stay_quiet(tmp_path):
+    # compliant counter/duration names, perf_counter-derived values, a
+    # non-volcano literal on a foreign inc(), and wall-clock reads that
+    # never feed a metric all pass
+    assert _lint(tmp_path, "scheduler/x.py", """
+        import time
+
+        from volcano_tpu.scheduler import metrics
+
+        def record(t0):
+            metrics.inc("volcano_retries_total")
+            metrics.observe("volcano_bind_latency_seconds",
+                            time.perf_counter() - t0)
+            metrics.set_gauge("volcano_pool_size", 3)
+            counter.inc("retries")          # not a volcano series
+            stamp = time.time()             # not a metric value
+            return stamp
+    """, select=["metric-discipline"]) == []
+
+
+def test_metric_discipline_suppressions_carry_justification():
+    """The sanctioned exceptions are line-suppressed with their reasons:
+    the two reference-parity counter names in metrics.py and the one
+    cross-process epoch edge in cache.py — the rule still fires on any
+    NEW violation in those files."""
+    import volcano_tpu
+
+    pkg = os.path.dirname(os.path.abspath(volcano_tpu.__file__))
+    mx = open(os.path.join(pkg, "scheduler", "metrics.py")).read()
+    assert mx.count("vtlint: disable=metric-discipline") == 2
+    cache = open(os.path.join(pkg, "scheduler", "cache.py")).read()
+    assert cache.count("vtlint: disable=metric-discipline") == 1
+
+
 # --- suppression contract ---------------------------------------------------
 
 

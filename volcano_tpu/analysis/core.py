@@ -93,6 +93,7 @@ def _load_rule_modules() -> None:
         rules_excepts,
         rules_hotpath,
         rules_io,
+        rules_metrics,
         rules_parity,
         rules_registry,
         rules_residue,

@@ -1,0 +1,135 @@
+"""metric-discipline: naming and clock hygiene for the metrics layer.
+
+The bounded-histogram metrics core (scheduler/metrics.py, r8) makes the
+series operators scrape the contract surface; this rule fences the three
+regressions that silently corrupt it:
+
+1. **Counter naming** — a series recorded with ``inc()`` is a Prometheus
+   counter and must end ``_total`` (the exposition stamps ``# TYPE ...
+   counter``; scrape-side rate()/increase() tooling keys on the suffix).
+   The reference-parity names that predate the convention
+   (``volcano_total_preemption_attempts``, ``volcano_job_retry_counts``)
+   carry justified line suppressions — new counters don't get to.
+2. **Duration units** — a histogram whose name says it measures time
+   (``latency`` / ``duration``) must carry an explicit unit suffix
+   (``_seconds`` / ``_milliseconds`` / ``_microseconds``): a unitless
+   duration series is unreadable on a dashboard and unfixable once
+   scraped.
+3. **Monotonic clocks** — a metric value derived from ``time.time()`` in
+   the emitting expression measures wall-clock, which steps under NTP
+   and skews latency tails; measurement sites must use
+   ``time.monotonic()`` / ``time.perf_counter()``.  The one sanctioned
+   exception (the cross-process first-seen→bind series, whose start edge
+   is an epoch creation timestamp) carries a justified suppression.
+
+Scope: the whole package — metric calls are recognized by shape
+(``metrics.inc`` / ``metrics.observe`` / ``metrics.update_*`` /
+``metrics.register_*`` / ``metrics.set_gauge``, or the bare helpers
+inside a module that defines them) with a ``volcano``-prefixed literal
+name where naming is checked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    dotted_name,
+    rule,
+)
+
+_UNIT_SUFFIXES = ("_seconds", "_milliseconds", "_microseconds")
+_DURATION_MARKERS = ("latency", "duration")
+
+
+def _metric_call(call: ast.Call) -> Optional[str]:
+    """The metric-layer verb this call invokes (``inc`` / ``observe`` /
+    ``set_gauge`` / ``update_*`` / ``register_*`` / ``observe_*``), or
+    None.  Bare names count too — metrics.py itself calls its own
+    module-level ``inc``/``observe``."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    tail = name.split(".")[-1]
+    if tail in ("inc", "observe", "set_gauge"):
+        return tail
+    if "metrics" in name.split(".")[:-1] and (
+        tail.startswith("update_") or tail.startswith("register_")
+        or tail.startswith("observe_")
+    ):
+        return tail
+    return None
+
+
+def _literal_metric_name(call: ast.Call) -> Optional[str]:
+    """First-arg string literal when it names a volcano series."""
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        name = call.args[0].value
+        if name.startswith("volcano"):
+            return name
+    return None
+
+
+def _uses_wall_clock(call: ast.Call) -> bool:
+    """Any ``time.time()`` call inside the metric call's argument
+    subtree — the value being recorded was derived from wall clock."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func) or ""
+                parts = name.split(".")
+                if parts[-1] == "time" and len(parts) > 1 \
+                        and parts[-2] in ("time", "_time"):
+                    return True
+    return False
+
+
+@rule(
+    "metric-discipline",
+    "metrics hygiene: counters recorded with inc() must end _total, "
+    "duration histograms must carry a unit suffix "
+    "(_seconds/_milliseconds/_microseconds), and metric values must not "
+    "be derived from wall-clock time.time() — use time.monotonic() / "
+    "time.perf_counter(); reference-parity names and cross-process epoch "
+    "edges carry justified line suppressions",
+)
+def check_metric_discipline(ctx: FileContext) -> Iterable[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        verb = _metric_call(node)
+        if verb is None:
+            continue
+        if _uses_wall_clock(node):
+            yield ctx.finding(
+                "metric-discipline",
+                node,
+                f"metric value for {verb}() derived from wall-clock "
+                "time.time(): latency/duration measurement must use "
+                "time.monotonic() or time.perf_counter() (wall clock "
+                "steps under NTP and skews the recorded tail)",
+            )
+        name = _literal_metric_name(node)
+        if name is None:
+            continue
+        if verb == "inc" and not name.endswith("_total"):
+            yield ctx.finding(
+                "metric-discipline",
+                node,
+                f"counter {name!r} recorded with inc() must end "
+                "'_total' (Prometheus counter naming; the exposition "
+                "stamps TYPE counter)",
+            )
+        if verb == "observe" and any(
+            m in name for m in _DURATION_MARKERS
+        ) and not name.endswith(_UNIT_SUFFIXES):
+            yield ctx.finding(
+                "metric-discipline",
+                node,
+                f"duration histogram {name!r} must carry a unit suffix "
+                "(_seconds/_milliseconds/_microseconds)",
+            )

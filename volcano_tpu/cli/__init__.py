@@ -17,6 +17,7 @@ from volcano_tpu.cli.vtctl import (
     cmd_resume,
     cmd_run,
     cmd_suspend,
+    cmd_top,
     cmd_trace_render,
     cmd_uncordon,
     main,
@@ -35,6 +36,7 @@ __all__ = [
     "cmd_resume",
     "cmd_run",
     "cmd_suspend",
+    "cmd_top",
     "cmd_trace_render",
     "cmd_uncordon",
     "main",

@@ -346,6 +346,80 @@ def cmd_events(store, namespace: str = "",
     return text
 
 
+def _phase_summary(phases: dict, top: int = 3) -> str:
+    """The ``top`` costliest phases of one cycle, ``name=seconds``."""
+    if not phases:
+        return ""
+    items = sorted(phases.items(), key=lambda kv: -kv[1])[:top]
+    return " ".join(f"{k}={v:.3f}" for k, v in items)
+
+
+def cmd_top(samples, out: Optional[io.TextIOBase] = None, n: int = 12,
+            now: Optional[float] = None) -> str:
+    """Render the per-cycle time-series ring (volcano_tpu/timeseries.py)
+    as a live control-plane dashboard: last ``n`` scheduler cycles with
+    duration / backlog / binds / drain lag / top phases, a window
+    percentile summary, and the newest store-side sample (event-log
+    position + WAL fsync accounting)."""
+    import time as _time
+
+    now = _time.time() if now is None else now
+    cycles = [s for s in samples if s.get("kind") == "cycle"]
+    stores = [s for s in samples if s.get("kind") == "store"]
+    buf = io.StringIO()
+    if not samples:
+        buf.write("no time-series samples (arm the recorder with "
+                  "VOLCANO_TPU_TIMESERIES=1)\n")
+    else:
+        row = "%-8s%-8s%-10s%-8s%-9s%-7s%-7s%-7s%s\n"
+        buf.write(row % ("Cycle", "Age", "Dur(ms)", "Path", "Backlog",
+                         "Binds", "Evict", "Drain", "Phases"))
+        for s in cycles[-n:]:
+            buf.write(row % (
+                s.get("cycle", "-"),
+                f"{max(now - s.get('ts', now), 0.0):.1f}s",
+                f"{s.get('dur_s', 0.0) * 1e3:.1f}",
+                s.get("path", "-"),
+                s.get("backlog", "-"),
+                s.get("binds", "-"),
+                s.get("evictions", "-"),
+                s.get("drain_pending", "-"),
+                _phase_summary(s.get("phases") or {}),
+            ))
+        if cycles:
+            durs = sorted(s.get("dur_s", 0.0) for s in cycles)
+            p = lambda q: durs[min(int(q * len(durs)), len(durs) - 1)] * 1e3  # noqa: E731
+            buf.write(
+                f"cycles: {len(durs)} sampled, dur p50 {p(0.5):.1f}ms "
+                f"p99 {p(0.99):.1f}ms max {durs[-1] * 1e3:.1f}ms\n"
+            )
+        if stores:
+            s = stores[-1]
+            line = (f"store: seq={s.get('log_seq')} "
+                    f"log_rows={s.get('log_rows')}")
+            wal = s.get("wal")
+            if wal:
+                line += (f" wal: records={wal.get('records')} "
+                         f"fsyncs={wal.get('fsync_total')} "
+                         f"fsync_s={wal.get('fsync_s')}")
+            buf.write(line + "\n")
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def _fetch_debug_timeseries(server_url: str) -> list:
+    """The remote time-series ring: GET <server>/debug/timeseries."""
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(
+        server_url.rstrip("/") + "/debug/timeseries", timeout=10
+    ) as r:
+        return _json.load(r).get("samples") or []
+
+
 def cmd_trace_render(records, trace_id: str = "",
                      out: Optional[io.TextIOBase] = None) -> str:
     """Span tree for one trace — the given id, or the most recent trace
@@ -596,6 +670,17 @@ def main(argv=None) -> int:
                         help="trace id (default: most recent)")
     tr_sub.add_parser("dump", parents=[common])
 
+    # vtload: the per-cycle time-series dashboard (timeseries.py)
+    top_p = sub.add_parser("top", parents=[common],
+                           help="live per-cycle dashboard from the "
+                                "/debug/timeseries ring")
+    top_p.add_argument("--n", type=int, default=12,
+                       help="cycle rows to show")
+    top_p.add_argument("--watch", type=float, default=0.0,
+                       help="refresh every N seconds (0 = render once)")
+    top_p.add_argument("--count", type=int, default=0,
+                       help="refresh iterations with --watch (0 = forever)")
+
     cl_p = sub.add_parser("cluster", help="simulated cluster management")
     cl_sub = cl_p.add_subparsers(dest="cmd", required=True)
     init_p = cl_sub.add_parser("init", parents=[common])
@@ -662,6 +747,32 @@ def main(argv=None) -> int:
                                 "0 = free port, <0 = disabled)")
 
     args = parser.parse_args(argv)
+
+    if args.group == "top":
+        from volcano_tpu import timeseries
+
+        def samples_once():
+            if args.server:
+                return _fetch_debug_timeseries(args.server)
+            return (timeseries.RECORDER.samples()
+                    if timeseries.RECORDER is not None else [])
+
+        import time as _time
+
+        i = 0
+        try:
+            while True:
+                cmd_top(samples_once(), out=sys.stdout, n=args.n)
+                i += 1
+                if args.watch <= 0 or (args.count and i >= args.count):
+                    break
+                _time.sleep(args.watch)
+        except KeyboardInterrupt:
+            pass
+        except Exception as e:  # surface as CLI error, not traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        return 0
 
     if args.group == "up":
         from volcano_tpu.cli import daemons

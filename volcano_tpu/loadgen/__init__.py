@@ -1,0 +1,39 @@
+"""vtload: seeded open-loop load generation for the volcano-tpu bus.
+
+The benches replay one big closed batch; this package models the other
+half of ROADMAP item 2 — "millions of users submitting jobs at
+controlled QPS" — as a **seeded open-loop arrival process** (Poisson
+inter-arrivals, gang-size / resource / queue mix distributions,
+exponential dwell departures) that drives the real store bus and
+daemons, deterministic per seed like chaosd, and measures **pod
+first-seen→bind latency** into the bounded metric histograms
+(scheduler/metrics.py) so p50/p99/p999 fall out of the same series the
+reference exports.
+
+* :mod:`volcano_tpu.loadgen.workload` — ``LoadSpec`` (the distributions),
+  ``build_schedule`` (the deterministic event list), ``LoadGen`` (submit
+  / observe-binds / depart against any Store-shaped client: the
+  in-process ``Store`` or a ``RemoteStore`` over real HTTP).
+* :mod:`volcano_tpu.loadgen.harness` — the open-loop runner
+  (:func:`run_open_loop`, wall-clock or lockstep-deterministic pacing),
+  the ``SLOReport`` percentile readout, and :func:`saturation_search`
+  (raise QPS until p99 breaches the band) — what ``bench.py
+  --open-loop`` (cfg8) and the SLO chaos gate run.
+"""
+
+from volcano_tpu.loadgen.harness import (  # noqa: F401
+    SLOReport,
+    run_open_loop,
+    saturation_search,
+)
+from volcano_tpu.loadgen.workload import (  # noqa: F401
+    Arrival,
+    LoadGen,
+    LoadSpec,
+    build_schedule,
+)
+
+__all__ = [
+    "Arrival", "LoadGen", "LoadSpec", "build_schedule",
+    "SLOReport", "run_open_loop", "saturation_search",
+]

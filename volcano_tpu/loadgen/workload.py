@@ -1,0 +1,280 @@
+"""Seeded open-loop workload: deterministic arrival/departure schedules.
+
+The generator is split in two so determinism is inspectable:
+
+* :func:`build_schedule` turns a :class:`LoadSpec` into the COMPLETE
+  event list up front — arrival times (Poisson inter-arrivals at
+  ``qps``), gang sizes, per-pod resources, queue assignment, and dwell
+  (lifetime after full placement, exponential) — all drawn from one
+  ``numpy`` generator seeded by ``spec.seed``.  Same seed, same
+  schedule, byte for byte: the chaosd determinism contract.
+* :class:`LoadGen` replays that schedule against any Store-shaped client
+  (the in-process ``Store`` or a ``RemoteStore`` over real HTTP):
+  ``submit_due(now)`` creates the due gangs (PodGroup + pods, the same
+  wire shape bench.py's e2e store uses), ``observe()`` watches for bind
+  decisions (``pod.node_name`` set) and records first-seen→bind latency
+  into the bounded metric histograms, ``depart_due()`` deletes gangs
+  whose dwell expired — sustained churn without unbounded store growth.
+
+Time is the caller's: ``now`` is seconds since the run started (wall
+clock for a real open-loop run, virtual ticks for the deterministic SLO
+chaos gate), while latency is always measured on the monotonic clock at
+the actual submit/observe instants.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from volcano_tpu.api import POD_GROUP_KEY, Resource
+from volcano_tpu.api.objects import Metadata, Pod, PodGroup, PodSpec
+from volcano_tpu.api.types import PodGroupPhase
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.metrics import Histogram
+
+
+@dataclass(frozen=True)
+class LoadSpec:
+    """Distributions for one open-loop run (all draws seeded)."""
+
+    qps: float = 20.0                 # gang arrivals per second
+    duration_s: float = 5.0           # arrival window (departures may run on)
+    seed: int = 0
+    #: (gang size, weight) mix — weights need not sum to 1
+    gang_sizes: Tuple[Tuple[int, float], ...] = ((1, 6.0), (2, 3.0), (4, 1.0))
+    cpu_millis: Tuple[int, ...] = (100, 250, 500)
+    mem_mb: Tuple[int, ...] = (128, 256, 512)
+    queues: Tuple[str, ...] = ("default",)
+    namespace: str = "load"
+    #: mean seconds a fully-placed gang stays resident before departing;
+    #: 0 disables departures (gangs live forever)
+    dwell_s: float = 0.0
+    prefix: str = "lg"
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One scheduled gang arrival (fully materialized at build time)."""
+
+    t: float                 # seconds since run start
+    name: str                # gang / PodGroup name
+    queue: str
+    cpu_millis: Tuple[int, ...]   # per pod
+    mem_bytes: Tuple[int, ...]    # per pod
+    dwell_s: float           # post-placement lifetime (inf = forever)
+
+    @property
+    def size(self) -> int:
+        return len(self.cpu_millis)
+
+    def pod_names(self) -> List[str]:
+        return [f"{self.name}-{i}" for i in range(self.size)]
+
+
+def build_schedule(spec: LoadSpec) -> List[Arrival]:
+    """The deterministic event list for ``spec`` — every random draw
+    happens here, in a fixed order, from one seeded generator."""
+    rng = np.random.default_rng(spec.seed)
+    sizes = np.array([s for s, _ in spec.gang_sizes], np.int64)
+    weights = np.array([w for _, w in spec.gang_sizes], np.float64)
+    weights = weights / weights.sum()
+    out: List[Arrival] = []
+    t = 0.0
+    i = 0
+    while True:
+        t += float(rng.exponential(1.0 / max(spec.qps, 1e-9)))
+        if t > spec.duration_s:
+            break
+        size = int(rng.choice(sizes, p=weights))
+        cpus = tuple(int(c) for c in rng.choice(spec.cpu_millis, size))
+        mems = tuple(int(m) * (1 << 20) for m in rng.choice(spec.mem_mb, size))
+        queue = str(rng.choice(spec.queues))
+        dwell = (
+            float(rng.exponential(spec.dwell_s)) if spec.dwell_s > 0
+            else math.inf
+        )
+        out.append(Arrival(
+            t=t, name=f"{spec.prefix}{spec.seed}-{i:06d}", queue=queue,
+            cpu_millis=cpus, mem_bytes=mems, dwell_s=dwell,
+        ))
+        i += 1
+    return out
+
+
+class LoadGen:
+    """Replay a :class:`LoadSpec` schedule against a store client.
+
+    ``store`` needs only ``create`` / ``list`` / ``delete`` — the
+    in-process ``Store`` and the HTTP ``RemoteStore`` both qualify, so
+    the same generator drives in-process harness runs and real
+    subprocess daemons."""
+
+    def __init__(self, store, spec: LoadSpec, clock=time.monotonic):
+        self.store = store
+        self.spec = spec
+        self.schedule = build_schedule(spec)
+        self._clock = clock
+        self._next = 0
+        #: pod key -> monotonic submit instant, for unbound pods
+        self.inflight: Dict[str, float] = {}
+        #: gang name -> {"arr", "pods" (unbound keys), "bound_at"}
+        self.gangs: Dict[str, Dict[str, Any]] = {}
+        #: bounded first-seen→bind latency histogram (seconds) — the
+        #: run-local readout; every sample is ALSO routed through the
+        #: PR-4 reference series via metrics.update_pod_e2e_latency
+        self.hist = Histogram()
+        self.submitted_pods = 0
+        self.bound_pods = 0
+        self.departed_gangs = 0
+
+    # -- arrivals ------------------------------------------------------------
+
+    def due(self, now_s: float) -> List[Arrival]:
+        """Arrivals scheduled at or before ``now_s`` and not yet
+        submitted (does not consume them; :meth:`submit` does)."""
+        out = []
+        j = self._next
+        while j < len(self.schedule) and self.schedule[j].t <= now_s:
+            out.append(self.schedule[j])
+            j += 1
+        return out
+
+    def submit(self, arr: Arrival) -> None:
+        """Create one gang (PodGroup + pods).  Must be called in
+        schedule order; raises on out-of-order submission.  Transient
+        store errors propagate — the caller owns retry policy (the SLO
+        gate retries with backoff so faulted and fault-free runs submit
+        identical batches) — and re-submission after a partial failure
+        is safe: objects an earlier cut attempt already committed
+        (KeyError / 409) are skipped, the rest of the gang is created."""
+        if self._next >= len(self.schedule) \
+                or self.schedule[self._next] is not arr:
+            raise ValueError("arrivals must be submitted in schedule order")
+        ns = self.spec.namespace
+        pg = PodGroup(
+            meta=Metadata(name=arr.name, namespace=ns),
+            min_member=arr.size, queue=arr.queue,
+        )
+        pg.status.phase = PodGroupPhase.PENDING  # enqueue admits it
+        try:
+            self.store.create("PodGroup", pg)
+        except KeyError:
+            pass  # a cut earlier attempt committed it server-side
+        ann = {POD_GROUP_KEY: arr.name}
+        keys = []
+        for i, pod_name in enumerate(arr.pod_names()):
+            try:
+                self.store.create("Pod", Pod(
+                    meta=Metadata(name=pod_name, namespace=ns,
+                                  annotations=dict(ann)),
+                    spec=PodSpec(image="loadgen", resources=Resource(
+                        float(arr.cpu_millis[i]), float(arr.mem_bytes[i]))),
+                ))
+            except KeyError:
+                pass  # idempotent resubmit of a partially-landed gang
+            keys.append(f"{ns}/{pod_name}")
+        # first-seen edge: the instant the LAST pod of the gang hit the
+        # bus (one clock read per gang keeps the generator cheap)
+        t_sub = self._clock()
+        for k in keys:
+            self.inflight[k] = t_sub
+        self.gangs[arr.name] = {
+            "arr": arr, "pods": set(keys), "bound_at": None,
+        }
+        self.submitted_pods += arr.size
+        self._next += 1
+
+    def submit_due(self, now_s: float) -> int:
+        """Submit every due arrival; returns how many gangs landed."""
+        n = 0
+        for arr in self.due(now_s):
+            self.submit(arr)
+            n += 1
+        return n
+
+    # -- bind observation / departures ---------------------------------------
+
+    def observe(self) -> int:
+        """One watch pass: record first-seen→bind latency for every
+        in-flight pod the scheduler has bound since the last call.
+        Returns how many binds were observed."""
+        if not self.inflight:
+            return 0
+        now = self._clock()
+        ns_prefix = self.spec.namespace + "/"
+        n = 0
+        for pod in self.store.list("Pod"):
+            key = pod.meta.key
+            if not key.startswith(ns_prefix):
+                continue
+            t_sub = self.inflight.get(key)
+            if t_sub is None or not pod.node_name:
+                continue
+            lat = max(now - t_sub, 0.0)
+            self.hist.observe(lat)
+            metrics.update_pod_e2e_latency(lat * 1e3)
+            del self.inflight[key]
+            self.bound_pods += 1
+            n += 1
+            gang = self.gangs.get(key.rsplit("-", 1)[0].split("/", 1)[1])
+            if gang is not None:
+                gang["pods"].discard(key)
+                if not gang["pods"] and gang["bound_at"] is None:
+                    gang["bound_at"] = now
+        return n
+
+    def depart_due(self) -> int:
+        """Delete fully-placed gangs whose dwell expired (churn).
+        Returns how many gangs departed."""
+        now = self._clock()
+        gone = []
+        for name, gang in self.gangs.items():
+            bound_at = gang["bound_at"]
+            if bound_at is None or math.isinf(gang["arr"].dwell_s):
+                continue
+            if now - bound_at < gang["arr"].dwell_s:
+                continue
+            ns = self.spec.namespace
+            for pod_name in gang["arr"].pod_names():
+                self.store.delete("Pod", f"{ns}/{pod_name}")
+            self.store.delete("PodGroup", f"{ns}/{name}")
+            gone.append(name)
+        for name in gone:
+            del self.gangs[name]
+            self.departed_gangs += 1
+        return len(gone)
+
+    # -- progress ------------------------------------------------------------
+
+    @property
+    def pending_pods(self) -> int:
+        """Submitted, not yet observed bound — the backlog depth."""
+        return len(self.inflight)
+
+    @property
+    def all_submitted(self) -> bool:
+        return self._next >= len(self.schedule)
+
+    @property
+    def done(self) -> bool:
+        """Every scheduled gang submitted and every pod's bind observed."""
+        return self.all_submitted and not self.inflight
+
+    def quantile_ms(self, q: float) -> float:
+        return self.hist.quantile(q) * 1e3
+
+    def placements(self) -> List[Tuple[str, str]]:
+        """Final (pod key, node) pairs for this generator's namespace —
+        what the SLO chaos gate compares bit-for-bit against a
+        fault-free run."""
+        ns_prefix = self.spec.namespace + "/"
+        return sorted(
+            (p.meta.key, p.node_name)
+            for p in self.store.list("Pod")
+            if p.meta.key.startswith(ns_prefix) and p.node_name
+        )

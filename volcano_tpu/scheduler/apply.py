@@ -247,6 +247,10 @@ class AsyncApplier:
     # -- consumer side (the applier thread) ------------------------------------
 
     def _loop(self) -> None:
+        import time as _time
+
+        from volcano_tpu.scheduler import metrics
+
         while True:
             with self._cv:
                 while not self._q and not self._stopped:
@@ -256,8 +260,15 @@ class AsyncApplier:
                 n = min(len(self._q), self.batch_max)
                 batch = [self._q.popleft() for _ in range(n)]
                 self._applying = n
+            t0 = _time.perf_counter()
             try:
                 self._apply(batch)
+                # off-cycle drain visibility: wall seconds one dequeued
+                # batch took to reach the store (histogram; vtctl top's
+                # drain_pending column shows queue DEPTH, this shows the
+                # write-back cost per batch)
+                metrics.observe("volcano_decision_drain_batch_seconds",
+                                _time.perf_counter() - t0)
             finally:
                 with self._cv:
                     self._applying = 0

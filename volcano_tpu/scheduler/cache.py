@@ -579,7 +579,10 @@ class SchedulerCache:
             return
         created = pod.meta.creation_timestamp
         if created:
-            metrics.update_pod_e2e_latency((_time.time() - created) * 1e3)
+            # sanctioned wall-clock read: the start edge is the pod's
+            # epoch creation_timestamp stamped by ANOTHER process, so a
+            # monotonic clock has no common origin to subtract from
+            metrics.update_pod_e2e_latency((_time.time() - created) * 1e3)  # vtlint: disable=metric-discipline
         tid = pod.meta.annotations.get(trace.TRACE_ID_KEY, "")
         if tid:
             # marker span: the decision instant, in the gang's own trace

@@ -54,6 +54,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from volcano_tpu import timeseries
 from volcano_tpu.api.job import POD_GROUP_KEY
 from volcano_tpu.api.types import PodGroupPhase, PodPhase, TaskStatus
 from volcano_tpu.scheduler import metrics
@@ -1973,6 +1974,9 @@ class FastCycle:
         # filled by scheduler.run_object_residue when the vectorized
         # residue engine served the sub-cycle: {"tasks": n, "seconds": s}
         self.residue_stats: Dict[str, float] = {}
+        # per-cycle sample fields for the time-series recorder (backlog /
+        # binds / evictions); written only while the recorder is armed
+        self.last_cycle_stats: Dict[str, int] = {}
         self._vol_session_cleared = False
         # pg key -> (phase, running, failed, succeeded, unsched msg): the
         # last status this scheduler wrote, to suppress no-op patches
@@ -2293,6 +2297,16 @@ class FastCycle:
                 else:
                     self._ship_enqueue_ops(enq_ops)
         ph["publish"] = time.perf_counter() - t
+        if timeseries.RECORDER is not None:
+            # armed-only per-cycle sample fields (scheduler._record_cycle
+            # reads these); everything here is already computed — the
+            # disarmed hot path pays exactly this one attribute check
+            self.last_cycle_stats = {
+                "backlog": int(aux["n_tasks"]),
+                "binds": len(pub_binds),
+                "evictions": len(evicts),
+                "residue_jobs": len(self.last_residue_reasons),
+            }
         if run_sub:
             # the sub-cycle's snapshot must see this cycle's published
             # binds even when the Binder seam has not written the store yet

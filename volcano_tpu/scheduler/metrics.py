@@ -1,42 +1,271 @@
-"""Scheduler metrics with reference-compatible names.
+"""Scheduler metrics with reference-compatible names (vtload core).
 
 Collector names/semantics mirror KB/pkg/scheduler/metrics/metrics.go:38-121
-(namespace ``volcano``). Backed by simple in-process counters/histograms with
-a Prometheus-text exposition, so tests and operators can scrape the same
-series names the reference exports.
+(namespace ``volcano``).  r8 rebuilt the backing store on **bounded
+log-linear bucket histograms** (HDR-style): ``observe()`` folds every
+sample into a fixed bucket universe — ``SUBBUCKETS`` linear sub-buckets
+per decade between ``10^EMIN`` and ``10^EMAX`` — so a series that has
+seen 10^6 observations occupies exactly the same state as one that has
+seen 10^2 (the r1–r7 implementation appended every sample to an unbounded
+Python list, a memory leak under sustained load and no percentile
+readout).  Quantile error is bounded by one sub-bucket width: at most
+``9/SUBBUCKETS`` of the value (10% at the default 90).
+
+Exposition (:func:`expose_text`) is proper Prometheus text format:
+``# HELP`` / ``# TYPE`` per family, cumulative ``_bucket{le="..."}``
+lines (only non-empty boundaries plus the mandatory ``le="+Inf"``),
+``_sum`` / ``_count``, byte-stable ordering (families alphabetical,
+series by sorted label tuple) — conformance is asserted by the mini
+parser in ``tests/test_metrics.py``.
+
+Cardinality guard: at most :data:`MAX_SERIES_PER_METRIC` distinct label
+sets per metric name.  Beyond the cap new series are dropped (the
+observation is discarded, never an error) and counted in
+``volcano_metrics_dropped_series_total{metric=...}`` — so
+``register_job_retry``-style per-job labels cannot grow without bound
+under churn.
+
+Measurement discipline (enforced by the vtlint ``metric-discipline``
+rule): counters end ``_total``, duration series carry a unit suffix, and
+latency values are derived from monotonic clocks (``time.monotonic`` /
+``time.perf_counter``), never wall-clock ``time.time`` — the one
+sanctioned exception is the cross-process first-seen→bind series, whose
+start edge is an epoch creation timestamp.
 """
 
 from __future__ import annotations
 
+import math
+import threading
 import time
-from collections import defaultdict
-from typing import Dict, List, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
-_histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List[float]] = defaultdict(list)
-_counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
-_gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = defaultdict(float)
+#: linear sub-buckets per decade (HDR-style log-linear).  Worst-case
+#: relative quantile error = 9/SUBBUCKETS (one sub-bucket width).
+SUBBUCKETS = 90
+#: decade range: finite boundaries span [10^EMIN, 10^EMAX]
+EMIN = -9
+EMAX = 9
+#: finite bucket universe (underflow + per-decade linear sub-buckets);
+#: values >= 10^EMAX count only toward +Inf
+MAX_BUCKETS = (EMAX - EMIN) * SUBBUCKETS + 2
+#: label-cardinality cap per metric name (the guard above)
+MAX_SERIES_PER_METRIC = 512
+
+_LO = 10.0 ** EMIN
+_HI = 10.0 ** EMAX
+#: index of the +Inf-only overflow bucket
+_OVERFLOW = (EMAX - EMIN) * SUBBUCKETS + 1
+
+_DROPPED_SERIES = "volcano_metrics_dropped_series_total"
+
+
+def _bucket_index(v: float) -> int:
+    """Fixed log-linear bucket index for ``v`` (0 = underflow, holds
+    zero/negative/NaN too; ``_OVERFLOW`` = values beyond the last finite
+    boundary, reported only under ``le="+Inf"``)."""
+    if not v > _LO:  # <= _LO, zero, negative, NaN
+        return 0
+    if v >= _HI:
+        return _OVERFLOW
+    e = math.floor(math.log10(v))
+    # repair float edges: log10 can land one decade off at exact powers
+    if v < 10.0 ** e:
+        e -= 1
+    elif v >= 10.0 ** (e + 1):
+        e += 1
+    m = v / (10.0 ** e)
+    # ceil-minus-one keeps exact boundary values in their own (lower)
+    # bucket: le is INCLUSIVE in the Prometheus contract
+    sub = math.ceil((m - 1.0) * SUBBUCKETS / 9.0) - 1
+    if sub < 0:
+        sub = 0
+    elif sub >= SUBBUCKETS:
+        sub = SUBBUCKETS - 1
+    return 1 + (e - EMIN) * SUBBUCKETS + sub
+
+
+def _bucket_upper(idx: int) -> float:
+    """Inclusive upper boundary (the ``le`` value) of a finite bucket."""
+    if idx <= 0:
+        return _LO
+    e = EMIN + (idx - 1) // SUBBUCKETS
+    sub = (idx - 1) % SUBBUCKETS
+    return (10.0 ** e) * (1.0 + 9.0 * (sub + 1) / SUBBUCKETS)
+
+
+class Histogram:
+    """One bounded series: sparse bucket counts + count/sum/min/max.
+
+    State is bounded by the bucket universe (``MAX_BUCKETS`` entries at
+    most), never by observation volume."""
+
+    __slots__ = ("buckets", "count", "sum", "vmin", "vmax")
+
+    def __init__(self):
+        self.buckets: Dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    def observe(self, v: float) -> None:
+        idx = _bucket_index(v)
+        self.buckets[idx] = self.buckets.get(idx, 0) + 1
+        self.count += 1
+        self.sum += v
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """Non-empty finite boundaries as ``(le, cumulative_count)``,
+        ascending, PLUS the mandatory ``(+Inf, count)`` terminator."""
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for idx in sorted(self.buckets):
+            cum += self.buckets[idx]
+            if idx < _OVERFLOW:
+                out.append((_bucket_upper(idx), cum))
+        out.append((math.inf, self.count))
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile ``q`` in [0, 1]: the inclusive upper bound
+        of the bucket holding that rank (error ≤ one sub-bucket width).
+        Overflow-bucket ranks report the observed max; empty series 0.
+        One implementation — the snapshot owns the rank walk."""
+        return HistogramSnapshot(self).quantile(q)
+
+
+class HistogramSnapshot:
+    """Read-side view returned by :func:`get_histogram` — quantile
+    readout plus enough list-likeness (``len``, iteration over
+    bucket-representative values) for existing call sites."""
+
+    __slots__ = ("count", "sum", "buckets", "vmin", "vmax")
+
+    def __init__(self, hist: Optional[Histogram]):
+        if hist is None:
+            self.count = 0
+            self.sum = 0.0
+            self.buckets: List[Tuple[float, int]] = [(math.inf, 0)]
+            self.vmin = math.inf
+            self.vmax = -math.inf
+        else:
+            self.count = hist.count
+            self.sum = hist.sum
+            self.buckets = hist.cumulative()
+            self.vmin = hist.vmin
+            self.vmax = hist.vmax
+
+    def quantile(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        rank = max(1, math.ceil(q * self.count))
+        for le, cum in self.buckets:
+            if cum >= rank:
+                if math.isinf(le):
+                    return self.vmax
+                return min(le, self.vmax)
+        return self.vmax
+
+    def __len__(self) -> int:
+        return self.count
+
+    def __iter__(self) -> Iterator[float]:
+        """Bucket-representative values (each boundary repeated by its
+        bucket's count), ascending — the bounded stand-in for the raw
+        sample list the pre-r8 implementation kept."""
+        prev = 0
+        for le, cum in self.buckets:
+            rep = self.vmax if math.isinf(le) else min(le, self.vmax)
+            for _ in range(cum - prev):
+                yield rep
+            prev = cum
+
+    def __bool__(self) -> bool:
+        return self.count > 0
+
+
+_mu = threading.Lock()
+_histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Histogram] = {}
+_counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+_gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+#: distinct label sets seen per metric name (the cardinality guard)
+_series_counts: Dict[str, int] = {}
 
 
 def _key(name: str, labels: Dict[str, str]):
     return (name, tuple(sorted(labels.items())))
 
 
+def _admit(family: dict, key) -> bool:
+    """Cardinality guard, called under ``_mu``: admit a NEW series only
+    below the per-name cap; a rejected series bumps the dropped counter
+    (itself bounded by the number of metric names)."""
+    if key in family:
+        return True
+    name = key[0]
+    n = _series_counts.get(name, 0)
+    if n >= MAX_SERIES_PER_METRIC:
+        dk = (_DROPPED_SERIES, (("metric", name),))
+        _counters[dk] = _counters.get(dk, 0.0) + 1.0
+        return False
+    _series_counts[name] = n + 1
+    return True
+
+
 def observe(name: str, value: float, **labels) -> None:
-    _histograms[_key(name, labels)].append(value)
+    key = _key(name, labels)
+    with _mu:
+        h = _histograms.get(key)
+        if h is None:
+            if not _admit(_histograms, key):
+                return
+            h = _histograms[key] = Histogram()
+        h.observe(value)
 
 
 def inc(name: str, value: float = 1.0, **labels) -> None:
-    _counters[_key(name, labels)] += value
+    key = _key(name, labels)
+    with _mu:
+        if key not in _counters and not _admit(_counters, key):
+            return
+        _counters[key] = _counters.get(key, 0.0) + value
 
 
 def set_gauge(name: str, value: float, **labels) -> None:
-    _gauges[_key(name, labels)] = value
+    key = _key(name, labels)
+    with _mu:
+        if key not in _gauges and not _admit(_gauges, key):
+            return
+        _gauges[key] = value
 
 
 def reset() -> None:
-    _histograms.clear()
-    _counters.clear()
-    _gauges.clear()
+    with _mu:
+        _histograms.clear()
+        _counters.clear()
+        _gauges.clear()
+        _series_counts.clear()
+
+
+def get_histogram(name: str, **labels) -> HistogramSnapshot:
+    with _mu:
+        return HistogramSnapshot(_histograms.get(_key(name, labels)))
+
+
+def get_counter(name: str, **labels) -> float:
+    with _mu:
+        return _counters.get(_key(name, labels), 0.0)
+
+
+def quantile(name: str, q: float, **labels) -> float:
+    """Percentile readout for a histogram series (p50 = 0.5, p99 = 0.99,
+    p999 = 0.999): 0.0 when the series is empty."""
+    return get_histogram(name, **labels).quantile(q)
 
 
 # -- recording helpers mirroring the reference call sites --------------------
@@ -70,8 +299,9 @@ def update_pod_e2e_latency(ms: float) -> None:
     """Reference-parity per-pod e2e latency (metrics.go E2eSchedulingLatency
     family): pod first seen on the bus (creation) -> bind decision, in
     milliseconds.  Emitted from the vtrace bind spans (volcano_tpu/trace.py)
-    — populated only while tracing is armed, so the disarmed hot path stays
-    untouched."""
+    while tracing is armed, and by the vtload open-loop harness
+    (volcano_tpu/loadgen/) for every pod it submits — the series the
+    ``bench.py --open-loop`` p50/p99/p999 report reads."""
     observe("volcano_e2e_job_scheduling_latency_milliseconds", ms)
 
 
@@ -80,7 +310,9 @@ def register_schedule_attempt(succeeded: bool) -> None:
 
 
 def register_preemption_attempt() -> None:
-    inc("volcano_total_preemption_attempts")
+    # reference-parity name (metrics.go TotalPreemptionAttempts): predates
+    # the _total suffix convention, kept verbatim for scrape compatibility
+    inc("volcano_total_preemption_attempts")  # vtlint: disable=metric-discipline
 
 
 def update_preemption_victims(count: int) -> None:
@@ -96,7 +328,9 @@ def update_unschedule_job_count(count: int) -> None:
 
 
 def register_job_retry(job: str) -> None:
-    inc("volcano_job_retry_counts", job_id=job)
+    # reference-parity name (metrics.go JobRetryCounts), kept verbatim;
+    # the per-job label is fenced by the cardinality guard above
+    inc("volcano_job_retry_counts", job_id=job)  # vtlint: disable=metric-discipline
 
 
 def register_residue_tasks(cls: str, count: int) -> None:
@@ -127,9 +361,16 @@ def register_wal_fsync(n: int = 1) -> None:
     inc("volcano_store_wal_fsync_total", float(n))
 
 
+def observe_wal_fsync(seconds: float) -> None:
+    """Duration of one group-commit fsync — the histogram that makes the
+    ACK barrier's tail latency visible on /metrics and in ``vtctl top``
+    (the ``_total`` counters above only show volume)."""
+    observe("volcano_store_wal_fsync_seconds", seconds)
+
+
 def register_wal_recovery(n: int) -> None:
     """Records replayed from the WAL tail during crash recovery."""
-    inc("volcano_store_wal_recovery_replayed_records", float(n))
+    inc("volcano_store_wal_recovery_replayed_records_total", float(n))
 
 
 # -- elastic autoscaler series (volcano_tpu/elastic/) -------------------------
@@ -150,17 +391,42 @@ def register_drain_eviction(pool: str) -> None:
     inc("volcano_elastic_drain_evictions_total", pool=pool)
 
 
-def expose_text() -> str:
-    """Prometheus text exposition of all recorded series."""
-    lines = []
-    for (name, labels), value in sorted(_counters.items()):
-        lines.append(f"{name}{_fmt(labels)} {value}")
-    for (name, labels), value in sorted(_gauges.items()):
-        lines.append(f"{name}{_fmt(labels)} {value}")
-    for (name, labels), values in sorted(_histograms.items()):
-        lines.append(f"{name}_count{_fmt(labels)} {len(values)}")
-        lines.append(f"{name}_sum{_fmt(labels)} {sum(values)}")
-    return "\n".join(lines) + "\n"
+# -- exposition ---------------------------------------------------------------
+
+#: HELP strings for the exposition (fallback is generated); keep these
+#: one-line — they land verbatim in the text format
+_HELP: Dict[str, str] = {
+    "volcano_e2e_scheduling_latency_milliseconds":
+        "End-to-end scheduling cycle latency in milliseconds",
+    "volcano_e2e_job_scheduling_latency_milliseconds":
+        "Pod first-seen to bind-decision latency in milliseconds",
+    "volcano_action_scheduling_latency_microseconds":
+        "Per-action scheduling latency in microseconds",
+    "volcano_plugin_scheduling_latency_microseconds":
+        "Per-plugin callback latency in microseconds",
+    "volcano_task_scheduling_latency_microseconds":
+        "Per-task scheduling latency in microseconds",
+    "volcano_schedule_attempts_total":
+        "Schedule attempts by result",
+    "volcano_residue_tasks_total":
+        "Tasks routed to the host residue path, by reason class",
+    "volcano_store_wal_appended_records_total":
+        "Records appended to the store write-ahead log",
+    "volcano_store_wal_fsync_total":
+        "Group-commit fsyncs of the WAL tail (the ACK barrier)",
+    "volcano_store_wal_fsync_seconds":
+        "Duration of one group-commit WAL fsync in seconds",
+    "volcano_store_wal_recovery_replayed_records_total":
+        "WAL records replayed during crash recovery",
+    "volcano_decision_drain_batch_seconds":
+        "Wall seconds one async-applier batch took to reach the store",
+    _DROPPED_SERIES:
+        "Observations dropped by the per-metric label-cardinality cap",
+}
+
+
+def _help_line(name: str, mtype: str) -> str:
+    return _HELP.get(name, f"volcano-tpu {mtype} {name}")
 
 
 def _fmt(labels) -> str:
@@ -170,9 +436,46 @@ def _fmt(labels) -> str:
     return "{" + inner + "}"
 
 
-def get_histogram(name: str, **labels) -> List[float]:
-    return _histograms.get(_key(name, labels), [])
+def _num(v: float) -> str:
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return f"{f:.10g}"
 
 
-def get_counter(name: str, **labels) -> float:
-    return _counters.get(_key(name, labels), 0.0)
+def _le(le: float) -> str:
+    return "+Inf" if math.isinf(le) else _num(le)
+
+
+def expose_text() -> str:
+    """Prometheus text exposition of all recorded series: HELP/TYPE per
+    family, histogram ``_bucket``/``_sum``/``_count`` encoding, byte-
+    stable ordering (families alphabetical, series by label tuple)."""
+    with _mu:
+        counters = sorted(_counters.items())
+        gauges = sorted(_gauges.items())
+        hists = sorted(
+            (k, HistogramSnapshot(h)) for k, h in _histograms.items()
+        )
+    families: Dict[str, Tuple[str, list]] = {}
+    for (name, labels), value in counters:
+        families.setdefault(name, ("counter", []))[1].append((labels, value))
+    for (name, labels), value in gauges:
+        families.setdefault(name, ("gauge", []))[1].append((labels, value))
+    for (name, labels), snap in hists:
+        families.setdefault(name, ("histogram", []))[1].append((labels, snap))
+    lines: List[str] = []
+    for name in sorted(families):
+        mtype, series = families[name]
+        lines.append(f"# HELP {name} {_help_line(name, mtype)}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in series:
+            if mtype != "histogram":
+                lines.append(f"{name}{_fmt(labels)} {_num(value)}")
+                continue
+            for le, cum in value.buckets:
+                blabels = labels + (("le", _le(le)),)
+                lines.append(f"{name}_bucket{_fmt(blabels)} {cum}")
+            lines.append(f"{name}_sum{_fmt(labels)} {_num(value.sum)}")
+            lines.append(f"{name}_count{_fmt(labels)} {value.count}")
+    return "\n".join(lines) + "\n"

@@ -13,7 +13,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from volcano_tpu import trace
+from volcano_tpu import timeseries, trace
 from volcano_tpu.scheduler import metrics
 
 
@@ -27,6 +27,12 @@ class _Handler(BaseHTTPRequestHandler):
             # the daemon's live flight recorder (volcano_tpu/trace.py) —
             # every component carrying a MetricsServer serves its ring
             body = json.dumps(trace.debug_payload()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path == "/debug/timeseries":
+            # the per-cycle time-series ring (volcano_tpu/timeseries.py)
+            # — what `vtctl top` renders live
+            body = json.dumps(timeseries.debug_payload()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif self.path == "/healthz":

@@ -12,7 +12,7 @@ from typing import Optional
 
 import volcano_tpu.scheduler.actions  # noqa: F401  (registers actions)
 import volcano_tpu.scheduler.plugins  # noqa: F401  (registers plugins)
-from volcano_tpu import trace
+from volcano_tpu import timeseries, trace
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.scheduler.cache import SchedulerCache
 from volcano_tpu.scheduler.conf import SchedulerConf, default_conf, load_conf
@@ -91,6 +91,10 @@ class Scheduler:
         self.elector = elector
         self._profile_cycle = 0
         self._profile_warned = False
+        # monotone cycle counter + bind-log watermark for the
+        # time-series recorder samples
+        self._cycle_n = 0
+        self._bind_log_n = 0
         # cross-cycle incremental snapshot state (class masks, node-static
         # arrays, device uploads) — survives sessions, invalidated by node
         # epoch changes
@@ -560,6 +564,8 @@ class Scheduler:
                             cyc.annotate(link_error=repr(e))
             if ran:
                 metrics.update_e2e_duration(start)
+                if timeseries.RECORDER is not None:
+                    self._record_cycle(start, "fast")
                 return
         if self.fast_cycle is not None and self.cache.applier is not None:
             # whole-cycle object fallback: previous fast cycles' async
@@ -571,6 +577,36 @@ class Scheduler:
             self.cache.applier.flush(timeout=60.0)
         self.run_object_actions(self.conf.actions)
         metrics.update_e2e_duration(start)
+        if timeseries.RECORDER is not None:
+            self._record_cycle(start, "object")
+
+    def _record_cycle(self, start: float, path: str) -> None:
+        """One ``kind="cycle"`` time-series sample (armed-only; callers
+        guard with the single ``timeseries.RECORDER is None`` check so
+        the disarmed hot path pays nothing).  Adds NO phase keys — the
+        recorder observes the cycle, it never changes its shape."""
+        fields: dict = {"dur_s": round(time.perf_counter() - start, 6),
+                        "path": path, "cycle": self._cycle_n}
+        self._cycle_n += 1
+        fc = self.fast_cycle
+        # BOTH paths append to cache.bind_log (the fast publish extends
+        # it too), so the watermark must advance every recorded cycle or
+        # a fast->object transition would bill the object cycle for
+        # every fast bind since the last object cycle
+        n_binds = len(self.cache.bind_log)
+        if path == "fast" and fc is not None:
+            fields["phases"] = {
+                k: round(v, 6) for k, v in (fc.phases or {}).items()
+            }
+            fields.update(fc.last_cycle_stats)
+        else:
+            fields["binds"] = n_binds - self._bind_log_n
+        self._bind_log_n = n_binds
+        applier = self.cache.applier
+        if applier is not None:
+            # drain lag: decisions published but not yet written back
+            fields["drain_pending"] = applier.pending
+        timeseries.record("cycle", **fields)
 
     def _open_object_session(self):
         ssn = open_session(self.cache, self.conf.tiers)

@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
-from volcano_tpu import trace
+from volcano_tpu import timeseries, trace
 from volcano_tpu.chaos import ChaosPlanError, FaultPlan, env_plan, fire_crash
 from volcano_tpu.locksan import make_lock, make_rlock
 from volcano_tpu.store.codec import (
@@ -50,7 +50,7 @@ def _traced(verb: str):
     """Continue the client's ``X-Volcano-Trace`` context around one
     request verb: the request span parents to the caller's span across
     the process boundary.  Disarmed = one attribute check per request
-    (the chaos-guard discipline); the ``/chaos`` and ``/debug/trace``
+    (the chaos-guard discipline); the ``/chaos`` and ``/debug/*``
     admin endpoints are never traced (reading the flight recorder must
     not write to it)."""
 
@@ -59,7 +59,7 @@ def _traced(verb: str):
             if trace.TRACER is None:
                 return fn(self)
             path = self.path
-            if path.startswith("/chaos") or path.startswith("/debug/trace"):
+            if path.startswith("/chaos") or path.startswith("/debug/"):
                 return fn(self)
             header = self.headers.get(trace.HEADER, "")
             if not header:
@@ -268,6 +268,10 @@ class StoreServer:
                     # flight-recorder admin endpoint: exempt from chaos
                     # (forensics must work mid-storm) and never traced
                     return self._reply(200, trace.debug_payload())
+                if u.path == "/debug/timeseries":
+                    # per-cycle/per-flush time-series ring (vtctl top):
+                    # chaos-exempt like /debug/trace
+                    return self._reply(200, timeseries.debug_payload())
                 chaos_plan = server.chaos
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
                     return
@@ -1123,6 +1127,13 @@ class StoreServer:
                 # old snapshot + old segments or the new snapshot
                 fsync_dir(os.path.dirname(os.path.abspath(self.state_path)))
                 self.wal.drop_below(floor)
+        if timeseries.RECORDER is not None:
+            # store-side time-series sample, one per flush: event-log
+            # position + WAL accounting, the server half of `vtctl top`
+            timeseries.record(
+                "store", log_seq=self.seq, log_rows=self._log_rows,
+                wal=self.wal.stats() if self.wal is not None else None,
+            )
 
     def _stage_enc_hint(self, kind: str, obj, wire: Optional[dict]) -> None:
         """Stage the request's own wire dict as the object's encoding for

@@ -189,6 +189,7 @@ class WriteAheadLog:
             os.fsync(fd)
             ok = True
         finally:
+            dur = _time.perf_counter() - t0
             with self._cv:
                 self._syncing = False
                 if ok:
@@ -198,12 +199,15 @@ class WriteAheadLog:
                     # that were never made durable
                     self._synced = max(self._synced, target)
                     self.fsync_total += 1
-                self.fsync_s += _time.perf_counter() - t0
+                self.fsync_s += dur
                 self._cv.notify_all()
         if ok:
             from volcano_tpu.scheduler import metrics
 
             metrics.register_wal_fsync()
+            # group-commit fsync tail latency: the histogram behind
+            # volcano_store_wal_fsync_seconds on /metrics and vtctl top
+            metrics.observe_wal_fsync(dur)
 
     def append_commit(self, record: Dict[str, Any]) -> None:
         self.commit(self.append(record))

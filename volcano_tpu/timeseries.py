@@ -1,0 +1,117 @@
+"""vtload time series: a bounded per-cycle flight recorder.
+
+The vtrace flight recorder (volcano_tpu/trace.py) answers "what happened
+inside one trace"; this module answers "what has the control plane been
+doing, cycle over cycle" — the time-series half of the vtload
+observability layer.  Each armed process keeps a bounded ring of samples:
+
+* ``kind="cycle"`` — recorded by the scheduler after every completed
+  cycle: wall duration, fast-path phase breakdown (the bench.py phase
+  keys), backlog depth (pending tasks entering the solve), binds and
+  evictions published, async-applier drain lag (queued decisions).
+* ``kind="store"`` — recorded by the StoreServer at every state flush:
+  event-log seq, buffered rows, WAL stats (records/fsyncs/fsync seconds)
+  when the durable tier is armed.
+
+Arming follows the chaos/trace discipline: **disarmed is the default and
+costs one module attribute check per site** (``RECORDER is None``);
+``VOLCANO_TPU_TIMESERIES=1`` (or ``{"ring": N}``) arms at boot, tests arm
+in-process via :func:`arm`.  The ring is served live at
+``/debug/timeseries`` on the Store and Metrics servers (chaos-exempt,
+like ``/debug/trace``), rendered by ``vtctl top``, and folded into
+``trace.crash_dump()`` artifacts so a crash ships its last N cycles of
+telemetry alongside its spans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+ENV_VAR = "VOLCANO_TPU_TIMESERIES"
+DEFAULT_RING = 2048
+
+
+class Recorder:
+    """Bounded ring of per-cycle / per-flush samples."""
+
+    def __init__(self, ring: int = DEFAULT_RING):
+        self.ring_size = max(int(ring), 1)
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=self.ring_size)
+        self._seq = 0
+
+    def record(self, kind: str, **fields: Any) -> None:
+        with self._mu:
+            self._seq += 1
+            self._ring.append(
+                {"seq": self._seq, "kind": kind, "ts": time.time(), **fields}
+            )
+
+    def samples(self) -> List[Dict[str, Any]]:
+        """Snapshot of the ring, oldest first."""
+        with self._mu:
+            return list(self._ring)
+
+    def payload(self) -> Dict[str, Any]:
+        return {
+            "armed": True,
+            "pid": os.getpid(),
+            "ring": self.ring_size,
+            "samples": self.samples(),
+        }
+
+
+def _recorder_from_env(raw: str) -> Optional[Recorder]:
+    raw = (raw or "").strip()
+    if not raw or raw in ("0", "off", "none"):
+        return None
+    if raw.startswith("{"):
+        try:
+            cfg = json.loads(raw)
+        except ValueError:
+            cfg = {}
+        return Recorder(ring=int(cfg.get("ring", DEFAULT_RING)))
+    return Recorder()
+
+
+#: the process recorder; None = disarmed, and every instrumentation site
+#: is a single ``timeseries.RECORDER is None`` attribute check (the
+#: faultpoint-style guard chaos/trace established)
+RECORDER: Optional[Recorder] = _recorder_from_env(os.environ.get(ENV_VAR, ""))
+
+
+def arm(recorder: Optional[Recorder] = None) -> Recorder:
+    """Arm recording in-process (tests, embedders); returns the recorder."""
+    global RECORDER
+    RECORDER = recorder or Recorder()
+    return RECORDER
+
+
+def disarm() -> None:
+    global RECORDER
+    RECORDER = None
+
+
+def record(kind: str, **fields: Any) -> None:
+    """Record one sample when armed; free no-op otherwise."""
+    rec = RECORDER
+    if rec is not None:
+        rec.record(kind, **fields)
+
+
+def samples() -> List[Dict[str, Any]]:
+    rec = RECORDER
+    return rec.samples() if rec is not None else []
+
+
+def debug_payload() -> Dict[str, Any]:
+    """The ``/debug/timeseries`` response body (store + metrics servers)."""
+    rec = RECORDER
+    if rec is None:
+        return {"armed": False, "pid": os.getpid(), "samples": []}
+    return rec.payload()

@@ -144,10 +144,17 @@ class Tracer:
             "spans": self.records(),
         }
 
-    def dump_to(self, path: str, reason: str = "") -> str:
+    def dump_to(self, path: str, reason: str = "",
+                extra: Optional[Dict[str, Any]] = None) -> str:
+        """Atomic artifact write (temp + rename); ``extra`` merges
+        additional top-level keys into the payload (crash_dump attaches
+        the time-series ring this way)."""
+        payload = self.dump(reason)
+        if extra:
+            payload.update(extra)
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(self.dump(reason), f)
+            json.dump(payload, f)
         os.replace(tmp, path)
         return path
 
@@ -441,17 +448,25 @@ def debug_payload() -> Dict[str, Any]:
 
 def crash_dump(reason: str) -> Optional[str]:
     """Dump the flight recorder as a JSON artifact — called on daemon
-    crash, invariant violation, or chaos-soak divergence.  Returns the
-    path written, or None when disarmed/empty.  Never raises: forensics
-    must not mask the original failure."""
+    crash, invariant violation, or chaos-soak divergence.  When the
+    vtload time-series recorder is armed, its ring rides along under
+    ``"timeseries"`` so the artifact carries the last N cycles of
+    telemetry next to the spans.  Returns the path written, or None when
+    disarmed/empty.  Never raises: forensics must not mask the original
+    failure."""
+    from volcano_tpu import timeseries
+
     tr = TRACER
     if tr is None:
         return None
     directory = tr.dump_dir or "."
     name = f"vtrace-{component() or 'proc'}-{os.getpid()}-{reason}.json"
     path = os.path.join(directory, name)
+    extra = None
+    if timeseries.RECORDER is not None:
+        extra = {"timeseries": timeseries.RECORDER.samples()}
     try:
         os.makedirs(directory, exist_ok=True)
-        return tr.dump_to(path, reason)
+        return tr.dump_to(path, reason, extra=extra)
     except OSError:
         return None
