@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace
+.PHONY: test e2e parity bench bench-residue bench-wire loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -84,6 +84,21 @@ bench-residue:
 # tests/test_loadgen.py; `vtctl top` renders the per-cycle time series.
 loadtest:
 	$(PY) bench.py --open-loop
+
+# vtprof (volcano_tpu/vtprof.py + tests/test_vtprof.py): the critical-
+# path profiler suite — disarmed-zero-overhead + placement-parity
+# smokes, the >=95% attribution bar, the steady-state recompile
+# sentinel, the leak sentinel, /debug/prof, and `vtctl profile`.
+profile:
+	$(PY) -m pytest tests/test_vtprof.py tests/test_perfgate.py -q
+
+# the continuous perf-regression gate: fresh capture of the gated
+# headline configs (cfg5/cfg7/cfg8 — same-device bands derived from the
+# BENCH_r0*.json trajectory via `bench.py --history`) with a per-config,
+# per-phase attribution diff and a nonzero exit on breach.  The
+# sub-second machinery smoke lives in tier-1 (tests/test_perfgate.py).
+perfgate:
+	$(PY) bench.py --check
 
 # the columnar store wire (store/segment.py): cfg7 runs config 5 against
 # the HTTP apiserver in its own OS process — publish + off-cycle drain of

@@ -56,6 +56,16 @@ N_JOBS = 5_000
 N_QUEUES = 2
 BASELINE_SECONDS = 60.0  # reference Go CPU path at this scale (BASELINE.md)
 
+#: every metric payload printed this invocation, in order — the perf
+#: gate (--check) reads the fresh capture from here instead of scraping
+#: its own stdout
+LAST_RESULTS = []
+
+
+def _print_json(payload):
+    LAST_RESULTS.append(payload)
+    print(json.dumps(payload))
+
 
 def build_sim_snapshot(seed=0, **kw):
     from volcano_tpu.scheduler.simargs import build_sim_args
@@ -99,7 +109,7 @@ def _emit(metric, cycle, placed, extra=None):
             **(extra or {}),
         },
     }
-    print(json.dumps(payload))
+    _print_json((payload))
 
 
 def config1():
@@ -194,7 +204,7 @@ def config4():
     # tunnel latency the min hides (VERDICT r3 weak #2); a real contended
     # cycle amortizes dispatch via the storm kernels, so storm throughput
     # comes from config 6, never from this number.
-    print(json.dumps({
+    _print_json(({
         "metric": "cfg4_preempt_victim_solve",
         "value": round(per_min, 5),
         "unit": "s/preemptor",
@@ -465,7 +475,7 @@ def config6(include_best_effort=True):
 
         import jax
 
-        print(json.dumps({
+        _print_json(({
             "metric": metric,
             "value": round(cycle, 4),
             "unit": "s",
@@ -574,7 +584,7 @@ def config5(reps=3, dynamic_frac=0.0,
     }
     if dynamic_frac:
         extra["dynamic_tasks"] = int(N_TASKS * dynamic_frac)
-    print(json.dumps({
+    _print_json(({
         "metric": metric,
         "value": round(publish, 4),
         "unit": "s",
@@ -631,7 +641,7 @@ def config5_volumes(sizes=(500, 2000)):
             },
         },
     }
-    print(json.dumps(payload))
+    _print_json((payload))
 
 
 def config5_dynamic(reps=3):
@@ -768,7 +778,7 @@ def config7():
 
     import jax
 
-    print(json.dumps({
+    _print_json(({
         "metric": "e2e_http_schedule_cycle_100k_tasks_10k_nodes",
         "value": round(publish, 4),
         "unit": "s",
@@ -807,7 +817,7 @@ def config7():
     }))
     # the WAL-on vs WAL-off comparison line: ratio > 1.25 breaks the
     # acceptance band (group commit must amortize fsync per segment)
-    print(json.dumps({
+    _print_json(({
         "metric": "cfg7_wal_on_vs_off_drain",
         "value": round(wal_run["drain"], 4),
         "unit": "s",
@@ -894,7 +904,7 @@ def config8_open_loop(duration_s=8.0, qps=25.0, band_p99_ms=1000.0,
         base_qps=qps * 2, band_p99_ms=band_p99_ms,
         max_doublings=max_doublings,
     )
-    print(json.dumps({
+    _print_json(({
         "metric": "cfg8_open_loop_first_seen_to_bind",
         "value": round(base.p50_ms / 1e3, 4),
         "unit": "s",
@@ -916,6 +926,406 @@ def config8_open_loop(duration_s=8.0, qps=25.0, band_p99_ms=1000.0,
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes,
            10: config8_open_loop}
+
+
+# -- bench trajectory + continuous perf-regression gate (vtprof PR) -----------
+#
+# `--history` collates every BENCH_r0*.json driver capture into ONE
+# machine-readable artifact (BENCH_TRAJECTORY.json) plus a markdown
+# table appended to BASELINE.md, so the gate and humans read one file
+# instead of nine.  `--check` runs a fresh capture of the headline
+# configs and compares value + per-phase attribution against bands —
+# derived from the same-device trajectory by default, or an explicit
+# `--bands` JSON file — and exits nonzero with a per-config, per-phase
+# diff on any breach (`make perfgate`).
+
+TRAJECTORY_FILE = "BENCH_TRAJECTORY.json"
+#: headline metrics the gate fences (cfg5 / cfg7 / cfg8)
+GATED_METRICS = (
+    "e2e_schedule_cycle_100k_tasks_10k_nodes",
+    "e2e_http_schedule_cycle_100k_tasks_10k_nodes",
+    "cfg8_open_loop_first_seen_to_bind",
+)
+#: band slack over the best same-device trajectory reading: headline
+#: values breathe ±15% run-to-run on the tunnel (BASELINE.md), phases
+#: more — the gate catches regressions, not noise
+VALUE_SLACK = 1.8
+PHASE_SLACK = 2.5
+PHASE_FLOOR_S = 0.05
+
+
+def _payloads_from_doc(doc):
+    """Every metric payload a BENCH_r0*.json driver capture carries:
+    the bare payload form (r08), the ``parsed*`` fields, and every JSON
+    line embedded in the driver's ``tail`` transcript."""
+    if not isinstance(doc, dict):
+        return
+    if "metric" in doc and "value" in doc:
+        yield doc
+        return
+    for key in sorted(doc):
+        if key.startswith("parsed") and isinstance(doc[key], dict) \
+                and "metric" in doc[key]:
+            yield doc[key]
+    for line in str(doc.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            payload = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(payload, dict) and "metric" in payload \
+                and "value" in payload:
+            yield payload
+
+
+def load_bench_rounds(directory="."):
+    """[(round_number, {metric: payload})] from BENCH_r*.json, ascending;
+    within one round the last occurrence of a metric wins (the driver
+    tail repeats headline lines across sweeps)."""
+    import glob
+    import re
+
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(directory, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r0*(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        metrics = {}
+        for payload in _payloads_from_doc(doc):
+            if payload.get("value") is not None:
+                metrics[payload["metric"]] = payload
+        if metrics:
+            rounds.append((int(m.group(1)), metrics))
+    rounds.sort()
+    return rounds
+
+
+def build_trajectory(rounds):
+    return {
+        "source": "bench.py --history (BENCH_r0*.json collation)",
+        "rounds": [
+            {
+                "round": n,
+                "metrics": {
+                    metric: {
+                        "value": p.get("value"),
+                        "unit": p.get("unit"),
+                        "vs_baseline": p.get("vs_baseline"),
+                        "device": (p.get("extra") or {}).get("device"),
+                        "phases_s": (p.get("extra") or {}).get("phases_s"),
+                        "p99_ms": (p.get("extra") or {}).get("p99_ms"),
+                    }
+                    for metric, p in sorted(m.items())
+                },
+            }
+            for n, m in rounds
+        ],
+    }
+
+
+_TRAJ_BEGIN = "<!-- bench-trajectory:begin -->"
+_TRAJ_END = "<!-- bench-trajectory:end -->"
+
+
+def trajectory_markdown(traj):
+    rounds = traj["rounds"]
+    metrics = sorted({m for r in rounds for m in r["metrics"]})
+    head = ("| metric | " + " | ".join(f"r{r['round']:02d}" for r in rounds)
+            + " |")
+    sep = "|---" * (len(rounds) + 1) + "|"
+    lines = [
+        _TRAJ_BEGIN,
+        "## Bench trajectory (generated by `python bench.py --history`)",
+        "",
+        "Headline `value` per metric per driver round (seconds unless the "
+        "metric says otherwise); `—` = not captured that round.  "
+        "Machine-readable twin: `BENCH_TRAJECTORY.json` — what "
+        "`bench.py --check` derives its default bands from.",
+        "",
+        head, sep,
+    ]
+    for metric in metrics:
+        cells = []
+        for r in rounds:
+            p = r["metrics"].get(metric)
+            cells.append("—" if p is None else f"{p['value']}")
+        lines.append(f"| `{metric}` | " + " | ".join(cells) + " |")
+    lines.append(_TRAJ_END)
+    return "\n".join(lines) + "\n"
+
+
+def cmd_history(directory=".", out_path=None, baseline_md=None):
+    """Collate BENCH_r0*.json into BENCH_TRAJECTORY.json + the BASELINE.md
+    table (replacing a previous generated section in place)."""
+    rounds = load_bench_rounds(directory)
+    traj = build_trajectory(rounds)
+    out_path = out_path or os.path.join(directory, TRAJECTORY_FILE)
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(traj, f, indent=1)
+    print(f"wrote {out_path}: {len(traj['rounds'])} round(s), "
+          f"{sum(len(r['metrics']) for r in traj['rounds'])} metric line(s)")
+    md = trajectory_markdown(traj)
+    if baseline_md:
+        try:
+            with open(baseline_md, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            text = ""
+        if _TRAJ_BEGIN in text and _TRAJ_END in text:
+            pre = text.split(_TRAJ_BEGIN)[0]
+            post = text.split(_TRAJ_END, 1)[1].lstrip("\n")
+            text = pre + md + post
+        else:
+            text = text.rstrip("\n") + "\n\n" + md
+        with open(baseline_md, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"updated {baseline_md} trajectory table")
+    return traj
+
+
+def _same_device_class(a, b):
+    """CPU-container readings must not gate against v5e readings and
+    vice versa — compare by cpu-ness of the recorded device string.  A
+    missing device on either side matches NOTHING: a device-less
+    trajectory reading must never slip into the accelerator band pool
+    just because '' contains no 'cpu'."""
+    if not a or not b:
+        return False
+    a, b = a.lower(), b.lower()
+    return ("cpu" in a) == ("cpu" in b)
+
+
+def derive_bands(traj, device_str):
+    """Default bands from the best same-device trajectory reading per
+    gated metric: value band = best × VALUE_SLACK, per-phase bands from
+    the best round's attribution × PHASE_SLACK (+ an absolute floor so
+    a 1 ms phase cannot fail on scheduler jitter)."""
+    bands = {}
+    for metric in GATED_METRICS:
+        best = None
+        best_round = None
+        for r in traj.get("rounds", ()):
+            p = r["metrics"].get(metric)
+            if p is None or p.get("value") is None:
+                continue
+            if not _same_device_class(p.get("device"), device_str):
+                continue
+            if best is None or p["value"] < best["value"]:
+                best, best_round = p, r["round"]
+        if best is None:
+            continue
+        band = {
+            "max_s": round(best["value"] * VALUE_SLACK, 4),
+            "source_round": best_round,
+            "source_value": best["value"],
+        }
+        if best.get("phases_s"):
+            band["phases_max_s"] = {
+                k: round(v * PHASE_SLACK + PHASE_FLOOR_S, 4)
+                for k, v in best["phases_s"].items()
+            }
+        if best.get("p99_ms") is not None:
+            band["max_p99_ms"] = round(best["p99_ms"] * VALUE_SLACK, 2)
+        bands[metric] = band
+    return bands
+
+
+def check_results(results, bands):
+    """Compare a fresh capture against bands.  Returns (ok, lines):
+    every gated metric gets a verdict line, breaches get a per-phase
+    attribution diff so the regression localizes from the gate output
+    alone."""
+    ok = True
+    lines = []
+    by_metric = {p["metric"]: p for p in results if isinstance(p, dict)}
+    for metric, band in sorted(bands.items()):
+        p = by_metric.get(metric)
+        if p is None or p.get("value") is None:
+            ok = False
+            err = (p or {}).get("error", "no result captured")
+            lines.append(f"FAIL {metric}: {err}")
+            continue
+        extra = p.get("extra") or {}
+        breaches = []
+        if band.get("max_s") is not None and p["value"] > band["max_s"]:
+            breaches.append(
+                f"value {p['value']:.4f}s > band {band['max_s']:.4f}s")
+        phases = extra.get("phases_s") or {}
+        for phase, cap in sorted((band.get("phases_max_s") or {}).items()):
+            got = phases.get(phase)
+            if got is not None and got > cap:
+                breaches.append(f"phase {phase} {got:.4f}s > {cap:.4f}s")
+        if band.get("max_p99_ms") is not None \
+                and extra.get("p99_ms") is not None \
+                and extra["p99_ms"] > band["max_p99_ms"]:
+            breaches.append(
+                f"p99 {extra['p99_ms']:.1f}ms > {band['max_p99_ms']:.1f}ms")
+        if breaches:
+            ok = False
+            lines.append(f"FAIL {metric}: " + "; ".join(breaches))
+            # the attribution diff: every measured phase vs its band
+            for phase, got in sorted(phases.items()):
+                cap = (band.get("phases_max_s") or {}).get(phase)
+                mark = " BREACH" if cap is not None and got > cap else ""
+                cap_txt = f"{cap:.4f}" if cap is not None else "—"
+                lines.append(
+                    f"  phase {phase:<12} {got:.4f}s / band {cap_txt}s{mark}")
+        else:
+            lines.append(
+                f"ok   {metric}: {p['value']:.4f}s <= "
+                f"{band.get('max_s', float('inf')):.4f}s "
+                f"(band from r{band.get('source_round', '?')})")
+    if not bands:
+        ok = False
+        lines.append("FAIL: no bands resolved (no same-device trajectory "
+                     "history and no --bands file)")
+    return ok, lines
+
+
+def _build_small_e2e_store(n_nodes=50, n_jobs=40, tasks_per_job=5):
+    """Scaled-down cfg5-shaped cluster for the perf-gate smoke."""
+    from volcano_tpu.api import POD_GROUP_KEY, Resource
+    from volcano_tpu.api.objects import Metadata, Node, Pod, PodGroup, PodSpec, Queue
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.store import Store
+
+    store = Store()
+    store.create("Queue", Queue(meta=Metadata(name="q0", namespace=""),
+                                weight=1))
+    store.create("Queue", Queue(meta=Metadata(name="default", namespace=""),
+                                weight=1))
+    for i in range(n_nodes):
+        store.create("Node", Node(
+            meta=Metadata(name=f"n{i:03d}", namespace=""),
+            allocatable=Resource(8000.0, 16.0 * (1 << 30), max_task_num=110)))
+    for j in range(n_jobs):
+        pg = PodGroup(meta=Metadata(name=f"pg{j:03d}", namespace="default"),
+                      min_member=tasks_per_job, queue="q0")
+        pg.status.phase = PodGroupPhase.PENDING
+        store.create("PodGroup", pg)
+        for t in range(tasks_per_job):
+            store.create("Pod", Pod(
+                meta=Metadata(name=f"p{j:03d}-{t}", namespace="default",
+                              annotations={POD_GROUP_KEY: f"pg{j:03d}"}),
+                spec=PodSpec(image="bench",
+                             resources=Resource(250.0, 256 * (1 << 20)))))
+    return store
+
+
+def config_smoke():
+    """Perf-gate smoke capture: the cfg5 pipeline at toy scale (one run,
+    full 5-action conf) — proves the capture→bands→verdict machinery end
+    to end without the 100k×10k cost.  Gated by generous absolute bands
+    (SMOKE_BANDS), not the trajectory."""
+    from volcano_tpu.scheduler.conf import full_conf
+
+    conf = full_conf("tpu")
+    conf.apply_mode = "async"
+    run = _e2e_run(_build_small_e2e_store(), conf)
+    import jax
+
+    _print_json({
+        "metric": "perfgate_smoke_small_cycle",
+        "value": round(run["publish"], 4),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "pods_bound": run["bound"],
+            "phases_s": run["phases"],
+            "steady_cycle_s": round(run["steady"], 4),
+            "path": "fastpath" if run["fastpath"] else "object",
+            "device": str(jax.devices()[0]),
+        },
+    })
+
+
+#: absolute smoke bands: the toy cycle finishing at all inside these is
+#: the machinery proof; a doctored band file is the failure proof
+SMOKE_BANDS = {
+    "perfgate_smoke_small_cycle": {"max_s": 60.0},
+}
+
+
+#: which headline metric each gated config captures
+CONFIG_METRIC = {
+    5: "e2e_schedule_cycle_100k_tasks_10k_nodes",
+    7: "e2e_http_schedule_cycle_100k_tasks_10k_nodes",
+    8: "cfg8_open_loop_first_seen_to_bind",
+    10: "cfg8_open_loop_first_seen_to_bind",
+}
+
+
+def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
+    """The continuous perf-regression gate: fresh capture vs bands;
+    returns the process exit code (nonzero on breach)."""
+    import jax
+
+    device = str(jax.devices()[0])
+    if bands_path:
+        with open(bands_path, encoding="utf-8") as f:
+            bands = json.load(f)
+    elif smoke:
+        bands = dict(SMOKE_BANDS)
+    else:
+        traj_path = os.path.join(directory, TRAJECTORY_FILE)
+        if os.path.exists(traj_path):
+            with open(traj_path, encoding="utf-8") as f:
+                traj = json.load(f)
+        else:
+            traj = build_trajectory(load_bench_rounds(directory))
+        bands = derive_bands(traj, device)
+    if not smoke:
+        # gate only what this invocation captures — a cfg7 band (derived
+        # OR from a --bands file) must not fail a cfg5-only run as
+        # "missing" — and don't burn a capture there is no band for
+        # (e.g. cfg5 on the CPU container: the only cfg5 trajectory
+        # readings are v5e)
+        wanted = {CONFIG_METRIC.get(n) for n in configs}
+        bands = {m: b for m, b in bands.items() if m in wanted}
+        skipped = [n for n in configs if CONFIG_METRIC.get(n) not in bands]
+        if skipped:
+            print(f"perfgate: skipping config(s) {skipped} — no band "
+                  f"for this capture (device {device})")
+        configs = tuple(n for n in configs
+                        if CONFIG_METRIC.get(n) in bands)
+    start = len(LAST_RESULTS)
+    if smoke:
+        runners = {0: config_smoke}
+        configs = (0,)
+    else:
+        runners = {
+            5: lambda: config5(reps=1),
+            7: config7,
+            8: lambda: config8_open_loop(duration_s=5.0, max_doublings=1),
+            10: lambda: config8_open_loop(duration_s=5.0, max_doublings=1),
+        }
+    for n in configs:
+        fn = runners.get(n)
+        if fn is None:
+            print(json.dumps({"metric": f"config{n}",
+                              "error": "not a gated config (5/7/8)"}))
+            continue
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — a crash is a gate failure
+            # record the crash under the GATED metric name so the
+            # verdict line carries the actual exception
+            _print_json({"metric": CONFIG_METRIC.get(n, f"config{n}"),
+                         "value": None, "unit": "s", "error": repr(e)})
+    ok, lines = check_results(LAST_RESULTS[start:], bands)
+    print(f"perfgate: device={device} bands="
+          + (bands_path or ("smoke" if smoke else "trajectory")))
+    for line in lines:
+        print(line)
+    print("perfgate: PASS" if ok else "perfgate: FAIL")
+    return 0 if ok else 1
 
 
 def default_suite():
@@ -942,7 +1352,7 @@ def default_suite():
         try:
             fn()
         except Exception as e:  # noqa: BLE001 — per-config isolation
-            print(json.dumps({"metric": metric, "value": None,
+            _print_json(({"metric": metric, "value": None,
                               "unit": "s", "error": repr(e)}))
 
 
@@ -960,7 +1370,30 @@ def main():
                        help="cfg8: sustained open-loop QPS with "
                             "p50/p99/p999 first-seen->bind latency + "
                             "saturation search (volcano_tpu/loadgen)")
+    group.add_argument("--check", action="store_true",
+                       help="continuous perf-regression gate: fresh "
+                            "capture of the gated configs vs the "
+                            "trajectory/--bands bands; exits nonzero "
+                            "with a per-config per-phase diff on breach "
+                            "(make perfgate)")
+    group.add_argument("--history", action="store_true",
+                       help="collate BENCH_r0*.json into "
+                            "BENCH_TRAJECTORY.json + the BASELINE.md "
+                            "trajectory table")
+    ap.add_argument("--configs", default="5,7,8",
+                    help="--check: comma-separated gated configs "
+                         "(5,7,8; default all three — configs without a "
+                         "same-device band are skipped)")
+    ap.add_argument("--bands", default="",
+                    help="--check: explicit band JSON file instead of "
+                         "the trajectory-derived defaults")
+    ap.add_argument("--smoke", action="store_true",
+                    help="--check: toy-scale capture against absolute "
+                         "bands (machinery proof, not a perf claim)")
     ns = ap.parse_args()
+    if ns.history:
+        cmd_history(baseline_md="BASELINE.md")
+        return
     # amortize XLA compiles across bench invocations
     from volcano_tpu.scheduler.scheduler import (
         enable_persistent_compilation_cache,
@@ -969,7 +1402,15 @@ def main():
     enable_persistent_compilation_cache(
         default_dir="/tmp/volcano-tpu-xla-cache"
     )
-    if ns.all:
+    if ns.check:
+        import sys
+
+        configs = tuple(
+            int(c) for c in str(ns.configs).split(",") if c.strip()
+        )
+        sys.exit(cmd_check(configs=configs, bands_path=ns.bands or None,
+                           smoke=ns.smoke))
+    elif ns.all:
         for n in sorted(CONFIGS):
             CONFIGS[n]()
         kernel_cycle()

@@ -305,3 +305,52 @@ def test_remote_describe_events_trace_roundtrip(tmp_path, capsys, traced):
         assert any(s["name"].startswith("store.") for s in spans)
     finally:
         srv.stop()
+
+
+# -- vtctl profile (vtprof critical-path report) ------------------------------
+
+
+def test_vtctl_profile_local_renders_report_and_remote_fetch(capsys):
+    """`vtctl profile` renders the in-process profiler's report; with
+    --server it fetches /debug/prof from the remote daemon instead."""
+    import json
+
+    from volcano_tpu import vtprof
+    from volcano_tpu.cli.vtctl import main
+    from volcano_tpu.store.server import StoreServer
+
+    # disarmed local mode: actionable hint, rc 0
+    vtprof.disarm()
+    assert main(["profile"]) == 0
+    assert "VOLCANO_TPU_PROF=1" in capsys.readouterr().out
+
+    prof = vtprof.arm()
+    try:
+        prof.begin_cycle()
+        tok = prof.dispatch_begin(lambda: None)
+        prof.dispatch_end(tok, "allocate_solve", phase="solve")
+        prof.record_fetch("allocate_solve", "solve", 0.02, 0.004)
+        prof.end_cycle(0.08, {"solve": 0.05, "publish": 0.02}, "fast")
+        # local text report
+        assert main(["profile"]) == 0
+        out = capsys.readouterr().out
+        assert "vtprof: 1 cycle(s) sampled" in out
+        assert "allocate_solve" in out and "wait=0.0200s" in out
+        # local raw payload
+        assert main(["profile", "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["totals"]["allocate_solve"]["dispatches"] == 1
+        # remote: the same ring served over /debug/prof
+        srv = StoreServer().start()
+        try:
+            assert main(["profile", "--server", srv.url]) == 0
+            out = capsys.readouterr().out
+            assert "vtprof: 1 cycle(s) sampled" in out
+            assert "allocate_solve" in out
+        finally:
+            srv.stop()
+        # a dead server is a CLI error, not a traceback
+        assert main(["profile", "--server", "http://127.0.0.1:9"]) == 1
+        assert "error:" in capsys.readouterr().err
+    finally:
+        vtprof.disarm()

@@ -1,0 +1,233 @@
+"""The continuous perf-regression gate (bench.py --check / --history).
+
+Tier-1 proves the MACHINERY sub-second — trajectory collation across
+every BENCH_r0*.json format, same-device band derivation, the
+pass/doctored-fail verdict with its per-phase attribution diff, and the
+--check exit-code wiring — with the real capture stubbed.  The real
+capture runs under `make perfgate` (`python bench.py --check`).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def _driver_doc(tail_payloads, **extra):
+    """The r01–r07 driver capture shape: JSON lines inside ``tail``."""
+    return {
+        "n": 1, "cmd": "python bench.py", "rc": 0,
+        "tail": "noise line\n" + "\n".join(
+            json.dumps(p) for p in tail_payloads),
+        **extra,
+    }
+
+
+CFG5 = "e2e_schedule_cycle_100k_tasks_10k_nodes"
+
+
+def _payload(metric=CFG5, value=1.0, device="TFRT_CPU_0", phases=None,
+             **extra):
+    return {"metric": metric, "value": value, "unit": "s",
+            "vs_baseline": 60.0 / value,
+            "extra": {"device": device,
+                      **({"phases_s": phases} if phases else {}), **extra}}
+
+
+# -- trajectory collation -----------------------------------------------------
+
+
+def test_history_collates_every_bench_format_and_is_idempotent(tmp_path):
+    # r01: driver form, metric only in the tail; a later tail line for
+    # the same metric wins (the driver transcript repeats sweeps)
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(_driver_doc([
+        _payload(value=2.0), _payload(value=1.5),
+    ])))
+    # r02: driver form with a parsed payload AND a tail line
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(_driver_doc(
+        [_payload(value=1.2, phases={"solve": 0.6, "publish": 0.3})],
+        parsed=_payload(metric="cfg7_x", value=9.0),
+    )))
+    # r03: the r08 bare-payload form
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        _payload(metric="cfg8_open_loop_first_seen_to_bind", value=0.02,
+                 p99_ms=30.0)))
+    # a non-bench json must be ignored
+    (tmp_path / "OTHER.json").write_text("{}")
+
+    rounds = bench.load_bench_rounds(str(tmp_path))
+    assert [n for n, _ in rounds] == [1, 2, 3]
+    assert rounds[0][1][CFG5]["value"] == 1.5  # last tail line wins
+    assert set(rounds[1][1]) == {CFG5, "cfg7_x"}
+
+    baseline = tmp_path / "BASELINE.md"
+    baseline.write_text("# BASELINE\n\nprose stays.\n")
+    bench.cmd_history(directory=str(tmp_path),
+                      baseline_md=str(baseline))
+    traj = json.load(open(tmp_path / "BENCH_TRAJECTORY.json"))
+    assert [r["round"] for r in traj["rounds"]] == [1, 2, 3]
+    assert traj["rounds"][1]["metrics"][CFG5]["phases_s"]["solve"] == 0.6
+    text = baseline.read_text()
+    assert "prose stays." in text
+    assert text.count("## Bench trajectory") == 1
+    assert "| `cfg7_x` |" in text
+    # idempotent: a second run REPLACES the generated section in place
+    bench.cmd_history(directory=str(tmp_path), baseline_md=str(baseline))
+    assert baseline.read_text().count("## Bench trajectory") == 1
+
+
+# -- band derivation ----------------------------------------------------------
+
+
+def test_derive_bands_same_device_class_only():
+    traj = bench.build_trajectory([
+        (5, {CFG5: _payload(value=0.66, device="TPU v5e",
+                            phases={"solve": 0.25})}),
+        (6, {CFG5: _payload(value=2.4, device="TFRT_CPU_0",
+                            phases={"solve": 1.8})}),
+    ])
+    cpu = bench.derive_bands(traj, "TFRT_CPU_0")
+    tpu = bench.derive_bands(traj, "TPU v5e lite")
+    assert cpu[CFG5]["source_round"] == 6
+    assert cpu[CFG5]["max_s"] == pytest.approx(2.4 * bench.VALUE_SLACK)
+    assert cpu[CFG5]["phases_max_s"]["solve"] == pytest.approx(
+        1.8 * bench.PHASE_SLACK + bench.PHASE_FLOOR_S)
+    assert tpu[CFG5]["source_round"] == 5
+    # no same-device history -> no band for that metric
+    assert bench.derive_bands(bench.build_trajectory([]), "TFRT_CPU_0") == {}
+    # a device-less reading matches NO class (it must not slip into the
+    # accelerator pool just because '' contains no 'cpu')
+    traj_nodev = bench.build_trajectory([
+        (7, {CFG5: _payload(value=0.1, device=None)}),
+    ])
+    assert bench.derive_bands(traj_nodev, "TPU v5e") == {}
+    assert bench.derive_bands(traj_nodev, "TFRT_CPU_0") == {}
+
+
+# -- the verdict --------------------------------------------------------------
+
+
+def _bands():
+    return {CFG5: {"max_s": 2.0, "phases_max_s": {"solve": 1.0,
+                                                  "publish": 0.5}}}
+
+
+def test_check_results_passes_inside_bands():
+    ok, lines = bench.check_results(
+        [_payload(value=1.5, phases={"solve": 0.8, "publish": 0.3})],
+        _bands())
+    assert ok
+    assert any(line.startswith("ok   " + CFG5) for line in lines)
+
+
+def test_check_results_fails_with_per_phase_attribution_diff():
+    ok, lines = bench.check_results(
+        [_payload(value=1.5, phases={"solve": 1.4, "publish": 0.05})],
+        _bands())
+    assert not ok
+    joined = "\n".join(lines)
+    assert f"FAIL {CFG5}" in joined
+    assert "phase solve" in joined and "BREACH" in joined
+    assert "phase publish" in joined  # the full diff prints, not just hits
+    # value breach alone also fails
+    ok2, lines2 = bench.check_results([_payload(value=9.9)], _bands())
+    assert not ok2 and "value 9.9000s > band 2.0000s" in "\n".join(lines2)
+    # a crashed capture is a gate failure, not a silent pass
+    ok3, lines3 = bench.check_results(
+        [{"metric": "config5", "value": None, "error": "boom"}], _bands())
+    assert not ok3 and "no result captured" in "\n".join(lines3)
+    # no bands at all must fail loudly (a vacuous gate is worse than none)
+    ok4, lines4 = bench.check_results([], {})
+    assert not ok4 and "no bands resolved" in "\n".join(lines4)
+
+
+# -- --check wiring (capture stubbed: the sub-second tier-1 smoke) ------------
+
+
+def test_cmd_check_smoke_exit_codes_with_stubbed_capture(tmp_path,
+                                                         monkeypatch):
+    def fake_smoke():
+        bench._print_json(_payload(
+            metric="perfgate_smoke_small_cycle", value=0.4,
+            phases={"solve": 0.2, "publish": 0.1}))
+
+    monkeypatch.setattr(bench, "config_smoke", fake_smoke)
+    assert bench.cmd_check(smoke=True) == 0
+    # a doctored band file must flip the verdict (nonzero exit)
+    doctored = tmp_path / "bands.json"
+    doctored.write_text(json.dumps({
+        "perfgate_smoke_small_cycle": {
+            "max_s": 1e-6, "phases_max_s": {"solve": 1e-6}},
+    }))
+    assert bench.cmd_check(smoke=True, bands_path=str(doctored)) == 1
+
+
+def test_cmd_check_skips_configs_without_same_device_band(tmp_path,
+                                                          monkeypatch,
+                                                          capsys):
+    # trajectory knows cfg5 only on another device class -> the gate
+    # must skip it (no wasted capture) and fail for want of bands
+    (tmp_path / bench.TRAJECTORY_FILE).write_text(json.dumps(
+        bench.build_trajectory([
+            (5, {CFG5: _payload(value=0.66, device="TPU v5e")}),
+        ])))
+    monkeypatch.setattr(
+        bench, "config5",
+        lambda **kw: (_ for _ in ()).throw(AssertionError("ran anyway")))
+    import jax
+
+    if "cpu" not in str(jax.devices()[0]).lower():
+        pytest.skip("needs a CPU device to mismatch the TPU-only history")
+    rc = bench.cmd_check(configs=(5,), directory=str(tmp_path))
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "skipping config(s) [5]" in out
+    assert "no bands resolved" in out
+
+
+def test_cmd_check_bands_file_gates_only_requested_configs(tmp_path,
+                                                           monkeypatch,
+                                                           capsys):
+    """Review hardening: an explicit --bands file carrying cfg7/cfg8
+    bands must not fail a cfg5-only run as 'missing', and a config the
+    file has no band for is skipped, not captured pointlessly."""
+    bands = tmp_path / "bands.json"
+    bands.write_text(json.dumps({
+        CFG5: {"max_s": 2.0},
+        "e2e_http_schedule_cycle_100k_tasks_10k_nodes": {"max_s": 3.0},
+        "cfg8_open_loop_first_seen_to_bind": {"max_s": 0.1},
+    }))
+    monkeypatch.setattr(
+        bench, "config5",
+        lambda **kw: bench._print_json(_payload(value=1.0)))
+    monkeypatch.setattr(
+        bench, "config7",
+        lambda: (_ for _ in ()).throw(AssertionError("cfg7 ran anyway")))
+    assert bench.cmd_check(configs=(5,), bands_path=str(bands)) == 0
+    out = capsys.readouterr().out
+    assert f"ok   {CFG5}" in out
+    assert "no result captured" not in out
+    # a config with no band in the file is skipped loudly
+    bands2 = tmp_path / "bands2.json"
+    bands2.write_text(json.dumps({CFG5: {"max_s": 2.0}}))
+    assert bench.cmd_check(configs=(5, 7), bands_path=str(bands2)) == 0
+    assert "skipping config(s) [7]" in capsys.readouterr().out
+
+
+def test_cmd_check_surfaces_capture_exception_in_verdict(tmp_path,
+                                                         monkeypatch,
+                                                         capsys):
+    """Review hardening: a crashed capture records its error under the
+    GATED metric name, so the FAIL line carries the real exception."""
+    bands = tmp_path / "bands.json"
+    bands.write_text(json.dumps({CFG5: {"max_s": 2.0}}))
+    monkeypatch.setattr(
+        bench, "config5",
+        lambda **kw: (_ for _ in ()).throw(RuntimeError("kaboom")))
+    assert bench.cmd_check(configs=(5,), bands_path=str(bands)) == 1
+    out = capsys.readouterr().out
+    assert f"FAIL {CFG5}" in out and "kaboom" in out

@@ -926,6 +926,102 @@ def test_trace_span_near_misses(tmp_path):
     """, select=["trace-span-discipline"]) == []
 
 
+# --- rule: device-sync-discipline --------------------------------------------
+
+
+def test_device_sync_fires_on_block_until_ready(tmp_path):
+    findings = _lint(tmp_path, "tensor_actions.py", """
+        def solve(out):
+            out.block_until_ready()
+            return out
+    """, select=["device-sync-discipline"])
+    assert _rules_of(findings) == ["device-sync-discipline"]
+
+
+def test_device_sync_fires_on_raw_device_get(tmp_path):
+    findings = _lint(tmp_path, "fast_victims.py", """
+        import jax
+
+        def reclaim_pass(state):
+            return jax.device_get(state)
+    """, select=["device-sync-discipline"])
+    assert _rules_of(findings) == ["device-sync-discipline"]
+
+
+def test_device_sync_fires_on_asarray_and_coercion_of_solve_result(tmp_path):
+    # np.asarray of a tracked solve output, float()/bool() of tuple-
+    # unpacked victim_step results — the implicit-sync class
+    findings = _lint(tmp_path, "tensor_actions.py", """
+        import numpy as np
+
+        def attempt(consts, state, req):
+            out_state, assigned, nstar, vmask, clean = victim_step(
+                consts, state, req)
+            if not bool(clean):
+                return None
+            return np.asarray(vmask)
+    """, select=["device-sync-discipline"])
+    assert _rules_of(findings) == ["device-sync-discipline"] * 2
+    # a jit wrapper created in-function taints its results too
+    findings = _lint(tmp_path, "fastpath.py", """
+        import jax
+        import numpy as np
+
+        def run(args):
+            packed = jax.jit(lambda a: a + 1)
+            out = packed(args)
+            return np.asarray(out)
+    """, select=["device-sync-discipline"])
+    assert _rules_of(findings) == ["device-sync-discipline"]
+
+
+def test_device_sync_near_misses_stay_quiet(tmp_path):
+    # the sanctioned boundaries themselves: vtprof.fetch / device_get
+    assert _lint(tmp_path, "tensor_actions.py", """
+        from volcano_tpu import vtprof
+
+        def solve(packed, args):
+            out = packed(args)
+            flat = vtprof.fetch(out, kernel="allocate_solve", phase="solve")
+            return flat
+    """, select=["device-sync-discipline"]) == []
+    # a device name RE-fetched through vtprof.device_get is host after
+    assert _lint(tmp_path, "fast_victims.py", """
+        import numpy as np
+        from volcano_tpu import vtprof
+
+        def attempt(consts, state, req):
+            ok, vmask = victim_step(consts, state, req)
+            ok, vmask = vtprof.device_get((ok, vmask), kernel="victim_step")
+            return bool(ok), np.asarray(vmask)
+    """, select=["device-sync-discipline"]) == []
+    # np.asarray of plain host data is not a sync
+    assert _lint(tmp_path, "volsolve.py", """
+        import numpy as np
+
+        def masks(rows):
+            rows = sorted(rows)
+            return np.asarray(rows)
+    """, select=["device-sync-discipline"]) == []
+    # the identical sync OUTSIDE the fastpath-hot module set is exempt
+    # (bench drivers / parity suites block on purpose)
+    assert _lint(tmp_path, "bench_driver.py", """
+        def time_cycle(out):
+            out.block_until_ready()
+    """, select=["device-sync-discipline"]) == []
+
+
+def test_device_sync_suppressions_carry_justification():
+    """The sanctioned startup syncs (prewarm's device handshake + warm
+    blocks) are line-suppressed with their reasons; the rule still fires
+    on any NEW sync in scheduler.py."""
+    import volcano_tpu
+
+    pkg = os.path.dirname(os.path.abspath(volcano_tpu.__file__))
+    sched = open(os.path.join(pkg, "scheduler", "scheduler.py")).read()
+    assert sched.count("vtlint: disable=device-sync-discipline") == 2
+
+
 # --- rule: metric-discipline -------------------------------------------------
 
 

@@ -89,6 +89,7 @@ def _load_rule_modules() -> None:
     _LOADED = True
     from volcano_tpu.analysis import (  # noqa: F401  (import = registration)
         rules_concurrency,
+        rules_device,
         rules_epsilon,
         rules_excepts,
         rules_hotpath,

@@ -1,0 +1,195 @@
+"""device-sync-discipline: no stray device syncs in fastpath-hot modules.
+
+The vtprof critical-path attribution (volcano_tpu/vtprof.py) is only as
+honest as the fetch discipline: every device→host synchronization in the
+fastpath-hot modules must go through the sanctioned boundaries —
+``vtprof.fetch`` for the packed solve outputs and ``vtprof.device_get``
+for the whole-pass contention fetches.  A stray ``.block_until_ready()``,
+``jax.device_get``, ``np.asarray(<device array>)`` or an implicit-sync
+``float(...)`` / ``int(...)`` / ``bool(...)`` coercion of a device value:
+
+* serializes dispatch (the host blocks mid-phase where the profiler
+  expects async submission), and
+* books device wait time into the ``host`` segment, corrupting exactly
+  the attribution ROADMAP item 1's sharding work will be judged with.
+
+Recognition is deliberately conservative (near-misses must stay quiet):
+
+* ``.block_until_ready()`` and ``device_get`` (other than
+  ``vtprof.device_get``) fire anywhere in the module set;
+* ``np.asarray`` / ``float`` / ``int`` / ``bool`` fire only on a bare
+  name whose most recent assignment in the same function came from a
+  known device-solve call (``victim_step`` / ``preempt_solve`` /
+  ``reclaim_solve`` / ``preempt_rounds`` / ``allocate_solve[_batch]`` /
+  ``water_fill``) or from a jit wrapper created in that function
+  (``jax.jit(...)`` / ``_packed_solve(...)`` /
+  ``_PACKED_SOLVES.get(...)``).  Reassigning the name from a sanctioned
+  fetch clears it.
+
+The sanctioned startup syncs (Scheduler.prewarm's device handshake and
+warm-task blocks — they run before the first timed cycle, where blocking
+is the point) carry justified line suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    dotted_name,
+    rule,
+    walk_functions,
+)
+
+#: fastpath-hot modules (by basename, like the other loop-shape rules)
+_HOT_MODULES = {
+    "fastpath.py", "tensor_actions.py", "fast_victims.py", "volsolve.py",
+    "kernels.py", "victim_kernels.py", "snapshot.py", "scheduler.py",
+}
+
+#: calls whose results are device arrays (the dispatch entries)
+_DEVICE_SOLVES = {
+    "victim_step", "preempt_solve", "reclaim_solve", "preempt_rounds",
+    "allocate_solve", "allocate_solve_batch", "water_fill",
+}
+
+#: calls that CREATE a jit wrapper; names bound to them are dispatchers
+_JIT_MAKERS = {"jit", "_packed_solve"}
+
+#: coercions that implicitly synchronize a device value
+_COERCIONS = {"float", "int", "bool"}
+
+
+def _call_tail(call: ast.Call) -> str:
+    name = dotted_name(call.func) or ""
+    return name.split(".")[-1]
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            if isinstance(elt, ast.Name):
+                out.append(elt.id)
+        return out
+    return []
+
+
+def _collect_wrappers(fn: ast.AST) -> Set[str]:
+    """Names bound (anywhere in the function) to a jit-wrapper factory."""
+    wrappers: Set[str] = set()
+    for sub in ast.walk(fn):
+        if not (isinstance(sub, ast.Assign)
+                and isinstance(sub.value, ast.Call)):
+            continue
+        name = dotted_name(sub.value.func) or ""
+        tail = name.split(".")[-1]
+        if tail in _JIT_MAKERS or name.endswith("_PACKED_SOLVES.get"):
+            for t in sub.targets:
+                wrappers.update(_target_names(t))
+    return wrappers
+
+
+def _device_assignments(fn: ast.AST,
+                        wrappers: Set[str]) -> Dict[str, List[Tuple[int, bool]]]:
+    """name -> [(lineno, is_device)] for every bare-name assignment in
+    the function, so a use can resolve its most recent producer."""
+    history: Dict[str, List[Tuple[int, bool]]] = {}
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Assign):
+            continue
+        is_device = False
+        if isinstance(sub.value, ast.Call):
+            name = dotted_name(sub.value.func) or ""
+            tail = name.split(".")[-1]
+            is_device = (
+                tail in _DEVICE_SOLVES
+                or (name in wrappers)
+            ) and not name.startswith("vtprof.")
+        for t in sub.targets:
+            for n in _target_names(t):
+                history.setdefault(n, []).append((sub.lineno, is_device))
+    for entries in history.values():
+        entries.sort()
+    return history
+
+
+def _is_device_at(history, name: str, lineno: int) -> bool:
+    entries = history.get(name)
+    if not entries:
+        return False
+    latest = None
+    for ln, is_dev in entries:
+        if ln <= lineno:
+            latest = is_dev
+        else:
+            break
+    return bool(latest)
+
+
+@rule(
+    "device-sync-discipline",
+    "fastpath-hot modules must not synchronize with the device outside "
+    "the sanctioned vtprof boundaries: no .block_until_ready(), no "
+    "jax.device_get (use vtprof.device_get), and no np.asarray / "
+    "float / int / bool of a device-solve result (use vtprof.fetch) — "
+    "hidden syncs serialize dispatch and corrupt the critical-path "
+    "attribution; startup warm-up blocks carry justified suppressions",
+)
+def check_device_sync_discipline(ctx: FileContext) -> Iterable[Finding]:
+    if ctx.basename not in _HOT_MODULES:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "block_until_ready":
+            yield ctx.finding(
+                "device-sync-discipline", node,
+                ".block_until_ready() outside the sanctioned vtprof "
+                "fetch boundaries: route the fetch through vtprof.fetch "
+                "/ vtprof.device_get so the wait is attributed, not "
+                "hidden in a host phase",
+            )
+            continue
+        name = dotted_name(node.func) or ""
+        if name.split(".")[-1] == "device_get" \
+                and not name.startswith("vtprof."):
+            yield ctx.finding(
+                "device-sync-discipline", node,
+                f"{name}() is an unattributed device sync: use "
+                "vtprof.device_get (disarmed it IS jax.device_get)",
+            )
+    for fn in walk_functions(ctx.tree):
+        wrappers = _collect_wrappers(fn)
+        history = _device_assignments(fn, wrappers)
+        if not history:
+            continue
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = dotted_name(sub.func) or ""
+            tail = name.split(".")[-1]
+            coercion = name in _COERCIONS
+            asarray = tail == "asarray" and name.split(".")[0] in (
+                "np", "numpy",
+            )
+            if not (coercion or asarray):
+                continue
+            if len(sub.args) != 1 or not isinstance(sub.args[0], ast.Name):
+                continue
+            arg = sub.args[0]
+            if _is_device_at(history, arg.id, sub.lineno):
+                what = "np.asarray" if asarray else f"{name}(...)"
+                yield ctx.finding(
+                    "device-sync-discipline", sub,
+                    f"{what} of device-solve result {arg.id!r} is an "
+                    "implicit sync outside the sanctioned boundaries: "
+                    "fetch once through vtprof.fetch / vtprof.device_get "
+                    "and branch on host values",
+                )

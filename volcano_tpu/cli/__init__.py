@@ -14,6 +14,7 @@ from volcano_tpu.cli.vtctl import (
     cmd_list,
     cmd_node_list,
     cmd_pool_list,
+    cmd_profile,
     cmd_resume,
     cmd_run,
     cmd_suspend,
@@ -33,6 +34,7 @@ __all__ = [
     "cmd_list",
     "cmd_node_list",
     "cmd_pool_list",
+    "cmd_profile",
     "cmd_resume",
     "cmd_run",
     "cmd_suspend",

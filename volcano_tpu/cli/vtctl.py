@@ -354,31 +354,44 @@ def _phase_summary(phases: dict, top: int = 3) -> str:
     return " ".join(f"{k}={v:.3f}" for k, v in items)
 
 
+def _dev_host_cell(s: dict) -> str:
+    """``dev/host`` milliseconds for one cycle sample — present only when
+    the vtprof profiler enriched the row (scheduler._record_cycle)."""
+    if "device_s" not in s and "host_s" not in s:
+        return "-"
+    dev = (s.get("device_s") or 0.0) + (s.get("transfer_s") or 0.0)
+    return f"{dev * 1e3:.1f}/{(s.get('host_s') or 0.0) * 1e3:.1f}"
+
+
 def cmd_top(samples, out: Optional[io.TextIOBase] = None, n: int = 12,
             now: Optional[float] = None) -> str:
     """Render the per-cycle time-series ring (volcano_tpu/timeseries.py)
     as a live control-plane dashboard: last ``n`` scheduler cycles with
-    duration / backlog / binds / drain lag / top phases, a window
-    percentile summary, and the newest store-side sample (event-log
-    position + WAL fsync accounting)."""
+    duration / device-host split / backlog / binds / drain lag / top
+    phases, a window percentile summary, an anomaly line (vtprof
+    sentinel trips: steady-state recompiles, leak-sentinel hits), and
+    the newest store-side sample (event-log position + WAL fsync
+    accounting)."""
     import time as _time
 
     now = _time.time() if now is None else now
     cycles = [s for s in samples if s.get("kind") == "cycle"]
     stores = [s for s in samples if s.get("kind") == "store"]
+    anomalies = [s for s in samples if s.get("kind") == "anomaly"]
     buf = io.StringIO()
     if not samples:
         buf.write("no time-series samples (arm the recorder with "
                   "VOLCANO_TPU_TIMESERIES=1)\n")
     else:
-        row = "%-8s%-8s%-10s%-8s%-9s%-7s%-7s%-7s%s\n"
-        buf.write(row % ("Cycle", "Age", "Dur(ms)", "Path", "Backlog",
-                         "Binds", "Evict", "Drain", "Phases"))
+        row = "%-8s%-8s%-10s%-12s%-8s%-9s%-7s%-7s%-7s%s\n"
+        buf.write(row % ("Cycle", "Age", "Dur(ms)", "Dev/Host", "Path",
+                         "Backlog", "Binds", "Evict", "Drain", "Phases"))
         for s in cycles[-n:]:
             buf.write(row % (
                 s.get("cycle", "-"),
                 f"{max(now - s.get('ts', now), 0.0):.1f}s",
                 f"{s.get('dur_s', 0.0) * 1e3:.1f}",
+                _dev_host_cell(s),
                 s.get("path", "-"),
                 s.get("backlog", "-"),
                 s.get("binds", "-"),
@@ -386,6 +399,18 @@ def cmd_top(samples, out: Optional[io.TextIOBase] = None, n: int = 12,
                 s.get("drain_pending", "-"),
                 _phase_summary(s.get("phases") or {}),
             ))
+        if anomalies:
+            kinds: dict = {}
+            for a in anomalies:
+                kinds[a.get("anomaly", "?")] = \
+                    kinds.get(a.get("anomaly", "?"), 0) + 1
+            last = anomalies[-1]
+            buf.write(
+                "anomalies: "
+                + " ".join(f"{k}x{v}" for k, v in sorted(kinds.items()))
+                + f" (last: {last.get('anomaly')} @ cycle "
+                  f"{last.get('cycle', '?')})\n"
+            )
         if cycles:
             durs = sorted(s.get("dur_s", 0.0) for s in cycles)
             p = lambda q: durs[min(int(q * len(durs)), len(durs) - 1)] * 1e3  # noqa: E731
@@ -409,15 +434,38 @@ def cmd_top(samples, out: Optional[io.TextIOBase] = None, n: int = 12,
     return text
 
 
-def _fetch_debug_timeseries(server_url: str) -> list:
-    """The remote time-series ring: GET <server>/debug/timeseries."""
+def cmd_profile(payload, out: Optional[io.TextIOBase] = None) -> str:
+    """Flame-style critical-path report from a vtprof payload (the local
+    profiler's or a remote ``/debug/prof`` body): per-phase
+    host/dispatch/wait/transfer bars, the per-kernel dispatch/compile
+    table, memory watermarks, anomalies."""
+    from volcano_tpu import vtprof
+
+    text = vtprof.report_text(payload)
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def _fetch_debug(server_url: str, path: str):
+    """GET one /debug/* admin payload from a remote daemon."""
     import json as _json
     import urllib.request
 
     with urllib.request.urlopen(
-        server_url.rstrip("/") + "/debug/timeseries", timeout=10
+        server_url.rstrip("/") + path, timeout=10
     ) as r:
-        return _json.load(r).get("samples") or []
+        return _json.load(r)
+
+
+def _fetch_debug_prof(server_url: str) -> dict:
+    """The remote profile: GET <server>/debug/prof."""
+    return _fetch_debug(server_url, "/debug/prof")
+
+
+def _fetch_debug_timeseries(server_url: str) -> list:
+    """The remote time-series ring: GET <server>/debug/timeseries."""
+    return _fetch_debug(server_url, "/debug/timeseries").get("samples") or []
 
 
 def cmd_trace_render(records, trace_id: str = "",
@@ -439,13 +487,7 @@ def cmd_trace_render(records, trace_id: str = "",
 
 def _fetch_debug_trace(server_url: str) -> list:
     """The remote flight recorder: GET <server>/debug/trace."""
-    import json as _json
-    import urllib.request
-
-    with urllib.request.urlopen(
-        server_url.rstrip("/") + "/debug/trace", timeout=10
-    ) as r:
-        return _json.load(r).get("spans") or []
+    return _fetch_debug(server_url, "/debug/trace").get("spans") or []
 
 
 def _local_trace_records(state_path: str) -> list:
@@ -681,6 +723,13 @@ def main(argv=None) -> int:
     top_p.add_argument("--count", type=int, default=0,
                        help="refresh iterations with --watch (0 = forever)")
 
+    # vtprof: the critical-path profile report (vtprof.py)
+    prof_p = sub.add_parser("profile", parents=[common],
+                            help="device/host critical-path profile from "
+                                 "the /debug/prof ring")
+    prof_p.add_argument("--json", action="store_true",
+                        help="raw payload instead of the text report")
+
     cl_p = sub.add_parser("cluster", help="simulated cluster management")
     cl_sub = cl_p.add_subparsers(dest="cmd", required=True)
     init_p = cl_sub.add_parser("init", parents=[common])
@@ -769,6 +818,25 @@ def main(argv=None) -> int:
                 _time.sleep(args.watch)
         except KeyboardInterrupt:
             pass
+        except Exception as e:  # surface as CLI error, not traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.group == "profile":
+        from volcano_tpu import vtprof
+
+        try:
+            if args.server:
+                payload = _fetch_debug_prof(args.server)
+            else:
+                payload = vtprof.debug_payload()
+            if args.json:
+                import json as _json
+
+                print(_json.dumps(payload))
+            else:
+                cmd_profile(payload, out=sys.stdout)
         except Exception as e:  # surface as CLI error, not traceback
             print(f"error: {e}", file=sys.stderr)
             return 1

@@ -58,6 +58,7 @@ from typing import List, Tuple
 
 import numpy as np
 
+from volcano_tpu import vtprof
 from volcano_tpu.scheduler import metrics
 
 # storms above this many preemptor tasks take the batched-rounds kernel
@@ -315,8 +316,6 @@ class FastContention:
         re-arm the queue on success.  Returns False when the object
         machinery must take the whole cycle (kernel-inexpressible case
         encountered); nothing was published."""
-        import jax
-
         from volcano_tpu.scheduler.victim_kernels import reclaim_solve
 
         snap = self.snap
@@ -330,6 +329,9 @@ class FastContention:
             queue_live[qs] = True
         if not job_cand.any() or not queue_live.any():
             return True
+        prof = vtprof.PROFILER
+        tok = prof.dispatch_begin(reclaim_solve) if prof is not None \
+            else None
         out_s, pipe, rec, abort = reclaim_solve(
             self.consts, self.state,
             self.task_req_dev, self.task_class_dev,
@@ -343,9 +345,13 @@ class FastContention:
             has_proportion=self.has_proportion,
             job_key_order=self.job_key_order,
         )
-        # ONE device round trip for the whole pass
-        out_s, pipe, ea, pn, pa, abort = jax.device_get(
-            (out_s, pipe, rec.evict_att, rec.pipe_node, rec.pipe_att, abort)
+        if tok is not None:
+            prof.dispatch_end(tok, "reclaim_solve", phase="reclaim")
+        # ONE device round trip for the whole pass (vtprof.device_get is
+        # the sanctioned whole-pass fetch boundary)
+        out_s, pipe, ea, pn, pa, abort = vtprof.device_get(
+            (out_s, pipe, rec.evict_att, rec.pipe_node, rec.pipe_att, abort),
+            kernel="reclaim_solve", phase="reclaim",
         )
         if bool(abort):
             return False
@@ -359,8 +365,6 @@ class FastContention:
         semantics, phase 2 within-job.  Returns False when the object
         sub-cycle must take over (nothing recorded by this pass survives —
         the kernel aborted before recording)."""
-        import jax
-
         from volcano_tpu.scheduler.victim_kernels import preempt_solve
 
         snap = self.snap
@@ -445,6 +449,9 @@ class FastContention:
             is_pre = pend_ok & (counts_left > 0)
             if not is_pre.any():
                 return True
+        prof = vtprof.PROFILER
+        tok = prof.dispatch_begin(preempt_solve) if prof is not None \
+            else None
         out_s, pipe, rec, att_total, last_v, any_p1, abort = preempt_solve(
             self.consts, self.state,
             self.task_req_dev, self.task_class_dev, attempt_rows,
@@ -460,10 +467,13 @@ class FastContention:
             job_key_order=self.job_key_order,
             gang_pipelined=self.gang_pipelined,
         )
+        if tok is not None:
+            prof.dispatch_end(tok, "preempt_solve", phase="preempt")
         (out_s, pipe, ea, pn, pa, att_total, last_v, any_p1,
-         abort) = jax.device_get(
+         abort) = vtprof.device_get(
             (out_s, pipe, rec.evict_att, rec.pipe_node, rec.pipe_att,
-             att_total, last_v, any_p1, abort)
+             att_total, last_v, any_p1, abort),
+            kernel="preempt_solve", phase="preempt",
         )
         if bool(abort):
             return False
@@ -482,8 +492,6 @@ class FastContention:
         exact tail.  Never aborts — rounds are capacity-safe by
         construction, and anything they could not serve is simply left
         for the exact loop."""
-        import jax
-
         from volcano_tpu.scheduler.victim_kernels import preempt_rounds
 
         snap = self.snap
@@ -498,6 +506,9 @@ class FastContention:
             pstart[1:] = np.cumsum(counts[:-1]).astype(np.int32)
         rows_packed = np.zeros(T, np.int32)
         rows_packed[: rows.size] = rows
+        prof = vtprof.PROFILER
+        tok = prof.dispatch_begin(preempt_rounds) if prof is not None \
+            else None
         out_s, pipe, rec, att_total, last_v, any_commit, _, _ = (
             preempt_rounds(
                 self.consts, self.state,
@@ -513,10 +524,13 @@ class FastContention:
                 gang_pipelined=self.gang_pipelined,
             )
         )
+        if tok is not None:
+            prof.dispatch_end(tok, "preempt_rounds", phase="preempt")
         (out_s, pipe, ea, pn, pa, att_total, last_v,
-         any_commit) = jax.device_get(
+         any_commit) = vtprof.device_get(
             (out_s, pipe, rec.evict_att, rec.pipe_node, rec.pipe_att,
-             att_total, last_v, any_commit)
+             att_total, last_v, any_commit),
+            kernel="preempt_rounds", phase="preempt",
         )
         if int(att_total) == 0:
             return attempt_rows

@@ -54,7 +54,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from volcano_tpu import timeseries
+from volcano_tpu import timeseries, vtprof
 from volcano_tpu.api.job import POD_GROUP_KEY
 from volcano_tpu.api.types import PodGroupPhase, PodPhase, TaskStatus
 from volcano_tpu.scheduler import metrics
@@ -2043,6 +2043,12 @@ class FastCycle:
         ph["snapshot"] = time.perf_counter() - t
         if snap is None:
             return False
+        if vtprof.PROFILER is not None:
+            # memory watermarks (armed-only): array bytes held by the
+            # snapshot this cycle — the gauge the leak sentinel reads
+            vtprof.PROFILER.note_bytes(
+                "snapshot", vtprof.array_bytes(snap)
+            )
         if aux.get("vol_solve_s"):
             # claim interning + verdicts (volsolve.py), carved out of the
             # snapshot figure so a volume-heavy cycle self-localizes; the
@@ -2138,6 +2144,12 @@ class FastCycle:
             ready = snap.job_ready_init.copy()
         metrics.update_action_duration("allocate", t0)
         ph["solve"] = time.perf_counter() - t0
+        if vtprof.PROFILER is not None:
+            vtprof.PROFILER.note_bytes(
+                "solve_out",
+                task_node.nbytes + task_kind.nbytes
+                + task_seq.nbytes + ready.nbytes,
+            )
 
         t = time.perf_counter()
         be_rows, be_nodes, be_per_job = (

@@ -347,6 +347,42 @@ def register_residue_tasks(cls: str, count: int) -> None:
     inc("volcano_residue_tasks_total", float(count), **{"class": cls})
 
 
+# -- vtprof critical-path series (volcano_tpu/vtprof.py) ----------------------
+
+def register_jit_compile(kernel: str, n: int = 1) -> None:
+    """XLA compiles observed for one registered kernel (compile-cache
+    growth seen by the vtprof sentinel).  In steady state this series
+    must be FLAT — shape-bucketing discipline is the contract; any
+    post-warmup advance is an anomaly."""
+    inc("volcano_jit_compiles_total", float(n), kernel=kernel)
+
+
+def register_kernel_dispatch(kernel: str, n: int = 1) -> None:
+    inc("volcano_kernel_dispatch_total", float(n), kernel=kernel)
+
+
+def observe_prof_segment(phase: str, segment: str, seconds: float) -> None:
+    """One cycle's share of a (phase, segment) cell — segment in
+    host/dispatch/wait/transfer, the vtprof critical-path taxonomy."""
+    observe("volcano_prof_segment_seconds", seconds,
+            phase=phase, segment=segment)
+
+
+def observe_kernel_device_seconds(kernel: str, seconds: float) -> None:
+    """Device wait+transfer the host spent on one kernel in one cycle."""
+    observe("volcano_kernel_device_seconds", seconds, kernel=kernel)
+
+
+def update_device_bytes(component: str, nbytes: int) -> None:
+    """Memory watermark gauge: array bytes held per component
+    (mirror / snapshot / solve_out / device)."""
+    set_gauge("volcano_device_bytes", float(nbytes), component=component)
+
+
+def register_prof_anomaly(kind: str) -> None:
+    inc("volcano_prof_anomalies_total", kind=kind)
+
+
 # -- store WAL durability series (volcano_tpu/store/wal.py) -------------------
 
 def register_wal_append(n: int = 1) -> None:
@@ -420,6 +456,18 @@ _HELP: Dict[str, str] = {
         "WAL records replayed during crash recovery",
     "volcano_decision_drain_batch_seconds":
         "Wall seconds one async-applier batch took to reach the store",
+    "volcano_jit_compiles_total":
+        "XLA compiles per kernel (steady state must stay flat)",
+    "volcano_kernel_dispatch_total":
+        "Jitted kernel dispatches per kernel",
+    "volcano_prof_segment_seconds":
+        "Per-cycle critical-path share by phase and segment",
+    "volcano_kernel_device_seconds":
+        "Per-cycle device wait+transfer seconds per kernel",
+    "volcano_device_bytes":
+        "Array bytes held per component (memory watermark)",
+    "volcano_prof_anomalies_total":
+        "vtprof sentinel trips (steady-state recompiles, leaks) by kind",
     _DROPPED_SERIES:
         "Observations dropped by the per-metric label-cardinality cap",
 }

@@ -13,7 +13,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from volcano_tpu import timeseries, trace
+from volcano_tpu import timeseries, trace, vtprof
 from volcano_tpu.scheduler import metrics
 
 
@@ -33,6 +33,12 @@ class _Handler(BaseHTTPRequestHandler):
             # the per-cycle time-series ring (volcano_tpu/timeseries.py)
             # — what `vtctl top` renders live
             body = json.dumps(timeseries.debug_payload()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path == "/debug/prof":
+            # the vtprof critical-path profile (volcano_tpu/vtprof.py)
+            # — what `vtctl profile` renders
+            body = json.dumps(vtprof.debug_payload()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif self.path == "/healthz":

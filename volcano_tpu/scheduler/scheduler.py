@@ -12,7 +12,7 @@ from typing import Optional
 
 import volcano_tpu.scheduler.actions  # noqa: F401  (registers actions)
 import volcano_tpu.scheduler.plugins  # noqa: F401  (registers plugins)
-from volcano_tpu import timeseries, trace
+from volcano_tpu import timeseries, trace, vtprof
 from volcano_tpu.scheduler import metrics
 from volcano_tpu.scheduler.cache import SchedulerCache
 from volcano_tpu.scheduler.conf import SchedulerConf, default_conf, load_conf
@@ -173,7 +173,9 @@ class Scheduler:
             try:
                 import jax.numpy as jnp
 
-                jnp.zeros((1,), jnp.float32).block_until_ready()
+                # sanctioned startup sync: the device/tunnel handshake IS
+                # the point (runs before the first timed cycle)
+                jnp.zeros((1,), jnp.float32).block_until_ready()  # vtlint: disable=device-sync-discipline
             except Exception as e:  # noqa: BLE001 — surfaces on first real use
                 # recorded, not swallowed: lets an operator distinguish
                 # "device handshake failed at startup" from "first cycle
@@ -243,13 +245,29 @@ class Scheduler:
         toucher.join()
         critical, later = self._warm_tasks(backend, snap, aux, bucket_levels)
         self._run_warm_tasks(critical)
+
+        def _handshake():
+            # warmup handshake: compiles so far were expected; the first
+            # compile-free cycle after this marks steady state, and any
+            # later compile is a sentinel anomaly.  Must run AFTER the
+            # background warm thread too — its deferred compiles are
+            # warmup, not steady-state recompiles.
+            if vtprof.PROFILER is not None:
+                vtprof.PROFILER.warmup_handshake()
+
         if background and later:
+            def _bg_warm():
+                self._run_warm_tasks(later, True)
+                _handshake()
+
             self.prewarm_background = threading.Thread(
-                target=self._run_warm_tasks, args=(later, True), daemon=True
+                target=_bg_warm, daemon=True
             )
             self.prewarm_background.start()
-        elif later:
-            self._run_warm_tasks(later)
+        else:
+            if later:
+                self._run_warm_tasks(later)
+            _handshake()
         return time.perf_counter() - t0
 
     def _run_warm_tasks(self, tasks, swallow: bool = False) -> None:
@@ -405,8 +423,10 @@ class Scheduler:
                 storm, fallback = [], []
 
                 def warm(where, fn, *a, **kw):
+                    # sanctioned startup sync: prewarm blocks on compile
+                    # completion by design, off the cycle path
                     where.append(
-                        lambda: jax.block_until_ready(fn(*a, **kw))
+                        lambda: jax.block_until_ready(fn(*a, **kw))  # vtlint: disable=device-sync-discipline
                     )
 
                 if "preempt" in self.conf.actions:
@@ -529,6 +549,10 @@ class Scheduler:
 
     def _run_once_inner(self) -> None:
         start = time.perf_counter()
+        if vtprof.PROFILER is not None:
+            # critical-path profiler cycle scope (armed-only; disarmed
+            # the cycle pays exactly this one attribute check)
+            vtprof.PROFILER.begin_cycle()
         if self.fast_cycle is not None:
             with trace.span("scheduler.cycle", path="fast") as cyc:
                 ran = self.fast_cycle.try_run()
@@ -564,6 +588,12 @@ class Scheduler:
                             cyc.annotate(link_error=repr(e))
             if ran:
                 metrics.update_e2e_duration(start)
+                if vtprof.PROFILER is not None:
+                    vtprof.PROFILER.end_cycle(
+                        time.perf_counter() - start,
+                        dict(self.fast_cycle.phases or {}), "fast",
+                        mirror=self.fast_cycle.mirror,
+                    )
                 if timeseries.RECORDER is not None:
                     self._record_cycle(start, "fast")
                 return
@@ -577,6 +607,9 @@ class Scheduler:
             self.cache.applier.flush(timeout=60.0)
         self.run_object_actions(self.conf.actions)
         metrics.update_e2e_duration(start)
+        if vtprof.PROFILER is not None:
+            vtprof.PROFILER.end_cycle(
+                time.perf_counter() - start, {}, "object")
         if timeseries.RECORDER is not None:
             self._record_cycle(start, "object")
 
@@ -606,6 +639,15 @@ class Scheduler:
         if applier is not None:
             # drain lag: decisions published but not yet written back
             fields["drain_pending"] = applier.pending
+        prof = vtprof.PROFILER
+        if prof is not None and prof.cycles:
+            # the device/host split of THIS cycle (end_cycle ran just
+            # before) — vtctl top's Dev(ms) column reads these
+            seg = prof.cycles[-1].get("seg") or {}
+            fields["host_s"] = seg.get("host", 0.0)
+            fields["device_s"] = round(
+                seg.get("dispatch", 0.0) + seg.get("wait", 0.0), 6)
+            fields["transfer_s"] = seg.get("transfer", 0.0)
         timeseries.record("cycle", **fields)
 
     def _open_object_session(self):

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from volcano_tpu import trace
+from volcano_tpu import trace, vtprof
 from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.scheduler.cache import VolumeBindingError
 
@@ -130,6 +130,9 @@ class _VictimDriver:
         else:
             from volcano_tpu.scheduler.victim_kernels import victim_step
 
+            prof = vtprof.PROFILER
+            tok = prof.dispatch_begin(victim_step) if prof is not None \
+                else None
             out_state, assigned, nstar, vmask, clean = victim_step(
                 self.consts,
                 self.state,
@@ -139,6 +142,15 @@ class _VictimDriver:
                 qt,
                 mode=mode,
                 **self.kw,
+            )
+            phase = "reclaim" if mode == "reclaim" else "preempt"
+            if tok is not None:
+                prof.dispatch_end(tok, "victim_step", phase=phase)
+            # ONE sanctioned per-attempt sync for the whole result tuple
+            # (the driver must branch host-side on clean/assigned)
+            assigned, nstar, vmask, clean = vtprof.device_get(
+                (assigned, nstar, vmask, clean),
+                kernel="victim_step", phase=phase,
             )
         if not bool(clean):
             return False, "", [], False
@@ -442,6 +454,13 @@ def allocate(ssn) -> None:
 _PACKED_SOLVES: dict = {}
 
 
+def _solve_kernel_name(solve) -> str:
+    """Stable kernel label for the profiler/compile sentinel: the solve
+    fn's name minus the module plumbing ("allocate_solve" /
+    "allocate_solve_batch")."""
+    return getattr(solve, "__name__", str(solve))
+
+
 def _packed_solve(solve, static_kw):
     key = (solve, tuple(sorted(static_kw.items())))
     fn = _PACKED_SOLVES.get(key)
@@ -457,6 +476,10 @@ def _packed_solve(solve, static_kw):
             ])
 
         fn = jax.jit(run)
+        # compile-sentinel registration: the packed wrapper is the jit
+        # entry the cycle actually dispatches, so ITS cache growth is
+        # what a steady-state recompile looks like
+        vtprof.register_jit(_solve_kernel_name(solve), fn)
         _PACKED_SOLVES[key] = fn
     return fn
 
@@ -496,6 +519,8 @@ def jax_allocate_solve(backend, snap, n_pending=None):
         use_proportion=backend.proportion_queue_order,
         **extra,
     ))
+    prof = vtprof.PROFILER
+    tok = prof.dispatch_begin(packed) if prof is not None else None
     out = packed(
         devn(snap.node_idle, "idle"),
         devn(snap.node_releasing, "releasing"),
@@ -525,10 +550,16 @@ def jax_allocate_solve(backend, snap, n_pending=None):
         jnp.float32(w_least),
         jnp.float32(w_balanced),
     )
+    kname = _solve_kernel_name(solve)
+    if tok is not None:
+        prof.dispatch_end(tok, kname, phase="solve")
     # device phase timed at the ONE block-until-ready boundary — never
-    # inside the jit body (the vtlint trace-span-discipline contract)
-    with trace.span("device.allocate_solve", batch=use_batch):
-        flat = np.asarray(out)  # ONE device->host fetch for all four outputs
+    # inside the jit body (the vtlint trace-span-discipline contract);
+    # vtprof.fetch IS that boundary: disarmed it is exactly np.asarray
+    # (ONE device->host fetch for all four outputs), armed it splits
+    # device-wait from transfer and annotates the span
+    with trace.span("device.allocate_solve", batch=use_batch) as sp:
+        flat = vtprof.fetch(out, kernel=kname, phase="solve", span=sp)
     T = snap.task_req.shape[0]
     J = snap.job_queue.shape[0]
     return (
@@ -630,6 +661,7 @@ def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
             ])
 
         packed = jax.jit(run)
+        vtprof.register_jit("dynamic_" + _solve_kernel_name(solve), packed)
         _PACKED_SOLVES[key] = packed
     vol_args = ()
     if has_vol:
@@ -639,6 +671,8 @@ def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
             dev(v["claim_group"]), dev(v["group_cap"]),
             dev(v["group_global"]),
         )
+    prof = vtprof.PROFILER
+    tok = prof.dispatch_begin(packed) if prof is not None else None
     out = packed(
         vol_args,
         dev(dyn["node_ports_w"]),
@@ -676,9 +710,12 @@ def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
         jnp.float32(w_least),
         jnp.float32(w_balanced),
     )
+    kname = "dynamic_" + _solve_kernel_name(solve)
+    if tok is not None:
+        prof.dispatch_end(tok, kname, phase="dyn_solve")
     # same block-until-ready boundary discipline as the express solve
-    with trace.span("device.dynamic_solve", batch=use_batch):
-        flat = np.asarray(out)
+    with trace.span("device.dynamic_solve", batch=use_batch) as sp:
+        flat = vtprof.fetch(out, kernel=kname, phase="dyn_solve", span=sp)
     T = dyn["task_req"].shape[0]
     J = snap.job_queue.shape[0]
     return (

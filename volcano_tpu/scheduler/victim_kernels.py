@@ -1286,3 +1286,14 @@ def preempt_rounds(
         final_s, out.pipe, final_rec, out.att_total, out.last_v,
         out.any_commit, out.cursor, out.dropped,
     )
+
+
+# -- vtprof compile-sentinel registration (see kernels.py tail): the
+# contention kernels are dispatched directly by fast_victims.py and the
+# tensor-path victim driver, so their caches ARE the dispatch caches.
+from volcano_tpu import vtprof as _vtprof  # noqa: E402
+
+_vtprof.register_jit("victim_step", victim_step)
+_vtprof.register_jit("reclaim_solve", reclaim_solve)
+_vtprof.register_jit("preempt_solve", preempt_solve)
+_vtprof.register_jit("preempt_rounds", preempt_rounds)

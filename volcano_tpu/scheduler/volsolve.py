@@ -52,9 +52,12 @@ wrong bind.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from volcano_tpu import vtprof
 
 #: distinct constraining claims the device payload can carry per cycle;
 #: overflow routes the overflowing jobs to the residue engine (the same
@@ -177,6 +180,11 @@ class VolumeCycleIndex:
             return info
         info = self._resolve(claim_key)
         self.claims[claim_key] = info
+        prof = vtprof.PROFILER
+        if prof is not None:
+            # claims interned this cycle — the volsolve share of the
+            # critical-path report's host breakdown
+            prof.count("volsolve.claims")
         return info
 
     def _resolve(self, claim_key: str) -> ClaimInfo:
@@ -411,6 +419,16 @@ class VolumePartition:
         len(rows) task slots).  ``N`` is the snapshot's bucketed node axis;
         masks/caps are built over the live prefix and padded.
         """
+        prof = vtprof.PROFILER
+        t0 = time.perf_counter() if prof is not None else 0.0
+        out = self._payload(rows, T, N)
+        if prof is not None:
+            # the packed-mask build is host compute inside the cycle's
+            # vol_solve phase; named so the report can break it out
+            prof.note_host("volsolve.payload", time.perf_counter() - t0)
+        return out
+
+    def _payload(self, rows: np.ndarray, T: int, N: int) -> Optional[dict]:
         relevant = [
             i for i, r in enumerate(rows)
             if self.task_volumes.get(int(r)) is not None

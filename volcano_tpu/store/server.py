@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
-from volcano_tpu import timeseries, trace
+from volcano_tpu import timeseries, trace, vtprof
 from volcano_tpu.chaos import ChaosPlanError, FaultPlan, env_plan, fire_crash
 from volcano_tpu.locksan import make_lock, make_rlock
 from volcano_tpu.store.codec import (
@@ -272,6 +272,10 @@ class StoreServer:
                     # per-cycle/per-flush time-series ring (vtctl top):
                     # chaos-exempt like /debug/trace
                     return self._reply(200, timeseries.debug_payload())
+                if u.path == "/debug/prof":
+                    # vtprof critical-path profile (vtctl profile):
+                    # chaos-exempt like /debug/trace
+                    return self._reply(200, vtprof.debug_payload())
                 chaos_plan = server.chaos
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
                     return

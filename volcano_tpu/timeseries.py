@@ -12,6 +12,10 @@ observability layer.  Each armed process keeps a bounded ring of samples:
 * ``kind="store"`` — recorded by the StoreServer at every state flush:
   event-log seq, buffered rows, WAL stats (records/fsyncs/fsync seconds)
   when the durable tier is armed.
+* ``kind="anomaly"`` — recorded by the vtprof sentinels (vtprof.py) when
+  both layers are armed: ``anomaly`` carries the trip class
+  (``steady-state-recompile``, ``device-bytes-leak``) plus the trip's
+  detail fields; ``vtctl top`` renders these as its anomaly line.
 
 Arming follows the chaos/trace discipline: **disarmed is the default and
 costs one module attribute check per site** (``RECORDER is None``);

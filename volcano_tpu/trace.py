@@ -453,8 +453,10 @@ def crash_dump(reason: str) -> Optional[str]:
     ``"timeseries"`` so the artifact carries the last N cycles of
     telemetry next to the spans.  Returns the path written, or None when
     disarmed/empty.  Never raises: forensics must not mask the original
-    failure."""
-    from volcano_tpu import timeseries
+    failure.  When the vtprof profiler is armed, its sentinel trips ride
+    under ``"anomalies"`` and its critical-path summary under
+    ``"profile"``."""
+    from volcano_tpu import timeseries, vtprof
 
     tr = TRACER
     if tr is None:
@@ -465,6 +467,10 @@ def crash_dump(reason: str) -> Optional[str]:
     extra = None
     if timeseries.RECORDER is not None:
         extra = {"timeseries": timeseries.RECORDER.samples()}
+    if vtprof.PROFILER is not None:
+        extra = dict(extra or {})
+        extra["anomalies"] = vtprof.PROFILER.anomalies_snapshot()
+        extra["profile"] = vtprof.PROFILER.summary()
     try:
         os.makedirs(directory, exist_ok=True)
         return tr.dump_to(path, reason, extra=extra)

@@ -1,0 +1,628 @@
+"""vtprof: the device/host critical-path profiler (observability layer 3).
+
+vtrace (trace.py) answers "what happened inside one trace", the vtload
+time series (timeseries.py) answers "what has the control plane been
+doing cycle over cycle"; this module answers the question every future
+perf PR starts from: **which side of the dispatch boundary does the time
+live on?**  The fast cycle's ``phases`` dict is wall-clock only — host
+Python, device compute, and tunnel transfer are indistinguishable in it —
+so vtprof splits every phase into four segments:
+
+* ``host``      — Python/numpy time (phase wall-clock minus the rest)
+* ``dispatch``  — submitting a jitted kernel (async: returns immediately)
+* ``wait``      — ``block_until_ready`` at a sanctioned fetch boundary
+                  (device compute the host actually waited on)
+* ``transfer``  — device→host copy of the solve outputs
+
+Instrumentation rides the two sanctioned fetch boundaries
+(:func:`fetch` in ``tensor_actions.jax_allocate_solve`` /
+``jax_dynamic_solve``) and the whole-pass fetches in ``fast_victims.py``
+(:func:`device_get`); the vtlint ``device-sync-discipline`` rule forbids
+stray syncs anywhere else in the fastpath-hot modules, so the
+attribution cannot be corrupted by a hidden ``block_until_ready``.
+
+**Jit recompile sentinel**: jitted kernels register themselves in
+:data:`_JIT_REGISTRY` (:func:`register_jit` — kernels.py,
+victim_kernels.py, and the packed solve wrappers in tensor_actions.py).
+Each armed cycle end scans their compile caches (``jax.jit``'s
+``_cache_size``); growth increments
+``volcano_jit_compiles_total{kernel=}``.  After the warmup handshake
+(``Scheduler.prewarm`` calls :meth:`Profiler.warmup_handshake`) the
+first compile-free cycle marks steady state, and any later compile is
+flagged as an **anomaly** — a time-series event, an entry in the
+``anomalies`` section of ``trace.crash_dump()``, and an anomaly line in
+``vtctl top`` — because shape-bucketing discipline is the contract the
+mesh-sharded deployment lives or dies by.
+
+**Memory watermarks**: per-cycle ``volcano_device_bytes{component=}``
+gauges for mirror / snapshot / solve-output array bytes and live device
+buffers, with a churn-bounded leak sentinel (trips once when the
+trailing-window device watermark grows past ``LEAK_RATIO`` × the
+baseline window plus ``LEAK_MIN_BYTES``).
+
+Arming follows the chaos/trace/timeseries discipline: **disarmed is the
+default and costs one module attribute check per site** (``PROFILER is
+None``); ``VOLCANO_TPU_PROF=1`` (or ``{"ring": N}``) arms at boot, tests
+arm in-process via :func:`arm`.  The profile is served at
+``/debug/prof`` on the Store and Metrics servers (chaos-exempt, like
+``/debug/trace``) and rendered by ``vtctl profile [--server URL]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+ENV_VAR = "VOLCANO_TPU_PROF"
+DEFAULT_RING = 512
+MAX_ANOMALIES = 256
+
+#: leak sentinel: compare the min device-bytes watermark of the trailing
+#: window against the baseline window; trip once when it grew past
+#: ratio × baseline AND by more than the absolute floor (churny loads
+#: legitimately wobble by a few buffers)
+LEAK_WINDOW = 16
+LEAK_RATIO = 1.5
+LEAK_MIN_BYTES = 16 << 20
+
+#: kernel name -> jitted callables answering ``_cache_size()`` — the
+#: compile-cache registry the recompile sentinel scans.  Maintained
+#: unconditionally (registration happens once per jit wrapper, never per
+#: cycle); scanning happens only while armed.
+_JIT_REGISTRY: Dict[str, List[Any]] = {}
+_registry_mu = threading.Lock()
+
+_SEGMENTS = ("host", "dispatch", "wait", "transfer")
+
+
+def register_jit(name: str, fn: Any) -> Any:
+    """Register a jitted callable under a kernel name for compile-cache
+    scanning; returns ``fn`` so call sites can register inline."""
+    with _registry_mu:
+        _JIT_REGISTRY.setdefault(name, []).append(fn)
+    return fn
+
+
+def _cache_size(fn: Any) -> int:
+    cs = getattr(fn, "_cache_size", None)
+    if cs is None:
+        return 0
+    try:
+        return int(cs())
+    except Exception:  # noqa: BLE001 — forensics must not crash the cycle
+        return 0
+
+
+def registry_cache_sizes() -> Dict[str, int]:
+    """Summed compile-cache size per registered kernel name."""
+    with _registry_mu:
+        items = [(k, list(v)) for k, v in _JIT_REGISTRY.items()]
+    return {k: sum(_cache_size(f) for f in fns) for k, fns in items}
+
+
+def array_bytes(obj: Any) -> int:
+    """Total nbytes of the numpy/jax arrays hanging off ``obj`` (its
+    attribute dict, or the mapping itself) — the watermark estimator for
+    mirror/snapshot objects.  Non-array attributes are ignored."""
+    if obj is None:
+        return 0
+    values = obj.values() if isinstance(obj, dict) else vars(obj).values()
+    total = 0
+    for v in values:
+        n = getattr(v, "nbytes", None)
+        if isinstance(n, int):
+            total += n
+    return total
+
+
+def _live_device_bytes() -> int:
+    """Bytes held by live device buffers (jax.live_arrays); 0 when jax
+    is unavailable."""
+    try:
+        import jax
+
+        return sum(int(a.nbytes) for a in jax.live_arrays())
+    except Exception:  # noqa: BLE001 — watermark is best-effort telemetry
+        return 0
+
+
+class Profiler:
+    """Per-process critical-path accumulator: a bounded ring of per-cycle
+    segment breakdowns, cumulative per-kernel totals, the compile
+    sentinel, and the memory watermarks."""
+
+    def __init__(self, ring: int = DEFAULT_RING):
+        self.ring_size = max(int(ring), 1)
+        self._mu = threading.Lock()
+        #: per-cycle records, oldest first
+        self.cycles: deque = deque(maxlen=self.ring_size)
+        #: kernel -> {dispatches, dispatch_s, wait_s, transfer_s, compiles}
+        self.totals: Dict[str, Dict[str, float]] = {}
+        self.anomalies: List[Dict[str, Any]] = []
+        self.compiles_total = 0
+        self._cache_seen: Dict[str, int] = {}
+        self._warmed = False
+        self.steady = False
+        self._cycle_n = 0
+        self._leak_tripped = False
+        #: ANCHORED baseline: min device bytes over the first full
+        #: window, captured once — a sliding baseline would let a slow
+        #: leak outrun the ring and never trip (ratio tends to 1 as the
+        #: footprint grows)
+        self._leak_baseline: Optional[int] = None
+        #: current-cycle accumulator; None outside a cycle (prewarm
+        #: threads still record — into totals only)
+        self._cur: Optional[Dict[str, Any]] = None
+
+    # -- dispatch / fetch instrumentation (called from the hot sites) ---------
+
+    def dispatch_begin(self, fn: Any):
+        """Armed-only site token; pair with :meth:`dispatch_end`."""
+        return (fn, time.perf_counter())
+
+    def dispatch_end(self, tok, kernel: str, phase: str = "") -> None:
+        self._note(kernel, phase,
+                   dispatch_s=time.perf_counter() - tok[1], dispatches=1)
+
+    def record_fetch(self, kernel: str, phase: str,
+                     wait_s: float, transfer_s: float) -> None:
+        self._note(kernel, phase, wait_s=wait_s, transfer_s=transfer_s)
+
+    def _note(self, kernel: str, phase: str, **incr) -> None:
+        with self._mu:
+            tot = self.totals.setdefault(kernel, {
+                "dispatches": 0, "dispatch_s": 0.0, "wait_s": 0.0,
+                "transfer_s": 0.0, "compiles": 0,
+            })
+            for k, v in incr.items():
+                tot[k] = tot.get(k, 0) + v
+            cur = self._cur
+            if cur is not None:
+                kc = cur["kernels"].setdefault(kernel, {
+                    "dispatches": 0, "dispatch_s": 0.0, "wait_s": 0.0,
+                    "transfer_s": 0.0,
+                })
+                for k, v in incr.items():
+                    kc[k] = kc.get(k, 0) + v
+                pd = cur["phase_dev"].setdefault(phase or "device", {
+                    "dispatch": 0.0, "wait": 0.0, "transfer": 0.0,
+                })
+                pd["dispatch"] += incr.get("dispatch_s", 0.0)
+                pd["wait"] += incr.get("wait_s", 0.0)
+                pd["transfer"] += incr.get("transfer_s", 0.0)
+
+    def note_host(self, name: str, seconds: float) -> None:
+        """A named host-side sub-segment (e.g. volsolve claim interning)
+        — rides the cycle record for the report's host breakdown."""
+        with self._mu:
+            cur = self._cur
+            if cur is not None:
+                cur["host_notes"][name] = (
+                    cur["host_notes"].get(name, 0.0) + seconds
+                )
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._mu:
+            cur = self._cur
+            if cur is not None:
+                cur["counts"][name] = cur["counts"].get(name, 0) + n
+
+    def note_bytes(self, component: str, nbytes: int) -> None:
+        with self._mu:
+            cur = self._cur
+            if cur is not None:
+                cur["bytes"][component] = int(nbytes)
+
+    # -- the compile sentinel -------------------------------------------------
+
+    def _scan_compiles_locked(self) -> Dict[str, int]:
+        sizes = registry_cache_sizes()
+        deltas: Dict[str, int] = {}
+        for name, size in sizes.items():
+            d = size - self._cache_seen.get(name, 0)
+            if d > 0:
+                deltas[name] = d
+            self._cache_seen[name] = size
+        return deltas
+
+    def warmup_handshake(self) -> None:
+        """End of warmup: compiles so far were expected (prewarm, first
+        dispatches).  The first compile-free cycle AFTER this marks
+        steady state; later compiles become anomalies."""
+        with self._mu:
+            deltas = self._scan_compiles_locked()
+            n = sum(deltas.values())
+            self.compiles_total += n
+            for k, d in deltas.items():
+                self.totals.setdefault(k, {
+                    "dispatches": 0, "dispatch_s": 0.0, "wait_s": 0.0,
+                    "transfer_s": 0.0, "compiles": 0,
+                })["compiles"] += d
+            self._warmed = True
+        self._emit_compile_metrics(deltas)
+
+    def _emit_compile_metrics(self, deltas: Dict[str, int]) -> None:
+        if not deltas:
+            return
+        from volcano_tpu.scheduler import metrics
+
+        for kernel, d in deltas.items():
+            metrics.register_jit_compile(kernel, d)
+
+    # -- cycle scope ----------------------------------------------------------
+
+    def begin_cycle(self) -> None:
+        with self._mu:
+            self._cur = {
+                "kernels": {}, "phase_dev": {}, "host_notes": {},
+                "counts": {}, "bytes": {},
+            }
+
+    def end_cycle(self, dur_s: float, phases: Dict[str, float],
+                  path: str, mirror: Any = None) -> None:
+        """Close the cycle scope: fold the site records into one per-cycle
+        segment breakdown, scan the compile caches, sample the memory
+        watermarks, and run the sentinels.  Armed-only (callers guard
+        with the single ``PROFILER is None`` check)."""
+        if mirror is not None:
+            self.note_bytes("mirror", array_bytes(mirror))
+        dev_bytes = _live_device_bytes()
+        with self._mu:
+            cur = self._cur or {
+                "kernels": {}, "phase_dev": {}, "host_notes": {},
+                "counts": {}, "bytes": {},
+            }
+            self._cur = None
+            deltas = self._scan_compiles_locked()
+            ncomp = sum(deltas.values())
+            self.compiles_total += ncomp
+            for k, d in deltas.items():
+                self.totals.setdefault(k, {
+                    "dispatches": 0, "dispatch_s": 0.0, "wait_s": 0.0,
+                    "transfer_s": 0.0, "compiles": 0,
+                })["compiles"] += d
+            cur["bytes"]["device"] = dev_bytes
+            per_phase = self._attribute_locked(dur_s, phases, cur)
+            seg = {s: 0.0 for s in _SEGMENTS}
+            for row in per_phase.values():
+                for s in _SEGMENTS:
+                    seg[s] += row[s]
+            rec = {
+                "cycle": self._cycle_n,
+                "path": path,
+                "dur_s": round(dur_s, 6),
+                "phases": {k: round(v, 6) for k, v in (phases or {}).items()},
+                "per_phase": per_phase,
+                "seg": {k: round(v, 6) for k, v in seg.items()},
+                "kernels": cur["kernels"],
+                "host_notes": {
+                    k: round(v, 6) for k, v in cur["host_notes"].items()
+                },
+                "counts": cur["counts"],
+                "bytes": cur["bytes"],
+                "compiles": deltas,
+            }
+            self._cycle_n += 1
+            self.cycles.append(rec)
+            anomalies_out = []
+            if self._warmed:
+                if ncomp == 0:
+                    self.steady = True
+                elif self.steady:
+                    anomalies_out.append({
+                        "kind": "steady-state-recompile",
+                        "cycle": rec["cycle"],
+                        "kernels": dict(deltas),
+                    })
+            leak = self._leak_check_locked()
+            if leak is not None:
+                anomalies_out.append(leak)
+            for a in anomalies_out:
+                if len(self.anomalies) < MAX_ANOMALIES:
+                    self.anomalies.append(a)
+        # emission happens OUTSIDE the lock: the metrics/timeseries layers
+        # take their own locks (lock-order hygiene)
+        self._emit_cycle_metrics(rec, deltas, anomalies_out)
+
+    def _attribute_locked(self, dur_s, phases, cur) -> Dict[str, Dict]:
+        """Per-phase host/dispatch/wait/transfer rows.  Device parts
+        recorded under a fastpath phase name live INSIDE that phase's
+        wall-clock; parts under any other label (object path, prewarm
+        stragglers) become their own pseudo-phase."""
+        per_phase: Dict[str, Dict[str, float]] = {}
+        phase_dev = cur["phase_dev"]
+        for name, total in (phases or {}).items():
+            dev = phase_dev.get(name, {})
+            d = dev.get("dispatch", 0.0)
+            w = dev.get("wait", 0.0)
+            t = dev.get("transfer", 0.0)
+            per_phase[name] = {
+                "total": total, "host": max(total - d - w - t, 0.0),
+                "dispatch": d, "wait": w, "transfer": t,
+            }
+        extra_dev = 0.0
+        for name, dev in phase_dev.items():
+            if name in per_phase:
+                continue
+            d, w, t = dev["dispatch"], dev["wait"], dev["transfer"]
+            per_phase[name] = {
+                "total": d + w + t, "host": 0.0,
+                "dispatch": d, "wait": w, "transfer": t,
+            }
+            extra_dev += d + w + t
+        if not phases:
+            # object-path cycle: no phase breakdown — everything outside
+            # the recorded device parts is host work
+            per_phase["cycle"] = {
+                "total": max(dur_s - extra_dev, 0.0),
+                "host": max(dur_s - extra_dev, 0.0),
+                "dispatch": 0.0, "wait": 0.0, "transfer": 0.0,
+            }
+        return {
+            name: {k: round(v, 6) for k, v in row.items()}
+            for name, row in per_phase.items()
+        }
+
+    def _leak_check_locked(self) -> Optional[Dict[str, Any]]:
+        if self._leak_tripped:
+            return None
+        if self._leak_baseline is None:
+            if len(self.cycles) < LEAK_WINDOW:
+                return None
+            series = [c["bytes"].get("device", 0) for c in self.cycles]
+            self._leak_baseline = min(series[:LEAK_WINDOW])
+        if len(self.cycles) < 2 * LEAK_WINDOW:
+            return None
+        baseline = self._leak_baseline
+        recent = min(c["bytes"].get("device", 0)
+                     for c in list(self.cycles)[-LEAK_WINDOW:])
+        if recent > baseline * LEAK_RATIO and \
+                recent - baseline > LEAK_MIN_BYTES:
+            self._leak_tripped = True
+            return {
+                "kind": "device-bytes-leak",
+                "cycle": self.cycles[-1]["cycle"],
+                "baseline_bytes": int(baseline),
+                "recent_bytes": int(recent),
+            }
+        return None
+
+    def _emit_cycle_metrics(self, rec, deltas, anomalies_out) -> None:
+        from volcano_tpu import timeseries
+        from volcano_tpu.scheduler import metrics
+
+        self._emit_compile_metrics(deltas)
+        for phase, row in rec["per_phase"].items():
+            for segment in _SEGMENTS:
+                if row[segment] > 0.0:
+                    metrics.observe_prof_segment(phase, segment, row[segment])
+        for kernel, kc in rec["kernels"].items():
+            if kc.get("dispatches"):
+                metrics.register_kernel_dispatch(kernel, kc["dispatches"])
+            dev = kc.get("wait_s", 0.0) + kc.get("transfer_s", 0.0)
+            if dev > 0.0:
+                metrics.observe_kernel_device_seconds(kernel, dev)
+        for component, n in rec["bytes"].items():
+            metrics.update_device_bytes(component, n)
+        for a in anomalies_out:
+            metrics.register_prof_anomaly(a["kind"])
+            # the sample's own kind stays "anomaly"; the trip class rides
+            # as the ``anomaly`` field (vtctl top's anomaly line)
+            timeseries.record("anomaly", anomaly=a["kind"], **{
+                k: v for k, v in a.items() if k != "kind"
+            })
+
+    # -- readout --------------------------------------------------------------
+
+    def anomalies_snapshot(self) -> List[Dict[str, Any]]:
+        with self._mu:
+            return list(self.anomalies)
+
+    def payload(self) -> Dict[str, Any]:
+        """The ``/debug/prof`` response body / report input."""
+        with self._mu:
+            return {
+                "armed": True,
+                "pid": os.getpid(),
+                "ring": self.ring_size,
+                "steady": self.steady,
+                "compiles_total": self.compiles_total,
+                "cycles": list(self.cycles),
+                "totals": {k: dict(v) for k, v in self.totals.items()},
+                "anomalies": list(self.anomalies),
+            }
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact form for crash-dump artifacts."""
+        with self._mu:
+            last = self.cycles[-1] if self.cycles else None
+            return {
+                "cycles": self._cycle_n,
+                "steady": self.steady,
+                "compiles_total": self.compiles_total,
+                "totals": {k: dict(v) for k, v in self.totals.items()},
+                "last_cycle": last,
+            }
+
+
+# -- attribution / report over a payload (shared local + remote) --------------
+
+
+def attribution(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Aggregate coverage over a payload's cycle ring: how much of the
+    sampled wall-clock lands in named host/device/transfer segments.
+    The acceptance bar: coverage >= 0.95 (no large unattributed
+    bucket)."""
+    wall = 0.0
+    attributed = 0.0
+    seg_totals = {s: 0.0 for s in _SEGMENTS}
+    phase_rows: Dict[str, Dict[str, float]] = {}
+    for cyc in payload.get("cycles", ()):
+        wall += cyc.get("dur_s", 0.0)
+        for name, row in cyc.get("per_phase", {}).items():
+            agg = phase_rows.setdefault(
+                name, {"total": 0.0, **{s: 0.0 for s in _SEGMENTS}}
+            )
+            agg["total"] += row["total"]
+            for s in _SEGMENTS:
+                agg[s] += row[s]
+                seg_totals[s] += row[s]
+            attributed += row["total"]
+    return {
+        "wall_s": wall,
+        "attributed_s": attributed,
+        "coverage": (attributed / wall) if wall > 0 else 1.0,
+        "segments": seg_totals,
+        "phases": phase_rows,
+    }
+
+
+def report_text(payload: Dict[str, Any], width: int = 28) -> str:
+    """Flame-style text report for ``vtctl profile``: per-phase bars
+    split into host/dispatch/wait/transfer, the per-kernel table, memory
+    watermarks, and the anomaly tail."""
+    if not payload.get("armed") or not payload.get("cycles"):
+        return ("no profile samples (arm the profiler with "
+                "VOLCANO_TPU_PROF=1)\n")
+    att = attribution(payload)
+    lines = [
+        f"vtprof: {len(payload['cycles'])} cycle(s) sampled "
+        f"(pid {payload.get('pid', '?')}), wall {att['wall_s']:.3f}s, "
+        f"attributed {att['coverage'] * 100:.1f}%"
+        + (" [steady]" if payload.get("steady") else ""),
+    ]
+    wall = max(att["wall_s"], 1e-9)
+    seg_mark = {"host": "H", "dispatch": "D", "wait": "W", "transfer": "T"}
+    for name, row in sorted(att["phases"].items(),
+                            key=lambda kv: -kv[1]["total"]):
+        bar = ""
+        for s in _SEGMENTS:
+            bar += seg_mark[s] * int(round(width * row[s] / wall))
+        lines.append(
+            f"  {name:<12} {row['total']:.4f}s "
+            f"|{bar:<{width}}| "
+            + " ".join(f"{s}={row[s]:.4f}" for s in _SEGMENTS if row[s] > 0)
+        )
+    unatt = att["wall_s"] - att["attributed_s"]
+    lines.append(f"  {'unattributed':<12} {max(unatt, 0.0):.4f}s")
+    totals = payload.get("totals", {})
+    if totals:
+        lines.append("kernels:")
+        for kernel, t in sorted(totals.items()):
+            lines.append(
+                f"  {kernel:<28} dispatches={int(t.get('dispatches', 0)):<6} "
+                f"compiles={int(t.get('compiles', 0)):<3} "
+                f"dispatch={t.get('dispatch_s', 0.0):.4f}s "
+                f"wait={t.get('wait_s', 0.0):.4f}s "
+                f"transfer={t.get('transfer_s', 0.0):.4f}s"
+            )
+    last = payload["cycles"][-1]
+    if last.get("bytes"):
+        lines.append("memory watermarks (last cycle): " + " ".join(
+            f"{k}={v / (1 << 20):.1f}MiB"
+            for k, v in sorted(last["bytes"].items())
+        ))
+    anomalies = payload.get("anomalies") or []
+    if anomalies:
+        lines.append(f"anomalies: {len(anomalies)}")
+        for a in anomalies[-5:]:
+            detail = " ".join(
+                f"{k}={v}" for k, v in sorted(a.items()) if k != "kind"
+            )
+            lines.append(f"  {a['kind']} {detail}")
+    else:
+        lines.append("anomalies: none")
+    return "\n".join(lines) + "\n"
+
+
+# -- arming ---------------------------------------------------------------
+
+
+def _profiler_from_env(raw: str) -> Optional[Profiler]:
+    raw = (raw or "").strip()
+    if not raw or raw in ("0", "off", "none"):
+        return None
+    if raw.startswith("{"):
+        try:
+            cfg = json.loads(raw)
+        except ValueError:
+            cfg = {}
+        return Profiler(ring=int(cfg.get("ring", DEFAULT_RING)))
+    return Profiler()
+
+
+#: the process profiler; None = disarmed, and every instrumentation site
+#: is a single ``vtprof.PROFILER is None`` attribute check (the
+#: faultpoint-style guard chaos/trace/timeseries established)
+PROFILER: Optional[Profiler] = _profiler_from_env(os.environ.get(ENV_VAR, ""))
+
+
+def arm(profiler: Optional[Profiler] = None) -> Profiler:
+    """Arm profiling in-process (tests, embedders); returns the
+    profiler."""
+    global PROFILER
+    PROFILER = profiler or Profiler()
+    return PROFILER
+
+
+def disarm() -> None:
+    global PROFILER
+    PROFILER = None
+
+
+# -- the sanctioned fetch boundaries ------------------------------------------
+
+
+def fetch(out: Any, kernel: str, phase: str = "", span: Any = None):
+    """THE sanctioned device→host fetch for a single packed solve output:
+    disarmed it is exactly ``np.asarray(out)``; armed it splits the
+    boundary into device-wait (``block_until_ready``) and transfer
+    (the host copy), attributes both to ``kernel``/``phase``, and
+    annotates the enclosing vtrace span when given."""
+    import numpy as np
+
+    prof = PROFILER
+    if prof is None:
+        return np.asarray(out)
+    t0 = time.perf_counter()
+    bur = getattr(out, "block_until_ready", None)
+    if bur is not None:
+        bur()
+    t1 = time.perf_counter()
+    arr = np.asarray(out)
+    t2 = time.perf_counter()
+    prof.record_fetch(kernel, phase, t1 - t0, t2 - t1)
+    if span is not None:
+        span.annotate(wait_s=round(t1 - t0, 6), transfer_s=round(t2 - t1, 6))
+    return arr
+
+
+def device_get(tree: Any, kernel: str, phase: str = ""):
+    """The sanctioned whole-pass fetch (``jax.device_get`` shape) used by
+    the contention kernels: disarmed it is exactly
+    ``jax.device_get(tree)``."""
+    import jax
+
+    prof = PROFILER
+    if prof is None:
+        return jax.device_get(tree)
+    t0 = time.perf_counter()
+    jax.block_until_ready(tree)
+    t1 = time.perf_counter()
+    out = jax.device_get(tree)
+    t2 = time.perf_counter()
+    prof.record_fetch(kernel, phase, t1 - t0, t2 - t1)
+    return out
+
+
+def debug_payload() -> Dict[str, Any]:
+    """The ``/debug/prof`` response body (store + metrics servers)."""
+    prof = PROFILER
+    if prof is None:
+        return {"armed": False, "pid": os.getpid(), "cycles": [],
+                "totals": {}, "anomalies": []}
+    return prof.payload()
