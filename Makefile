@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate
+.PHONY: test e2e parity bench bench-residue bench-wire bench-shard loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -108,6 +108,19 @@ perfgate:
 # lint rule
 bench-wire:
 	$(PY) bench.py --config 7
+
+# the mesh-sharded deployed cycle + partitioned store bus (ROADMAP item
+# 1, PR 11): the tier-1 smoke first proves 2-device-mesh placement
+# parity with the single-device run (sub-second, virtual CPU mesh),
+# then cfg9 runs 1M tasks x 100k nodes end-to-end — mesh from
+# VOLCANO_TPU_CFG9_MESH (auto), store shards from
+# VOLCANO_TPU_CFG9_SHARDS (4), vtprof armed (>=95% attribution bar),
+# plus the cfg7-shaped sharded-vs-single-shard drain comparison.
+# CPU containers: set VOLCANO_TPU_CFG9_SCALE (e.g. 0.01) to shrink.
+bench-shard:
+	$(PY) -m pytest tests/test_parallel.py -q \
+	  -k "shard_smoke or victim_step_mesh" -p no:cacheprovider
+	$(PY) bench.py --config 11
 
 # container images (reference Makefile:40-48 / installer/dockerfile/):
 # `image` = CPU-jax control plane, `image-tpu` = jax[tpu]+libtpu wheel

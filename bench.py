@@ -21,7 +21,12 @@ and that 60 s is the Go path's *solve alone*, not its end-to-end cycle).
 
 `--config N` runs one of the BASELINE configs (full methodology:
 best-of-3 for cfg5, storm + best-effort-storm lines for cfg6), `--all`
-runs all of them plus the kernel-only cycle (one JSON line each):
+runs all of them plus the kernel-only cycle (one JSON line each).
+`--config 11` is cfg9 (`make bench-shard`): the mesh-sharded deployed
+cycle against the partitioned store bus — 1M tasks × 100k nodes at full
+scale (VOLCANO_TPU_CFG9_SCALE shrinks it for CPU containers), vtprof
+armed (the ≥95% attribution bar), plus the cfg7-shaped sharded-vs-
+single-shard drain comparison line:
   1  gang+priority, allocate only (single queue, no fair share)
   2  drf+proportion multi-queue fair share
   3  predicates+nodeorder (per-class node masks + affinity scores)
@@ -654,19 +659,20 @@ def config5_dynamic(reps=3):
             metric="cfg5d_e2e_cycle_10pct_dynamic_predicates")
 
 
-def _apiserver_proc(q, state="", wal=False, save_interval=0.25):
+def _apiserver_proc(q, state="", wal=False, save_interval=0.25, shards=1):
     """Child-process entry: a StoreServer on a free port, url via queue.
     ``state``/``wal`` arm the durable tier (segment WAL, store/wal.py)
     for the WAL-on drain comparison; the comparison passes a long
     ``save_interval`` so it measures the ACK path's fsync overhead, not
     background snapshot serialization (the WAL alone already guarantees
-    zero acked loss — checkpoints only bound replay length)."""
+    zero acked loss — checkpoints only bound replay length).
+    ``shards`` arms the partitioned decision bus (store/partition.py)."""
     import time as _time
 
     from volcano_tpu.store.server import StoreServer
 
     srv = StoreServer(state_path=state or None, wal=wal,
-                      save_interval=save_interval).start()
+                      save_interval=save_interval, shards=shards).start()
     q.put(srv.url)
     while True:
         _time.sleep(3600)
@@ -923,9 +929,241 @@ def config8_open_loop(duration_s=8.0, qps=25.0, band_p99_ms=1000.0,
     }))
 
 
+# -- cfg9: mesh-sharded fast cycle + partitioned store bus --------------------
+#
+# ROADMAP item 1's headline: 1M pending tasks × 100k nodes END TO END —
+# watch mirror, array snapshot, mesh-sharded batched solve (conf
+# `mesh:`, parallel/sharded.py NamedShardings), columnar publish split
+# by namespace shard, partitioned StoreServer drain (per-shard apply
+# locks + per-shard WAL-ready watch logs; store/partition.py).  The
+# headline capture runs on a real device mesh (v5e); CI and the CPU
+# container scale down with VOLCANO_TPU_CFG9_SCALE (the same store
+# shape at fraction of the size — machinery proof, not a perf claim).
+# vtprof runs ARMED by design: the acceptance bar is ≥95% wall-clock
+# attribution of where the sharded cycle spends.
+
+N_NODES9 = 100_000
+N_TASKS9 = 1_000_000
+#: namespaces the cfg9 workload spreads over — the partitioned bus
+#: shards by namespace hash, so one-namespace workloads cannot scale
+CFG9_NAMESPACES = 16
+
+
+def _build_shard_e2e_store(n_nodes, n_tasks, tasks_per_job=20,
+                           n_namespaces=CFG9_NAMESPACES, n_queues=2):
+    """cfg5-shaped store at cfg9 scale, spread over namespaces so the
+    partitioned decision bus actually shards (store/partition.py hashes
+    the namespace)."""
+    from volcano_tpu.api import POD_GROUP_KEY, Resource
+    from volcano_tpu.api.objects import (
+        Metadata, Node, Pod, PodGroup, PodSpec, Queue,
+    )
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.store import Store
+
+    rng = np.random.default_rng(9)
+    n_jobs = max(n_tasks // tasks_per_job, 1)
+    node_cpu = rng.choice([16000, 32000], n_nodes)
+    node_mem = rng.choice([32, 64], n_nodes) * (1 << 30)
+    cpus = rng.choice([250, 500, 1000, 2000], n_tasks)
+    mems = rng.choice([256, 512, 1024, 2048], n_tasks) * (1 << 20)
+
+    store = Store()
+    for q in range(n_queues):
+        store.create("Queue", Queue(meta=Metadata(name=f"q{q}", namespace=""),
+                                    weight=n_queues - q))
+    store.create("Queue", Queue(meta=Metadata(name="default", namespace=""),
+                                weight=1))
+    for i in range(n_nodes):
+        store.create("Node", Node(
+            meta=Metadata(name=f"n{i:06d}", namespace=""),
+            allocatable=Resource(float(node_cpu[i]), float(node_mem[i]),
+                                 max_task_num=110)))
+    k = 0
+    for j in range(n_jobs):
+        ns = f"team{j % n_namespaces}"
+        pg = PodGroup(meta=Metadata(name=f"pg{j:06d}", namespace=ns),
+                      min_member=min(tasks_per_job, n_tasks - k),
+                      queue=f"q{j % n_queues}")
+        pg.status.phase = PodGroupPhase.PENDING
+        store.create("PodGroup", pg)
+        ann = {POD_GROUP_KEY: f"pg{j:06d}"}
+        for _t in range(min(tasks_per_job, n_tasks - k)):
+            store.create("Pod", Pod(
+                meta=Metadata(name=f"p{k:07d}", namespace=ns,
+                              annotations=dict(ann)),
+                spec=PodSpec(image="bench",
+                             resources=Resource(float(cpus[k]),
+                                                float(mems[k])))))
+            k += 1
+        if k >= n_tasks:
+            break
+    return store
+
+
+def _cfg9_run(n_nodes, n_tasks, shards, mesh_setting, prof=True):
+    """One end-to-end cfg9 pass: partitioned apiserver in its own OS
+    process, the store loaded over the wire, a mesh-conf'd Scheduler on
+    a RemoteStore, one timed cycle + off-cycle drain.  Returns plain
+    measurement data (the server dies on return)."""
+    import multiprocessing as mp
+
+    from volcano_tpu import vtprof
+    from volcano_tpu.scheduler.conf import full_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+    from volcano_tpu.store.client import RemoteStore
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    srv_proc = ctx.Process(target=_apiserver_proc,
+                           args=(q, "", False, 0.25, shards), daemon=True)
+    srv_proc.start()
+    try:
+        url = q.get(timeout=120)
+        remote = RemoteStore(url)
+        local = _build_shard_e2e_store(n_nodes, n_tasks)
+        t0 = time.perf_counter()
+        ops = []
+        for kind in ("Queue", "Node", "PodGroup", "Pod"):
+            for obj in local.items(kind):
+                ops.append({"op": "create", "kind": kind, "object": obj})
+        for i in range(0, len(ops), 4000):
+            errs = [e for e in remote.bulk(ops[i:i + 4000]) if e]
+            assert not errs, errs[:3]
+        load_s = time.perf_counter() - t0
+
+        conf = full_conf("tpu")
+        conf.apply_mode = "async"
+        conf.mesh = mesh_setting
+        sched = Scheduler(remote, conf=conf)
+        profiler = vtprof.arm() if prof else None
+        try:
+            warm = sched.prewarm()
+            t1 = time.perf_counter()
+            if sched.prewarm_background is not None:
+                sched.prewarm_background.join()
+            warm_bg = time.perf_counter() - t1
+            t0 = time.perf_counter()
+            sched.run_once()
+            publish = time.perf_counter() - t0
+            phases = _phases_of(sched)
+            while sched.cache.applier.pending > 0:
+                time.sleep(0.005)
+            drain = time.perf_counter() - t0 - publish
+            coverage = None
+            if profiler is not None:
+                att = vtprof.attribution(profiler.payload())
+                coverage = round(att["coverage"], 4)
+        finally:
+            if prof:
+                vtprof.disarm()
+        drain_kinds = dict(sched.cache.applier.drain_stats)
+        bound = sum(1 for p in remote.items("Pod") if p.node_name)
+        mesh_devices = (
+            sched.mesh.devices.size if sched.mesh is not None else 1
+        )
+        return {
+            "publish": publish, "drain": drain, "phases": phases,
+            "drain_kinds": drain_kinds, "bound": bound, "load_s": load_s,
+            "warm": warm, "warm_bg": warm_bg, "coverage": coverage,
+            "mesh_devices": mesh_devices, "shards": shards,
+            "fastpath": bool(sched.fast_cycle
+                             and sched.fast_cycle.mirror is not None),
+        }
+    finally:
+        srv_proc.terminate()
+        srv_proc.join(timeout=5)
+
+
+def config9_shard(scale=None):
+    """cfg9: the mesh-sharded deployed cycle against the partitioned
+    store bus — 1M × 100k at full scale (VOLCANO_TPU_CFG9_SCALE shrinks
+    it for CPU containers/CI), mesh from VOLCANO_TPU_CFG9_MESH (default
+    `auto`), shard count from VOLCANO_TPU_CFG9_SHARDS (default 4).  Two
+    lines: the headline cycle, and the cfg7-shaped sharded-vs-single
+    drain comparison (the partitioning claim, isolated)."""
+    import jax
+
+    if scale is None:
+        scale = float(os.environ.get("VOLCANO_TPU_CFG9_SCALE", "1.0"))
+    shards = int(os.environ.get("VOLCANO_TPU_CFG9_SHARDS", "4"))
+    mesh_setting = os.environ.get("VOLCANO_TPU_CFG9_MESH", "auto")
+    n_nodes = max(int(N_NODES9 * scale), 64)
+    n_tasks = max(int(N_TASKS9 * scale), 640)
+
+    run = _cfg9_run(n_nodes, n_tasks, shards, mesh_setting)
+    shard_attr = {
+        k: round(v, 3)
+        for k, v in sorted(run["drain_kinds"].items())
+        if k.startswith("shard")
+    }
+    _print_json({
+        "metric": "cfg9_mesh_sharded_1m_x_100k",
+        "value": round(run["publish"], 4),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "n_tasks": n_tasks, "n_nodes": n_nodes, "scale": scale,
+            "mesh": mesh_setting, "mesh_devices": run["mesh_devices"],
+            "store_shards": shards,
+            "pods_bound": run["bound"],
+            "pods_per_sec": int(run["bound"] / max(run["publish"], 1e-9)),
+            "phases_s": run["phases"],
+            "async_drain_s": round(run["drain"], 2),
+            "drain_shards_s": shard_attr,
+            "drain_wire_s": round(
+                run["drain_kinds"].get("wire_s", 0.0), 3),
+            "prof_attribution": run["coverage"],
+            "prewarm_s": round(run["warm"], 1),
+            "prewarm_bg_s": round(run["warm_bg"], 1),
+            "store_load_s": round(run["load_s"], 1),
+            "path": "fastpath" if run["fastpath"] else "object",
+            "namespaces": CFG9_NAMESPACES,
+            "device": str(jax.devices()[0]),
+        },
+    })
+
+    # the partitioning claim isolated: the SAME cfg7-shaped workload's
+    # off-cycle drain against >=4 shards vs one shard — the sharded
+    # drain must measurably beat the single-shard reading (per-shard
+    # attribution shows where each shard's ship spent).  Own scale knob:
+    # the win comes from pipelining client encode against server
+    # decode/apply across shards, which needs a drain big enough to
+    # pipeline — sub-second toy drains pay the split overhead instead,
+    # so CI smokes keep cfg9b at the shape the claim is about.
+    cmp_scale = float(os.environ.get("VOLCANO_TPU_CFG9B_SCALE", str(scale)))
+    cmp_nodes = max(int(N_NODES * cmp_scale), 64)
+    cmp_tasks = max(int(N_TASKS * cmp_scale), 640)
+    sharded = _cfg9_run(cmp_nodes, cmp_tasks, shards, "off", prof=False)
+    single = _cfg9_run(cmp_nodes, cmp_tasks, 1, "off", prof=False)
+    _print_json({
+        "metric": "cfg9b_sharded_drain_vs_single_shard",
+        "value": round(sharded["drain"], 4),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "n_tasks": cmp_tasks, "n_nodes": cmp_nodes,
+            "store_shards": shards,
+            "single_shard_drain_s": round(single["drain"], 4),
+            "ratio": round(
+                sharded["drain"] / max(single["drain"], 1e-9), 3),
+            "drain_shards_s": {
+                k: round(v, 3)
+                for k, v in sorted(sharded["drain_kinds"].items())
+                if k.startswith("shard")
+            },
+            "sharded_wire_s": round(
+                sharded["drain_kinds"].get("wire_s", 0.0), 3),
+            "single_wire_s": round(
+                single["drain_kinds"].get("wire_s", 0.0), 3),
+            "device": str(jax.devices()[0]),
+        },
+    })
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes,
-           10: config8_open_loop}
+           10: config8_open_loop, 11: config9_shard}
 
 
 # -- bench trajectory + continuous perf-regression gate (vtprof PR) -----------
@@ -945,6 +1183,7 @@ GATED_METRICS = (
     "e2e_schedule_cycle_100k_tasks_10k_nodes",
     "e2e_http_schedule_cycle_100k_tasks_10k_nodes",
     "cfg8_open_loop_first_seen_to_bind",
+    "cfg9_mesh_sharded_1m_x_100k",
 )
 #: band slack over the best same-device trajectory reading: headline
 #: values breathe ±15% run-to-run on the tunnel (BASELINE.md), phases
@@ -981,15 +1220,21 @@ def _payloads_from_doc(doc):
 
 
 def load_bench_rounds(directory="."):
-    """[(round_number, {metric: payload})] from BENCH_r*.json, ascending;
-    within one round the last occurrence of a metric wins (the driver
-    tail repeats headline lines across sweeps)."""
+    """[(round_number, {metric: payload})] from BENCH_r*.json AND
+    MULTICHIP_r*.json, ascending; captures for the same round merge
+    (BENCH wins ties — MULTICHIP rounds carry the mesh/cfg9 lines),
+    and within one file the last occurrence of a metric wins (the
+    driver tail repeats headline lines across sweeps)."""
     import glob
     import re
 
-    rounds = []
-    for path in sorted(glob.glob(os.path.join(directory, "BENCH_r*.json"))):
-        m = re.search(r"BENCH_r0*(\d+)\.json$", os.path.basename(path))
+    by_round = {}
+    # MULTICHIP first so a same-round BENCH reading overrides on ties
+    paths = sorted(glob.glob(os.path.join(directory, "MULTICHIP_r*.json")))
+    paths += sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")))
+    for path in paths:
+        m = re.search(r"(?:BENCH|MULTICHIP)_r0*(\d+)\.json$",
+                      os.path.basename(path))
         if not m:
             continue
         try:
@@ -1002,9 +1247,8 @@ def load_bench_rounds(directory="."):
             if payload.get("value") is not None:
                 metrics[payload["metric"]] = payload
         if metrics:
-            rounds.append((int(m.group(1)), metrics))
-    rounds.sort()
-    return rounds
+            by_round.setdefault(int(m.group(1)), {}).update(metrics)
+    return sorted(by_round.items())
 
 
 def build_trajectory(rounds):
@@ -1259,6 +1503,7 @@ CONFIG_METRIC = {
     7: "e2e_http_schedule_cycle_100k_tasks_10k_nodes",
     8: "cfg8_open_loop_first_seen_to_bind",
     10: "cfg8_open_loop_first_seen_to_bind",
+    11: "cfg9_mesh_sharded_1m_x_100k",
 }
 
 
@@ -1305,6 +1550,7 @@ def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
             7: config7,
             8: lambda: config8_open_loop(duration_s=5.0, max_doublings=1),
             10: lambda: config8_open_loop(duration_s=5.0, max_doublings=1),
+            11: config9_shard,
         }
     for n in configs:
         fn = runners.get(n)
@@ -1382,8 +1628,10 @@ def main():
                             "trajectory table")
     ap.add_argument("--configs", default="5,7,8",
                     help="--check: comma-separated gated configs "
-                         "(5,7,8; default all three — configs without a "
-                         "same-device band are skipped)")
+                         "(5,7,8,11; default 5,7,8 — configs without a "
+                         "same-device band are skipped; 11 = cfg9 "
+                         "mesh+partitioned-store, scaled by "
+                         "VOLCANO_TPU_CFG9_SCALE)")
     ap.add_argument("--bands", default="",
                     help="--check: explicit band JSON file instead of "
                          "the trajectory-derived defaults")

@@ -1,0 +1,507 @@
+"""Partitioned store bus (store/partition.py + StoreServer shards=N).
+
+The gate for ROADMAP item 1's store half:
+
+  * the shard hash is stable and the segment split is a partition
+    (row union preserved, within-shard order preserved, node tables
+    re-interned per shard);
+  * a partitioned server fed the SAME sub-segment sequence as a
+    single-shard server produces a BYTE-IDENTICAL merged watch stream
+    (frozen uid/clock — the PR-6 proof pattern), and each
+    ``/watch?shard=i`` slice is exactly the merged stream filtered to
+    that shard's namespaces;
+  * the async applier splits a cycle's segment by namespace shard,
+    ships the sub-segments concurrently, and the store converges to
+    the unsplit outcome with per-shard drain attribution;
+  * the PR-7 zero-acked-loss gate holds on the partitioned WAL: kill a
+    ``shards=4`` server with acked sub-segments in four WAL files,
+    reboot, and every ACKed mutation is back bit-for-bit (the merged
+    per-shard replay); a WAL-off boot absorbs a partitioned life's
+    leftover tails.
+"""
+
+import json
+import time
+
+import pytest
+
+from volcano_tpu.api import objects as api_objects
+from volcano_tpu.api.objects import Metadata, Queue
+from volcano_tpu.scheduler.cache import SchedulerCache
+from volcano_tpu.store.client import RemoteStore
+from volcano_tpu.store.partition import (
+    ShardedWAL,
+    leftover_shard_dirs,
+    shard_of,
+    shard_of_key,
+    split_segment,
+    wal_shard,
+)
+from volcano_tpu.store.segment import DecisionSegment
+from volcano_tpu.store.server import StoreServer
+
+from tests.helpers import build_pod
+
+NSHARDS = 4
+
+#: namespaces spread across every shard (asserted below)
+_NAMESPACES = [f"team{i}" for i in range(8)]
+
+
+def _seed_pods(create, n, namespaces=_NAMESPACES, nodes=("n0", "n1")):
+    for i in range(n):
+        create("Pod", build_pod(f"p{i}", namespace=namespaces[i % len(namespaces)]))
+
+
+def _mixed_segment(n=24, n_evict=4):
+    """One cycle-shaped segment whose rows span every shard."""
+    bind_keys, bind_nodes, table = [], [], ["n0", "n1", "n2"]
+    for i in range(n):
+        bind_keys.append(f"{_NAMESPACES[i % len(_NAMESPACES)]}/p{i}")
+        bind_nodes.append(i % len(table))
+    evicts = [
+        (f"{_NAMESPACES[i % len(_NAMESPACES)]}/p{n + i}", "preempt")
+        for i in range(n_evict)
+    ]
+    return DecisionSegment.build(bind_keys, bind_nodes, table, evicts)
+
+
+# -- the hash + split --------------------------------------------------------
+
+
+def test_shard_of_is_stable_and_covers_all_shards():
+    # crc32 is process-independent: pin a few values so a hash change
+    # (which would orphan per-shard WAL/watch streams) fails loudly
+    assert shard_of("team0", 4) == shard_of("team0", 4)
+    assert shard_of_key("team0/p1", 4) == shard_of("team0", 4)
+    assert shard_of_key("/cluster-scoped", 4) == shard_of("", 4)
+    assert shard_of("anything", 1) == 0
+    seen = {shard_of(ns, NSHARDS) for ns in _NAMESPACES}
+    assert seen == set(range(NSHARDS)), (
+        "test namespaces must cover every shard; adjust _NAMESPACES"
+    )
+
+
+def test_split_segment_is_a_partition_preserving_order():
+    seg = _mixed_segment(n=24, n_evict=4)
+    subs = split_segment(seg, NSHARDS)
+    assert {s for s, _ in subs} <= set(range(NSHARDS))
+    # union of rows == original rows; within-shard order preserved
+    all_binds = []
+    all_evicts = []
+    for shard, sub in subs:
+        for k in sub.bind_keys:
+            assert shard_of_key(k, NSHARDS) == shard
+        for k in sub.evict_keys:
+            assert shard_of_key(k, NSHARDS) == shard
+        # node table re-interned per shard: only referenced hosts
+        assert set(sub.node_table) == set(sub.bind_hosts)
+        all_binds.extend(zip(sub.bind_keys, sub.bind_hosts))
+        all_evicts.extend(sub.evict_pairs())
+        # each sub-segment reserved its OWN event uid block
+        assert len(sub.bind_keys) + len(sub.evict_keys) >= 1
+    assert sorted(all_binds) == sorted(zip(seg.bind_keys, seg.bind_hosts))
+    assert sorted(all_evicts) == sorted(seg.evict_pairs())
+    orig_order = {k: i for i, k in enumerate(seg.bind_keys)}
+    for _, sub in subs:
+        idxs = [orig_order[k] for k in sub.bind_keys]
+        assert idxs == sorted(idxs)
+    # splitting on one shard is the identity
+    assert split_segment(seg, 1) == [(0, seg)]
+
+
+def test_wal_shard_routes_every_record_shape():
+    assert wal_shard({"op": "segment", "shard": 3}, 4) == 3
+    assert wal_shard({"op": "patch", "kind": "Pod", "key": "team0/p0"}, 4) \
+        == shard_of("team0", 4)
+    assert wal_shard(
+        {"op": "patch_col", "kind": "Pod", "keys": ["team1/p0", "team1/p1"]},
+        4,
+    ) == shard_of("team1", 4)
+    assert wal_shard(
+        {"op": "create", "kind": "Pod",
+         "object": {"meta": {"namespace": "team2", "name": "x"}}}, 4
+    ) == shard_of("team2", 4)
+    assert wal_shard({"op": "delete", "kind": "Node", "key": "/n0"}, 1) == 0
+
+
+# -- watch-stream byte identity vs the single-shard server -------------------
+
+
+def _run_stream(monkeypatch, shards):
+    """Apply the SAME deterministic sub-segment sequence (frozen uid
+    counter + clock) and return (server, merged watch events)."""
+    monkeypatch.setattr(api_objects, "_uid_token", "t0")
+    monkeypatch.setattr(api_objects, "_uid_next", 1000)
+    monkeypatch.setattr(time, "time", lambda: 1234.5)
+    srv = StoreServer(shards=shards).start()
+    _seed_pods(srv.store.create, 32)
+    with srv.lock:
+        srv._pump_log()  # seed events drain with deterministic seqs
+    seg = _mixed_segment(n=24, n_evict=4)
+    for shard, sub in split_segment(seg, NSHARDS):
+        # sequential, in shard order: both servers see the identical op
+        # sequence, so seq/rv assignment matches exactly
+        res = srv._apply_segment(dict(sub.to_wire(), shard=shard))
+        assert not res["binds"] and not res["evicts"]
+    return srv, srv.watch_since(0, set(), 0)["events"]
+
+
+def test_partitioned_watch_stream_byte_identical_to_single_shard(monkeypatch):
+    srv1, stream1 = _run_stream(monkeypatch, shards=1)
+    srvN, streamN = _run_stream(monkeypatch, shards=NSHARDS)
+    try:
+        assert json.dumps(streamN) == json.dumps(stream1)
+        # per-shard fan-out: each shard's slice is exactly the merged
+        # stream filtered to that shard's namespaces, order preserved
+        covered = 0
+
+        def shard_of_event(e):
+            # a segment-born Event is cluster-scoped (namespace "") but
+            # belongs to its segment's shard — the involved pod's
+            # namespace; everything else shards by its own namespace
+            if e["kind"] == "Event":
+                return shard_of_key(e["object"]["involved"][1], NSHARDS)
+            return shard_of(e["object"]["meta"].get("namespace") or "",
+                            NSHARDS)
+
+        for s in range(NSHARDS):
+            slice_s = srvN.watch_since(0, set(), 0, shard=s)["events"]
+            expect = [e for e in stream1 if shard_of_event(e) == s]
+            assert json.dumps(slice_s) == json.dumps(expect), f"shard {s}"
+            covered += len(slice_s)
+        assert covered == len(stream1)  # the slices partition the stream
+    finally:
+        srv1.stop()
+        srvN.stop()
+
+
+def test_shard_scoped_remote_watcher_sees_only_its_namespaces(monkeypatch):
+    srv = StoreServer(shards=NSHARDS).start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 8)
+        target = shard_of("team0", NSHARDS)
+        watcher = RemoteStore(srv.url, shard=target)
+        q = watcher.watch("Pod")
+        seg = _mixed_segment(n=8, n_evict=0)
+        for shard, sub in split_segment(seg, NSHARDS):
+            rs.apply_segment(sub, shard=shard)
+        watcher.poll()
+        got = []
+        while q:
+            got.append(q.popleft())
+        assert got, "shard watcher saw nothing"
+        assert all(
+            shard_of(e.obj.meta.namespace, NSHARDS) == target for e in got
+        )
+        expect = sum(
+            1 for k in seg.bind_keys
+            if shard_of_key(k, NSHARDS) == target
+        )
+        assert len(got) == expect
+    finally:
+        srv.stop()
+
+
+# -- the applier's concurrent split-ship -------------------------------------
+
+
+def test_applier_splits_and_ships_concurrently_with_attribution():
+    srv = StoreServer(shards=NSHARDS).start()
+    try:
+        rs = RemoteStore(srv.url)
+        rs.create("Queue", Queue(meta=Metadata(name="default", namespace="")))
+        _seed_pods(rs.create, 32)
+        assert rs.segment_shards == NSHARDS
+        cache = SchedulerCache(rs, async_apply=True)
+        seg = _mixed_segment(n=24, n_evict=4)
+        try:
+            assert cache.publish_segment(seg)
+            assert cache.applier.flush(timeout=30.0)
+            assert cache.err_log == []
+        finally:
+            cache.applier.stop(flush=False)
+        # every bind landed, exactly the unsplit outcome
+        for i, key in enumerate(seg.bind_keys):
+            assert rs.get("Pod", key).node_name == seg.bind_hosts[i]
+        for key in seg.evict_keys:
+            assert rs.get("Pod", key).deleting is True
+        # one Scheduled/Evict event per row, across all sub-blocks
+        evs = rs.list("Event")
+        assert len(evs) == len(seg.bind_keys) + len(seg.evict_keys)
+        # per-shard drain attribution rode the stats dict
+        stats = cache.applier.drain_stats
+        shard_keys = [k for k in stats if k.startswith("shard")]
+        assert shard_keys, stats
+        assert {f"shard{s:02d}_s"
+                for s, _ in split_segment(seg, NSHARDS)} == set(shard_keys)
+    finally:
+        srv.stop()
+
+
+def test_unsharded_server_keeps_single_segment_path():
+    """A shards=1 server advertises 1 and the applier ships ONE segment
+    — no shardNN attribution keys, the pre-partition wire exactly."""
+    srv = StoreServer().start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 8)
+        assert rs.segment_shards == 1
+        cache = SchedulerCache(rs, async_apply=True)
+        seg = _mixed_segment(n=8, n_evict=0)
+        try:
+            assert cache.publish_segment(seg)
+            assert cache.applier.flush(timeout=30.0)
+            assert cache.err_log == []
+            assert not any(k.startswith("shard")
+                           for k in cache.applier.drain_stats)
+        finally:
+            cache.applier.stop(flush=False)
+    finally:
+        srv.stop()
+
+
+# -- the PR-7 zero-acked-loss gate on the partitioned WAL --------------------
+
+
+def _boot(tmp_path, shards, port=0):
+    return StoreServer(
+        state_path=str(tmp_path / "state.json"), wal=True, shards=shards,
+        save_interval=3600, port=port,
+    ).start()
+
+
+def test_partitioned_wal_zero_acked_loss_after_kill(tmp_path):
+    """Acked sub-segments in FOUR shard WALs; SIGKILL-shaped death; the
+    reboot merges the shard tails by seq and recovers every ACKed
+    mutation bit-for-bit — the PR-7 gate, partitioned."""
+    srv = _boot(tmp_path, NSHARDS)
+    rs = RemoteStore(srv.url)
+    _seed_pods(rs.create, 32)
+    seg = _mixed_segment(n=24, n_evict=4)
+    subs = split_segment(seg, NSHARDS)
+    for shard, sub in subs:
+        res = rs.apply_segment(sub, shard=shard)
+        assert not res["binds"] and not res["evicts"]
+    # per-shard WAL files really exist and each got its shard's record
+    wal_dir = str(tmp_path / "state.json.wal")
+    assert len(leftover_shard_dirs(wal_dir)) == NSHARDS
+    stats = srv.wal.stats()
+    assert stats["shards"] == NSHARDS
+    per_shard_records = [p["records"] for p in stats["per_shard"]]
+    for shard, _ in subs:
+        assert per_shard_records[shard] >= 1
+    acked = {p.meta.key: (p.node_name, p.deleting, p.meta.resource_version)
+             for p in rs.list("Pod")}
+    acked_events = {e.meta.name for e in rs.list("Event")}
+    seq, rv = srv.seq, srv.store._rv
+    srv.kill()
+
+    srv2 = _boot(tmp_path, NSHARDS, port=srv.port)
+    try:
+        rs2 = RemoteStore(srv2.url)
+        after = {p.meta.key: (p.node_name, p.deleting,
+                              p.meta.resource_version)
+                 for p in rs2.list("Pod")}
+        assert after == acked
+        assert {e.meta.name for e in rs2.list("Event")} == acked_events
+        assert srv2.seq == seq and srv2.store._rv == rv
+    finally:
+        srv2.stop()
+
+
+def test_partitioned_wal_checkpoint_carries_per_shard_floors(tmp_path):
+    srv = _boot(tmp_path, NSHARDS)
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 8)
+        for shard, sub in split_segment(_mixed_segment(n=8, n_evict=0),
+                                        NSHARDS):
+            rs.apply_segment(sub, shard=shard)
+        srv.flush_state(force=True)
+        with open(tmp_path / "state.json") as f:
+            data = json.load(f)
+        floors = data["wal_floor"]
+        assert isinstance(floors, list) and len(floors) == NSHARDS
+        assert all(isinstance(f, int) and f >= 2 for f in floors)
+    finally:
+        srv.stop()
+
+
+def test_partitioned_crash_kill_storm_keeps_gate_green(tmp_path):
+    """Seeded kill storm against the partitioned WAL store: repeated
+    kill+reboot cycles with acked decision traffic in between — every
+    reboot recovers exactly the acked state (no loss, no resurrection),
+    the PR-7 storm shape on the sharded bus."""
+    port = 0
+    expect = {}
+    srv = _boot(tmp_path, NSHARDS)
+    port = srv.port
+    rs = RemoteStore(srv.url)
+    _seed_pods(rs.create, 40, namespaces=_NAMESPACES)
+    for p in rs.list("Pod"):
+        expect[p.meta.key] = ""
+    for round_ in range(3):
+        seg = DecisionSegment.build(
+            [f"{_NAMESPACES[(round_ * 5 + i) % len(_NAMESPACES)]}"
+             f"/p{(round_ * 5 + i) % 40}" for i in range(5)],
+            [0] * 5, [f"n{round_}"],
+        )
+        for shard, sub in split_segment(seg, NSHARDS):
+            res = rs.apply_segment(sub, shard=shard)
+            assert not res["binds"]
+        for k, h in zip(seg.bind_keys, seg.bind_hosts):
+            expect[k] = h
+        srv.kill()
+        srv = _boot(tmp_path, NSHARDS, port=port)
+        rs = RemoteStore(srv.url)
+        got = {p.meta.key: p.node_name for p in rs.list("Pod")}
+        assert got == expect, f"round {round_}"
+    srv.stop()
+
+
+def test_wal_off_boot_absorbs_partitioned_leftover_tail(tmp_path):
+    """Dropping from a partitioned WAL-on life to a WAL-off boot must
+    absorb every shard's acked tail (merged by seq), snapshot it, and
+    retire the shard segments — the PR-7 lineage rule, sharded."""
+    srv = _boot(tmp_path, NSHARDS)
+    rs = RemoteStore(srv.url)
+    _seed_pods(rs.create, 16)
+    seg = _mixed_segment(n=12, n_evict=0)
+    for shard, sub in split_segment(seg, NSHARDS):
+        rs.apply_segment(sub, shard=shard)
+    acked = {p.meta.key: p.node_name for p in rs.list("Pod")}
+    srv.kill()
+
+    srv2 = StoreServer(state_path=str(tmp_path / "state.json"),
+                       save_interval=3600, port=srv.port).start()
+    try:
+        rs2 = RemoteStore(srv2.url)
+        assert {p.meta.key: p.node_name
+                for p in rs2.list("Pod")} == acked
+        # shard tails retired after absorption
+        wal_dir = str(tmp_path / "state.json.wal")
+        import os
+
+        for d in leftover_shard_dirs(wal_dir):
+            assert [n for n in os.listdir(d) if n.endswith(".wal")] == []
+    finally:
+        srv2.stop()
+
+
+def test_sharded_wal_independent_group_commit(tmp_path):
+    """Each shard has its own fsync leader: records appended to two
+    shards fsync through two independent commits, and a shard with no
+    pending appends never fsyncs at all."""
+    wal = ShardedWAL(str(tmp_path / "w"), 4)
+    wal.append({"op": "patch", "kind": "Pod", "key": "team0/p0",
+                "fields": {}, "seq": 1})
+    wal.append({"op": "patch", "kind": "Pod", "key": "team1/p0",
+                "fields": {}, "seq": 2})
+    wal.commit()
+    stats = wal.stats()
+    assert stats["records"] == 2
+    touched = [p for p in stats["per_shard"] if p["records"]]
+    assert len(touched) == 2
+    assert all(p["fsync_total"] == 1 for p in touched)
+    untouched = [p for p in stats["per_shard"] if not p["records"]]
+    assert all(p["fsync_total"] == 0 for p in untouched)
+    # replay merges across shards in seq order
+    wal.sync_close()
+    wal2 = ShardedWAL(str(tmp_path / "w"), 4)
+    seqs = [rec["seq"] for rec in wal2.replay([0, 0, 0, 0])]
+    assert seqs == [1, 2]
+    wal2.sync_close()
+
+
+# -- review hardening (PR 11 code review) ------------------------------------
+
+
+@pytest.mark.parametrize("old_shards,new_shards", [(4, 1), (1, 4), (4, 2)])
+def test_shard_count_change_across_kill_keeps_acked_records(
+    tmp_path, old_shards, new_shards
+):
+    """The zero-acked-loss contract survives an operator re-partitioning
+    the bus across a crash: records fsynced under one shard layout must
+    replay on a boot with ANY other layout (orphaned-layout tails are
+    absorbed seq-merged, snapshotted, and retired)."""
+    srv = _boot(tmp_path, old_shards)
+    rs = RemoteStore(srv.url)
+    _seed_pods(rs.create, 16)
+    seg = _mixed_segment(n=12, n_evict=0)
+    for shard, sub in split_segment(seg, old_shards):
+        res = rs.apply_segment(sub, shard=shard)
+        assert not res["binds"]
+    acked = {p.meta.key: p.node_name for p in rs.list("Pod")}
+    srv.kill()
+
+    srv2 = _boot(tmp_path, new_shards, port=srv.port)
+    try:
+        rs2 = RemoteStore(srv2.url)
+        after = {p.meta.key: p.node_name for p in rs2.list("Pod")}
+        assert after == acked, f"{old_shards}->{new_shards} lost acked state"
+        # kill AGAIN without new traffic: the absorbed tail must have
+        # been made durable (snapshot) before the orphaned segments died
+        srv2.kill()
+        srv3 = _boot(tmp_path, new_shards, port=srv.port)
+        try:
+            rs3 = RemoteStore(srv3.url)
+            assert {p.meta.key: p.node_name
+                    for p in rs3.list("Pod")} == acked
+        finally:
+            srv3.stop()
+    finally:
+        if not srv2._killed:
+            srv2.stop()
+
+
+def test_untagged_segment_reaches_every_shard_watcher():
+    """A segment shipped WITHOUT a shard tag (pre-partition client /
+    failed healthz probe) must reach shard-scoped watchers of every
+    shard — over-delivery, never a silent per-shard gap."""
+    srv = StoreServer(shards=NSHARDS).start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 8)
+        watchers = []
+        for s in range(NSHARDS):
+            w = RemoteStore(srv.url, shard=s)
+            watchers.append((s, w, w.watch("Pod")))
+        seg = _mixed_segment(n=8, n_evict=0)
+        rs.apply_segment(seg)  # whole segment, no shard tag
+        for s, w, q in watchers:
+            w.poll()
+            got = []
+            while q:
+                got.append(q.popleft().obj.meta.key)
+            assert got == seg.bind_keys, f"shard {s} watcher missed rows"
+    finally:
+        srv.stop()
+
+
+def test_sharded_fanout_wire_attribution_not_inflated():
+    """wire_s accounts the fan-out ONCE (wall minus server sections),
+    not the sum of overlapping per-ship walls — it must stay comparable
+    with the single-segment path's reading (and can never exceed the
+    whole drain's wall-clock)."""
+    import time as _time
+
+    srv = StoreServer(shards=NSHARDS).start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 32)
+        cache = SchedulerCache(rs, async_apply=True)
+        seg = _mixed_segment(n=24, n_evict=0)
+        t0 = _time.perf_counter()
+        try:
+            assert cache.publish_segment(seg)
+            assert cache.applier.flush(timeout=30.0)
+            wall = _time.perf_counter() - t0
+            assert cache.err_log == []
+            stats = cache.applier.drain_stats
+            assert stats["wire_s"] <= wall + 0.05, (stats["wire_s"], wall)
+        finally:
+            cache.applier.stop(flush=False)
+    finally:
+        srv.stop()

@@ -1310,3 +1310,70 @@ def test_crash_safe_io_module_scope_and_suppression(tmp_path):
         with open("state.json", "w") as f:  # vtlint: disable=crash-safe-io
             f.write("{}")
     """, select=["crash-safe-io"]) == []
+
+
+# --- shard-spec-complete (PR 11: mesh-sharded deployed cycle) ----------------
+
+
+def test_shard_spec_complete_fires_on_undeclared_cycle_arg(tmp_path):
+    findings = _lint(tmp_path, "parallel/sharded.py", """
+        _SPECS = {"idle": None}
+        _REPLICATED = frozenset({"eps"})
+
+        def _cycle(args, w):
+            return args["idle"] + args["eps"] + args["node_extra"]
+    """, select=["shard-spec-complete"])
+    assert _rules_of(findings) == ["shard-spec-complete"]
+    assert "node_extra" in findings[0].message
+
+
+def test_shard_spec_complete_fires_when_spec_table_missing(tmp_path):
+    findings = _lint(tmp_path, "parallel/sharded.py", """
+        def _cycle(args, w):
+            return args["idle"]
+    """, select=["shard-spec-complete"])
+    assert _rules_of(findings) == ["shard-spec-complete"]
+
+
+def test_shard_spec_complete_near_misses_stay_quiet(tmp_path):
+    # every arg declared (spec'd or explicitly replicated): quiet
+    assert _lint(tmp_path, "parallel/sharded.py", """
+        _SPECS = {"idle": None, "used": None}
+        _REPLICATED = frozenset({"eps", "total"})
+
+        def _cycle(args, w):
+            return args["idle"] + args["used"] + args["eps"] + args["total"]
+    """, select=["shard-spec-complete"]) == []
+    # args[...] reads OUTSIDE a cycle function: out of scope (helper
+    # dicts, wire payloads)
+    assert _lint(tmp_path, "parallel/sharded.py", """
+        _SPECS = {"idle": None}
+
+        def helper(args):
+            return args["whatever"]
+    """, select=["shard-spec-complete"]) == []
+    # same code outside the sharded module set: out of scope
+    assert _lint(tmp_path, "scheduler/other.py", """
+        def _cycle(args, w):
+            return args["undeclared"]
+    """, select=["shard-spec-complete"]) == []
+    # non-constant subscripts (loops over keys) never fire
+    assert _lint(tmp_path, "parallel/sharded.py", """
+        _SPECS = {"idle": None}
+
+        def _cycle(args, w):
+            return {k: args[k] for k in args}
+    """, select=["shard-spec-complete"]) == []
+
+
+def test_shard_spec_complete_real_module_is_total():
+    """The real sharded.py declares a placement for every cycle arg —
+    the live proof the deployed mesh path has no silent-default arrays."""
+    from volcano_tpu.parallel import sharded
+
+    from volcano_tpu.scheduler.simargs import build_sim_args
+
+    args = build_sim_args(8, 16, 4, 2, seed=0)
+    declared = set(sharded._SPECS) | set(sharded._REPLICATED)
+    missing = set(args) - declared
+    assert not missing, f"undeclared cycle args: {sorted(missing)}"

@@ -99,6 +99,7 @@ def _load_rule_modules() -> None:
         rules_registry,
         rules_residue,
         rules_retry,
+        rules_shard,
         rules_statement,
         rules_trace,
         rules_wire,

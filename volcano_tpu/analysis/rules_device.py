@@ -46,8 +46,14 @@ from volcano_tpu.analysis.core import (
 
 #: fastpath-hot modules (by basename, like the other loop-shape rules)
 _HOT_MODULES = {
-    "fastpath.py", "tensor_actions.py", "fast_victims.py", "volsolve.py",
+    # the fastpath package (PR 11 split of the old fastpath.py monolith;
+    # the old basename stays for the rule's own test fixtures)
+    "fastpath.py",
+    "mirror.py", "snapshot_build.py", "cycle.py", "publish.py",
+    "tensor_actions.py", "fast_victims.py", "volsolve.py",
     "kernels.py", "victim_kernels.py", "snapshot.py", "scheduler.py",
+    # the sharded-cycle module: its fetch boundaries are vtprof-sanctioned
+    "sharded.py",
 }
 
 #: calls whose results are device arrays (the dispatch entries)

@@ -43,7 +43,13 @@ from volcano_tpu.analysis.core import (
 #: the kernel-twin modules: host mirrors of device programs, where Python
 #: cost is the product the paper optimizes away
 KERNEL_TWIN_BASENAMES = {
+    # the fastpath package (PR 11 split of the old fastpath.py monolith;
+    # the old basename stays for the rule's own test fixtures)
     "fastpath.py",
+    "mirror.py",
+    "snapshot_build.py",
+    "cycle.py",
+    "publish.py",
     "kernels.py",
     "victim_kernels.py",
     "fast_victims.py",

@@ -1,0 +1,142 @@
+"""shard-spec-complete: every sharded-cycle argument has a declared placement.
+
+The mesh-sharded fast cycle (parallel/sharded.py) jits one cycle function
+with explicit ``NamedSharding`` in_shardings derived from the ``_SPECS``
+PartitionSpec table; anything absent from the table silently replicates
+via the ``P()`` default.  That default is exactly how a sharding bug
+ships: a new node-shaped array added to the cycle without a ``_SPECS``
+entry quietly replicates whole across the mesh — correctness holds (GSPMD
+inserts resharding collectives), so no test fails, but the scale axis the
+mesh exists for (node-plane memory and bandwidth dividing by shard count)
+silently stops applying to that array.
+
+This rule makes the placement decision explicit and total: in the module
+set (``sharded.py``) every string key read from the cycle-argument dict
+(``args["name"]`` inside the jitted cycle body ``_cycle``) must appear in
+the ``_SPECS`` PartitionSpec table OR in the explicit ``_REPLICATED``
+set.  A name in neither is a finding — add it to ``_SPECS`` with its node
+axis, or to ``_REPLICATED`` with the reason it replicates (a conscious
+placement, reviewable in the diff, instead of a silent default).
+
+Recognition is conservative: only constant-string subscripts of the
+``args`` parameter inside functions named ``_cycle``/``cycle``/
+``sharded_cycle`` are checked, so helper dicts and wire payloads
+elsewhere in the module never fire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from volcano_tpu.analysis.core import FileContext, Finding, rule
+
+_SCOPED_BASENAMES = {"sharded.py"}
+
+#: cycle-body function names whose ``args[...]`` reads are checked
+_CYCLE_FNS = {"_cycle", "cycle", "sharded_cycle"}
+
+#: module-level names holding the placement tables
+_SPEC_TABLE = "_SPECS"
+_REPL_TABLE = "_REPLICATED"
+
+
+def _assigned_value(tree: ast.AST, name: str) -> Optional[ast.AST]:
+    """The module-level value bound to ``name`` (Assign or AnnAssign)."""
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name) and node.target.id == name:
+                return node.value
+    return None
+
+
+def _string_keys(value: Optional[ast.AST]) -> Optional[Set[str]]:
+    """String keys of a dict literal / elements of a set literal, looking
+    through ``frozenset({...})``/``set({...})`` wrappers; None when the
+    table is absent or not a literal the rule can read."""
+    if value is None:
+        return None
+    if isinstance(value, ast.Call):
+        fn = value.func
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in ("frozenset", "set")
+            and value.args
+        ):
+            value = value.args[0]
+    out: Set[str] = set()
+    if isinstance(value, ast.Dict):
+        for k in value.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out.add(k.value)
+        return out
+    if isinstance(value, (ast.Set, ast.List, ast.Tuple)):
+        for e in value.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+        return out
+    return None
+
+
+@rule(
+    "shard-spec-complete",
+    "an array argument enters the jitted sharded cycle with no entry in "
+    "the PartitionSpec table (_SPECS) and no explicit replicated "
+    "declaration (_REPLICATED): it silently replicates across the mesh — "
+    "declare its node-axis spec or its reason to replicate",
+)
+def check_shard_spec_complete(ctx: FileContext) -> Iterable[Finding]:
+    if ctx.basename not in _SCOPED_BASENAMES:
+        return
+    specs = _string_keys(_assigned_value(ctx.tree, _SPEC_TABLE))
+    repl = _string_keys(_assigned_value(ctx.tree, _REPL_TABLE))
+    declared = (specs or set()) | (repl or set())
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name not in _CYCLE_FNS:
+            continue
+        arg_names = {a.arg for a in fn.args.args} | {
+            a.arg for a in fn.args.kwonlyargs
+        }
+        if "args" not in arg_names:
+            continue
+        if specs is None:
+            yield ctx.finding(
+                "shard-spec-complete",
+                fn,
+                f"module defines a sharded cycle ({fn.name!r}) but no "
+                f"{_SPEC_TABLE} PartitionSpec table — every argument "
+                "placement is a silent default",
+            )
+            return
+        seen: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Subscript):
+                continue
+            if not (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "args"
+            ):
+                continue
+            sl = node.slice
+            if not (
+                isinstance(sl, ast.Constant) and isinstance(sl.value, str)
+            ):
+                continue
+            name = sl.value
+            if name in declared or name in seen:
+                continue
+            seen.add(name)
+            yield ctx.finding(
+                "shard-spec-complete",
+                node,
+                f"cycle argument {name!r} has no PartitionSpec "
+                f"({_SPEC_TABLE}) and no explicit replicated declaration "
+                f"({_REPL_TABLE}): it silently replicates across the "
+                "mesh — declare its placement",
+            )

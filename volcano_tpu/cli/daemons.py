@@ -66,20 +66,25 @@ def _elector(store, component: str, identity: str, enabled: bool):
 
 
 def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = True,
-                  state: str = "", wal: bool = False, announce=print) -> None:
+                  state: str = "", wal: bool = False, shards: int = 1,
+                  announce=print) -> None:
     """``state`` names a JSON file the server persists all objects to (the
     etcd analogue): a restarted apiserver resumes with every CRD, and
     clients behind the restart relist.  ``wal=True`` adds the segment
     write-ahead log beside it (``<state>.wal/``): every ACKed mutation is
     fsynced before its 2xx, so a SIGKILLed apiserver recovers with zero
-    acked loss (store/wal.py)."""
+    acked loss (store/wal.py).  ``shards>1`` partitions the decision bus
+    by namespace hash (store/partition.py): per-shard apply locks,
+    per-shard WAL directories with independent group-commit fsync, and
+    ``/watch?shard=i`` fan-out — the scheduler's applier splits each
+    cycle's segment to match."""
     from volcano_tpu import trace
     from volcano_tpu.api.objects import Metadata, Queue
     from volcano_tpu.store.server import StoreServer
 
     trace.set_component("apiserver")
     srv = StoreServer(host=host, port=port, state_path=state or None,
-                      wal=wal)
+                      wal=wal, shards=shards)
     if default_queue and srv.store.get("Queue", "/default") is None:
         srv.store.create("Queue", Queue(meta=Metadata(name="default", namespace="")))
     announce(f"apiserver listening on {srv.url}", flush=True)

@@ -776,6 +776,12 @@ def main(argv=None) -> int:
                        help="segment write-ahead log beside --state "
                             "(store/wal.py): ACK-after-fsync, crash "
                             "recovery = snapshot + replay, zero acked loss")
+    api_p.add_argument("--shards", type=int, default=1,
+                       help="partition the decision bus by namespace hash "
+                            "(store/partition.py): per-shard segment "
+                            "apply locks, per-shard WAL files with "
+                            "independent group-commit fsync, "
+                            "/watch?shard=i fan-out; 1 = unpartitioned")
     for comp in ("controller", "scheduler", "kubelet", "elastic"):
         p = sub.add_parser(comp, parents=[common], help=f"run the {comp} against --server")
         p.add_argument("--identity", default="")
@@ -868,7 +874,8 @@ def main(argv=None) -> int:
         try:
             if args.group == "apiserver":
                 daemons.run_apiserver(port=args.port, host=args.host,
-                                      state=args.state, wal=args.wal)
+                                      state=args.state, wal=args.wal,
+                                      shards=args.shards)
             elif args.group == "controller":
                 daemons.run_controller(args.server, identity=args.identity,
                                        leader_elect=not args.no_leader_elect,

@@ -44,6 +44,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from volcano_tpu import vtprof
 from volcano_tpu.scheduler.kernels import allocate_solve_batch, water_fill
 
 #: argument name -> PartitionSpec over the ("nodes",) mesh axis.
@@ -57,7 +58,33 @@ _SPECS: Dict[str, P] = {
     "node_valid": P("nodes"),
     "class_mask": P(None, "nodes"),
     "class_score": P(None, "nodes"),
+    # dynamic-solve node planes (ports/affinity resident state): node
+    # axis 0, like idle/used — the dyn wave's feasibility masks shard
+    # with the node rows they gate
+    "node_ports_w": P("nodes", None),
+    "node_selcnt": P("nodes", None),
 }
+
+#: cycle arguments that REPLICATE across the mesh, listed explicitly so
+#: the ``shard-spec-complete`` vtlint rule can prove every array entering
+#: the jitted sharded cycle has a declared placement (a name in neither
+#: table is a silent default — exactly the drift the rule fences).
+#: task/job/queue state is small relative to [*, N] node planes and every
+#: shard needs the full job ranking each round; the volsel claim bitsets
+#: replicate too (task-major rows whose node axis is PACKED into u32
+#: words — words do not split on a node boundary, and volume waves are
+#: residue-scale, so replication is bytes, not a bandwidth term).
+_REPLICATED = frozenset({
+    "task_req", "task_job", "task_class", "task_valid",
+    "job_queue", "job_min", "job_prio", "job_ready_init",
+    "job_alloc_init", "job_schedulable", "job_start", "job_ntasks",
+    "queue_weight", "queue_request", "queue_alloc_init",
+    "queue_participates",
+    "total", "eps",
+    "task_volmask_w", "task_claims", "claim_group", "group_cap",
+    "group_global",
+    "task_ports_w", "task_aff_w", "task_anti_w", "task_self_w",
+})
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "nodes") -> Mesh:
@@ -176,7 +203,11 @@ def make_sharded_victim_step(mesh: Mesh, consts, state, **static_kw):
     """(jitted_fn, device_consts, device_state): victim_step compiled with
     node-axis shardings over the mesh. ``jitted_fn(consts, state, t_req,
     t_cls, jt, qt)`` runs one preemptor's solve; the returned new state
-    keeps node-shaped rows sharded so chained solves stay distributed."""
+    keeps node-shaped rows sharded so chained solves stay distributed.
+    The compile cache is ``victim_step``'s own (already in the vtprof
+    registry under that name, registered at victim_kernels import) — the
+    sharded path adds placements, not a second jit wrapper, so the
+    recompile sentinel sees its compiles without double counting."""
     from volcano_tpu.scheduler.victim_kernels import victim_step
 
     def shard_tuple(tup):
@@ -236,9 +267,23 @@ def make_sharded_cycle(
         ),
         in_shardings=(shardings, None, None),
     )
+    # every sharded-cycle jit joins the vtprof compile-cache registry so
+    # the recompile sentinel and `vtctl profile` see the mesh path too
+    # (registration is unconditional; scanning happens only while armed)
+    vtprof.register_jit("sharded_cycle", fn)
     import jax.numpy as jnp
 
     return (
         lambda a: fn(a, jnp.float32(w_least), jnp.float32(w_balanced)),
         device_args,
     )
+
+
+def fetch_outputs(out, kernel: str = "sharded_cycle", phase: str = "solve"):
+    """THE sanctioned device→host fetch boundary for a sharded cycle's
+    output tuple: disarmed it is exactly ``np.asarray`` per output (the
+    device-sync-discipline contract); armed, each output's block-until-
+    ready wait splits from its host copy and attributes to ``kernel`` —
+    so the mesh path's wall-clock lands in named vtprof segments instead
+    of vanishing into the caller's host time."""
+    return tuple(vtprof.fetch(o, kernel=kernel, phase=phase) for o in out)

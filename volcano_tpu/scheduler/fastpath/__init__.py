@@ -1,0 +1,92 @@
+"""Array-native fast cycle: watch-fed numpy mirror -> device solve -> bulk
+publish, with zero per-pod Python on the critical path.
+
+Why this exists: the object-model cycle (cache.snapshot -> Session ->
+tensor_actions -> close_session) re-materializes O(cluster) Python objects
+every period.  The decision kernel itself solves 100k x 10k in ~0.2 s on
+one TPU chip, but the object path around it measured 13.5 s publish at that
+scale — all interpreter time.  The reference has the same structure (its
+informer cache *is* an incremental mirror; Snapshot() deep-clones it,
+cache.go:537-589) but pays Go prices.  The TPU-native answer is to keep the
+cluster state as arrays end-to-end:
+
+  store watch events ──O(changes)──▶ pod/node/job/queue row tables (numpy)
+          │                                   │ O(T) vectorized reductions
+          ▼                                   ▼
+  eligibility counters              TensorSnapshot (same dataclass, same
+                                    semantics as snapshot.py's builder)
+                                              │ jitted solve (kernels.py)
+                                              ▼
+                     applier bulk verbs ◀── decisions + status patches
+
+The fast cycle runs whenever the cluster is *expressible*: static
+predicates (node selectors, node affinity, tolerations — plus node
+readiness/taints/pressure) factor into per-class [C, N] mask rows exactly
+as on the object tensor path, computed by the SAME shared helpers and
+cached per (class, node) cell with node-event invalidation.  Jobs whose
+pending pods carry resident-state predicates (host ports, pod
+(anti)affinity, volumes) are PARTITIONED out of the array solve and
+host-solved in an object residue sub-cycle — one odd pod does not forfeit
+the fast path for the rest of the cluster; PDB/PV/PVC/StorageClass objects
+alone never force the object path (PDB shadow gangs attach only to
+group-less pods, volume objects only to claim-referencing pods).  Only
+group-less/unlinked pods and predicate-class-cap overflow take the whole
+cycle to the object path.
+
+Decision parity: the fast snapshot builder reproduces snapshot.py's array
+semantics field-for-field (tests/test_fastpath.py asserts equality against
+build_tensor_snapshot on the same store), so the solve — and therefore the
+placements — are identical to the tensor object path.  Known tie-breaking
+divergences, same class the object path already documents vs the reference
+(which randomizes ties, scheduler_helper.go:100-106):
+  * within a job, equal-priority pending tasks order by uid *arrival*
+    rather than uid string order (differs only across multi-writer uid
+    token boundaries);
+  * enqueue admission under a contended overcommit budget orders pending
+    groups by (queue uid, -priority, creation) rather than live proportion
+    shares.
+"""
+
+# The fast path is a package since PR 11 (ROADMAP item 1's refactor
+# license): the monolithic fastpath.py split along the shard boundary —
+#   mirror.py          watch-fed array row tables (state layer)
+#   snapshot_build.py  vectorized snapshot + dynamic/volume classifier
+#   cycle.py           FastCycle driver (solve orchestration)
+#   publish.py         segment publish + status close tail
+# This __init__ re-exports the public surface so every existing
+# ``from volcano_tpu.scheduler.fastpath import X`` keeps working.
+
+from volcano_tpu.scheduler.fastpath.mirror import (  # noqa: F401
+    _ALLOCATED_CODES,
+    _BOUND,
+    _FAILED,
+    _INT32_MAX,
+    _OTHER,
+    _PENDING,
+    _READY_CODES,
+    _RELEASING,
+    _RUNNING,
+    _STATUS_CODE,
+    _SUCCEEDED,
+    ArrayMirror,
+    _grow,
+    _NodeShim,
+    _Rows,
+    _TaskShim,
+)
+from volcano_tpu.scheduler.fastpath.snapshot_build import (  # noqa: F401
+    _pack_u32,
+    _residue_counts,
+    _task_arrays,
+    _TiersOnly,
+    _unpack_f32,
+    build_dyn_solve_inputs,
+    build_fast_snapshot,
+    build_victim_pool,
+)
+from volcano_tpu.scheduler.fastpath.cycle import FastCycle  # noqa: F401
+from volcano_tpu.scheduler.fastpath.publish import (  # noqa: F401
+    fit_errors,
+    publish_and_close,
+    volume_bind_filter,
+)

@@ -1,0 +1,1125 @@
+"""Watch-fed array mirror of the store — the fast cycle's state layer.
+
+Split out of the original monolithic ``fastpath.py`` (PR 11's refactor
+license: a clean shard boundary needs snapshot / classifier+solve-input /
+cycle-driver / publish layers in separate modules).  This module owns the
+incremental row tables: store watch events apply in O(changes), and the
+snapshot builder (``fastpath.snapshot_build``) reads the tables
+vectorized.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from volcano_tpu.api.job import POD_GROUP_KEY
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.store.store import EventType
+
+# status codes (i8) — a compressed TaskStatus for the pod table
+_PENDING, _BOUND, _RUNNING, _RELEASING, _SUCCEEDED, _FAILED, _OTHER = range(7)
+
+_STATUS_CODE = {
+    TaskStatus.PENDING: _PENDING,
+    TaskStatus.BOUND: _BOUND,
+    TaskStatus.BINDING: _BOUND,
+    TaskStatus.ALLOCATED: _BOUND,
+    TaskStatus.RUNNING: _RUNNING,
+    TaskStatus.RELEASING: _RELEASING,
+    TaskStatus.SUCCEEDED: _SUCCEEDED,
+    TaskStatus.FAILED: _FAILED,
+    TaskStatus.UNKNOWN: _OTHER,
+}
+
+#: statuses that count as "allocated" (helpers.go:66-73) and as gang-ready
+_ALLOCATED_CODES = (_BOUND, _RUNNING)
+_READY_CODES = (_BOUND, _RUNNING, _SUCCEEDED)
+
+_INT32_MAX = np.iinfo(np.int32).max
+
+
+class _TaskShim:
+    """Minimal TaskInfo view for the shared predicate/class helpers (they
+    read ``task.pod.spec`` only)."""
+
+    __slots__ = ("pod",)
+
+    def __init__(self, pod):
+        self.pod = pod
+
+
+class _NodeShim:
+    """Minimal NodeInfo view for the shared predicate/score helpers (they
+    read ``node.node`` and ``node.name`` only)."""
+
+    __slots__ = ("node", "name")
+
+    def __init__(self, node_obj):
+        self.node = node_obj
+        self.name = node_obj.meta.name
+
+
+class _Rows:
+    """Grow-only row allocator with key <-> row maps and a free list.
+
+    ``reuse=False`` keeps freed rows retired forever — required when other
+    tables hold row indices (pods point at node rows): a reused row would
+    silently re-attribute stale references to the new occupant."""
+
+    def __init__(self, reuse: bool = True):
+        self.key_row: Dict[str, int] = {}
+        self.row_key: List[Optional[str]] = []
+        self.free: List[int] = []
+        self.reuse = reuse
+
+    def acquire(self, key: str) -> Tuple[int, bool]:
+        row = self.key_row.get(key)
+        if row is not None:
+            return row, False
+        if self.reuse and self.free:
+            row = self.free.pop()
+            self.row_key[row] = key
+        else:
+            row = len(self.row_key)
+            self.row_key.append(key)
+        self.key_row[key] = row
+        return row, True
+
+    def release(self, key: str) -> Optional[int]:
+        row = self.key_row.pop(key, None)
+        if row is not None:
+            self.row_key[row] = None
+            self.free.append(row)
+        return row
+
+    def __len__(self):
+        return len(self.key_row)
+
+
+def _grow(arr: np.ndarray, n: int) -> np.ndarray:
+    if n <= arr.shape[0]:
+        return arr
+    cap = max(64, arr.shape[0])
+    while cap < n:
+        cap *= 2
+    out = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+class ArrayMirror:
+    """Incremental array mirror of the store, fed by list+watch.
+
+    Row tables (numpy, geometric growth) for pods/nodes/podgroups/queues +
+    interning maps.  ``ineligible_*`` counters track the conditions that
+    force the object path; they are maintained per event so eligibility is
+    O(1) per cycle.
+    """
+
+    def __init__(self, store, scheduler_name: str, default_queue: str):
+        self.store = store
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+        self._watches = [
+            (kind, store.watch(kind))
+            for kind in (
+                "Pod", "Node", "PodGroup", "Queue", "PriorityClass",
+                "PodDisruptionBudget", "PersistentVolume",
+                "PersistentVolumeClaim", "StorageClass",
+            )
+        ]
+        self._synced = False
+        self._resyncing = False
+        #: StaleWatch recoveries performed by drain() — the chaos soak
+        #: asserts the relist path actually ran under log truncation
+        self.stale_relists = 0
+        self._reset_tables(["cpu", "memory"])
+
+    def _reset_tables(self, dims: List[str]) -> None:
+        # resource dims: cpu/memory + discovered scalars.  A new scalar
+        # forces a full resync (rare: a new device type joins the cluster).
+        self.dims = list(dims)
+        self._dim_index = {d: i for i, d in enumerate(self.dims)}
+
+        R = len(self.dims)
+        self.pods = _Rows()
+        self.p_req = np.zeros((0, R), np.float32)       # init_resreq
+        self.p_resreq = np.zeros((0, R), np.float32)    # resreq (shares/usage)
+        self.p_prio = np.zeros((0,), np.int32)
+        self.p_status = np.zeros((0,), np.int8)
+        self.p_node = np.zeros((0,), np.int32)          # node row or -1
+        self.p_job = np.zeros((0,), np.int32)           # job row or -1
+        self.p_best_effort = np.zeros((0,), bool)
+        self.p_live = np.zeros((0,), bool)
+        self.p_rank = np.zeros((0,), np.int64)          # arrival order
+        self.p_rv = np.zeros((0,), np.int64)            # resource_version
+        # resident-state predicates (host ports, pod (anti)affinity,
+        # volumes): the pod's JOB is partitioned out of the array solve
+        # and host-solved in the residue sub-cycle — UNLESS every dynamic
+        # predicate on the job's pending pods is port/selector-expressible
+        # (p_dyn_expr), in which case the device dynamic solve serves it
+        self.p_dynamic = np.zeros((0,), bool)
+        self.p_dyn_expr = np.zeros((0,), bool)
+        # claim-referencing pods (pod.volumes non-empty): their volume
+        # verdict — express / device volume solve / residue — is computed
+        # once per CYCLE from store PVC/PV/StorageClass state
+        # (volsolve.py), not per event: volume objects carry no watch
+        # handlers here, so an ingest-time verdict could go stale
+        self.p_has_vol = np.zeros((0,), bool)
+        #: row -> pod object, kept only for claim-referencing pods: the
+        #: cycle classifier and publish-time allocate/bind validation need
+        #: pod.volumes + metadata without a per-pod store round trip
+        self.vol_pod_objs: Dict[int, object] = {}
+        # conformance veto (plugins/conformance.py): False for
+        # system-critical / kube-system pods — victim pool input for the
+        # fast preempt/reclaim passes (fast_victims.py)
+        self.p_evictable = np.zeros((0,), bool)
+        self._next_rank = 0
+
+        self.nodes = _Rows(reuse=False)  # pod rows hold node row indices
+        self.n_alloc = np.zeros((0, R), np.float32)
+        self.n_max_tasks = np.zeros((0,), np.int32)
+        self.n_live = np.zeros((0,), bool)
+        self.n_rv = np.zeros((0,), np.int64)            # resource_version
+        self.node_objs: List[Optional[object]] = []  # row -> Node object
+
+        # static predicate classes (snapshot.py's factorization): pods
+        # intern their (selector, affinity, tolerations, ports) key to a
+        # mirror-global class id; per-(class, node) mask/raw-affinity-score
+        # cells are computed lazily via the SAME _static_predicate /
+        # node_affinity_score code the object builder uses, and node events
+        # invalidate just that node's column
+        self.class_ids: Dict[object, int] = {}
+        self.class_examples: List[object] = []   # class id -> example pod
+        self.class_overflow = False  # live classes exceed the cap
+        self.cls_mask = np.zeros((0, 0), bool)   # [Ccap, Ncap]
+        self.cls_score = np.zeros((0, 0), np.float32)
+        self.cls_valid = np.zeros((0, 0), bool)  # cell computed?
+        self.p_class = np.zeros((0,), np.int32)
+        # name -> retired row list: a node deleted and re-created must pull
+        # its still-resident pods' p_node links onto the new row, or their
+        # usage would silently vanish from the reborn node
+        self._retired_node_rows: Dict[str, List[int]] = {}
+
+        self.jobs = _Rows()  # PodGroups + shadow gangs
+        self.j_min = np.zeros((0,), np.int32)
+        self.j_queue = np.zeros((0,), np.int32)         # queue row or -1
+        self.j_prio = np.zeros((0,), np.int32)
+        self.j_phase = np.zeros((0,), np.int8)          # index into _PHASES
+        self.j_rv = np.zeros((0,), np.int64)            # resource_version
+        self.j_min_req = np.zeros((0, R), np.float32)   # MinResources
+        self.j_live = np.zeros((0,), bool)
+        self.j_has_unsched = np.zeros((0,), bool)       # Unschedulable cond
+        # shadow gangs for plain (group-less) pods — the mirror analogue of
+        # the object cache's shadow PodGroups (cache.py:525-535, reference
+        # cache/util.go:36-60): keyed shadow/{ns}/{owner-uid-or-pod-name},
+        # MinMember 1 unless a PodDisruptionBudget configures it (setPDB,
+        # event_handlers.go:494-510), default queue, priority 0, always
+        # schedulable.  j_shadow marks them so status writes skip them (no
+        # store PodGroup exists); j_pdb marks budget-backed gangs, which
+        # outlive their member pods (the object builder keeps a PDB shadow
+        # alive with zero pods); j_members refcounts live member pods so a
+        # member-less, budget-less shadow row is released instead of
+        # accumulating forever under pod churn.
+        self.j_shadow = np.zeros((0,), bool)
+        self.j_pdb = np.zeros((0,), bool)
+        self.j_members = np.zeros((0,), np.int32)
+        #: shadow rows sort after every real PodGroup (the object path
+        #: appends them after the rv-sorted groups) in creation order
+        self._shadow_seq = 0
+        # pods whose PodGroup annotation has no live job row yet: the object
+        # path gives these shadow jobs (cache/util.go:36-60); the fast path
+        # defers to it while any exist.  _pod_wait_group is the reverse map
+        # so re-annotated/deleted pods drop their stale wait entries.
+        self.unlinked_pods: Set[str] = set()
+        self._waiting_on_group: Dict[str, Set[str]] = {}
+        self._pod_wait_group: Dict[str, str] = {}
+
+        # -- interned host-ports + pod-(anti)affinity selectors (SURVEY
+        # §7c: label interning + bitset intersections).  Ports and
+        # exact-match selectors intern to bit positions; per-pod bitset
+        # rows and per-(node, bit) resident counts keep the node-level
+        # masks O(changes).  Sound under partial interning: a port/selector
+        # a PENDING pod needs always interns (or the pod stays
+        # residue-dynamic), and any bit shared between a pending pod and a
+        # resident is the same bit.
+        self.PW = 4   # u32 words -> 128 distinct host ports
+        self.SW = 2   # u32 words -> 64 distinct affinity selectors
+        self.port_ids: Dict[int, int] = {}
+        self.sel_ids: Dict[frozenset, int] = {}
+        self.p_ports = np.zeros((0, self.PW), np.uint32)    # own host ports
+        self.p_selmatch = np.zeros((0, self.SW), np.uint32)  # labels satisfy
+        self.p_aff_req = np.zeros((0, self.SW), np.uint32)   # required terms
+        self.p_aff_anti = np.zeros((0, self.SW), np.uint32)  # anti terms
+        #: node row whose resident counts currently include this pod (-1)
+        self.p_contrib_node = np.zeros((0,), np.int32)
+        self.p_labels: List[Optional[dict]] = []   # row -> pod labels
+        self.n_port_cnt = np.zeros((0, 32 * self.PW), np.int16)
+        self.n_sel_cnt = np.zeros((0, 32 * self.SW), np.int16)
+
+        self.queues = _Rows()
+        self.q_weight = np.zeros((0,), np.float32)
+        self.q_live = np.zeros((0,), bool)
+
+        self.priority_classes: Dict[str, int] = {}
+        self.default_priority = 0
+
+        self._phases = list(PodGroupPhase)
+        self._phase_idx = {p: i for i, p in enumerate(self._phases)}
+
+    # -- ingest ---------------------------------------------------------------
+
+    def _resync(self, dims: Optional[List[str]] = None) -> None:
+        """Full rebuild from store lists (queue/priority-class change,
+        scalar-dim widening, class-cap churn). Watches stay subscribed;
+        tables reset. Re-entrant class-cap overflow during the rebuild
+        flags the mirror instead of recursing (see _class_id)."""
+        self._reset_tables(dims or ["cpu", "memory"])
+        self._resyncing = True
+        try:
+            self._full_sync()
+        finally:
+            self._resyncing = False
+
+    def _full_sync(self) -> None:
+        for pc in self.store.items("PriorityClass"):
+            self._on_priority_class(pc)
+        for q in self.store.items("Queue"):
+            self._on_queue(q)
+        for node in self.store.items("Node"):
+            self._on_node(node)
+        for pg in self.store.items("PodGroup"):
+            self._on_podgroup(pg)
+        # PDB pass BEFORE pods, like the object builder (cache.py:475-491):
+        # a budget creates/configures the shadow gang its controller's
+        # plain pods will join
+        for pdb in self.store.items("PodDisruptionBudget"):
+            self._on_pdb(pdb)
+        for pod in self.store.items("Pod"):
+            self._on_pod(pod)
+        self._synced = True
+
+    def drain(self) -> None:
+        """Apply queued watch events; first call performs the full sync.
+        Events queued before/during the sync are NOT discarded — row
+        upserts are idempotent, and RemoteStore watch queues (which pin
+        their cursor at subscription) have no local backlog to drop.
+        Falling off a RemoteStore server's event log (StaleWatch) recovers
+        here with a relist, so every embedding — not just the daemon run
+        loop, which additionally handles full apiserver outages — survives
+        a watch-log overflow."""
+        if not self._synced:
+            self._full_sync()
+            return
+        from volcano_tpu.store.client import StaleWatch
+
+        try:
+            self._drain_events()
+        except StaleWatch:
+            # poll() already advanced the cursor past the gap.  Drop every
+            # queue's pre-gap buffer FIRST: events from before the overflow
+            # would otherwise apply on top of the fresh relist (e.g. an
+            # UPDATED for an object whose DELETE fell into the gap would
+            # re-ingest it forever), then relist to recover the drop.
+            for _, q in self._watches:
+                getattr(q, "_buf", q).clear()
+            self.stale_relists += 1
+            self._resync(dims=self.dims)
+
+    def _drain_events(self) -> None:
+        resync = False
+        for kind, q in self._watches:
+            while q:
+                ev = q.popleft()
+                # EventType is a str enum whose VALUE is "Deleted" — a
+                # "DELETED" (name) comparison silently never matches and
+                # every deletion would re-ingest as an upsert, leaving dead
+                # pods consuming mirror capacity forever
+                deleted = ev.type == EventType.DELETED
+                if kind == "Pod":
+                    if deleted:
+                        self._del_pod(ev.obj)
+                    else:
+                        self._on_pod(ev.obj)
+                elif kind == "Node":
+                    if deleted:
+                        self._del_node(ev.obj)
+                    else:
+                        self._on_node(ev.obj)
+                elif kind == "PodGroup":
+                    if deleted:
+                        self._del_podgroup(ev.obj)
+                    else:
+                        self._on_podgroup(ev.obj)
+                elif kind == "Queue":
+                    # queue add/remove re-wires job rows; rare enough that a
+                    # full resync is simpler than fixing up every job
+                    resync = True
+                elif kind == "PriorityClass":
+                    resync = True  # priorities baked into pod/job rows
+                elif kind == "PodDisruptionBudget":
+                    if deleted:
+                        self._del_pdb(ev.obj)
+                    else:
+                        self._on_pdb(ev.obj)
+                # PV/PVC/StorageClass events need no mirror state: volume
+                # objects matter only to claim-referencing (dynamic) pods,
+                # and the residue/preempt sub-cycles read the store directly
+        if resync:
+            self._resync()
+
+    def _vec(self, res, out_row: np.ndarray) -> bool:
+        """Write a Resource into a row; False if it has an unknown scalar
+        dim (caller must resync with widened dims)."""
+        out_row[0] = res.milli_cpu
+        out_row[1] = res.memory
+        if res.scalars:
+            for name, v in res.scalars.items():
+                idx = self._dim_index.get(name)
+                if idx is None:
+                    return False
+                out_row[idx] = v
+        return True
+
+    def _widen_dims(self, res) -> None:
+        names = sorted(set(list(res.scalars) + self.dims[2:]))
+        self._resync(dims=["cpu", "memory", *names])
+
+    def _on_priority_class(self, pc) -> None:
+        self.priority_classes[pc.meta.name] = pc.value
+        if getattr(pc, "global_default", False):
+            self.default_priority = pc.value
+
+    def _on_queue(self, q) -> None:
+        row, _ = self.queues.acquire(q.meta.name)
+        self.q_weight = _grow(self.q_weight, row + 1)
+        self.q_live = _grow(self.q_live, row + 1)
+        self.q_weight[row] = q.weight
+        self.q_live[row] = True
+
+    def _on_node(self, node) -> None:
+        row, new = self.nodes.acquire(node.meta.name)
+        n = row + 1
+        self.n_alloc = _grow(self.n_alloc, n)
+        self.n_max_tasks = _grow(self.n_max_tasks, n)
+        self.n_live = _grow(self.n_live, n)
+        self.n_rv = _grow(self.n_rv, n)
+        self.n_port_cnt = _grow(self.n_port_cnt, n)
+        self.n_sel_cnt = _grow(self.n_sel_cnt, n)
+        if new:
+            retired = self._retired_node_rows.pop(node.meta.name, None)
+            if retired:
+                stale = np.isin(self.p_node, np.asarray(retired, np.int32))
+                moved = np.nonzero(stale & self.p_live)[0]
+                self.p_node[moved] = row
+                # their port/selector contributions follow them off the
+                # retired row (which is never served again) onto the reborn
+                # node's counters
+                for prow in moved:
+                    self._sub_contrib(int(prow))
+                    self._add_contrib(int(prow), row)
+        while len(self.node_objs) < n:
+            self.node_objs.append(None)
+        self.n_alloc[row] = 0.0  # updates may drop a scalar dim
+        if not self._vec(node.allocatable, self.n_alloc[row]):
+            self._widen_dims(node.allocatable)
+            return
+        self.n_max_tasks[row] = (
+            node.allocatable.max_task_num
+            if node.allocatable.max_task_num is not None else _INT32_MAX
+        )
+        self.node_objs[row] = node
+        self.n_live[row] = True
+        self.n_rv[row] = node.meta.resource_version
+        # labels/taints/conditions may have changed: every class's cell for
+        # this node recomputes lazily at next build
+        if self.cls_valid.shape[1] > row:
+            self.cls_valid[:, row] = False
+
+    def _del_node(self, node) -> None:
+        self._del_node_key(node.meta.name)
+
+    def _del_node_key(self, name: str) -> None:
+        row = self.nodes.release(name)
+        if row is not None:
+            self.n_live[row] = False
+            self.node_objs[row] = None  # retired rows must not pin objects
+            self._retired_node_rows.setdefault(name, []).append(row)
+
+    def _grow_job_arrays(self, n: int) -> None:
+        """Grow every job-axis array to cover row ``n - 1`` — the single
+        owner of the job-column list (real PodGroups and shadow gangs both
+        allocate through it)."""
+        self.j_min = _grow(self.j_min, n)
+        self.j_queue = _grow(self.j_queue, n)
+        self.j_prio = _grow(self.j_prio, n)
+        self.j_phase = _grow(self.j_phase, n)
+        self.j_rv = _grow(self.j_rv, n)
+        self.j_min_req = _grow(self.j_min_req, n)
+        self.j_live = _grow(self.j_live, n)
+        self.j_has_unsched = _grow(self.j_has_unsched, n)
+        self.j_shadow = _grow(self.j_shadow, n)
+        self.j_pdb = _grow(self.j_pdb, n)
+        self.j_members = _grow(self.j_members, n)
+
+    def _on_podgroup(self, pg) -> None:
+        row, _ = self.jobs.acquire(pg.meta.key)
+        self._grow_job_arrays(row + 1)
+        self.j_shadow[row] = False
+        self.j_min[row] = pg.min_member
+        qname = pg.queue or self.default_queue
+        self.j_queue[row] = self.queues.key_row.get(qname, -1)
+        self.j_prio[row] = self.priority_classes.get(
+            pg.priority_class_name, self.default_priority
+        )
+        self.j_phase[row] = self._phase_idx[pg.status.phase]
+        self.j_rv[row] = pg.meta.resource_version
+        self.j_min_req[row] = 0.0
+        if not self._vec(pg.min_resources, self.j_min_req[row]):
+            self._widen_dims(pg.min_resources)
+            return
+        self.j_live[row] = True
+        self.j_has_unsched[row] = any(
+            c.kind == "Unschedulable" and c.status == "True"
+            for c in pg.status.conditions
+        )
+        # link pods that arrived before their group (the wait-set discipline
+        # guarantees every member's CURRENT annotation is this group)
+        waiting = self._waiting_on_group.pop(pg.meta.key, None)
+        if waiting:
+            for pod_key in waiting:
+                self._pod_wait_group.pop(pod_key, None)
+                prow = self.pods.key_row.get(pod_key)
+                if prow is not None:
+                    self.p_job[prow] = row
+                self.unlinked_pods.discard(pod_key)
+
+    def _del_podgroup(self, pg) -> None:
+        self._del_podgroup_key(pg.meta.key)
+
+    def _del_podgroup_key(self, pg_key: str) -> None:
+        row = self.jobs.release(pg_key)
+        if row is not None:
+            self.j_live[row] = False
+            # surviving member pods become shadow jobs on the object path;
+            # mark them unlinked so the fast path defers
+            for prow in np.nonzero(
+                self.p_live[: len(self.p_job)] & (self.p_job[: len(self.p_job)] == row)
+            )[0]:
+                key = self.pods.row_key[prow]
+                if key is not None:
+                    self.p_job[prow] = -1
+                    self.unlinked_pods.add(key)
+                    self._set_wait(key, pg_key)
+
+    # -- shadow gangs (plain pods / PDBs) ------------------------------------
+
+    @staticmethod
+    def _shadow_key_for(pod) -> str:
+        """The shadow gang a plain pod joins — owner-grouped when a
+        controller owns it, per-pod otherwise (cache.py:549-552,
+        reference cache/util.go:36-60)."""
+        owner = pod.meta.owner
+        if owner:
+            return f"shadow/{pod.meta.namespace}/{owner[1]}"
+        return f"shadow/{pod.meta.namespace}/{pod.meta.name}"
+
+    def _ensure_shadow_row(self, key: str) -> int:
+        """Acquire (creating if needed) the shadow gang's job row.  New
+        rows: MinMember 1, default queue, priority 0, phase Inqueue (a
+        shadow gang has no PodGroup, so it is never enqueue-gated —
+        job_schedulable is phase != Pending)."""
+        row, new = self.jobs.acquire(key)
+        if new:
+            self._grow_job_arrays(row + 1)
+            self.j_min[row] = 1
+            self.j_queue[row] = self.queues.key_row.get(self.default_queue, -1)
+            self.j_prio[row] = 0
+            self.j_phase[row] = self._phase_idx[PodGroupPhase.INQUEUE]
+            # shadow rows order after every real PodGroup, in creation
+            # order (the object builder appends them after the rv-sorted
+            # groups; ordering between a PDB shadow and a later plain-pod
+            # shadow is arrival-order here vs PDB-pass-first there — a
+            # tie-break-level divergence, both classes have priority 0)
+            self.j_rv[row] = (1 << 50) + self._shadow_seq
+            self._shadow_seq += 1
+            self.j_min_req[row] = 0.0
+            self.j_has_unsched[row] = False
+            self.j_shadow[row] = True
+            self.j_pdb[row] = False
+            self.j_members[row] = 0
+            self.j_live[row] = True
+        return row
+
+    def _shadow_ref(self, jrow: int, delta: int) -> None:
+        """Adjust a shadow gang's member refcount; a member-less,
+        budget-less row is released (the object builder rebuilds per cycle,
+        so its pod-created shadows vanish with their pods — PDB-backed ones
+        persist, event_handlers.go:494-510)."""
+        if jrow < 0 or not self.j_shadow[jrow]:
+            return
+        self.j_members[jrow] += delta
+        if self.j_members[jrow] <= 0 and not self.j_pdb[jrow]:
+            key = self.jobs.row_key[jrow]
+            if key is not None:
+                self.jobs.release(key)
+            self.j_live[jrow] = False
+
+    def _on_pdb(self, pdb) -> None:
+        """setPDB (event_handlers.go:494-510): the budget's controller
+        owner names the shadow gang; MinAvailable comes from the budget."""
+        if pdb.meta.owner is None:
+            return  # "controller of PodDisruptionBudget is empty"
+        row = self._ensure_shadow_row(
+            f"shadow/{pdb.meta.namespace}/{pdb.meta.owner[1]}"
+        )
+        self.j_min[row] = pdb.min_available
+        self.j_pdb[row] = True
+
+    def _del_pdb(self, pdb) -> None:
+        if pdb.meta.owner is None:
+            return
+        row = self.jobs.key_row.get(
+            f"shadow/{pdb.meta.namespace}/{pdb.meta.owner[1]}"
+        )
+        if row is not None and self.j_shadow[row]:
+            # the object builder rebuilds per cycle, so a deleted budget
+            # reverts its gang to the plain-pod MinMember of 1 — and a
+            # member-less row loses its reason to exist
+            self.j_min[row] = 1
+            self.j_pdb[row] = False
+            self._shadow_ref(row, 0)
+
+    def _set_wait(self, pod_key: str, group_key: str) -> None:
+        self._clear_wait(pod_key)
+        self._waiting_on_group.setdefault(group_key, set()).add(pod_key)
+        self._pod_wait_group[pod_key] = group_key
+
+    def _clear_wait(self, pod_key: str) -> None:
+        group_key = self._pod_wait_group.pop(pod_key, None)
+        if group_key is not None:
+            waiting = self._waiting_on_group.get(group_key)
+            if waiting is not None:
+                waiting.discard(pod_key)
+                if not waiting:
+                    del self._waiting_on_group[group_key]
+
+    # -- port/selector interning (SURVEY §7c) --------------------------------
+
+    def _intern_port(self, port: int) -> Optional[int]:
+        pid = self.port_ids.get(port)
+        if pid is None:
+            if len(self.port_ids) >= 32 * self.PW:
+                return None  # cap: the pod stays residue-dynamic
+            pid = len(self.port_ids)
+            self.port_ids[port] = pid
+        return pid
+
+    def _intern_selector(self, sel: Dict[str, str]) -> Optional[int]:
+        key = frozenset(sel.items())
+        sid = self.sel_ids.get(key)
+        if sid is None:
+            if len(self.sel_ids) >= 32 * self.SW:
+                return None
+            sid = len(self.sel_ids)
+            self.sel_ids[key] = sid
+            # existing pods' label-match bitsets predate this selector:
+            # backfill the new bit (and resident counts) once — O(P) per
+            # DISTINCT selector ever seen, not per pod
+            self._backfill_selector(key, sid)
+        return sid
+
+    def _backfill_selector(self, sel_items, sid: int) -> None:
+        w, b = divmod(sid, 32)
+        bit = np.uint32(1 << b)
+        P = min(len(self.p_labels), self.p_selmatch.shape[0])
+        for row in np.nonzero(self.p_live[:P])[0]:
+            labels = self.p_labels[row]
+            if labels and all(labels.get(k) == v for k, v in sel_items):
+                self.p_selmatch[row, w] |= bit
+                crow = self.p_contrib_node[row]
+                if crow >= 0:
+                    self.n_sel_cnt[crow, sid] += 1
+
+    @staticmethod
+    def _bit_indices(words) -> List[int]:
+        out = []
+        for w in range(words.shape[0]):
+            word = int(words[w])
+            while word:
+                b = (word & -word).bit_length() - 1
+                out.append(w * 32 + b)
+                word &= word - 1
+        return out
+
+    def _sub_contrib(self, row: int) -> None:
+        """Remove this pod's port/selector bits from its node's resident
+        counts (it left the node, changed, or died)."""
+        crow = int(self.p_contrib_node[row])
+        if crow < 0:
+            return
+        pp = self.p_ports[row]
+        if pp.any():
+            self.n_port_cnt[crow, self._bit_indices(pp)] -= 1
+        ps = self.p_selmatch[row]
+        if ps.any():
+            self.n_sel_cnt[crow, self._bit_indices(ps)] -= 1
+        self.p_contrib_node[row] = -1
+
+    def _add_contrib(self, row: int, crow: int) -> None:
+        pp = self.p_ports[row]
+        if pp.any():
+            self.n_port_cnt[crow, self._bit_indices(pp)] += 1
+        ps = self.p_selmatch[row]
+        if ps.any():
+            self.n_sel_cnt[crow, self._bit_indices(ps)] += 1
+        self.p_contrib_node[row] = crow
+
+    @staticmethod
+    def _pod_dynamic(pod) -> bool:
+        """Resident-state-dependent predicates the class system cannot
+        express (host ports, pod (anti)affinity) — node selector, node
+        affinity, and tolerations are static and factor into classes,
+        exactly as on the object tensor path (snapshot.py:415-426).
+
+        Volumes are NOT a dynamic marker here anymore: claim-referencing
+        pods flag ``p_has_vol`` instead, and build_fast_snapshot resolves
+        their verdict once per cycle through volsolve.py — only pods whose
+        claims actually constrain node choice (the object builder's
+        ``volume_constrains`` discipline) leave the express path, so
+        emptyDir/configMap-style and dynamic-class volumes no longer
+        forfeit it."""
+        spec = pod.spec
+        aff = spec.affinity
+        return bool(
+            spec.host_ports
+            or (aff is not None and (aff.pod_affinity or aff.pod_anti_affinity))
+        )
+
+    #: class-count backstop: key churn from long-gone pods eventually
+    #: forces a resync (which drops retired keys), like SnapshotCache's LRU
+    _MAX_CLASSES = 4096
+
+    def _class_id(self, pod) -> Optional[int]:
+        """Intern the pod's static-predicate class key.  Returns None when
+        the class cap was hit: retired-key churn is cured by one full
+        resync (which re-ingests this pod, so the caller must abandon its
+        now-stale row writes); if LIVE pods alone exceed the cap, the
+        mirror marks itself class-overflowed — ineligible_reason() then
+        routes every cycle to the object path instead of resyncing forever.
+        """
+        from volcano_tpu.scheduler.snapshot import _task_class_key
+
+        key = _task_class_key(_TaskShim(pod))
+        cid = self.class_ids.get(key)
+        if cid is not None:
+            return cid
+        if len(self.class_examples) >= self._MAX_CLASSES:
+            if self._resyncing:
+                self.class_overflow = True
+                return None
+            self._resync(dims=self.dims)
+            return None
+        cid = len(self.class_examples)
+        self.class_ids[key] = cid
+        self.class_examples.append(pod)
+        self._ensure_cls_capacity(cid, len(self.node_objs) - 1)
+        return cid
+
+    def _ensure_cls_capacity(self, cid: int, nrow: int) -> None:
+        """Grow the per-(class, node) cell arrays geometrically to cover
+        (cid, nrow) — the single owner of the growth policy."""
+        cap_c, cap_n = self.cls_mask.shape
+        if cid < cap_c and nrow < cap_n:
+            return
+        new_c = max(cap_c, 8)
+        while new_c <= cid:
+            new_c *= 2
+        new_n = max(cap_n, 64)
+        while new_n <= nrow:
+            new_n *= 2
+        mask = np.zeros((new_c, new_n), bool)
+        score = np.zeros((new_c, new_n), np.float32)
+        valid = np.zeros((new_c, new_n), bool)
+        mask[:cap_c, :cap_n] = self.cls_mask
+        score[:cap_c, :cap_n] = self.cls_score
+        valid[:cap_c, :cap_n] = self.cls_valid
+        self.cls_mask, self.cls_score, self.cls_valid = mask, score, valid
+
+    def fill_class_cells(self, cids: np.ndarray, node_rows: np.ndarray,
+                         nodeaffinity_weight: float) -> None:
+        """Compute any uncomputed (class, node) mask/score cells — the SAME
+        predicate/score code the object builder runs (snapshot.py
+        _static_predicate + nodeorder.node_affinity_score), invoked
+        O(new cells) rather than O(C x N) per cycle."""
+        if not cids.size or not node_rows.size:
+            return
+        self._ensure_cls_capacity(int(cids.max()), int(node_rows.max()))
+        from volcano_tpu.scheduler.plugins.nodeorder import node_affinity_score
+        from volcano_tpu.scheduler.snapshot import _static_predicate
+
+        sub_valid = self.cls_valid[np.ix_(cids, node_rows)]
+        if sub_valid.all():
+            return
+        missing_c, missing_n = np.nonzero(~sub_valid)
+        for ci, ni in zip(missing_c, missing_n):
+            cid = int(cids[ci])
+            nrow = int(node_rows[ni])
+            node_obj = self.node_objs[nrow]
+            if node_obj is None:
+                continue
+            task = _TaskShim(self.class_examples[cid])
+            nview = _NodeShim(node_obj)
+            ok = _static_predicate(task, nview)
+            self.cls_mask[cid, nrow] = ok
+            self.cls_score[cid, nrow] = (
+                nodeaffinity_weight * node_affinity_score(task, nview)
+                if ok else 0.0
+            )
+            self.cls_valid[cid, nrow] = True
+
+    def _on_pod(self, pod) -> None:
+        if pod.spec.scheduler_name != self.scheduler_name:
+            return
+        key = pod.meta.key
+        row, new = self.pods.acquire(key)
+        # previous job link, for shadow-gang membership accounting (a
+        # reused/new row's p_job column is garbage until set below)
+        old_j = (
+            int(self.p_job[row])
+            if not new and self.p_live[row] else -1
+        )
+        n = row + 1
+        self.p_req = _grow(self.p_req, n)
+        self.p_resreq = _grow(self.p_resreq, n)
+        self.p_prio = _grow(self.p_prio, n)
+        self.p_status = _grow(self.p_status, n)
+        self.p_node = _grow(self.p_node, n)
+        self.p_job = _grow(self.p_job, n)
+        self.p_best_effort = _grow(self.p_best_effort, n)
+        self.p_live = _grow(self.p_live, n)
+        self.p_rank = _grow(self.p_rank, n)
+        self.p_rv = _grow(self.p_rv, n)
+        self.p_dynamic = _grow(self.p_dynamic, n)
+        self.p_dyn_expr = _grow(self.p_dyn_expr, n)
+        self.p_has_vol = _grow(self.p_has_vol, n)
+        self.p_evictable = _grow(self.p_evictable, n)
+        self.p_class = _grow(self.p_class, n)
+        self.p_ports = _grow(self.p_ports, n)
+        self.p_selmatch = _grow(self.p_selmatch, n)
+        self.p_aff_req = _grow(self.p_aff_req, n)
+        self.p_aff_anti = _grow(self.p_aff_anti, n)
+        self.p_contrib_node = _grow(self.p_contrib_node, n)
+        while len(self.p_labels) < n:
+            self.p_labels.append(None)
+        if new:
+            self.p_rank[row] = self._next_rank
+            self._next_rank += 1
+            self.p_contrib_node[row] = -1
+        elif self.p_live[row]:
+            # the old row's port/selector bits leave its node's resident
+            # counts before anything is overwritten (re-added below from
+            # the fresh state; early-return paths resync wholesale)
+            self._sub_contrib(row)
+        cid = self._class_id(pod)
+        if cid is None:
+            return  # class-cap resync re-ingested everything incl. this pod
+        self.p_class[row] = cid
+
+        resreq = pod.spec.resreq()
+        init = pod.spec.init_resreq()
+        # zero first: a reused row (or an update that dropped a scalar)
+        # must not inherit stale resource columns
+        self.p_resreq[row] = 0.0
+        self.p_req[row] = 0.0
+        if not self._vec(resreq, self.p_resreq[row]):
+            self._widen_dims(resreq)
+            return
+        if not self._vec(init, self.p_req[row]):
+            # a scalar appearing only in init-container requests still
+            # widens the dim set — p_req is the fit requirement
+            self._widen_dims(init)
+            return
+        prio = pod.spec.priority
+        if prio == 0 and pod.spec.priority_class:
+            prio = self.priority_classes.get(
+                pod.spec.priority_class, self.default_priority
+            )
+        self.p_prio[row] = prio
+        from volcano_tpu.api.types import task_status_of_pod
+
+        self.p_status[row] = _STATUS_CODE[task_status_of_pod(pod)]
+        self.p_node[row] = self.nodes.key_row.get(pod.node_name, -1)
+        group = pod.meta.annotations.get(POD_GROUP_KEY, "")
+        if group:
+            group_key = f"{pod.meta.namespace}/{group}"
+            jrow = self.jobs.key_row.get(group_key, -1)
+            self.p_job[row] = jrow
+            if jrow < 0:
+                # group not seen yet (event ordering) or deleted: defer to
+                # the object path until the link resolves
+                self.unlinked_pods.add(key)
+                self._set_wait(key, group_key)
+            else:
+                self.unlinked_pods.discard(key)
+                self._clear_wait(key)
+        else:
+            # plain pod: joins its shadow gang (the object path's shadow
+            # PodGroup, cache.py:525-535) — one group-less pod no longer
+            # sends the whole cycle to the object path
+            self.unlinked_pods.discard(key)
+            self._clear_wait(key)
+            self.p_job[row] = self._ensure_shadow_row(
+                self._shadow_key_for(pod)
+            )
+        new_j = int(self.p_job[row])
+        if new_j != old_j:
+            self._shadow_ref(new_j, +1)
+            self._shadow_ref(old_j, -1)
+        self.p_best_effort[row] = resreq.is_empty()
+        self.p_dynamic[row] = self._pod_dynamic(pod)
+        self.p_has_vol[row] = bool(pod.volumes)
+        # a reused row's previous occupant must not leak its pod object
+        self.vol_pod_objs.pop(row, None)
+        if pod.volumes:
+            self.vol_pod_objs[row] = pod
+        # port/selector bit rows + expressibility (fills p_ports/p_selmatch/
+        # p_aff_*; labels recorded first so selector backfill sees them)
+        labels = pod.meta.labels or {}
+        self.p_labels[row] = labels
+        spec = pod.spec
+        expr_ok = True
+        pw_row = np.zeros(self.PW, np.uint32)
+        for port in spec.host_ports:
+            pid = self._intern_port(port)
+            if pid is None:
+                expr_ok = False
+            else:
+                pw_row[pid // 32] |= np.uint32(1 << (pid % 32))
+        req_row = np.zeros(self.SW, np.uint32)
+        anti_row = np.zeros(self.SW, np.uint32)
+        aff = spec.affinity
+        if aff is not None:
+            for sel, out_row in (
+                [(s, req_row) for s in aff.pod_affinity]
+                + [(s, anti_row) for s in aff.pod_anti_affinity]
+            ):
+                sid = self._intern_selector(sel)
+                if sid is None:
+                    expr_ok = False
+                else:
+                    out_row[sid // 32] |= np.uint32(1 << (sid % 32))
+        sm_row = np.zeros(self.SW, np.uint32)
+        if self.sel_ids and labels:
+            for sel_items, sid in self.sel_ids.items():
+                if all(labels.get(k) == v for k, v in sel_items):
+                    sm_row[sid // 32] |= np.uint32(1 << (sid % 32))
+        self.p_ports[row] = pw_row
+        self.p_selmatch[row] = sm_row
+        self.p_aff_req[row] = req_row
+        self.p_aff_anti[row] = anti_row
+        # expressible-dynamic: ports/affinity interned.  Volume
+        # expressibility is orthogonal and per-cycle (volsolve.py) — a
+        # claim-referencing pod's verdict joins the partition at snapshot
+        # build, not here
+        self.p_dyn_expr[row] = self.p_dynamic[row] and expr_ok
+        self.p_evictable[row] = not (
+            pod.spec.priority_class
+            in ("system-cluster-critical", "system-node-critical")
+            or pod.meta.namespace == "kube-system"
+        )
+        self.p_live[row] = True
+        self.p_rv[row] = pod.meta.resource_version
+        crow = int(self.p_node[row])
+        if crow >= 0:
+            self._add_contrib(row, crow)
+
+    def _drop_pod_row(self, key: str) -> None:
+        row = self.pods.release(key)
+        self.unlinked_pods.discard(key)
+        self._clear_wait(key)
+        if row is not None and self.p_live[row]:
+            self.p_live[row] = False
+            self._sub_contrib(row)
+            self.p_labels[row] = None
+            self.vol_pod_objs.pop(row, None)
+            self._shadow_ref(int(self.p_job[row]), -1)
+
+    def _del_pod(self, pod) -> None:
+        self._drop_pod_row(pod.meta.key)
+
+    def refresh_pod(self, key: str) -> None:
+        """Re-read one pod from the store (async-apply failure recovery)."""
+        pod = self.store.get("Pod", key)
+        if pod is None:
+            self._drop_pod_row(key)
+        else:
+            self._on_pod(pod)
+
+    # -- checkpoint (warm-restart prewarm, VERDICT r4 next #5) ---------------
+
+    #: checkpoint format version; bump on any row-table layout change
+    _CKPT_VERSION = 2  # r6: p_has_vol column + vol_pod_objs map
+    #: attributes that must not serialize (live handles)
+    _CKPT_SKIP = ("store", "_watches")
+
+    def save_checkpoint(self, path: str) -> None:
+        """Persist the full mirror state (row tables, interning maps,
+        cached objects) + the store's resource version, atomically.  A
+        restarted scheduler restores and DELTA-reconciles instead of
+        re-ingesting 100k objects — the warm-restart analogue of
+        WaitForCacheSync resuming from an informer cache (reference
+        cache.go:303-329)."""
+        import os
+        import pickle
+
+        payload = {
+            "version": self._CKPT_VERSION,
+            "scheduler_name": self.scheduler_name,
+            "default_queue": self.default_queue,
+            "store_rv": self.store.resource_version,
+            "store_uid": getattr(self.store, "uid", None),
+            "state": {
+                k: v for k, v in self.__dict__.items()
+                if k not in self._CKPT_SKIP
+            },
+        }
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+
+    def try_restore_checkpoint(self, path: str) -> bool:
+        """Restore a checkpoint and reconcile against the live store by
+        per-object resource version.  False (and untouched state) when
+        the file is unreadable, from another configuration, or from a
+        different store lineage — the caller falls back to a full sync."""
+        import pickle
+
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+        except Exception:  # noqa: BLE001 — unreadable/corrupt: full sync
+            return False
+        if (
+            payload.get("version") != self._CKPT_VERSION
+            or payload.get("scheduler_name") != self.scheduler_name
+            or payload.get("default_queue") != self.default_queue
+        ):
+            return False
+        try:
+            cur_rv = self.store.resource_version
+            cur_uid = getattr(self.store, "uid", None)
+        except Exception:  # noqa: BLE001 — store unreachable
+            return False
+        ck_uid = payload.get("store_uid")
+        if ck_uid is not None and cur_uid is not None and ck_uid != cur_uid:
+            return False  # different store lineage (rv alignment is luck)
+        if cur_rv < payload.get("store_rv", 0):
+            return False  # younger store: different lineage
+        self.__dict__.update(payload["state"])
+        self._reconcile_store()
+        self._synced = True
+        return True
+
+    def _reconcile_store(self) -> None:
+        """Delta-relist: re-ingest only objects whose resource version
+        moved while the checkpoint was cold, drop vanished ones.  Each
+        ingest is idempotent, so watch events that arrive concurrently
+        (the queues subscribed before this ran) re-apply harmlessly."""
+        store = self.store
+        # low-cardinality kinds: any drift forces the cheap full resync
+        qs = store.list("Queue")
+        q_ok = len(qs) == len(self.queues.key_row)
+        for q in qs:
+            r = self.queues.key_row.get(q.meta.name)
+            q_ok = q_ok and r is not None and bool(self.q_live[r]) and (
+                self.q_weight[r] == q.weight
+            )
+        pcs = {pc.meta.name: pc.value for pc in store.items("PriorityClass")}
+        defp = 0
+        for pc in store.items("PriorityClass"):
+            if getattr(pc, "global_default", False):
+                defp = pc.value
+        if (
+            not q_ok or pcs != self.priority_classes
+            or defp != self.default_priority
+        ):
+            self._resync(dims=self.dims)
+            return
+        seen_n = set()
+        for node in store.items("Node"):
+            seen_n.add(node.meta.name)
+            row = self.nodes.key_row.get(node.meta.name)
+            if (
+                row is None or not self.n_live[row]
+                or self.n_rv[row] != node.meta.resource_version
+            ):
+                self._on_node(node)
+        for name in [k for k in self.nodes.key_row if k not in seen_n]:
+            self._del_node_key(name)
+        seen_g = set()
+        for pg in store.items("PodGroup"):
+            seen_g.add(pg.meta.key)
+            row = self.jobs.key_row.get(pg.meta.key)
+            if (
+                row is None or not self.j_live[row]
+                or self.j_rv[row] != pg.meta.resource_version
+            ):
+                self._on_podgroup(pg)
+        for key in [
+            k for k in self.jobs.key_row
+            if not k.startswith("shadow/") and k not in seen_g
+        ]:
+            self._del_podgroup_key(key)
+        # PDBs: re-apply all, demote budget rows whose budget vanished
+        pdb_rows = set()
+        for pdb in store.items("PodDisruptionBudget"):
+            self._on_pdb(pdb)
+            if pdb.meta.owner is not None:
+                r = self.jobs.key_row.get(
+                    f"shadow/{pdb.meta.namespace}/{pdb.meta.owner[1]}"
+                )
+                if r is not None:
+                    pdb_rows.add(r)
+        for r in np.nonzero(self.j_pdb & self.j_live)[0]:
+            if int(r) not in pdb_rows:
+                self.j_min[r] = 1
+                self.j_pdb[r] = False
+                self._shadow_ref(int(r), 0)
+        seen_p = set()
+        for pod in store.items("Pod"):
+            if pod.spec.scheduler_name != self.scheduler_name:
+                continue
+            key = pod.meta.key
+            seen_p.add(key)
+            row = self.pods.key_row.get(key)
+            if (
+                row is None or not self.p_live[row]
+                or self.p_rv[row] != pod.meta.resource_version
+            ):
+                self._on_pod(pod)
+        for key in [k for k in self.pods.key_row if k not in seen_p]:
+            self._drop_pod_row(key)
+
+    # -- eligibility ----------------------------------------------------------
+
+    def ineligible_reason(self) -> Optional[str]:
+        """Only conditions the mirror structurally cannot express force the
+        object path.  Deliberately NOT here:
+          * group-less (plain) pods — they join shadow gang rows exactly
+            like the object cache's shadow PodGroups (cache.py:525-535),
+            with PDB-configured minimums (_on_pdb);
+          * PV/PVC/StorageClass objects — volume objects matter only to
+            pods that reference a claim, and those are dynamic pods;
+          * dynamic pods (host ports, pod (anti)affinity, volumes) — their
+            JOBS are partitioned out of the array solve and host-solved in
+            the residue sub-cycle (build_fast_snapshot / FastCycle)."""
+        if self.class_overflow:
+            return "predicate class cap exceeded"
+        if self.unlinked_pods:
+            return "pods whose PodGroup is absent"
+        return None
+

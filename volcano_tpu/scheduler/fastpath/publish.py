@@ -1,0 +1,368 @@
+"""Publish + close: the fast cycle's output layer.
+
+Turns the solve outputs into the columnar ``DecisionSegment`` (or the
+per-object bulk fallback), writes PodGroup statuses with the
+fingerprint/no-op discipline, renders fit-error aggregates, and validates
+volume binds.  Functions take the ``FastCycle`` driver (``fc``) as their
+first argument — split out of the original monolithic fastpath.py so the
+store-side shard boundary (store/partition.py) has one client-side
+producer module to mirror.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from volcano_tpu.api.types import PodGroupPhase
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.fastpath.mirror import (
+    _BOUND,
+    _FAILED,
+    _RUNNING,
+    _SUCCEEDED,
+)
+
+# -- publish + close -----------------------------------------------------
+
+def publish_and_close(fc, m, snap, aux, task_node, task_kind, ready,
+                      be_rows, be_nodes, be_per_job,
+                      write_status: bool = True,
+                      evicts=None,
+                      ready_status=None,
+                      pe_rows_solve=None,
+                      task_job_solve=None,
+                      task_req_solve=None) -> List[Tuple[str, str]]:
+    """``evicts``: (pod_key, reason) victims from the contention
+    passes, published through the evictor's bulk verb.
+    ``ready_status``: end-state per-job ready counts for the STATUS
+    section when preempt evictions ran after allocate (the bind filter
+    keeps allocate-time readiness, as the object path's dispatch
+    does).  ``pe_rows_solve``/``task_job_solve``: the task-array
+    layout ``task_node``/``task_kind`` index — the preempt pass may
+    have re-packed ``aux``/``snap`` since the solve (best-effort rows
+    joining), so the caller passes the solve-time arrays."""
+    from volcano_tpu.api.objects import PodGroupCondition, PodGroupStatus
+
+    n_jobs = aux["n_jobs"]
+    J = snap.job_min_available.shape[0]
+    jm = snap.job_min_available
+    pod_j = aux["pod_j"]
+    if pe_rows_solve is None:
+        pe_rows_solve = aux["pe_rows"]
+    if task_job_solve is None:
+        task_job_solve = snap.task_job
+    if task_req_solve is None:
+        task_req_solve = snap.task_req
+
+    express = np.nonzero(task_kind == 1)[0]
+    express_per_job = np.zeros(J, np.int64)
+    if express.size:
+        express_per_job += np.bincount(
+            task_job_solve[express], minlength=J
+        )
+    ready_final = ready.astype(np.int64) + be_per_job
+    if fc.gang_on:
+        gang_ready = ready_final >= jm
+    else:
+        gang_ready = np.ones(J, bool)
+
+    # -- binds (vectorized: row indices all the way) ---------------------
+    # columns only — key strings come out in ONE fancy-indexed sweep
+    # and node ids stay interned indices into snap.node_names, so the
+    # columnar segment builds straight from the solve outputs with no
+    # per-bind tuple/dict encode inside the timed publish phase
+    node_rows = aux["node_rows"]
+    pe_rows = pe_rows_solve
+    pub_express = express[gang_ready[task_job_solve[express]]] if express.size else express
+    row_key = m.pods.row_key
+    names = snap.node_names
+    bind_cols: List[Tuple[np.ndarray, np.ndarray]] = []
+    if pub_express.size:
+        prows = pe_rows[pub_express]
+        nidx = task_node[pub_express]
+        prows, nidx = fc._volume_bind_filter(m, prows, nidx, names)
+        m.p_status[prows] = _BOUND
+        m.p_node[prows] = node_rows[nidx]
+        bind_cols.append((prows, nidx))
+    if be_rows.size:
+        keep = gang_ready[pod_j[be_rows]]
+        pub_be, pub_be_nodes = be_rows[keep], be_nodes[keep]
+        if pub_be.size:
+            pub_be, pub_be_nodes = fc._volume_bind_filter(
+                m, pub_be, pub_be_nodes, names
+            )
+        if pub_be.size:
+            m.p_status[pub_be] = _BOUND
+            m.p_node[pub_be] = node_rows[pub_be_nodes]
+            bind_cols.append((pub_be, pub_be_nodes))
+    if bind_cols:
+        rows_all = np.concatenate([p for p, _ in bind_cols])
+        nidx_all = np.concatenate([n for _, n in bind_cols])
+        bind_keys = [row_key[r] for r in rows_all.tolist()]
+        # intern only the REFERENCED node names: a steady trickle
+        # cycle ships a table of its few touched nodes, not all 10k
+        uniq, inv = np.unique(nidx_all, return_inverse=True)
+        bind_table = [names[i] for i in uniq.tolist()]
+        bind_nodes = inv.tolist()
+    else:
+        bind_keys, bind_nodes, bind_table = [], [], []
+
+    # -- per-job status (framework._update_pod_group_status parity) -----
+    codes = aux["codes"]
+    live = aux["live"]
+
+    def per_job(code):
+        rows = np.nonzero(live & (codes == code))[0]
+        out = np.zeros(max(n_jobs, 1), np.int64)
+        if rows.size and n_jobs:
+            out[:n_jobs] = np.bincount(pod_j[rows], minlength=n_jobs)[:n_jobs]
+        return out
+
+    running_ct = per_job(_RUNNING)
+    failed_ct = per_job(_FAILED)
+    succeeded_ct = per_job(_SUCCEEDED)
+    store_alloc = per_job(_BOUND) + running_ct
+    allocated_after = store_alloc + express_per_job[: max(n_jobs, 1)] + be_per_job[: max(n_jobs, 1)]
+    ntasks_per_job = np.zeros(max(n_jobs, 1), np.int64)
+    lrows = np.nonzero(live)[0]
+    if lrows.size and n_jobs:
+        ntasks_per_job[:n_jobs] = np.bincount(
+            pod_j[lrows], minlength=n_jobs
+        )[:n_jobs]
+
+    status_ready = (
+        ready_final if ready_status is None
+        else ready_status.astype(np.int64)
+    )
+    unready = (
+        status_ready[:n_jobs] < jm[:n_jobs].astype(np.int64)
+        if fc.gang_on else np.zeros(n_jobs, bool)
+    )
+
+    # fit-error aggregates for unready jobs with pending express tasks
+    # (job_info.go:338-373): per-dim insufficient-node counts via a
+    # sorted idle column + searchsorted — O((N + U) log N), no [U, N]
+    # materialization.  Shadow gangs skip it: no PodGroup receives the
+    # message.
+    shadow_job = aux["shadow_job"]
+    fit_msgs = (
+        fc._fit_errors(snap, aux, task_node, task_kind,
+                         unready & ~shadow_job[: unready.shape[0]],
+                         task_req_solve)
+        if write_status else {}
+    )
+
+    inqueue_idx = m._phase_idx[PodGroupPhase.INQUEUE]
+    running_phase = m._phase_idx[PodGroupPhase.RUNNING]
+    unknown_phase = m._phase_idx[PodGroupPhase.UNKNOWN]
+    pending_phase = m._phase_idx[PodGroupPhase.PENDING]
+
+    ops: List[dict] = []
+    n_unsched_jobs = 0
+    for j in range(n_jobs) if write_status else ():
+        if shadow_job[j]:
+            # shadow gangs have no store PodGroup to write status to
+            # (the object path's close likewise skips pod_group-less
+            # jobs); their gang gate still filtered the binds above
+            continue
+        jrow = aux["job_rows"][j]
+        pg_key = m.jobs.row_key[jrow]
+        cur_phase = int(m.j_phase[jrow])
+        unsched = bool(unready[j])
+        if unsched:
+            n_unsched_jobs += 1
+            unready_n = int(jm[j] - status_ready[j])
+            fit = fit_msgs.get(j, "")
+            msg = (
+                f"{unready_n}/{int(ntasks_per_job[j])} tasks in gang "
+                f"unschedulable" + (f": {fit}" if fit else "")
+            )
+            metrics.update_unschedule_task_count(pg_key, unready_n)
+        else:
+            msg = ""
+        if int(running_ct[j]) and unsched:
+            phase = unknown_phase
+        elif int(allocated_after[j]) > int(jm[j]):
+            phase = running_phase
+        elif cur_phase != inqueue_idx:
+            phase = pending_phase
+        else:
+            phase = inqueue_idx
+        fp = (
+            phase, int(running_ct[j]), int(failed_ct[j]),
+            int(succeeded_ct[j]), msg,
+        )
+        if fc._status_fp.get(pg_key) == fp and not (
+            unsched and fc._last_unsched.get(pg_key) != msg
+        ):
+            continue
+        conditions = []
+        if unsched:
+            conditions.append(PodGroupCondition(
+                kind="Unschedulable", status="True",
+                reason="NotEnoughResources", message=msg,
+            ))
+            if fc._last_unsched.get(pg_key) != msg:
+                # warning event on condition transitions only (the gang
+                # plugin's recording rule)
+                from volcano_tpu import events as ev_mod
+                from volcano_tpu.api.objects import Metadata, new_uid
+
+                ops.append({"op": "create", "kind": "Event",
+                            "object": ev_mod.ClusterEvent(
+                                meta=Metadata(name=new_uid("event"),
+                                              namespace=""),
+                                involved=("PodGroup", pg_key),
+                                reason="Unschedulable",
+                                message=msg, type=ev_mod.WARNING)})
+                fc._last_unsched[pg_key] = msg
+                metrics.register_job_retry(pg_key)
+        else:
+            fc._last_unsched.pop(pg_key, None)
+        status = PodGroupStatus(
+            phase=fc._phase_list[phase],
+            conditions=conditions,
+            running=int(running_ct[j]),
+            succeeded=int(succeeded_ct[j]),
+            failed=int(failed_ct[j]),
+        )
+        fc._status_fp[pg_key] = fp
+        ops.append({"op": "patch", "kind": "PodGroup", "key": pg_key,
+                    "fields": {"status": status}})
+    if write_status:
+        metrics.update_unschedule_job_count(n_unsched_jobs)
+
+    # -- ship -----------------------------------------------------------
+    binds: List[Tuple[str, str]] = []
+    shipped = False
+    if fc.columnar_on and fc.cache.applier is not None:
+        from volcano_tpu.store.segment import DecisionSegment
+
+        seg = DecisionSegment.build(
+            bind_keys, bind_nodes, bind_table, evicts
+        )
+        shipped = fc.cache.publish_segment(seg)
+        if shipped:
+            binds = seg.bind_pairs()
+    if not shipped:
+        # per-object bulk fallback (columnarPublish: false, or sync
+        # apply mode where the Binder/Evictor seams own the writes)
+        binds = list(zip(
+            bind_keys, (bind_table[n] for n in bind_nodes)
+        ))
+        fc.cache.bind_bulk(binds)
+        if evicts:
+            fc.cache.evict_bulk(evicts)
+    if ops:
+        applier = fc.cache.applier
+        if applier is not None:
+            applier.submit_ops(ops)
+        else:
+            try:
+                results = fc.store.bulk(ops)
+            except Exception as e:  # noqa: BLE001 — retried next cycle
+                for op in ops:
+                    fc.cache._record_err(
+                        "status", op.get("key", op["kind"]), e
+                    )
+            else:
+                for op, err in zip(ops, results):
+                    if err is not None:
+                        fc.cache._record_err(
+                            "status", op.get("key", op["kind"]),
+                            RuntimeError(err),
+                        )
+    return binds
+
+def volume_bind_filter(fc, m, prows, nidx, names):
+    """allocate_volumes + bind_volumes for published binds of claim-
+    referencing pods — VALIDATION, not placement: the solve already
+    chose the nodes (device volume bitsets / express non-constraining
+    claims), so this is where dynamic-class claims provision their PV
+    and static assumptions commit.  A concurrent store writer (PV
+    vanished, claim re-bound under the solve) surfaces as the
+    existing ``VolumeBindingError`` race: the bind is dropped, the
+    pod stays pending in mirror and store, and next cycle retries —
+    the same handling as the object paths' replay/bulk apply.
+    Volume-free cycles exit on one vectorized check."""
+    hasv = m.p_has_vol[prows]
+    if not hasv.any():
+        return prows, nidx
+    from volcano_tpu.scheduler.cache import VolumeBindingError
+    from volcano_tpu.scheduler.model import TaskInfo
+
+    if not fc._vol_session_cleared:
+        # fresh per-cycle binder view (claims/PV lists are
+        # session-cached); the flag resets each try_run
+        fc.cache.clear_session_volumes()
+        fc._vol_session_cleared = True
+    keep = np.ones(prows.size, bool)
+    for i in np.nonzero(hasv)[0]:
+        pod = m.vol_pod_objs.get(int(prows[i]))
+        if pod is None or not pod.volumes:
+            continue
+        task = TaskInfo(pod)
+        try:
+            fc.cache.allocate_volumes(task, names[int(nidx[i])])
+            fc.cache.bind_volumes(task)
+        except VolumeBindingError as e:
+            fc.cache._record_err("bind_volumes", pod.meta.key, e)
+            keep[i] = False
+    if keep.all():
+        return prows, nidx
+    return prows[keep], nidx[keep]
+
+def fit_errors(fc, snap, aux, task_node, task_kind, unready,
+               task_req_solve=None):
+    n_jobs = aux["n_jobs"]
+    if task_req_solve is None:
+        task_req_solve = snap.task_req
+    if not fc.gang_on or not unready.any():
+        return {}
+    with_pend = unready & (snap.job_ntasks[:n_jobs] > 0)
+    ujobs = np.nonzero(with_pend)[0]
+    if not ujobs.size:
+        return {}
+    from volcano_tpu.scheduler.model import render_fit_error
+
+    n_nodes = aux["n_nodes"]
+    idle_after = snap.node_idle[:n_nodes].copy()
+    placed = np.nonzero(task_kind == 1)[0]
+    if placed.size:
+        np.subtract.at(
+            idle_after, task_node[placed], task_req_solve[placed]
+        )
+    total = int(snap.node_valid[:n_nodes].sum())
+    heads = snap.job_start[ujobs]
+    head_cls = snap.task_class[heads]
+    req = snap.task_req[heads]  # [U, R]
+    out = {}
+    R = req.shape[1]
+    counts = np.zeros((ujobs.size, R), np.int64)
+    excluded = np.zeros(ujobs.size, np.int64)
+    # one sorted-idle column set per predicate class in play
+    for cid in np.unique(head_cls):
+        rows = np.nonzero(head_cls == cid)[0]
+        mask = snap.class_node_mask[cid][:n_nodes] & snap.node_valid[:n_nodes]
+        excluded[rows] = total - int(mask.sum())
+        masked = idle_after[mask]
+        for r in range(R):
+            col = np.sort(masked[:, r])
+            # nodes with idle < req == index of first element >= req
+            counts[rows, r] = np.searchsorted(
+                col, req[rows, r], side="left"
+            )
+    for u, j in enumerate(ujobs):
+        reasons = {}
+        if excluded[u]:
+            reasons["node(s) excluded by predicates"] = int(excluded[u])
+        for r, dim in enumerate(snap.dims):
+            c = int(counts[u, r])
+            if c:
+                reasons[f"insufficient {dim}"] = c
+        if reasons:
+            out[int(j)] = render_fit_error(total, reasons)
+    return out
+

@@ -1,0 +1,766 @@
+"""Vectorized snapshot build + dynamic-job classifier for the fast cycle.
+
+The snapshot layer of the fastpath package: turns the ArrayMirror's row
+tables into a bucketed ``TensorSnapshot`` (semantics identical to
+``snapshot.build_tensor_snapshot`` — asserted by tests/test_fastpath.py),
+classifies dynamic/volume jobs into express / device-dynamic / residue,
+and builds the device inputs for the dynamic solve and the victim pool.
+Everything here is host-side numpy; the solve itself is dispatched by
+``fastpath.cycle`` through ``tensor_actions``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu.api.types import PodGroupPhase
+from volcano_tpu.scheduler.fastpath.mirror import (
+    _ALLOCATED_CODES,
+    _INT32_MAX,
+    _PENDING,
+    _READY_CODES,
+    _RELEASING,
+    _RUNNING,
+    ArrayMirror,
+)
+from volcano_tpu.scheduler.snapshot import TensorSnapshot, _bucket
+
+class _TiersOnly:
+    """Minimal ssn stand-in for TensorBackend (it reads only .tiers)."""
+
+    def __init__(self, tiers):
+        self.tiers = tiers
+
+
+def _task_arrays(m: ArrayMirror, pe_rows: np.ndarray, pod_j: np.ndarray,
+                 n_jobs: int, N: int, R: int, node_rows_arr: np.ndarray,
+                 n_live_ct: int, nodeaffinity_weight: float,
+                 job_start: np.ndarray, job_ntasks: np.ndarray,
+                 min_T: int = 1) -> dict:
+    """Task/class arrays from sorted pending express rows.  Called at
+    snapshot build, and AGAIN by the fast reclaim pass after it pipelines
+    preemptors (the kernels walk contiguous job_start..+job_ntasks row
+    ranges, so a consumed row forces a re-pack — the object path gets the
+    same effect from backend.invalidate() between actions).  ``job_start``
+    and ``job_ntasks`` are written in place.  ``min_T`` keeps a re-pack at
+    the cycle's original task bucket so the preempt solve reuses the shape
+    the cycle (and prewarm) already compiled instead of re-bucketing down
+    and JIT-compiling mid-cycle."""
+    n_tasks = pe_rows.size
+    T = max(_bucket(max(n_tasks, 1)), min_T)
+    task_req = np.zeros((T, R), np.float32)
+    task_job = np.zeros((T,), np.int32)
+    task_valid = np.zeros((T,), bool)
+    job_start[:] = 0
+    job_ntasks[:] = 0
+    if n_tasks:
+        task_req[:n_tasks] = m.p_req[pe_rows]
+        task_job[:n_tasks] = pod_j[pe_rows]
+        task_valid[:n_tasks] = True
+        counts = np.bincount(pod_j[pe_rows], minlength=n_jobs)[:n_jobs]
+        job_ntasks[:n_jobs] = counts.astype(np.int32)
+        starts = np.zeros(n_jobs, np.int64)
+        if n_jobs > 1:
+            np.cumsum(counts[:-1], out=starts[1:])
+        job_start[:n_jobs] = starts.astype(np.int32)
+
+    # predicate classes: remap mirror-global class ids to snapshot indices
+    # in first-appearance order over the (sorted) task rows — the object
+    # builder's insertion-order class indexing (snapshot.py:444-451) —
+    # then gather the lazily-filled per-(class, node) mask/score cells
+    task_class_arr = np.zeros((T,), np.int32)
+    if n_tasks:
+        g_cls = m.p_class[pe_rows].astype(np.int64)
+        uniq, first_idx = np.unique(g_cls, return_index=True)
+        order = np.argsort(first_idx, kind="stable")
+        lut = np.empty(uniq.size, np.int32)
+        lut[order] = np.arange(uniq.size, dtype=np.int32)
+        task_class_arr[:n_tasks] = lut[np.searchsorted(uniq, g_cls)]
+        cids_in_order = uniq[order]  # snapshot class idx -> mirror class id
+    else:
+        cids_in_order = np.zeros(0, np.int64)
+    # class axis bucketed like the object snapshot (snapshot.py): a fresh
+    # class mid-cycle must not change the [C, N] shape and trigger an
+    # in-cycle storm-kernel recompile
+    C = _bucket(max(cids_in_order.size, 1), minimum=4)
+    class_mask = np.zeros((C, N), bool)
+    class_score = np.zeros((C, N), np.float32)
+    if cids_in_order.size and n_live_ct:
+        m.fill_class_cells(cids_in_order, node_rows_arr, nodeaffinity_weight)
+        sel = np.ix_(cids_in_order, node_rows_arr)
+        nC = cids_in_order.size
+        class_mask[:nC, :n_live_ct] = m.cls_mask[sel]
+        class_score[:nC, :n_live_ct] = m.cls_score[sel]
+    else:
+        # no pending tasks: all-True row, matching snapshot.py:498-499
+        class_mask[:, :n_live_ct] = True
+    return {
+        "n_tasks": n_tasks,
+        "task_req": task_req,
+        "task_job": task_job,
+        "task_class": task_class_arr,
+        "task_valid": task_valid,
+        "class_mask": class_mask,
+        "class_score": class_score,
+        "pod_keys": [m.pods.row_key[r] for r in pe_rows],
+    }
+
+
+def build_victim_pool(m: ArrayMirror, snap: TensorSnapshot, aux: dict) -> None:
+    """Fill snap.run_* (the preempt/reclaim victim pool, snapshot.py
+    505-539 semantics) from mirror rows: running tasks in node-resident
+    insertion order — nodes in snapshot order, within a node by arrival
+    (the object pool iterates node.tasks insertion order; arrival-vs-uid
+    rank is the documented divergence).  Called lazily only on cycles
+    whose prechecks say contention work may exist; adds
+    aux["run_rows"] = pool index -> mirror pod row."""
+    live, codes, pod_j = aux["live"], aux["codes"], aux["pod_j"]
+    R = snap.node_idle.shape[1]
+    node_rows_arr = aux["node_rows"]
+    n_idx_of_row = np.full(len(m.n_live), -1, np.int32)
+    if node_rows_arr.size:
+        n_idx_of_row[node_rows_arr] = np.arange(
+            node_rows_arr.size, dtype=np.int32
+        )
+    rrows = np.nonzero(live & (codes == _RUNNING))[0]
+    rnode = rrows
+    if rrows.size:
+        rn = m.p_node[rrows]
+        ok = rn >= 0
+        rrows, rn = rrows[ok], rn[ok]
+        if rrows.size:
+            ok = m.n_live[rn]
+            rrows, rn = rrows[ok], rn[ok]
+        rnode = n_idx_of_row[rn] if rrows.size else rn
+        if rrows.size:
+            ok = rnode >= 0
+            rrows, rnode = rrows[ok], rnode[ok]
+        if rrows.size:
+            order2 = np.lexsort((m.p_rank[rrows], rnode))
+            rrows, rnode = rrows[order2], rnode[order2]
+    nv = rrows.size
+    V = _bucket(max(nv, 1))
+    run_req = np.zeros((V, R), np.float32)
+    run_node = np.zeros((V,), np.int32)
+    run_job = np.zeros((V,), np.int32)
+    run_prio = np.zeros((V,), np.int32)
+    run_rank = np.zeros((V,), np.int32)
+    run_evictable = np.zeros((V,), bool)
+    run_valid = np.zeros((V,), bool)
+    if nv:
+        run_req[:nv] = m.p_resreq[rrows]
+        run_node[:nv] = rnode
+        run_job[:nv] = pod_j[rrows]
+        run_prio[:nv] = m.p_prio[rrows]
+        # dense rank over the pool by arrival (uid-rank stand-in)
+        run_rank[:nv] = np.argsort(np.argsort(m.p_rank[rrows])).astype(np.int32)
+        run_evictable[:nv] = m.p_evictable[rrows]
+        run_valid[:nv] = True
+    snap.run_uids = [m.pods.row_key[r] for r in rrows]
+    snap.run_req, snap.run_node, snap.run_job = run_req, run_node, run_job
+    snap.run_prio, snap.run_rank = run_prio, run_rank
+    snap.run_evictable, snap.run_valid = run_evictable, run_valid
+    aux["run_rows"] = rrows
+
+
+def _pack_u32(bits: np.ndarray) -> np.ndarray:
+    """[n, W*32] bool -> [n, W] u32 bitset words."""
+    n, nbits = bits.shape
+    W = nbits // 32
+    weights = (np.uint64(1) << np.arange(32, dtype=np.uint64))
+    return (
+        (bits.reshape(n, W, 32).astype(np.uint64) * weights)
+        .sum(axis=2).astype(np.uint32)
+    )
+
+
+def _unpack_f32(words: np.ndarray) -> np.ndarray:
+    """[n, W] u32 bitset words -> [n, W*32] f32 0/1 vectors."""
+    n, W = words.shape
+    shifts = np.arange(32, dtype=np.uint32)
+    return (
+        ((words[:, :, None] >> shifts) & 1)
+        .astype(np.float32).reshape(n, W * 32)
+    )
+
+
+def build_dyn_solve_inputs(m: ArrayMirror, snap: TensorSnapshot, aux: dict,
+                           nodeaffinity_weight: float,
+                           task_node, task_kind, be_rows, be_nodes,
+                           ready) -> Optional[dict]:
+    """Device inputs for the dynamic (host-ports / pod-affinity) exact
+    solve: the dyn-expr jobs' pending task arrays, the post-express node/
+    job/queue state, and the resident port/selector bitsets — including
+    the labels of pods the express solve and backfill placed THIS cycle
+    (host parity: the residue pass sees published binds via the overlay).
+    Returns None when no dyn-expr job has pending work."""
+    n_jobs = aux["n_jobs"]
+    nJ = max(n_jobs, 1)
+    pod_j = aux["pod_j"]
+    P = aux["codes"].shape[0]
+    dyn_expr = aux["dyn_expr_job"]
+    de_of_pod = (pod_j >= 0) & dyn_expr[np.clip(pod_j, 0, nJ - 1)]
+    pend = (
+        aux["live"] & (aux["codes"] == _PENDING)
+        & ~m.p_best_effort[:P] & de_of_pod
+    )
+    rows = np.nonzero(pend)[0]
+    if not rows.size:
+        return None
+    rows = rows[np.lexsort(
+        (m.p_rank[rows], -m.p_prio[rows], pod_j[rows])
+    )]
+    N = snap.node_idle.shape[0]
+    R = snap.node_idle.shape[1]
+    J = snap.job_queue.shape[0]
+    job_start = np.zeros(J, np.int32)
+    job_ntasks = np.zeros(J, np.int32)
+    ta = _task_arrays(
+        m, rows, pod_j, n_jobs, N, R, aux["node_rows"],
+        aux["n_nodes"], nodeaffinity_weight, job_start, job_ntasks,
+    )
+    T = ta["task_req"].shape[0]
+
+    # port bitsets / selector match vectors for the dyn tasks (zero rows
+    # for the job's plain pending members — they ride the same solve)
+    S = 32 * m.SW
+
+    def pad(arr):
+        out = np.zeros((T,) + arr.shape[1:], arr.dtype)
+        out[: rows.size] = arr
+        return out
+
+    # port/selector payloads stay PACKED u32 words on the wire to the
+    # device (the solve wrapper unpacks them in-jit): the unpacked
+    # [T, bits] f32/bool forms are ~30 MB at bench scale and the tunnel's
+    # host->device bandwidth (~30 MB/s) made the upload — not the solve —
+    # the dynamic pass's dominant cost
+    task_ports_w = pad(m.p_ports[rows])
+    task_aff_w = pad(m.p_aff_req[rows])
+    task_anti_w = pad(m.p_aff_anti[rows])
+    task_self_w = pad(m.p_selmatch[rows])
+
+    # resident port bits / selector match counts per node + this cycle's
+    # express/backfill placements (counts feed both the feasibility
+    # checks and the interpod affinity score, nodeorder.py:61-74)
+    node_rows_arr = aux["node_rows"]
+    n_live_ct = aux["n_nodes"]
+    node_ports_w = np.zeros((N, m.PW), np.uint32)
+    node_selcnt = np.zeros((N, S), np.int32)
+    if n_live_ct:
+        node_ports_w[:n_live_ct] = _pack_u32(m.n_port_cnt[node_rows_arr] > 0)
+        node_selcnt[:n_live_ct] = m.n_sel_cnt[node_rows_arr]
+    placed = np.nonzero(task_kind > 0)[0]
+    if placed.size:
+        # express pods carry no ports (they would be dynamic) but their
+        # labels can satisfy selectors; most match nothing — skip them
+        pm = m.p_selmatch[aux["pe_rows"][placed]]
+        nz = pm.any(axis=1)
+        if nz.any():
+            np.add.at(
+                node_selcnt, task_node[placed[nz]],
+                _unpack_f32(pm[nz]).astype(np.int32),
+            )
+    if be_rows.size:
+        bm = m.p_selmatch[be_rows]
+        nz = bm.any(axis=1)
+        if nz.any():
+            np.add.at(
+                node_selcnt, be_nodes[nz],
+                _unpack_f32(bm[nz]).astype(np.int32),
+            )
+    node_selcnt = node_selcnt.astype(np.uint16)
+
+    # post-express/backfill node + share state (matches the device state
+    # at the express solve's end; backfilled BE pods add task slots only)
+    idle2 = snap.node_idle.copy()
+    rel2 = snap.node_releasing.copy()
+    used2 = snap.node_used.copy()
+    tc2 = snap.node_task_count.copy()
+    job_alloc2 = snap.job_alloc_init.copy()
+    queue_alloc2 = snap.queue_alloc_init.copy()
+    if placed.size:
+        alloc_rows = placed[task_kind[placed] == 1]
+        pipe_rows = placed[task_kind[placed] == 2]
+        np.subtract.at(
+            idle2, task_node[alloc_rows], snap.task_req[alloc_rows]
+        )
+        np.subtract.at(
+            rel2, task_node[pipe_rows], snap.task_req[pipe_rows]
+        )
+        np.add.at(used2, task_node[placed], snap.task_req[placed])
+        np.add.at(tc2, task_node[placed], 1)
+        np.add.at(job_alloc2, snap.task_job[placed], snap.task_req[placed])
+        np.add.at(
+            queue_alloc2, snap.job_queue[snap.task_job[placed]],
+            snap.task_req[placed],
+        )
+    if be_rows.size:
+        np.add.at(tc2, be_nodes, 1)
+
+    sched_mask = np.zeros(J, bool)
+    sched_mask[:n_jobs] = dyn_expr[:n_jobs]
+    # volume payload (volsolve.py): packed feasible-node bitsets + the
+    # attach-capacity tensor for the routed tasks; None when no routed
+    # task carries device volume state, so port/affinity-only waves keep
+    # their existing (volsel-free) kernel specialization
+    volsel = None
+    vp = aux.get("volume_partition")
+    if vp is not None:
+        volsel = vp.payload(rows, ta["task_req"].shape[0], N)
+    return {
+        "rows": rows,
+        "volsel": volsel,
+        "task_req": ta["task_req"], "task_job": ta["task_job"],
+        "task_class": ta["task_class"], "task_valid": ta["task_valid"],
+        "class_mask": ta["class_mask"], "class_score": ta["class_score"],
+        "job_start": job_start, "job_ntasks": job_ntasks,
+        "job_schedulable": snap.job_schedulable & sched_mask,
+        "job_ready_init": ready.astype(np.int32),
+        "job_alloc_init": job_alloc2,
+        "queue_alloc_init": queue_alloc2,
+        "node_idle": idle2, "node_releasing": rel2, "node_used": used2,
+        "node_task_count": tc2,
+        "node_ports_w": node_ports_w, "node_selcnt": node_selcnt,
+        "task_ports_w": task_ports_w, "task_aff_w": task_aff_w,
+        "task_anti_w": task_anti_w, "task_self_w": task_self_w,
+    }
+
+
+def _residue_counts(residue_reason_job: Dict[int, str],
+                    pend_any_per_job: np.ndarray, n_jobs: int) -> Dict[str, int]:
+    """Pending-task totals per residue reason class (the
+    volcano_residue_tasks_total increments for this cycle)."""
+    counts: Dict[str, int] = {}
+    for j, reason in residue_reason_job.items():
+        if j < n_jobs:
+            counts[reason] = counts.get(reason, 0) + int(pend_any_per_job[j])
+    return counts
+
+
+def build_fast_snapshot(
+    m: ArrayMirror, nodeaffinity_weight: float = 1.0,
+    dyn_batch: Optional[Tuple[str, int]] = None,
+) -> Tuple[Optional[TensorSnapshot], dict]:
+    """Vectorized TensorSnapshot from the mirror — semantics identical to
+    snapshot.build_tensor_snapshot on the same store (asserted by
+    tests/test_fastpath.py), including the static predicate-class
+    factorization (selectors, node affinity, tolerations — computed by the
+    same shared helpers, cached per (class, node) cell).  Returns
+    (snapshot, aux) where aux carries the row<->key mappings the publish
+    step needs; snapshot is None when there are no live queues (nothing
+    schedulable — object path would drop every job too).
+    """
+    from volcano_tpu.api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_SCALAR
+
+    R = len(m.dims)
+    eps = np.array(
+        [MIN_MILLI_CPU, MIN_MEMORY] + [MIN_SCALAR] * (R - 2), np.float32
+    )
+
+    # -- queues (sorted by uid, snapshot.py:327) -----------------------------
+    q_names = sorted(m.queues.key_row)
+    if not q_names:
+        return None, {}
+    q_idx_of_row = np.full(len(m.q_live), -1, np.int32)
+    for i, name in enumerate(q_names):
+        q_idx_of_row[m.queues.key_row[name]] = i
+    Q = _bucket(max(len(q_names), 1), minimum=4)
+    queue_weight = np.zeros((Q,), np.float32)
+    queue_valid = np.zeros((Q,), bool)
+    for i, name in enumerate(q_names):
+        queue_weight[i] = m.q_weight[m.queues.key_row[name]]
+        queue_valid[i] = True
+
+    # -- nodes (store arrival order == object snapshot order) ----------------
+    node_rows = [
+        m.nodes.key_row[k] for k in m.nodes.key_row
+    ]  # dict preserves acquire order; rows are never reused for nodes
+    n_live_ct = len(node_rows)
+    N = _bucket(max(n_live_ct, 1))
+    node_rows_arr = np.asarray(node_rows, np.int64) if node_rows else np.zeros(0, np.int64)
+    n_idx_of_row = np.full(len(m.n_live), -1, np.int32)
+    n_idx_of_row[node_rows_arr] = np.arange(n_live_ct, dtype=np.int32)
+
+    node_alloc = np.zeros((N, R), np.float32)
+    node_max_tasks = np.full((N,), _INT32_MAX, np.int32)
+    node_valid = np.zeros((N,), bool)
+    if n_live_ct:
+        node_alloc[:n_live_ct] = m.n_alloc[node_rows_arr]
+        node_max_tasks[:n_live_ct] = m.n_max_tasks[node_rows_arr]
+        node_valid[:n_live_ct] = True
+
+    # -- jobs (sorted by PodGroup resource_version, cache.py:415) ------------
+    job_rows = np.nonzero(m.j_live)[0]
+    # drop REAL jobs whose queue is missing (cache.py:420-424) — their pods
+    # too; shadow gangs stay like the object builder's (which never
+    # queue-checks them): queue -1 means the solve can't allocate them but
+    # their residents still count toward node usage
+    job_q_idx = np.where(
+        job_rows.size and (m.j_queue[job_rows] >= 0),
+        q_idx_of_row[np.clip(m.j_queue[job_rows], 0, None)],
+        -1,
+    ) if job_rows.size else np.zeros(0, np.int32)
+    kept = (job_q_idx >= 0) | m.j_shadow[job_rows]
+    job_rows = job_rows[kept]
+    job_q_idx = job_q_idx[kept]
+    order = np.argsort(m.j_rv[job_rows], kind="stable")
+    job_rows = job_rows[order]
+    job_q_idx = job_q_idx[order]
+    n_jobs = job_rows.size
+    J = _bucket(max(n_jobs, 1), minimum=4)
+    j_idx_of_row = np.full(len(m.j_live), -1, np.int32)
+    j_idx_of_row[job_rows] = np.arange(n_jobs, dtype=np.int32)
+
+    job_queue = np.zeros((J,), np.int32)
+    job_min = np.zeros((J,), np.int32)
+    job_prio = np.zeros((J,), np.int32)
+    job_ready_init = np.zeros((J,), np.int32)
+    job_alloc_init = np.zeros((J, R), np.float32)
+    job_schedulable = np.zeros((J,), bool)
+    job_start = np.zeros((J,), np.int32)
+    job_ntasks = np.zeros((J,), np.int32)
+    pending_phase = m._phase_idx[PodGroupPhase.PENDING]
+    if n_jobs:
+        job_queue[:n_jobs] = job_q_idx
+        job_min[:n_jobs] = m.j_min[job_rows]
+        job_prio[:n_jobs] = m.j_prio[job_rows]
+        job_schedulable[:n_jobs] = m.j_phase[job_rows] != pending_phase
+
+    # -- pods: usage, shares, pending rows -----------------------------------
+    P = len(m.p_live)
+    live = m.p_live[:P].copy()
+    pj = np.where(live, m.p_job[:P], -1)
+    # pods of dropped/missing jobs are skipped wholesale (cache.py:474-475)
+    pod_j = np.where(pj >= 0, j_idx_of_row[np.clip(pj, 0, None)], -1)
+    live &= pod_j >= 0
+    codes = m.p_status[:P]
+
+    # node usage (NodeInfo add_task semantics, model.py:219-231: every
+    # resident subtracts idle — sequential clamped sub == max(alloc-sum,0) —
+    # releasing residents additionally accumulate the releasing pool)
+    pn = np.where(live, m.p_node[:P], -1)
+    res_rows = np.nonzero(live & (pn >= 0))[0]
+    if res_rows.size:
+        res_rows = res_rows[m.n_live[pn[res_rows]]]  # node vanished: skip
+    res_nodes = n_idx_of_row[pn[res_rows]] if res_rows.size else res_rows
+    if res_rows.size:
+        ok = res_nodes >= 0
+        res_rows, res_nodes = res_rows[ok], res_nodes[ok]
+    node_used = np.zeros((N, R), np.float32)
+    node_rel = np.zeros((N, R), np.float32)
+    node_tc = np.zeros((N,), np.int32)
+    if res_rows.size:
+        np.add.at(node_used, res_nodes, m.p_resreq[res_rows])
+        rel_rows = codes[res_rows] == _RELEASING
+        if rel_rows.any():
+            np.add.at(node_rel, res_nodes[rel_rows], m.p_resreq[res_rows[rel_rows]])
+        node_tc[:] = np.bincount(res_nodes, minlength=N).astype(np.int32)
+    node_idle = np.maximum(node_alloc - node_used, 0.0)
+
+    # shares (snapshot.py:375-393): allocated statuses charge job/queue
+    # alloc + queue request; pending charges queue request; ready counts
+    charge = live & np.isin(codes, _ALLOCATED_CODES)
+    ready_m = live & np.isin(codes, _READY_CODES)
+    pend_all = live & (codes == _PENDING)
+    queue_alloc = np.zeros((Q, R), np.float32)
+    queue_request = np.zeros((Q, R), np.float32)
+    queue_participates = np.zeros((Q,), bool)
+    if n_jobs:
+        queue_participates[job_q_idx[job_q_idx >= 0]] = True
+    ch_rows = np.nonzero(charge)[0]
+    if ch_rows.size:
+        np.add.at(job_alloc_init, pod_j[ch_rows], m.p_resreq[ch_rows])
+        # queue shares skip queue-less (shadow) jobs, snapshot.py:386-391
+        chq = ch_rows[job_queue[pod_j[ch_rows]] >= 0]
+        np.add.at(queue_alloc, job_queue[pod_j[chq]], m.p_resreq[chq])
+        np.add.at(queue_request, job_queue[pod_j[chq]], m.p_resreq[chq])
+    pd_rows = np.nonzero(pend_all)[0]
+    if pd_rows.size:
+        pdq = pd_rows[job_queue[pod_j[pd_rows]] >= 0]
+        np.add.at(queue_request, job_queue[pod_j[pdq]], m.p_resreq[pdq])
+    rd_rows = np.nonzero(ready_m)[0]
+    if rd_rows.size:
+        job_ready_init[:n_jobs] = np.bincount(
+            pod_j[rd_rows], minlength=n_jobs
+        ).astype(np.int32)[:n_jobs]
+
+    # -- volume verdicts (volsolve.py) ---------------------------------------
+    # once per cycle, and only when claim-referencing pending pods exist
+    # (volume-free clusters do zero work here and grow no vol_solve
+    # phase): each referenced claim interns to a feasible-node bitset +
+    # attach-capacity group, each pod to express / device / residue
+    vol_dev = None
+    vol_res_mask = None
+    vol_res_reason: Dict[int, str] = {}
+    volume_partition = None
+    vol_solve_s = 0.0
+    vol_rows = np.nonzero(pend_all & m.p_has_vol[:P])[0]
+    if vol_rows.size:
+        t0v = time.perf_counter()
+        from volcano_tpu.scheduler.volsolve import (
+            RESIDUE as _VOL_RESIDUE, VolumeCycleIndex, VolumePartition,
+        )
+
+        vidx = VolumeCycleIndex(
+            m.store, [m.node_objs[r] for r in node_rows], n_live_ct
+        )
+        volume_partition = VolumePartition(vidx)
+        for r in vol_rows:
+            pod = m.vol_pod_objs.get(int(r))
+            if pod is None:
+                continue
+            ns = pod.meta.namespace
+            volume_partition.classify_task(
+                int(r), [f"{ns}/{name}" for name in pod.volumes]
+            )
+        vol_dev = np.zeros(P, bool)
+        vol_res_mask = np.zeros(P, bool)
+        for r in vol_rows:
+            tv = volume_partition.task_volumes.get(int(r))
+            if tv is None:
+                continue
+            if tv.verdict == "device":
+                vol_dev[r] = True
+            elif tv.verdict == _VOL_RESIDUE:
+                vol_res_mask[r] = True
+                vol_res_reason[int(r)] = tv.reason
+        vol_solve_s = time.perf_counter() - t0v
+
+    # -- dynamic-job partition (snapshot.py:414-436) -------------------------
+    # a job with any live PENDING resident-state pod (host ports, pod
+    # (anti)affinity, constraining volumes) is excluded WHOLE from the
+    # array solve.  Jobs whose dynamic pending pods are ALL
+    # port/selector/volume-expressible and non-best-effort run the DEVICE
+    # dynamic solve after the express pass (dyn_expr_job); the rest go to
+    # the host residue sub-cycle (within-job task order intact, gang
+    # atomicity preserved).  Resident dynamic pods need no exclusion:
+    # their usage is plain resources and express pods carry no
+    # resident-state predicates of their own.
+    nJ = max(n_jobs, 1)
+    dyn_job = np.zeros(nJ, bool)
+    dyn_pod_mask = pend_all & m.p_dynamic[:P]
+    if vol_dev is not None:
+        dyn_pod_mask = dyn_pod_mask | (pend_all & (vol_dev | vol_res_mask))
+    dyn_rows = np.nonzero(dyn_pod_mask)[0]
+    if dyn_rows.size and n_jobs:
+        dyn_job[np.unique(pod_j[dyn_rows])] = True
+    resid_job = np.zeros(nJ, bool)
+    residue_reason_job: Dict[int, str] = {}
+    if dyn_rows.size and n_jobs:
+        # non-expressible dynamic pods (inexpressible volume shapes /
+        # intern-cap overflow) force the host path for their whole job
+        nonexpr_row = m.p_dynamic[:P] & ~m.p_dyn_expr[:P]
+        if vol_res_mask is not None:
+            nonexpr_row = nonexpr_row | vol_res_mask
+        nonexpr = dyn_rows[nonexpr_row[dyn_rows]]
+        if nonexpr.size:
+            for r in nonexpr:
+                j = int(pod_j[r])
+                residue_reason_job.setdefault(
+                    j, vol_res_reason.get(int(r), "intern-overflow")
+                )
+            resid_job[np.unique(pod_j[nonexpr])] = True
+        # so does ANY pending best-effort pod of a dynamic job: its
+        # backfill needs resident-state predicates and the device dynamic
+        # pass has no backfill stage
+        be_pend = np.nonzero(pend_all & m.p_best_effort[:P])[0]
+        if be_pend.size:
+            be_j = np.unique(pod_j[be_pend])
+            for j in be_j[dyn_job[be_j]]:
+                residue_reason_job.setdefault(int(j), "best-effort")
+            resid_job[be_j[dyn_job[be_j]]] = True
+    if volume_partition is not None:
+        # claim-group contention closure (volsolve.py owns the
+        # invariant): jobs sharing a capacity group with any residue-
+        # classed claimant join the residue transitively
+        row_job = {
+            int(r): int(pod_j[r])
+            for r in vol_rows if 0 <= int(pod_j[r]) < nJ
+        }
+        resid_set = set(np.nonzero(resid_job)[0].tolist())
+        for j, why in volume_partition.demote_contended_jobs(
+            row_job, resid_set
+        ).items():
+            resid_job[j] = True
+            residue_reason_job.setdefault(j, why)
+    dyn_expr_job = dyn_job & ~resid_job
+    # batch-wave demotion: volume state (volsel) forces the dynamic solve
+    # onto the exact sequential kernel, so a batch-scale port/affinity
+    # wave sharing the cycle with volume gangs would regress from the
+    # batched-rounds kernel (~0.1 s at 10k tasks) to ~0.3 ms/step — the
+    # r4 storm lesson.  When the dyn-expr wave would pick the batched
+    # variant (``dyn_batch`` = (solve_mode, batch_threshold)), the
+    # volume-device jobs step aside to the VECTORIZED residue engine
+    # (low-ms/task) and the wave keeps its kernel.
+    if (
+        dyn_batch is not None and vol_dev is not None
+        and dyn_batch[0] != "exact"
+    ):
+        vol_dev_job = np.zeros(nJ, bool)
+        vd_rows = np.nonzero(pend_all & vol_dev)[0]
+        if vd_rows.size and n_jobs:
+            vol_dev_job[np.unique(pod_j[vd_rows])] = True
+        cand = vol_dev_job & dyn_expr_job
+        if cand.any():
+            nbr = np.nonzero(pend_all & ~m.p_best_effort[:P])[0]
+            wave = int(dyn_expr_job[pod_j[nbr]].sum()) if nbr.size else 0
+            if dyn_batch[0] == "batch" or wave > dyn_batch[1]:
+                for j in np.nonzero(cand)[0]:
+                    resid_job[j] = True
+                    residue_reason_job.setdefault(int(j), "batch-wave")
+                dyn_expr_job = dyn_job & ~resid_job
+    # job-order safety (snapshot.py:581-586): a dynamic job outranking an
+    # express job in its queue would be served AFTER it by the device-first
+    # partition — priority inversion under contention; the caller must take
+    # the exact host path for the whole cycle instead.  (Equal-priority
+    # interleave divergence remains, the documented approximation class.)
+    partition_unsafe = False
+    if dyn_rows.size and n_jobs:
+        pend_nonbe = pend_all & ~m.p_best_effort[:P]
+        contender = np.zeros(nJ, bool)
+        nb_rows = np.nonzero(pend_nonbe)[0]
+        if nb_rows.size:
+            contender[np.unique(pod_j[nb_rows])] = True
+        for q in np.unique(job_q_idx[dyn_job[:n_jobs] & contender[:n_jobs]]):
+            sel = job_q_idx == q
+            dp = m.j_prio[job_rows[sel & dyn_job[:n_jobs] & contender[:n_jobs]]]
+            ep = m.j_prio[job_rows[sel & ~dyn_job[:n_jobs] & contender[:n_jobs]]]
+            if dp.size and ep.size and dp.max() > ep.min():
+                partition_unsafe = True
+                break
+
+    # pending non-BestEffort task rows of EXPRESS jobs, grouped by job in
+    # job order, within a job by (-priority, arrival) — snapshot.py:395-406
+    # with the uid-arrival divergence documented in the module docstring
+    dyn_of_pod = np.zeros(P, bool)
+    if dyn_rows.size:
+        dyn_of_pod[pod_j >= 0] = dyn_job[np.clip(pod_j[pod_j >= 0], 0, nJ - 1)]
+    pend_express = pend_all & ~m.p_best_effort[:P] & ~dyn_of_pod
+    pe_rows = np.nonzero(pend_express)[0]
+    if pe_rows.size:
+        sort = np.lexsort(
+            (m.p_rank[pe_rows], -m.p_prio[pe_rows], pod_j[pe_rows])
+        )
+        pe_rows = pe_rows[sort]
+    ta = _task_arrays(m, pe_rows, pod_j, n_jobs, N, R, node_rows_arr,
+                      n_live_ct, nodeaffinity_weight,
+                      job_start, job_ntasks)
+    n_tasks = ta["n_tasks"]
+    task_req, task_job = ta["task_req"], ta["task_job"]
+    task_class_arr, task_valid = ta["task_class"], ta["task_valid"]
+    class_mask, class_score = ta["class_mask"], ta["class_score"]
+    pod_keys = ta["pod_keys"]
+
+    total = node_alloc[node_valid].sum(axis=0).astype(np.float32)
+
+    node_names = [k for k in m.nodes.key_row]
+
+    snap = TensorSnapshot(
+        dims=list(m.dims),
+        eps=eps,
+        node_names=node_names,
+        node_idle=node_idle,
+        node_releasing=node_rel,
+        node_used=node_used,
+        node_alloc=node_alloc,
+        node_max_tasks=node_max_tasks,
+        node_task_count=node_tc,
+        node_valid=node_valid,
+        task_uids=pod_keys,  # fast path keys rows by pod key, not uid
+        task_req=task_req,
+        task_job=task_job,
+        task_class=task_class_arr,
+        task_valid=task_valid,
+        job_uids=[m.jobs.row_key[r] for r in job_rows],
+        job_queue=job_queue,
+        job_min_available=job_min,
+        job_priority=job_prio,
+        job_creation=np.arange(J, dtype=np.int32),
+        job_ready_init=job_ready_init,
+        job_alloc_init=job_alloc_init,
+        job_schedulable=job_schedulable,
+        job_start=job_start,
+        job_ntasks=job_ntasks,
+        queue_names=q_names,
+        queue_weight=queue_weight,
+        queue_alloc_init=queue_alloc,
+        queue_request=queue_request,
+        queue_valid=queue_valid,
+        queue_participates=queue_participates,
+        class_node_mask=class_mask,
+        class_node_score=class_score,
+        total=total,
+    )
+    # per-job stats for the preempt/reclaim prechecks and enqueue
+    run_per_job = np.zeros(max(n_jobs, 1), np.int64)
+    running_rows = np.nonzero(live & (codes == _RUNNING))[0]
+    if running_rows.size and n_jobs:
+        run_per_job[:n_jobs] = np.bincount(
+            pod_j[running_rows], minlength=n_jobs
+        )[:n_jobs]
+    pend_any_per_job = np.zeros(max(n_jobs, 1), np.int64)
+    if pd_rows.size and n_jobs:
+        pend_any_per_job[:n_jobs] = np.bincount(
+            pod_j[pd_rows], minlength=n_jobs
+        )[:n_jobs]
+    # pending non-BE counts INCLUDING dynamic jobs — the preempt/reclaim
+    # prechecks must see residue starvation too (conservative direction:
+    # more pending can only make the precheck answer "possible")
+    pend_nonbe_per_job = np.zeros(nJ, np.int64)
+    nb_all = np.nonzero(pend_all & ~m.p_best_effort[:P])[0]
+    if nb_all.size and n_jobs:
+        pend_nonbe_per_job[:n_jobs] = np.bincount(
+            pod_j[nb_all], minlength=n_jobs
+        )[:n_jobs]
+
+    aux = {
+        "pe_rows": pe_rows,            # task row index -> mirror pod row
+        "job_rows": job_rows,          # job index -> mirror job row
+        "node_rows": node_rows_arr,    # node index -> mirror node row
+        "n_jobs": n_jobs,
+        "n_tasks": n_tasks,
+        "n_nodes": n_live_ct,
+        "pod_j": pod_j,                # mirror pod row -> job index
+        "live": live,
+        # decision parity: a COPY, not a view — _publish_and_close mutates
+        # p_status for published binds and must still count pre-publish
+        # store state when computing PodGroup phases
+        "codes": codes.copy(),
+        "node_used": node_used,
+        "run_per_job": run_per_job,
+        "pend_any_per_job": pend_any_per_job,
+        "pend_nonbe_per_job": pend_nonbe_per_job,
+        # dynamic-job partition outputs
+        "dyn_job": dyn_job,            # [max(n_jobs,1)] bool
+        "dyn_expr_job": dyn_expr_job,  # device-solvable dynamic jobs
+        "partition_unsafe": partition_unsafe,
+        # shadow gangs have no store PodGroup: status writes skip them
+        "shadow_job": m.j_shadow[job_rows],  # [n_jobs] bool
+        # only the non-expressible dynamic jobs still need the host
+        # residue sub-cycle
+        "residue_keys": {
+            m.jobs.row_key[job_rows[j]]
+            for j in np.nonzero(resid_job[:n_jobs])[0]
+        },
+        # why each residue job took the slow class (feeds the
+        # volcano_residue_tasks_total counter + the cycle span annotation)
+        "residue_reasons": {
+            m.jobs.row_key[job_rows[j]]: reason
+            for j, reason in residue_reason_job.items()
+            if j < n_jobs
+        },
+        # pending tasks entering the slow class this cycle, by reason
+        "residue_task_counts": _residue_counts(
+            residue_reason_job, pend_any_per_job, n_jobs
+        ),
+        # per-cycle volume interning (volsolve.py): the dyn-solve payload
+        # builder and publish validation read it; None on volume-free
+        # cycles so they pay nothing
+        "volume_partition": volume_partition,
+        "vol_solve_s": vol_solve_s,
+    }
+    return snap, aux

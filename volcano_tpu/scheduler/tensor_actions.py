@@ -675,8 +675,12 @@ def jax_dynamic_solve(backend, snap, dyn, n_pending=None):
     tok = prof.dispatch_begin(packed) if prof is not None else None
     out = packed(
         vol_args,
-        dev(dyn["node_ports_w"]),
-        dev(dyn["node_selcnt"]),
+        # node-axis resident planes shard with the node rows they gate
+        # (parallel/sharded._SPECS: "node_ports_w"/"node_selcnt"); the
+        # task-major payloads and packed volsel claim words replicate —
+        # see sharded._REPLICATED for the declared placement of every arg
+        devn(dyn["node_ports_w"], "node_ports_w"),
+        devn(dyn["node_selcnt"], "node_selcnt"),
         dev(dyn["task_ports_w"]),
         dev(dyn["task_aff_w"]),
         dev(dyn["task_anti_w"]),

@@ -83,7 +83,8 @@ def _connection_cut(e: BaseException) -> bool:
 
 class RemoteStore:
     def __init__(self, url: str, timeout: float = 30.0,
-                 chaos: Optional[FaultPlan] = None):
+                 chaos: Optional[FaultPlan] = None,
+                 shard: Optional[int] = None):
         self.url = url.rstrip("/")
         self.timeout = timeout
         # client-side fault injection (volcano_tpu/chaos.py): defaults to
@@ -93,6 +94,12 @@ class RemoteStore:
         self.chaos = chaos if chaos is not None else env_plan()
         self._watches: Dict[str, List[_RemoteWatchQueue]] = {}
         self._cursor = 0
+        # shard-scoped watcher (partitioned servers): poll only that
+        # shard's slice of the log — the per-shard watch fan-out consumer
+        self.shard = shard
+        #: partitioned-bus shard count advertised by /healthz, fetched
+        #: lazily once (1 = unpartitioned, incl. pre-partition servers)
+        self._segment_shards: Optional[int] = None
 
     # -- http ----------------------------------------------------------------
 
@@ -318,16 +325,35 @@ class RemoteStore:
             )
         return results
 
-    def apply_segment(self, seg) -> Dict[str, Any]:
+    @property
+    def segment_shards(self) -> int:
+        """The server's partitioned-bus shard count (``/healthz``
+        ``shards``), cached after the first read.  The async applier
+        splits each cycle's segment by namespace shard and ships the
+        sub-segments concurrently when this is > 1."""
+        if self._segment_shards is None:
+            code, body = self._request("GET", "/healthz")
+            if code != 200:
+                raise RemoteStoreError(self._err(code, body))
+            self._segment_shards = max(1, int(body.get("shards", 1)))
+        return self._segment_shards
+
+    def apply_segment(self, seg, shard: Optional[int] = None
+                      ) -> Dict[str, Any]:
         """Ship one columnar decision segment (store/segment.py) in ONE
         request — the whole cycle's binds + evicts + their Events as
         parallel columns over interned string tables, no per-object op
         dicts and no per-object encode.  The server applies it under one
-        lock with lazy materialization.  Returns the sparse per-row error
-        dict ``{"binds": [[row, err], ...], "evicts": [...]}``; raises on
+        lock with lazy materialization; on a partitioned server
+        ``shard`` routes a sub-segment to its shard's apply lock, WAL,
+        and watch log.  Returns the sparse per-row error dict
+        ``{"binds": [[row, err], ...], "evicts": [...]}``; raises on
         transport failure (the caller never retries a mutation blindly —
         same contract as ``bulk``)."""
-        code, body = self._request("POST", "/bulk", {"ops": [seg.to_wire()]})
+        op = seg.to_wire()
+        if shard is not None:
+            op["shard"] = int(shard)
+        code, body = self._request("POST", "/bulk", {"ops": [op]})
         if code != 200:
             raise RemoteStoreError(self._err(code, body))
         res = (body.get("results") or [None])[0]
@@ -402,8 +428,11 @@ class RemoteStore:
         if not self._watches:
             return 0
         kinds = ",".join(sorted(self._watches))
+        shard_arg = f"&shard={self.shard}" if self.shard is not None else ""
         code, body = self._request(
-            "GET", f"/watch?since={self._cursor}&kinds={kinds}&timeout={timeout}"
+            "GET",
+            f"/watch?since={self._cursor}&kinds={kinds}&timeout={timeout}"
+            f"{shard_arg}",
         )
         if code != 200:
             raise RemoteStoreError(self._err(code, body))

@@ -1,0 +1,285 @@
+"""Partitioned store bus: shard the decision stream by namespace hash.
+
+ROADMAP item 1's store half.  The columnar wire (PR 6) made a cycle's
+output ONE ``DecisionSegment`` and the WAL (PR 7) made it ONE durable
+record — but both still funnel through one server lock, one WAL file,
+one fsync leader, and one watch log: cfg7's 1.7–2.1 s drain is a single
+pipe however many decisions it carries.  This module partitions that
+pipe.  The shard key is the **namespace hash** (``shard_of``): every
+decision row, WAL record, and watch-log entry for a namespace lands on
+the same shard deterministically, so per-shard streams are complete and
+ordered for the objects they cover.
+
+Three pieces:
+
+* ``split_segment`` — the client half: one cycle's ``DecisionSegment``
+  splits into per-shard sub-segments (row order preserved within a
+  shard, node tables re-interned per shard, one reserved Event uid block
+  per sub-segment).  The async applier ships them concurrently; the
+  server applies each under its shard's apply lock.
+
+* ``ShardedWAL`` — per-shard ``WriteAheadLog`` directories
+  (``<wal>/s00``, ``s01``, …) with INDEPENDENT group-commit fsync: a
+  segment for shard 2 never waits behind shard 0's fsync leader, and
+  concurrent sub-segment ships fsync different files in parallel.
+  Records keep their global ``seq`` stamps, so recovery merges the
+  shards' tails back into one ordered replay.
+
+* ``shard_of``/``shard_of_key``/``wal_shard`` — the one hash everybody
+  agrees on (client split, server routing, WAL placement, watch
+  tagging).  Cluster-scoped objects (namespace ``""``) hash like any
+  other namespace — deterministically onto one shard.
+
+StoreServer grows ``shards=N`` (server.py): shard-tagged watch-log
+entries, ``/watch?shard=i`` fan-out, per-shard apply locks, and the
+sharded WAL wired through the existing checkpoint/recovery protocol
+(per-shard floors in the snapshot's ``wal_floor``).  ``shards=1`` is
+byte-for-byte the unpartitioned server.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from volcano_tpu.locksan import make_lock
+
+#: subdirectory name shape for one shard's WAL segments
+_SHARD_DIR_FMT = "s{:02d}"
+
+
+def shard_of(namespace: str, nshards: int) -> int:
+    """The shard a namespace's decision stream lands on: crc32 of the
+    namespace modulo the shard count — stable across processes and runs
+    (never Python's salted ``hash``)."""
+    if nshards <= 1:
+        return 0
+    return zlib.crc32(namespace.encode()) % nshards
+
+
+def shard_of_key(key: str, nshards: int) -> int:
+    """Shard of an object key (``namespace/name``; cluster-scoped keys
+    carry an empty namespace and hash like any other)."""
+    if nshards <= 1:
+        return 0
+    ns, _, _ = key.partition("/")
+    return shard_of(ns, nshards)
+
+
+def wal_shard(rec: Dict[str, Any], nshards: int) -> int:
+    """The WAL shard one wire record belongs to.  Segments carry their
+    shard explicitly (the client split already decided); per-op records
+    route by their object's namespace so one namespace's history stays
+    on one shard (replay order within a shard == append order)."""
+    if nshards <= 1:
+        return 0
+    if rec.get("op") == "segment":
+        return int(rec.get("shard", 0)) % nshards
+    key = rec.get("key")
+    if isinstance(key, str):
+        return shard_of_key(key, nshards)
+    keys = rec.get("keys")
+    if isinstance(keys, list) and keys and isinstance(keys[0], str):
+        # columnar patch run: the client compresses runs per cycle —
+        # rows of one run share a kind and, in practice, a namespace
+        # stream; route by the first key (deterministic either way)
+        return shard_of_key(keys[0], nshards)
+    obj = rec.get("object")
+    if isinstance(obj, dict):
+        meta = obj.get("meta") or {}
+        return shard_of(str(meta.get("namespace") or ""), nshards)
+    return 0
+
+
+def split_segment(seg, nshards: int) -> List[Tuple[int, Any]]:
+    """Split one cycle's ``DecisionSegment`` into per-shard sub-segments.
+
+    Rows keep their original relative order within a shard; node tables
+    re-intern only the nodes a shard references; every non-empty
+    sub-segment reserves its OWN Event uid block (``DecisionSegment.
+    build``), so the server derives its Event names with no cross-shard
+    coordination.  Returns ``[(shard, sub_segment)]`` for the non-empty
+    shards — callers ship each with the ``shard`` tag on the wire op.
+    """
+    from volcano_tpu.store.segment import DecisionSegment
+
+    if nshards <= 1:
+        return [(0, seg)]
+    binds: List[List[Tuple[str, str]]] = [[] for _ in range(nshards)]
+    evicts: List[List[Tuple[str, str]]] = [[] for _ in range(nshards)]
+    table = seg.node_table
+    # namespace -> shard memo: the hash runs once per DISTINCT namespace
+    # (dozens), not once per row (100k+) — the split is on the drain path
+    ns_shard: Dict[str, int] = {}
+
+    def _shard(key: str) -> int:
+        ns, _, _ = key.partition("/")
+        s = ns_shard.get(ns)
+        if s is None:
+            s = ns_shard[ns] = shard_of(ns, nshards)
+        return s
+
+    for i, key in enumerate(seg.bind_keys):
+        binds[_shard(key)].append((key, table[seg.bind_nodes[i]]))
+    reasons = seg.evict_reason_strs
+    for j, key in enumerate(seg.evict_keys):
+        evicts[_shard(key)].append((key, reasons[j]))
+    out: List[Tuple[int, Any]] = []
+    for s in range(nshards):
+        if not binds[s] and not evicts[s]:
+            continue
+        interned: Dict[str, int] = {}
+        node_table: List[str] = []
+        bind_keys: List[str] = []
+        bind_nodes: List[int] = []
+        for key, host in binds[s]:
+            idx = interned.get(host)
+            if idx is None:
+                idx = interned[host] = len(node_table)
+                node_table.append(host)
+            bind_keys.append(key)
+            bind_nodes.append(idx)
+        out.append((s, DecisionSegment.build(
+            bind_keys, bind_nodes, node_table, evicts[s] or None
+        )))
+    return out
+
+
+class ShardedWAL:
+    """N independent ``WriteAheadLog``\\ s under one directory, one per
+    shard (``s00/``, ``s01/``, …), presenting the single-WAL surface the
+    StoreServer's checkpoint/recovery protocol already speaks — except
+    ``rotate``/``replay``/``drop_below`` carry a per-shard floor LIST
+    and ``append`` takes the target shard.
+
+    Independence is the point: each shard has its own fsync leader, so
+    group commit batches per shard and concurrent sub-segment ships
+    never share a durability barrier.  Global ordering is recovered at
+    replay from the records' ``seq`` stamps (assigned under the server
+    lock), merged across shards.
+    """
+
+    def __init__(self, dir_path: str, nshards: int):
+        from volcano_tpu.store.wal import WriteAheadLog
+
+        if nshards < 2:
+            raise ValueError("ShardedWAL needs >= 2 shards; use "
+                             "WriteAheadLog for the single-shard bus")
+        os.makedirs(dir_path, exist_ok=True)
+        self.dir = dir_path
+        self.nshards = nshards
+        self.wals: List[WriteAheadLog] = [
+            WriteAheadLog(os.path.join(dir_path, _SHARD_DIR_FMT.format(s)))
+            for s in range(nshards)
+        ]
+        # serializes floor bookkeeping across rotate/drop (each shard's
+        # own appends/fsyncs stay under its WAL's condition, untouched)
+        self._mu = make_lock("ShardedWAL._mu")
+
+    # -- append / group commit --------------------------------------------
+
+    def append(self, rec: Dict[str, Any], shard: Optional[int] = None) -> int:
+        s = wal_shard(rec, self.nshards) if shard is None else shard
+        return self.wals[s % self.nshards].append(rec)
+
+    def commit(self, ticket: Optional[int] = None) -> None:
+        """Fsync every shard with un-synced appends.  Each shard's
+        ``commit`` returns immediately when its tail is already durable,
+        so a request that touched one shard pays one fsync — and two
+        requests on different shards pay two CONCURRENT fsyncs, never a
+        shared leader."""
+        for w in self.wals:
+            w.commit()
+
+    # -- checkpoint protocol ----------------------------------------------
+
+    def rotate(self) -> List[int]:
+        """Rotate every shard; returns the per-shard floor list — the
+        snapshot's ``wal_floor`` payload for a partitioned bus."""
+        with self._mu:
+            return [w.rotate() for w in self.wals]
+
+    def drop_below(self, floors) -> None:
+        with self._mu:
+            for w, f in zip(self.wals, self._floor_list(floors)):
+                w.drop_below(f)
+
+    def drop_all(self) -> None:
+        with self._mu:
+            for w in self.wals:
+                w.drop_all()
+
+    def _floor_list(self, floors) -> List[int]:
+        if isinstance(floors, int):
+            # a floor stamped by a single-shard life: only meaningful as
+            # "everything covered" (recovery re-absorbs via seq merge)
+            return [floors] * self.nshards
+        out = [int(f) for f in floors]
+        if len(out) < self.nshards:
+            out += [0] * (self.nshards - len(out))
+        return out[: self.nshards]
+
+    # -- recovery ----------------------------------------------------------
+
+    def replay(self, floors=0) -> Iterator[Dict[str, Any]]:
+        """Every intact record from every shard's segments at/above its
+        floor, merged into GLOBAL order by the records' ``seq`` stamps
+        (append order within a shard is preserved by the stable sort —
+        ties can only be same-shard records appended under one seq,
+        which the server never produces)."""
+        records: List[Tuple[int, int, Dict[str, Any]]] = []
+        for s, (w, f) in enumerate(
+            zip(self.wals, self._floor_list(floors))
+        ):
+            for i, rec in enumerate(w.replay(f)):
+                records.append((int(rec.get("seq", 0)), i, rec))
+        records.sort(key=lambda t: (t[0], t[1]))
+        for _, _, rec in records:
+            yield rec
+
+    @property
+    def torn_tails(self) -> int:
+        return sum(w.torn_tails for w in self.wals)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        per = [w.stats() for w in self.wals]
+        return {
+            "shards": self.nshards,
+            "records": sum(p["records"] for p in per),
+            "fsync_total": sum(p["fsync_total"] for p in per),
+            "fsync_s": round(sum(p["fsync_s"] for p in per), 4),
+            "replayed_records": sum(p["replayed_records"] for p in per),
+            "torn_tails": sum(p["torn_tails"] for p in per),
+            "per_shard": per,
+        }
+
+    def sync_close(self) -> None:
+        for w in self.wals:
+            w.sync_close()
+
+    def kill(self) -> None:
+        for w in self.wals:
+            w.kill()
+
+
+def leftover_shard_dirs(wal_dir: str) -> List[str]:
+    """Shard subdirectories left by a crashed partitioned WAL-on life
+    (``<wal>/s00`` …) — the WAL-off absorb path scans these too, so
+    dropping from a partitioned bus to interval persistence can't
+    silently lose an acked tail."""
+    try:
+        names = os.listdir(wal_dir)
+    except OSError:
+        return []
+    out = []
+    for n in sorted(names):
+        p = os.path.join(wal_dir, n)
+        if (
+            len(n) == 3 and n.startswith("s") and n[1:].isdigit()
+            and os.path.isdir(p)
+        ):
+            out.append(p)
+    return out

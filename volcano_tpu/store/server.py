@@ -88,14 +88,27 @@ class StoreServer:
         state_path: Optional[str] = None,
         save_interval: float = 0.25,
         wal=None,
+        shards: int = 1,
     ):
         self.store = store or Store()
         self.admission = admission
         # lock-order contract (enforced statically by vtlint `lock-order`
         # and at runtime by the env-gated sanitizer, `make sanitize`):
-        # _flush_lock is always taken BEFORE lock, never the reverse
+        # _flush_lock is always taken BEFORE lock, never the reverse;
+        # a shard apply lock is always taken BEFORE lock, never the reverse
         self.lock = make_rlock("StoreServer.lock")
         self.cond = threading.Condition(self.lock)
+        # partitioned decision bus (store/partition.py): shard count for
+        # the segment stream / WAL / watch fan-out.  shards == 1 is the
+        # unpartitioned server, byte-for-byte.  Each shard gets an apply
+        # lock serializing ITS sub-segments (ship order per shard) while
+        # different shards' sub-segments overlap everywhere outside the
+        # short global seq/rv critical section.
+        self.shards = max(1, int(shards))
+        self._shard_locks = [
+            make_rlock("StoreServer.shard_apply")
+            for _ in range(self.shards)
+        ]
         # ordered event log: plain per-event dict entries, or columnar
         # block entries {"seq": <last row's seq>, "n": rows, "kind": K,
         # "block": PatchLogBlock|EventLogBlock, "start": first block row}
@@ -129,14 +142,21 @@ class StoreServer:
         # snapshot + torn-tail-tolerant replay (_load_state).
         self.wal = None
         if wal:
-            from volcano_tpu.store.wal import WriteAheadLog
-
             if state_path is None:
                 raise ValueError(
                     "wal requires state_path (the WAL checkpoints into "
                     "the state file)")
-            self.wal = WriteAheadLog(
-                wal if isinstance(wal, str) else state_path + ".wal")
+            wal_dir = wal if isinstance(wal, str) else state_path + ".wal"
+            if self.shards > 1:
+                # partitioned bus: one WAL per shard with independent
+                # group-commit fsync (store/partition.py)
+                from volcano_tpu.store.partition import ShardedWAL
+
+                self.wal = ShardedWAL(wal_dir, self.shards)
+            else:
+                from volcano_tpu.store.wal import WriteAheadLog
+
+                self.wal = WriteAheadLog(wal_dir)
         self._sync_persist = (state_path is not None and save_interval <= 0
                               and self.wal is None)
         self._dirty_kinds: set = set()
@@ -280,7 +300,8 @@ class StoreServer:
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
                     return
                 if u.path == "/healthz":
-                    payload = {"ok": True, "uid": server.store.uid}
+                    payload = {"ok": True, "uid": server.store.uid,
+                               "shards": server.shards}
                     if server.wal is not None:
                         # durability observability for operators/bench:
                         # record/fsync totals, cumulative fsync seconds,
@@ -291,7 +312,11 @@ class StoreServer:
                     since = int(q.get("since", ["0"])[0])
                     kinds = set(q.get("kinds", [""])[0].split(",")) - {""}
                     timeout = float(q.get("timeout", ["0"])[0])
-                    return self._reply(200, server.watch_since(since, kinds, timeout))
+                    shard_q = q.get("shard", [None])[0]
+                    return self._reply(200, server.watch_since(
+                        since, kinds, timeout,
+                        shard=int(shard_q) if shard_q is not None else None,
+                    ))
                 if len(parts) == 2 and parts[0] == "apis":
                     kind = parts[1]
                     with server.lock:
@@ -454,8 +479,11 @@ class StoreServer:
         the post-op seq/rv so recovery resumes the exact continuity line.
         Must be called under the server lock AFTER the op's ``_pump_log``
         (so the stamps reflect the op) — append order is then apply
-        order.  The fsync happens later, in ``_commit_ack``, outside the
-        lock."""
+        order.  On a partitioned bus the record routes to its namespace
+        shard's WAL (partition.wal_shard; segments carry their shard
+        explicitly), and recovery merges the shard tails back into one
+        ordered replay by these seq stamps.  The fsync happens later, in
+        ``_commit_ack``, outside the lock."""
         rec["seq"] = self.seq
         rec["rv"] = self.store._rv
         self.wal.append(rec)
@@ -570,6 +598,19 @@ class StoreServer:
         objects arrive encoded). Per-op admission still applies. The lock is
         reentrant, so holding it across the batch while delegating to
         create/update keeps the batch contiguous in the event log."""
+        if len(ops) == 1 and ops[0].get("op") == "segment":
+            # the partitioned bus's hot shape (the applier ships each
+            # sub-segment as its own single-op bulk): skip the batch
+            # wrapper's global lock — the apply manages its own
+            # shard-then-server locking (see _apply_segment for the
+            # honest concurrency model: applies still serialize on the
+            # server lock; the overlap is decode/encode/fsync)
+            try:
+                results = [self._apply_segment(ops[0])]
+            except Exception as e:  # noqa: BLE001 — per-op isolation
+                results = [repr(e)]
+            self._commit_ack()
+            return results
         results: List[Optional[str]] = []
         with self.lock:
             for op in ops:
@@ -602,8 +643,13 @@ class StoreServer:
                         continue
                     elif verb == "segment":
                         # columnar decision segment (store/segment.py):
-                        # result is the sparse per-row error dict
-                        results.append(self._apply_segment(op))
+                        # result is the sparse per-row error dict.  The
+                        # batch already holds the server lock, which
+                        # covers every shard — skip the shard lock so
+                        # the lock ORDER (shard before server) stays
+                        # acyclic (single-op segment bulks take the
+                        # fast path above instead)
+                        results.append(self._apply_segment(op, _in_bulk=True))
                         continue
                     elif verb == "delete":
                         deleted = self.store.delete(kind, op.get("key", ""))
@@ -673,7 +719,8 @@ class StoreServer:
             col_dec[f] = _decoder(hint) if hint is not None else (lambda v: v)
         return col_dec
 
-    def _apply_segment(self, op: Dict[str, Any]) -> Dict[str, Any]:
+    def _apply_segment(self, op: Dict[str, Any],
+                       _in_bulk: bool = False) -> Dict[str, Any]:
         """Apply one columnar decision segment: the whole cycle's binds,
         evicts, and their Events land under ONE lock acquisition, with no
         per-object store write, object encode, or log entry.  The store
@@ -686,10 +733,38 @@ class StoreServer:
         reply can never leave a half-applied segment.  Never flushes
         inline (the bulk wrapper's _maybe_flush runs outside the lock,
         preserving the _flush_lock-before-lock order)."""
+        from contextlib import nullcontext
+
         from volcano_tpu.store.segment import DecisionSegment, PatchLogBlock
 
         seg = DecisionSegment.from_wire(op)
-        with self.lock:
+        # an UNTAGGED segment on a partitioned server (a pre-partition
+        # client, or an applier whose /healthz probe transiently failed)
+        # spans shards: it routes to shard 0 for locking/WAL durability,
+        # but its log entries stay untagged so shard-scoped watchers of
+        # EVERY shard receive its rows (over-delivery is safe; a
+        # shard-0-only tag would leave the other shards' watchers
+        # permanently stale with no relist signal)
+        shard_tag = op.get("shard")
+        shard = (int(shard_tag) % self.shards) if shard_tag is not None else 0
+        # per-shard apply lock (partitioned bus): sub-segments of ONE
+        # shard apply atomically in ship order.  Honest concurrency
+        # model: the staging below still runs under the GLOBAL server
+        # lock (seq/rv assignment, the shared enc caches, the log), so
+        # different shards' APPLIES serialize — cross-shard overlap
+        # happens in what is OUTSIDE both locks: each handler thread's
+        # request decode/reply encode, socket I/O, and the per-shard
+        # group-commit fsync in _commit_ack (independent WAL files).
+        # The shard lock is the seam for narrowing the global section
+        # later without changing callers.  Order: shard lock strictly
+        # BEFORE the server lock (lock-order contract); a multi-op bulk
+        # already holds the server lock — which covers every shard — so
+        # it skips the shard lock (``_in_bulk``) rather than inverting
+        # the order.
+        shard_lock = (
+            nullcontext() if _in_bulk else self._shard_locks[shard]
+        )
+        with shard_lock, self.lock:
             # queued per-object events must keep their place in the order
             self._pump_log()
             stamp = time.time()
@@ -708,7 +783,7 @@ class StoreServer:
             if bkeys:
                 pre = [self._enc_pre("Pod", k) for k in bkeys]
                 blk = PatchLogBlock("node_name", bkeys, bvals, pre, rv_b0)
-                self._append_block(blk)
+                self._append_block(blk, shard_tag)
                 for i, k in enumerate(bkeys):
                     pend[("Pod", k)] = (blk, i)
                 self._dirty_kinds.add("Pod")
@@ -717,13 +792,13 @@ class StoreServer:
                 blk = PatchLogBlock(
                     "deleting", ekeys, [True] * len(ekeys), pre, rv_e0
                 )
-                self._append_block(blk)
+                self._append_block(blk, shard_tag)
                 for i, k in enumerate(ekeys):
                     pend[("Pod", k)] = (blk, i)
                 self._dirty_kinds.add("Pod")
             for blk in (ebind, eevict):
                 if len(blk):
-                    self._append_block(blk)
+                    self._append_block(blk, shard_tag)
                     for i in range(len(blk)):
                         pend[("Event", blk.key(i))] = (blk, i)
                     self._dirty_kinds.add("Event")
@@ -732,22 +807,31 @@ class StoreServer:
                 # the WHOLE cycle is one WAL record — the wire op verbatim
                 # plus the Event stamp, so replay reproduces the exact
                 # lazy apply (group commit then amortizes one fsync over
-                # 100k binds in _commit_ack)
+                # 100k binds in _commit_ack); the shard tag rides along so
+                # a partitioned bus appends it to that shard's WAL
                 rec = dict(op)
                 rec["stamp"] = stamp
+                rec["shard"] = shard
                 self._wal_append(rec)
             self.cond.notify_all()
         return res
 
-    def _append_block(self, blk) -> None:
+    def _append_block(self, blk, shard=None) -> None:
         """One log entry for a whole columnar block; rows occupy the seq
-        range (blk.seq0 .. entry["seq"])."""
+        range (blk.seq0 .. entry["seq"]).  On a partitioned server the
+        entry carries its shard so ``/watch?shard=i`` fan-out serves (and
+        expands) only that shard's blocks; ``shard=None`` (an untagged,
+        cross-shard segment) leaves the entry untagged — served to every
+        shard-scoped watcher."""
         n = len(blk)
         blk.seq0 = self.seq + 1
         self.seq += n
         self._log_rows += n
-        self.log.append({"seq": self.seq, "n": n, "kind": blk.kind,
-                         "block": blk, "start": 0})
+        entry = {"seq": self.seq, "n": n, "kind": blk.kind,
+                 "block": blk, "start": 0}
+        if self.shards > 1 and shard is not None:
+            entry["shard"] = int(shard) % self.shards
+        self.log.append(entry)
 
     def _enc_of(self, kind: str, key: str) -> Optional[Dict[str, Any]]:
         """The object's current encoding, resolving the lazy columnar half
@@ -833,9 +917,12 @@ class StoreServer:
                 # resurrect old field values and deleted objects on top
                 # of the newer state
                 self.wal.drop_all()
+                # ... including segments in layouts this life's WAL does
+                # not own (a shard-count change ago): they predate the
+                # WAL-off snapshot too and must not be absorbed later
+                self._drop_foreign_wal(data)
             else:
-                replayed, skipped = self._replay_wal(
-                    int(data.get("wal_floor", 0)))
+                replayed, skipped = self._replay_wal(data)
                 if replayed:
                     from volcano_tpu.scheduler import metrics
 
@@ -854,6 +941,22 @@ class StoreServer:
             replayed, skipped = self._absorb_leftover_wal(data)
         return replayed, skipped
 
+    def _wal_floor_of(self, data):
+        """The snapshot's WAL floor in the shape THIS life's WAL speaks:
+        an int for the single log, a per-shard list for the partitioned
+        bus.  A floor stamped by a life with a different shard count is
+        coerced conservatively (floor 0 = replay everything; records
+        replay idempotently over the snapshot, same as the absorb path)."""
+        floor = data.get("wal_floor", 0)
+        sharded_wal = getattr(self.wal, "nshards", 1) > 1
+        if sharded_wal:
+            return floor if isinstance(floor, list) else 0
+        if isinstance(floor, list):
+            # partitioned-life snapshot booted unsharded: this life's
+            # fresh single log has no covered segments — replay all
+            return 0
+        return int(floor)
+
     def _absorb_leftover_wal(self, data):
         """WAL-OFF boot with leftover WAL segments beside the state file:
         a previous WAL-on life crashed with acked-but-uncheckpointed
@@ -865,28 +968,48 @@ class StoreServer:
         import os
 
         from volcano_tpu.store import wal as walmod
+        from volcano_tpu.store.partition import leftover_shard_dirs
 
         wal_dir = self.state_path + ".wal"
-        indices = walmod.list_segment_indices(wal_dir)
-        if not indices:
+        floor_raw = data.get("wal_floor", 0)
+        floors = floor_raw if isinstance(floor_raw, list) else []
+        flat_floor = int(floor_raw) if not isinstance(floor_raw, list) else 0
+        # a crashed PARTITIONED WAL-on life leaves per-shard subdirs; a
+        # single-log life leaves *.wal at the top level — absorb both,
+        # merging shard tails into global order by their seq stamps
+        shard_dirs = leftover_shard_dirs(wal_dir)
+        sources = [(wal_dir, flat_floor)] + [
+            (d, int(floors[i]) if i < len(floors) else 0)
+            for i, d in enumerate(shard_dirs)
+        ]
+        pending = []  # (seq, tiebreak, rec)
+        tie = 0
+        seg_paths = []  # every leftover segment file (reaped below)
+        for src_dir, floor in sources:
+            indices = walmod.list_segment_indices(src_dir)
+            for idx in indices:
+                path = os.path.join(src_dir, f"{idx:08d}.wal")
+                seg_paths.append((src_dir, path))
+                if idx < floor:
+                    continue  # covered by the snapshot: reap, don't replay
+                records, _torn = walmod.read_records(path)
+                for rec in records:
+                    tie += 1
+                    pending.append((int(rec.get("seq", 0)), tie, rec))
+        if not seg_paths:
             return 0, 0
-        floor = int(data.get("wal_floor", 0))
+        pending.sort(key=lambda t: (t[0], t[1]))
         replayed = skipped = 0
-        for idx in indices:
-            if idx < floor:
-                continue
-            records, _torn = walmod.read_records(
-                os.path.join(wal_dir, f"{idx:08d}.wal"))
-            for rec in records:
-                replayed += 1
-                try:
-                    self._replay_record(rec)
-                except Exception:  # noqa: BLE001 — recovery must not die
-                    skipped += 1
-                if "seq" in rec:
-                    self.seq = max(self.seq, int(rec["seq"]))
-                if "rv" in rec:
-                    self.store._rv = max(self.store._rv, int(rec["rv"]))
+        for seq, _, rec in pending:
+            replayed += 1
+            try:
+                self._replay_record(rec)
+            except Exception:  # noqa: BLE001 — recovery must not die
+                skipped += 1
+            if "seq" in rec:
+                self.seq = max(self.seq, int(rec["seq"]))
+            if "rv" in rec:
+                self.store._rv = max(self.store._rv, int(rec["rv"]))
         if replayed:
             from volcano_tpu.scheduler import metrics
 
@@ -894,12 +1017,15 @@ class StoreServer:
         # make the absorbed tail durable BEFORE the segments die; a crash
         # in between re-absorbs idempotently on the next boot
         self.flush_state(force=True)
-        for idx in indices:
+        touched = set()
+        for src_dir, path in seg_paths:
             try:
-                os.unlink(os.path.join(wal_dir, f"{idx:08d}.wal"))
+                os.unlink(path)
             except OSError:
                 pass
-        walmod.fsync_dir(wal_dir)
+            touched.add(src_dir)
+        for src_dir in touched:
+            walmod.fsync_dir(src_dir)
         return replayed, skipped
 
     def _load_snapshot(self, data) -> None:
@@ -945,15 +1071,96 @@ class StoreServer:
         # note: the reload happens before any watch queue is registered, so
         # the synthetic creations produce no events — clients relist
 
-    def _replay_wal(self, floor: int):
-        """Replay the WAL tail (segments >= the snapshot's floor) through
-        the store verbs.  Runs before any watch queue exists, so like the
+    def _foreign_wal_sources(self, data):
+        """``[(dir, floor)]`` for WAL segment locations a SHARD-COUNT
+        CHANGE orphaned: acked records this life's WAL layout does not
+        own.  A single-log life owns the top level and orphans every
+        shard subdir; an N-shard life owns ``s00..s{N-1}`` and orphans
+        the top level plus any higher-indexed shard dirs from a wider
+        previous life.  Floors come from the snapshot's ``wal_floor`` in
+        the shape the ORPHANING life stamped them (list entry i for
+        ``s{i}``, the scalar for the top level); an orphaned location
+        with no matching floor entry replays from 0 — its records apply
+        over the snapshot exactly like the absorb path's."""
+        import os
+
+        from volcano_tpu.store.partition import leftover_shard_dirs
+
+        wal_dir = self.wal.dir
+        nshards_now = getattr(self.wal, "nshards", 1)
+        floor_raw = data.get("wal_floor", 0) if data else 0
+        floors = floor_raw if isinstance(floor_raw, list) else []
+        flat = int(floor_raw) if not isinstance(floor_raw, list) else 0
+        sources = []
+        shard_dirs = leftover_shard_dirs(wal_dir)
+        if nshards_now == 1:
+            for d in shard_dirs:
+                i = int(os.path.basename(d)[1:])
+                sources.append((d, int(floors[i]) if i < len(floors) else 0))
+        else:
+            sources.append((wal_dir, flat))
+            for d in shard_dirs:
+                i = int(os.path.basename(d)[1:])
+                if i >= nshards_now:
+                    sources.append(
+                        (d, int(floors[i]) if i < len(floors) else 0))
+        return sources
+
+    def _drop_foreign_wal(self, data) -> None:
+        """Unlink orphaned-layout segments wholesale (the WAL-off-
+        snapshot lineage rule: they predate the newest snapshot)."""
+        import os
+
+        from volcano_tpu.store import wal as walmod
+
+        for src_dir, _floor in self._foreign_wal_sources(data):
+            dropped = False
+            for idx in walmod.list_segment_indices(src_dir):
+                try:
+                    os.unlink(os.path.join(src_dir, f"{idx:08d}.wal"))
+                    dropped = True
+                except OSError:
+                    pass
+            if dropped:
+                walmod.fsync_dir(src_dir)
+
+    def _replay_wal(self, data):
+        """Replay the WAL tail through the store verbs: this life's own
+        layout (segments >= the snapshot's floor) MERGED by seq stamp
+        with any orphaned-layout tail a shard-count change left behind
+        (a ``--shards 4`` life's acked records must survive a
+        ``--shards 1`` reboot and vice versa — the zero-acked-loss
+        contract does not care how the operator re-partitioned).
+        Orphaned segments are absorbed: replayed, snapshotted durable,
+        then retired.  Runs before any watch queue exists, so like the
         snapshot load it produces no events — clients behind the crash
-        relist.  Returns (replayed, skipped): a record that cannot apply
-        (version-drift field, vanished key) is skipped and counted, never
-        fatal — recovery must always come up."""
-        replayed = skipped = 0
+        relist.  Returns (replayed, skipped): a record that cannot
+        apply (version-drift field, vanished key) is skipped and
+        counted, never fatal — recovery must always come up."""
+        import os
+
+        from volcano_tpu.store import wal as walmod
+
+        floor = self._wal_floor_of(data)
+        pending = []  # (seq, tiebreak, rec)
+        tie = 0
         for rec in self.wal.replay(floor):
+            tie += 1
+            pending.append((int(rec.get("seq", 0)), tie, rec))
+        foreign_files = []
+        for src_dir, src_floor in self._foreign_wal_sources(data):
+            for idx in walmod.list_segment_indices(src_dir):
+                path = os.path.join(src_dir, f"{idx:08d}.wal")
+                foreign_files.append((src_dir, path))
+                if idx < src_floor:
+                    continue  # covered by the snapshot: reap, don't replay
+                records, _torn = walmod.read_records(path)
+                for rec in records:
+                    tie += 1
+                    pending.append((int(rec.get("seq", 0)), tie, rec))
+        pending.sort(key=lambda t: (t[0], t[1]))
+        replayed = skipped = 0
+        for _, _, rec in pending:
             replayed += 1
             try:
                 self._replay_record(rec)
@@ -967,6 +1174,19 @@ class StoreServer:
                 self.seq = max(self.seq, int(rec["seq"]))
             if "rv" in rec:
                 self.store._rv = max(self.store._rv, int(rec["rv"]))
+        if foreign_files:
+            # make the absorbed foreign tail durable, then retire it —
+            # a crash in between re-absorbs idempotently next boot
+            self.flush_state(force=True)
+            touched = set()
+            for src_dir, path in foreign_files:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                touched.add(src_dir)
+            for src_dir in touched:
+                walmod.fsync_dir(src_dir)
         return replayed, skipped
 
     def _replay_record(self, rec: Dict[str, Any]) -> None:
@@ -1220,8 +1440,13 @@ class StoreServer:
         return enc, encode(ev.old) if ev.old is not None else None
 
     def _pump_log(self) -> None:
-        """Drain the store's watch queues into the global ordered log."""
+        """Drain the store's watch queues into the global ordered log.
+        Partitioned servers tag each entry with its namespace shard
+        (served shard-scoped by ``/watch?shard=``, stripped from the
+        wire); single-shard servers append exactly the historical entry
+        shape."""
         moved = False
+        sharded = self.shards > 1
         for kind, q in self._queues.items():
             while q:
                 ev = q.popleft()
@@ -1229,15 +1454,20 @@ class StoreServer:
                 self.seq += 1
                 self._log_rows += 1
                 enc_obj, enc_old = self._encode_event_obj(kind, ev)
-                self.log.append(
-                    {
-                        "seq": self.seq,
-                        "kind": kind,
-                        "type": ev.type.value,
-                        "object": enc_obj,
-                        "old": enc_old,
-                    }
-                )
+                entry = {
+                    "seq": self.seq,
+                    "kind": kind,
+                    "type": ev.type.value,
+                    "object": enc_obj,
+                    "old": enc_old,
+                }
+                if sharded:
+                    from volcano_tpu.store.partition import shard_of_key
+
+                    entry["shard"] = shard_of_key(
+                        ev.obj.meta.key, self.shards
+                    )
+                self.log.append(entry)
                 moved = True
         self._trim_log()
         # unconsumed hints (a no-op write that produced no event) must not
@@ -1247,8 +1477,15 @@ class StoreServer:
         if moved:
             self.cond.notify_all()
 
-    def watch_since(self, since: int, kinds, timeout: float) -> Dict[str, Any]:
+    def watch_since(self, since: int, kinds, timeout: float,
+                    shard: Optional[int] = None) -> Dict[str, Any]:
+        """``shard`` (partitioned servers): serve only that shard's
+        entries — the per-shard watch fan-out.  A shard-scoped watcher
+        pays block expansion only for its own shard's segments, so
+        fan-out cost divides by the shard count instead of every watcher
+        expanding every cycle's blocks."""
         deadline = time.monotonic() + timeout
+        strip = self.shards > 1
         with self.lock:
             if since < self.seq - self._log_rows or since > self.seq:
                 # fell off the buffer — or the client's cursor is from
@@ -1268,10 +1505,18 @@ class StoreServer:
                         lo = mid + 1
                 evs = []
                 for e in log[lo:]:
+                    # untagged entries (cross-shard segments from
+                    # pre-partition clients) deliver to EVERY shard-
+                    # scoped watcher — over-delivery, never a silent gap
+                    if shard is not None and e.get("shard", shard) != shard:
+                        continue
                     blk = e.get("block")
                     if blk is None:
                         if not kinds or e["kind"] in kinds:
-                            evs.append(e)
+                            evs.append(
+                                {k: v for k, v in e.items() if k != "shard"}
+                                if strip else e
+                            )
                         continue
                     if kinds and e["kind"] not in kinds:
                         continue
