@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire bench-shard loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate
+.PHONY: test e2e parity bench bench-residue bench-wire bench-shard loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -99,6 +99,16 @@ profile:
 # sub-second machinery smoke lives in tier-1 (tests/test_perfgate.py).
 perfgate:
 	$(PY) bench.py --check
+
+# vtaudit (volcano_tpu/vtaudit.py + tests/test_vtaudit.py): the
+# incremental state-digest auditor — digest algebra invariants, the
+# flipped-byte corruption drill with exact (kind, namespace, name)
+# localization, mirror-vs-partitioned-server beacon-pinned equality,
+# WAL-replay digest verification, and the `vtctl audit` walk; the
+# digest-maintenance lint rule fences the store's mutation verbs.
+audit:
+	$(PY) -m pytest tests/test_vtaudit.py -q
+	$(PY) -m volcano_tpu.analysis --select digest-maintenance volcano_tpu
 
 # the columnar store wire (store/segment.py): cfg7 runs config 5 against
 # the HTTP apiserver in its own OS process — publish + off-cycle drain of

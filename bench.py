@@ -836,6 +836,36 @@ def config7():
         },
     }))
 
+    # digest-off comparison: the SAME workload with the vtaudit state
+    # digest disarmed (VOLCANO_TPU_AUDIT=0 rides os.environ into the
+    # spawned apiserver AND disarms the client-side mirror audit) — the
+    # headline run above already paid for digest-ON, so one extra run
+    # prices the incremental per-mutation hash.  ratio = on/off > 1.05
+    # breaks the acceptance band (with an absolute noise floor — fast
+    # containers drain in microseconds, where a ratio is meaningless):
+    # the O(1) splitmix64 fold per verb must stay inside measurement
+    # noise of the drain.
+    _env_prev = os.environ.get("VOLCANO_TPU_AUDIT")
+    os.environ["VOLCANO_TPU_AUDIT"] = "0"
+    try:
+        off_run = one_run(steady_cycles=False)
+    finally:
+        if _env_prev is None:
+            os.environ.pop("VOLCANO_TPU_AUDIT", None)
+        else:
+            os.environ["VOLCANO_TPU_AUDIT"] = _env_prev
+    _print_json(({
+        "metric": "cfg7_digest_on_vs_off_drain",
+        "value": round(drain, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SECONDS / max(publish, 1e-9), 1),
+        "extra": {
+            "digest_off_drain_s": round(off_run["drain"], 4),
+            "digest_off_publish_s": round(off_run["publish"], 4),
+            "ratio": round(drain / max(off_run["drain"], 1e-9), 3),
+        },
+    }))
+
 
 def _build_open_loop_store(n_nodes=200):
     """Small-but-real cluster for the open-loop SLO runs: latency under
@@ -1411,6 +1441,22 @@ def check_results(results, bands):
                 and extra["p99_ms"] > band["max_p99_ms"]:
             breaches.append(
                 f"p99 {extra['p99_ms']:.1f}ms > {band['max_p99_ms']:.1f}ms")
+        if band.get("max_ratio") is not None:
+            ratio = extra.get("ratio")
+            if ratio is None:
+                ok = False
+                lines.append(f"FAIL {metric}: no ratio in capture")
+                continue
+            # noise floor: a ratio over a sub-second base is measurement
+            # noise (fast containers drain in microseconds) — a breach
+            # needs the absolute delta too
+            base = p["value"] / max(ratio, 1e-9)
+            delta = p["value"] - base
+            if ratio > band["max_ratio"] \
+                    and delta > band.get("min_delta_s", 0.0):
+                breaches.append(
+                    f"ratio {ratio:.3f} > band {band['max_ratio']:.3f} "
+                    f"(delta {delta:.3f}s)")
         if breaches:
             ok = False
             lines.append(f"FAIL {metric}: " + "; ".join(breaches))
@@ -1421,6 +1467,16 @@ def check_results(results, bands):
                 cap_txt = f"{cap:.4f}" if cap is not None else "—"
                 lines.append(
                     f"  phase {phase:<12} {got:.4f}s / band {cap_txt}s{mark}")
+        elif band.get("max_s") is None and band.get("max_ratio") is not None:
+            if extra["ratio"] > band["max_ratio"]:
+                lines.append(
+                    f"ok   {metric}: ratio {extra['ratio']:.3f} > "
+                    f"{band['max_ratio']:.3f} but delta under the "
+                    f"{band.get('min_delta_s', 0.0):.2f}s noise floor")
+            else:
+                lines.append(
+                    f"ok   {metric}: ratio {extra['ratio']:.3f} <= "
+                    f"{band['max_ratio']:.3f}")
         else:
             lines.append(
                 f"ok   {metric}: {p['value']:.4f}s <= "
@@ -1540,6 +1596,12 @@ def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
                   f"for this capture (device {device})")
         configs = tuple(n for n in configs
                         if CONFIG_METRIC.get(n) in bands)
+        # cfg7 captures the digest on/off drain comparison alongside its
+        # headline; the absolute 1.05x band gates the auditor's overhead
+        # (no trajectory needed — a ratio is device-invariant)
+        if 7 in configs:
+            bands["cfg7_digest_on_vs_off_drain"] = {
+                "max_ratio": 1.05, "min_delta_s": 0.25}
     start = len(LAST_RESULTS)
     if smoke:
         runners = {0: config_smoke}

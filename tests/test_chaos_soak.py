@@ -386,6 +386,36 @@ def _check_invariants_inner(client):
     assert statement.outstanding() == 0, "unsettled scheduler Statements"
 
 
+def _assert_digest_converged(srv):
+    """PR-13 convergence gate: at storm end a mirror fed the merged
+    watch stream reaches beacon-pinned digest equality with the server,
+    and the server's maintained table equals a raw recompute (no storm
+    path ever mutated an object behind the digest hooks)."""
+    from volcano_tpu import vtaudit
+    from volcano_tpu.scheduler.fastpath import ArrayMirror
+
+    if not vtaudit.enabled():
+        return
+    m = ArrayMirror(RemoteStore(srv.url), "volcano-tpu", "default")
+    res = None
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        m.drain()
+        with srv.lock:
+            srv.stamp_beacon()
+        m.drain()
+        res = m.audit_verify()
+        if res is not None:
+            break  # quiescent: the beacon closed the poll batch
+        time.sleep(0.05)
+    assert res is not None and res["ok"], res
+    truth = srv.store.recompute_digest()
+    maint = srv.store.digest_payload(srv.shards)
+    assert maint is not None
+    assert maint["root"] == vtaudit.hexd(truth.root())
+    assert maint["shards"] == truth.payload(srv.shards)["shards"]
+
+
 def _soak(plan, n_jobs=3, replicas=2, elect=False, flap_component="",
           schedulers=1, controllers=1, queues=("default",),
           trace_ids_out=None):
@@ -452,6 +482,7 @@ def _soak(plan, n_jobs=3, replicas=2, elect=False, flap_component="",
             time.sleep(1.0)
 
         _check_invariants(client)
+        _assert_digest_converged(srv)
 
         leases = {}
         if elect:

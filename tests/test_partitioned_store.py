@@ -505,3 +505,58 @@ def test_sharded_fanout_wire_attribution_not_inflated():
             cache.applier.stop(flush=False)
     finally:
         srv.stop()
+
+
+# -- per-shard digest surface (PR 13: vtaudit) --------------------------------
+
+
+def test_healthz_carries_per_shard_digest_and_seq():
+    """/healthz exposes the maintained digest per shard next to that
+    shard's newest seq — shard skew and divergence at a glance; the
+    per-shard digests must roll up to the root exactly."""
+    import urllib.request
+
+    from volcano_tpu import vtaudit
+
+    if not vtaudit.enabled():
+        pytest.skip("digest auditing disarmed in env")
+    srv = StoreServer(shards=NSHARDS).start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_pods(rs.create, 16)
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=10) as r:
+            hz = json.load(r)
+        dg = hz["digest"]
+        assert dg["seq"] == srv.seq
+        assert len(dg["shards"]) == NSHARDS
+        total = sum(int(s["digest"], 16) for s in dg["shards"]) % (1 << 64)
+        assert vtaudit.hexd(total) == dg["root"]
+        # every seeded namespace's shard saw traffic; no shard seq can
+        # exceed the global seq
+        touched = {shard_of(ns, NSHARDS) for ns in _NAMESPACES}
+        for s, entry in enumerate(dg["shards"]):
+            assert entry["seq"] <= dg["seq"]
+            if s in touched:
+                assert entry["seq"] > 0
+        # the rollup agrees with /debug/digest's maintained tier
+        with urllib.request.urlopen(
+            srv.url + "/debug/digest", timeout=10
+        ) as r:
+            dbg = json.load(r)
+        assert dbg["root"] == dg["root"]
+        assert dbg["shards"] == [e["digest"] for e in dg["shards"]]
+        assert dbg["shard_seq"] == [e["seq"] for e in dg["shards"]]
+        # one more namespace-scoped write moves EXACTLY that shard's
+        # digest and seq
+        before = dg["shards"]
+        rs.create("Pod", build_pod("extra", namespace=_NAMESPACES[0]))
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=10) as r:
+            after = json.load(r)["digest"]["shards"]
+        hot = shard_of(_NAMESPACES[0], NSHARDS)
+        for s in range(NSHARDS):
+            if s == hot:
+                assert after[s] != before[s]
+            else:
+                assert after[s]["digest"] == before[s]["digest"]
+    finally:
+        srv.stop()

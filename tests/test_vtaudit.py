@@ -1,0 +1,386 @@
+"""vtaudit: the incremental state-digest auditor (PR 13 tentpole).
+
+The gate for continuous divergence detection:
+
+  * the digest algebra: order independence (per-bucket sums commute),
+    removal as the exact inverse, field-delta patches equal to a full
+    re-digest, and version-counter neutrality (``SKIP_LEAVES``) — the
+    invariants every maintenance hook relies on;
+  * the corruption drill: flip ONE field of ONE stored object behind
+    the verbs' back and the maintained-vs-recompute walk must localize
+    it to the exact ``(kind, namespace, name)`` — locally, and over a
+    partitioned server via the ``?recompute=1`` debug tier;
+  * the mirror half: an ``ArrayMirror`` fed the watch stream maintains
+    its own table and ``audit_verify`` reaches digest equality with
+    the server (beacon-pinned remotely, lock-synchronous in-process),
+    detects tampering, and self-heals by resync;
+  * the WAL half: ``replay_wal_digest`` folds a snapshot+WAL lineage
+    into the same digest the live server reports;
+  * the beacon protocol: seq-pinned checkpoints ride the event log to
+    every shard watcher without ever surfacing as objects.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from volcano_tpu import vtaudit
+from volcano_tpu.api.objects import Metadata, Queue
+from volcano_tpu.store import Store
+from volcano_tpu.store.client import RemoteStore
+from volcano_tpu.store.server import StoreServer
+
+from tests.helpers import build_node, build_pod, build_podgroup
+
+pytestmark = pytest.mark.skipif(
+    not vtaudit.enabled(), reason="digest auditing disarmed in env"
+)
+
+
+def _fetch(url, path):
+    with urllib.request.urlopen(url.rstrip("/") + path, timeout=10) as r:
+        return json.load(r)
+
+
+# -- the digest algebra -------------------------------------------------------
+
+
+def test_digest_order_independent_and_removal_inverse():
+    pods = [build_pod(f"p{i}", namespace=f"ns{i % 3}") for i in range(12)]
+    a = vtaudit.table_from_objects(("Pod", p) for p in pods)
+    b = vtaudit.table_from_objects(("Pod", p) for p in reversed(pods))
+    assert a.root() == b.root()
+    assert a.bucket_payload() == b.bucket_payload()
+    # add one more, remove it again: bit-for-bit back where we started
+    before = a.payload(4)
+    extra = build_pod("extra", namespace="ns1")
+    a.set_obj("Pod", extra.meta.key, extra)
+    assert a.payload(4) != before
+    a.remove("Pod", extra.meta.key)
+    assert a.payload(4) == before
+
+
+def test_field_delta_patch_equals_full_redigest():
+    t = vtaudit.DigestTable()
+    p = build_pod("p0")
+    t.set_obj("Pod", p.meta.key, p)
+    old = p.node_name
+    p.node_name = "n7"
+    t.apply_fields("Pod", p.meta.key, (("node_name", old, "n7"),), obj=p)
+    fresh = vtaudit.table_from_objects([("Pod", p)])
+    assert t.root() == fresh.root()
+    assert t.object_payload("Pod", "default") == fresh.object_payload(
+        "Pod", "default")
+
+
+def test_resource_version_is_digest_neutral():
+    """rv bumps on every write by design — digesting it would make every
+    no-op-adjacent path a divergence; SKIP_LEAVES drops it."""
+    p = build_pod("p0")
+    p.meta.resource_version = 1
+    d1 = vtaudit.obj_digest("Pod", p)
+    p.meta.resource_version = 999
+    assert vtaudit.obj_digest("Pod", p) == d1
+    # a REAL field flip does move the digest
+    p.node_name = "n1"
+    assert vtaudit.obj_digest("Pod", p) != d1
+
+
+def test_store_maintains_digest_through_every_verb():
+    """create/update/patch/delete all keep the maintained table equal to
+    a ground-truth recompute (the invariant vtlint's digest-maintenance
+    rule fences statically)."""
+    st = Store()
+    st.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    st.create("Node", build_node("n0"))
+    for i in range(6):
+        st.create("Pod", build_pod(f"p{i}"))
+    st.patch("Pod", "default/p1", {"node_name": "n0"})
+    p3 = st.get("Pod", "default/p3")
+    p3.phase = type(p3.phase)("Running")
+    st.update("Pod", p3)
+    st.delete("Pod", "default/p4")
+    maint = st._digest
+    truth = st.recompute_digest()
+    assert maint is not None
+    assert maint.root() == truth.root()
+    assert maint.bucket_payload() == truth.bucket_payload()
+
+
+# -- the corruption drill -----------------------------------------------------
+
+
+def test_corruption_localizes_to_exact_object_locally():
+    from volcano_tpu.cli import vtctl
+
+    st = Store()
+    for i in range(8):
+        st.create("Pod", build_pod(f"p{i}", namespace=f"ns{i % 2}"))
+    assert "state digest OK" in vtctl.cmd_audit_local(st)
+    # flip one byte of one object's state behind the verbs' back
+    st._objects["Pod"]["ns1/p5"].node_name = "flipped"
+    text = vtctl.cmd_audit_local(st)
+    assert "STATE DIGEST DIVERGENCE" in text
+    assert "Pod ns1/p5" in text
+    # exactly one object implicated
+    assert text.count("maintained=") - 1 == 1
+
+
+def test_corruption_localizes_over_partitioned_server():
+    """The remote drill: the maintained rollup vs the server-side
+    ``?recompute=1`` tier walks shard -> bucket -> object down to the
+    flipped pod, and ``vtctl audit --server`` exits 2."""
+    from volcano_tpu.cli import vtctl
+
+    srv = StoreServer(shards=4).start()
+    try:
+        rs = RemoteStore(srv.url)
+        for i in range(10):
+            rs.create("Pod", build_pod(f"p{i}", namespace=f"team{i % 4}"))
+        assert "state digest OK" in vtctl.cmd_audit_remote(srv.url)
+        srv.store._objects["Pod"]["team2/p6"].node_name = "flipped"
+        text = vtctl.cmd_audit_remote(srv.url)
+        assert "STATE DIGEST DIVERGENCE" in text
+        assert "Pod team2/p6" in text
+        assert vtctl.main(["audit", "--server", srv.url]) == 2
+    finally:
+        srv.stop()
+
+
+def test_debug_digest_recompute_tier_matches_maintained_when_clean():
+    srv = StoreServer(shards=4).start()
+    try:
+        rs = RemoteStore(srv.url)
+        for i in range(6):
+            rs.create("Pod", build_pod(f"p{i}", namespace=f"team{i % 3}"))
+        dbg = _fetch(srv.url, "/debug/digest")
+        rec = _fetch(srv.url, "/debug/digest?recompute=1")
+        assert dbg["enabled"] and rec["recompute"]
+        assert dbg["root"] == rec["root"]
+        assert dbg["shards"] == rec["shards"]
+        # healthz mirrors the same rollup
+        hz = _fetch(srv.url, "/healthz")
+        assert hz["digest"]["root"] == dbg["root"]
+    finally:
+        srv.stop()
+
+
+# -- the mirror half ----------------------------------------------------------
+
+
+def _seed_cluster(create):
+    create("Queue", Queue(meta=Metadata(name="default", namespace="")))
+    create("Node", build_node("n0"))
+    create("PodGroup", build_podgroup("pg", min_member=1))
+    for i in range(5):
+        create("Pod", build_pod(f"p{i}", group="pg"))
+
+
+def test_mirror_audit_verify_in_process_and_detects_tampering():
+    from volcano_tpu.scheduler.fastpath import ArrayMirror
+
+    st = Store()
+    _seed_cluster(st.create)
+    m = ArrayMirror(st, "volcano-tpu", "default")
+    m.drain()
+    res = m.audit_verify()
+    assert res is not None and res["ok"], res
+    assert res["mode"] == "store"
+    # keep verifying through incremental traffic
+    st.patch("Pod", "default/p1", {"node_name": "n0"})
+    st.delete("Pod", "default/p4")
+    m.drain()
+    res = m.audit_verify()
+    assert res is not None and res["ok"], res
+    # tamper the MIRROR's table: detection names the kind, resync heals
+    m._audit.set_enc("Pod", "default/poison", {"meta": {"name": "poison"}})
+    res = m.audit_verify()
+    assert res is not None and not res["ok"] and res["kinds"] == ["Pod"]
+    assert m.audit_divergences == 1
+    m.drain()
+    res = m.audit_verify()
+    assert res is not None and res["ok"], res
+
+
+def test_mirror_reaches_digest_equality_with_partitioned_server():
+    """The merged watch stream of a shards=4 server drives the mirror's
+    independent table to beacon-pinned equality with the server's."""
+    from volcano_tpu.scheduler.fastpath import ArrayMirror
+
+    srv = StoreServer(shards=4).start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_cluster(rs.create)
+        mirror_store = RemoteStore(srv.url)
+        m = ArrayMirror(mirror_store, "volcano-tpu", "default")
+        m.drain()
+        with srv.lock:
+            assert srv.stamp_beacon()  # seq-pinned checkpoint, on demand
+        m.drain()  # the poll that delivers the beacon
+        res = m.audit_verify()
+        assert res is not None and res["ok"], res
+        assert res["mode"] == "beacon" and res["seq"] == srv.seq
+        # more traffic, a new beacon: still equal
+        rs.patch("Pod", "default/p2", {"node_name": "n0"})
+        rs.delete("Pod", "default/p0")
+        with srv.lock:
+            assert srv.stamp_beacon()
+        m.drain()
+        res = m.audit_verify()
+        assert res is not None and res["ok"], res
+    finally:
+        srv.stop()
+
+
+def test_beacon_rides_every_shard_watch_and_is_not_an_object():
+    srv = StoreServer(shards=4).start()
+    try:
+        rs = RemoteStore(srv.url)
+        for i in range(8):
+            rs.create("Pod", build_pod(f"p{i}", namespace=f"team{i}"))
+        watchers = [RemoteStore(srv.url, shard=s) for s in range(4)]
+        queues = [w.watch("Pod") for w in watchers]
+        for w in watchers:
+            w.poll()
+        with srv.lock:
+            assert srv.stamp_beacon()
+            # the cadence path never re-beacons without seq progress
+            # (the just-stamped beacon pinned the current seq)
+            assert not srv._maybe_beacon()
+        for w, q in zip(watchers, queues):
+            while q:
+                q.popleft()
+            w.poll()
+            # the beacon reached this shard's watcher as a beacon, not
+            # as a Pod event
+            assert not q
+            assert w.last_beacon is not None and w.beacon_is_tail
+            assert w.last_beacon["seq"] == srv.seq
+        dbg = _fetch(srv.url, "/debug/digest")
+        assert watchers[0].last_beacon["root"] == dbg["root"]
+        # beacons never materialize as listable objects
+        assert rs.list("Pod") and len(rs.list("Pod")) == 8
+    finally:
+        srv.stop()
+
+
+# -- the WAL half -------------------------------------------------------------
+
+
+def test_wal_replay_digest_matches_live_server(tmp_path):
+    srv = StoreServer(
+        state_path=str(tmp_path / "state.json"), save_interval=3600,
+        wal=True, shards=4,
+    ).start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_cluster(rs.create)
+        rs.patch("Pod", "default/p3", {"node_name": "n0"})
+        rs.delete("Pod", "default/p1")
+        live = _fetch(srv.url, "/debug/digest")
+        res = vtaudit.replay_wal_digest(str(tmp_path / "state.json"))
+        assert res["digest"] is not None
+        assert res["digest"]["root"] == live["root"]
+        assert res["digest"]["shards"] == live["shards"]
+        assert res["seq"] == live["seq"]
+        # the CLI wrapper agrees and stamps the verdict
+        from volcano_tpu.cli import vtctl
+
+        text = vtctl.cmd_audit_wal(
+            str(tmp_path / "state.json.wal"), server_url=srv.url)
+        assert "MATCH" in text and "MISMATCH" not in text
+        assert vtctl.main([
+            "audit", "wal", str(tmp_path / "state.json.wal"),
+            "--server", srv.url]) == 0
+    finally:
+        srv.stop()
+
+
+def test_wal_replay_digest_survives_kill_and_matches_reboot(tmp_path):
+    srv = StoreServer(
+        state_path=str(tmp_path / "state.json"), save_interval=3600,
+        wal=True, shards=4,
+    ).start()
+    rs = RemoteStore(srv.url)
+    _seed_cluster(rs.create)
+    rs.patch("Pod", "default/p2", {"node_name": "n0"})
+    srv.kill()  # no flush: the WAL tail is the only record
+    res = vtaudit.replay_wal_digest(str(tmp_path / "state.json"))
+    srv2 = StoreServer(
+        port=srv.port, state_path=str(tmp_path / "state.json"),
+        save_interval=3600, wal=True, shards=4,
+    ).start()
+    try:
+        live = _fetch(srv2.url, "/debug/digest")
+        assert res["digest"]["root"] == live["root"]
+        assert res["digest"]["shards"] == live["shards"]
+    finally:
+        srv2.stop()
+
+
+# -- metrics / anomaly wiring -------------------------------------------------
+
+
+def test_audit_metrics_registered_and_monotonic():
+    from volcano_tpu.scheduler import metrics
+
+    c0 = metrics.get_counter("volcano_audit_digest_checks_total")
+    d0 = metrics.get_counter("volcano_audit_divergence_total")
+    metrics.register_audit_check()
+    metrics.register_audit_divergence()
+    metrics.observe_beacon_lag(0.25)
+    assert metrics.get_counter("volcano_audit_digest_checks_total") == c0 + 1
+    assert metrics.get_counter("volcano_audit_divergence_total") == d0 + 1
+    text = metrics.expose_text()
+    for name in ("volcano_audit_digest_checks_total",
+                 "volcano_audit_divergence_total",
+                 "volcano_audit_beacon_lag_seconds"):
+        assert name in text
+
+def test_audit_verify_survives_stale_watch_during_quiescence_peek():
+    """The quiescence peek in ``audit_verify`` polls the wire, so it can
+    fall off the server's event log mid-check (cfg7 found this: a long
+    solve between drains overflowed the log and the StaleWatch escaped
+    ``run_once`` through ``_audit_tick``).  It must recover exactly like
+    ``drain()`` — relist, count it, report non-quiescent — and the next
+    beacon-pinned check must pass again."""
+    from volcano_tpu.scheduler.fastpath import ArrayMirror
+    from volcano_tpu.store.client import StaleWatch
+
+    class _StaleQueue:
+        def __bool__(self):
+            raise StaleWatch("watch cursor fell off the server log")
+
+        def clear(self):
+            pass
+
+    srv = StoreServer(shards=2).start()
+    try:
+        rs = RemoteStore(srv.url)
+        _seed_cluster(rs.create)
+        m = ArrayMirror(RemoteStore(srv.url), "volcano-tpu", "default")
+        m.drain()
+        with srv.lock:
+            assert srv.stamp_beacon()
+        m.drain()
+        assert m.audit_verify()["ok"]
+        relists = m.stale_relists
+        m._watches.insert(0, ("Pod", _StaleQueue()))
+        res = m.audit_verify()  # must NOT raise
+        assert res is None
+        assert m.stale_relists == relists + 1
+        # a real post-gap poll stops raising (the cursor advanced past
+        # the gap); the injected queue stands in for the raising window
+        # only, so retire it and prove the next pinned check converges
+        m._watches.remove(("Pod", next(
+            q for _, q in m._watches if isinstance(q, _StaleQueue))))
+        rs.patch("Pod", "default/p1", {"node_name": "n1"})
+        with srv.lock:
+            assert srv.stamp_beacon()
+        m.drain()
+        res = m.audit_verify()
+        assert res is not None and res["ok"], res
+    finally:
+        srv.stop()

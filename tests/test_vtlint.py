@@ -1377,3 +1377,87 @@ def test_shard_spec_complete_real_module_is_total():
     declared = set(sharded._SPECS) | set(sharded._REPLICATED)
     missing = set(args) - declared
     assert not missing, f"undeclared cycle args: {sorted(missing)}"
+
+
+# --- rule: digest-maintenance (PR 13: vtaudit state-digest auditor) ----------
+
+
+def test_digest_maintenance_fires_on_unaudited_mutations(tmp_path):
+    """Every mutation class: direct subscript write, alias .pop, in-place
+    setattr, lazy-patch staging — all without touching `_digest`."""
+    findings = _lint(tmp_path, "store/store.py", """
+        class Store:
+            def rogue_insert(self, kind, key, obj):
+                self._objects[kind][key] = obj
+
+            def rogue_alias_pop(self, kind, key):
+                bucket = self._objects[kind]
+                return bucket.pop(key, None)
+
+            def rogue_setattr(self, obj, field, v):
+                setattr(obj, field, v)
+
+            def rogue_lazy(self, kind, key, fields, rv):
+                lp = self._lazy_patch.get(kind)
+                lp[key] = (fields, rv)
+    """, select=["digest-maintenance"])
+    assert _rules_of(findings) == ["digest-maintenance"] * 4
+    texts = "\n".join(f.message for f in findings)
+    assert "_objects" in texts and "_lazy_patch" in texts
+    assert "setattr" in texts
+
+
+def test_digest_maintenance_near_misses_stay_quiet(tmp_path):
+    # the mutation routes through the digest helper: quiet
+    assert _lint(tmp_path, "store/store.py", """
+        class Store:
+            def create(self, kind, key, obj):
+                self._objects[kind][key] = obj
+                dg = self._digest
+                if dg is not None:
+                    dg.set_obj(kind, key, obj)
+    """, select=["digest-maintenance"]) == []
+    # materialization folds values the staging path already digested:
+    # structurally exempt, whatever it touches
+    assert _lint(tmp_path, "store/store.py", """
+        class Store:
+            def _materialize(self, kind, key):
+                entry = self._lazy_patch[kind].pop(key, None)
+                if entry:
+                    setattr(self._objects[kind][key], "x", entry)
+    """, select=["digest-maintenance"]) == []
+    # _lazy_create holds staged Events — unaudited kind, out of scope
+    assert _lint(tmp_path, "store/store.py", """
+        class Store:
+            def stage(self, blk, r):
+                self._lazy_create["Event"][blk.key(r)] = (blk, r)
+    """, select=["digest-maintenance"]) == []
+    # reads never fire
+    assert _lint(tmp_path, "store/store.py", """
+        class Store:
+            def get(self, kind, key):
+                lp = self._lazy_patch.get(kind)
+                if lp and key in lp:
+                    return lp[key]
+                return self._objects[kind].get(key)
+    """, select=["digest-maintenance"]) == []
+    # identical mutation outside the store module set: out of scope
+    assert _lint(tmp_path, "scheduler/cache.py", """
+        class Cache:
+            def rogue_insert(self, kind, key, obj):
+                self._objects[kind][key] = obj
+    """, select=["digest-maintenance"]) == []
+
+
+def test_digest_maintenance_real_store_is_clean():
+    """The live proof: every mutation verb in the real store keeps the
+    digest (or is structurally exempt) — zero findings over store/."""
+    import volcano_tpu
+
+    pkg = os.path.dirname(os.path.abspath(volcano_tpu.__file__))
+    findings = run_paths(
+        [os.path.join(pkg, "store")],
+        root=os.path.dirname(pkg),
+        select=["digest-maintenance"],
+    )
+    assert findings == [], "\n".join(f.human() for f in findings)

@@ -88,6 +88,7 @@ def _load_rule_modules() -> None:
         return
     _LOADED = True
     from volcano_tpu.analysis import (  # noqa: F401  (import = registration)
+        rules_audit,
         rules_concurrency,
         rules_device,
         rules_epsilon,

@@ -1,0 +1,198 @@
+"""digest-maintenance: every object mutation must keep the state digest.
+
+vtaudit (volcano_tpu/vtaudit.py) maintains an incremental, order-
+independent digest of the store's audited objects — updated O(1) under
+the same ``_mu`` hold as the mutation itself (``dg.set_obj`` /
+``dg.apply_fields`` / ``dg.remove``).  The whole divergence-detection
+story (mirror verify, /debug/digest, beacons, ``vtctl audit``) rests on
+ONE invariant: no object-mutating path in the store may skip the digest
+update, or the maintained rollup silently drifts from reality and the
+auditor cries wolf on a healthy store.
+
+This rule fences that invariant in the store module set
+(``store/store.py``, ``store/partition.py``): inside any function that
+mutates a digested container — a subscript assignment, ``del``,
+``.pop``/``.clear``/``.update``/``.setdefault`` on ``self._objects`` or
+``self._lazy_patch`` (directly or through a local alias), or an
+in-place ``setattr`` on a live object — the function must also touch
+``_digest`` (the maintenance hook lives in the same verb, same lock
+hold).  Exemptions are structural, not suppressions:
+
+* ``_materialize*``/``materialize*`` methods — materialization folds
+  exactly the values the staging path ALREADY digested
+  (``_stage_lazy_rows``), so it is digest-neutral by design;
+* ``self._lazy_create`` — staged Event blocks; Events are outside
+  ``vtaudit.AUDITED_KINDS`` (unbounded append-only log records).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set
+
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    dotted_name,
+    rule,
+)
+
+_SCOPED_SUFFIXES = (
+    "store/store.py",
+    "store/partition.py",
+)
+
+#: the digested containers (``self.<name>``); ``_lazy_create`` is
+#: deliberately absent — Events are unaudited
+_CONTAINERS = {"_objects", "_lazy_patch"}
+
+_MUTATOR_METHODS = {"pop", "clear", "update", "setdefault", "popitem"}
+
+
+def _is_exempt(fn: ast.AST) -> bool:
+    """Materialization is digest-neutral by design (see module doc)."""
+    return getattr(fn, "name", "").lstrip("_").startswith("materialize")
+
+
+def _touches_digest(fn: ast.AST) -> bool:
+    """True when the function references ``_digest`` — as an attribute
+    (``self._digest``) or a key (``self.__dict__["_digest"]``)."""
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Attribute) and sub.attr == "_digest":
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == "_digest":
+            return True
+    return False
+
+
+def _container_root(expr: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """The digested container an expression drills into, or None.  Peels
+    subscripts and ``.get(...)`` reads, so ``self._objects[kind]``,
+    ``self._lazy_patch.get(kind)`` and aliases thereof all resolve."""
+    cur = expr
+    while True:
+        if isinstance(cur, ast.Subscript):
+            cur = cur.value
+            continue
+        if (
+            isinstance(cur, ast.Call)
+            and isinstance(cur.func, ast.Attribute)
+            and cur.func.attr == "get"
+        ):
+            cur = cur.func.value
+            continue
+        break
+    name = dotted_name(cur)
+    if name is None:
+        return None
+    tail = name.split(".")[-1]
+    if tail in _CONTAINERS:
+        return tail
+    return aliases.get(name)
+
+
+def _collect_aliases(fn: ast.AST) -> Dict[str, str]:
+    """Local names bound from a digested container (``pods =
+    self._objects["Pod"]``, ``lp = self._lazy_patch.get(kind)``) —
+    transitively, in source order (good enough for the straight-line
+    binds the store uses)."""
+    aliases: Dict[str, str] = {}
+    for sub in ast.walk(fn):
+        if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+            continue
+        tgt = sub.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        root = _container_root(sub.value, aliases)
+        if root is not None:
+            aliases[tgt.id] = root
+    return aliases
+
+
+def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Every node of ``fn`` except those inside nested function defs —
+    a nested def is its own audit scope."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule(
+    "digest-maintenance",
+    "object mutation in the store module set (store/store.py, "
+    "store/partition.py) inside a function that never touches `_digest` "
+    "— the incremental state digest (volcano_tpu/vtaudit.py) silently "
+    "drifts from reality and `vtctl audit` flags a healthy store; update "
+    "the digest under the same lock hold (set_obj/apply_fields/remove), "
+    "or suppress with the digest-neutrality argument on the line",
+)
+def check_digest_maintenance(ctx: FileContext) -> Iterable[Finding]:
+    if not any(ctx.relpath.endswith(s) for s in _SCOPED_SUFFIXES):
+        return
+    funcs = [
+        fn for fn in ast.walk(ctx.tree)
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in funcs:
+        if _is_exempt(fn) or _touches_digest(fn):
+            continue
+        aliases = _collect_aliases(fn)
+        seen: Set[int] = set()
+
+        def hit(node: ast.AST, what: str):
+            if id(node) in seen:
+                return None
+            seen.add(id(node))
+            return ctx.finding(
+                "digest-maintenance",
+                node,
+                f"{what} in `{fn.name}` without a `_digest` update — "
+                "the maintained state digest drifts from the stored "
+                "objects (vtaudit divergence on a healthy store); "
+                "route the mutation through the digest helper under "
+                "the same lock hold",
+            )
+
+        for node in _own_nodes(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript):
+                        root = _container_root(tgt.value, aliases)
+                        if root is not None:
+                            f = hit(node, f"subscript write into `{root}`")
+                            if f:
+                                yield f
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        root = _container_root(tgt.value, aliases)
+                        if root is not None:
+                            f = hit(node, f"`del` from `{root}`")
+                            if f:
+                                yield f
+            elif isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if fname == "setattr":
+                    f = hit(node, "in-place `setattr` on a live object")
+                    if f:
+                        yield f
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATOR_METHODS
+                ):
+                    root = _container_root(node.func.value, aliases)
+                    if root is not None:
+                        f = hit(
+                            node,
+                            f"`.{node.func.attr}()` on `{root}`",
+                        )
+                        if f:
+                            yield f

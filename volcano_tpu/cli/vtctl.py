@@ -468,6 +468,180 @@ def _fetch_debug_timeseries(server_url: str) -> list:
     return _fetch_debug(server_url, "/debug/timeseries").get("samples") or []
 
 
+# -- vtaudit: state-digest audit (volcano_tpu/vtaudit.py) ---------------------
+
+
+def _audit_localize(maint, truth):
+    """The localization walk over two DigestTables: mismatched
+    (kind, namespace) buckets -> mismatched objects.  Returns sorted
+    ``(kind, namespace, name, maintained_hex, actual_hex)`` rows."""
+    from volcano_tpu import vtaudit
+
+    zero = vtaudit.hexd(0)
+    out = []
+    for bk in vtaudit.diff_maps(maint.bucket_payload(),
+                                truth.bucket_payload()):
+        kind, _, ns = bk.partition("|")
+        a = maint.object_payload(kind, ns)
+        b = truth.object_payload(kind, ns)
+        for key in vtaudit.diff_maps(a, b):
+            out.append((kind, ns, key.rpartition("/")[2],
+                        a.get(key, zero), b.get(key, zero)))
+    return sorted(out)
+
+
+def cmd_audit_local(store, out: Optional[io.TextIOBase] = None) -> str:
+    """Audit a local store: the incrementally maintained digest against
+    a ground-truth recompute from the objects, localized on mismatch."""
+    from volcano_tpu import vtaudit
+
+    buf = io.StringIO()
+    truth = store.recompute_digest()
+    maint = store._digest
+    if maint is None:
+        buf.write("digest maintenance disarmed (VOLCANO_TPU_AUDIT=0); "
+                  f"recomputed root={vtaudit.hexd(truth.root())}\n")
+    else:
+        bad = _audit_localize(maint, truth)
+        if not bad:
+            nobj = sum(len(m) for m in maint.objd.values())
+            buf.write(f"state digest OK  root={vtaudit.hexd(maint.root())}"
+                      f"  objects={nobj}\n")
+        else:
+            buf.write("STATE DIGEST DIVERGENCE  "
+                      f"maintained={vtaudit.hexd(maint.root())}  "
+                      f"actual={vtaudit.hexd(truth.root())}\n")
+            for kind, ns, name, mine, actual in bad:
+                buf.write(f"  {kind} {ns}/{name}: maintained={mine} "
+                          f"actual={actual}\n")
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def cmd_audit_remote(server_url: str,
+                     out: Optional[io.TextIOBase] = None) -> str:
+    """Audit a remote store server three ways: the incrementally
+    maintained /debug/digest rollups against a server-side ground-truth
+    recompute of the raw objects (``?recompute=1`` — catches state
+    corruption that bypassed the mutation verbs), walking
+    shard -> bucket -> object on mismatch, plus a client-side recompute
+    from the wire lists (catches serving-cache / transport drift)."""
+    from urllib.parse import quote
+
+    from volcano_tpu import vtaudit
+    from volcano_tpu.store.client import RemoteStore
+
+    buf = io.StringIO()
+    dbg = _fetch_debug(server_url, "/debug/digest")
+    if not dbg.get("enabled"):
+        buf.write("server digest maintenance disarmed "
+                  "(VOLCANO_TPU_AUDIT=0)\n")
+        text = buf.getvalue()
+        if out is not None:
+            out.write(text)
+        return text
+    shards = max(1, len(dbg.get("shards") or []))
+    truth = _fetch_debug(server_url, "/debug/digest?recompute=1")
+    rs = RemoteStore(server_url)
+    wire = vtaudit.table_from_objects(
+        (kind, obj) for kind in sorted(vtaudit.AUDITED_KINDS)
+        for obj in rs.list(kind)
+    )
+    wire_root = vtaudit.hexd(wire.root())
+    bad_shards = [i for i, (a, b) in enumerate(zip(dbg["shards"],
+                                                   truth["shards"]))
+                  if a != b]
+    if not bad_shards and wire_root == truth["root"]:
+        buf.write(f"state digest OK  root={dbg['root']}  seq={dbg['seq']}"
+                  f"  shards={shards}\n")
+    else:
+        zero = vtaudit.hexd(0)
+        if bad_shards:
+            buf.write(f"STATE DIGEST DIVERGENCE  shards={bad_shards}  "
+                      f"maintained={dbg['root']}  actual={truth['root']}\n")
+            srv_buckets = _fetch_debug(
+                server_url, "/debug/digest?detail=buckets")["buckets"]
+            true_buckets = _fetch_debug(
+                server_url,
+                "/debug/digest?recompute=1&detail=buckets")["buckets"]
+            for bk in vtaudit.diff_maps(srv_buckets, true_buckets):
+                kind, _, ns = bk.partition("|")
+                tier = f"kind={quote(kind)}&namespace={quote(ns)}"
+                srv_objs = _fetch_debug(
+                    server_url, f"/debug/digest?{tier}")["objects"]
+                true_objs = _fetch_debug(
+                    server_url,
+                    f"/debug/digest?recompute=1&{tier}")["objects"]
+                for key in vtaudit.diff_maps(srv_objs, true_objs):
+                    buf.write(f"  {kind} {ns}/{key.rpartition('/')[2]}: "
+                              f"maintained={srv_objs.get(key, zero)} "
+                              f"actual={true_objs.get(key, zero)}\n")
+        if wire_root != truth["root"]:
+            buf.write("WIRE DIGEST DIVERGENCE  (served list encodings "
+                      f"disagree with raw state)  wire={wire_root}  "
+                      f"actual={truth['root']}\n")
+            for bk in vtaudit.diff_maps(
+                    wire.bucket_payload(None, shards),
+                    _fetch_debug(
+                        server_url,
+                        "/debug/digest?recompute=1&detail=buckets"
+                    )["buckets"]):
+                kind, _, ns = bk.partition("|")
+                my_objs = wire.object_payload(kind, ns)
+                true_objs = _fetch_debug(
+                    server_url,
+                    "/debug/digest?recompute=1&"
+                    f"kind={quote(kind)}&namespace={quote(ns)}")["objects"]
+                for key in vtaudit.diff_maps(my_objs, true_objs):
+                    buf.write(f"  {kind} {ns}/{key.rpartition('/')[2]}: "
+                              f"wire={my_objs.get(key, zero)} "
+                              f"actual={true_objs.get(key, zero)}\n")
+        # the walk above is not seq-pinned: if the server moved while
+        # we walked, a clean server can look diverged — say so
+        seq2 = _fetch_debug(server_url, "/debug/digest").get("seq")
+        if seq2 != dbg.get("seq"):
+            buf.write(f"  (state moved during audit: seq {dbg.get('seq')}"
+                      f" -> {seq2}; re-run to confirm)\n")
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def cmd_audit_wal(wal_dir: str, state: str = "", server_url: str = "",
+                  out: Optional[io.TextIOBase] = None) -> str:
+    """Replay a snapshot+WAL lineage into a digest (scratch copy — the
+    live lineage is never touched) and, with --server, verify it against
+    the live server's current digest."""
+    from volcano_tpu import vtaudit
+
+    buf = io.StringIO()
+    state_path = state or (wal_dir[:-4] if wal_dir.endswith(".wal")
+                           else wal_dir)
+    res = vtaudit.replay_wal_digest(state_path)
+    dg = res["digest"]
+    if dg is None:
+        buf.write("digest maintenance disarmed (VOLCANO_TPU_AUDIT=0); "
+                  "nothing to verify\n")
+    else:
+        buf.write(f"WAL replay digest  root={dg['root']}  seq={res['seq']}"
+                  f"  shards={res['shards']}  "
+                  f"replayed={res['replayed_records']}  "
+                  f"torn_tails={res['torn_tails']}\n")
+        if server_url:
+            live = _fetch_debug(server_url, "/debug/digest")
+            verdict = ("MATCH" if live.get("root") == dg["root"]
+                       else "MISMATCH")
+            buf.write(f"live server root={live.get('root')}  "
+                      f"seq={live.get('seq')}  {verdict}\n")
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
 def cmd_trace_render(records, trace_id: str = "",
                      out: Optional[io.TextIOBase] = None) -> str:
     """Span tree for one trace — the given id, or the most recent trace
@@ -730,6 +904,23 @@ def main(argv=None) -> int:
     prof_p.add_argument("--json", action="store_true",
                         help="raw payload instead of the text report")
 
+    # vtaudit: the state-digest auditor (vtaudit.py)
+    audit_p = sub.add_parser("audit", parents=[common],
+                             help="state-digest audit: divergence "
+                                  "detection with (kind, namespace, "
+                                  "name) localization")
+    audit_sub = audit_p.add_subparsers(dest="cmd")
+    awal_p = audit_sub.add_parser(
+        "wal", parents=[common],
+        help="replay a snapshot+WAL lineage into a digest (scratch "
+             "copy) and verify it against the live server")
+    awal_p.add_argument("dir",
+                        help="the WAL directory (<state>.wal) or the "
+                             "state path itself")
+    awal_p.add_argument("--snapshot", default="",
+                        help="snapshot path when it is not "
+                             "<dir minus .wal>")
+
     cl_p = sub.add_parser("cluster", help="simulated cluster management")
     cl_sub = cl_p.add_subparsers(dest="cmd", required=True)
     init_p = cl_sub.add_parser("init", parents=[common])
@@ -847,6 +1038,23 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 1
         return 0
+
+    if args.group == "audit":
+        try:
+            if getattr(args, "cmd", None) == "wal":
+                text = cmd_audit_wal(args.dir, state=args.snapshot,
+                                     server_url=args.server,
+                                     out=sys.stdout)
+            elif args.server:
+                text = cmd_audit_remote(args.server, out=sys.stdout)
+            else:
+                cluster = _load_cluster(args.state)
+                text = cmd_audit_local(cluster.store, out=sys.stdout)
+        except Exception as e:  # surface as CLI error, not traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        # exit 2 on divergence so scripts/CI can gate on a clean audit
+        return 2 if ("DIVERGENCE" in text or "MISMATCH" in text) else 0
 
     if args.group == "up":
         from volcano_tpu.cli import daemons

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from volcano_tpu import vtaudit
 from volcano_tpu.api.job import POD_GROUP_KEY
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 from volcano_tpu.store.store import EventType
@@ -135,6 +136,19 @@ class ArrayMirror:
         #: StaleWatch recoveries performed by drain() — the chaos soak
         #: asserts the relist path actually ran under log truncation
         self.stale_relists = 0
+        # independent digest rollup (vtaudit): maintained from the SAME
+        # watch stream the row tables consume, so digest equality with
+        # the store proves the stream delivered the whole state — not
+        # that two copies of one bug agree.  Events are recorded lazily
+        # (_audit_pending: last write wins per key) and folded into the
+        # table only at verify/quiescence time, keeping the hot drain
+        # path at two dict writes per event.
+        self._audit = vtaudit.DigestTable() if vtaudit.enabled() else None
+        self._audit_pending: Dict[str, Dict[str, tuple]] = {}
+        self.audit_checks = 0
+        self.audit_divergences = 0
+        self.last_audit: Optional[Dict] = None
+        vtaudit.set_debug_source(self._audit_debug)
         self._reset_tables(["cpu", "memory"])
 
     def _reset_tables(self, dims: List[str]) -> None:
@@ -299,6 +313,7 @@ class ArrayMirror:
             self._on_pdb(pdb)
         for pod in self.store.items("Pod"):
             self._on_pod(pod)
+        self._audit_rebuild()
         self._synced = True
 
     def drain(self) -> None:
@@ -330,9 +345,26 @@ class ArrayMirror:
 
     def _drain_events(self) -> None:
         resync = False
+        audit = self._audit
         for kind, q in self._watches:
             while q:
                 ev = q.popleft()
+                if audit is not None and kind in vtaudit.AUDITED_KINDS:
+                    # absolute per-key record (set-to-post-state / del):
+                    # last write wins, so folding at quiescence yields
+                    # the final state regardless of intra-key ordering.
+                    # Remote events carry their wire encoding (ev.enc);
+                    # in-process ones fold from the live object — equal
+                    # at quiescence by the same last-write-wins argument.
+                    self._audit_pending.setdefault(kind, {})[
+                        ev.obj.meta.key
+                    ] = (
+                        ("del", None)
+                        if ev.type == EventType.DELETED
+                        else ("enc", ev.enc)
+                        if getattr(ev, "enc", None) is not None
+                        else ("obj", ev.obj)
+                    )
                 # EventType is a str enum whose VALUE is "Deleted" — a
                 # "DELETED" (name) comparison silently never matches and
                 # every deletion would re-ingest as an upsert, leaving dead
@@ -369,6 +401,115 @@ class ArrayMirror:
                 # and the residue/preempt sub-cycles read the store directly
         if resync:
             self._resync()
+
+    # -- state-digest audit (vtaudit) ----------------------------------------
+
+    def _audit_rebuild(self) -> None:
+        """Reseed the digest table from store lists — the audit analogue
+        of a full sync (list+watch: the list is the seed, the pending
+        ops re-apply idempotently on top)."""
+        if self._audit is None:
+            return
+        self._audit_pending.clear()
+        self._audit = vtaudit.table_from_objects(
+            (kind, obj)
+            for kind, _ in self._watches
+            for obj in self.store.items(kind)
+        )
+
+    def _audit_fold(self) -> None:
+        """Fold the pending per-key ops into the digest table (verify /
+        quiescence time — never per event)."""
+        t = self._audit
+        for kind, pend in self._audit_pending.items():
+            for key, (mode, val) in pend.items():
+                if mode == "del":
+                    t.remove(kind, key)
+                elif mode == "enc":
+                    t.set_enc(kind, key, val)
+                else:
+                    t.set_obj(kind, key, val)
+        self._audit_pending.clear()
+
+    def audit_verify(self) -> Optional[Dict]:
+        """Compare the mirror's independently maintained digest rollup
+        against the store's — beacon-pinned over a RemoteStore, lock-
+        synchronous in-process.  Quiescence-gated: runs only when every
+        watch queue is drained and (remotely) the newest beacon closed
+        its poll batch, so both sides describe the same seq; returns
+        None when not quiescent.  On divergence the mirror resyncs
+        itself (the recovery) after reporting the mismatched kinds (the
+        alarm) — the caller owns metrics/anomaly emission."""
+        if self._audit is None or not self._synced or self._resyncing:
+            return None
+        watched = [k for k, _ in self._watches if k in vtaudit.AUDITED_KINDS]
+        store = self.store
+        if hasattr(store, "last_beacon"):  # RemoteStore
+            ref = store.last_beacon
+            if ref is None or not store.beacon_is_tail:
+                return None
+            from volcano_tpu.store.client import StaleWatch
+
+            try:
+                undrained = any(q for _, q in self._watches)
+            except StaleWatch:
+                # the quiescence peek polls the wire, so it can fall off
+                # the server's event log exactly like drain() — same
+                # recovery (drop pre-gap buffers, relist), and certainly
+                # not quiescent
+                for _, q in self._watches:
+                    getattr(q, "_buf", q).clear()
+                self.stale_relists += 1
+                self._resync(dims=self.dims)
+                return None
+            if undrained:
+                return None  # undrained events: not at the beacon's seq
+            self._audit_fold()
+            mine = {k: vtaudit.hexd(d)
+                    for k, d in self._audit.kind_rollup().items()}
+            bad = vtaudit.diff_kinds(mine, ref.get("kinds") or {}, watched)
+            res = {"ok": not bad, "kinds": bad, "seq": ref.get("seq"),
+                   "ts": ref.get("ts"), "mode": "beacon"}
+        else:  # in-process Store: compare under the apply lock
+            with store._mu:
+                if any(q for _, q in self._watches):
+                    return None
+                dg = store._digest
+                if dg is None:
+                    return None
+                self._audit_fold()
+                mine = {k: vtaudit.hexd(d)
+                        for k, d in self._audit.kind_rollup().items()}
+                theirs = {k: vtaudit.hexd(d)
+                          for k, d in dg.kind_rollup().items()}
+            bad = vtaudit.diff_kinds(mine, theirs, watched)
+            res = {"ok": not bad, "kinds": bad, "seq": None, "ts": None,
+                   "mode": "store"}
+        self.audit_checks += 1
+        self.last_audit = res
+        if bad:
+            self.audit_divergences += 1
+            self._resync(dims=self.dims)
+        return res
+
+    def _audit_debug(self) -> Dict:
+        """/debug/digest body served by the MetricsServer (vtaudit's
+        debug-source registry).  Read-only best effort: the scheduler
+        thread owns the table, so no fold happens here and a racing
+        mutation at worst garbles one debug reply (the registry catches
+        and reports the exception)."""
+        t = self._audit
+        if t is None:
+            return {"enabled": False, "source": "mirror", "digest": None}
+        return {
+            "enabled": True,
+            "source": "mirror",
+            "digest": t.payload(),
+            "pending": sum(len(m) for m in self._audit_pending.values()),
+            "checks": self.audit_checks,
+            "divergences": self.audit_divergences,
+            "last": self.last_audit,
+        }
 
     def _vec(self, res, out_row: np.ndarray) -> bool:
         """Write a Resource into a row; False if it has an unknown scalar
@@ -962,8 +1103,11 @@ class ArrayMirror:
 
     #: checkpoint format version; bump on any row-table layout change
     _CKPT_VERSION = 2  # r6: p_has_vol column + vol_pod_objs map
-    #: attributes that must not serialize (live handles)
-    _CKPT_SKIP = ("store", "_watches")
+    #: attributes that must not serialize (live handles) — the audit
+    #: table rides along implicitly: restore rebuilds it from the store
+    #: in _reconcile_store, so a stale checkpointed digest can never
+    #: mask post-checkpoint drift
+    _CKPT_SKIP = ("store", "_watches", "_audit", "_audit_pending")
 
     def save_checkpoint(self, path: str) -> None:
         """Persist the full mirror state (row tables, interning maps,
@@ -1103,6 +1247,7 @@ class ArrayMirror:
                 self._on_pod(pod)
         for key in [k for k in self.pods.key_row if k not in seen_p]:
             self._drop_pod_row(key)
+        self._audit_rebuild()
 
     # -- eligibility ----------------------------------------------------------
 

@@ -383,6 +383,26 @@ def register_prof_anomaly(kind: str) -> None:
     inc("volcano_prof_anomalies_total", kind=kind)
 
 
+# -- vtaudit state-digest series (volcano_tpu/vtaudit.py) ---------------------
+
+def register_audit_check(n: int = 1) -> None:
+    """Digest verification passes the mirror completed against a store
+    checkpoint (beacon or lock-synchronous compare)."""
+    inc("volcano_audit_digest_checks_total", float(n))
+
+
+def register_audit_divergence(n: int = 1) -> None:
+    """Digest mismatches — in steady state this series must stay at
+    ZERO; any advance is the steady-state-divergence anomaly."""
+    inc("volcano_audit_divergence_total", float(n))
+
+
+def observe_beacon_lag(seconds: float) -> None:
+    """Age of the beacon a verification pass consumed (beacon wall-clock
+    stamp to verify time) — how stale the audited checkpoint was."""
+    observe("volcano_audit_beacon_lag_seconds", seconds)
+
+
 # -- store WAL durability series (volcano_tpu/store/wal.py) -------------------
 
 def register_wal_append(n: int = 1) -> None:
@@ -446,6 +466,12 @@ _HELP: Dict[str, str] = {
         "Schedule attempts by result",
     "volcano_residue_tasks_total":
         "Tasks routed to the host residue path, by reason class",
+    "volcano_audit_digest_checks_total":
+        "Mirror-vs-store digest verification passes completed",
+    "volcano_audit_divergence_total":
+        "State digest mismatches detected (steady state: zero)",
+    "volcano_audit_beacon_lag_seconds":
+        "Age of the digest beacon consumed by a verification pass",
     "volcano_store_wal_appended_records_total":
         "Records appended to the store write-ahead log",
     "volcano_store_wal_fsync_total":

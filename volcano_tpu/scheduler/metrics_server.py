@@ -13,7 +13,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from volcano_tpu import timeseries, trace, vtprof
+from volcano_tpu import timeseries, trace, vtaudit, vtprof
 from volcano_tpu.scheduler import metrics
 
 
@@ -39,6 +39,12 @@ class _Handler(BaseHTTPRequestHandler):
             # the vtprof critical-path profile (volcano_tpu/vtprof.py)
             # — what `vtctl profile` renders
             body = json.dumps(vtprof.debug_payload()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path == "/debug/digest":
+            # the mirror's state-digest view (volcano_tpu/vtaudit.py)
+            # — what `vtctl audit` compares against the store's
+            body = json.dumps(vtaudit.debug_payload()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif self.path == "/healthz":

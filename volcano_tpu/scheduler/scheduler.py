@@ -95,6 +95,9 @@ class Scheduler:
         # time-series recorder samples
         self._cycle_n = 0
         self._bind_log_n = 0
+        # off-cycle digest verify throttle (vtaudit): the checkpoint
+        # marker (beacon seq / store rv) the last verify consumed
+        self._audit_marker: object = None
         # cross-cycle incremental snapshot state (class masks, node-static
         # arrays, device uploads) — survives sessions, invalidated by node
         # epoch changes
@@ -596,6 +599,7 @@ class Scheduler:
                     )
                 if timeseries.RECORDER is not None:
                     self._record_cycle(start, "fast")
+                self._audit_tick()
                 return
         if self.fast_cycle is not None and self.cache.applier is not None:
             # whole-cycle object fallback: previous fast cycles' async
@@ -612,6 +616,50 @@ class Scheduler:
                 time.perf_counter() - start, {}, "object")
         if timeseries.RECORDER is not None:
             self._record_cycle(start, "object")
+
+    def _audit_tick(self) -> None:
+        """Off-cycle state-digest verify (vtaudit): after a fast cycle,
+        compare the mirror's watch-fed digest rollup against the store's
+        newest checkpoint — at most once per beacon seq (RemoteStore) or
+        store resource version (in-process), so a busy scheduler never
+        re-verifies an already-audited state.  A mismatch is the
+        steady-state-divergence anomaly, wired into metrics, the
+        time-series anomaly line, and (via the module debug source)
+        trace.crash_dump() exactly like vtprof's recompile sentinel."""
+        fc = self.fast_cycle
+        if fc is None:
+            return
+        mirror = fc.mirror
+        if getattr(mirror, "_audit", None) is None:
+            return
+        store = mirror.store
+        if hasattr(store, "last_beacon"):
+            ref = store.last_beacon
+            marker = None if ref is None else ref.get("seq")
+        else:
+            marker = store.resource_version
+        if marker is None or marker == self._audit_marker:
+            return
+        res = mirror.audit_verify()
+        if res is None:
+            return  # not quiescent: the next cycle retries this marker
+        self._audit_marker = marker
+        metrics.register_audit_check()
+        ts = res.get("ts")
+        if ts is not None:
+            # wall-clock beacon age; cross-host epoch skew makes this a
+            # coarse staleness signal, not a precise latency
+            lag = max(0.0, time.time() - ts)
+            metrics.observe_beacon_lag(lag)
+        if not res["ok"]:
+            metrics.register_audit_divergence()
+            if timeseries.RECORDER is not None:
+                timeseries.record(
+                    "anomaly", anomaly="steady-state-divergence",
+                    kinds=",".join(res["kinds"]), seq=res.get("seq"),
+                    mode=res.get("mode"), cycle=self._cycle_n,
+                )
+            trace.crash_dump("steady-state-divergence")
 
     def _record_cycle(self, start: float, path: str) -> None:
         """One ``kind="cycle"`` time-series sample (armed-only; callers

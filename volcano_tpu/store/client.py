@@ -25,7 +25,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 from urllib.parse import quote
 
-from volcano_tpu import trace
+from volcano_tpu import trace, vtaudit
 from volcano_tpu.admission import AdmissionError
 from volcano_tpu.chaos import FaultPlan, env_plan
 from volcano_tpu.store.codec import decode_object, encode, encode_fields
@@ -100,6 +100,13 @@ class RemoteStore:
         #: partitioned-bus shard count advertised by /healthz, fetched
         #: lazily once (1 = unpartitioned, incl. pre-partition servers)
         self._segment_shards: Optional[int] = None
+        #: newest digest beacon seen on the watch stream (vtaudit): the
+        #: seq-pinned checkpoint payload a mirror verifies against
+        self.last_beacon: Optional[Dict[str, Any]] = None
+        #: True iff that beacon was the FINAL event of the last non-empty
+        #: poll batch — the quiescence signal a verifier needs (a beacon
+        #: mid-batch pins a digest the consumer has already moved past)
+        self.beacon_is_tail = False
 
     # -- http ----------------------------------------------------------------
 
@@ -440,15 +447,27 @@ class RemoteStore:
             self._cursor = body["next"]
             raise StaleWatch("watch cursor fell off the server log; relist")
         events = body.get("events") or []
-        for e in events:
+        for i, e in enumerate(events):
+            if e["kind"] == vtaudit.BEACON_KIND:
+                # digest beacon: a seq-pinned audit checkpoint, not an
+                # object event — intercept before decode_object (which
+                # has no class for it) and record whether it closed the
+                # batch (the verifier's quiescence gate)
+                self.last_beacon = e.get("digest")
+                self.beacon_is_tail = i == len(events) - 1
+                continue
             ev = Event(
                 kind=e["kind"],
                 type=EventType(e["type"]),
                 obj=decode_object(e["kind"], e["object"]),
                 old=decode_object(e["kind"], e["old"]) if e.get("old") else None,
+                # the wire encoding rides along so an audit consumer can
+                # fold it into its digest table without re-encoding
+                enc=e["object"],
             )
             for q in self._watches.get(e["kind"], []):
                 q.append(ev)
+            self.beacon_is_tail = False
         self._cursor = max(self._cursor, body.get("next", self._cursor))
         return len(events)
 

@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
-from volcano_tpu import timeseries, trace, vtprof
+from volcano_tpu import timeseries, trace, vtaudit, vtprof
 from volcano_tpu.chaos import ChaosPlanError, FaultPlan, env_plan, fire_crash
 from volcano_tpu.locksan import make_lock, make_rlock
 from volcano_tpu.store.codec import (
@@ -120,6 +120,14 @@ class StoreServer:
         #: rows) — the relist horizon is ``seq - _log_rows``
         self._log_rows = 0
         self.seq = 0
+        #: newest seq that touched each shard (untagged/cross-shard
+        #: entries advance every shard) — the /healthz skew surface
+        self._shard_seq = [0] * self.shards
+        # digest beacon cadence state (vtaudit): seq of the last stamped
+        # beacon and the monotonic stamp time.  Starting the clock at
+        # boot means a short-lived server never stamps one spontaneously.
+        self._beacon_seq = 0
+        self._beacon_mono = time.monotonic()
         # durability (the etcd analogue): objects + sequence persist to
         # ``state_path`` so a restarted server resumes with all CRDs; the
         # event log is NOT persisted — clients behind the restart relist,
@@ -296,12 +304,29 @@ class StoreServer:
                     # vtprof critical-path profile (vtctl profile):
                     # chaos-exempt like /debug/trace
                     return self._reply(200, vtprof.debug_payload())
+                if u.path == "/debug/digest":
+                    # vtaudit state digests (vtctl audit): chaos-exempt —
+                    # auditing a diverged store must work mid-storm
+                    return self._reply(200, server.digest_debug(q))
                 chaos_plan = server.chaos
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
                     return
                 if u.path == "/healthz":
                     payload = {"ok": True, "uid": server.store.uid,
                                "shards": server.shards}
+                    with server.lock:
+                        server._pump_log()
+                        dg = server.store.digest_payload(server.shards)
+                        if dg is not None:
+                            # per-shard digest/seq: shard skew at a glance
+                            payload["digest"] = {
+                                "root": dg["root"], "seq": server.seq,
+                                "shards": [
+                                    {"digest": d, "seq": s}
+                                    for d, s in zip(dg["shards"],
+                                                    server._shard_seq)
+                                ],
+                            }
                     if server.wal is not None:
                         # durability observability for operators/bench:
                         # record/fsync totals, cumulative fsync seconds,
@@ -802,6 +827,7 @@ class StoreServer:
                     for i in range(len(blk)):
                         pend[("Event", blk.key(i))] = (blk, i)
                     self._dirty_kinds.add("Event")
+            self._maybe_beacon()
             self._trim_log()
             if self.wal is not None:
                 # the WHOLE cycle is one WAL record — the wire op verbatim
@@ -831,7 +857,96 @@ class StoreServer:
                  "block": blk, "start": 0}
         if self.shards > 1 and shard is not None:
             entry["shard"] = int(shard) % self.shards
+            self._shard_seq[entry["shard"]] = self.seq
+        else:
+            # untagged (cross-shard) block: every shard's stream carries
+            # it, so every shard's newest-seq watermark advances
+            for s in range(self.shards):
+                self._shard_seq[s] = self.seq
         self.log.append(entry)
+
+    # -- digest beacons / audit surface (vtaudit) --------------------------
+
+    def _maybe_beacon(self) -> bool:
+        """Stamp a digest beacon if one is due (caller holds the server
+        lock).  Preconditions keep the beacon coherent with the log:
+        auditing armed, seq advanced since the last beacon, the cadence
+        interval elapsed, and every store watch queue already drained —
+        a beacon stamped ahead of unpumped events would pin a digest the
+        log cannot yet reproduce, a false divergence for every verifier."""
+        if self.store._digest is None:
+            return False
+        if self.seq == self._beacon_seq:
+            return False
+        if time.monotonic() - self._beacon_mono < vtaudit.beacon_interval_s():
+            return False
+        if any(self._queues.values()):
+            return False
+        return self.stamp_beacon()
+
+    def stamp_beacon(self) -> bool:
+        """Append a seq-pinned digest beacon entry to the event log
+        (caller holds the server lock; lock order server.lock -> _mu is
+        the contract, so reading the store digest here is safe).  The
+        beacon consumes one seq and one log row like any entry, so watch
+        cursors move past it normally.  It is deliberately NOT WAL'd:
+        after a crash the digest is re-derivable from recovered state,
+        and watch_since's ``since > seq`` relist check absorbs the seq
+        regression a lost beacon leaves behind."""
+        payload = self.store.digest_payload(self.shards)
+        if payload is None:
+            return False
+        self.seq += 1
+        self._log_rows += 1
+        self.log.append(vtaudit.beacon_entry(self.seq, payload, time.time()))
+        self._beacon_seq = self.seq
+        self._beacon_mono = time.monotonic()
+        self.cond.notify_all()
+        return True
+
+    def digest_debug(self, q: Dict[str, List[str]]) -> Dict[str, Any]:
+        """``/debug/digest`` payload (chaos-exempt).  Default: root +
+        per-shard rollups pinned to the server seq.  ``?detail=buckets``
+        (optionally ``&shard=i``): per-``(kind, namespace)`` bucket
+        digests — the localization walk's second rung.  ``?kind=K&
+        namespace=NS``: per-object digests for one bucket — the final
+        rung, naming the exact diverged objects.  ``recompute=1`` on any
+        tier serves a ground-truth re-encode of the RAW objects instead
+        of the incrementally maintained table — the auditor's reference
+        for localizing corruption that bypassed the mutation verbs (a
+        flipped byte in object state never updates the maintained
+        digest, so maintained-vs-recompute names the exact object)."""
+        with self.lock:
+            self._pump_log()
+            rec = (q.get("recompute") or [None])[0] not in (None, "", "0")
+            t = self.store.recompute_digest() if rec else None
+            kind = (q.get("kind") or [None])[0]
+            if kind is not None:
+                ns = (q.get("namespace") or [""])[0]
+                objs = (t.object_payload(kind, ns) if t is not None
+                        else self.store.digest_objects(kind, ns))
+                return {"seq": self.seq, "kind": kind, "namespace": ns,
+                        "recompute": rec, "objects": objs}
+            sh = (q.get("shard") or [None])[0]
+            if (q.get("detail") or [None])[0] == "buckets" or sh is not None:
+                shard = int(sh) if sh is not None else None
+                buckets = (
+                    t.bucket_payload(shard, self.shards) if t is not None
+                    else self.store.digest_buckets(shard, self.shards)
+                )
+                return {"seq": self.seq, "recompute": rec,
+                        "buckets": buckets}
+            payload = (t.payload(self.shards) if t is not None
+                       else self.store.digest_payload(self.shards))
+            out: Dict[str, Any] = {
+                "enabled": self.store._digest is not None,
+                "seq": self.seq,
+                "recompute": rec,
+                "shard_seq": list(self._shard_seq),
+            }
+            if payload is not None:
+                out.update(payload)
+            return out
 
     def _enc_of(self, kind: str, key: str) -> Optional[Dict[str, Any]]:
         """The object's current encoding, resolving the lazy columnar half
@@ -1468,13 +1583,15 @@ class StoreServer:
                         ev.obj.meta.key, self.shards
                     )
                 self.log.append(entry)
+                self._shard_seq[entry.get("shard", 0)] = self.seq
                 moved = True
+        beaconed = self._maybe_beacon()
         self._trim_log()
         # unconsumed hints (a no-op write that produced no event) must not
         # survive to describe some LATER mutation of the key
         if self._enc_hints:
             self._enc_hints.clear()
-        if moved:
+        if moved or beaconed:
             self.cond.notify_all()
 
     def watch_since(self, since: int, kinds, timeout: float,
@@ -1487,6 +1604,10 @@ class StoreServer:
         deadline = time.monotonic() + timeout
         strip = self.shards > 1
         with self.lock:
+            # a quiescent server still beacons on the poll path, so a
+            # watcher that drained a burst gets its seq-pinned checkpoint
+            # without waiting for the next mutation to pump the log
+            self._maybe_beacon()
             if since < self.seq - self._log_rows or since > self.seq:
                 # fell off the buffer — or the client's cursor is from
                 # before a server restart: tell it to relist
@@ -1512,7 +1633,11 @@ class StoreServer:
                         continue
                     blk = e.get("block")
                     if blk is None:
-                        if not kinds or e["kind"] in kinds:
+                        # digest beacons bypass the kind filter: every
+                        # watcher gets its verification checkpoints no
+                        # matter which kinds it subscribed to
+                        if (e["kind"] == vtaudit.BEACON_KIND
+                                or not kinds or e["kind"] in kinds):
                             evs.append(
                                 {k: v for k, v in e.items() if k != "shard"}
                                 if strip else e

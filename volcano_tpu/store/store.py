@@ -65,6 +65,10 @@ class Event:
     #: applied — lets the store server maintain its encoded-object cache
     #: by delta instead of re-encoding the full object per bind/patch
     fields: Any = None
+    #: remote transport only (RemoteStore.poll): the wire encoding of the
+    #: post-state, attached for free from the watch entry — the mirror's
+    #: digest auditor hashes it without re-encoding the decoded object
+    enc: Any = None
 
 
 class Store:
@@ -105,6 +109,13 @@ class Store:
         # dedupe against what already landed — resubmission is idempotent
         # (bind/evict rows are idempotent already via no-op suppression)
         self._applied_segments: OrderedDict = OrderedDict()
+        # incremental state digest (volcano_tpu/vtaudit.py): per-object
+        # 64-bit digests + (kind, namespace) bucket sums, maintained by
+        # every mutating verb below under _mu — the store half of the
+        # mirror/WAL divergence auditor.  None when auditing is disarmed.
+        from volcano_tpu import vtaudit
+
+        self._digest = vtaudit.DigestTable() if vtaudit.enabled() else None
         # mutation lock: the async applier writes from its own thread while
         # the owning thread reads/writes (StoreServer adds its own RLock on
         # top for multi-client HTTP, which nests fine: server.lock is
@@ -130,6 +141,18 @@ class Store:
         self.__dict__.setdefault("_lazy_patch", defaultdict(dict))
         self.__dict__.setdefault("_lazy_create", defaultdict(dict))
         self.__dict__.setdefault("_applied_segments", OrderedDict())
+        from volcano_tpu import vtaudit
+
+        if not vtaudit.enabled():
+            self.__dict__["_digest"] = None
+        elif self.__dict__.get("_digest") is None:
+            # state pickled before the auditor (or by a disarmed life):
+            # rebuild the digest from the objects themselves
+            self.__dict__["_digest"] = vtaudit.table_from_objects(
+                (kind, obj)
+                for kind, bucket in self._objects.items()
+                for obj in bucket.values()
+            )
         self._mu = make_rlock("Store._mu")
 
     def _watched(self, kind: str) -> bool:
@@ -204,6 +227,9 @@ class Store:
 
                 obj.meta.creation_timestamp = time.time()
             self._objects[kind][key] = obj
+            dg = self._digest
+            if dg is not None:
+                dg.set_obj(kind, key, obj)
             self._notify(Event(kind, EventType.ADDED, obj))
             return obj
 
@@ -222,6 +248,9 @@ class Store:
             self._rv += 1
             obj.meta.resource_version = self._rv
             self._objects[kind][key] = obj
+            dg = self._digest
+            if dg is not None:
+                dg.set_obj(kind, key, obj)
             self._notify(Event(kind, EventType.UPDATED, obj, old))
             return obj
 
@@ -296,11 +325,18 @@ class Store:
                 return obj  # no-op: quiescence contract (see update())
             from volcano_tpu.api.fastclone import deep_clone
 
+            dg = self._digest
+            trips = [] if dg is not None else None
             for k, v in fields.items():
                 parent, leaf = _walk(obj, k)
+                if trips is not None:
+                    # pre-setattr value: the digest delta's old leaf
+                    trips.append((k, getattr(parent, leaf), v))
                 setattr(parent, leaf, v)
             self._rv += 1
             obj.meta.resource_version = self._rv
+            if trips is not None:
+                dg.apply_fields(kind, key, trips, obj=obj)
             # copy-on-write shadow: path hops are shallow-copied, so
             # unpatched fields/siblings share the old shadow's
             # (immutable-by-contract) values; the queued Event keeps the
@@ -470,6 +506,7 @@ class Store:
         pend = self._lazy_patch["Pod"]
         errs: List[List[Any]] = []
         changed: List[int] = []
+        old_vals: List[Any] = []  # pending-aware pre-values, parallel to changed
         ev_rows: List[int] = []
         for i, key in enumerate(keys):
             obj = pods.get(key)
@@ -484,11 +521,18 @@ class Store:
             if cur == (True if values is None else values[i]):
                 continue  # no-op write: Event only, no patch row
             changed.append(i)
+            old_vals.append(cur)
         rv0 = self._rv + 1
         self._rv += len(changed)
+        dg = self._digest
         for j, i in enumerate(changed):
             key = keys[i]
             value = True if values is None else values[i]
+            if dg is not None:
+                # staged rows digest NOW (one scalar-leaf delta each):
+                # _materialize later folds exactly these values, so
+                # materialization itself is digest-neutral
+                dg.apply_fields("Pod", key, ((field, old_vals[j], value),))
             p = pend.get(key)
             if p is None:
                 pend[key] = ({field: value}, rv0 + j)
@@ -603,6 +647,9 @@ class Store:
             self._materialize(kind, key)
             obj = self._objects[kind].pop(key, None)
             if obj is not None:
+                dg = self._digest
+                if dg is not None:
+                    dg.remove(kind, key)
                 self._notify(Event(kind, EventType.DELETED, obj))  # drops the shadow too
             return obj
 
@@ -624,6 +671,45 @@ class Store:
 
     def items(self, kind: str) -> Iterator[Any]:
         return iter(self.list(kind))
+
+    # -- state digest (vtaudit) ---------------------------------------------
+
+    def digest_payload(self, nshards: int = 1) -> Optional[Dict[str, Any]]:
+        """Maintained digest rollup (root/shards/kinds, hex) — the store
+        half of every /healthz, /debug/digest, and beacon surface.  None
+        when auditing is disarmed."""
+        with self._mu:
+            dg = self._digest
+            return None if dg is None else dg.payload(nshards)
+
+    def digest_buckets(self, shard: Optional[int] = None,
+                       nshards: int = 1) -> Dict[str, str]:
+        """Maintained per-(kind, namespace) bucket digests — the
+        localization walk's middle tier."""
+        with self._mu:
+            dg = self._digest
+            return {} if dg is None else dg.bucket_payload(shard, nshards)
+
+    def digest_objects(self, kind: str, namespace: str) -> Dict[str, str]:
+        """Maintained per-object digests of one bucket — the walk's
+        bottom tier."""
+        with self._mu:
+            dg = self._digest
+            return {} if dg is None else dg.object_payload(kind, namespace)
+
+    def recompute_digest(self):
+        """Ground-truth digest: a fresh walk over every (materialized)
+        object, independent of the incrementally maintained table — what
+        ``vtctl audit`` compares the maintained digests against."""
+        from volcano_tpu import vtaudit
+
+        with self._mu:
+            self.materialize_all()
+            return vtaudit.table_from_objects(
+                (kind, obj)
+                for kind, bucket in self._objects.items()
+                for obj in bucket.values()
+            )
 
     # -- watch --------------------------------------------------------------
 

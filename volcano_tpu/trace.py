@@ -471,6 +471,13 @@ def crash_dump(reason: str) -> Optional[str]:
         extra = dict(extra or {})
         extra["anomalies"] = vtprof.PROFILER.anomalies_snapshot()
         extra["profile"] = vtprof.PROFILER.summary()
+    from volcano_tpu import vtaudit
+
+    if vtaudit.has_debug_source():
+        # the mirror's digest view + last verify verdict ride along so a
+        # steady-state-divergence dump names the mismatched kinds
+        extra = dict(extra or {})
+        extra["audit"] = vtaudit.debug_payload()
     try:
         os.makedirs(directory, exist_ok=True)
         return tr.dump_to(path, reason, extra=extra)

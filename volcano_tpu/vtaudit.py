@@ -1,0 +1,459 @@
+"""vtaudit: incremental state-digest auditor for the store bus.
+
+The observability stack covers *time* (vtrace spans, vtload histograms,
+vtprof critical-path attribution) but nothing covered *state*: after the
+partitioned bus (PR 11) the truth lives in N per-shard WALs, a columnar
+server log, and a delta-fed ArrayMirror — and the only agreement proof
+was an offline byte-identity test harness.  This module is the live
+instrument: an **incremental, order-independent digest** of the whole
+object state, cheap enough to maintain on every mutation, comparable
+across processes, and localizable to the exact object on mismatch.
+
+Digest contract (the ANALYSIS.md "State digest" section is the
+normative copy):
+
+* Per object: ``D(kind, key, enc) = (M(kind,key) * sum(leaf_hash(path,
+  value))) mod 2^64`` over the flattened **canonical encoded form**
+  (``codec.encode``), where ``M`` is a per-identity odd multiplier and
+  ``leaf_hash`` mixes ``crc32(path + typed-scalar-repr)`` through a
+  splitmix64 finalizer.  Multilinearity is the point: a patch that
+  changes k leaves updates the digest with k cached hash lookups and one
+  multiply — never a re-flatten of the object.
+* Per ``(kind, namespace)`` bucket: sum of its objects' digests mod
+  2^64 — order-independent, so any two replicas that hold the same SET
+  of objects agree regardless of apply interleaving.
+* Rollups: namespace -> shard via ``partition.shard_of`` (the one hash
+  the whole bus routes by), shards -> root by the same modular sum.
+* ``meta.resource_version`` is excluded (``SKIP_LEAVES``): rv is
+  bus-assigned bookkeeping, restamped by WAL replay and recovery, and
+  excluding it is what lets recovery maintain the digest through the
+  ordinary verbs instead of a wholesale rebuild.
+* ``Event`` objects are excluded (``AUDITED_KINDS``): fire-and-forget,
+  shadowless, never mirrored — and hashing 100k lazy Event rows per
+  cycle would be the drain's new hot path.
+
+Collision math: each leaf contributes ~32 bits (crc32 input) spread over
+64 by the finalizer; a single corrupted leaf goes undetected with
+probability ~2^-32, independent per check.  This is an auditor, not an
+authenticator — it trades cryptographic strength for O(1) maintenance
+under the apply locks.
+
+Consumers: ``store/store.py`` maintains the authoritative table under
+``_mu``; ``store/server.py`` exposes it (/healthz, /debug/digest) and
+stamps **digest beacons** into the event stream; ``scheduler/fastpath/
+mirror.py`` maintains an independent table from its watch stream and
+verifies against beacons (remote) or the store table (in-process);
+``cli/vtctl.py audit`` walks shard -> bucket -> object on mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from volcano_tpu.store import codec
+from volcano_tpu.store.partition import shard_of
+
+_MASK = (1 << 64) - 1
+
+#: leaves excluded from every digest: bus-assigned bookkeeping that WAL
+#: replay and snapshot recovery legitimately restamp
+SKIP_LEAVES = frozenset({"meta.resource_version"})
+
+#: kinds the digest covers — everything in the codec registry except the
+#: fire-and-forget Event stream (shadowless, never mirrored, and the
+#: single hottest create path in a drain)
+AUDITED_KINDS = frozenset(k for k in codec.KIND_CLASSES if k != "Event")
+
+#: wire kind of a digest beacon entry in the server's event log — never
+#: a real object kind, delivered to every watcher regardless of filters
+BEACON_KIND = "__beacon__"
+
+#: markers for empty containers (a leaf must exist or {} and absent
+#: would hash alike); control prefix keeps them out of real string space
+_EMPTY_DICT = "\x01{}"
+_EMPTY_LIST = "\x01[]"
+
+_CACHE_CAP = 1 << 20
+
+
+def enabled() -> bool:
+    """Digest maintenance arming — ON by default, ``VOLCANO_TPU_AUDIT=0``
+    disarms (the bench's digest-off comparison arm).  Read at each
+    construction site, never cached at import."""
+    return os.environ.get("VOLCANO_TPU_AUDIT", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+# -- hash primitives ----------------------------------------------------------
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer: spreads crc32's 32 bits over all 64."""
+    x &= _MASK
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK
+    return x ^ (x >> 31)
+
+
+#: (path, scalar) -> leaf hash.  Paths and values repeat massively
+#: (every pod shares its field paths; node names and phases intern
+#: themselves here), so the hot-path cost is one dict hit.
+_leaf_cache: Dict[Tuple[str, Any], int] = {}
+#: (kind, key) -> odd multiplier
+_mult_cache: Dict[Tuple[str, str], int] = {}
+
+
+def leaf_hash(path: str, value: Any) -> int:
+    """Hash of one flattened scalar leaf.  The value repr is type-tagged
+    so ``1``/``1.0``/``"1"``/``True`` stay distinct across the JSON
+    round trip (json preserves int/float/str/bool identity)."""
+    ck = (path, value)
+    try:
+        h = _leaf_cache.get(ck)
+    except TypeError:  # unhashable scalar cannot occur in encoded forms
+        h = None
+        ck = None
+    if h is None:
+        if value is None:
+            tag = "z"
+        elif value is True:
+            tag = "b1"
+        elif value is False:
+            tag = "b0"
+        elif isinstance(value, str):
+            tag = "s" + value
+        else:
+            tag = "n" + repr(value)
+        h = _mix64(zlib.crc32(f"{path}\x00{tag}".encode()))
+        if ck is not None and len(_leaf_cache) < _CACHE_CAP:
+            _leaf_cache[ck] = h
+    return h
+
+
+def key_mult(kind: str, key: str) -> int:
+    """The per-identity odd multiplier — binds every leaf sum to WHICH
+    object it describes, so two objects with identical content still
+    produce distinct bucket contributions."""
+    m = _mult_cache.get((kind, key))
+    if m is None:
+        m = _mix64(zlib.crc32(f"{kind}\x00{key}".encode())
+                   + 0x9E3779B97F4A7C15) | 1
+        if len(_mult_cache) < _CACHE_CAP:
+            _mult_cache[(kind, key)] = m
+    return m
+
+
+def _flatten(enc: Any, path: str, out: List[Tuple[str, Any]]) -> None:
+    if isinstance(enc, dict):
+        if not enc:
+            out.append((path, _EMPTY_DICT))
+            return
+        for k in sorted(enc):
+            _flatten(enc[k], f"{path}.{k}" if path else str(k), out)
+    elif isinstance(enc, (list, tuple)):
+        if not enc:
+            out.append((path, _EMPTY_LIST))
+            return
+        for i, v in enumerate(enc):
+            _flatten(v, f"{path}.{i}", out)
+    else:
+        if path not in SKIP_LEAVES:
+            out.append((path, enc))
+
+
+def leaf_sum(enc: Any, path: str = "") -> int:
+    """Sum of leaf hashes of one encoded subtree rooted at ``path`` —
+    the building block of both absolute digests and patch deltas (a
+    scalar at ``path`` contributes exactly its absolute-flatten leaf)."""
+    out: List[Tuple[str, Any]] = []
+    _flatten(enc, path, out)
+    s = 0
+    for p, v in out:
+        s += leaf_hash(p, v)
+    return s & _MASK
+
+
+def obj_digest_enc(kind: str, key: str, enc: Any) -> int:
+    """Per-object digest from its canonical encoded form."""
+    return (key_mult(kind, key) * leaf_sum(enc)) & _MASK
+
+
+def obj_digest(kind: str, obj: Any) -> int:
+    """Per-object digest from a decoded object (encodes first — the
+    absolute path; deltas never come here)."""
+    return obj_digest_enc(kind, obj.meta.key, codec.encode(obj))
+
+
+def field_delta(path: str, old_value: Any, new_value: Any) -> int:
+    """Leaf-sum delta of one field changing ``old_value -> new_value``
+    (values are decoded; object-valued patches flatten their encoding).
+    Multiply by ``key_mult`` to get the digest delta."""
+    return (leaf_sum(codec.encode(new_value), path)
+            - leaf_sum(codec.encode(old_value), path)) & _MASK
+
+
+def ns_of_key(key: str) -> str:
+    return key.partition("/")[0]
+
+
+def hexd(d: int) -> str:
+    return "%016x" % (d & _MASK)
+
+
+# -- the digest table ---------------------------------------------------------
+
+
+class DigestTable:
+    """Incremental digest state: per-object digests plus per-``(kind,
+    namespace)`` bucket sums.  All mutators are O(changed leaves); the
+    caller provides the locking (Store under ``_mu``, mirror on its own
+    thread).  Plain dicts throughout — pickles with the store snapshot.
+    """
+
+    def __init__(self) -> None:
+        #: (kind, namespace) -> modular sum of object digests
+        self.buckets: Dict[Tuple[str, str], int] = {}
+        #: kind -> {key -> object digest}
+        self.objd: Dict[str, Dict[str, int]] = {}
+
+    # -- mutators (caller holds the apply lock) ---------------------------
+
+    def set_obj(self, kind: str, key: str, obj: Any) -> None:
+        """Absolute (re)digest of one object — create/update path."""
+        if kind not in AUDITED_KINDS:
+            return
+        self.set_enc(kind, key, codec.encode(obj))
+
+    def set_enc(self, kind: str, key: str, enc: Any) -> None:
+        if kind not in AUDITED_KINDS:
+            return
+        d = obj_digest_enc(kind, key, enc)
+        per = self.objd.setdefault(kind, {})
+        old = per.get(key, 0)
+        per[key] = d
+        b = (kind, ns_of_key(key))
+        self.buckets[b] = (self.buckets.get(b, 0) + d - old) & _MASK
+
+    def apply_fields(self, kind: str, key: str,
+                     trips: Iterable[Tuple[str, Any, Any]],
+                     obj: Any = None) -> None:
+        """Delta path: ``trips`` is ``(dotted_path, old_value,
+        new_value)`` per changed field — the COW patch and lazy-staging
+        hot paths.  Falls back to an absolute set when the object was
+        never digested (defensive; cannot happen through the verbs)."""
+        if kind not in AUDITED_KINDS:
+            return
+        per = self.objd.setdefault(kind, {})
+        old = per.get(key)
+        if old is None:
+            if obj is not None:
+                self.set_obj(kind, key, obj)
+            return
+        delta = 0
+        for path, ov, nv in trips:
+            delta += field_delta(path, ov, nv)
+        delta = (key_mult(kind, key) * (delta & _MASK)) & _MASK
+        per[key] = (old + delta) & _MASK
+        b = (kind, ns_of_key(key))
+        self.buckets[b] = (self.buckets.get(b, 0) + delta) & _MASK
+
+    def remove(self, kind: str, key: str) -> None:
+        if kind not in AUDITED_KINDS:
+            return
+        per = self.objd.get(kind)
+        d = per.pop(key, None) if per else None
+        if d is not None:
+            b = (kind, ns_of_key(key))
+            self.buckets[b] = (self.buckets.get(b, 0) - d) & _MASK
+
+    def clear(self) -> None:
+        self.buckets.clear()
+        self.objd.clear()
+
+    # -- rollups -----------------------------------------------------------
+
+    def shard_rollup(self, nshards: int) -> List[int]:
+        out = [0] * max(1, int(nshards))
+        for (_, ns), d in self.buckets.items():
+            s = shard_of(ns, len(out))
+            out[s] = (out[s] + d) & _MASK
+        return out
+
+    def kind_rollup(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for (kind, _), d in self.buckets.items():
+            out[kind] = (out.get(kind, 0) + d) & _MASK
+        # zero sums drop out: a fully-deleted kind must compare equal to
+        # a never-seen one (diff_* treat absent as zero)
+        return {k: d for k, d in out.items() if d}
+
+    def root(self) -> int:
+        s = 0
+        for d in self.buckets.values():
+            s += d
+        return s & _MASK
+
+    def payload(self, nshards: int = 1) -> Dict[str, Any]:
+        """The wire/debug shape every surface speaks: hex digests so the
+        values survive JSON without precision loss."""
+        return {
+            "root": hexd(self.root()),
+            "shards": [hexd(d) for d in self.shard_rollup(nshards)],
+            "kinds": {k: hexd(d) for k, d in sorted(self.kind_rollup()
+                                                    .items())},
+        }
+
+    def bucket_payload(self, shard: Optional[int] = None,
+                       nshards: int = 1) -> Dict[str, str]:
+        """Per-``(kind, namespace)`` buckets (``"kind|ns"`` keys),
+        optionally restricted to one shard — the localization walk's
+        middle tier."""
+        out: Dict[str, str] = {}
+        for (kind, ns), d in self.buckets.items():
+            if not d:
+                continue  # emptied bucket == never-seen bucket
+            if shard is not None and shard_of(ns, nshards) != shard:
+                continue
+            out[f"{kind}|{ns}"] = hexd(d)
+        return out
+
+    def object_payload(self, kind: str, namespace: str) -> Dict[str, str]:
+        """Per-object digests of one bucket — the walk's bottom tier."""
+        per = self.objd.get(kind) or {}
+        return {k: hexd(d) for k, d in per.items()
+                if ns_of_key(k) == namespace}
+
+
+def table_from_objects(items: Iterable[Tuple[str, Any]]) -> DigestTable:
+    """Full recompute from ``(kind, obj)`` pairs — recovery of old
+    snapshots, the mirror's list seed, and the audit walk's ground
+    truth."""
+    t = DigestTable()
+    for kind, obj in items:
+        if kind in AUDITED_KINDS:
+            t.set_obj(kind, obj.meta.key, obj)
+    return t
+
+
+# -- comparison / localization ------------------------------------------------
+
+
+def diff_maps(a: Dict[str, str], b: Dict[str, str]) -> List[str]:
+    """Keys whose hex digests differ (absent == zero state on either
+    side is NOT equal to a present non-zero digest)."""
+    zero = hexd(0)
+    keys = set(a) | set(b)
+    return sorted(k for k in keys
+                  if a.get(k, zero) != b.get(k, zero))
+
+
+def diff_kinds(a: Dict[str, str], b: Dict[str, str],
+               kinds: Iterable[str]) -> List[str]:
+    """Per-kind digest comparison restricted to ``kinds`` — replicas
+    that subscribe to a subset (the mirror's watch set) compare only
+    what they both claim to hold."""
+    zero = hexd(0)
+    return sorted(k for k in kinds
+                  if a.get(k, zero) != b.get(k, zero))
+
+
+# -- beacon -------------------------------------------------------------------
+
+
+def beacon_interval_s() -> float:
+    """Seconds between beacon stamps on a moving event log (env-tunable;
+    tests pin it low for prompt verification)."""
+    try:
+        return float(os.environ.get("VOLCANO_TPU_AUDIT_BEACON_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+def beacon_entry(seq: int, payload: Dict[str, Any],
+                 ts: float) -> Dict[str, Any]:
+    """One seq-pinned checkpoint record for the server's event log.
+    ``kind`` is the sentinel every watch filter passes through; the
+    digest payload describes the state EXACTLY at ``seq`` (the entry is
+    appended at the tail of its pump batch, under the server lock)."""
+    return {"seq": seq, "kind": BEACON_KIND, "type": "Beacon",
+            "digest": dict(payload, seq=seq, ts=round(ts, 6))}
+
+
+# -- debug payload registry (MetricsServer /debug/digest) ---------------------
+
+#: the armed process's digest source — a callable returning the
+#: /debug/digest JSON body (the scheduler registers its mirror's view,
+#: the same pattern as vtprof's PROFILER singleton)
+_DEBUG_SOURCE = None
+
+
+def set_debug_source(fn) -> None:
+    global _DEBUG_SOURCE
+    _DEBUG_SOURCE = fn
+
+
+def has_debug_source() -> bool:
+    return _DEBUG_SOURCE is not None
+
+
+def debug_payload() -> Dict[str, Any]:
+    src = _DEBUG_SOURCE
+    if src is None:
+        return {"enabled": enabled(), "digest": None}
+    try:
+        body = src()
+    except Exception as e:  # noqa: BLE001 — debug surface, never raises out
+        return {"enabled": enabled(), "error": repr(e)}
+    return body
+
+
+# -- WAL replay audit ---------------------------------------------------------
+
+
+def replay_wal_digest(state_path: str, shards: int = 0,
+                      ) -> Dict[str, Any]:
+    """Replay a snapshot + segment-WAL lineage into a digest, WITHOUT
+    touching the original files: recovery rotates segments, stamps
+    snapshots, and reaps covered files, so the lineage is copied into a
+    scratch directory and the real ``StoreServer`` recovery runs there
+    (never started — ``__init__`` does the whole replay).  Returns the
+    recovered digest payload plus replay forensics."""
+    import shutil
+    import tempfile
+
+    from volcano_tpu.store.partition import leftover_shard_dirs
+    from volcano_tpu.store.server import StoreServer
+
+    wal_dir = state_path + ".wal"
+    tmp = tempfile.mkdtemp(prefix="vtaudit-wal-")
+    try:
+        scratch_state = os.path.join(tmp, os.path.basename(state_path))
+        if os.path.exists(state_path):
+            shutil.copy2(state_path, scratch_state)
+        if os.path.isdir(wal_dir):
+            shutil.copytree(wal_dir, scratch_state + ".wal")
+        if shards <= 0:
+            shards = max(1, len(leftover_shard_dirs(scratch_state + ".wal")))
+        srv = StoreServer(port=0, state_path=scratch_state, wal=True,
+                          shards=shards)
+        try:
+            with srv.store._mu:
+                dg = srv.store._digest
+                payload = (dg.payload(shards) if dg is not None else None)
+            stats = srv.wal.stats() if srv.wal is not None else {}
+            return {
+                "digest": payload,
+                "seq": srv.seq,
+                "shards": shards,
+                "replayed_records": stats.get("replayed_records", 0),
+                "torn_tails": stats.get("torn_tails", 0),
+            }
+        finally:
+            if srv.wal is not None:
+                srv.wal.sync_close()
+            srv.httpd.server_close()  # free the (never-served) socket
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
