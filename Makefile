@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire bench-shard loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
+.PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -131,6 +131,19 @@ bench-shard:
 	$(PY) -m pytest tests/test_parallel.py -q \
 	  -k "shard_smoke or victim_step_mesh" -p no:cacheprovider
 	$(PY) bench.py --config 11
+
+# vtdelta (volcano_tpu/scheduler/delta/ + tests/test_delta.py, ROADMAP
+# item 2): event-driven incremental micro-cycles with admission control
+# and backlog shedding.  The tier-1 suite proves bit-for-bit
+# micro-vs-full parity (the snapshot-incremental oracle), jit-flat
+# steady state over >=50 micro-cycles, the Backlogged shed/readmit
+# lifecycle, and the chaos-storm/crash-kill gates composed with delta
+# mode on; cfg10 (`--config 12`) measures micro vs full pump latency on
+# a resident cluster plus the lockstep saturation search.
+# CPU containers: set VOLCANO_TPU_CFG10_SCALE (e.g. 0.1) to shrink.
+bench-delta:
+	$(PY) -m pytest tests/test_delta.py -q -p no:cacheprovider
+	$(PY) bench.py --config 12
 
 # container images (reference Makefile:40-48 / installer/dockerfile/):
 # `image` = CPU-jax control plane, `image-tpu` = jax[tpu]+libtpu wheel

@@ -1191,9 +1191,182 @@ def config9_shard(scale=None):
     })
 
 
+# -- cfg10: vtdelta steady-state trickle (scheduler/delta/) -------------------
+#
+# ROADMAP item 2's measurement: the event-driven incremental core under
+# the workload it exists for — a big RESIDENT cluster (cfg5-shaped:
+# running gangs pinned to nodes) receiving a steady trickle of small
+# gang arrivals with periodic batched departures.  Reports micro-cycle
+# vs full-cycle pump latency side by side (departure pumps are
+# structural `job-remove` fallbacks — the honest mix, not a micro-only
+# showcase), then the lockstep saturation search with delta mode on.
+# CPU containers: VOLCANO_TPU_CFG10_SCALE shrinks the resident set.
+
+#: resident gangs kept live during the trickle before a departure wave
+CFG10_POPULATION = 64
+#: gangs reaped per departure wave (one structural pump amortizes all)
+CFG10_WAVE = 8
+
+
+def _build_delta_store(n_nodes, n_tasks, tasks_per_job=20):
+    """cfg5-shaped resident cluster: RUNNING gangs pinned round-robin —
+    the steady state a trickle arrives on top of."""
+    from volcano_tpu.api import POD_GROUP_KEY, Resource
+    from volcano_tpu.api.objects import (
+        Metadata, Node, Pod, PodGroup, PodSpec, Queue,
+    )
+    from volcano_tpu.api.types import PodGroupPhase, PodPhase
+    from volcano_tpu.store import Store
+
+    store = Store()
+    store.create("Queue", Queue(meta=Metadata(name="default", namespace=""),
+                                weight=1))
+    for i in range(n_nodes):
+        store.create("Node", Node(
+            meta=Metadata(name=f"n{i:05d}", namespace=""),
+            allocatable=Resource(32000.0, 64.0 * (1 << 30),
+                                 max_task_num=200)))
+    n_jobs = max(n_tasks // tasks_per_job, 1)
+    for j in range(n_jobs):
+        pg = PodGroup(meta=Metadata(name=f"res{j:05d}", namespace="default"),
+                      min_member=tasks_per_job, queue="default")
+        pg.status.phase = PodGroupPhase.RUNNING
+        store.create("PodGroup", pg)
+        for t in range(tasks_per_job):
+            store.create("Pod", Pod(
+                meta=Metadata(
+                    name=f"res{j:05d}-{t}", namespace="default",
+                    annotations={POD_GROUP_KEY: f"res{j:05d}"}),
+                spec=PodSpec(resources=Resource(250.0, 256.0 * (1 << 20))),
+                phase=PodPhase.RUNNING,
+                node_name=f"n{(j * tasks_per_job + t) % n_nodes:05d}",
+            ))
+    return store
+
+
+def config10_delta(scale=None, trickle_cycles=200, duration_s=4.0,
+                   sat_base_qps=250.0, band_p99_ms=1000.0,
+                   max_doublings=3):
+    """cfg10: vtdelta micro-cycles vs full cycles on a resident cluster
+    plus the lockstep saturation search (`make bench-delta`)."""
+    import collections
+
+    import jax
+
+    from volcano_tpu.api import POD_GROUP_KEY, Resource
+    from volcano_tpu.api.objects import Metadata, Pod, PodGroup, PodSpec
+    from volcano_tpu.loadgen import LoadSpec, run_open_loop, saturation_search
+    from volcano_tpu.scheduler.conf import full_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+
+    if scale is None:
+        scale = float(os.environ.get("VOLCANO_TPU_CFG10_SCALE", "1.0"))
+    n_nodes = max(int(N_NODES * scale), 64)
+    n_tasks = max(int(N_TASKS * scale), 640)
+
+    def delta_conf():
+        conf = full_conf("tpu")
+        conf.delta = "on"  # oracle stays OFF: this is the timed path
+        return conf
+
+    store = _build_delta_store(n_nodes, n_tasks)
+    sched = Scheduler(store, conf=delta_conf())
+    fc = sched.fast_cycle
+
+    def submit(name, size=2):
+        pg = PodGroup(meta=Metadata(name=name, namespace="default"),
+                      min_member=size, queue="default")
+        store.create("PodGroup", pg)
+        for t in range(size):
+            store.create("Pod", Pod(
+                meta=Metadata(name=f"{name}-{t}", namespace="default",
+                              annotations={POD_GROUP_KEY: name}),
+                spec=PodSpec(resources=Resource(100.0, 64.0 * (1 << 20)))))
+
+    def reap(name):
+        for t in range(2):
+            store.delete("Pod", f"default/{name}-{t}")
+        store.delete("PodGroup", f"default/{name}")
+
+    # unmeasured warmup: arm + the trickle shape's solve compiles (the
+    # cfg8 rule — steady state measures the scheduler, not XLA)
+    sched.run_once()
+    for i in range(8):
+        submit(f"wm{i:03d}")
+        sched.run_once()
+
+    lat = {"micro": [], "full": []}
+    reasons = collections.Counter()
+    live = collections.deque(f"wm{i:03d}" for i in range(8))
+    for i in range(trickle_cycles):
+        submit(f"tk{i:04d}")
+        live.append(f"tk{i:04d}")
+        if len(live) > CFG10_POPULATION:
+            # one departure wave: CFG10_WAVE gangs leave before this
+            # pump — a single structural job-remove fallback amortizes
+            # the whole batch
+            for _ in range(CFG10_WAVE):
+                reap(live.popleft())
+        t0 = time.perf_counter()
+        sched.run_once()
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        mode = fc.delta.last["mode"]
+        lat[mode].append(dt_ms)
+        if mode == "full":
+            reasons[fc.delta.last["fallback_reason"]] += 1
+
+    def pct(xs, q):
+        if not xs:
+            return None
+        return round(float(np.percentile(np.asarray(xs), q)), 3)
+
+    # lockstep saturation with delta mode on: fresh clusters per step,
+    # virtual-time arrivals (wall-clock-independent QPS), same-process
+    # jit caches — the ROADMAP item 2 gate (>= 10x the cfg8 r08 breach)
+    def run_at(q, dur):
+        sat_store = _build_delta_store(max(n_nodes // 10, 16),
+                                       max(n_tasks // 10, 160))
+        sat_sched = Scheduler(sat_store, conf=delta_conf())
+        spec = LoadSpec(qps=q, duration_s=dur, seed=10,
+                        gang_sizes=((1, 6.0), (2, 3.0)),
+                        cpu_millis=(100,), mem_mb=(64,), namespace="sat")
+        return run_open_loop(sat_store, spec, sat_sched.run_once,
+                             tick_s=0.05, settle_s=60.0)
+
+    run_at(sat_base_qps, 1.0)  # warm the saturation shapes, unmeasured
+    sat = saturation_search(
+        lambda q: run_at(q, duration_s), base_qps=sat_base_qps,
+        band_p99_ms=band_p99_ms, max_doublings=max_doublings,
+    )
+
+    micro_p50 = pct(lat["micro"], 50)
+    _print_json({
+        "metric": "cfg10_delta_steady_state_micro_cycle",
+        "value": round((micro_p50 or 0.0) / 1e3, 5),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "n_nodes": n_nodes, "resident_tasks": n_tasks, "scale": scale,
+            "trickle_cycles": trickle_cycles,
+            "micro_cycles": len(lat["micro"]),
+            "full_cycles": len(lat["full"]),
+            "micro_p50_ms": micro_p50,
+            "micro_p99_ms": pct(lat["micro"], 99),
+            "full_p50_ms": pct(lat["full"], 50),
+            "full_p99_ms": pct(lat["full"], 99),
+            "full_reasons": dict(reasons),
+            "speedup_p50": (
+                round(pct(lat["full"], 50) / micro_p50, 2)
+                if micro_p50 and lat["full"] else None),
+            "saturation": sat.as_dict(),
+            "device": str(jax.devices()[0]),
+        },
+    })
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes,
-           10: config8_open_loop, 11: config9_shard}
+           10: config8_open_loop, 11: config9_shard, 12: config10_delta}
 
 
 # -- bench trajectory + continuous perf-regression gate (vtprof PR) -----------
@@ -1214,6 +1387,7 @@ GATED_METRICS = (
     "e2e_http_schedule_cycle_100k_tasks_10k_nodes",
     "cfg8_open_loop_first_seen_to_bind",
     "cfg9_mesh_sharded_1m_x_100k",
+    "cfg10_delta_steady_state_micro_cycle",
 )
 #: band slack over the best same-device trajectory reading: headline
 #: values breathe ±15% run-to-run on the tunnel (BASELINE.md), phases
@@ -1560,6 +1734,7 @@ CONFIG_METRIC = {
     8: "cfg8_open_loop_first_seen_to_bind",
     10: "cfg8_open_loop_first_seen_to_bind",
     11: "cfg9_mesh_sharded_1m_x_100k",
+    12: "cfg10_delta_steady_state_micro_cycle",
 }
 
 
@@ -1613,6 +1788,8 @@ def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
             8: lambda: config8_open_loop(duration_s=5.0, max_doublings=1),
             10: lambda: config8_open_loop(duration_s=5.0, max_doublings=1),
             11: config9_shard,
+            12: lambda: config10_delta(trickle_cycles=60, duration_s=2.0,
+                                       max_doublings=1),
         }
     for n in configs:
         fn = runners.get(n)

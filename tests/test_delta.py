@@ -1,0 +1,621 @@
+"""vtdelta (scheduler/delta/): event-driven incremental scheduling core.
+
+* snapshot-incremental parity: micro-built snapshots are bit-for-bit
+  equal to fresh full builds over randomized seeded event streams (the
+  oracle runs inside every micro cycle here);
+* delta-vs-full fuzz: lockstep schedulers over identical stores produce
+  identical bind logs with delta on vs off;
+* structural events (node add/remove, job remove, queue move, preempt/
+  reclaim waves) force full fallbacks with their trigger reason in the
+  cycle's timeseries row, and micro-cycles resume after;
+* jit flatness: >= 50 post-warmup micro-cycles with varying dirty sizes
+  advance the compile counter by exactly zero;
+* admission control: token-bucket holds, watermark shedding to the
+  ``Backlogged`` condition (never dropped), sticky re-shed, re-admit on
+  recovery;
+* metrics exposition, `vtctl top` delta panel, and the chaos-storm /
+  crash-kill SLO gates composed with delta mode on.
+"""
+
+import http.client
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from volcano_tpu import timeseries
+from volcano_tpu.api import Resource
+from volcano_tpu.api.objects import Metadata, Node, PriorityClass, Queue
+from volcano_tpu.api.types import PodPhase
+from volcano_tpu.backoff import Backoff
+from volcano_tpu.loadgen import LoadGen, LoadSpec, run_open_loop
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.conf import default_conf, full_conf
+from volcano_tpu.scheduler.scheduler import Scheduler
+from volcano_tpu.store import Store
+from volcano_tpu.store.client import RemoteStore, RemoteStoreError, wait_healthy
+from volcano_tpu.store.server import StoreServer
+
+from helpers import (
+    build_node,
+    build_pod,
+    build_podgroup,
+    build_queue,
+    make_store,
+)
+
+TRANSIENT = (RemoteStoreError, OSError, http.client.HTTPException)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    metrics.reset()
+    timeseries.disarm()
+    yield
+    timeseries.disarm()
+    metrics.reset()
+
+
+def _delta_conf(base="default", **kw):
+    conf = (default_conf if base == "default" else full_conf)("tpu")
+    conf.delta = "on"
+    conf.delta_oracle = True  # every micro cycle proves bit-equality
+    for k, v in kw.items():
+        setattr(conf, k, v)
+    return conf
+
+
+def _sched(store, conf):
+    # the default Binder writes placements back to the store, so tests
+    # can assert on pod.node_name AND on cache.bind_log
+    return Scheduler(store, conf=conf)
+
+
+def _mixed_store(seed, n_nodes=5, n_jobs=6, running_jobs=2):
+    import random
+
+    rng = random.Random(seed)
+    nodes = [build_node(f"n{i:02d}", cpu=str(rng.choice([4, 8])),
+                        memory=f"{rng.choice([8, 16])}Gi")
+             for i in range(n_nodes)]
+    queues = [build_queue("qa", weight=2), build_queue("qb", weight=1),
+              build_queue("default")]
+    podgroups, pods = [], []
+    for j in range(n_jobs):
+        n_tasks = rng.randint(1, 4)
+        pg = build_podgroup(f"job{j}", min_member=rng.randint(1, n_tasks),
+                            queue=rng.choice(["qa", "qb"]))
+        podgroups.append(pg)
+        running = j < running_jobs
+        for t in range(n_tasks):
+            pod = build_pod(f"job{j}-{t}", group=f"job{j}",
+                            cpu=rng.choice(["500m", "1"]),
+                            memory=f"{rng.choice([512, 1024])}Mi",
+                            priority=rng.choice([0, 5]))
+            if running:
+                pod.node_name = nodes[t % n_nodes].meta.name
+                pod.phase = PodPhase.RUNNING
+            pods.append(pod)
+    return make_store(nodes=nodes, queues=queues, podgroups=podgroups,
+                      pods=pods)
+
+
+def _fuzz_stream(store, sched, rng, steps):
+    """Randomized event stream: gang arrivals, pod deletions, node churn,
+    queue moves — pumping after each step.  The engine's oracle asserts
+    snapshot-incremental parity inside every micro cycle."""
+    created = []
+    for step in range(steps):
+        ev = rng.random()
+        if ev < 0.55 or not created:
+            name = f"fz{step:03d}"
+            store.create("PodGroup", build_podgroup(
+                name, min_member=1, queue=rng.choice(["qa", "qb"])))
+            for t in range(rng.randint(1, 3)):
+                store.create("Pod", build_pod(
+                    f"{name}-{t}", group=name, cpu=rng.choice(["100m", "250m"]),
+                    memory="128Mi", priority=rng.choice([0, 5])))
+            created.append(name)
+        elif ev < 0.75:
+            victim = created.pop(rng.randrange(len(created)))
+            for p in list(store.list("Pod")):
+                if p.meta.name.startswith(victim + "-"):
+                    store.delete("Pod", f"{p.meta.namespace}/{p.meta.name}")
+            store.delete("PodGroup", f"default/{victim}")
+        elif ev < 0.9:
+            store.create("Node", build_node(f"nx{step:03d}", cpu="4",
+                                            memory="8Gi"))
+        else:
+            # queue move: a structural job-requeue
+            victim = rng.choice(created)
+            pg = store.get("PodGroup", f"default/{victim}")
+            if pg is not None:
+                store.patch("PodGroup", f"default/{victim}",
+                            {"queue": "qb" if pg.queue == "qa" else "qa"})
+        sched.run_once()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_micro_cycle_snapshot_parity_fuzz(seed):
+    """The snapshot-incremental oracle over a randomized stream: every
+    micro cycle's snapshot is bit-for-bit a fresh full build's (the
+    engine raises from inside run_once otherwise), and micro cycles
+    actually dominate the steady stream."""
+    import random
+
+    store = _mixed_store(seed)
+    sched = _sched(store, _delta_conf())
+    sched.run_once()
+    _fuzz_stream(store, sched, random.Random(1000 + seed), steps=25)
+    micro = metrics.get_counter("volcano_delta_micro_cycles_total")
+    assert micro >= 10, f"only {micro} micro cycles in a 25-step stream"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_delta_binds_equal_full_cycle_replay(seed):
+    """Acceptance: micro-cycle placements bit-for-bit equal a full-cycle
+    replay — two schedulers, identical stores and event streams, delta
+    on vs off, identical bind logs."""
+    import random
+
+    logs = []
+    for delta_on in (True, False):
+        store = _mixed_store(seed)
+        conf = _delta_conf() if delta_on else default_conf("tpu")
+        sched = _sched(store, conf)
+        sched.run_once()
+        _fuzz_stream(store, sched, random.Random(2000 + seed), steps=20)
+        logs.append(list(sched.cache.bind_log))
+    assert logs[0] == logs[1]
+    assert len(logs[0]) > 5
+
+
+def test_structural_events_force_full_with_reason_then_micro_resumes():
+    store = _mixed_store(3)
+    sched = _sched(store, _delta_conf(base="full"))
+    fc_reason = lambda: sched.fast_cycle.delta.last["fallback_reason"]  # noqa: E731
+    fc_mode = lambda: sched.fast_cycle.delta.last["mode"]  # noqa: E731
+    sched.run_once()
+    assert (fc_mode(), fc_reason()) == ("full", "arm")
+    sched.run_once()
+    assert fc_mode() == "micro"
+
+    store.create("Node", build_node("late", cpu="8", memory="16Gi"))
+    sched.run_once()
+    assert (fc_mode(), fc_reason()) == ("full", "node-add")
+    sched.run_once()
+    assert fc_mode() == "micro"
+
+    store.delete("Node", "/late")
+    sched.run_once()
+    assert (fc_mode(), fc_reason()) == ("full", "node-remove")
+
+    pg5 = store.get("PodGroup", "default/job5")
+    store.patch("PodGroup", "default/job5",
+                {"queue": "qb" if pg5.queue == "qa" else "qa"})
+    sched.run_once()
+    assert (fc_mode(), fc_reason()) == ("full", "job-requeue")
+
+    for p in list(store.list("Pod")):
+        if p.meta.name.startswith("job5-"):
+            store.delete("Pod", f"{p.meta.namespace}/{p.meta.name}")
+    store.delete("PodGroup", "default/job5")
+    sched.run_once()
+    assert (fc_mode(), fc_reason()) == ("full", "job-remove")
+    sched.run_once()
+    assert fc_mode() == "micro"
+
+
+def test_dirty_storm_falls_back(monkeypatch):
+    from volcano_tpu.scheduler.delta import engine as engine_mod
+
+    store = _mixed_store(0, n_jobs=2, running_jobs=0)
+    sched = _sched(store, _delta_conf())
+    for _ in range(3):  # arm + drain the first cycle's own bind echoes
+        sched.run_once()
+    assert sched.fast_cycle.delta.last["mode"] == "micro"
+    monkeypatch.setattr(engine_mod, "DIRTY_STORM", 4)
+    # one wave dirtying more rows than the (shrunk) storm bound
+    for i in range(4):
+        store.create("PodGroup", build_podgroup(f"w{i}", min_member=1,
+                                                queue="qa"))
+        for t in range(2):
+            store.create("Pod", build_pod(f"w{i}-{t}", group=f"w{i}",
+                                          cpu="100m", memory="128Mi"))
+    sched.run_once()
+    assert sched.fast_cycle.delta.last["fallback_reason"] == "dirty-storm"
+    # the wave's own bind echoes can re-trip the (shrunk) bound once
+    # more; after they drain, micro-cycles resume
+    for _ in range(3):
+        sched.run_once()
+    assert sched.fast_cycle.delta.last["mode"] == "micro"
+
+
+def test_contention_wave_rebuilds_full_with_reason():
+    """A preempt wave arriving in steady micro state: the cycle rebuilds
+    on the full path (reason ``contention``) before victim pools are
+    carved, and the micro-vs-full binds stay equal by replay."""
+    store = make_store(
+        nodes=[build_node(f"n{i}", cpu="4", memory="8Gi") for i in range(4)],
+        queues=[build_queue("qa", weight=1), build_queue("default")],
+        podgroups=[], pods=[])
+    store.create("PriorityClass", PriorityClass(
+        meta=Metadata(name="urgent", namespace=""), value=10))
+    store.create("PriorityClass", PriorityClass(
+        meta=Metadata(name="low", namespace=""), value=1))
+    # the cluster is full of RUNNING low-priority residents (victims
+    # must be running — a bound-not-started pod is not preemptible)
+    for i in range(8):
+        pg = build_podgroup(f"low{i}", min_member=1, queue="qa")
+        pg.priority_class_name = "low"
+        store.create("PodGroup", pg)
+        store.create("Pod", build_pod(
+            f"low{i}-0", group=f"low{i}", cpu="2", memory="2Gi", priority=1,
+            node_name=f"n{i % 4}", phase=PodPhase.RUNNING))
+    sched = _sched(store, _delta_conf(base="full"))
+    for _ in range(3):
+        sched.run_once()
+    assert sched.fast_cycle.delta.last["mode"] == "micro"
+    # the starving high-priority gang: preempt work on a dirty-only pump
+    hi = build_podgroup("hi", min_member=2, queue="qa")
+    hi.priority_class_name = "urgent"
+    store.create("PodGroup", hi)
+    for t in range(2):
+        store.create("Pod", build_pod(f"hi-{t}", group="hi", cpu="2",
+                                      memory="2Gi", priority=10))
+    sched.run_once()
+    assert metrics.get_counter("volcano_delta_full_fallbacks_total",
+                               reason="contention") >= 1
+    evicted = [k for k, _ in sched.cache.evict_log]
+    assert evicted, "the wave must actually preempt"
+    for key in evicted:  # play kubelet: reap the evicted victims
+        store.delete("Pod", key)
+    for _ in range(3):
+        sched.run_once()
+    # the wave resolved: the urgent gang is placed
+    hi_pods = [p for p in store.list("Pod") if p.meta.name.startswith("hi-")]
+    assert hi_pods and all(p.node_name for p in hi_pods)
+
+
+def _trickle_store(n_nodes=10):
+    store = Store()
+    store.create("Queue", Queue(
+        meta=Metadata(name="default", namespace=""), weight=1))
+    for i in range(n_nodes):
+        store.create("Node", Node(
+            meta=Metadata(name=f"n{i}", namespace=""),
+            allocatable=Resource(8000.0, 16.0 * (1 << 30),
+                                 max_task_num=200)))
+    return store
+
+
+def _submit_gang(store, name, n, cpu=10.0):
+    store.create("PodGroup", build_podgroup(name, min_member=n,
+                                            queue="default"))
+    for t in range(n):
+        p = build_pod(f"{name}-{t}", group=name, cpu=f"{int(cpu)}m",
+                      memory="16Mi")
+        store.create("Pod", p)
+
+
+def test_jit_cache_flat_across_50_post_warmup_micro_cycles():
+    """Acceptance: >= 50 post-warmup micro-cycles with dirty sizes
+    varying 1-3 gangs x 1-5 tasks (inside one task bucket) advance the
+    jit compile counter by exactly ZERO — shape-bucketing discipline
+    holds under delta mode, admission filter included."""
+    from volcano_tpu import vtprof
+
+    prof = vtprof.arm()
+    try:
+        store = _trickle_store()
+        sched = _sched(store, _delta_conf(delta_admit_qps=1e9))
+        # 70 initial gangs pin the J bucket at 128: the 50-gang trickle
+        # below (70 + 2 + 50 = 122 live jobs) never re-buckets it
+        for i in range(70):
+            _submit_gang(store, f"w{i:03d}", 1)
+        sched.run_once()
+        for i in range(2):  # warm the trickle shape itself
+            _submit_gang(store, f"t{i:03d}", 1)
+            sched.run_once()
+        prof.warmup_handshake()
+        sched.run_once()
+        assert prof.steady
+        total_before = prof.compiles_total
+        micro_before = metrics.get_counter("volcano_delta_micro_cycles_total")
+        for i in range(50):
+            # dirty sizes vary 1-5 tasks — all inside the minimum task
+            # bucket, so the solve shapes stay pinned
+            _submit_gang(store, f"k{i:03d}", 1 + (i % 5), cpu=10.0)
+            sched.run_once()
+        micro = metrics.get_counter(
+            "volcano_delta_micro_cycles_total") - micro_before
+        assert micro >= 50, f"only {micro} micro cycles in the trickle"
+        assert prof.compiles_total == total_before, (
+            "micro-cycle trickle recompiled", prof._cache_seen)
+        assert prof.anomalies_snapshot() == []
+        assert all(p.node_name for p in store.list("Pod"))
+    finally:
+        vtprof.disarm()
+
+
+# -- admission control + shedding ---------------------------------------------
+
+
+def _starved_store():
+    """One tiny node nothing fits on: every gang backlogs."""
+    return make_store(
+        nodes=[build_node("n0", cpu="1", memory="1Gi")],
+        queues=[build_queue("default")], podgroups=[], pods=[])
+
+
+def _submit_backlog(store, n, cpu="4", prio=None):
+    for i in range(n):
+        store.create("PodGroup", build_podgroup(f"g{i}", min_member=1,
+                                                queue="default"))
+        store.create("Pod", build_pod(
+            f"g{i}-0", group=f"g{i}", cpu=cpu, memory="4Gi",
+            priority=(prio(i) if prio else 0)))
+
+
+def test_token_bucket_admission_holds_then_drains():
+    """rate=2 gangs/s with an injectable clock: the first pump admits
+    the burst, holds the rest (filtered from solve, still INQUEUE); as
+    virtual time advances, held gangs drain through the gate — one
+    batched micro-cycle per pump, tokens charged once per gang."""
+    clock = [0.0]
+    store = make_store(
+        nodes=[build_node("n0", cpu="16", memory="32Gi")],
+        queues=[build_queue("default")], podgroups=[], pods=[])
+    conf = _delta_conf(delta_admit_qps=2.0, delta_burst=2)
+    sched = _sched(store, conf)
+    sched.run_once()
+    fc = sched.fast_cycle
+    fc.delta.admission.bucket._now = lambda: clock[0]
+    fc.delta.admission.bucket._last = 0.0
+    _submit_backlog(store, 6, cpu="100m")
+    sched.run_once()
+    assert fc.delta.last["backlog_gangs"] == 6
+    assert fc.delta.last["held_gangs"] == 4  # burst=2 admitted
+    bound = lambda: sum(1 for p in store.list("Pod") if p.node_name)  # noqa: E731
+    assert bound() == 2
+    # no time passes -> nothing new admitted, held set stable
+    sched.run_once()
+    assert fc.delta.last["held_gangs"] == 4
+    assert bound() == 2
+    clock[0] = 1.0  # +2 tokens
+    sched.run_once()
+    assert fc.delta.last["held_gangs"] == 2
+    assert bound() == 4
+    clock[0] = 2.0
+    sched.run_once()
+    assert fc.delta.last["held_gangs"] == 0
+    assert bound() == 6
+    # placed gangs left the backlog; admission slots were released
+    sched.run_once()
+    assert fc.delta.last["backlog_gangs"] == 0
+
+
+def test_shed_to_backlogged_condition_and_readmit():
+    """Above the high watermark the lowest-priority over-quota gangs get
+    the ``Backlogged`` condition — pods stay in the store (never
+    dropped) — and the condition clears once depth recovers below the
+    low watermark."""
+    store = _starved_store()
+    sched = _sched(store, _delta_conf(delta_high_watermark=4))
+    sched.run_once()
+    fc = sched.fast_cycle
+    # priorities ascending with i: g0..g3 are the lowest -> shed targets
+    _submit_backlog(store, 8, prio=lambda i: 8 - i)
+    sched.run_once()
+    assert fc.delta.last["backlog_gangs"] == 8
+    assert fc.delta.last["shed_gangs"] == 4
+    conds = {pg.meta.name: [c for c in pg.status.conditions]
+             for pg in store.list("PodGroup")}
+    shed = {n for n, cs in conds.items()
+            if any(c.kind == "Backlogged" for c in cs)}
+    assert shed == {"g4", "g5", "g6", "g7"}  # lowest priority (prio=8-i)
+    for c in sum(conds.values(), []):
+        if c.kind == "Backlogged":
+            assert c.reason == "AdmissionShed" and c.status == "True"
+    # never dropped: every pod still lives in the store
+    assert len(store.list("Pod")) == 8
+    assert metrics.get_counter("volcano_delta_shed_gangs_total") == 4
+    # sticky: another pump re-sheds the same gangs, counter flat
+    sched.run_once()
+    assert metrics.get_counter("volcano_delta_shed_gangs_total") == 4
+    # recovery: drain to depth 2 (<= low = high//2)
+    for i in range(6):
+        if f"g{i}" in shed:
+            continue
+        store.delete("Pod", f"default/g{i}-0")
+        store.delete("PodGroup", f"default/g{i}")
+    for n in sorted(shed)[:2]:
+        store.delete("Pod", f"default/{n}-0")
+        store.delete("PodGroup", f"default/{n}")
+    sched.run_once()
+    assert fc.delta.last["backlog_gangs"] == 2
+    assert fc.delta.last["shed_gangs"] == 0
+    for pg in store.list("PodGroup"):
+        assert not any(c.kind == "Backlogged" for c in pg.status.conditions)
+
+
+def test_delta_metrics_exposition():
+    store = _mixed_store(2)
+    sched = _sched(store, _delta_conf(delta_high_watermark=1))
+    sched.run_once()
+    sched.run_once()
+    text = metrics.expose_text()
+    assert "volcano_delta_micro_cycles_total" in text
+    assert 'volcano_delta_full_fallbacks_total{reason="arm"}' in text
+    assert "# HELP volcano_delta_micro_cycles_total" in text
+
+
+def test_timeseries_rows_carry_mode_and_vtctl_renders_delta_panel():
+    from volcano_tpu.cli.vtctl import cmd_top
+
+    timeseries.arm()
+    store = _mixed_store(1)
+    sched = _sched(store, _delta_conf())
+    sched.run_once()
+    store.create("PodGroup", build_podgroup("late", min_member=1,
+                                            queue="qa"))
+    store.create("Pod", build_pod("late-0", group="late", cpu="100m",
+                                  memory="128Mi"))
+    sched.run_once()
+    rows = [s for s in timeseries.samples()
+            if s.get("kind") == "cycle"]
+    assert rows, "no cycle rows recorded"
+    assert rows[0]["mode"] == "full" and rows[0]["fallback_reason"] == "arm"
+    assert rows[-1]["mode"] == "micro"
+    assert "backlog_gangs" in rows[-1]
+    text = cmd_top(timeseries.samples())
+    assert "delta:" in text and "micro" in text and "fallbacks:" in text
+
+
+# -- the SLO gates composed with delta mode on --------------------------------
+
+
+def _delta_gate_run(plan, seed=7, delta=True):
+    """Lockstep open-loop over real HTTP with a delta-mode scheduler,
+    optionally under a seeded request-plane chaos storm (the ISSUE-9
+    gate recipe with conf.delta flipped on)."""
+    srv = StoreServer().start()
+    try:
+        assert wait_healthy(srv.url, timeout=10)
+        srv.store.create("Queue", Queue(
+            meta=Metadata(name="default", namespace=""), weight=1))
+        for i in range(6):
+            srv.store.create("Node", Node(
+                meta=Metadata(name=f"n{i}", namespace=""),
+                allocatable=Resource(8000.0, 16.0 * (1 << 30),
+                                     max_task_num=110)))
+        client = RemoteStore(srv.url)
+        conf = full_conf("tpu")
+        if delta:
+            conf.delta = "on"
+            conf.delta_oracle = True
+        sched = Scheduler(client, conf=conf)
+        if plan is not None:
+            data = json.dumps(plan).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                srv.url + "/chaos", data=data, method="POST"), timeout=10)
+        spec = LoadSpec(qps=40, duration_s=0.8, seed=seed,
+                        cpu_millis=(100,), mem_mb=(64,), namespace="slo")
+        gen = LoadGen(client, spec)
+        retry = Backoff(base=0.01, cap=0.2, seed=41)
+        import time as _time
+
+        deadline = _time.monotonic() + 120
+        vnow = 0.0
+        while not gen.done:
+            assert _time.monotonic() < deadline, "gate never converged"
+            for arr in gen.due(vnow):
+                while True:
+                    try:
+                        gen.submit(arr)
+                        break
+                    except TRANSIENT:
+                        retry.sleep()
+            while True:
+                try:
+                    sched.run_once()
+                    break
+                except TRANSIENT:
+                    retry.sleep()
+            try:
+                gen.observe()
+            except TRANSIENT:
+                retry.sleep()
+            vnow += 0.05
+        if plan is not None:
+            status = json.load(urllib.request.urlopen(
+                srv.url + "/chaos", timeout=10))
+            assert any(s["fires"] > 0 for s in status["stats"]), (
+                "the storm never actually fired")
+        return gen.placements(), gen
+    finally:
+        srv.stop()
+
+
+_DELTA_GATE_PLAN = {
+    "seed": 11,
+    "rules": [
+        {"point": "server.request", "action": "http_500",
+         "every": 5, "count": 25},
+        {"point": "server.request", "action": "cut_body",
+         "after": 7, "every": 9, "count": 8},
+    ],
+}
+
+
+def test_chaos_storm_slo_gate_with_delta_on():
+    """The chaos gate composed with delta mode: bounded tail, full
+    convergence, and placements bit-for-bit equal to both the fault-free
+    delta run and the fault-free full-cycle run."""
+    placed_chaos, gen_chaos = _delta_gate_run(_DELTA_GATE_PLAN)
+    placed_clean, gen_clean = _delta_gate_run(None)
+    placed_full, _ = _delta_gate_run(None, delta=False)
+    assert gen_chaos.submitted_pods == gen_clean.submitted_pods > 20
+    assert gen_chaos.bound_pods == gen_chaos.submitted_pods
+    assert placed_chaos == placed_clean == placed_full
+    p99 = gen_chaos.quantile_ms(0.99)
+    assert 0.0 < p99 < 5000.0, p99
+    assert metrics.get_counter("volcano_delta_micro_cycles_total") > 0
+
+
+def test_crash_kill_restart_rearms_delta_and_converges():
+    """Crash-kill composed with delta: the scheduler process dies every
+    few pumps (rebuilt from scratch — fresh mirror, fresh engine, full
+    relist) and the run still converges to exactly the placements of an
+    uninterrupted delta run."""
+    def run(kill_every):
+        store = _mixed_store(5, running_jobs=0)
+        sched = _sched(store, _delta_conf())
+        for step in range(12):
+            if kill_every and step and step % kill_every == 0:
+                # crash-kill: the replacement relists everything and
+                # re-arms the delta hook from scratch
+                sched = _sched(store, _delta_conf())
+            if step < 6:
+                store.create("PodGroup", build_podgroup(
+                    f"ck{step}", min_member=1, queue="qa"))
+                store.create("Pod", build_pod(
+                    f"ck{step}-0", group=f"ck{step}", cpu="100m",
+                    memory="128Mi"))
+            sched.run_once()
+        return sorted((f"{p.meta.namespace}/{p.meta.name}", p.node_name)
+                      for p in store.list("Pod"))
+
+    uninterrupted = run(kill_every=0)
+    crashed = run(kill_every=3)
+    assert crashed == uninterrupted
+    assert len(crashed) > 6
+    assert all(node for _, node in crashed)
+    # every restart re-armed the hook (structural "arm" fallback)
+    assert metrics.get_counter("volcano_delta_full_fallbacks_total",
+                               reason="arm") >= 4
+
+
+def test_lockstep_saturation_sustains_250_gangs_per_second():
+    """Acceptance: the lockstep harness sustains >= 250 gangs/s through
+    a delta-mode scheduler with bounded p99 (>= 10x the BENCH_r08 breach
+    of 25 gangs/s sustained / 100 breach on this CPU container)."""
+    store = Store()
+    store.create("Queue", Queue(
+        meta=Metadata(name="default", namespace=""), weight=1))
+    for i in range(8):
+        store.create("Node", Node(
+            meta=Metadata(name=f"n{i}", namespace=""),
+            allocatable=Resource(64000.0, 64.0 * (1 << 30),
+                                 max_task_num=500)))
+    sched = Scheduler(store, conf=_delta_conf(base="full"))
+    spec = LoadSpec(qps=250, duration_s=1.0, seed=3, cpu_millis=(100,),
+                    mem_mb=(64,), gang_sizes=((1, 6.0), (2, 3.0)),
+                    namespace="sat")
+    report = run_open_loop(store, spec, sched.run_once, tick_s=0.05,
+                           settle_s=60.0)
+    assert report.sustained, report.as_dict()
+    assert report.bound_pods == report.submitted_pods > 200
+    assert 0.0 < report.p99_ms < 2000.0, report.as_dict()
+    assert metrics.get_counter("volcano_delta_micro_cycles_total") > 0

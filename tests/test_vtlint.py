@@ -1461,3 +1461,65 @@ def test_digest_maintenance_real_store_is_clean():
         select=["digest-maintenance"],
     )
     assert findings == [], "\n".join(f.human() for f in findings)
+
+
+# --- delta-discipline --------------------------------------------------------
+
+
+def test_delta_discipline_fires_on_direct_snapshot_writes(tmp_path):
+    """Every poke class: subscript store, whole-attribute rebind, and an
+    augmented in-place update — all outside a patch_* function."""
+    findings = _lint(tmp_path, "scheduler/delta/rogue.py", """
+        def shed_tasks(snap, keep):
+            snap.task_req[:] = snap.task_req[keep]
+
+        def rebind(snapshot, uids):
+            snapshot.task_uids = uids
+
+        def bump(ref_snap):
+            ref_snap.job_ntasks[0] += 1
+    """, select=["delta-discipline"])
+    assert _rules_of(findings) == ["delta-discipline"] * 3
+    texts = "\n".join(f.message for f in findings)
+    assert "snap.task_req" in texts and "snapshot.task_uids" in texts
+    assert "patch_task_planes" in texts
+
+
+def test_delta_discipline_near_misses_stay_quiet(tmp_path):
+    # the sanctioned API's own body: exempt by the patch_* convention
+    assert _lint(tmp_path, "scheduler/delta/incr.py", """
+        def patch_task_planes(m, snap, aux, pe_rows, w):
+            snap.task_req[:] = 0
+            snap.task_uids = []
+    """, select=["delta-discipline"]) == []
+    # reads never fire
+    assert _lint(tmp_path, "scheduler/delta/engine.py", """
+        def depth(snap):
+            t = snap.task_valid.sum()
+            return int(t)
+    """, select=["delta-discipline"]) == []
+    # non-snapshot bindings with snapshot-ish attributes: out of scope
+    assert _lint(tmp_path, "scheduler/delta/agg.py", """
+        def fold(agg):
+            agg.task_req = 0
+    """, select=["delta-discipline"]) == []
+    # identical poke outside scheduler/delta/: other modules own their
+    # snapshots (the fast reclaim pass legitimately re-packs in place)
+    assert _lint(tmp_path, "scheduler/fastpath/cycle.py", """
+        def repack(snap, keep):
+            snap.task_req[:] = snap.task_req[keep]
+    """, select=["delta-discipline"]) == []
+
+
+def test_delta_discipline_real_package_is_clean():
+    """The live proof: the real delta package routes every snapshot
+    write through the patch API."""
+    import volcano_tpu
+
+    pkg = os.path.dirname(os.path.abspath(volcano_tpu.__file__))
+    findings = run_paths(
+        [os.path.join(pkg, "scheduler", "delta")],
+        root=os.path.dirname(pkg),
+        select=["delta-discipline"],
+    )
+    assert findings == [], "\n".join(f.human() for f in findings)

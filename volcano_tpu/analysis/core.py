@@ -90,6 +90,7 @@ def _load_rule_modules() -> None:
     from volcano_tpu.analysis import (  # noqa: F401  (import = registration)
         rules_audit,
         rules_concurrency,
+        rules_delta,
         rules_device,
         rules_epsilon,
         rules_excepts,

@@ -1,0 +1,103 @@
+"""delta-discipline: snapshot columns are patched, never poked.
+
+The vtdelta micro-cycle contract (scheduler/delta/, ANALYSIS.md) hangs
+on one invariant: a snapshot leaving the delta engine is bit-for-bit
+what a fresh full build would have produced on the same mirror state,
+modulo the admission filter — and the ONLY sanctioned way the delta
+modules rewrite snapshot columns is the ``patch_*`` API
+(``incremental.patch_task_planes``), which keeps the jit shape buckets
+pinned and the aux row maps coherent.  An ad-hoc ``snap.task_req[...] =
+...`` elsewhere in the package silently breaks the snapshot-incremental
+oracle's coverage (the oracle compares builds, not later mutations) and
+can re-bucket a plane shape mid-steady-state, tripping the vtprof
+recompile sentinel.
+
+The rule fences the package set (``scheduler/delta/``): any assignment
+— plain, augmented, or in-place subscript — whose target drills into an
+attribute of a snapshot-named binding (``snap``, ``snapshot``,
+``*_snap``, ``snap_*``) fires unless it happens inside a ``patch_*``
+function (the sanctioned API's own body).  Reads never fire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from volcano_tpu.analysis.core import FileContext, Finding, rule
+
+_SCOPED_FRAGMENT = "scheduler/delta/"
+
+
+def _snapshot_root(expr: ast.AST) -> Optional[str]:
+    """The snapshot-named binding an assignment target drills into, or
+    None.  Peels subscripts: ``snap.task_req[:5]`` -> attribute
+    ``task_req`` on name ``snap``."""
+    cur = expr
+    while isinstance(cur, ast.Subscript):
+        cur = cur.value
+    if not isinstance(cur, ast.Attribute):
+        return None
+    base = cur.value
+    if not isinstance(base, ast.Name):
+        return None
+    n = base.id
+    if (
+        n in ("snap", "snapshot")
+        or n.startswith("snap_")
+        or n.endswith("_snap")
+        or n.endswith("snapshot")
+    ):
+        return f"{n}.{cur.attr}"
+    return None
+
+
+def _enclosing_patch_fn(stack) -> bool:
+    return any(
+        isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and f.name.startswith("patch_")
+        for f in stack
+    )
+
+
+@rule(
+    "delta-discipline",
+    "snapshot-column write in a scheduler/delta/ module outside the "
+    "sanctioned patch API (`patch_*`, incremental.patch_task_planes) — "
+    "mutations after the build escape the snapshot-incremental parity "
+    "oracle and can re-bucket a jit plane shape mid-steady-state "
+    "(vtprof recompile sentinel); route the write through the patch "
+    "API, or name the invariant that makes it build-equivalent in a "
+    "suppression",
+)
+def check_delta_discipline(ctx: FileContext) -> Iterable[Finding]:
+    if _SCOPED_FRAGMENT not in ctx.relpath:
+        return
+
+    def walk(node: ast.AST, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from walk(child, stack + [child])
+                continue
+            if isinstance(child, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    child.targets if isinstance(child, ast.Assign)
+                    else [child.target]
+                )
+                for tgt in targets:
+                    root = _snapshot_root(tgt)
+                    if root is not None and not _enclosing_patch_fn(stack):
+                        yield ctx.finding(
+                            "delta-discipline",
+                            child,
+                            f"direct snapshot-column write `{root}` "
+                            "outside the sanctioned patch API — the "
+                            "snapshot-incremental oracle compares "
+                            "BUILDS, so a post-build poke silently "
+                            "escapes parity coverage; route it through "
+                            "`patch_task_planes` (or a `patch_*` "
+                            "helper beside it)",
+                        )
+            yield from walk(child, stack)
+
+    yield from walk(ctx.tree, [])

@@ -418,6 +418,26 @@ def cmd_top(samples, out: Optional[io.TextIOBase] = None, n: int = 12,
                 f"cycles: {len(durs)} sampled, dur p50 {p(0.5):.1f}ms "
                 f"p99 {p(0.99):.1f}ms max {durs[-1] * 1e3:.1f}ms\n"
             )
+        # vtdelta panel: rows carry mode/fallback_reason only while
+        # conf.delta is on — absent fields mean the panel stays silent
+        dmodes = [s.get("mode") for s in cycles if s.get("mode")]
+        if dmodes:
+            micro = sum(1 for v in dmodes if v == "micro")
+            reasons: dict = {}
+            for s in cycles:
+                r = s.get("fallback_reason")
+                if r:
+                    reasons[r] = reasons.get(r, 0) + 1
+            last = cycles[-1]
+            line = (f"delta: {micro}/{len(dmodes)} micro, "
+                    f"backlog={last.get('backlog_gangs', 0)} gangs "
+                    f"(held={last.get('held_gangs', 0)} "
+                    f"shed={last.get('shed_gangs', 0)})")
+            if reasons:
+                line += " fallbacks: " + " ".join(
+                    f"{k}x{v}" for k, v in sorted(reasons.items())
+                )
+            buf.write(line + "\n")
         if stores:
             s = stores[-1]
             line = (f"store: seq={s.get('log_seq')} "

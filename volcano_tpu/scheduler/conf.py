@@ -78,6 +78,28 @@ class SchedulerConf:
     # warm-restart analogue of resuming an informer cache
     # (WaitForCacheSync, reference cache.go:303-329).  None = full list.
     mirror_checkpoint: Optional[str] = None
+    # vtdelta (scheduler/delta/): "on" = event-driven micro-cycles —
+    # the fast path diffs watch-delta dirty sets into row-keyed
+    # aggregates instead of full O(P) snapshot sweeps, falling back to
+    # full builds on structural events.  "off" = every cycle full.
+    delta: str = "off"
+    # admission gate: gangs/s granted solve admission (token bucket;
+    # a gang pays once and stays admitted until it places or departs).
+    # 0 = unlimited.
+    delta_admit_qps: float = 0.0
+    # token-bucket burst depth; 0 = auto (max(1, admit qps))
+    delta_burst: int = 0
+    # backlog shedding: above this many distinct pending gangs, the
+    # lowest-priority over-quota gangs are shed to a Backlogged
+    # PodGroupCondition (never dropped) until depth recovers below the
+    # low watermark.  0 = shedding off.
+    delta_high_watermark: int = 0
+    # re-admit threshold; 0 = high watermark // 2
+    delta_low_watermark: int = 0
+    # snapshot-incremental parity oracle: every micro-cycle also runs a
+    # fresh full build and asserts bit-for-bit equality (tests/debug;
+    # env VOLCANO_TPU_DELTA_ORACLE=1 forces it on)
+    delta_oracle: bool = False
 
 
 def default_conf(backend: str = "host") -> SchedulerConf:
@@ -172,6 +194,23 @@ def load_conf(text: str) -> SchedulerConf:
         if mode not in ("auto", "off"):
             raise ValueError(f"fastPath must be 'auto' or 'off', got {mode!r}")
         conf.fast_path = mode
+    if "delta" in data:
+        raw = data["delta"]
+        # YAML 1.1 reads bare on/off as booleans
+        mode = ("on" if raw else "off") if isinstance(raw, bool) else str(raw)
+        if mode not in ("on", "off"):
+            raise ValueError(f"delta must be 'on' or 'off', got {mode!r}")
+        conf.delta = mode
+    if "deltaAdmitQps" in data:
+        conf.delta_admit_qps = float(data["deltaAdmitQps"])
+    if "deltaBurst" in data:
+        conf.delta_burst = int(data["deltaBurst"])
+    if "deltaHighWatermark" in data:
+        conf.delta_high_watermark = int(data["deltaHighWatermark"])
+    if "deltaLowWatermark" in data:
+        conf.delta_low_watermark = int(data["deltaLowWatermark"])
+    if "deltaOracle" in data:
+        conf.delta_oracle = bool(data["deltaOracle"])
     return conf
 
 

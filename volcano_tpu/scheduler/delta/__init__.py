@@ -1,0 +1,22 @@
+"""vtdelta: event-driven incremental scheduling core.
+
+Turns the fast path's "full snapshot -> full solve every cycle" into
+event-driven micro-cycles (ROADMAP item 2):
+
+* ``dirty``       — the mirror-side dirty-set hook (pod rows + structural
+                    event reasons) fed by ArrayMirror's ingest paths.
+* ``incremental`` — row-keyed aggregate accumulators maintained by
+                    shadow-diff from the dirty set, the sanctioned
+                    snapshot patch API, and the ``snapshot-incremental``
+                    parity oracle.
+* ``admission``   — token-bucket admission gate + backlog watermark
+                    shedding (``Backlogged`` condition, re-admit on
+                    recovery).
+* ``engine``      — the DeltaEngine driver: micro-cycle vs full-fallback
+                    decision, oracle arming, per-cycle stats.
+"""
+
+from volcano_tpu.scheduler.delta.dirty import DirtySet
+from volcano_tpu.scheduler.delta.engine import DeltaEngine
+
+__all__ = ["DirtySet", "DeltaEngine"]

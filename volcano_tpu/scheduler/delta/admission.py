@@ -1,0 +1,201 @@
+"""Token-bucket admission control + backlog watermark shedding.
+
+Sits between the snapshot build and the solve: of the gangs with
+pending-eligible tasks this cycle, only ADMITTED gangs reach the solver.
+
+* **Token bucket** (``delta_admit_qps`` gangs/s, ``delta_burst`` depth):
+  a gang is charged one token the first cycle it is admitted and then
+  stays admitted for free until it places or departs — so a steady
+  backlog doesn't re-pay for the same gangs every pump.  Non-admitted
+  gangs are HELD: filtered from the solve but otherwise untouched
+  (publish still reports them).  Above the high watermark, held arrivals
+  batch naturally into one micro-cycle per pump.
+* **Shedding** (``delta_high_watermark``): when backlog depth (distinct
+  pending gangs) exceeds the high watermark, the lowest-priority
+  over-quota non-shadow gangs are shed to a ``Backlogged``
+  PodGroupCondition — never dropped: the pods stay pending in the store
+  and the mirror, the gang is just excluded from solve until depth
+  falls back under the low watermark (default high//2), at which point
+  the condition is cleared and the gang re-enters admission.  Shedding
+  is sticky: already-shed gangs are preferred over shedding new ones.
+
+Decisions are pure functions of (mirror, aux, clock); the engine caches
+the last :class:`Decision` so a same-state full rebuild (contention
+fallback) can re-apply it without re-charging tokens or re-shipping
+condition ops.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class TokenBucket:
+    """Gang-admission token bucket; ``now_fn`` injectable for tests."""
+
+    def __init__(self, rate: float, burst: int = 0, now_fn=time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst > 0 else max(1.0, self.rate)
+        self._now = now_fn
+        self.tokens = self.burst
+        self._last = self._now()
+
+    def take(self, n: float = 1.0) -> bool:
+        now = self._now()
+        self.tokens = min(
+            self.burst, self.tokens + (now - self._last) * self.rate
+        )
+        self._last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+class Decision:
+    """One cycle's admission outcome, in snapshot job numbering."""
+
+    __slots__ = ("depth", "held_jobs", "shed_jobs", "newly_shed")
+
+    def __init__(self, depth: int, held_jobs: Set[int],
+                 shed_jobs: Set[int], newly_shed: int) -> None:
+        self.depth = depth
+        self.held_jobs = held_jobs
+        self.shed_jobs = shed_jobs
+        self.newly_shed = newly_shed
+
+    @property
+    def excluded(self) -> Set[int]:
+        return self.held_jobs | self.shed_jobs
+
+
+class AdmissionController:
+    """Persistent admission state keyed by PodGroup KEY (job rows are
+    reusable; keys are not)."""
+
+    def __init__(self, conf, store, now_fn=time.monotonic) -> None:
+        self.store = store
+        self.rate = float(getattr(conf, "delta_admit_qps", 0.0) or 0.0)
+        self.high = int(getattr(conf, "delta_high_watermark", 0) or 0)
+        low = int(getattr(conf, "delta_low_watermark", 0) or 0)
+        self.low = low if low > 0 else self.high // 2
+        self.bucket = (
+            TokenBucket(
+                self.rate, int(getattr(conf, "delta_burst", 0) or 0),
+                now_fn=now_fn,
+            )
+            if self.rate > 0 else None
+        )
+        #: keys holding a paid admission slot (charged once, kept until
+        #: the gang leaves the backlog)
+        self.admitted: Set[str] = set()
+        #: keys currently carrying the Backlogged condition
+        self.shed: Set[str] = set()
+
+    # -- decision --------------------------------------------------------
+
+    def decide(self, m, aux) -> Decision:
+        """Compute held/shed sets for this cycle's backlog and ship the
+        Backlogged / re-admit condition patches.  Mutates persistent
+        token + shed state; the engine must call this at most once per
+        pump (full-rebuild re-application uses the cached Decision)."""
+        pe_rows = aux["pe_rows"]
+        pod_j = aux["pod_j"]
+        job_rows = aux["job_rows"]
+        jidx = np.unique(pod_j[pe_rows]) if len(pe_rows) else np.zeros(
+            0, np.int64
+        )
+        jidx = jidx[jidx >= 0]
+        depth = int(jidx.size)
+
+        keys: Dict[int, str] = {}
+        prio: Dict[int, float] = {}
+        shadow: Dict[int, bool] = {}
+        for j in jidx.tolist():
+            jrow = int(job_rows[j])
+            keys[j] = m.jobs.row_key[jrow] or ""
+            prio[j] = float(m.j_prio[jrow])
+            shadow[j] = bool(m.j_shadow[jrow])
+        backlog_keys = set(keys.values())
+
+        # gangs that left the backlog (placed / departed) release their
+        # admission slot; shed keys are kept (condition clear happens on
+        # readmit, or the group is gone and the patch would miss anyway)
+        self.admitted &= backlog_keys
+        self.shed &= backlog_keys
+
+        # -- token-bucket admission (priority, then FIFO-ish job order) --
+        held_jobs: Set[int] = set()
+        if self.bucket is not None:
+            for j in sorted(jidx.tolist(), key=lambda j: (-prio[j], j)):
+                k = keys[j]
+                if k in self.admitted:
+                    continue
+                if self.bucket.take(1.0):
+                    self.admitted.add(k)
+                else:
+                    held_jobs.add(j)
+        else:
+            self.admitted |= backlog_keys
+
+        # -- watermark shedding ------------------------------------------
+        ops: List[dict] = []
+        shed_jobs: Set[int] = set()
+        newly_shed = 0
+        if self.high > 0 and depth > self.high:
+            need = depth - self.high
+            # lowest priority first; sticky: already-shed keys sort ahead
+            cands = sorted(
+                (j for j in jidx.tolist() if not shadow[j]),
+                key=lambda j: (keys[j] not in self.shed, prio[j], -j),
+            )
+            for j in cands[:need]:
+                shed_jobs.add(j)
+                k = keys[j]
+                self.admitted.discard(k)
+                if k not in self.shed:
+                    self.shed.add(k)
+                    newly_shed += 1
+                    ops.append(self._backlog_op(k, True))
+        elif self.shed and depth <= self.low:
+            # recovered: clear every Backlogged condition; the gangs
+            # re-enter admission on the next pump
+            for k in sorted(self.shed):
+                ops.append(self._backlog_op(k, False))
+            self.shed.clear()
+
+        # still-shed gangs from earlier pumps stay excluded even when no
+        # NEW shedding happened this cycle (sticky until readmit)
+        for j in jidx.tolist():
+            if keys[j] in self.shed:
+                shed_jobs.add(j)
+        held_jobs -= shed_jobs
+
+        if ops:
+            try:
+                self.store.bulk(ops)
+            except Exception as exc:  # pragma: no cover - store hiccup
+                log.warning("delta admission condition ship failed: %s", exc)
+
+        return Decision(depth, held_jobs, shed_jobs, newly_shed)
+
+    @staticmethod
+    def _backlog_op(key: str, shed: bool) -> dict:
+        from volcano_tpu.api.objects import PodGroupCondition
+
+        conds = (
+            [PodGroupCondition(kind="Backlogged", status="True",
+                               reason="AdmissionShed",
+                               message="shed above backlog high watermark")]
+            if shed else []
+        )
+        return {
+            "op": "patch", "kind": "PodGroup", "key": key,
+            "fields": {"status.conditions": conds},
+        }

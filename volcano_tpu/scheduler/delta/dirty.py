@@ -1,0 +1,48 @@
+"""Dirty-set tracking: the hook object ArrayMirror ingest paths feed.
+
+One DirtySet instance is installed as ``mirror.delta_hook`` by the
+DeltaEngine.  Ingest paths that change a pod's aggregate contribution
+(p_live / p_status / p_node / p_job / p_resreq / best-effort /
+dynamic-volume flags) call :meth:`pod`; events that invalidate row-keyed
+aggregation wholesale — resync, node add/remove, PodGroup delete or
+queue move — call :meth:`structural` with a reason string that becomes
+the full-fallback trigger recorded in the cycle's timeseries row.
+
+The discipline is deliberately minimal: the hook only RECORDS.  All
+interpretation (diff application, fallback decision) happens at build
+time in engine.py, so the hot ingest path pays one set-add per event.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+
+class DirtySet:
+    """Per-cycle dirty pod rows + pending structural event reasons."""
+
+    def __init__(self) -> None:
+        self.pods: Set[int] = set()
+        #: ordered, deduped structural reasons since the last full build;
+        #: non-empty forces the next build onto the full path
+        self.structural_reasons: List[str] = []
+
+    # -- hook surface (called from ArrayMirror ingest) -------------------
+
+    def pod(self, row: int) -> None:
+        self.pods.add(int(row))
+
+    def pods_many(self, rows) -> None:
+        """Vectorized variant for bulk mutation sites (publish binds)."""
+        self.pods.update(int(r) for r in rows)
+
+    def structural(self, reason: str) -> None:
+        if reason not in self.structural_reasons:
+            self.structural_reasons.append(reason)
+
+    # -- engine surface --------------------------------------------------
+
+    def clear(self) -> None:
+        """Full rebuild absorbed everything recorded so far."""
+        self.pods.clear()
+        self.structural_reasons.clear()

@@ -1,0 +1,197 @@
+"""DeltaEngine: the micro-cycle vs full-fallback driver.
+
+Owns the DirtySet installed as ``mirror.delta_hook``, the PodAggregates
+accumulators, and the AdmissionController.  Per pump:
+
+1. If any STRUCTURAL event fired since the last full build (resync,
+   node add/remove, PodGroup remove, queue move, arming) — or the dirty
+   set blew past :data:`DIRTY_STORM` — fall back to a full snapshot
+   build, rebuild the aggregates, and record the trigger reason.
+2. Otherwise shadow-diff the dirty rows into the aggregates.  Two
+   remaining hazards that row-diffing can't express cheaply force a
+   full build: a live non-shadow job whose queue link hasn't resolved
+   yet ("job-dropped": the full sweep drops its pods from node usage),
+   and pending dynamic/volume pods ("dynamic": the volume/dynamic
+   partition needs the full classifier).  "dynamic" keeps the freshly
+   diffed aggregates (they are still exact — no rebuild needed).
+3. Micro: ``build_fast_snapshot(..., agg=...)`` — aggregate gathers
+   replace the O(P) pod sweeps; every downstream consumer (solve,
+   contention, publish) sees bit-identical inputs, which the opt-in
+   ``snapshot-incremental`` oracle (``delta_oracle`` conf knob or
+   ``VOLCANO_TPU_DELTA_ORACLE=1``) asserts against a fresh full build.
+4. Admission + shedding run on BOTH modes (post-oracle); exclusions are
+   applied through the sanctioned ``patch_task_planes`` API with the
+   task bucket pinned, so the jit cache stays flat across micro-cycles.
+
+``rebuild_full`` is the contention escape hatch: when a micro-built
+cycle discovers reclaim/preempt work, the cycle driver rebuilds on the
+full path (victim pools need full snapshot context) and RE-APPLIES the
+cached admission decision — same mirror state, same job numbering — so
+no tokens are re-charged and no condition ops are re-shipped.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.delta.admission import AdmissionController, Decision
+from volcano_tpu.scheduler.delta.dirty import DirtySet
+from volcano_tpu.scheduler.delta.incremental import (
+    PodAggregates,
+    assert_snapshot_equal,
+    patch_task_planes,
+)
+from volcano_tpu.scheduler.fastpath.snapshot_build import build_fast_snapshot
+
+#: dirty rows beyond which diff application loses to one vectorized
+#: full sweep (the per-row Python loop vs O(P) numpy)
+DIRTY_STORM = 2048
+
+
+class DeltaEngine:
+    """Per-FastCycle delta state; one instance lives for the scheduler's
+    lifetime and re-arms across mirror resyncs/restores."""
+
+    def __init__(self, conf, store, now_fn=time.monotonic) -> None:
+        self.conf = conf
+        self.dirty = DirtySet()
+        self.agg: Optional[PodAggregates] = None
+        self.admission = AdmissionController(conf, store, now_fn=now_fn)
+        self._decision: Optional[Decision] = None
+        self._oracle = bool(
+            getattr(conf, "delta_oracle", False)
+            or os.environ.get("VOLCANO_TPU_DELTA_ORACLE")
+        )
+        #: last build's stats for timeseries rows / vtctl / bench
+        self.last = {
+            "mode": "full", "fallback_reason": "arm",
+            "backlog_gangs": 0, "held_gangs": 0, "shed_gangs": 0,
+        }
+
+    # -- hook installation ----------------------------------------------
+
+    def arm(self, m) -> None:
+        """Idempotent: installs the dirty hook on (re)created mirrors.
+        A fresh install means events were missed — structural."""
+        if m.delta_hook is not self.dirty:
+            m.delta_hook = self.dirty
+            self.dirty.structural("arm")
+
+    # -- the per-pump build ---------------------------------------------
+
+    def build(self, m, nodeaffinity_weight: float,
+              dyn_batch) -> Tuple[Optional[object], dict]:
+        R = m.p_resreq.shape[1]
+        if self.agg is None or self.agg.R != R:
+            self.agg = PodAggregates(R)
+            self.dirty.structural("init")
+
+        reason = None
+        if self.dirty.structural_reasons:
+            reason = self.dirty.structural_reasons[0]
+        elif len(self.dirty.pods) > DIRTY_STORM:
+            reason = "dirty-storm"
+        elif bool((m.j_live & ~m.j_shadow & (m.j_queue < 0)).any()):
+            # the full sweep silently drops pods of queue-less jobs from
+            # node usage; row-keyed aggregates can't see the job-side
+            # flip, so defer to the full path until the link resolves
+            reason = "job-dropped"
+
+        if reason is None:
+            self.agg.apply(m, self.dirty.pods)
+            self.dirty.pods.clear()
+            if self.agg.n_dynvol_pending > 0:
+                # aggregates stay exact — full build, no rebuild
+                snap, aux = build_fast_snapshot(
+                    m, nodeaffinity_weight, dyn_batch=dyn_batch
+                )
+                mode, reason = "full", "dynamic"
+            else:
+                snap, aux = build_fast_snapshot(
+                    m, nodeaffinity_weight, dyn_batch=dyn_batch,
+                    agg=self.agg,
+                )
+                mode = "micro"
+                if self._oracle and snap is not None:
+                    ref = build_fast_snapshot(
+                        m, nodeaffinity_weight, dyn_batch=dyn_batch
+                    )
+                    assert_snapshot_equal((snap, aux), ref)
+        else:
+            snap, aux = build_fast_snapshot(
+                m, nodeaffinity_weight, dyn_batch=dyn_batch
+            )
+            self.agg.rebuild(m)
+            self.dirty.clear()
+            mode = "full"
+
+        if mode == "micro":
+            metrics.register_delta_micro_cycle()
+        else:
+            metrics.register_delta_fallback(reason)
+
+        if snap is None:
+            self._decision = None
+            self.last = {
+                "mode": mode, "fallback_reason": reason or "",
+                "backlog_gangs": 0, "held_gangs": 0, "shed_gangs": 0,
+            }
+            return snap, aux
+
+        decision = self.admission.decide(m, aux)
+        self._decision = decision
+        if decision.newly_shed:
+            metrics.register_delta_shed(decision.newly_shed)
+        self._apply_decision(m, snap, aux, decision, nodeaffinity_weight)
+        self.last = {
+            "mode": mode, "fallback_reason": reason or "",
+            "backlog_gangs": decision.depth,
+            "held_gangs": len(decision.held_jobs),
+            "shed_gangs": len(decision.shed_jobs),
+        }
+        return snap, aux
+
+    # -- contention escape hatch ----------------------------------------
+
+    def rebuild_full(self, m, nodeaffinity_weight: float,
+                     dyn_batch) -> Tuple[Optional[object], dict]:
+        """Full rebuild on the SAME mirror state after a micro cycle
+        discovered reclaim/preempt work; re-applies the cached admission
+        decision (same state -> same job numbering) without charging
+        tokens.  The micro counter stays incremented — it counts micro
+        SNAPSHOT BUILDS; the timeseries row flips to mode=full."""
+        snap, aux = build_fast_snapshot(
+            m, nodeaffinity_weight, dyn_batch=dyn_batch
+        )
+        self.agg.rebuild(m)
+        self.dirty.clear()
+        metrics.register_delta_fallback("contention")
+        decision = self._decision
+        if snap is not None and decision is not None:
+            self._apply_decision(m, snap, aux, decision, nodeaffinity_weight)
+        self.last = dict(
+            self.last, mode="full", fallback_reason="contention",
+        )
+        return snap, aux
+
+    # -- shared decision application ------------------------------------
+
+    @staticmethod
+    def _apply_decision(m, snap, aux, decision: Decision,
+                        nodeaffinity_weight: float) -> None:
+        # publish must not clobber shed gangs' Backlogged condition with
+        # Unschedulable — carried per-cycle in aux
+        aux["delta_shed_jobs"] = set(decision.shed_jobs)
+        excluded = decision.excluded
+        if not excluded:
+            return
+        pe_rows = aux["pe_rows"]
+        keep = pe_rows[~np.isin(
+            aux["pod_j"][pe_rows], np.fromiter(excluded, np.int64)
+        )]
+        patch_task_planes(m, snap, aux, keep, nodeaffinity_weight)

@@ -1,0 +1,397 @@
+"""Row-keyed incremental aggregates + the sanctioned snapshot patch API.
+
+:class:`PodAggregates` maintains exactly the quantities
+``snapshot_build.build_fast_snapshot`` derives from its O(P) pod sweeps
+— node usage/releasing/task counts, job alloc/ready/running/pending
+counts, queue alloc/request — as float64/int64 accumulators keyed by
+MIRROR ROW (node row, job row, queue row), updated by shadow-diff from
+the dirty set instead of recomputed from scratch.
+
+Why this is exact, not approximate:
+
+* Accumulators are f64 sums of integer-valued f32 inputs (milli-CPU,
+  bytes, device counts), so every sum is exact and therefore
+  order-independent — adding and subtracting contributions in event
+  order lands on the same bits as one fresh sweep.  The full build path
+  accumulates in f64 too and both cast to f32 once, at gather time.
+* Every contribution is keyed by row and recorded in a shadow copy of
+  the pod's state at apply time; the diff discipline subtracts exactly
+  what was added regardless of what occupies the row later, so pod/job
+  row reuse needs no special casing.
+* Anything row-keying cannot express — resync, node add/remove (row
+  migration), PodGroup removal, queue moves — is a STRUCTURAL event:
+  the engine falls back to a full build and calls :meth:`rebuild`.
+
+The ``snapshot-incremental`` oracle (:func:`assert_snapshot_equal`)
+proves a micro-built snapshot bit-for-bit equals a fresh full build on
+the same mirror state; the randomized fuzz in tests/test_delta.py and
+the opt-in ``VOLCANO_TPU_DELTA_ORACLE`` runtime flag keep it honest.
+
+:func:`patch_task_planes` is the one sanctioned way to rewrite snapshot
+task columns after the build (admission filtering): vtlint's
+``delta-discipline`` rule flags any other snapshot-column store in this
+package.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Tuple
+
+import numpy as np
+
+from volcano_tpu.scheduler.fastpath.mirror import (
+    _BOUND,
+    _PENDING,
+    _RELEASING,
+    _RUNNING,
+    _SUCCEEDED,
+)
+
+#: statuses charging job/queue alloc (mirror._ALLOCATED_CODES)
+_ALLOC = (_BOUND, _RUNNING)
+#: statuses counting toward gang readiness (mirror._READY_CODES)
+_READY = (_BOUND, _RUNNING, _SUCCEEDED)
+
+
+def _grow(arr: np.ndarray, n: int) -> np.ndarray:
+    if n <= arr.shape[0]:
+        return arr
+    cap = max(64, arr.shape[0])
+    while cap < n:
+        cap *= 2
+    out = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+class PodAggregates:
+    """Incrementally-maintained pod-sweep aggregates, keyed by mirror row."""
+
+    def __init__(self, R: int) -> None:
+        self.R = R
+        # node-row accumulators
+        self.node_used = np.zeros((0, R), np.float64)
+        self.node_rel = np.zeros((0, R), np.float64)
+        self.node_tc = np.zeros((0,), np.int64)
+        # job-row accumulators
+        self.job_alloc = np.zeros((0, R), np.float64)
+        self.job_ready = np.zeros((0,), np.int64)
+        self.run_ct = np.zeros((0,), np.int64)
+        self.pend_any = np.zeros((0,), np.int64)
+        self.pend_nonbe = np.zeros((0,), np.int64)
+        # queue-row accumulators
+        self.q_alloc = np.zeros((0, R), np.float64)
+        self.q_request = np.zeros((0, R), np.float64)
+        #: live pending pods carrying dynamic predicates or volume claims
+        #: (job-linked) — non-zero forces the "dynamic" full fallback,
+        #: because the volume/dynamic partition needs the full classifier
+        self.n_dynvol_pending = 0
+        # shadow pod columns: the state each row's current contribution
+        # was computed from (s_qrow pins the queue ROW at apply time so a
+        # later row-reuse under a different queue still subtracts from
+        # the right bucket)
+        self.s_live = np.zeros((0,), bool)
+        self.s_status = np.zeros((0,), np.int8)
+        self.s_node = np.zeros((0,), np.int32)
+        self.s_job = np.zeros((0,), np.int32)
+        self.s_qrow = np.zeros((0,), np.int32)
+        self.s_req = np.zeros((0, R), np.float64)
+        self.s_be = np.zeros((0,), bool)
+        self.s_dynvol = np.zeros((0,), bool)
+
+    # -- growth ----------------------------------------------------------
+
+    def _grow_pod(self, n: int) -> None:
+        self.s_live = _grow(self.s_live, n)
+        self.s_status = _grow(self.s_status, n)
+        self.s_node = _grow(self.s_node, n)
+        self.s_job = _grow(self.s_job, n)
+        self.s_qrow = _grow(self.s_qrow, n)
+        self.s_req = _grow(self.s_req, n)
+        self.s_be = _grow(self.s_be, n)
+        self.s_dynvol = _grow(self.s_dynvol, n)
+
+    def _grow_node(self, n: int) -> None:
+        self.node_used = _grow(self.node_used, n)
+        self.node_rel = _grow(self.node_rel, n)
+        self.node_tc = _grow(self.node_tc, n)
+
+    def _grow_job(self, n: int) -> None:
+        self.job_alloc = _grow(self.job_alloc, n)
+        self.job_ready = _grow(self.job_ready, n)
+        self.run_ct = _grow(self.run_ct, n)
+        self.pend_any = _grow(self.pend_any, n)
+        self.pend_nonbe = _grow(self.pend_nonbe, n)
+
+    def _grow_queue(self, n: int) -> None:
+        self.q_alloc = _grow(self.q_alloc, n)
+        self.q_request = _grow(self.q_request, n)
+
+    # -- the per-pod contribution (mirrors the full sweep's predicates) --
+
+    def _contrib(self, sign: int, status: int, node: int, job: int,
+                 qrow: int, req: np.ndarray, be: bool, dynvol: bool) -> None:
+        if node >= 0:
+            if sign > 0:
+                self._grow_node(node + 1)
+            self.node_used[node] += sign * req
+            self.node_tc[node] += sign
+            if status == _RELEASING:
+                self.node_rel[node] += sign * req
+        if sign > 0:
+            self._grow_job(job + 1)
+            if qrow >= 0:
+                self._grow_queue(qrow + 1)
+        if status in _ALLOC:
+            self.job_alloc[job] += sign * req
+            if qrow >= 0:
+                self.q_alloc[qrow] += sign * req
+                self.q_request[qrow] += sign * req
+        if status in _READY:
+            self.job_ready[job] += sign
+        if status == _RUNNING:
+            self.run_ct[job] += sign
+        if status == _PENDING:
+            if qrow >= 0:
+                self.q_request[qrow] += sign * req
+            self.pend_any[job] += sign
+            if not be:
+                self.pend_nonbe[job] += sign
+            if dynvol:
+                self.n_dynvol_pending += sign
+
+    # -- diff application ------------------------------------------------
+
+    def apply(self, m, rows: Iterable[int]) -> None:
+        """Subtract each dirty row's shadow contribution, add its current
+        mirror contribution, refresh the shadow.  Dirty sets are small by
+        construction (the engine falls back on dirty storms), so the
+        per-row Python loop stays off the critical path's O(P) floor."""
+        P = len(m.p_live)
+        for r in rows:
+            r = int(r)
+            self._grow_pod(r + 1)
+            if self.s_live[r]:
+                self._contrib(
+                    -1, int(self.s_status[r]), int(self.s_node[r]),
+                    int(self.s_job[r]), int(self.s_qrow[r]),
+                    self.s_req[r], bool(self.s_be[r]),
+                    bool(self.s_dynvol[r]),
+                )
+                self.s_live[r] = False
+            if r >= P or not m.p_live[r]:
+                continue
+            job = int(m.p_job[r])
+            if job < 0:
+                # unlinked pods contribute nothing (the full sweep's
+                # ``live &= pod_j >= 0`` gate); they also hold the fast
+                # path ineligible until the link resolves
+                continue
+            status = int(m.p_status[r])
+            node = int(m.p_node[r])
+            qrow = int(m.j_queue[job])
+            req = m.p_resreq[r].astype(np.float64)
+            be = bool(m.p_best_effort[r])
+            dynvol = bool(m.p_dynamic[r] or m.p_has_vol[r])
+            self._contrib(+1, status, node, job, qrow, req, be, dynvol)
+            self.s_live[r] = True
+            self.s_status[r] = status
+            self.s_node[r] = node
+            self.s_job[r] = job
+            self.s_qrow[r] = qrow
+            self.s_req[r] = req
+            self.s_be[r] = be
+            self.s_dynvol[r] = dynvol
+
+    # -- full rebuild (structural fallback) ------------------------------
+
+    def rebuild(self, m) -> None:
+        """Vectorized recompute of every accumulator + shadow from the
+        current mirror state — the structural-event (and first-build)
+        reset that re-anchors the diff discipline."""
+        P = len(m.p_live)
+        R = self.R
+        nN = len(m.n_live)
+        nJ = len(m.j_live)
+        nQ = len(m.q_live)
+        self.node_used = np.zeros((max(nN, 1), R), np.float64)
+        self.node_rel = np.zeros((max(nN, 1), R), np.float64)
+        self.node_tc = np.zeros((max(nN, 1),), np.int64)
+        self.job_alloc = np.zeros((max(nJ, 1), R), np.float64)
+        self.job_ready = np.zeros((max(nJ, 1),), np.int64)
+        self.run_ct = np.zeros((max(nJ, 1),), np.int64)
+        self.pend_any = np.zeros((max(nJ, 1),), np.int64)
+        self.pend_nonbe = np.zeros((max(nJ, 1),), np.int64)
+        self.q_alloc = np.zeros((max(nQ, 1), R), np.float64)
+        self.q_request = np.zeros((max(nQ, 1), R), np.float64)
+
+        live = m.p_live[:P]
+        job = m.p_job[:P]
+        elig = live & (job >= 0)
+        rows = np.nonzero(elig)[0]
+        status = m.p_status[:P]
+        node = m.p_node[:P]
+        qrow = np.where(
+            elig, m.j_queue[np.clip(job, 0, max(nJ - 1, 0))], -1
+        ).astype(np.int32) if nJ else np.full(P, -1, np.int32)
+        req = m.p_resreq[:P].astype(np.float64)
+        be = m.p_best_effort[:P]
+        dynvol = m.p_dynamic[:P] | m.p_has_vol[:P]
+
+        if rows.size:
+            st = status[rows]
+            nd = node[rows]
+            jb = job[rows]
+            qr = qrow[rows]
+            rq = req[rows]
+            resident = nd >= 0
+            if resident.any():
+                np.add.at(self.node_used, nd[resident], rq[resident])
+                self.node_tc[: nN] += np.bincount(
+                    nd[resident], minlength=nN
+                )[:nN] if nN else 0
+                relm = resident & (st == _RELEASING)
+                if relm.any():
+                    np.add.at(self.node_rel, nd[relm], rq[relm])
+            alloc = np.isin(st, _ALLOC)
+            if alloc.any():
+                np.add.at(self.job_alloc, jb[alloc], rq[alloc])
+                aq = alloc & (qr >= 0)
+                if aq.any():
+                    np.add.at(self.q_alloc, qr[aq], rq[aq])
+                    np.add.at(self.q_request, qr[aq], rq[aq])
+            ready = np.isin(st, _READY)
+            if ready.any():
+                self.job_ready[: nJ] += np.bincount(
+                    jb[ready], minlength=nJ
+                )[:nJ]
+            running = st == _RUNNING
+            if running.any():
+                self.run_ct[: nJ] += np.bincount(
+                    jb[running], minlength=nJ
+                )[:nJ]
+            pend = st == _PENDING
+            if pend.any():
+                pq = pend & (qr >= 0)
+                if pq.any():
+                    np.add.at(self.q_request, qr[pq], rq[pq])
+                self.pend_any[: nJ] += np.bincount(
+                    jb[pend], minlength=nJ
+                )[:nJ]
+                pnb = pend & ~be[rows]
+                if pnb.any():
+                    self.pend_nonbe[: nJ] += np.bincount(
+                        jb[pnb], minlength=nJ
+                    )[:nJ]
+            self.n_dynvol_pending = int((pend & dynvol[rows]).sum())
+        else:
+            self.n_dynvol_pending = 0
+
+        # shadow reset (vectorized copies of the state just aggregated)
+        self._grow_pod(P)
+        self.s_live[:P] = elig
+        self.s_live[P:] = False
+        self.s_status[:P] = status
+        self.s_node[:P] = node
+        self.s_job[:P] = job
+        self.s_qrow[:P] = qrow
+        self.s_req[:P] = req
+        self.s_be[:P] = be
+        self.s_dynvol[:P] = dynvol
+
+
+# -- sanctioned snapshot patch API (vtlint delta-discipline) -------------
+
+def patch_task_planes(m, snap, aux, pe_rows: np.ndarray,
+                      nodeaffinity_weight: float) -> None:
+    """Rewrite the snapshot's task planes for a FILTERED pending set
+    (admission holds / backlog sheds) — the one sanctioned way a delta
+    module writes snapshot columns.  Keeps the jit shapes the cycle
+    already compiled: ``min_T`` pins the task bucket, and the class
+    planes pad back to the original C if the filtered set uses fewer
+    predicate classes (padding rows are never indexed — task_valid is
+    False past n_tasks)."""
+    from volcano_tpu.scheduler.fastpath.snapshot_build import _task_arrays
+
+    N = snap.node_idle.shape[0]
+    R = snap.node_idle.shape[1]
+    min_T = snap.task_req.shape[0]
+    ta = _task_arrays(
+        m, pe_rows, aux["pod_j"], aux["n_jobs"], N, R, aux["node_rows"],
+        aux["n_nodes"], nodeaffinity_weight, snap.job_start,
+        snap.job_ntasks, min_T=min_T,
+    )
+    snap.task_req[:] = ta["task_req"]
+    snap.task_job[:] = ta["task_job"]
+    snap.task_class[:] = ta["task_class"]
+    snap.task_valid[:] = ta["task_valid"]
+    snap.task_uids = ta["pod_keys"]
+    cm, cs = ta["class_mask"], ta["class_score"]
+    nC = cm.shape[0]
+    if nC < snap.class_node_mask.shape[0]:
+        snap.class_node_mask[:nC] = cm
+        snap.class_node_mask[nC:] = False
+        snap.class_node_score[:nC] = cs
+        snap.class_node_score[nC:] = 0.0
+    else:
+        snap.class_node_mask[:] = cm[: snap.class_node_mask.shape[0]]
+        snap.class_node_score[:] = cs[: snap.class_node_score.shape[0]]
+    aux["pe_rows"] = pe_rows
+    aux["n_tasks"] = ta["n_tasks"]
+
+
+# -- the snapshot-incremental parity oracle ------------------------------
+
+#: aux keys the oracle compares (row maps + everything the solve,
+#: contention prechecks and publish consume downstream)
+_AUX_KEYS = (
+    "pe_rows", "job_rows", "node_rows", "n_jobs", "n_tasks", "n_nodes",
+    "pod_j", "live", "codes", "node_used", "run_per_job",
+    "pend_any_per_job", "pend_nonbe_per_job", "dyn_job", "dyn_expr_job",
+    "partition_unsafe", "shadow_job", "residue_keys", "residue_reasons",
+    "residue_task_counts",
+)
+
+
+def _eq(a, b) -> bool:
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        a = np.asarray(a)
+        b = np.asarray(b)
+        return (
+            a.dtype == b.dtype and a.shape == b.shape
+            and a.tobytes() == b.tobytes()
+        )
+    return a == b
+
+
+def assert_snapshot_equal(got: Tuple, want: Tuple) -> None:
+    """Bit-for-bit comparison of two (snapshot, aux) pairs — the
+    ``snapshot-incremental`` oracle.  ``got`` is the micro build,
+    ``want`` the fresh full build on the same mirror state.  Raises
+    AssertionError naming the first diverging field."""
+    snap_g, aux_g = got
+    snap_w, aux_w = want
+    if (snap_g is None) != (snap_w is None):
+        raise AssertionError(
+            f"snapshot-incremental: one side is None "
+            f"(micro={snap_g is None}, full={snap_w is None})"
+        )
+    if snap_g is None:
+        return
+    for f in dataclasses.fields(snap_g):
+        a = getattr(snap_g, f.name)
+        b = getattr(snap_w, f.name)
+        if a is None and b is None:
+            continue
+        if not _eq(a, b):
+            raise AssertionError(
+                f"snapshot-incremental: snapshot field {f.name!r} "
+                f"diverges between micro and full build"
+            )
+    for k in _AUX_KEYS:
+        if not _eq(aux_g.get(k), aux_w.get(k)):
+            raise AssertionError(
+                f"snapshot-incremental: aux[{k!r}] diverges between "
+                f"micro and full build"
+            )

@@ -109,6 +109,14 @@ class FastCycle:
         # last status this scheduler wrote, to suppress no-op patches
         self._status_fp: Dict[str, tuple] = {}
         self._phase_list = list(PodGroupPhase)
+        # vtdelta (conf.delta == "on"): event-driven micro-cycles —
+        # dirty-set diffed pod aggregates, token-bucket admission, and
+        # backlog shedding (ROADMAP item 2; scheduler/delta/)
+        self.delta = None
+        if self.conf_ok and getattr(self.conf, "delta", "off") == "on":
+            from volcano_tpu.scheduler.delta import DeltaEngine
+
+            self.delta = DeltaEngine(self.conf, self.store)
 
     # -- entry ---------------------------------------------------------------
 
@@ -124,6 +132,8 @@ class FastCycle:
             self.mirror = ArrayMirror(
                 self.store, self.cache.scheduler_name, self.cache.default_queue
             )
+            if self.delta is not None:
+                self.delta.arm(self.mirror)
             ckpt = self.conf.mirror_checkpoint
             if ckpt:
                 import os
@@ -153,6 +163,9 @@ class FastCycle:
                 self.store, self.cache.scheduler_name, self.cache.default_queue
             )
         m = self.mirror
+        if self.delta is not None:
+            # before drain: the hook must see this pump's watch deltas
+            self.delta.arm(m)
         ph = self.phases = {}
         self.residue_stats = {}
         self._vol_session_cleared = False
@@ -163,10 +176,16 @@ class FastCycle:
         if m.ineligible_reason() is not None:
             return False
         t = time.perf_counter()
-        snap, aux = build_fast_snapshot(
-            m, self.nodeaffinity_weight,
-            dyn_batch=(self.conf.solve_mode, self.probe.batch_threshold),
-        )
+        if self.delta is not None:
+            snap, aux = self.delta.build(
+                m, self.nodeaffinity_weight,
+                dyn_batch=(self.conf.solve_mode, self.probe.batch_threshold),
+            )
+        else:
+            snap, aux = build_fast_snapshot(
+                m, self.nodeaffinity_weight,
+                dyn_batch=(self.conf.solve_mode, self.probe.batch_threshold),
+            )
         ph["snapshot"] = time.perf_counter() - t
         if snap is None:
             return False
@@ -198,6 +217,24 @@ class FastCycle:
             "preempt" in self.conf.actions
             and self._preempt_possible(snap, aux)
         )
+        if (
+            self.delta is not None
+            and self.delta.last.get("mode") == "micro"
+            and (reclaim_work or preempt_later)
+        ):
+            # a preempt/reclaim wave is a structural event (ISSUE/delta
+            # contract): rebuild on the full path before victim pools are
+            # carved.  Same mirror state — prechecks stay valid and the
+            # cached admission decision re-applies without token charges.
+            t = time.perf_counter()
+            snap, aux = self.delta.rebuild_full(
+                m, self.nodeaffinity_weight,
+                dyn_batch=(self.conf.solve_mode, self.probe.batch_threshold),
+            )
+            ph["snapshot"] += time.perf_counter() - t
+            if snap is None:
+                return False
+            self.last_residue_reasons = dict(aux.get("residue_reasons", {}))
 
         enq_ops: List[dict] = []
         if "enqueue" in self.conf.actions:
@@ -446,6 +483,10 @@ class FastCycle:
                 "evictions": len(evicts),
                 "residue_jobs": len(self.last_residue_reasons),
             }
+            if self.delta is not None:
+                # micro/full split + admission state for the cycle row
+                # (vtctl top's delta panel and the cfg10 bench read these)
+                self.last_cycle_stats.update(self.delta.last)
         if run_sub:
             # the sub-cycle's snapshot must see this cycle's published
             # binds even when the Binder seam has not written the store yet
@@ -534,6 +575,7 @@ class FastCycle:
         evicts = []
         run_rows = aux["run_rows"]
         codes = aux["codes"]
+        h = m.delta_hook
         for i, reason in cont.evictions:
             prow = int(run_rows[i])
             # optimistic mirror update (the store's deleting=True watch
@@ -541,6 +583,8 @@ class FastCycle:
             # object path's close also sees victims as RELEASING
             m.p_status[prow] = _RELEASING
             codes[prow] = _RELEASING
+            if h is not None:
+                h.pod(prow)
             evicts.append((snap.run_uids[i], reason))
         # end-state ready counts (post solve/backfill/evictions) exist only
         # once advance_post_solve folded the solve in; a reclaim-only cycle

@@ -149,6 +149,14 @@ class ArrayMirror:
         self.audit_divergences = 0
         self.last_audit: Optional[Dict] = None
         vtaudit.set_debug_source(self._audit_debug)
+        # delta dirty-set hook (scheduler/delta/dirty.py): armed by the
+        # DeltaEngine when conf.delta == "on", None otherwise.  Ingest
+        # paths that change a pod's aggregate contribution call
+        # ``hook.pod(row)``; events that invalidate row-keyed aggregation
+        # wholesale call ``hook.structural(reason)``.  Deliberately an
+        # instance attribute (not reset by _resync): the hook must
+        # survive a resync so it can observe the resync itself.
+        self.delta_hook = None
         self._reset_tables(["cpu", "memory"])
 
     def _reset_tables(self, dims: List[str]) -> None:
@@ -290,6 +298,9 @@ class ArrayMirror:
         scalar-dim widening, class-cap churn). Watches stay subscribed;
         tables reset. Re-entrant class-cap overflow during the rebuild
         flags the mirror instead of recursing (see _class_id)."""
+        h = self.delta_hook
+        if h is not None:
+            h.structural("resync")
         self._reset_tables(dims or ["cpu", "memory"])
         self._resyncing = True
         try:
@@ -550,6 +561,11 @@ class ArrayMirror:
         self.n_port_cnt = _grow(self.n_port_cnt, n)
         self.n_sel_cnt = _grow(self.n_sel_cnt, n)
         if new:
+            h = self.delta_hook
+            if h is not None:
+                # covers rebirth too: the p_node migration below moves
+                # resident pods' contributions across node rows wholesale
+                h.structural("node-add")
             retired = self._retired_node_rows.pop(node.meta.name, None)
             if retired:
                 stale = np.isin(self.p_node, np.asarray(retired, np.int32))
@@ -585,6 +601,9 @@ class ArrayMirror:
     def _del_node_key(self, name: str) -> None:
         row = self.nodes.release(name)
         if row is not None:
+            h = self.delta_hook
+            if h is not None:
+                h.structural("node-remove")
             self.n_live[row] = False
             self.node_objs[row] = None  # retired rows must not pin objects
             self._retired_node_rows.setdefault(name, []).append(row)
@@ -608,6 +627,13 @@ class ArrayMirror:
     def _on_podgroup(self, pg) -> None:
         row, _ = self.jobs.acquire(pg.meta.key)
         self._grow_job_arrays(row + 1)
+        # queue moves re-bucket every contributed member pod's queue
+        # aggregates — a structural event for the delta engine (job
+        # planes themselves are gathered fresh each build and need none)
+        old_q = (
+            int(self.j_queue[row])
+            if self.j_live[row] and not self.j_shadow[row] else None
+        )
         self.j_shadow[row] = False
         self.j_min[row] = pg.min_member
         qname = pg.queue or self.default_queue
@@ -626,6 +652,10 @@ class ArrayMirror:
             c.kind == "Unschedulable" and c.status == "True"
             for c in pg.status.conditions
         )
+        h = self.delta_hook
+        if h is not None and old_q is not None \
+                and int(self.j_queue[row]) != old_q:
+            h.structural("job-requeue")
         # link pods that arrived before their group (the wait-set discipline
         # guarantees every member's CURRENT annotation is this group)
         waiting = self._waiting_on_group.pop(pg.meta.key, None)
@@ -635,6 +665,8 @@ class ArrayMirror:
                 prow = self.pods.key_row.get(pod_key)
                 if prow is not None:
                     self.p_job[prow] = row
+                    if h is not None:
+                        h.pod(int(prow))
                 self.unlinked_pods.discard(pod_key)
 
     def _del_podgroup(self, pg) -> None:
@@ -643,6 +675,9 @@ class ArrayMirror:
     def _del_podgroup_key(self, pg_key: str) -> None:
         row = self.jobs.release(pg_key)
         if row is not None:
+            h = self.delta_hook
+            if h is not None:
+                h.structural("job-remove")
             self.j_live[row] = False
             # surviving member pods become shadow jobs on the object path;
             # mark them unlinked so the fast path defers
@@ -1076,6 +1111,11 @@ class ArrayMirror:
         crow = int(self.p_node[row])
         if crow >= 0:
             self._add_contrib(row, crow)
+        h = self.delta_hook
+        if h is not None:
+            # early-return paths above (_class_id cap, _widen_dims) all
+            # route through _resync, which already fired structural()
+            h.pod(row)
 
     def _drop_pod_row(self, key: str) -> None:
         row = self.pods.release(key)
@@ -1087,6 +1127,9 @@ class ArrayMirror:
             self.p_labels[row] = None
             self.vol_pod_objs.pop(row, None)
             self._shadow_ref(int(self.p_job[row]), -1)
+            h = self.delta_hook
+            if h is not None:
+                h.pod(row)
 
     def _del_pod(self, pod) -> None:
         self._drop_pod_row(pod.meta.key)
@@ -1107,7 +1150,9 @@ class ArrayMirror:
     #: table rides along implicitly: restore rebuilds it from the store
     #: in _reconcile_store, so a stale checkpointed digest can never
     #: mask post-checkpoint drift
-    _CKPT_SKIP = ("store", "_watches", "_audit", "_audit_pending")
+    _CKPT_SKIP = (
+        "store", "_watches", "_audit", "_audit_pending", "delta_hook",
+    )
 
     def save_checkpoint(self, path: str) -> None:
         """Persist the full mirror state (row tables, interning maps,

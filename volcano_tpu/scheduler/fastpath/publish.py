@@ -85,6 +85,8 @@ def publish_and_close(fc, m, snap, aux, task_node, task_kind, ready,
         prows, nidx = fc._volume_bind_filter(m, prows, nidx, names)
         m.p_status[prows] = _BOUND
         m.p_node[prows] = node_rows[nidx]
+        if m.delta_hook is not None:
+            m.delta_hook.pods_many(prows)
         bind_cols.append((prows, nidx))
     if be_rows.size:
         keep = gang_ready[pod_j[be_rows]]
@@ -96,6 +98,8 @@ def publish_and_close(fc, m, snap, aux, task_node, task_kind, ready,
         if pub_be.size:
             m.p_status[pub_be] = _BOUND
             m.p_node[pub_be] = node_rows[pub_be_nodes]
+            if m.delta_hook is not None:
+                m.delta_hook.pods_many(pub_be)
             bind_cols.append((pub_be, pub_be_nodes))
     if bind_cols:
         rows_all = np.concatenate([p for p, _ in bind_cols])
@@ -161,11 +165,17 @@ def publish_and_close(fc, m, snap, aux, task_node, task_kind, ready,
 
     ops: List[dict] = []
     n_unsched_jobs = 0
+    # delta admission: gangs shed to the Backlogged condition this cycle
+    # were filtered from the solve — an Unschedulable/phase write here
+    # would clobber the condition the admission controller just set
+    delta_shed = aux.get("delta_shed_jobs") or ()
     for j in range(n_jobs) if write_status else ():
         if shadow_job[j]:
             # shadow gangs have no store PodGroup to write status to
             # (the object path's close likewise skips pod_group-less
             # jobs); their gang gate still filtered the binds above
+            continue
+        if j in delta_shed:
             continue
         jrow = aux["job_rows"][j]
         pg_key = m.jobs.row_key[jrow]

@@ -344,6 +344,7 @@ def _residue_counts(residue_reason_job: Dict[int, str],
 def build_fast_snapshot(
     m: ArrayMirror, nodeaffinity_weight: float = 1.0,
     dyn_batch: Optional[Tuple[str, int]] = None,
+    agg=None,
 ) -> Tuple[Optional[TensorSnapshot], dict]:
     """Vectorized TensorSnapshot from the mirror — semantics identical to
     snapshot.build_tensor_snapshot on the same store (asserted by
@@ -353,6 +354,18 @@ def build_fast_snapshot(
     (snapshot, aux) where aux carries the row<->key mappings the publish
     step needs; snapshot is None when there are no live queues (nothing
     schedulable — object path would drop every job too).
+
+    ``agg`` (delta/incremental.py PodAggregates) switches the pod-sweep
+    aggregates — node usage, job/queue shares, ready/pending counts —
+    to row-keyed gathers from incrementally-maintained accumulators
+    instead of the O(P) sweeps: the delta micro-cycle mode.  The light
+    O(P) masks (live/pod_j/codes/pe_rows) are still recomputed exactly
+    as in the full sweep, so everything downstream (solve, contention,
+    publish) sees identical inputs.  Callers must only pass ``agg``
+    when the DeltaEngine's micro preconditions hold (no pending
+    dynamic/volume pods, no structural event since the last rebuild);
+    the snapshot-incremental oracle asserts bit-equality with a fresh
+    full build.
     """
     from volcano_tpu.api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_SCALAR
 
@@ -441,52 +454,96 @@ def build_fast_snapshot(
 
     # node usage (NodeInfo add_task semantics, model.py:219-231: every
     # resident subtracts idle — sequential clamped sub == max(alloc-sum,0) —
-    # releasing residents additionally accumulate the releasing pool)
-    pn = np.where(live, m.p_node[:P], -1)
-    res_rows = np.nonzero(live & (pn >= 0))[0]
-    if res_rows.size:
-        res_rows = res_rows[m.n_live[pn[res_rows]]]  # node vanished: skip
-    res_nodes = n_idx_of_row[pn[res_rows]] if res_rows.size else res_rows
-    if res_rows.size:
-        ok = res_nodes >= 0
-        res_rows, res_nodes = res_rows[ok], res_nodes[ok]
+    # releasing residents additionally accumulate the releasing pool).
+    # Both modes accumulate in float64 and cast to float32 once: the
+    # inputs are integer-valued (milli-CPU / bytes / device counts), so
+    # f64 sums are exact and therefore ORDER-INDEPENDENT — the property
+    # that lets the delta aggregates' add/subtract discipline reproduce a
+    # fresh sweep bit for bit (asserted by the snapshot-incremental
+    # oracle every oracle-armed cycle).
     node_used = np.zeros((N, R), np.float32)
     node_rel = np.zeros((N, R), np.float32)
     node_tc = np.zeros((N,), np.int32)
-    if res_rows.size:
-        np.add.at(node_used, res_nodes, m.p_resreq[res_rows])
-        rel_rows = codes[res_rows] == _RELEASING
-        if rel_rows.any():
-            np.add.at(node_rel, res_nodes[rel_rows], m.p_resreq[res_rows[rel_rows]])
-        node_tc[:] = np.bincount(res_nodes, minlength=N).astype(np.int32)
+    if agg is not None:
+        if n_live_ct:
+            node_used[:n_live_ct] = \
+                agg.node_used[node_rows_arr].astype(np.float32)
+            node_rel[:n_live_ct] = \
+                agg.node_rel[node_rows_arr].astype(np.float32)
+            node_tc[:n_live_ct] = \
+                agg.node_tc[node_rows_arr].astype(np.int32)
+    else:
+        pn = np.where(live, m.p_node[:P], -1)
+        res_rows = np.nonzero(live & (pn >= 0))[0]
+        if res_rows.size:
+            res_rows = res_rows[m.n_live[pn[res_rows]]]  # node vanished: skip
+        res_nodes = n_idx_of_row[pn[res_rows]] if res_rows.size else res_rows
+        if res_rows.size:
+            ok = res_nodes >= 0
+            res_rows, res_nodes = res_rows[ok], res_nodes[ok]
+        if res_rows.size:
+            used64 = np.zeros((N, R), np.float64)
+            np.add.at(used64, res_nodes, m.p_resreq[res_rows])
+            node_used[:] = used64.astype(np.float32)
+            rel_rows = codes[res_rows] == _RELEASING
+            if rel_rows.any():
+                rel64 = np.zeros((N, R), np.float64)
+                np.add.at(
+                    rel64, res_nodes[rel_rows], m.p_resreq[res_rows[rel_rows]]
+                )
+                node_rel[:] = rel64.astype(np.float32)
+            node_tc[:] = np.bincount(res_nodes, minlength=N).astype(np.int32)
     node_idle = np.maximum(node_alloc - node_used, 0.0)
 
     # shares (snapshot.py:375-393): allocated statuses charge job/queue
     # alloc + queue request; pending charges queue request; ready counts
-    charge = live & np.isin(codes, _ALLOCATED_CODES)
-    ready_m = live & np.isin(codes, _READY_CODES)
     pend_all = live & (codes == _PENDING)
     queue_alloc = np.zeros((Q, R), np.float32)
     queue_request = np.zeros((Q, R), np.float32)
     queue_participates = np.zeros((Q,), bool)
     if n_jobs:
         queue_participates[job_q_idx[job_q_idx >= 0]] = True
-    ch_rows = np.nonzero(charge)[0]
-    if ch_rows.size:
-        np.add.at(job_alloc_init, pod_j[ch_rows], m.p_resreq[ch_rows])
-        # queue shares skip queue-less (shadow) jobs, snapshot.py:386-391
-        chq = ch_rows[job_queue[pod_j[ch_rows]] >= 0]
-        np.add.at(queue_alloc, job_queue[pod_j[chq]], m.p_resreq[chq])
-        np.add.at(queue_request, job_queue[pod_j[chq]], m.p_resreq[chq])
-    pd_rows = np.nonzero(pend_all)[0]
-    if pd_rows.size:
-        pdq = pd_rows[job_queue[pod_j[pd_rows]] >= 0]
-        np.add.at(queue_request, job_queue[pod_j[pdq]], m.p_resreq[pdq])
-    rd_rows = np.nonzero(ready_m)[0]
-    if rd_rows.size:
-        job_ready_init[:n_jobs] = np.bincount(
-            pod_j[rd_rows], minlength=n_jobs
-        ).astype(np.int32)[:n_jobs]
+    if agg is not None:
+        # micro mode: gathers from the row-keyed accumulators.  The
+        # queue buckets agree with the sweep's job_queue[pod_j] routing
+        # because the aggregates key by m.j_queue at contribution time
+        # and queue moves are structural ("job-requeue" fallback).
+        if n_jobs:
+            job_alloc_init[:n_jobs] = \
+                agg.job_alloc[job_rows].astype(np.float32)
+            job_ready_init[:n_jobs] = \
+                agg.job_ready[job_rows].astype(np.int32)
+        for i, name in enumerate(q_names):
+            qrow = m.queues.key_row[name]
+            queue_alloc[i] = agg.q_alloc[qrow].astype(np.float32)
+            queue_request[i] = agg.q_request[qrow].astype(np.float32)
+    else:
+        charge = live & np.isin(codes, _ALLOCATED_CODES)
+        ready_m = live & np.isin(codes, _READY_CODES)
+        ch_rows = np.nonzero(charge)[0]
+        if ch_rows.size:
+            ja64 = np.zeros(job_alloc_init.shape, np.float64)
+            np.add.at(ja64, pod_j[ch_rows], m.p_resreq[ch_rows])
+            job_alloc_init[:] = ja64.astype(np.float32)
+        qa64 = np.zeros((Q, R), np.float64)
+        qr64 = np.zeros((Q, R), np.float64)
+        if ch_rows.size:
+            # queue shares skip queue-less (shadow) jobs, snapshot.py:386-391
+            chq = ch_rows[job_queue[pod_j[ch_rows]] >= 0]
+            np.add.at(qa64, job_queue[pod_j[chq]], m.p_resreq[chq])
+            np.add.at(qr64, job_queue[pod_j[chq]], m.p_resreq[chq])
+        pd_rows = np.nonzero(pend_all)[0]
+        if pd_rows.size:
+            pdq = pd_rows[job_queue[pod_j[pd_rows]] >= 0]
+            np.add.at(qr64, job_queue[pod_j[pdq]], m.p_resreq[pdq])
+        if ch_rows.size or pd_rows.size:
+            queue_alloc[:] = qa64.astype(np.float32)
+            queue_request[:] = qr64.astype(np.float32)
+        rd_rows = np.nonzero(ready_m)[0]
+        if rd_rows.size:
+            job_ready_init[:n_jobs] = np.bincount(
+                pod_j[rd_rows], minlength=n_jobs
+            ).astype(np.int32)[:n_jobs]
 
     # -- volume verdicts (volsolve.py) ---------------------------------------
     # once per cycle, and only when claim-referencing pending pods exist
@@ -697,25 +754,31 @@ def build_fast_snapshot(
     )
     # per-job stats for the preempt/reclaim prechecks and enqueue
     run_per_job = np.zeros(max(n_jobs, 1), np.int64)
-    running_rows = np.nonzero(live & (codes == _RUNNING))[0]
-    if running_rows.size and n_jobs:
-        run_per_job[:n_jobs] = np.bincount(
-            pod_j[running_rows], minlength=n_jobs
-        )[:n_jobs]
     pend_any_per_job = np.zeros(max(n_jobs, 1), np.int64)
-    if pd_rows.size and n_jobs:
-        pend_any_per_job[:n_jobs] = np.bincount(
-            pod_j[pd_rows], minlength=n_jobs
-        )[:n_jobs]
     # pending non-BE counts INCLUDING dynamic jobs — the preempt/reclaim
     # prechecks must see residue starvation too (conservative direction:
     # more pending can only make the precheck answer "possible")
     pend_nonbe_per_job = np.zeros(nJ, np.int64)
-    nb_all = np.nonzero(pend_all & ~m.p_best_effort[:P])[0]
-    if nb_all.size and n_jobs:
-        pend_nonbe_per_job[:n_jobs] = np.bincount(
-            pod_j[nb_all], minlength=n_jobs
-        )[:n_jobs]
+    if agg is not None:
+        if n_jobs:
+            run_per_job[:n_jobs] = agg.run_ct[job_rows]
+            pend_any_per_job[:n_jobs] = agg.pend_any[job_rows]
+            pend_nonbe_per_job[:n_jobs] = agg.pend_nonbe[job_rows]
+    else:
+        running_rows = np.nonzero(live & (codes == _RUNNING))[0]
+        if running_rows.size and n_jobs:
+            run_per_job[:n_jobs] = np.bincount(
+                pod_j[running_rows], minlength=n_jobs
+            )[:n_jobs]
+        if pd_rows.size and n_jobs:
+            pend_any_per_job[:n_jobs] = np.bincount(
+                pod_j[pd_rows], minlength=n_jobs
+            )[:n_jobs]
+        nb_all = np.nonzero(pend_all & ~m.p_best_effort[:P])[0]
+        if nb_all.size and n_jobs:
+            pend_nonbe_per_job[:n_jobs] = np.bincount(
+                pod_j[nb_all], minlength=n_jobs
+            )[:n_jobs]
 
     aux = {
         "pe_rows": pe_rows,            # task row index -> mirror pod row

@@ -429,6 +429,29 @@ def register_wal_recovery(n: int) -> None:
     inc("volcano_store_wal_recovery_replayed_records_total", float(n))
 
 
+# -- vtdelta incremental-scheduling series (scheduler/delta/) -----------------
+
+def register_delta_micro_cycle(n: int = 1) -> None:
+    """Micro-cycle snapshot builds: the dirty-set diff replaced the full
+    O(P) pod sweeps.  A cycle that later rebuilds full for contention
+    still counts — the series counts BUILDS, not published cycles."""
+    inc("volcano_delta_micro_cycles_total", float(n))
+
+
+def register_delta_fallback(reason: str) -> None:
+    """Full snapshot builds while delta mode is on, by trigger: arm /
+    init / resync / node-add / node-remove / job-remove / job-requeue /
+    job-dropped / dynamic / dirty-storm / contention."""
+    inc("volcano_delta_full_fallbacks_total", reason=reason)
+
+
+def register_delta_shed(n: int = 1) -> None:
+    """Gangs newly shed to the Backlogged condition by the admission
+    controller's high watermark (re-admitted gangs don't decrement —
+    monotone counter; live depth is the cycle row's shed_gangs field)."""
+    inc("volcano_delta_shed_gangs_total", float(n))
+
+
 # -- elastic autoscaler series (volcano_tpu/elastic/) -------------------------
 
 def update_pool_size(pool: str, size: int) -> None:
@@ -494,6 +517,12 @@ _HELP: Dict[str, str] = {
         "Array bytes held per component (memory watermark)",
     "volcano_prof_anomalies_total":
         "vtprof sentinel trips (steady-state recompiles, leaks) by kind",
+    "volcano_delta_micro_cycles_total":
+        "Micro-cycle snapshot builds (dirty-set diff, no full sweep)",
+    "volcano_delta_full_fallbacks_total":
+        "Full snapshot builds under delta mode, by trigger reason",
+    "volcano_delta_shed_gangs_total":
+        "Gangs shed to the Backlogged condition by admission control",
     _DROPPED_SERIES:
         "Observations dropped by the per-metric label-cardinality cap",
 }
