@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
+.PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta bench-repl loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings
@@ -144,6 +144,17 @@ bench-shard:
 bench-delta:
 	$(PY) -m pytest tests/test_delta.py -q -p no:cacheprovider
 	$(PY) bench.py --config 12
+
+# vtrepl (store/replica.py + tests/test_replication.py): WAL-shipping
+# replication, follower-served watches, leader failover.  The tier-1
+# suite proves the group-commit ship watermark, byte-identical follower
+# replay, NotLeader redirects, sync-ack, and the SIGKILL-the-leader
+# storm (zero acked loss); cfg11 (`--config 13`) measures follower-
+# served watch fan-out read throughput scaling 1->2->4 follower
+# subprocesses.  CPU containers: set VOLCANO_TPU_CFG11_SCALE to shrink.
+bench-repl:
+	$(PY) -m pytest tests/test_replication.py -q -p no:cacheprovider
+	$(PY) bench.py --config 13
 
 # container images (reference Makefile:40-48 / installer/dockerfile/):
 # `image` = CPU-jax control plane, `image-tpu` = jax[tpu]+libtpu wheel

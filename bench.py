@@ -1364,9 +1364,178 @@ def config10_delta(scale=None, trickle_cycles=200, duration_s=4.0,
     })
 
 
+# -- cfg11: follower-served watch fan-out (store/replica.py) ------------------
+#
+# The replication PR's read-scaling claim: watch/list traffic served by
+# follower replicas scales with the follower count because each follower
+# is its own PROCESS (own GIL, own event log copy) serving the same
+# replicated stream.  The bench spawns a leader + 4 follower apiservers
+# as real subprocesses (in-process followers would serialize on this
+# process's GIL and measure nothing), seeds a resident event-log window
+# through the leader, waits for the followers to mirror it, then hammers
+# the first 1 / 2 / 4 followers with a fixed reader fleet replaying the
+# window via raw long-polls (loadgen/harness.fanout_watch_pass).  The
+# headline value is seconds per 10k events served at 4 followers (lower
+# is better, so the trajectory gate's max_s band fences regressions);
+# the 1->2->4 aggregate throughputs and their scaling ratios ride in
+# `extra`.  Gated alongside cfg7 (`--check --configs 7,13`): the drain
+# bands must hold while the fan-out tier scales.
+
+CFG11_EVENTS = 400    # resident event rows the readers replay per pass
+CFG11_READERS = 24    # reader threads (fixed fleet, split across followers)
+CFG11_WINDOW_S = 4.0  # measurement window per follower count
+
+
+def _cfg11_spawn(args, env):
+    import subprocess
+
+    return subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+
+
+def config11_repl(scale=None, readers=None, n_events=None, window_s=None,
+                  follower_counts=(1, 2, 4)):
+    """cfg11: follower-served watch fan-out read throughput 1->2->4
+    followers (VOLCANO_TPU_CFG11_SCALE shrinks readers/window for CI)."""
+    import shutil
+    import signal
+    import sys
+    import tempfile
+    import threading
+
+    import jax
+
+    from volcano_tpu.loadgen.harness import fanout_watch_pass
+    from volcano_tpu.store.client import RemoteStore, wait_healthy
+
+    if scale is None:
+        scale = float(os.environ.get("VOLCANO_TPU_CFG11_SCALE", "1.0"))
+    readers = readers or max(int(CFG11_READERS * scale), 4)
+    n_events = n_events or max(int(CFG11_EVENTS * scale), 60)
+    window_s = window_s or max(CFG11_WINDOW_S * scale, 1.0)
+    n_followers = max(follower_counts)
+
+    env = {k: v for k, v in os.environ.items() if k != "VOLCANO_TPU_CHAOS"}
+    env.update({"JAX_PLATFORMS": "cpu", "VOLCANO_TPU_BACKEND": "host"})
+    entry = [sys.executable, "-m", "volcano_tpu.cli", "apiserver",
+             "--port", "0", "--wal"]
+    workdir = tempfile.mkdtemp(prefix="cfg11-")
+    procs = []
+
+    def status(url):
+        import urllib.request
+
+        with urllib.request.urlopen(url + "/repl/status", timeout=10) as r:
+            return json.load(r)
+
+    try:
+        leader = _cfg11_spawn(
+            entry + ["--state", f"{workdir}/L.json",
+                     "--repl-ack", "async"], env)
+        procs.append(leader)
+        leader_url = leader.stdout.readline().strip().rsplit(" ", 1)[-1]
+        assert wait_healthy(leader_url, timeout=60)
+        follower_urls = []
+        for i in range(n_followers):
+            p = _cfg11_spawn(
+                entry + ["--state", f"{workdir}/f{i}.json",
+                         "--replica-of", leader_url], env)
+            procs.append(p)
+            follower_urls.append(
+                p.stdout.readline().strip().rsplit(" ", 1)[-1])
+        for u in follower_urls:
+            assert wait_healthy(u, timeout=60)
+
+        # followers past their bootstrap snapshot BEFORE the window is
+        # written, so every event row lands in their local logs live
+        def synced(target):
+            return all(status(u).get("applied", -1) >= target
+                       for u in follower_urls)
+
+        deadline = time.monotonic() + 60
+        while not synced(status(leader_url)["ship_seq"]):
+            assert time.monotonic() < deadline, "followers never synced"
+            time.sleep(0.1)
+        base_cursor = int(status(leader_url)["ship_seq"])
+
+        from volcano_tpu.api.objects import Metadata, Node
+        from volcano_tpu.api.resource import Resource
+
+        rs = RemoteStore(leader_url)
+        for i in range(n_events):
+            rs.create("Node", Node(
+                meta=Metadata(name=f"bn{i:05d}", namespace=""),
+                allocatable=Resource.from_resource_list(
+                    {"cpu": "1", "memory": "1Gi"})))
+        target = int(status(leader_url)["ship_seq"])
+        deadline = time.monotonic() + 60
+        while not synced(target):
+            assert time.monotonic() < deadline, "window never replicated"
+            time.sleep(0.1)
+
+        def measure(urls):
+            """Fixed reader fleet split across ``urls``; aggregate events
+            served in the window."""
+            stop = time.monotonic() + window_s
+            counts = [0] * readers
+
+            def read_loop(idx):
+                url = urls[idx % len(urls)]
+                cur = base_cursor
+                while time.monotonic() < stop:
+                    try:
+                        ev, nxt, relist = fanout_watch_pass(
+                            url, cur, timeout_s=0.25)
+                    except OSError:
+                        continue
+                    counts[idx] += ev
+                    cur = base_cursor if (relist or ev == 0) else nxt
+            threads = [threading.Thread(target=read_loop, args=(i,),
+                                        daemon=True)
+                       for i in range(readers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=window_s + 30)
+            return sum(counts) / window_s
+
+        measure(follower_urls[:1])  # warm connections/caches, unmeasured
+        thr = {}
+        for k in follower_counts:
+            thr[k] = measure(follower_urls[:k])
+        top = max(follower_counts)
+        base = max(thr[min(follower_counts)], 1e-9)
+        _print_json({
+            "metric": "cfg11_repl_fanout_watch_reads",
+            "value": round(10_000.0 / max(thr[top], 1e-9), 4),
+            "unit": "s",  # seconds per 10k follower-served events
+            "vs_baseline": None,
+            "extra": {
+                "events_per_s": {str(k): int(v) for k, v in thr.items()},
+                "scaling_vs_1_follower": {
+                    str(k): round(thr[k] / base, 2) for k in thr},
+                "readers": readers, "window_s": window_s,
+                "resident_events": n_events, "scale": scale,
+                "followers": n_followers,
+                "device": str(jax.devices()[0]),
+            },
+        })
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGKILL)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes,
-           10: config8_open_loop, 11: config9_shard, 12: config10_delta}
+           10: config8_open_loop, 11: config9_shard, 12: config10_delta,
+           13: config11_repl}
 
 
 # -- bench trajectory + continuous perf-regression gate (vtprof PR) -----------
@@ -1388,6 +1557,7 @@ GATED_METRICS = (
     "cfg8_open_loop_first_seen_to_bind",
     "cfg9_mesh_sharded_1m_x_100k",
     "cfg10_delta_steady_state_micro_cycle",
+    "cfg11_repl_fanout_watch_reads",
 )
 #: band slack over the best same-device trajectory reading: headline
 #: values breathe ±15% run-to-run on the tunnel (BASELINE.md), phases
@@ -1735,6 +1905,7 @@ CONFIG_METRIC = {
     10: "cfg8_open_loop_first_seen_to_bind",
     11: "cfg9_mesh_sharded_1m_x_100k",
     12: "cfg10_delta_steady_state_micro_cycle",
+    13: "cfg11_repl_fanout_watch_reads",
 }
 
 
@@ -1790,6 +1961,11 @@ def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
             11: config9_shard,
             12: lambda: config10_delta(trickle_cycles=60, duration_s=2.0,
                                        max_doublings=1),
+            # full shape, not a shrink: the headline (s / 10k events at
+            # the top follower count) scales with the reader fleet and
+            # the window amortizes per-pass overhead — a cut-down run
+            # would breach a band captured from the real configuration
+            13: config11_repl,
         }
     for n in configs:
         fn = runners.get(n)

@@ -141,7 +141,7 @@ class ControlPlane:
     rebuild on StaleWatch) from cli/daemons.py — same wire path as the
     subprocess daemons, but fast and with the electors inspectable."""
 
-    def __init__(self, url, elect=False, flap_plan=None):
+    def __init__(self, url, elect=False, flap_plan=None, peers=None):
         self.url = url
         self.stop = threading.Event()
         self.threads = []
@@ -149,6 +149,12 @@ class ControlPlane:
         self.crashes = []  # unexpected (non-transient) loop deaths
         self._elect = elect
         self._flap_plan = flap_plan
+        # replica peer URLs: every loop's RemoteStore re-resolves the
+        # leader through these after a NotLeader redirect or leader death
+        self.peers = list(peers) if peers else None
+
+    def _store(self):
+        return RemoteStore(self.url, peers=self.peers)
 
     def _elector(self, store, component, ident, flapped):
         if not self._elect:
@@ -170,7 +176,7 @@ class ControlPlane:
         while not self.stop.is_set():
             try:
                 if ctl is None:
-                    store = RemoteStore(self.url)
+                    store = self._store()
                     ctl = JobController(store, elector=self._elector(
                         store, "vk-controllers", ident, flapped))
                 ctl.pump()
@@ -191,7 +197,7 @@ class ControlPlane:
         while not self.stop.is_set():
             try:
                 if sched is None:
-                    store = RemoteStore(self.url)
+                    store = self._store()
                     sched = Scheduler(store, conf=full_conf(),
                                       elector=self._elector(
                                           store, "vk-scheduler", ident,
@@ -210,7 +216,7 @@ class ControlPlane:
         from volcano_tpu.cli.daemons import kubelet_step
 
         trace.set_component("kubelet")
-        store = RemoteStore(self.url)
+        store = self._store()
         retry = Backoff(base=0.02, cap=0.3, seed=23)
         while not self.stop.is_set():
             try:
@@ -232,7 +238,7 @@ class ControlPlane:
         while not self.stop.is_set():
             try:
                 if ctl is None:
-                    store = RemoteStore(self.url)
+                    store = self._store()
                     ctl = ElasticController(store, chaos=fault_plan)
                 ctl.pump()
                 for pool in store.list("NodePool"):
@@ -913,3 +919,178 @@ def test_chaos_soak_lease_flap_single_leader():
     lease = leases.get("vk-scheduler")
     assert lease is not None and lease.transitions >= 2, (
         f"lease never churned: {lease}")
+
+
+# -- the replication storms (repl.* faultpoints; make chaos) -------------------
+
+#: aimed at the WAL-shipping feed itself: replies cut mid-body (the
+#: follower pump's torn-tail reconnect), delay-injected feeds (lag accrues
+#: then catch-up bursts), and hard 500s (the pump's backoff path) — all
+#: while the control plane keeps writing through the leader
+PLAN_REPL_FEED_STORM = {
+    "seed": 505,
+    "rules": [
+        {"point": "repl.feed", "action": "cut_body",
+         "after": 2, "every": 3, "count": 8},
+        {"point": "repl.feed", "action": "delay", "arg": 0.2,
+         "after": 1, "every": 4, "count": 6},
+        {"point": "repl.feed", "action": "http_500",
+         "every": 5, "count": 5},
+    ],
+}
+#: armed on ONE follower: +40s skew makes its local lease copy look
+#: expired on every promotion check, so it keeps probing peers — but the
+#: live leader answers /repl/status, and the probe must refuse to promote
+#: over a living leader every single time (no double promotion)
+PLAN_REPL_LEASE_SKEW = {
+    "seed": 606,
+    "rules": [
+        {"point": "repl.lease", "action": "skew", "arg": 40.0,
+         "after": 2, "every": 1, "count": 10},
+    ],
+}
+
+
+def _repl_boot(tmp_path, name, leader=None, lease=1.0):
+    return StoreServer(
+        port=0, state_path=str(tmp_path / f"{name}.json"),
+        save_interval=3600, wal=True,
+        repl={"identity": None, "peers": [], "leader": leader,
+              "ack": "async", "lease_duration": lease},
+    ).start()
+
+
+def _wait_repl_converged(live, lead, deadline=30.0):
+    """Every live replica applied up to the leader's seq, same epoch."""
+    end = time.monotonic() + deadline
+    while time.monotonic() < end:
+        if all(s.seq >= lead.seq and s.repl.epoch == lead.repl.epoch
+               for s in live):
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        "replicas never converged: "
+        + str([(s.url, s.seq, s.repl.epoch) for s in live])
+        + f" leader {lead.url} seq={lead.seq} epoch={lead.repl.epoch}")
+
+
+def _repl_soak(tmp_path, feed_plan, kill_leader=False, skew_last=False,
+               n_jobs=3):
+    """One replication storm: a 3-replica cluster (real HTTP, own WAL
+    dirs), the standard gang workload written through peered clients,
+    repl.* faults armed over POST /chaos — optionally the leader stopped
+    mid-workload (failover) or one follower's promotion clock skewed
+    (must NOT promote over the living leader).  Asserts exactly one
+    leader, the expected promotion count, zero beacon divergence on
+    every live replica (the continuous vtaudit mirror check), identical
+    digest roots, and returns the final placements."""
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    lead = _repl_boot(tmp_path, "L")
+    f1 = _repl_boot(tmp_path, "f1", leader=lead.url)
+    f2 = _repl_boot(tmp_path, "f2", leader=lead.url)
+    servers = [lead, f1, f2]
+    urls = [s.url for s in servers]
+    for s in servers:
+        s.repl.peers = [u for u in urls if u != s.url]
+    cp = ControlPlane(lead.url, peers=urls)
+    stopped = []
+    try:
+        assert wait_healthy(lead.url, timeout=10)
+        client = RemoteStore(lead.url, peers=urls)
+        _submit(client, Queue(meta=Metadata(name="default", namespace="")),
+                kind="Queue")
+        for i in range(3):
+            _submit(client, Node(
+                meta=Metadata(name=f"n{i}", namespace=""),
+                allocatable=Resource.from_resource_list(
+                    {"cpu": "4", "memory": "8Gi", "pods": 110})), kind="Node")
+        # both followers past their bootstrap snapshot before the storm:
+        # the feed faults must hit the LIVE record tail
+        _wait_repl_converged([f1, f2], lead)
+        if skew_last:
+            _arm(f2.url, PLAN_REPL_LEASE_SKEW)
+        if feed_plan is not None:
+            _arm(lead.url, feed_plan)
+        cp.start()
+        for i in range(n_jobs):
+            _submit(client, _mk_job(f"cj{i}", 2))
+            if kill_leader and i == 0:
+                # mid-cycle leader loss: daemons and clients must
+                # refollow onto whichever follower promotes
+                lead.stop()
+                stopped.append(lead)
+                end = time.monotonic() + 30
+                while time.monotonic() < end:
+                    if any(f.repl.role == "leader" for f in (f1, f2)):
+                        break
+                    time.sleep(0.05)
+                else:
+                    raise AssertionError("no follower promoted")
+            _wait_running(client, f"soak/cj{i}", deadline=120)
+
+        live = [s for s in servers if s not in stopped]
+        leaders = [s for s in live if s.repl.role == "leader"]
+        assert len(leaders) == 1, (
+            f"leaders after the storm: {[s.url for s in leaders]}")
+        new_lead = leaders[0]
+        promotions = sum(s.repl.promotions for s in live)
+        assert promotions == (1 if kill_leader else 0), (
+            f"promotions={promotions} (kill_leader={kill_leader})")
+        if feed_plan is not None and not kill_leader:
+            status = json.load(urllib.request.urlopen(
+                lead.url + "/chaos", timeout=10))
+            assert any(r["fires"] > 0 for r in status["stats"]), (
+                "the repl.feed faults never fired")
+            _arm(lead.url, None)
+        if skew_last:
+            status = json.load(urllib.request.urlopen(
+                f2.url + "/chaos", timeout=10))
+            assert any(r["fires"] > 0 for r in status["stats"]), (
+                "the repl.lease skew never fired")
+            assert f2.repl.promotions == 0, (
+                "the skewed follower promoted over a living leader")
+            _arm(f2.url, None)
+
+        # a fresh beacon through the quiesced pipe, then full convergence
+        with new_lead.lock:
+            new_lead.stamp_beacon()
+        _wait_repl_converged(live, new_lead)
+        # continuous divergence detection: every beacon the followers
+        # mirrored through the whole storm compared equal
+        for s in live:
+            assert s.repl.divergence == 0, (
+                f"{s.url}: {s.repl.divergence} diverged beacons")
+        roots = {s.url: (s.store.digest_payload(s.shards) or {}).get("root")
+                 for s in live}
+        assert len(set(roots.values())) == 1 and None not in \
+            roots.values(), roots
+        _check_invariants(client)
+        _assert_digest_converged(new_lead)
+        return _placements(client)
+    finally:
+        cp.shutdown()
+        for s in servers:
+            if s not in stopped:
+                s.stop()
+
+
+@pytest.mark.slow
+def test_chaos_soak_repl_feed_storm_failover(tmp_path):
+    """The seeded replication failover storm: feed faults + leader loss
+    mid-workload must converge to the fault-free run's exact placements,
+    with one promotion, one surviving leader, and digest equality."""
+    baseline = _repl_soak(tmp_path / "base", None)
+    stormy = _repl_soak(tmp_path / "storm", PLAN_REPL_FEED_STORM,
+                        kill_leader=True)
+    assert stormy == baseline
+    assert len(stormy) == 6  # 3 gangs x 2 replicas, all Running
+
+
+@pytest.mark.slow
+def test_chaos_soak_repl_lease_skew_no_double_promotion(tmp_path):
+    """Feed faults plus a skewed promotion clock on one follower: its
+    lease copy looks expired throughout, but the live leader's
+    /repl/status answer must veto every promotion attempt."""
+    placements = _repl_soak(tmp_path / "skew", PLAN_REPL_FEED_STORM,
+                            skew_last=True)
+    assert len(placements) == 6

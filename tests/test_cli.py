@@ -418,3 +418,36 @@ def test_vtctl_audit_local_remote_wal_and_corruption(tmp_path, capsys):
     # a dead server is a CLI error, not a traceback
     assert main(["audit", "--server", "http://127.0.0.1:9"]) == 1
     assert "error:" in capsys.readouterr().err
+
+
+def test_vtctl_audit_remote_retries_when_state_moved_mid_walk(monkeypatch):
+    """The audit walk is not seq-pinned: a write landing mid-walk (a
+    replicated lease renewal is enough) makes a clean server look
+    diverged.  cmd_audit_remote must retry such a pass and settle on
+    the stable-seq verdict — clean if a later pass is clean, diverged
+    only when the mismatch reproduces (or moved on every pass)."""
+    from volcano_tpu.cli import vtctl
+
+    moved = ("WIRE DIGEST DIVERGENCE  wire=aa  actual=bb\n"
+             "  (state moved during audit: seq 5 -> 7; re-run to confirm)\n")
+    stable_bad = "WIRE DIGEST DIVERGENCE  wire=aa  actual=bb\n"
+    clean = "state digest OK  root=aa  seq=7  shards=1\n"
+
+    passes = iter([moved, moved, clean])
+    monkeypatch.setattr(vtctl, "_audit_remote_pass",
+                        lambda url: next(passes))
+    assert vtctl.cmd_audit_remote("http://x") == clean
+
+    # stable-seq divergence reports immediately — no retry can launder
+    # real corruption
+    calls = []
+    monkeypatch.setattr(
+        vtctl, "_audit_remote_pass",
+        lambda url: calls.append(1) or stable_bad)
+    assert vtctl.cmd_audit_remote("http://x") == stable_bad
+    assert len(calls) == 1
+
+    # moved on every pass: bounded retries, the caveat survives so the
+    # operator knows the verdict is unconfirmed
+    monkeypatch.setattr(vtctl, "_audit_remote_pass", lambda url: moved)
+    assert "state moved during audit" in vtctl.cmd_audit_remote("http://x")

@@ -1,0 +1,583 @@
+"""vtrepl: WAL-shipping replication, follower-served watches, failover.
+
+The gate for store/replica.py:
+
+  * Group-commit watermark: the feed NEVER ships a record whose fsync
+    has not landed — an unsynced append is invisible to followers until
+    its shard's synced ticket covers it.
+  * Follower replay determinism: a follower's watch stream and digest
+    root are byte-identical to the leader's (frozen uid counter + clock,
+    the PR-6 proof pattern), including across a torn feed reply
+    (``repl.feed`` cut_body) — reconnect must re-ship exactly-once.
+  * NotLeader redirects: a write against a follower 421s with the leader
+    URL; RemoteStore refollows (hint first, then peer resolution) and
+    the write lands on the leader.
+  * Sync-ack mode: the leader's 2xx waits for >=1 follower append; with
+    no follower connected the write times out into a 5xx (never a lying
+    ACK).
+  * Failover: on leader death the highest-applied follower promotes
+    (exactly one — no double promotion), pre-failover watch cursors take
+    exactly ONE StaleWatch relist and then stay incremental, and writers
+    re-resolve onto the promoted leader.
+  * THE acceptance storm (real subprocesses, real SIGKILL): a 3-replica
+    control plane in ``--repl-ack sync`` loses its leader mid-cycle; a
+    follower promotes and the run converges to placements bit-for-bit
+    equal to a fault-free run, every acked job Running, ``vtctl audit``
+    exit 0 against the promoted leader.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from volcano_tpu.api import objects as api_objects
+from volcano_tpu.api.objects import Metadata, Node, Queue
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import JobPhase
+from volcano_tpu.backoff import Backoff
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.store.client import (
+    RemoteStore,
+    RemoteStoreError,
+    StaleWatch,
+    resolve_leader,
+    wait_healthy,
+)
+from volcano_tpu.store.replica import ReplicationAckTimeout  # noqa: F401
+from volcano_tpu.store.server import StoreServer
+
+from tests.helpers import build_pod
+from tests.test_chaos_soak import (
+    TRANSIENT,
+    _check_invariants,
+    _mk_job,
+    _placements,
+    _submit,
+    _wait_running,
+)
+
+
+# -- in-process topology helpers ----------------------------------------------
+
+
+def _repl(peers=(), leader=None, ack="async", lease=5.0, identity=None):
+    return {"identity": identity, "peers": list(peers), "leader": leader,
+            "ack": ack, "lease_duration": lease}
+
+
+def _boot(tmp_path, name, leader=None, peers=(), ack="async", lease=5.0):
+    return StoreServer(
+        port=0, state_path=str(tmp_path / f"{name}.json"),
+        save_interval=3600, wal=True,
+        repl=_repl(peers=peers, leader=leader, ack=ack, lease=lease),
+    ).start()
+
+
+def _wait_caught_up(follower, leader, deadline=20.0):
+    end = time.monotonic() + deadline
+    while time.monotonic() < end:
+        if follower.seq >= leader.seq and follower.repl.epoch == \
+                leader.repl.epoch:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"follower never caught up: {follower.seq} < {leader.seq}")
+
+
+def _workload(rs):
+    """A small but surface-complete workload: per-object creates,
+    updates, patches, and one decision segment (EventLogBlock rows on
+    the log — the lazy-expansion path followers must replay)."""
+    from volcano_tpu.store.segment import DecisionSegment
+
+    rs.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+    rs.create("Node", Node(meta=Metadata(name="n0", namespace=""),
+                           allocatable=Resource.from_resource_list(
+                               {"cpu": "8", "memory": "16Gi"})))
+    for i in range(6):
+        rs.create("Pod", build_pod(f"p{i}"))
+    n = rs.get("Node", "/n0")
+    n.labels["zone"] = "z1"
+    rs.update("Node", n)
+    rs.patch("Pod", "default/p0", {"node_name": "n0"})
+    seg = DecisionSegment.build(
+        ["default/p1", "default/p2"], [0, 0], ["n0"],
+        evicts=[("default/p3", "Preempted")])
+    rs.apply_segment(seg)
+    rs.delete("Pod", "default/p5")
+
+
+# -- group-commit watermark ----------------------------------------------------
+
+
+def test_feed_never_ships_an_unfsynced_record(tmp_path):
+    """The shipping invariant, distilled: a record appended to the WAL
+    but not yet fsynced must not appear on the feed; the commit makes it
+    shippable."""
+    srv = _boot(tmp_path, "l")
+    try:
+        rs = RemoteStore(srv.url)
+        rs.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+        base = srv.seq
+        epoch = srv.repl.epoch
+
+        rec = {"op": "patch", "kind": "Queue", "key": "/q",
+               "fields": {}, "seq": base + 1}
+        ticket = srv.wal.append(rec)
+        srv.repl.log_append(rec, ticket)
+        out = srv.repl.feed(base, "", timeout=0, req_epoch=epoch)
+        assert out["records"] == []  # appended, NOT fsynced: invisible
+
+        srv.wal.commit()
+        srv.repl.on_commit()
+        out = srv.repl.feed(base, "", timeout=0, req_epoch=epoch)
+        assert [r["seq"] for r in out["records"]] == [base + 1]
+    finally:
+        srv.stop()
+
+
+# -- follower replay determinism (PR-6 frozen proof pattern) -------------------
+
+
+def _leader_follower_streams(tmp_path, monkeypatch, feed_plan=None):
+    """Run the controlled workload against a leader+follower pair (frozen
+    uid counter + clock) and return both servers' full watch streams and
+    digest roots.  ``feed_plan`` arms chaos on the leader first — the
+    torn-feed arm."""
+    monkeypatch.setattr(api_objects, "_uid_token", "t0")
+    monkeypatch.setattr(api_objects, "_uid_next", 1000)
+    monkeypatch.setattr(time, "time", lambda: 1234.5)
+    L = _boot(tmp_path, "l")
+    F = None
+    try:
+        if feed_plan is not None:
+            data = json.dumps(feed_plan).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                L.url + "/chaos", data=data, method="POST"), timeout=10)
+        F = _boot(tmp_path, "f", leader=L.url, peers=[L.url])
+        # first sync is a snapshot (fresh follower, epoch 0 vs leader's
+        # 1): the byte-identity proof covers every record REPLAYED after
+        # it — the whole workload — from the common post-sync cursor
+        _wait_caught_up(F, L)
+        cur = F.seq
+        _workload(RemoteStore(L.url))
+        _wait_caught_up(F, L)
+        evs_l = L.watch_since(cur, set(), 0)["events"]
+        evs_f = F.watch_since(cur, set(), 0)["events"]
+        root_l = (L.store.digest_payload() or {}).get("root")
+        root_f = (F.store.digest_payload() or {}).get("root")
+        return json.dumps(evs_l), json.dumps(evs_f), root_l, root_f
+    finally:
+        if F is not None:
+            F.stop()
+        L.stop()
+
+
+def test_follower_watch_stream_byte_identical(tmp_path, monkeypatch):
+    evs_l, evs_f, root_l, root_f = _leader_follower_streams(
+        tmp_path, monkeypatch)
+    assert evs_f == evs_l
+    assert root_f == root_l and root_l is not None
+    assert '"type"' in evs_l  # the streams actually carried the workload
+
+
+def test_follower_replay_survives_torn_feed_mid_stream(tmp_path, monkeypatch):
+    """Feed replies cut mid-segment (repl.feed cut_body): the follower's
+    reconnect must re-ship exactly-once — same byte-identical stream and
+    root as the clean run."""
+    plan = {"seed": 711, "rules": [
+        {"point": "repl.feed", "action": "cut_body", "every": 2,
+         "count": 4},
+    ]}
+    evs_l, evs_f, root_l, root_f = _leader_follower_streams(
+        tmp_path, monkeypatch, feed_plan=plan)
+    assert evs_f == evs_l
+    assert root_f == root_l and root_l is not None
+
+
+# -- NotLeader redirect + client refollow --------------------------------------
+
+
+def test_write_to_follower_redirects_and_lands_on_leader(tmp_path):
+    L = _boot(tmp_path, "l")
+    F = _boot(tmp_path, "f", leader=L.url, peers=[L.url])
+    try:
+        # hint-following: even a peerless client chases the 421's leader
+        # URL instead of failing the write
+        hinted = RemoteStore(F.url)
+        hinted.create("Queue", Queue(meta=Metadata(name="qa", namespace="")))
+        assert hinted.url == L.url
+
+        # peer resolution: a client with the replica set re-resolves
+        rs = RemoteStore(F.url, peers=[L.url, F.url])
+        rs.create("Queue", Queue(meta=Metadata(name="qb", namespace="")))
+        assert rs.url == L.url
+        _wait_caught_up(F, L)
+
+        # follower-served reads: list/get locally, no redirect
+        local = RemoteStore(F.url)
+        assert {q.meta.name for q in local.list("Queue")} == {"qa", "qb"}
+        assert local.url == F.url
+
+        # the redirect counter moved (process-global registry)
+        text = metrics.expose_text()
+        assert "volcano_repl_follower_redirects_total" in text
+    finally:
+        F.stop()
+        L.stop()
+
+
+# -- sync-ack mode -------------------------------------------------------------
+
+
+def test_sync_ack_blocks_until_a_follower_append(tmp_path):
+    L = _boot(tmp_path, "l", ack="sync")
+    L.repl.ack_timeout = 0.4  # fail fast: no follower will ever ack
+    F = None
+    try:
+        rs = RemoteStore(L.url, timeout=10.0)
+        with pytest.raises(RemoteStoreError):
+            rs.create("Queue", Queue(meta=Metadata(name="q0", namespace="")))
+
+        L.repl.ack_timeout = 10.0
+        F = _boot(tmp_path, "f", leader=L.url, peers=[L.url])
+        # with a live follower the 2xx waits for the ack and returns
+        rs.create("Queue", Queue(meta=Metadata(name="q1", namespace="")))
+        _wait_caught_up(F, L)
+        # the acked record is on the follower AT ack time (sync contract)
+        assert F.store.get("Queue", "/q1") is not None
+    finally:
+        if F is not None:
+            F.stop()
+        L.stop()
+
+
+# -- in-process failover -------------------------------------------------------
+
+
+def test_failover_promotes_one_follower_one_stalewatch(tmp_path):
+    L = _boot(tmp_path, "l", lease=0.8)
+    peers = [L.url]
+    F1 = _boot(tmp_path, "f1", leader=L.url, peers=peers, lease=0.8)
+    F2 = _boot(tmp_path, "f2", leader=L.url, peers=peers, lease=0.8)
+    urls = [L.url, F1.url, F2.url]
+    for s in (L, F1, F2):
+        s.repl.peers = [u for u in urls if u != s.url]
+    try:
+        rs = RemoteStore(L.url, peers=urls)
+        rs.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+        for i in range(4):
+            rs.create("Pod", build_pod(f"p{i}"))
+        _wait_caught_up(F1, L)
+        _wait_caught_up(F2, L)
+
+        watcher = RemoteStore(F1.url, peers=urls)
+        wq = watcher.watch("Pod")
+        watcher.poll()  # pin the cursor + epoch pre-failover
+
+        L.kill()
+        deadline = time.monotonic() + 20
+        promoted = None
+        while time.monotonic() < deadline and promoted is None:
+            for s in (F1, F2):
+                if s.repl.role == "leader":
+                    promoted = s
+            time.sleep(0.05)
+        assert promoted is not None, "no follower promoted"
+        other = F2 if promoted is F1 else F1
+
+        # exactly one leader; the other follower re-follows the new one
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+                other.repl.role != "follower"
+                or other.repl.leader_url != promoted.url):
+            time.sleep(0.05)
+        assert other.repl.role == "follower"
+        assert other.repl.leader_url == promoted.url
+        assert promoted.repl.epoch > 1
+
+        # writer refollows onto the promoted leader
+        rs.create("Pod", build_pod("after-failover"))
+        assert rs.url == promoted.url
+        _wait_caught_up(other, promoted)
+
+        # pre-failover watch cursor: EXACTLY one StaleWatch (the epoch
+        # fence), whose relist recovers the cursor-gap write
+        stale = 0
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and stale == 0:
+            try:
+                watcher.poll(timeout=0.5)
+            except StaleWatch:
+                stale += 1
+            except TRANSIENT:
+                time.sleep(0.05)
+        assert stale == 1, "the epoch fence never raised StaleWatch"
+        assert "after-failover" in {
+            p.meta.name for p in watcher.list("Pod")}
+        # ...and stays incremental: the next write arrives as an event,
+        # with no second relist (an escaping StaleWatch fails the test)
+        rs.create("Pod", build_pod("post-relist"))
+        deadline = time.monotonic() + 10
+        seen = False
+        while time.monotonic() < deadline and not seen:
+            try:
+                watcher.poll(timeout=0.5)
+            except TRANSIENT:
+                time.sleep(0.05)
+                continue
+            while wq:
+                seen = seen or wq.popleft().obj.meta.name == "post-relist"
+        assert seen and stale == 1
+    finally:
+        for s in (F1, F2):
+            s.stop()
+        # L was killed; reap its sockets
+        try:
+            L.stop()
+        except Exception:
+            pass
+
+
+# -- metrics exposition --------------------------------------------------------
+
+
+def test_repl_metrics_exposition(tmp_path):
+    L = _boot(tmp_path, "l")
+    F = _boot(tmp_path, "f", leader=L.url, peers=[L.url])
+    try:
+        rs = RemoteStore(L.url)
+        rs.create("Queue", Queue(meta=Metadata(name="q", namespace="")))
+        _wait_caught_up(F, L)
+        text = metrics.expose_text()
+        for name in ("volcano_repl_lag_seconds",
+                     "volcano_repl_shipped_segments_total",
+                     "volcano_repl_applied_seq",
+                     "volcano_repl_follower_redirects_total"):
+            assert f"# HELP {name}" in text, name
+            assert f"\n{name}" in text or text.startswith(name), name
+    finally:
+        F.stop()
+        L.stop()
+
+
+# -- THE acceptance storm: subprocess SIGKILL of the leader mid-cycle ----------
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _repl_status(url):
+    with urllib.request.urlopen(url + "/repl/status", timeout=10) as r:
+        return json.load(r)
+
+
+def _spawn_api(entry, env, tmp_path, name, port, peers, leader=None):
+    args = entry + ["apiserver", "--port", str(port),
+                    "--state", str(tmp_path / f"{name}.json"), "--wal",
+                    "--peers", ",".join(peers), "--repl-ack", "sync",
+                    "--lease-duration", "1.0"]
+    if leader:
+        args += ["--replica-of", leader]
+    return subprocess.Popen(args, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT, env=env)
+
+
+def _spawn_daemon(entry, comp, url, peers, env):
+    args = {"controller": ["--period", "0.05"],
+            "scheduler": ["--period", "0.1", "--metrics-port", "-1"],
+            "kubelet": ["--period", "0.05"]}[comp]
+    return subprocess.Popen(
+        entry + [comp, "--server", url, "--peers", ",".join(peers)] + args,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env)
+
+
+def _repl_storm(tmp_path, kill_leader, n_jobs=3):
+    """A 3-replica sync-ack control plane under a real workload; when
+    ``kill_leader`` the leader is SIGKILLed mid-cycle and NEVER
+    restarted — the promotion path is the only way the run converges.
+    Returns (placements, stale_count) from the surviving replicas."""
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    ports = _free_ports(3)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "VOLCANO_TPU_BACKEND": "host"}
+    env.pop("VOLCANO_TPU_CHAOS", None)
+    entry = [sys.executable, "-m", "volcano_tpu.cli"]
+
+    procs = {}
+    procs["api-0"] = _spawn_api(entry, env, tmp_path, "a", ports[0], urls)
+    assert wait_healthy(urls[0], timeout=30)
+    for i in (1, 2):
+        procs[f"api-{i}"] = _spawn_api(entry, env, tmp_path, f"f{i}",
+                                       ports[i], urls, leader=urls[0])
+    # sync-ack leader: wait for a follower to connect before writing, or
+    # the first creates burn ack-timeout windows
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            if _repl_status(urls[0])["followers"]:
+                break
+        except OSError:
+            pass
+        time.sleep(0.1)
+    else:
+        raise AssertionError("no follower ever connected to the leader")
+
+    try:
+        for comp in ("controller", "scheduler", "kubelet"):
+            procs[comp] = _spawn_daemon(entry, comp, urls[0], urls, env)
+
+        client = RemoteStore(urls[0], peers=urls)
+        for i in range(3):
+            _submit(client, Node(
+                meta=Metadata(name=f"n{i}", namespace=""),
+                allocatable=Resource.from_resource_list(
+                    {"cpu": "4", "memory": "8Gi", "pods": 110})),
+                kind="Node")
+
+        # a pre-failover watch cursor on a follower replica: it must
+        # survive the promotion with exactly one StaleWatch relist
+        watcher = RemoteStore(urls[1], peers=urls)
+        watcher.watch("Pod")
+        watcher.poll()
+        stale = 0
+
+        acked = []
+        killed = False
+        for i in range(n_jobs):
+            _submit(client, _mk_job(f"rj{i}", 2))
+            acked.append(f"soak/rj{i}")
+            if kill_leader and i == 1:
+                # SIGKILL the leader mid-cycle: daemons are pumping, the
+                # job's gang is mid-flight
+                procs["api-0"].kill()
+                procs["api-0"].wait()
+                killed = True
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                # the harness supervises DAEMONS only — a dead leader
+                # stays dead; promotion is the recovery path
+                for comp in ("controller", "scheduler", "kubelet"):
+                    if procs[comp].poll() is not None:
+                        procs[comp] = _spawn_daemon(
+                            entry, comp, urls[0] if not killed else urls[1],
+                            urls, env)
+                try:
+                    watcher.poll()
+                except StaleWatch:
+                    stale += 1
+                except TRANSIENT:
+                    pass
+                try:
+                    job = client.get("Job", f"soak/rj{i}")
+                    if job is not None and \
+                            job.status.state.phase == JobPhase.RUNNING:
+                        break
+                except TRANSIENT:
+                    pass
+                time.sleep(0.1)
+            _wait_running(client, f"soak/rj{i}", deadline=60)
+
+        live = urls if not kill_leader else urls[1:]
+        if kill_leader:
+            # single promoted leader, no double promotion, epoch advanced
+            roles = {}
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                roles = {u: _repl_status(u) for u in live}
+                if sum(1 for s in roles.values()
+                       if s["role"] == "leader") == 1:
+                    break
+                time.sleep(0.1)
+            leaders = [u for u, s in roles.items() if s["role"] == "leader"]
+            assert len(leaders) == 1, roles
+            assert all(s["epoch"] >= 2 for s in roles.values()), roles
+            assert roles[leaders[0]]["promotions"] >= 1
+            leader_url = leaders[0]
+            # the pre-failover watch survived via exactly one relist
+            assert stale == 1, f"expected exactly one StaleWatch, saw {stale}"
+        else:
+            leader_url = urls[0]
+            assert stale == 0
+
+        # zero acked loss: every acked job Running on the (new) leader
+        for key in acked:
+            job = client.get("Job", key)
+            assert job is not None
+            assert job.status.state.phase == JobPhase.RUNNING
+        _check_invariants(client)
+
+        # vtctl audit exit 0 against the promoted leader (and replicas
+        # agree on the root: mirror == store == shard rollups)
+        from volcano_tpu.cli import vtctl
+
+        assert vtctl.main(["audit", "--server", leader_url]) == 0
+
+        # replica digest equality via the beacon surface: every live
+        # replica's root matches at the same seq
+        seqs_roots = {}
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            seqs_roots = {u: _repl_status(u) for u in live}
+            if len({(s["applied"]) for s in seqs_roots.values()}) == 1:
+                break
+            time.sleep(0.1)
+        assert len({s["applied"] for s in seqs_roots.values()}) == 1, \
+            seqs_roots
+        assert all(s["divergence"] == 0 for s in seqs_roots.values()), \
+            seqs_roots
+
+        return _placements(client)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+def test_sigkill_leader_storm_zero_acked_loss(tmp_path):
+    """THE gate: kill-the-leader-mid-cycle under --repl-ack sync.  The
+    promoted follower must carry every acked write; final placements are
+    bit-for-bit equal to a fault-free run of the same workload."""
+    baseline = _repl_storm(tmp_path / "base", kill_leader=False)
+    stormy = _repl_storm(tmp_path / "storm", kill_leader=True)
+    assert stormy == baseline
+    assert len(baseline) == 6  # 3 gangs x 2 replicas, all Running
+
+
+# -- resolve_leader ------------------------------------------------------------
+
+
+def test_resolve_leader_skips_followers_and_dead_peers(tmp_path):
+    L = _boot(tmp_path, "l")
+    F = _boot(tmp_path, "f", leader=L.url, peers=[L.url])
+    try:
+        dead = "http://127.0.0.1:1"
+        assert resolve_leader([dead, F.url, L.url], timeout=15) == L.url
+        with pytest.raises(RemoteStoreError):
+            resolve_leader([dead], timeout=0.5)
+    finally:
+        F.stop()
+        L.stop()

@@ -657,6 +657,54 @@ def test_retry_backoff_elastic_near_miss(tmp_path):
     """, select=["retry-backoff"]) == []
 
 
+def test_retry_backoff_fires_in_replica_module(tmp_path):
+    # the follower pump's exact reconnect shape: the leader's feed dies
+    # mid-election and every follower re-polls — fixed-sleep retries here
+    # synchronize the whole replica fleet onto one reconnect beat
+    findings = _lint(tmp_path, "store/replica.py", """
+        import time
+
+        def pump(self):
+            while not self._stop.is_set():
+                try:
+                    self._follower_tick()
+                except OSError:
+                    time.sleep(0.25)
+    """, select=["retry-backoff"])
+    assert _rules_of(findings) == ["retry-backoff"]
+
+
+def test_retry_backoff_replica_near_misses(tmp_path):
+    # jitter-paced pump retry: the sanctioned shape store/replica.py uses
+    assert _lint(tmp_path, "store/replica.py", """
+        import time
+        from volcano_tpu.backoff import Backoff
+
+        def pump(self):
+            retry = Backoff(base=0.05, cap=2.0)
+            while not self._stop.is_set():
+                try:
+                    if self._follower_tick():
+                        retry.reset()
+                except OSError:
+                    retry.sleep()
+                    continue
+    """, select=["retry-backoff"]) == []
+    # the scope is the basename, not the store/ package: the same fixed
+    # sleep in another store module (server-side, no reconnect loops
+    # against a remote bus) stays out of scope
+    assert _lint(tmp_path, "store/server.py", """
+        import time
+
+        def pump(self):
+            while True:
+                try:
+                    self.tick()
+                except OSError:
+                    time.sleep(0.25)
+    """, select=["retry-backoff"]) == []
+
+
 def test_session_registry_scans_elastic_modules(tmp_path):
     # a (hypothetical) elastic plugin registering a typoed Session
     # callback must fire exactly as it would in scheduler/plugins/
@@ -832,15 +880,16 @@ def test_columnar_publish_near_misses_stay_quiet(tmp_path):
 
 def test_columnar_publish_suppressions_carry_justification():
     """The surviving per-op encode sites (client generic bulk, the state-
-    flush cache-miss fallback) are suppressed LINE-BY-LINE — the rule
-    still fires on any new decision loop in those files."""
+    flush cache-miss fallback, the replication snapshot's cache-miss
+    fallback) are suppressed LINE-BY-LINE — the rule still fires on any
+    new decision loop in those files."""
     import volcano_tpu
 
     pkg = os.path.dirname(os.path.abspath(volcano_tpu.__file__))
     client = open(os.path.join(pkg, "store", "client.py")).read()
     assert client.count("vtlint: disable=columnar-publish") >= 3
     server = open(os.path.join(pkg, "store", "server.py")).read()
-    assert server.count("vtlint: disable=columnar-publish") == 1
+    assert server.count("vtlint: disable=columnar-publish") == 2
 
 
 # --- rule: trace-span-discipline --------------------------------------------

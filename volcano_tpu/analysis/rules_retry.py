@@ -37,8 +37,10 @@ _TRANSIENT_NAMES = {
     "URLError", "HTTPException", "RemoteStoreError", "StaleWatch",
 }
 
-#: daemon modules the discipline applies to
-_SCOPED_BASENAMES = {"daemons.py", "leader.py", "client.py"}
+#: daemon modules the discipline applies to (replica.py: the follower
+#: pump retries the leader's feed across outages and elections — the
+#: exact reconnect-storm shape the jitter discipline exists for)
+_SCOPED_BASENAMES = {"daemons.py", "leader.py", "client.py", "replica.py"}
 
 #: daemon PACKAGES the discipline applies to wholesale: every module under
 #: cli/ (the daemon entrypoints) and elastic/ (elasticd's reconciler —

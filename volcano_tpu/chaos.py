@@ -94,6 +94,14 @@ FAULTPOINTS: Dict[str, tuple] = {
     "client.request": ("os_error", "delay"),
     "leader.clock": ("skew",),
     "elastic.provision": ("fail", "delay"),
+    # replication family (store/replica.py): torture the WAL-shipping
+    # feed and the promotion machinery.  ``repl.feed`` fires in the
+    # leader's /repl/feed handler (cut_body = feed cut mid-segment,
+    # delay = ship delay, http_500 = transient feed failure);
+    # ``repl.lease`` skews the FOLLOWER's promotion clock the same way
+    # leader.clock skews an elector (lease flap during promotion).
+    "repl.feed": ("http_500", "cut_body", "delay"),
+    "repl.lease": ("skew",),
     # crash-kill family: seeded process aborts at the moments a crash is
     # most likely to expose a durability/atomicity hole.  The only valid
     # action is ``abort`` — SIGKILL-self by default (real-subprocess
@@ -106,6 +114,7 @@ FAULTPOINTS: Dict[str, tuple] = {
     "crash.scheduler.drain": ("abort",),      # applier mid-drain, pre-ship
     "crash.controller.gang_create": ("abort",),  # gang partially created
     "crash.kubelet.ready": ("abort",),        # mid Pending->Running flip
+    "crash.replica.apply": ("abort",),        # follower mid-replay, pre-ack
 }
 
 ENV_VAR = "VOLCANO_TPU_CHAOS"
@@ -330,18 +339,20 @@ def crash_point(point: str, method: str = "", path: str = "") -> None:
 
 
 def chaos_clock(plan: FaultPlan,
-                base: Optional[Callable[[], float]] = None) -> Callable[[], float]:
+                base: Optional[Callable[[], float]] = None,
+                point: str = "leader.clock") -> Callable[[], float]:
     """A clock for LeaderElector's injectable ``clock`` parameter: reads
-    ``base`` (default ``time.monotonic``) and, when a ``leader.clock``
-    rule fires, skews the reading by ``arg`` seconds — a positive skew
-    makes every OTHER holder's lease look expired to this candidate
-    (takeover storm), a negative one makes this candidate renew with
-    timestamps in the past (its own lease flaps)."""
+    ``base`` (default ``time.monotonic``) and, when a ``point`` rule
+    (default ``leader.clock``; replicas pass ``repl.lease``) fires,
+    skews the reading by ``arg`` seconds — a positive skew makes every
+    OTHER holder's lease look expired to this candidate (takeover
+    storm), a negative one makes this candidate renew with timestamps
+    in the past (its own lease flaps)."""
     base = base or time.monotonic
 
     def clock() -> float:
         now = base()
-        rule = plan.fire("leader.clock")
+        rule = plan.fire(point)
         if rule is not None and rule.action == "skew":
             return now + rule.arg
         return now

@@ -48,6 +48,13 @@ def _maybe_debug_server(port: int, announce) -> None:
     announce(f"debug on http://127.0.0.1:{srv.port}/debug/trace", flush=True)
 
 
+def _peer_list(peers: str):
+    """``--peers`` comma list -> RemoteStore ``peers`` kwarg (None when
+    unset, so single-server deployments keep the fail-fast client)."""
+    urls = [p.strip() for p in peers.split(",") if p.strip()]
+    return urls or None
+
+
 def _elector(store, component: str, identity: str, enabled: bool):
     if not enabled:
         return None
@@ -67,6 +74,8 @@ def _elector(store, component: str, identity: str, enabled: bool):
 
 def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = True,
                   state: str = "", wal: bool = False, shards: int = 1,
+                  replica_of: str = "", peers: str = "", repl_ack: str = "",
+                  identity: str = "", lease_duration: float = 5.0,
                   announce=print) -> None:
     """``state`` names a JSON file the server persists all objects to (the
     etcd analogue): a restarted apiserver resumes with every CRD, and
@@ -77,15 +86,40 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
     by namespace hash (store/partition.py): per-shard apply locks,
     per-shard WAL directories with independent group-commit fsync, and
     ``/watch?shard=i`` fan-out — the scheduler's applier splits each
-    cycle's segment to match."""
+    cycle's segment to match.
+
+    ``replica_of=<leader url>`` boots this server as a FOLLOWER
+    (store/replica.py): it pulls the leader's synced WAL feed, replays it
+    through the recovery path, serves reads/watches locally, and rejects
+    writes with a NotLeader redirect.  ``peers`` (comma list of every
+    apiserver URL including this one) arms leader election for failover;
+    ``repl_ack=sync`` makes the leader's 2xx wait for >=1 follower append
+    (zero acked loss across a leader kill + promotion)."""
     from volcano_tpu import trace
     from volcano_tpu.api.objects import Metadata, Queue
     from volcano_tpu.store.server import StoreServer
 
     trace.set_component("apiserver")
+    peer_urls = [p.strip() for p in peers.split(",") if p.strip()]
+    repl = None
+    if replica_of or peer_urls or repl_ack:
+        repl = {
+            "identity": identity or None,
+            "peers": peer_urls,
+            "leader": replica_of or None,
+            "ack": repl_ack or "async",
+            "lease_duration": lease_duration,
+        }
+    if repl is not None and (not wal or not state):
+        raise SystemExit("replication requires --wal and --state: the feed "
+                         "ships fsynced WAL records and followers replay "
+                         "into their own WAL dirs")
     srv = StoreServer(host=host, port=port, state_path=state or None,
-                      wal=wal, shards=shards)
-    if default_queue and srv.store.get("Queue", "/default") is None:
+                      wal=wal, shards=shards, repl=repl)
+    # followers never seed: the default queue arrives via the feed (a
+    # local create would fork the lineage before the first snapshot sync)
+    if (default_queue and not replica_of
+            and srv.store.get("Queue", "/default") is None):
         srv.store.create("Queue", Queue(meta=Metadata(name="default", namespace="")))
     announce(f"apiserver listening on {srv.url}", flush=True)
 
@@ -108,7 +142,7 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
 
 def run_controller(server: str, identity: str = "", leader_elect: bool = True,
                    period: float = 0.2, announce=print,
-                   debug_port: int = -1) -> None:
+                   debug_port: int = -1, peers: str = "") -> None:
     from volcano_tpu import trace
     from volcano_tpu.controller import JobController
     from volcano_tpu.store.client import RemoteStore, StaleWatch
@@ -118,7 +152,7 @@ def run_controller(server: str, identity: str = "", leader_elect: bool = True,
     ident = identity or f"controller-{os.getpid()}"
 
     def build():
-        store = RemoteStore(server)
+        store = RemoteStore(server, peers=_peer_list(peers))
         return JobController(
             store, elector=_elector(store, "vk-controllers", ident, leader_elect)
         )
@@ -172,7 +206,8 @@ def run_controller(server: str, identity: str = "", leader_elect: bool = True,
 
 def run_scheduler(server: str, conf_path: str = "", identity: str = "",
                   leader_elect: bool = True, period: float = 1.0,
-                  metrics_port: int = 8080, announce=print) -> None:
+                  metrics_port: int = 8080, announce=print,
+                  peers: str = "") -> None:
     """schedule-period defaults to the reference's 1s and /metrics to :8080,
     as the reference binary (options.go:28,63; server.go:86-89). Pass
     metrics_port<0 to disable the endpoint, 0 for a free port."""
@@ -238,7 +273,7 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
             # is rebuilt per attempt: a failed construction would leave
             # orphaned watch queues on a shared client, buffering every
             # event forever
-            store = RemoteStore(server)
+            store = RemoteStore(server, peers=_peer_list(peers))
             sched = Scheduler(store, conf=conf,
                               elector=_elector(store, "vk-scheduler", ident,
                                                leader_elect))
@@ -337,7 +372,7 @@ def kubelet_step(store, now: float) -> None:
 
 
 def run_kubelet(server: str, period: float = 0.2, announce=print,
-                debug_port: int = -1) -> None:
+                debug_port: int = -1, peers: str = "") -> None:
     """Simulated kubelets over the remote store: bound pending pods start
     Running; pods marked deleting are reaped (the Releasing window the
     pipelined tasks wait on, SURVEY.md §3.5); Provisioning elastic nodes
@@ -354,7 +389,7 @@ def run_kubelet(server: str, period: float = 0.2, announce=print,
 
     trace.set_component("kubelet")
     _maybe_debug_server(debug_port, announce)
-    store = RemoteStore(server)
+    store = RemoteStore(server, peers=_peer_list(peers))
     announce(f"kubelet simulating against {server}", flush=True)
     transient = _transient_errors()
     down = False
@@ -377,7 +412,7 @@ def run_kubelet(server: str, period: float = 0.2, announce=print,
 
 def run_elastic(server: str, identity: str = "", leader_elect: bool = True,
                 period: float = 0.2, metrics_port: int = 8081,
-                announce=print) -> None:
+                announce=print, peers: str = "") -> None:
     """elasticd: the node-pool autoscaler daemon (volcano_tpu/elastic/).
     Leader-elected like the controller/scheduler; the VOLCANO_TPU_CHAOS
     env plan's ``elastic.provision`` rules inject provisioning
@@ -398,7 +433,7 @@ def run_elastic(server: str, identity: str = "", leader_elect: bool = True,
         else None
 
     def build():
-        store = RemoteStore(server)
+        store = RemoteStore(server, peers=_peer_list(peers))
         return ElasticController(
             store,
             elector=_elector(store, "vk-elastic", ident, leader_elect),

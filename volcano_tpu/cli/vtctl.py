@@ -448,6 +448,17 @@ def cmd_top(samples, out: Optional[io.TextIOBase] = None, n: int = 12,
                          f"fsyncs={wal.get('fsync_total')} "
                          f"fsync_s={wal.get('fsync_s')}")
             buf.write(line + "\n")
+            repl = s.get("repl")
+            if repl:
+                line = (f"repl: role={repl.get('role')} "
+                        f"epoch={repl.get('epoch')} "
+                        f"applied={repl.get('applied')}")
+                if repl.get("role") == "leader":
+                    line += (f" followers={repl.get('followers', 0)} "
+                             f"max_lag_rows={repl.get('max_lag_rows', 0)}")
+                else:
+                    line += f" lag_s={repl.get('lag_s', 0)}"
+                buf.write(line + "\n")
     text = buf.getvalue()
     if out is not None:
         out.write(text)
@@ -486,6 +497,35 @@ def _fetch_debug_prof(server_url: str) -> dict:
 def _fetch_debug_timeseries(server_url: str) -> list:
     """The remote time-series ring: GET <server>/debug/timeseries."""
     return _fetch_debug(server_url, "/debug/timeseries").get("samples") or []
+
+
+def cmd_replica_list(urls, out: Optional[io.TextIOBase] = None) -> str:
+    """One row per replica URL: role / epoch / applied seq / follower
+    ack ledger, from each server's ``/repl/status``.  Unreachable or
+    un-armed replicas render as rows too — a dead follower should be
+    VISIBLE in the panel, not silently dropped."""
+    buf = io.StringIO()
+    row = "%-28s%-10s%-7s%-10s%-9s%s\n"
+    buf.write(row % ("Replica", "Role", "Epoch", "Applied", "Unsynced",
+                     "Followers (acked/lag_rows/age_s)"))
+    for url in urls:
+        try:
+            st = _fetch_debug(url, "/repl/status")
+        except Exception as e:  # noqa: BLE001 — keep probing the rest
+            buf.write(row % (url, "down", "-", "-", "-", repr(e)))
+            continue
+        fol = st.get("followers") or {}
+        cell = " ".join(
+            f"{fid}={f.get('acked')}/{f.get('lag_rows')}/{f.get('age_s')}"
+            for fid, f in sorted(fol.items())
+        ) or "-"
+        buf.write(row % (st.get("identity", url), st.get("role", "?"),
+                         st.get("epoch", "-"), st.get("applied", "-"),
+                         st.get("unsynced", "-"), cell))
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
 
 
 # -- vtaudit: state-digest audit (volcano_tpu/vtaudit.py) ---------------------
@@ -541,13 +581,33 @@ def cmd_audit_local(store, out: Optional[io.TextIOBase] = None) -> str:
 
 
 def cmd_audit_remote(server_url: str,
-                     out: Optional[io.TextIOBase] = None) -> str:
+                     out: Optional[io.TextIOBase] = None,
+                     retries: int = 5) -> str:
     """Audit a remote store server three ways: the incrementally
     maintained /debug/digest rollups against a server-side ground-truth
     recompute of the raw objects (``?recompute=1`` — catches state
     corruption that bypassed the mutation verbs), walking
     shard -> bucket -> object on mismatch, plus a client-side recompute
-    from the wire lists (catches serving-cache / transport drift)."""
+    from the wire lists (catches serving-cache / transport drift).
+
+    The walk is not seq-pinned, so a mutation landing mid-walk — on a
+    replicated control plane a background lease renewal is enough —
+    makes a clean server look diverged.  A diverged pass that also saw
+    ``seq`` move is therefore retried (up to ``retries`` passes): only
+    divergence observed with a stable seq, or reproduced on every
+    pass, is reported."""
+    text = _audit_remote_pass(server_url)
+    for _ in range(max(1, retries) - 1):
+        if "DIVERGENCE" not in text or "state moved during audit" not in text:
+            break
+        text = _audit_remote_pass(server_url)
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def _audit_remote_pass(server_url: str) -> str:
+    """One (unpinned) audit walk — see ``cmd_audit_remote``."""
     from urllib.parse import quote
 
     from volcano_tpu import vtaudit
@@ -558,10 +618,7 @@ def cmd_audit_remote(server_url: str,
     if not dbg.get("enabled"):
         buf.write("server digest maintenance disarmed "
                   "(VOLCANO_TPU_AUDIT=0)\n")
-        text = buf.getvalue()
-        if out is not None:
-            out.write(text)
-        return text
+        return buf.getvalue()
     shards = max(1, len(dbg.get("shards") or []))
     truth = _fetch_debug(server_url, "/debug/digest?recompute=1")
     rs = RemoteStore(server_url)
@@ -624,10 +681,7 @@ def cmd_audit_remote(server_url: str,
         if seq2 != dbg.get("seq"):
             buf.write(f"  (state moved during audit: seq {dbg.get('seq')}"
                       f" -> {seq2}; re-run to confirm)\n")
-    text = buf.getvalue()
-    if out is not None:
-        out.write(text)
-    return text
+    return buf.getvalue()
 
 
 def cmd_audit_wal(wal_dir: str, state: str = "", server_url: str = "",
@@ -993,9 +1047,46 @@ def main(argv=None) -> int:
                             "apply locks, per-shard WAL files with "
                             "independent group-commit fsync, "
                             "/watch?shard=i fan-out; 1 = unpartitioned")
+    api_p.add_argument("--replica-of", default="",
+                       help="boot as a FOLLOWER of this leader URL "
+                            "(store/replica.py): pull the synced WAL "
+                            "feed, serve reads/watches locally, redirect "
+                            "writes with NotLeader; requires --wal --state")
+    api_p.add_argument("--peers", default="",
+                       help="comma list of every apiserver URL in the "
+                            "replication group (incl. this one): arms "
+                            "leader election so the highest-applied "
+                            "follower promotes on lease loss")
+    api_p.add_argument("--repl-ack", default="", choices=["", "async", "sync"],
+                       help="sync = the leader's 2xx waits for >=1 "
+                            "follower append (zero acked loss across "
+                            "failover); async = ship after ack (default)")
+    api_p.add_argument("--identity", default="",
+                       help="stable replica identity (defaults to the "
+                            "server's own URL)")
+    api_p.add_argument("--lease-duration", type=float, default=5.0,
+                       help="replication leader lease seconds (failover "
+                            "detection window)")
+
+    # replication introspection: per-follower lag/applied-seq panel
+    repl_p = sub.add_parser("replica", parents=[common],
+                            help="inspect a replication group")
+    repl_sub = repl_p.add_subparsers(dest="cmd")
+    repl_list = repl_sub.add_parser(
+        "list", parents=[common],
+        help="one row per replica: role, epoch, applied seq, lag")
+    repl_list.add_argument("--peers", default="",
+                           help="extra replica URLs to probe beside "
+                                "--server (comma list)")
+
     for comp in ("controller", "scheduler", "kubelet", "elastic"):
         p = sub.add_parser(comp, parents=[common], help=f"run the {comp} against --server")
         p.add_argument("--identity", default="")
+        p.add_argument("--peers", default="",
+                       help="comma list of replicated apiserver URLs: the "
+                            "daemon re-resolves the leader through "
+                            "wait_healthy on NotLeader/refused instead of "
+                            "failing the cycle")
         p.add_argument("--period", type=float,
                        default=1.0 if comp == "scheduler" else 0.2)
         if comp != "kubelet":
@@ -1076,6 +1167,27 @@ def main(argv=None) -> int:
         # exit 2 on divergence so scripts/CI can gate on a clean audit
         return 2 if ("DIVERGENCE" in text or "MISMATCH" in text) else 0
 
+    if args.group == "replica":
+        urls = [u for u in ([args.server] if args.server else [])
+                + [p.strip() for p in
+                   getattr(args, "peers", "").split(",") if p.strip()]]
+        # dedupe, order preserved: --server first, then --peers
+        seen: list = []
+        for u in urls:
+            u = u.rstrip("/")
+            if u not in seen:
+                seen.append(u)
+        if not seen:
+            print("error: --server (and/or --peers) is required",
+                  file=sys.stderr)
+            return 1
+        try:
+            cmd_replica_list(seen, out=sys.stdout)
+        except Exception as e:  # surface as CLI error, not traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        return 0
+
     if args.group == "up":
         from volcano_tpu.cli import daemons
 
@@ -1103,26 +1215,35 @@ def main(argv=None) -> int:
             if args.group == "apiserver":
                 daemons.run_apiserver(port=args.port, host=args.host,
                                       state=args.state, wal=args.wal,
-                                      shards=args.shards)
+                                      shards=args.shards,
+                                      replica_of=args.replica_of,
+                                      peers=args.peers,
+                                      repl_ack=args.repl_ack,
+                                      identity=args.identity,
+                                      lease_duration=args.lease_duration)
             elif args.group == "controller":
                 daemons.run_controller(args.server, identity=args.identity,
                                        leader_elect=not args.no_leader_elect,
                                        period=args.period,
-                                       debug_port=args.debug_port)
+                                       debug_port=args.debug_port,
+                                       peers=args.peers)
             elif args.group == "scheduler":
                 daemons.run_scheduler(args.server, conf_path=args.conf,
                                       identity=args.identity,
                                       leader_elect=not args.no_leader_elect,
                                       period=args.period,
-                                      metrics_port=args.metrics_port)
+                                      metrics_port=args.metrics_port,
+                                      peers=args.peers)
             elif args.group == "elastic":
                 daemons.run_elastic(args.server, identity=args.identity,
                                     leader_elect=not args.no_leader_elect,
                                     period=args.period,
-                                    metrics_port=args.metrics_port)
+                                    metrics_port=args.metrics_port,
+                                    peers=args.peers)
             else:
                 daemons.run_kubelet(args.server, period=args.period,
-                                    debug_port=args.debug_port)
+                                    debug_port=args.debug_port,
+                                    peers=args.peers)
         except KeyboardInterrupt:
             pass
         except Exception:

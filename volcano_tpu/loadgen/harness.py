@@ -153,6 +153,32 @@ class SaturationResult:
         }
 
 
+def fanout_watch_pass(url: str, cursor: int, *, timeout_s: float = 5.0):
+    """One raw ``/watch`` long-poll against ``url``; returns
+    ``(events, next_cursor, relist)``.
+
+    The cfg11 fan-out bench (bench.py --config 13) runs many reader
+    threads per process against follower replicas.  Full JSON decode of
+    every event body would make the Python client's GIL — not the
+    follower's serving path — the measured bottleneck, so this counts
+    events by scanning the raw bytes for the wire rows' ``"old"`` key
+    (every event row carries one, object encodings never do) and
+    extracts only the top-level cursor.  ``relist`` covers both the
+    explicit relist flag and the epoch fence a failover raises — either
+    way the caller restarts from the returned cursor."""
+    import re
+    import urllib.request
+
+    q = f"{url.rstrip('/')}/watch?since={cursor}&timeout={timeout_s}"
+    with urllib.request.urlopen(q, timeout=timeout_s + 10.0) as r:
+        body = r.read()
+    events = body.count(b'"old":')
+    m = re.search(rb'"next":\s*(\d+)', body)
+    nxt = int(m.group(1)) if m else cursor
+    relist = b'"relist": true' in body or b'"relist":true' in body
+    return events, nxt, relist
+
+
 def saturation_search(
     run_at: Callable[[float], SLOReport],
     base_qps: float,

@@ -429,6 +429,32 @@ def register_wal_recovery(n: int) -> None:
     inc("volcano_store_wal_recovery_replayed_records_total", float(n))
 
 
+# -- vtrepl replication series (volcano_tpu/store/replica.py) -----------------
+
+def update_repl_lag(seconds: float) -> None:
+    """Follower replication lag: 0 while caught up with the leader's
+    seq, else seconds since this follower was last caught up."""
+    set_gauge("volcano_repl_lag_seconds", seconds)
+
+
+def register_repl_shipped(n: int = 1) -> None:
+    """Synced records shipped over /repl/feed (leader side; a whole
+    decision segment is ONE record, same unit as wal_appended)."""
+    inc("volcano_repl_shipped_segments_total", float(n))
+
+
+def update_repl_applied_seq(seq: int) -> None:
+    """Newest leader seq this replica has applied — cross-replica skew
+    at a glance next to the leader's ship_seq."""
+    set_gauge("volcano_repl_applied_seq", seq)
+
+
+def register_repl_redirect(n: int = 1) -> None:
+    """Mutations rejected with a NotLeader redirect (a writer pointed at
+    a follower replica; steadily advancing = a client not refollowing)."""
+    inc("volcano_repl_follower_redirects_total", float(n))
+
+
 # -- vtdelta incremental-scheduling series (scheduler/delta/) -----------------
 
 def register_delta_micro_cycle(n: int = 1) -> None:
@@ -503,6 +529,14 @@ _HELP: Dict[str, str] = {
         "Duration of one group-commit WAL fsync in seconds",
     "volcano_store_wal_recovery_replayed_records_total":
         "WAL records replayed during crash recovery",
+    "volcano_repl_lag_seconds":
+        "Follower replication lag behind the leader in seconds",
+    "volcano_repl_shipped_segments_total":
+        "Synced WAL records shipped to followers over /repl/feed",
+    "volcano_repl_applied_seq":
+        "Newest leader sequence number applied by this replica",
+    "volcano_repl_follower_redirects_total":
+        "Writes rejected by a follower with a NotLeader redirect",
     "volcano_decision_drain_batch_seconds":
         "Wall seconds one async-applier batch took to reach the store",
     "volcano_jit_compiles_total":

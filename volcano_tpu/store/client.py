@@ -81,12 +81,33 @@ def _connection_cut(e: BaseException) -> bool:
     ))
 
 
+def _never_sent(e: BaseException) -> bool:
+    """True when the request provably never reached a server (connection
+    refused on connect): the ONLY transient after which re-issuing a
+    MUTATION is safe — anything cut later may have committed server-side,
+    and blind re-issue would double-apply."""
+    if isinstance(e, urllib.error.URLError) and not isinstance(
+            e, urllib.error.HTTPError):
+        reason = e.reason
+        if isinstance(reason, BaseException):
+            e = reason
+    return isinstance(e, ConnectionRefusedError)
+
+
 class RemoteStore:
     def __init__(self, url: str, timeout: float = 30.0,
                  chaos: Optional[FaultPlan] = None,
-                 shard: Optional[int] = None):
+                 shard: Optional[int] = None,
+                 peers: Optional[List[str]] = None):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        # replica set membership (store/replica.py): on a NotLeader
+        # redirect or a dead endpoint, _refollow re-resolves the leader
+        # across these URLs instead of failing the caller's cycle
+        self.peers = [p.rstrip("/") for p in (peers or [])]
+        #: serving epoch fence: adopted from watch responses; a change
+        #: mid-stream (failover / follower resync) raises one StaleWatch
+        self._epoch: Optional[int] = None
         # client-side fault injection (volcano_tpu/chaos.py): defaults to
         # the process-wide VOLCANO_TPU_CHAOS plan so daemon subprocesses
         # are torturable; None (the ambient case) costs one attribute
@@ -111,6 +132,37 @@ class RemoteStore:
     # -- http ----------------------------------------------------------------
 
     def _request(self, method: str, path: str, payload: Optional[dict] = None):
+        """One verb round trip with leader re-resolution: a NotLeader
+        421 (this endpoint is a follower replica) chases the redirect
+        hint; a dead endpoint re-resolves across ``peers`` — GETs
+        always, mutations only when the request provably never went out
+        (connection refused).  ``resolve_leader`` inside ``_refollow``
+        owns the decorrelated-jitter pacing."""
+        try:
+            code, body = self._request_once(method, path, payload)
+        except (OSError, http.client.HTTPException) as e:
+            if not self.peers or not (method == "GET" or _never_sent(e)):
+                raise
+            self._refollow(None)
+            return self._request_once(method, path, payload)
+        if (code == 421 and isinstance(body, dict)
+                and body.get("error") == "NotLeader"
+                and (self.peers or body.get("leader"))):
+            self._refollow(body.get("leader"))
+            return self._request_once(method, path, payload)
+        return code, body
+
+    def _refollow(self, hint: Optional[str]) -> None:
+        """Point this client at the current leader: hint first (the 421's
+        redirect), then every known peer.  Clears the cached shard count
+        — the new endpoint may be partitioned differently."""
+        urls = [hint.rstrip("/")] if hint else []
+        urls += [u for u in (self.peers + [self.url]) if u not in urls]
+        self.url = resolve_leader(urls, timeout=self.timeout)
+        self._segment_shards = None
+
+    def _request_once(self, method: str, path: str,
+                      payload: Optional[dict] = None):
         data = json.dumps(payload).encode() if payload is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
         if trace.TRACER is not None:
@@ -443,7 +495,17 @@ class RemoteStore:
         )
         if code != 200:
             raise RemoteStoreError(self._err(code, body))
-        if body.get("relist"):
+        # serving-epoch fence (replicated servers stamp one): an epoch
+        # change mid-stream — failover promotion, or this follower
+        # snapshot-resyncing under us — means the seq line may have
+        # forked, so the cursor is meaningless: ONE StaleWatch relist,
+        # then the stream continues incrementally under the new epoch
+        ep = body.get("epoch")
+        epoch_changed = (ep is not None and self._epoch is not None
+                         and ep != self._epoch)
+        if ep is not None:
+            self._epoch = ep
+        if body.get("relist") or epoch_changed:
             self._cursor = body["next"]
             raise StaleWatch("watch cursor fell off the server log; relist")
         events = body.get("events") or []
@@ -477,11 +539,15 @@ class RemoteStore:
 
 
 def wait_healthy(url: str, timeout: float = 30.0,
-                 request_timeout: float = 2.0) -> bool:
+                 request_timeout: float = 2.0,
+                 require_leader: bool = False) -> bool:
     """Deadline-bounded readiness probe: poll ``GET /healthz`` with
     jittered backoff until the server answers or ``timeout`` passes.
     Returns whether the server came up — the one health-wait the daemons
-    and tests share instead of ad-hoc polling loops."""
+    and tests share instead of ad-hoc polling loops.  With
+    ``require_leader``, a healthy FOLLOWER replica keeps the poll going
+    (its role can flip to leader mid-wait on a promotion); servers that
+    advertise no role (unreplicated) count as leaders."""
     from volcano_tpu.backoff import Backoff
 
     store = RemoteStore(url, timeout=request_timeout)
@@ -489,10 +555,38 @@ def wait_healthy(url: str, timeout: float = 30.0,
     bo = Backoff(base=0.05, cap=1.0)
     while True:
         try:
-            store.uid  # a /healthz round trip
-            return True
-        except (RemoteStoreError, OSError, http.client.HTTPException):
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                return False
-            time.sleep(min(bo.next(), remaining))
+            code, body = store._request_once("GET", "/healthz")
+            if code == 200 and (
+                not require_leader
+                or body.get("role", "leader") == "leader"
+            ):
+                return True
+        except (OSError, http.client.HTTPException):
+            pass
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        time.sleep(min(bo.next(), remaining))
+
+
+def resolve_leader(urls: List[str], timeout: float = 30.0,
+                   request_timeout: float = 2.0) -> str:
+    """The URL currently serving as leader among ``urls``: short
+    per-candidate ``wait_healthy(require_leader=True)`` probes in order,
+    decorrelated-jitter pacing between rounds (an election takes a lease
+    window to settle — every redirected writer re-probing in lockstep is
+    the herd the Backoff contract exists to break)."""
+    from volcano_tpu.backoff import Backoff
+
+    deadline = time.monotonic() + max(timeout, request_timeout)
+    bo = Backoff(base=0.05, cap=1.0)
+    while True:
+        for u in urls:
+            if wait_healthy(u, timeout=request_timeout,
+                            request_timeout=request_timeout,
+                            require_leader=True):
+                return u.rstrip("/")
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RemoteStoreError(f"no leader among {urls}")
+        time.sleep(min(bo.next(), remaining))

@@ -192,6 +192,12 @@ class ShardedWAL:
         for w in self.wals:
             w.commit()
 
+    def synced_tickets(self) -> List[int]:
+        """Per-shard fsync watermarks (see WriteAheadLog.synced_ticket):
+        the replication feed ships a record only once ITS shard's
+        watermark covers its append ticket."""
+        return [w.synced_ticket() for w in self.wals]
+
     # -- checkpoint protocol ----------------------------------------------
 
     def rotate(self) -> List[int]:

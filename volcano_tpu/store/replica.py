@@ -1,0 +1,751 @@
+"""vtrepl: WAL-shipping replication for the store bus.
+
+The segment WAL (store/wal.py) is already a physical replication log:
+every ACKed mutation is one CRC-framed wire record with seq/rv stamps.
+This module ships those records to N follower replicas over a long-poll
+``/repl/feed?from=seq`` endpoint and replays them through the SAME live
+verb paths the leader ran — so a follower's columnar caches, watch
+streams, and digest tables are byte-identical to the leader's, and the
+read side (watch fan-out, ``vtctl top``, dashboards, ``/debug/*``)
+scales horizontally while the single writer stays put.
+
+Core invariants:
+
+- **Group-commit watermark.**  A record ships only once its WAL shard's
+  fsync watermark covers its append ticket (``synced_ticket``): an
+  unfsynced record has been ACKed to nobody and must never leave the
+  process — a leader crash may legitimately lose it, and a follower
+  that replayed it would hold state the recovered leader cannot
+  reproduce.
+- **Same seq/rv line.**  Followers replay records verbatim (their own
+  WAL appends keep the leader's seq/rv stamps), and digest beacons —
+  which consume a seq but are never WAL'd — ship as synthetic
+  ``{"op": "beacon"}`` feed records so the seq lines never drift.  The
+  follower stamps its OWN digest at the beacon seq and compares roots
+  against the leader's payload: continuous replication-divergence
+  detection riding the existing vtaudit beacons.
+- **Epoch fencing.**  Every leadership (boot or promotion) bumps an
+  epoch that rides ``/healthz``, watch responses, and the feed.  An
+  epoch change means the seq line may have forked: followers resync
+  from a snapshot, and RemoteStore turns the change into ONE StaleWatch
+  relist — the failover cursor-gap contract.
+- **Failover rides LeaderElector.**  The leader renews a replicated
+  ``vt-store`` Lease through its own mutation verbs (so renewals are
+  WAL'd and shipped).  Followers watch their local copy expire; the
+  highest-``(applied_seq, identity)`` reachable candidate takes the
+  lease over via the stock ``LeaderElector`` CAS, bumps the epoch, and
+  stamps a floored checkpoint.  ``--repl-ack sync`` makes every client
+  2xx wait for >= 1 follower to append (and fsync) the record, so a
+  promoted follower provably holds every acked mutation.
+"""
+
+from __future__ import annotations
+
+import bisect
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from volcano_tpu import vtaudit
+from volcano_tpu.backoff import Backoff
+from volcano_tpu.chaos import InjectedCrash, crash_point
+from volcano_tpu.leader import LeaderElector
+from volcano_tpu.locksan import make_lock
+from volcano_tpu.store.codec import decode_fields, decode_object, encode
+from volcano_tpu.store.store import Conflict, PreconditionFailed
+
+#: the replicated leadership lease (LeaderElector name)
+LEASE_NAME = "vt-store"
+
+#: cap on retained shippable records; a follower further behind resyncs
+#: from a snapshot (the feed's "resourceVersion too old")
+REPL_LOG_CAP = 50_000
+
+#: max records per feed response (keeps one reply bounded; the follower
+#: immediately re-polls for the rest)
+FEED_BATCH = 512
+
+#: hard ceiling on one feed long-poll
+FEED_POLL_MAX = 30.0
+
+#: transients the pump retries (decorrelated-jitter Backoff, never a
+#: fixed sleep — the retry-backoff lint contract)
+_TRANSIENT = (OSError, http.client.HTTPException, ValueError)
+
+
+class ReplicationAckTimeout(RuntimeError):
+    """sync ack mode: no follower acked the record in time — the 2xx is
+    withheld (the handler's wire boundary turns this into a 5xx)."""
+
+
+def _http_json(url: str, timeout: float):
+    """One GET, JSON-decoded: ``(status, body)``.  HTTP errors return
+    their code/body like RemoteStore._request; connection errors raise."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except ValueError:
+            body = {"error": str(e)}
+        return e.code, body
+
+
+class _ServerStore:
+    """Store facade over the local StoreServer's mutation verbs, for the
+    stock LeaderElector: lease create/renew/takeover go through the
+    verbs (not raw Store calls) so they are WAL'd and replicated like
+    any client write.  Lease traffic never waits on the sync-ack barrier
+    (``_repl_sync=False``): the lease is soft state — blocking renewals
+    on follower liveness would deadlock a leader whose followers are
+    still booting."""
+
+    def __init__(self, srv):
+        self._srv = srv
+
+    def get(self, kind: str, key: str):
+        with self._srv.lock:
+            obj = self._srv.store.get(kind, key)
+        if obj is None:
+            return None
+        # wire round-trip copy: the elector mutates what it gets before
+        # its CAS — handing it the live object would let a LOST race
+        # leave an un-evented in-place edit behind
+        return decode_object(kind, encode(obj))
+
+    def create(self, kind: str, obj):
+        code, body = self._srv.create(kind, {"object": encode(obj)})
+        if code == 409:
+            raise KeyError(body.get("error", "exists"))
+        if code >= 400:
+            raise RuntimeError(body.get("error", f"http {code}"))
+        self._srv._commit_ack(_repl_sync=False)
+        return obj
+
+    def _update(self, kind: str, obj, expected_rv=None):
+        code, body = self._srv.update(kind, {"object": encode(obj)},
+                                      expected_rv=expected_rv)
+        if code == 409 and body.get("conflict"):
+            raise Conflict(body.get("error", "conflict"))
+        if code == 404:
+            raise KeyError(body.get("error", "not found"))
+        if code >= 400:
+            raise RuntimeError(body.get("error", f"http {code}"))
+        self._srv._commit_ack(_repl_sync=False)
+        return obj
+
+    def update(self, kind: str, obj):
+        return self._update(kind, obj)
+
+    def update_cas(self, kind: str, obj, expected_rv: int):
+        return self._update(kind, obj, expected_rv=expected_rv)
+
+
+class Replicator:
+    """Per-server replication state machine: the leader half (shippable
+    record log + watermark + follower ack ledger + sync-ack barrier) and
+    the follower half (feed pump, live-path replay, election/promotion).
+    One instance per StoreServer; the role flips in place on promotion."""
+
+    def __init__(self, srv, identity: Optional[str] = None,
+                 peers: Optional[List[str]] = None,
+                 leader_url: Optional[str] = None,
+                 ack: str = "async",
+                 lease_duration: float = 5.0,
+                 ack_timeout: float = 10.0):
+        if srv.wal is None:
+            raise ValueError("replication requires --wal (the WAL is the "
+                             "replication log)")
+        self.srv = srv
+        #: identity doubles as the advertised URL (lease holder == the
+        #: leader's base URL, so followers can follow the lease)
+        self.identity = (identity or srv.url).rstrip("/")
+        self.peers = [p.rstrip("/") for p in (peers or [])
+                      if p.rstrip("/") != self.identity]
+        self.role = "follower" if leader_url else "leader"
+        self.leader_url = (leader_url or self.identity).rstrip("/")
+        if ack not in ("async", "sync"):
+            raise ValueError(f"unknown repl ack mode {ack!r}")
+        self.ack = ack
+        self.ack_timeout = ack_timeout
+        self.lease_duration = lease_duration
+        # epoch: one per leadership.  A booting leader bumps past the
+        # snapshot's persisted epoch so followers of the previous life
+        # (whose applied beacons may exceed the recovered WAL) resync.
+        snap = int(getattr(srv, "_snap_repl_epoch", 0))
+        self.epoch = snap + 1 if self.role == "leader" else max(snap, 0)
+        # lock order: srv.lock may be held when taking _mu (log_append
+        # under the mutation path); _mu is NEVER held across srv.lock
+        self._mu = make_lock("Replicator._mu")
+        self._cv = threading.Condition(self._mu)      # watermark advanced
+        self._ack_cv = threading.Condition(self._mu)  # follower acks moved
+        self._pending: deque = deque()   # (seq, rec, wal_shard, ticket)
+        self._shipped_seqs: List[int] = []
+        self._shipped: List[Dict[str, Any]] = []
+        self._base_seq = srv.seq   # feedable horizon (same-epoch laggards)
+        self._ship_seq = srv.seq
+        self.acks: Dict[str, int] = {}
+        self._ack_time: Dict[str, float] = {}
+        self._tl = threading.local()
+        self.applied = srv.seq
+        self.divergence = 0
+        self.shipped_total = 0
+        self.snapshots_served = 0
+        self.promotions = 0
+        # promotion clock: wall time (lease stamps must compare across
+        # processes), chaos-skewable at the repl.lease faultpoint.  The
+        # plan is read per-call from srv.chaos so lease skew armed over
+        # POST /chaos hits a LIVE replica, like every other faultpoint
+
+        def _promo_clock() -> float:
+            now = time.time()
+            plan = self.srv.chaos
+            if plan is not None:
+                rule = plan.fire("repl.lease")
+                if rule is not None and rule.action == "skew":
+                    return now + rule.arg
+            return now
+
+        self._clock = _promo_clock
+        self._elector = LeaderElector(
+            _ServerStore(srv), LEASE_NAME, identity=self.identity,
+            lease_duration=lease_duration, clock=self._clock,
+        )
+        self._last_feed_ok = time.time()
+        self._caught_up_at = time.time()
+        self._last_leader_seq = 0  # newest leader seq seen on the feed
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- leader half: the shippable record log -----------------------------
+
+    def log_append(self, rec: Dict[str, Any], ticket: int) -> None:
+        """Track one just-WAL'd record (caller holds the server lock,
+        right after ``wal.append`` returned ``ticket``).  The record is
+        NOT yet shippable — ``on_commit`` advances the watermark once
+        its shard's fsync covers the ticket."""
+        from volcano_tpu.store.partition import wal_shard
+
+        nshards = getattr(self.srv.wal, "nshards", 1)
+        shard = wal_shard(rec, nshards) if nshards > 1 else 0
+        with self._mu:
+            self._pending.append((int(rec["seq"]), rec, shard, ticket))
+        self._tl.last_seq = int(rec["seq"])
+
+    def log_beacon(self, seq: int, payload: Dict[str, Any],
+                   ts: float) -> None:
+        """Ship a digest beacon as a synthetic feed record (caller holds
+        the server lock).  Beacons consume a seq but are never WAL'd;
+        without this, follower seq lines would drift one behind per
+        beacon and every block row after it would misalign."""
+        rec = {"op": "beacon", "seq": int(seq), "rv": self.srv.store._rv,
+               "digest": payload, "when": ts}
+        with self._mu:
+            self._pending.append((int(seq), rec, 0, None))
+            self._advance_locked(None)
+
+    def _synced_tickets(self) -> List[int]:
+        wal = self.srv.wal
+        if hasattr(wal, "synced_tickets"):
+            return wal.synced_tickets()
+        return [wal.synced_ticket()]
+
+    def _advance_locked(self, synced: Optional[List[int]]) -> None:
+        """Move the pending->shipped boundary (caller holds _mu).  The
+        shippable set is the longest PREFIX whose records are fsynced —
+        a later synced record never ships over an earlier unsynced one,
+        so followers always see a prefix of the ack history."""
+        moved = False
+        while self._pending:
+            seq, rec, shard, ticket = self._pending[0]
+            if ticket is not None:
+                if synced is None or synced[shard] < ticket:
+                    break
+            self._pending.popleft()
+            self._shipped_seqs.append(seq)
+            self._shipped.append(rec)
+            self._ship_seq = seq
+            moved = True
+        overflow = len(self._shipped) - REPL_LOG_CAP
+        if overflow > 0:
+            self._base_seq = self._shipped_seqs[overflow - 1]
+            del self._shipped_seqs[:overflow]
+            del self._shipped[:overflow]
+        if moved:
+            self._cv.notify_all()
+
+    def on_commit(self) -> None:
+        """Called after every successful group-commit fsync: recompute
+        the shipping watermark and wake feed long-polls."""
+        synced = self._synced_tickets()
+        with self._mu:
+            self._advance_locked(synced)
+
+    def sync_wait(self) -> None:
+        """The ``--repl-ack sync`` barrier, called between the WAL fsync
+        and the 2xx: block until ANY follower has acked (applied +
+        appended to its own WAL) the newest record this thread appended.
+        Stale acks can never satisfy a new record — acks are seqs and
+        new records always carry higher ones."""
+        if self.ack != "sync" or self.role != "leader":
+            return
+        target = getattr(self._tl, "last_seq", None)
+        if target is None:
+            return
+        self._tl.last_seq = None
+        deadline = time.monotonic() + self.ack_timeout
+        with self._mu:
+            while True:
+                if any(s >= target for s in self.acks.values()):
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ReplicationAckTimeout(
+                        f"no follower acked seq {target} within "
+                        f"{self.ack_timeout}s (sync ack mode)")
+                self._ack_cv.wait(remaining)
+
+    def feed(self, from_seq: int, follower_id: str, timeout: float,
+             req_epoch: Optional[int]) -> Optional[Dict[str, Any]]:
+        """Serve one ``/repl/feed`` request.  Returns None when this
+        replica is not the leader (the handler 421s with a redirect).
+        An epoch mismatch — or a cursor below the retained horizon —
+        serves a full snapshot; otherwise the synced record tail after
+        ``from_seq``, long-polling up to ``timeout`` for new records."""
+        now = time.time()
+        if follower_id:
+            with self._mu:
+                prev = self.acks.get(follower_id, -1)
+                if from_seq > prev:
+                    self.acks[follower_id] = from_seq
+                    self._ack_cv.notify_all()
+                self._ack_time[follower_id] = now
+        if self.role != "leader":
+            return None
+        if req_epoch is not None and req_epoch != self.epoch:
+            return self._feed_snapshot()
+        deadline = time.monotonic() + min(max(timeout, 0.0), FEED_POLL_MAX)
+        while True:
+            with self._mu:
+                if from_seq < self._base_seq:
+                    break  # fell off the retained log: snapshot below
+                lo = bisect.bisect_right(self._shipped_seqs, from_seq)
+                recs = self._shipped[lo:lo + FEED_BATCH]
+                if recs:
+                    self.shipped_total += len(recs)
+                    out = {
+                        "records": recs,
+                        "next": self._shipped_seqs[lo + len(recs) - 1],
+                    }
+                    self._stamp_feed(out)
+                    from volcano_tpu.scheduler import metrics
+
+                    metrics.register_repl_shipped(len(recs))
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    out = {"records": [], "next": from_seq}
+                    self._stamp_feed(out)
+                    return out
+                self._cv.wait(remaining)
+        return self._feed_snapshot()
+
+    def _stamp_feed(self, out: Dict[str, Any]) -> None:
+        out["seq"] = self.srv.seq
+        out["epoch"] = self.epoch
+        out["leader"] = self.leader_url
+        out["uid"] = self.srv.store.uid
+
+    def _feed_snapshot(self) -> Dict[str, Any]:
+        snap = self.srv.snapshot_payload()
+        self.snapshots_served += 1
+        out = {"snapshot": snap, "next": snap["seq"]}
+        self._stamp_feed(out)
+        return out
+
+    def writable(self) -> bool:
+        return self.role == "leader"
+
+    def status(self) -> Dict[str, Any]:
+        """``/repl/status`` payload — the election protocol's peer probe
+        and ``vtctl replica list``'s row source."""
+        now = time.time()
+        with self._mu:
+            followers = {
+                fid: {"acked": s,
+                      "lag_rows": max(self._ship_seq - s, 0),
+                      "age_s": round(now - self._ack_time.get(fid, now), 3)}
+                for fid, s in self.acks.items()
+            }
+            ship = self._ship_seq
+            pending = len(self._pending)
+        return {
+            "identity": self.identity, "role": self.role,
+            "epoch": self.epoch, "applied": self.srv.seq,
+            "leader": self.leader_url, "ack": self.ack,
+            "ship_seq": ship, "unsynced": pending,
+            "followers": followers, "divergence": self.divergence,
+            "shipped_total": self.shipped_total,
+            "promotions": self.promotions,
+            "uid": self.srv.store.uid,
+        }
+
+    # -- follower half: pump / replay / election ---------------------------
+
+    def start(self) -> "Replicator":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._mu:
+            self._cv.notify_all()
+            self._ack_cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        bo = Backoff(base=0.05, cap=2.0)
+        while not self._stop.is_set():
+            try:
+                if self.role == "leader":
+                    self._leader_tick()
+                    bo.reset()
+                    self._stop.wait(self.lease_duration / 3.0)
+                else:
+                    if self._follower_tick():
+                        bo.reset()
+                    else:
+                        # transient redirect / empty poll: jittered pause
+                        # (never a fixed sleep — retry-backoff contract)
+                        self._stop.wait(bo.next())
+            except InjectedCrash:
+                raise  # an armed crash must kill the pump, not retry it
+            except ReplicationAckTimeout:
+                # a leader whose followers are all down cannot renew
+                # under sync ack; pace the retry, don't die
+                self._stop.wait(bo.next())
+            except _TRANSIENT:
+                # leader unreachable / malformed reply: pace with the
+                # decorrelated-jitter backoff, then let the election
+                # check decide whether to keep following or promote
+                if self.role != "leader":
+                    self._maybe_elect()
+                self._stop.wait(bo.next())
+
+    def _leader_tick(self) -> None:
+        """Renew the replicated lease; demote if a higher epoch exists
+        (a partitioned ex-leader rejoining after a promotion)."""
+        self._elector.try_acquire()
+        lease = self._elector.store.get("Lease", f"/{LEASE_NAME}")
+        if lease is not None and lease.holder != self.identity:
+            # someone took the lease over: follow them
+            self._demote(lease.holder)
+            return
+        for peer in self.peers:
+            try:
+                code, st = _http_json(peer + "/repl/status", timeout=1.0)
+            except _TRANSIENT:
+                continue
+            if (code == 200 and st.get("role") == "leader"
+                    and int(st.get("epoch", 0)) > self.epoch):
+                self._demote(st.get("identity", peer))
+                return
+
+    def _demote(self, leader: str) -> None:
+        with self.srv.lock:
+            self.role = "follower"
+            self.leader_url = (leader or self.leader_url).rstrip("/")
+            self.srv.cond.notify_all()
+
+    def _follower_tick(self) -> bool:
+        """One feed round: long-poll the leader, replay the batch, ack
+        by advancing ``from``.  Returns whether progress was made."""
+        if self._should_elect() and self._maybe_elect():
+            return True
+        url = (f"{self.leader_url}/repl/feed?from={self.applied}"
+               f"&id={urllib.request.quote(self.identity, safe='')}"
+               f"&timeout=10&epoch={self.epoch}")
+        code, body = _http_json(url, timeout=20.0)
+        if code == 421:
+            # mid-election redirect: follow the hint next round
+            hint = body.get("leader")
+            if hint and hint.rstrip("/") != self.leader_url:
+                self.leader_url = hint.rstrip("/")
+                return True
+            return False
+        if code != 200:
+            raise OSError(f"feed http {code}: {body.get('error')}")
+        self._last_feed_ok = time.time()
+        if "snapshot" in body:
+            self._apply_snapshot(body)
+            return True
+        records = body.get("records") or []
+        for rec in records:
+            crash_point("crash.replica.apply")
+            apply_record(self.srv, self, rec)
+        if records:
+            # the ack barrier: the batch is in OUR wal before the next
+            # feed's ``from`` advances past it (sync-ack leaders count
+            # that cursor as the follower-append acknowledgment)
+            self.srv.wal.commit()
+            self.on_commit()
+        self.applied = self.srv.seq
+        resp_epoch = int(body.get("epoch", self.epoch))
+        if resp_epoch != self.epoch:
+            # leader changed epochs between our request and its reply;
+            # next round's epoch mismatch fetches the snapshot
+            self.epoch = resp_epoch
+        self._observe_lag(int(body.get("seq", self.applied)))
+        return bool(records)
+
+    def lag_seconds(self) -> float:
+        """Seconds since this follower was last caught up with the
+        leader's seq (0.0 while caught up) — the `vtctl top` panel's
+        follower cell; the gauge twin lives in _observe_lag."""
+        if self.role == "leader":
+            return 0.0
+        return max(time.time() - self._caught_up_at, 0.0) \
+            if self.applied < self._last_leader_seq else 0.0
+
+    def _observe_lag(self, leader_seq: int) -> None:
+        now = time.time()
+        self._last_leader_seq = leader_seq
+        if self.applied >= leader_seq:
+            self._caught_up_at = now
+            lag = 0.0
+        else:
+            lag = now - self._caught_up_at
+        from volcano_tpu.scheduler import metrics
+
+        metrics.update_repl_lag(lag)
+        metrics.update_repl_applied_seq(self.applied)
+
+    def _apply_snapshot(self, body: Dict[str, Any]) -> None:
+        """Full resync: replace the local store with the leader's
+        snapshot (epoch fence crossed, or we fell off the feed log).
+        Local watchers relist once — the served epoch changes with the
+        state, the same cursor-gap semantics as failover."""
+        snap = body["snapshot"]
+        srv = self.srv
+        srv.reset_from_snapshot(snap)
+        with srv.lock:
+            self.epoch = int(body.get("epoch", self.epoch))
+            with self._mu:
+                self._pending.clear()
+                del self._shipped[:]
+                del self._shipped_seqs[:]
+                self._base_seq = srv.seq
+                self._ship_seq = srv.seq
+            srv.cond.notify_all()
+        self.applied = srv.seq
+        # floored checkpoint: the snapshot is the new recovery basis —
+        # stale WAL segments from the previous epoch must not replay
+        # over it on restart
+        srv.flush_state(force=True)
+        self._observe_lag(int(body.get("seq", self.applied)))
+
+    # -- election / promotion ---------------------------------------------
+
+    def _should_elect(self) -> bool:
+        with self.srv.lock:
+            lease = self.srv.store.get("Lease", f"/{LEASE_NAME}")
+        now = self._clock()
+        if lease is not None:
+            return now - lease.renewed_at > lease.duration
+        # no lease replicated yet (fresh cluster): only feed silence
+        # longer than a lease window counts as leader loss
+        return now - self._last_feed_ok > self.lease_duration
+
+    def _maybe_elect(self) -> bool:
+        """Run one election round.  Promotion rule: among REACHABLE
+        candidates (peer /repl/status probes + self), the max
+        ``(applied_seq, identity)`` promotes — a strict total order, so
+        two mutually-reachable candidates can never both pass; the CAS
+        takeover on the replicated lease breaks any remaining race."""
+        if not self._should_elect():
+            return False
+        statuses = []
+        for peer in self.peers:
+            try:
+                code, st = _http_json(peer + "/repl/status", timeout=1.0)
+            except _TRANSIENT:
+                continue
+            if code == 200:
+                statuses.append(st)
+        live = [st for st in statuses
+                if st.get("role") == "leader"
+                and int(st.get("epoch", 0)) >= self.epoch]
+        if live:
+            # a live leader exists: adopt it and let the caller proceed
+            # to the feed — returning "promoted" here would skip the
+            # fetch, and our local lease copy only freshens THROUGH the
+            # feed (the election check would livelock on a stale lease)
+            best = max(live, key=lambda st: int(st.get("epoch", 0)))
+            self.leader_url = str(best.get("identity",
+                                           self.leader_url)).rstrip("/")
+            return False
+        cands = [(int(st.get("applied", -1)), str(st.get("identity", "")))
+                 for st in statuses]
+        cands.append((self.applied, self.identity))
+        if max(cands) != (self.applied, self.identity):
+            return False  # a better candidate is live; it will promote
+        seen_epochs = [int(st.get("epoch", 0)) for st in statuses]
+        return self._promote(seen_epochs)
+
+    def _promote(self, seen_epochs: List[int]) -> bool:
+        """Take the lease over via the stock elector (CAS on our local
+        replicated copy), bump the epoch, stamp a floored checkpoint.
+        Watchers of this replica see the epoch change on their next
+        poll and relist once (StaleWatch); followers of the dead leader
+        find us through /repl/status and snapshot-resync."""
+        if not self._elector.try_acquire():
+            return False
+        srv = self.srv
+        with srv.lock:
+            self.role = "leader"
+            self.epoch = max([self.epoch] + seen_epochs) + 1
+            self.leader_url = self.identity
+            with self._mu:
+                self._base_seq = min(self._base_seq, srv.seq)
+            self.promotions += 1
+            srv.cond.notify_all()
+        # the floored checkpoint: promotion is a durability epoch — the
+        # snapshot + rotate pins everything applied so far
+        srv.flush_state(force=True)
+        from volcano_tpu.scheduler import metrics
+
+        metrics.update_repl_applied_seq(self.applied)
+        return True
+
+
+# -- follower replay (the live-path mirror) --------------------------------
+
+
+def apply_record(srv, repl: Replicator, rec: Dict[str, Any]) -> None:
+    """Replay one shipped record through the LIVE verb paths — unlike
+    crash recovery's ``_replay_record``, this produces watch events, so
+    follower-served watch streams are byte-identical to the leader's:
+    the staged encoding hint is the leader's own restamped wire dict,
+    segments reuse the recorded stamp, and rv/seq stamps restore the
+    exact continuity line after every record."""
+    op = rec.get("op")
+    if op == "segment":
+        # the segment path manages its own shard+server locking and
+        # appends the record (with its leader stamps re-derived — the
+        # follower's seq/rv line is aligned record-by-record) to our WAL
+        srv._apply_segment(rec, stamp=rec.get("stamp"))
+        _align(srv, rec)
+        return
+    if op == "beacon":
+        _apply_beacon(srv, repl, rec)
+        return
+    kind = rec.get("kind", "")
+    store = srv.store
+    with srv.lock:
+        if op in ("create", "update"):
+            enc = rec["object"]
+            obj = decode_object(kind, enc)
+            rv = obj.meta.resource_version
+            try:
+                if op == "create":
+                    store.create(kind, obj)
+                else:
+                    store.update(kind, obj)
+            except KeyError:
+                # crossed lineage (snapshot already held a later life of
+                # the key): converge on the record's object either way
+                if op == "create":
+                    store.update(kind, obj)
+                else:
+                    store.create(kind, obj)
+            obj.meta.resource_version = rv
+            shadow = store._shadow[kind].get(obj.meta.key)
+            if shadow is not None:
+                shadow.meta.resource_version = rv
+            srv._enc_hints[(kind, obj.meta.key)] = enc
+        elif op == "patch":
+            when = rec.get("when")
+            try:
+                store.patch(
+                    kind, rec["key"],
+                    decode_fields(kind, rec.get("fields") or {}),
+                    when=decode_fields(kind, when) if when else None,
+                )
+            except (KeyError, PreconditionFailed):
+                pass  # replays exactly as it resolved on the leader
+        elif op == "patch_col":
+            cols = rec.get("columns") or {}
+            const_enc = rec.get("const") or {}
+            when = rec.get("when")
+            const = decode_fields(kind, const_enc) if const_enc else {}
+            when_dec = decode_fields(kind, when) if when else None
+            col_dec = srv._col_decoders(kind, cols)
+            for i, key in enumerate(rec.get("keys") or []):
+                fields = dict(const)
+                for f, vals in cols.items():
+                    fields[f] = col_dec[f](vals[i])
+                try:
+                    store.patch(kind, key, fields, when=when_dec)
+                except (KeyError, PreconditionFailed):
+                    pass
+        elif op == "delete":
+            store.delete(kind, rec.get("key", ""))
+        else:
+            return  # unknown op from a newer leader: skip, stay aligned
+        srv._pump_log()
+        if srv.wal is not None:
+            srv._wal_append(dict(rec))
+        _align(srv, rec)
+        srv.cond.notify_all()
+
+
+def _align(srv, rec: Dict[str, Any]) -> None:
+    """Pin the follower to the record's seq/rv stamps.  In the healthy
+    case these are no-ops (the live replay advanced both identically);
+    after a skipped/odd record they re-anchor the continuity line so
+    the next record still applies at the right position."""
+    if "seq" in rec:
+        srv.seq = max(srv.seq, int(rec["seq"]))
+    if "rv" in rec:
+        srv.store._rv = max(srv.store._rv, int(rec["rv"]))
+
+
+def _apply_beacon(srv, repl: Replicator, rec: Dict[str, Any]) -> None:
+    """Mirror a leader digest beacon: consume the same seq, stamp OUR
+    OWN digest at it (byte-identical to the leader's entry exactly when
+    the states agree), and count a divergence when the roots differ —
+    the replication integrity check riding the vtaudit beacon lane."""
+    with srv.lock:
+        srv._pump_log()
+        seq = int(rec["seq"])
+        if seq <= srv.seq:
+            return  # replayed duplicate (reconnect overlap): drop
+        own = srv.store.digest_payload(srv.shards)
+        leader_payload = rec.get("digest") or {}
+        payload = own if own is not None else leader_payload
+        if own is not None and leader_payload:
+            if own.get("root") != leader_payload.get("root"):
+                repl.divergence += 1
+                from volcano_tpu.scheduler import metrics
+
+                metrics.register_audit_divergence()
+        srv.seq = seq
+        srv._log_rows += 1
+        srv.log.append(vtaudit.beacon_entry(seq, payload,
+                                            float(rec.get("when", 0.0))))
+        srv._beacon_seq = srv.seq
+        srv._beacon_mono = time.monotonic()
+        srv._trim_log()
+        if "rv" in rec:
+            srv.store._rv = max(srv.store._rv, int(rec["rv"]))
+        srv.cond.notify_all()

@@ -89,6 +89,7 @@ class StoreServer:
         save_interval: float = 0.25,
         wal=None,
         shards: int = 1,
+        repl: Optional[Dict[str, Any]] = None,
     ):
         self.store = store or Store()
         self.admission = admission
@@ -205,6 +206,11 @@ class StoreServer:
         #: a successor is recovering from
         self._killed = False
         self._saver: Optional[threading.Thread] = None
+        # replication (store/replica.py): built AFTER recovery + the
+        # listening socket below (the identity defaults to the URL); the
+        # epoch a snapshot carries is captured during _load_snapshot
+        self.repl = None
+        self._snap_repl_epoch = 0
         # placeholder until the real watch queues register below: recovery
         # may checkpoint (the wal_floor stamp) and flush pumps this map
         self._queues: Dict[str, Any] = {}
@@ -251,9 +257,6 @@ class StoreServer:
                 )
                 if rule is None:
                     return False
-                if rule.action == "delay":
-                    time.sleep(rule.arg)
-                    return False
                 if rule.action == "truncate_log":
                     # drop the whole buffered log (seq preserved): every
                     # watcher whose cursor is behind head now falls off the
@@ -262,6 +265,19 @@ class StoreServer:
                     with server.lock:
                         del server.log[:]
                         server._log_rows = 0
+                    return False
+                return self._fault_reply(rule)
+
+            def _fault_reply(self, rule) -> bool:
+                """The request-shaped fault actions (delay / http_500 /
+                cut_body), shared by ``server.request`` and ``repl.feed``
+                — a replication feed cut mid-segment exercises the same
+                torn-reply machinery as a client watch cut.  Returns True
+                when the fault consumed the request."""
+                if rule is None:
+                    return False
+                if rule.action == "delay":
+                    time.sleep(rule.arg)
                     return False
                 if rule.action == "http_500":
                     # an unread request body would corrupt the next
@@ -308,12 +324,30 @@ class StoreServer:
                     # vtaudit state digests (vtctl audit): chaos-exempt —
                     # auditing a diverged store must work mid-storm
                     return self._reply(200, server.digest_debug(q))
+                if u.path == "/repl/status":
+                    # chaos-exempt: the election protocol probes peers
+                    # through this mid-storm — a faulted probe would read
+                    # as a dead peer and skew the promotion vote
+                    repl = server.repl
+                    if repl is None:
+                        return self._reply(
+                            404, {"error": "replication not armed"})
+                    return self._reply(200, repl.status())
+                if u.path == "/repl/feed":
+                    return self._repl_feed(q)
                 chaos_plan = server.chaos
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
                     return
                 if u.path == "/healthz":
                     payload = {"ok": True, "uid": server.store.uid,
                                "shards": server.shards}
+                    if server.repl is not None:
+                        # replicated servers advertise role/epoch so
+                        # wait_healthy(require_leader=True) can resolve
+                        # the writer and watchers can fence on failover
+                        payload["role"] = server.repl.role
+                        payload["epoch"] = server.repl.epoch
+                        payload["leader"] = server.repl.leader_url
                     with server.lock:
                         server._pump_log()
                         dg = server.store.digest_payload(server.shards)
@@ -364,6 +398,48 @@ class StoreServer:
                     return self._reply(200, {"object": encode(obj)})
                 return self._reply(404, {"error": f"no route {u.path}"})
 
+            def _repl_feed(self, q) -> None:
+                """``/repl/feed``: the replication shipping endpoint.
+                Carries its OWN faultpoint family (``repl.feed``) instead
+                of the generic request middleware, so a chaos plan can cut
+                the feed mid-segment or delay shipping without touching
+                client traffic on the same server."""
+                repl = server.repl
+                if repl is None:
+                    return self._reply(
+                        404, {"error": "replication not armed"})
+                plan = server.chaos
+                if plan is not None and self._fault_reply(
+                    plan.fire("repl.feed", method="GET", path=self.path)
+                ):
+                    return
+                out = repl.feed(
+                    int(q.get("from", ["-1"])[0]),
+                    q.get("id", [""])[0],
+                    float(q.get("timeout", ["0"])[0]),
+                    int(q["epoch"][0]) if "epoch" in q else None,
+                )
+                if out is None:
+                    return self._reply(421, {
+                        "error": "NotLeader", "leader": repl.leader_url})
+                return self._reply(200, out)
+
+            def _reject_writes(self) -> bool:
+                """NotLeader guard on every mutation verb: a follower
+                replica redirects writers to the leader with a 421 +
+                hint (RemoteStore._refollow chases it).  Runs AFTER the
+                chaos middleware — a fault plan targeting writes still
+                fires on a follower, same as any request."""
+                repl = server.repl
+                if repl is None or repl.writable():
+                    return False
+                from volcano_tpu.scheduler import metrics
+
+                metrics.register_repl_redirect()
+                self._reply(421, {
+                    "error": "NotLeader", "leader": repl.leader_url})
+                return True
+
             @_traced("POST")
             def do_POST(self):
                 u = urlparse(self.path)
@@ -377,6 +453,8 @@ class StoreServer:
                     return self._reply(200, server.chaos_status())
                 chaos_plan = server.chaos
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
+                    return
+                if self._reject_writes():
                     return
                 if u.path == "/bulk":
                     try:
@@ -404,6 +482,8 @@ class StoreServer:
                 chaos_plan = server.chaos
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
                     return
+                if self._reject_writes():
+                    return
                 if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
                     key = q.get("key", [""])[0]
                     try:
@@ -426,6 +506,8 @@ class StoreServer:
                 q = parse_qs(u.query)
                 chaos_plan = server.chaos
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
+                    return
+                if self._reject_writes():
                     return
                 if len(parts) == 2 and parts[0] == "apis":
                     cas = q.get("cas", [None])[0]
@@ -452,6 +534,8 @@ class StoreServer:
                 chaos_plan = server.chaos
                 if chaos_plan is not None and self._chaos_request(chaos_plan):
                     return
+                if self._reject_writes():
+                    return
                 if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
                     key = q.get("key", [""])[0]
                     with server.lock:
@@ -461,13 +545,27 @@ class StoreServer:
                             server._wal_append({"op": "delete",
                                                 "kind": parts[1],
                                                 "key": key})
-                    server._commit_ack()
+                    try:
+                        server._commit_ack()
+                    except Exception as e:  # noqa: BLE001 — wire boundary
+                        return self._reply(500, {"error": repr(e)})
                     return self._reply(200, {"deleted": obj is not None})
                 return self._reply(404, {"error": "no route"})
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self.url = f"http://{host}:{self.port}"
+        if repl is not None:
+            from volcano_tpu.store.replica import Replicator
+
+            self.repl = Replicator(
+                self,
+                identity=repl.get("identity"),
+                peers=repl.get("peers"),
+                leader_url=repl.get("leader"),
+                ack=repl.get("ack", "async"),
+                lease_duration=float(repl.get("lease_duration", 5.0)),
+            )
         self._thread: Optional[threading.Thread] = None
 
     # -- chaos admin (volcano_tpu/chaos.py) ------------------------------------
@@ -511,18 +609,32 @@ class StoreServer:
         ``_commit_ack``, outside the lock."""
         rec["seq"] = self.seq
         rec["rv"] = self.store._rv
-        self.wal.append(rec)
+        ticket = self.wal.append(rec)
+        if self.repl is not None:
+            # replication log entry (store/replica.py): shippable once
+            # this shard's fsync watermark covers the ticket (followers
+            # run the same path, building their own post-promotion log)
+            self.repl.log_append(rec, ticket)
+            # the record is in the ship queue — NOW a due beacon may
+            # stamp: it enqueues behind the record, so followers apply
+            # the mutations first and the digests compare at the same
+            # state (stamping before the ship, as pre-repl _pump_log
+            # did, made every segment-adjacent beacon a false divergence)
+            self._maybe_beacon()
         from volcano_tpu.scheduler import metrics
 
         metrics.register_wal_append()
 
-    def _commit_ack(self) -> None:
+    def _commit_ack(self, _repl_sync: bool = True) -> None:
         """The durability barrier between a successful mutation and its
         2xx reply: group-commit fsync the WAL tail (ACK-after-append —
         the etcd contract), then any sync-persist snapshot flush.  The
         ``crash.server.{pre,post}_fsync`` faultpoints bracket the fsync:
         a pre-fsync kill may lose the (never-ACKed) record, a post-fsync
-        kill must lose nothing."""
+        kill must lose nothing.  With replication armed, the fsync also
+        advances the shipping watermark, and in ``--repl-ack sync`` mode
+        the reply additionally waits for >= 1 follower append
+        (``_repl_sync=False`` exempts internal lease traffic)."""
         if self.wal is not None:
             plan = self.chaos
             if plan is not None:
@@ -530,6 +642,10 @@ class StoreServer:
             self.wal.commit()
             if plan is not None:
                 fire_crash(plan, "crash.server.post_fsync")
+            if self.repl is not None:
+                self.repl.on_commit()
+                if _repl_sync:
+                    self.repl.sync_wait()
         self._maybe_flush()
 
     def create(self, kind: str, data: Dict[str, Any],
@@ -745,7 +861,8 @@ class StoreServer:
         return col_dec
 
     def _apply_segment(self, op: Dict[str, Any],
-                       _in_bulk: bool = False) -> Dict[str, Any]:
+                       _in_bulk: bool = False,
+                       stamp: Optional[float] = None) -> Dict[str, Any]:
         """Apply one columnar decision segment: the whole cycle's binds,
         evicts, and their Events land under ONE lock acquisition, with no
         per-object store write, object encode, or log entry.  The store
@@ -792,7 +909,11 @@ class StoreServer:
         with shard_lock, self.lock:
             # queued per-object events must keep their place in the order
             self._pump_log()
-            stamp = time.time()
+            # stamp override: a follower replaying a shipped segment
+            # reuses the leader's recorded stamp, so its Events (and the
+            # watch stream built from them) are byte-identical
+            if stamp is None:
+                stamp = time.time()
             res = self.store.apply_segment_lazy(seg, stamp=stamp)
             plan = self.chaos
             if plan is not None:
@@ -827,7 +948,12 @@ class StoreServer:
                     for i in range(len(blk)):
                         pend[("Event", blk.key(i))] = (blk, i)
                     self._dirty_kinds.add("Event")
-            self._maybe_beacon()
+            if self.repl is None:
+                # repl leaders beacon AFTER the ship (_wal_append below):
+                # stamped here the beacon's digest already covers the
+                # segment but ships ahead of its record — a guaranteed
+                # false divergence on every follower
+                self._maybe_beacon()
             self._trim_log()
             if self.wal is not None:
                 # the WHOLE cycle is one WAL record — the wire op verbatim
@@ -876,6 +1002,11 @@ class StoreServer:
         log cannot yet reproduce, a false divergence for every verifier."""
         if self.store._digest is None:
             return False
+        if self.repl is not None and self.repl.role != "leader":
+            # followers never stamp their own beacons: the leader's ship
+            # as feed records and the follower mirrors them at the SAME
+            # seq — a locally stamped one would fork the seq line
+            return False
         if self.seq == self._beacon_seq:
             return False
         if time.monotonic() - self._beacon_mono < vtaudit.beacon_interval_s():
@@ -898,7 +1029,13 @@ class StoreServer:
             return False
         self.seq += 1
         self._log_rows += 1
-        self.log.append(vtaudit.beacon_entry(self.seq, payload, time.time()))
+        ts = time.time()
+        self.log.append(vtaudit.beacon_entry(self.seq, payload, ts))
+        if self.repl is not None:
+            # ship the beacon as a synthetic feed record: it consumed a
+            # seq, so followers must consume the same one — and mirror
+            # the digest for divergence detection (store/replica.py)
+            self.repl.log_beacon(self.seq, payload, ts)
         self._beacon_seq = self.seq
         self._beacon_mono = time.monotonic()
         self.cond.notify_all()
@@ -1183,6 +1320,9 @@ class StoreServer:
         uid = data.get("store_uid")
         if uid:
             self.store.uid = uid
+        # replication epoch continuity (store/replica.py): a booting
+        # leader bumps past this; a follower resumes its feed under it
+        self._snap_repl_epoch = int(data.get("repl_epoch", 0))
         # note: the reload happens before any watch queue is registered, so
         # the synthetic creations produce no events — clients relist
 
@@ -1382,6 +1522,59 @@ class StoreServer:
                 self._obj_enc.pop(("Pod", k), None)
             self._dirty_kinds.update(("Pod", "Event"))
 
+    def snapshot_payload(self) -> Dict[str, Any]:
+        """Full-state snapshot for a follower resync (``/repl/feed``
+        epoch mismatch or a cursor below the retained feed horizon) —
+        the same shape ``flush_state`` persists, built from the live
+        encoded caches without touching the flush's dirty-kind
+        bookkeeping (serving a snapshot must not affect checkpoints)."""
+        with self.lock:
+            self._pump_log()
+            kinds: Dict[str, List[Any]] = {}
+            enc_of = self._enc_of
+            for kind in KIND_CLASSES:
+                items = self.store.list(kind)
+                if items:
+                    kinds[kind] = [
+                        enc_of(kind, o.meta.key) or encode(o)  # vtlint: disable=columnar-publish
+                        for o in items
+                    ]
+            payload = {"seq": self.seq, "rv": self.store._rv,
+                       "store_uid": self.store.uid, "kinds": kinds}
+            if self.repl is not None:
+                payload["repl_epoch"] = self.repl.epoch
+            return payload
+
+    def reset_from_snapshot(self, snap: Dict[str, Any]) -> None:
+        """Follower resync: replace the entire store with the leader's
+        snapshot.  Every cache, queue, and log entry belongs to the
+        abandoned seq line, so everything resets; local watchers relist
+        (their cursors are from another epoch) and the caller stamps a
+        floored checkpoint so stale WAL segments never replay over the
+        adopted state."""
+        with self.lock:
+            self.store = Store()
+            self._queues = {}
+            self.log = []
+            self._log_rows = 0
+            self.seq = 0
+            self._enc_cache.clear()
+            self._obj_enc.clear()
+            self._enc_pending.clear()
+            self._enc_hints.clear()
+            self._dirty_kinds.clear()
+            self._load_snapshot(snap)
+            # everything the snapshot carries is dirty relative to the
+            # state file: the next flush must persist every kind
+            self._dirty_kinds.update(snap.get("kinds", {}))
+            self._shard_seq = [self.seq] * self.shards
+            self._beacon_seq = self.seq
+            self._beacon_mono = time.monotonic()
+            self._queues = {
+                kind: self.store.watch(kind) for kind in KIND_CLASSES
+            }
+            self.cond.notify_all()
+
     def _saver_loop(self) -> None:
         interval = max(self.save_interval, 0.05)
         while not self._saver_stop.wait(interval):
@@ -1447,6 +1640,14 @@ class StoreServer:
                            "kinds": dict(self._enc_cache)}
                 if floor is not None:
                     payload["wal_floor"] = floor
+                # persist the replication epoch (falling back to the
+                # loaded stamp while recovery flushes run before the
+                # Replicator exists); unreplicated snapshots stay
+                # byte-compatible — no key at epoch 0
+                repl_epoch = (self.repl.epoch if self.repl is not None
+                              else self._snap_repl_epoch)
+                if repl_epoch:
+                    payload["repl_epoch"] = repl_epoch
             import os
 
             # crash-safe state write: temp file, fsync, atomic rename —
@@ -1469,9 +1670,22 @@ class StoreServer:
         if timeseries.RECORDER is not None:
             # store-side time-series sample, one per flush: event-log
             # position + WAL accounting, the server half of `vtctl top`
+            repl_sample = None
+            if self.repl is not None:
+                st = self.repl.status()
+                repl_sample = {"role": st["role"], "epoch": st["epoch"],
+                               "applied": st["applied"]}
+                if st["role"] == "leader":
+                    fol = st["followers"]
+                    repl_sample["followers"] = len(fol)
+                    repl_sample["max_lag_rows"] = max(
+                        (f["lag_rows"] for f in fol.values()), default=0)
+                else:
+                    repl_sample["lag_s"] = round(self.repl.lag_seconds(), 3)
             timeseries.record(
                 "store", log_seq=self.seq, log_rows=self._log_rows,
                 wal=self.wal.stats() if self.wal is not None else None,
+                repl=repl_sample,
             )
 
     def _stage_enc_hint(self, kind: str, obj, wire: Optional[dict]) -> None:
@@ -1585,7 +1799,14 @@ class StoreServer:
                 self.log.append(entry)
                 self._shard_seq[entry.get("shard", 0)] = self.seq
                 moved = True
-        beaconed = self._maybe_beacon()
+        # with replication armed, beacons must NOT stamp here: _pump_log
+        # runs between a verb's store mutation and its _wal_append, so a
+        # beacon stamped now would ship BEFORE the record whose mutations
+        # its digest already covers — the follower, applying in ship
+        # order, would digest without those mutations and flag a false
+        # divergence.  Repl leaders stamp post-ship (_wal_append) and on
+        # the quiescent watch path instead.
+        beaconed = self._maybe_beacon() if self.repl is None else False
         self._trim_log()
         # unconsumed hints (a no-op write that produced no event) must not
         # survive to describe some LATER mutation of the key
@@ -1611,7 +1832,8 @@ class StoreServer:
             if since < self.seq - self._log_rows or since > self.seq:
                 # fell off the buffer — or the client's cursor is from
                 # before a server restart: tell it to relist
-                return {"events": None, "next": self.seq, "relist": True}
+                return self._watch_payload(
+                    {"events": None, "next": self.seq, "relist": True})
             while True:
                 log = self.log
                 # entries' seq fields (a block entry carries its LAST
@@ -1654,20 +1876,37 @@ class StoreServer:
                     start = e["start"]
                     evs.extend(blk.wire_rows(start + skip, start + n))
                 if evs or timeout <= 0:
-                    return {"events": evs, "next": self.seq}
+                    return self._watch_payload(
+                        {"events": evs, "next": self.seq})
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return {"events": [], "next": self.seq}
+                    return self._watch_payload(
+                        {"events": [], "next": self.seq})
                 self.cond.wait(remaining)
+
+    def _watch_payload(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Stamp the serving epoch onto a watch response — replicated
+        servers only (unreplicated responses stay byte-identical).  The
+        client fences on it: an epoch change mid-stream means the seq
+        line may have forked (failover, snapshot resync), and the ONLY
+        safe continuation is a relist (client.py turns it into one
+        StaleWatch)."""
+        if self.repl is not None:
+            payload["epoch"] = self.repl.epoch
+        return payload
 
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> "StoreServer":
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
+        if self.repl is not None:
+            self.repl.start()
         return self
 
     def stop(self) -> None:
+        if self.repl is not None:
+            self.repl.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
@@ -1693,6 +1932,8 @@ class StoreServer:
         on the same state/wal paths and port.)"""
         self._killed = True
         self._saver_stop.set()
+        if self.repl is not None:
+            self.repl.stop()
         # drain any flush already past the _killed guard: its os.replace
         # must land BEFORE a successor boots on these paths, or a dead
         # life's older snapshot (older wal_floor) could clobber the
@@ -1707,4 +1948,6 @@ class StoreServer:
             self.wal.kill()
 
     def serve_forever(self) -> None:
+        if self.repl is not None:
+            self.repl.start()
         self.httpd.serve_forever()

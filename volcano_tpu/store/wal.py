@@ -212,6 +212,14 @@ class WriteAheadLog:
     def append_commit(self, record: Dict[str, Any]) -> None:
         self.commit(self.append(record))
 
+    def synced_ticket(self) -> int:
+        """Highest append ticket covered by a successful fsync — the
+        replication shipping watermark (store/replica.py): a record whose
+        ticket is above this line has been ACKed to nobody and must never
+        leave the process."""
+        with self._cv:
+            return self._synced
+
     # -- checkpoint protocol ----------------------------------------------
 
     def rotate(self) -> int:
