@@ -7,7 +7,10 @@ DOCKER ?= docker
 .PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta bench-repl loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
-# runs it as a preamble so tier-1 runs can't pass with lint findings
+# runs it as a preamble so tier-1 runs can't pass with lint findings.
+# Time budget: <=15s on one core for the whole tree (currently ~7s: ~2s
+# interprocedural project-context build + ~5s file rules; `--stats`
+# prints the per-rule breakdown when the budget needs re-auditing).
 lint:
 	$(PY) -m volcano_tpu.analysis --json
 
@@ -41,11 +44,20 @@ elastic:
 	$(PY) -m pytest tests/test_elastic.py \
 	  tests/test_chaos_soak.py::test_chaos_soak_elastic_provision_failures -q
 
-# the daemons suite with the runtime lock-order sanitizer on: every lock
-# acquisition in the multi-process control plane is order-checked against
-# the acyclic graph the static `lock-order` rule proves (analysis/locksan.py)
+# two sanitizer legs, each the runtime twin of a static rule:
+#   1. lock order — every lock acquisition in the multi-process control
+#      plane is order-checked against the acyclic graph the static
+#      `lock-order` rule proves (volcano_tpu/locksan.py)
+#   2. effect order — the store/replica hot paths record the
+#      (mutate, append, beacon, ship, ack) sequence per request and any
+#      observable effect over an un-appended mutation raises at the
+#      offending site (volcano_tpu/effectsan.py, static twin
+#      `wal-effect-order`), exercised under the replication + daemons
+#      suites where the windows actually open
 sanitize:
 	VOLCANO_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_daemons.py -q
+	VOLCANO_TPU_EFFECT_SANITIZER=1 $(PY) -m pytest \
+	  tests/test_replication.py tests/test_daemons.py -q
 
 # vtrace (volcano_tpu/trace.py + tests/test_trace.py): the span runtime,
 # flight recorder, cross-daemon propagation, the armed-vs-disarmed

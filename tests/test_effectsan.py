@@ -1,0 +1,121 @@
+"""effectsan: the runtime effect-order sanitizer (volcano_tpu/effectsan.py).
+
+The dynamic twin of the static `wal-effect-order` rule: with
+VOLCANO_TPU_EFFECT_SANITIZER=1 the store/replica hot paths record the
+(mutate, append, beacon, ship, ack) sequence per thread and any
+observable effect over an un-appended mutation raises EffectOrderError
+at the offending site.  These tests drive the hooks directly with
+deliberately reordered sequences (the unit-level "reordered fixture"),
+then prove the instrumented server stays green end-to-end under the
+flag — the same legs `make sanitize` runs at full suite scale.
+"""
+
+import threading
+
+import pytest
+
+from volcano_tpu import effectsan
+from volcano_tpu.effectsan import EffectOrderError
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv(effectsan.ENV_FLAG, "1")
+    effectsan._reset()
+    yield
+    effectsan._reset()
+
+
+def test_disabled_hooks_are_noops(monkeypatch):
+    monkeypatch.delenv(effectsan.ENV_FLAG, raising=False)
+    effectsan.note_mutate("m")
+    effectsan.note_beacon("b")  # would raise if armed: mutate is pending
+    effectsan.note_ack("a")
+    assert effectsan.pending_count() == 0
+
+
+def test_canonical_order_is_clean(armed):
+    effectsan.note_mutate("StoreServer.create")
+    assert effectsan.pending_count() == 1
+    effectsan.note_append("StoreServer._wal_append")
+    assert effectsan.pending_count() == 0
+    effectsan.note_beacon("Replicator.log_beacon")
+    effectsan.note_ship("Replicator.log_append")
+    effectsan.note_ack("StoreServer._commit_ack")
+
+
+@pytest.mark.parametrize("observable,site", [
+    (effectsan.note_beacon, "Replicator.log_beacon"),
+    (effectsan.note_ship, "Replicator.log_append"),
+    (effectsan.note_ack, "StoreServer._commit_ack"),
+])
+def test_reordered_sequence_raises_at_offending_site(armed, observable, site):
+    """The deliberately reordered fixture: an observable effect fired
+    while the mutation's WAL append has not happened — the error names
+    BOTH the offending site and the un-appended mutation."""
+    effectsan.note_mutate("StoreServer.update")
+    with pytest.raises(EffectOrderError) as e:
+        observable(site)
+    msg = str(e.value)
+    assert site in msg
+    assert "StoreServer.update" in msg
+    # the raise resets the thread's state so a caught error cannot
+    # cascade into unrelated requests on the same handler thread
+    assert effectsan.pending_count() == 0
+
+
+def test_second_mutation_before_append_still_one_window(armed):
+    effectsan.note_mutate("a")
+    effectsan.note_mutate("b")
+    assert effectsan.pending_count() == 2
+    effectsan.note_append("wal")
+    assert effectsan.pending_count() == 0
+    effectsan.note_ack("ack")  # both covered by the single append
+
+
+def test_abandon_clears_pending_for_reused_handler_thread(armed):
+    """The except-Exception 500-reply shape: the failed request is never
+    acked, so its pending mutation must not leak into the next request
+    served by the same keep-alive thread."""
+    effectsan.note_mutate("StoreServer.patch")
+    effectsan.abandon("Handler.500")
+    assert effectsan.pending_count() == 0
+    effectsan.note_ack("StoreServer._commit_ack")  # next request: clean
+
+
+def test_pending_state_is_thread_local(armed):
+    effectsan.note_mutate("main-thread")
+    seen = {}
+
+    def other():
+        seen["pending"] = effectsan.pending_count()
+        effectsan.note_ack("other-thread")  # no pending HERE: clean
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert seen["pending"] == 0
+    assert effectsan.pending_count() == 1
+    effectsan.note_append("wal")
+
+
+def test_instrumented_server_is_clean_under_the_flag(monkeypatch, tmp_path):
+    """End-to-end leg: the real StoreServer's instrumented verb paths
+    (create / update / patch / delete / ack) run green with the sanitizer
+    armed — the production ordering satisfies its own runtime check."""
+    monkeypatch.setenv(effectsan.ENV_FLAG, "1")
+    from volcano_tpu.store.client import RemoteStore
+    from volcano_tpu.store.server import StoreServer
+
+    from tests.helpers import build_pod
+
+    srv = StoreServer(state_path=str(tmp_path / "state.json"),
+                      save_interval=3600, wal=True).start()
+    try:
+        rs = RemoteStore(srv.url)
+        rs.create("Pod", build_pod("p0"))
+        rs.create("Pod", build_pod("p1"))
+        rs.patch("Pod", "default/p0", {"node_name": "n0"})
+        rs.delete("Pod", "default/p1")
+    finally:
+        srv.stop()

@@ -1572,3 +1572,568 @@ def test_delta_discipline_real_package_is_clean():
         select=["delta-discipline"],
     )
     assert findings == [], "\n".join(f.human() for f in findings)
+
+
+# --- vtflow: wal-effect-order ------------------------------------------------
+
+
+def _lint_files(tmp_path, sources, select=None, worklist=False):
+    """Write a {relname: source} fixture tree and lint it as one project."""
+    paths = []
+    for relname, source in sources.items():
+        path = tmp_path / relname
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+        paths.append(str(path))
+    return run_paths(paths, root=str(tmp_path), select=select,
+                     worklist=worklist)
+
+
+def test_wal_effect_order_fires_on_beacon_before_append(tmp_path):
+    """The PR-15 regression shape: beacon stamped between the store verb
+    and the WAL append."""
+    findings = _lint(tmp_path, "store/server.py", """
+        class StoreServer:
+            def create(self, kind, obj):
+                self.store.create(kind, obj)
+                self._maybe_beacon()
+                self._wal_append({"op": "create"})
+    """, select=["wal-effect-order"])
+    assert _rules_of(findings) == ["wal-effect-order"]
+    assert findings[0].line == 5  # the beacon line, not the verb line
+
+
+def test_wal_effect_order_fires_on_composed_cross_function_ack(tmp_path):
+    """A helper whose first observable effect is an ack, called while the
+    caller holds an un-appended mutation: the finding anchors at the CALL
+    SITE (the line that composes the violation)."""
+    findings = _lint(tmp_path, "store/server.py", """
+        class StoreServer:
+            def update(self, kind, obj):
+                self.store.update(kind, obj)
+                self._finish()
+                self._wal_append({"op": "update"})
+
+            def _finish(self):
+                self._commit_ack()
+    """, select=["wal-effect-order"])
+    assert _rules_of(findings) == ["wal-effect-order"]
+    assert findings[0].line == 5  # `self._finish()` in the caller
+    assert "_finish" in findings[0].message
+
+
+def test_wal_effect_order_fires_on_exception_path_ack(tmp_path):
+    """No exception path may ack without the append: the handler inherits
+    the pending state a later statement's exception would expose."""
+    findings = _lint(tmp_path, "store/server.py", """
+        class StoreServer:
+            def patch(self, kind, obj):
+                try:
+                    self.store.patch(kind, obj)
+                    self.pump()
+                    self._wal_append({"op": "patch"})
+                except Exception:
+                    self._commit_ack()
+    """, select=["wal-effect-order"])
+    assert _rules_of(findings) == ["wal-effect-order"]
+    assert findings[0].line == 9  # the ack inside the handler
+
+
+def test_wal_effect_order_near_misses_stay_quiet(tmp_path):
+    findings = _lint(tmp_path, "store/server.py", """
+        class StoreServer:
+            def create(self, kind, obj):
+                # the canonical order: mutate -> append -> observable
+                self.store.create(kind, obj)
+                self._wal_append({"op": "create"})
+                self._maybe_beacon()
+                self._commit_ack()
+
+            def update(self, kind, obj):
+                # wal guard is configuration, not ordering: a wal-less
+                # server has no append obligation
+                self.store.update(kind, obj)
+                if self.wal is not None:
+                    self._wal_append({"op": "update"})
+                self._commit_ack()
+
+            def delete(self, kind, key):
+                # a repl-is-None beacon is local-only (the PR-15 FIX
+                # shape) — never an observable effect
+                self.store.delete(kind, key)
+                if self.repl is None:
+                    self._maybe_beacon()
+                self._wal_append({"op": "delete"})
+    """, select=["wal-effect-order"])
+    assert findings == []
+
+
+def test_wal_effect_order_materialize_is_exempt(tmp_path):
+    """Materialization folds state the staging path already logged — a
+    reader calling it then replying 200 is not an ordering bug."""
+    findings = _lint(tmp_path, "store/server.py", """
+        class StoreServer:
+            def _materialize(self, kind):
+                self.store.update(kind, None)
+
+            def do_GET(self):
+                self._materialize("Pod")
+                self._reply(200, {})
+    """, select=["wal-effect-order"])
+    assert findings == []
+
+
+def test_wal_effect_order_out_of_scope_module_stays_quiet(tmp_path):
+    findings = _lint(tmp_path, "elastic/daemon.py", """
+        class Daemon:
+            def create(self, kind, obj):
+                self.store.create(kind, obj)
+                self._maybe_beacon()
+                self._wal_append({"op": "create"})
+    """, select=["wal-effect-order"])
+    assert findings == []
+
+
+def test_wal_effect_order_caller_vs_callee_suppression(tmp_path):
+    """Composed findings anchor at the caller's call site; a disable at
+    the callee's effect line must NOT suppress them (the callee is
+    innocent alone — the composition is the bug)."""
+    src_callee_disabled = """
+        class StoreServer:
+            def update(self, kind, obj):
+                self.store.update(kind, obj)
+                self._finish()
+                self._wal_append({"op": "update"})
+
+            def _finish(self):
+                self._commit_ack()  # vtlint: disable=wal-effect-order
+    """
+    findings = _lint(tmp_path, "store/server.py", src_callee_disabled,
+                     select=["wal-effect-order"])
+    assert _rules_of(findings) == ["wal-effect-order"]
+
+    src_caller_disabled = """
+        class StoreServer:
+            def update(self, kind, obj):
+                self.store.update(kind, obj)
+                self._finish()  # vtlint: disable=wal-effect-order
+                self._wal_append({"op": "update"})
+
+            def _finish(self):
+                self._commit_ack()
+    """
+    findings = _lint(tmp_path / "b", "store/server.py",
+                     src_caller_disabled, select=["wal-effect-order"])
+    assert findings == []
+
+
+def test_file_level_suppression_of_interprocedural_rule(tmp_path):
+    """A file-wide disable covers project-scope findings anchored in that
+    file, exactly like file-scope findings."""
+    findings = _lint(tmp_path, "store/server.py", """
+        # ordering asserted by the runtime sanitizer instead:
+        # vtlint: disable=wal-effect-order
+        class StoreServer:
+            def create(self, kind, obj):
+                self.store.create(kind, obj)
+                self._maybe_beacon()
+                self._wal_append({"op": "create"})
+    """, select=["wal-effect-order"])
+    assert findings == []
+
+
+def test_trailing_disable_inside_multiline_statement(tmp_path):
+    """A disable trailing ANY physical line of a multi-line statement
+    covers the whole logical line — findings anchor at the statement's
+    first line, so a closing-paren disable still suppresses them."""
+    findings = _lint(tmp_path, "store/locks.py", """
+        import threading
+
+        LOCK = threading.Lock(
+        )  # vtlint: disable=lock-factory
+    """, select=["lock-factory"])
+    assert findings == []
+    # and the near-miss: the NEXT statement is outside the logical line
+    findings = _lint(tmp_path / "b", "store/locks.py", """
+        import threading
+
+        A = threading.Lock(
+        )  # vtlint: disable=lock-factory
+        B = threading.Lock()
+    """, select=["lock-factory"])
+    assert len(findings) == 1
+    assert findings[0].line == 6
+
+
+# --- vtflow: late-binding ----------------------------------------------------
+
+
+def test_late_binding_fires_on_attribute_capture(tmp_path):
+    """The PR-15 Replicator bug shape: another component's chaos plan
+    frozen into an attribute at construction time."""
+    findings = _lint(tmp_path, "store/replica.py", """
+        class Replicator:
+            def __init__(self, srv):
+                self.plan = srv.chaos
+    """, select=["late-binding"])
+    assert _rules_of(findings) == ["late-binding"]
+    assert "chaos" in findings[0].message
+
+
+def test_late_binding_fires_on_closure_default_and_guarded_capture(tmp_path):
+    findings = _lint(tmp_path, "store/replica.py", """
+        class Replicator:
+            def __init__(self, srv, follow):
+                def loop(plan=srv.chaos):
+                    return plan
+                self.loop = loop
+                if follow:
+                    self.targets = srv.peers
+    """, select=["late-binding"])
+    assert _rules_of(findings) == ["late-binding", "late-binding"]
+    assert "default" in findings[0].message  # closure-default freeze
+    assert "peers" in findings[1].message    # capture under an `if`
+
+
+def test_late_binding_fires_through_self_chain(tmp_path):
+    """`self.srv.chaos` at construction time is still another object's
+    late state — only BARE self attributes are own-state."""
+    findings = _lint(tmp_path, "store/replica.py", """
+        class Replicator:
+            def __init__(self, srv):
+                self.srv = srv
+                self.plan = self.srv.chaos
+    """, select=["late-binding"])
+    assert _rules_of(findings) == ["late-binding"]
+
+
+def test_late_binding_near_misses_stay_quiet(tmp_path):
+    findings = _lint(tmp_path, "store/replica.py", """
+        class Replicator:
+            def __init__(self, srv):
+                # the FIX shape: store the owning object, read per call
+                self.srv = srv
+                # own construction is ownership, not capture
+                self.chaos = build_plan()
+                # bare self attribute: own state
+                self.role = self.role_hint
+
+            def tick(self):
+                # method bodies run per call — late by construction
+                plan = self.srv.chaos
+                return plan
+
+            def arm(self):
+                # nested-def BODIES are exempt (they run later)
+                def loop():
+                    return self.srv.peers
+                return loop
+    """, select=["late-binding"])
+    assert findings == []
+
+
+# --- vtflow: proc-isolation --------------------------------------------------
+
+
+def test_proc_isolation_fires_on_global_mutated_from_verb_path(tmp_path):
+    """A module-level mutable written by a helper the verb path reaches:
+    in one process shared-for-free, across processes silently forked."""
+    findings = _lint(tmp_path, "store/server.py", """
+        _CACHE = {}
+
+        class StoreServer:
+            def do_POST(self):
+                self._handle("Pod")
+
+            def _handle(self, kind):
+                _CACHE[kind] = 1
+    """, select=["proc-isolation"])
+    assert _rules_of(findings) == ["proc-isolation"]
+    assert "_CACHE" in findings[0].message
+    assert findings[0].line == 9
+
+
+def test_proc_isolation_fires_on_cross_shard_fanout(tmp_path):
+    findings = _lint(tmp_path, "store/server.py", """
+        class StoreServer:
+            def _append_block(self, blk):
+                for s in range(self.shards):
+                    self._shard_seq[s] = self.seq
+    """, select=["proc-isolation"])
+    assert _rules_of(findings) == ["proc-isolation"]
+    assert "cross-shard" in findings[0].message
+
+
+def test_proc_isolation_fires_on_unlocked_rmw(tmp_path):
+    findings = _lint(tmp_path, "store/server.py", """
+        from volcano_tpu.locksan import make_lock
+
+        class StoreServer:
+            def __init__(self):
+                self.lock = make_lock("srv")
+                self.seq = 0
+
+            def do_POST(self):
+                self.seq += 1
+    """, select=["proc-isolation"])
+    assert _rules_of(findings) == ["proc-isolation"]
+    assert "read-modify-write" in findings[0].message
+
+
+def test_proc_isolation_near_misses_stay_quiet(tmp_path):
+    findings = _lint(tmp_path, "store/server.py", """
+        from volcano_tpu.locksan import make_lock
+
+        _CACHE = {}
+
+        class StoreServer:
+            def __init__(self):
+                self.lock = make_lock("srv")
+                self.seq = 0
+                # construction is single-threaded by contract
+                self.seq += 1
+
+            def do_POST(self):
+                with self.lock:
+                    self.seq += 1       # locked RMW
+                    self._bump()        # called-locked helper
+
+            def _bump(self):
+                self.seq += 1
+
+            def do_GET(self):
+                self._tl.hits += 1      # thread-local by construction
+
+            def _load_wal(self):
+                # recovery entry points are single-threaded by contract
+                self.seq += 1
+                _CACHE["recovered"] = 1
+
+            def _unreachable_helper(self):
+                # not reachable from any verb: globals check is scoped to
+                # the verb-reachable set
+                _CACHE["x"] = 1
+    """, select=["proc-isolation"])
+    assert findings == []
+
+
+def test_proc_isolation_out_of_seam_stays_quiet(tmp_path):
+    findings = _lint(tmp_path, "scheduler/cache.py", """
+        _CACHE = {}
+
+        class Cache:
+            def do_POST(self):
+                _CACHE["x"] = 1
+    """, select=["proc-isolation"])
+    assert findings == []
+
+
+# --- vtflow: digest-reachability ---------------------------------------------
+
+
+def test_digest_reachability_fires_across_files(tmp_path):
+    """A helper OUTSIDE the store module set, reached from an HTTP verb,
+    mutating a digested container with no digest touch anywhere in its
+    transitive effect set — invisible to per-file digest-maintenance."""
+    findings = _lint_files(tmp_path, {
+        "store/server.py": """
+            from fixup import repair
+
+            class StoreServer:
+                def do_POST(self):
+                    repair(self.store, "Pod")
+        """,
+        "fixup.py": """
+            def repair(store, kind):
+                store._objects[kind] = {}
+        """,
+    }, select=["digest-reachability"])
+    assert _rules_of(findings) == ["digest-reachability"]
+    assert findings[0].path == "fixup.py"
+
+
+def test_digest_reachability_near_misses_stay_quiet(tmp_path):
+    findings = _lint_files(tmp_path, {
+        "store/server.py": """
+            from fixup import repair, compact
+
+            class StoreServer:
+                def do_POST(self):
+                    repair(self.store, "Pod")
+                    compact(self.store)
+        """,
+        "fixup.py": """
+            def repair(store, kind):
+                # digest folded under the same hold: transitive effect
+                # set includes the digest touch
+                store._objects[kind] = {}
+                store._digest.fold(kind)
+
+            def compact(store):
+                # no digested-container mutation at all
+                store.note = 1
+
+            def _orphan(store):
+                # mutates, but NOTHING reachable from a verb calls it
+                store._objects["X"] = {}
+        """,
+    }, select=["digest-reachability"])
+    assert findings == []
+
+
+# --- lock-factory ------------------------------------------------------------
+
+
+def test_lock_factory_fires_on_raw_locks_in_daemon_modules(tmp_path):
+    findings = _lint(tmp_path, "elastic/daemon.py", """
+        import threading
+
+        class Daemon:
+            def __init__(self):
+                self.mu = threading.Lock()
+                self.cv = threading.Condition()
+    """, select=["lock-factory"])
+    assert _rules_of(findings) == ["lock-factory", "lock-factory"]
+    assert "make_lock" in findings[0].message
+    assert "hidden RLock" in findings[1].message
+
+
+def test_lock_factory_near_misses_stay_quiet(tmp_path):
+    findings = _lint(tmp_path, "admission/daemons.py", """
+        import threading
+        from volcano_tpu.locksan import make_lock
+
+        class Daemon:
+            def __init__(self):
+                self.mu = make_lock("adm")
+                # Condition over an existing factory lock wraps an
+                # already-visible lock
+                self.cv = threading.Condition(self.mu)
+    """, select=["lock-factory"])
+    assert findings == []
+    # outside the sanitizer-scoped module set raw locks are fine
+    findings = _lint(tmp_path / "b", "scheduler/metrics.py", """
+        import threading
+        MU = threading.Lock()
+    """, select=["lock-factory"])
+    assert findings == []
+
+
+# --- worklist mode, stats, determinism ---------------------------------------
+
+
+def test_worklist_keeps_suppressed_findings_with_justification(tmp_path):
+    findings = _lint_files(tmp_path, {
+        "store/server.py": """
+            class StoreServer:
+                def _append_block(self, blk):
+                    for s in range(self.shards):
+                        # in-process broadcast, deferred to ROADMAP 1
+                        self._shard_seq[s] = 0  # vtlint: disable=proc-isolation
+        """,
+    }, select=["proc-isolation"], worklist=True)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.suppressed
+    assert "proc-isolation" in f.justification
+    assert "[suppressed]" in f.human()
+    # without worklist the suppressed finding disappears entirely
+    findings = _lint_files(tmp_path, {
+        "store/server2.py": """
+            class StoreServer:
+                def _append_block(self, blk):
+                    for s in range(self.shards):
+                        self._shard_seq[s] = 0  # vtlint: disable=proc-isolation
+        """,
+    }, select=["proc-isolation"])
+    assert findings == []
+
+
+def test_worklist_cli_exit_zero_when_all_suppressed(tmp_path):
+    import json as _json
+
+    bad = tmp_path / "store" / "server.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        class StoreServer:
+            def _append_block(self, blk):
+                for s in range(self.shards):
+                    self._shard_seq[s] = 0  # vtlint: disable=proc-isolation
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis", "--json", "--worklist",
+         "--select", "proc-isolation", "--root", str(tmp_path), str(bad)],
+        capture_output=True, text=True, env=env,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr  # suppressed-only: clean
+    report = _json.loads(r.stdout)
+    assert report["live_count"] == 0
+    assert report["suppressed_count"] == 1
+    assert report["findings"][0]["suppressed"] is True
+
+
+def test_stats_reports_per_rule_counts_and_time(tmp_path):
+    import json as _json
+
+    bad = tmp_path / "store" / "server.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        class StoreServer:
+            def create(self, kind, obj):
+                self.store.create(kind, obj)
+                self._maybe_beacon()
+                self._wal_append({"op": "create"})
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis", "--json", "--stats",
+         "--select", "wal-effect-order", "--root", str(tmp_path), str(bad)],
+        capture_output=True, text=True, env=env,
+    )
+    report = _json.loads(r.stdout)
+    stats = report["stats"]
+    assert stats["files"] == 1
+    assert stats["total_s"] >= 0
+    assert stats["project_build_s"] >= 0
+    row = stats["rules"]["wal-effect-order"]
+    assert row["findings"] == 1
+    assert row["time_s"] >= 0
+
+
+def test_finding_order_is_deterministic(tmp_path):
+    """Findings sort by (path, line, rule, message) — two runs over the
+    same tree produce byte-identical output."""
+    sources = {
+        "store/server.py": """
+            _CACHE = {}
+
+            class StoreServer:
+                def do_POST(self):
+                    self.store.create("Pod", None)
+                    self._maybe_beacon()
+                    self._wal_append({})
+                    _CACHE["x"] = 1
+        """,
+        "store/replica.py": """
+            class Replicator:
+                def __init__(self, srv):
+                    self.plan = srv.chaos
+        """,
+    }
+    first = _lint_files(tmp_path, sources)
+    second = _lint_files(tmp_path, sources)
+    assert first == second
+    assert len(first) >= 3
+    keys = [(f.path, f.line, f.rule, f.message) for f in first]
+    assert keys == sorted(keys)
+
+
+def test_registered_rule_count_floor():
+    """ISSUE 16 acceptance: >=26 rules active, the four vtflow rules and
+    lock-factory among them."""
+    rules = all_rules()
+    assert len(rules) >= 26, sorted(rules)
+    for rid in ("wal-effect-order", "late-binding", "proc-isolation",
+                "digest-reachability", "lock-factory"):
+        assert rid in rules, rid

@@ -33,6 +33,14 @@ def main(argv=None) -> int:
                     help="comma-separated rule ids to run (default: all)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--stats", action="store_true",
+                    help="per-rule finding counts and wall time (stderr "
+                         "table, or a \"stats\" key with --json)")
+    ap.add_argument("--worklist", action="store_true",
+                    help="keep suppressed findings in the output, marked "
+                         "suppressed with the justifying comment attached "
+                         "— the machine-checked deferred-work inventory "
+                         "(suppressed-only findings do not fail the run)")
     ns = ap.parse_args(argv)
 
     rules = all_rules()
@@ -52,18 +60,27 @@ def main(argv=None) -> int:
         print(f"vtlint: no such path(s): {', '.join(missing)}", file=sys.stderr)
         return 2
     select = [s.strip() for s in ns.select.split(",")] if ns.select else None
+    stats = {} if ns.stats else None
     try:
-        findings = run_paths(paths, root=ns.root, select=select)
+        findings = run_paths(paths, root=ns.root, select=select,
+                             worklist=ns.worklist, stats=stats)
     except ValueError as e:
         print(f"vtlint: {e}", file=sys.stderr)
         return 2
 
+    live = [f for f in findings if not f.suppressed]
     if ns.as_json:
-        print(json.dumps({
+        report = {
             "findings": [f.as_dict() for f in findings],
             "count": len(findings),
             "rules": sorted(rules if select is None else select),
-        }, indent=2))
+        }
+        if ns.worklist:
+            report["live_count"] = len(live)
+            report["suppressed_count"] = len(findings) - len(live)
+        if stats is not None:
+            report["stats"] = stats
+        print(json.dumps(report, indent=2))
     else:
         for f in findings:
             print(f.human())
@@ -71,7 +88,21 @@ def main(argv=None) -> int:
         print(f"vtlint: {len(findings)} finding(s) "
               f"({n_rules} rule(s) active)",
               file=sys.stderr)
-    return 1 if findings else 0
+        if stats is not None:
+            print(f"vtlint: {stats['files']} file(s) in "
+                  f"{stats['total_s']:.2f}s (project context: "
+                  f"{stats['project_build_s']:.2f}s)", file=sys.stderr)
+            rows = sorted(
+                stats["rules"].items(),
+                key=lambda kv: (-kv[1]["time_s"], kv[0]),
+            )
+            for rid, row in rows:
+                print(f"vtlint:   {rid:<24} {row['findings']:>4} "
+                      f"finding(s)  {row['time_s']*1000:8.1f} ms",
+                      file=sys.stderr)
+    # suppressed findings are inventory, not failures: --worklist on a
+    # tree whose only findings are justified suppressions still exits 0
+    return 1 if live else 0
 
 
 if __name__ == "__main__":

@@ -21,9 +21,12 @@ from __future__ import annotations
 import ast
 import os
 import re
+import time
 import tokenize
-from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+from dataclasses import dataclass, field, replace as _dc_replace
+from typing import (
+    Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple,
+)
 
 #: rule id -> (description, fn)
 _REGISTRY: Dict[str, "Rule"] = {}
@@ -41,33 +44,46 @@ class Finding:
     path: str  # relative to the analysis root
     line: int
     message: str
+    #: set only in ``--worklist`` mode: the finding was suppressed in the
+    #: source; ``justification`` carries the suppressing comment's text so
+    #: the machine-readable inventory keeps the human reasoning attached
+    suppressed: bool = False
+    justification: str = ""
 
     def human(self) -> str:
-        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule}{tag}: {self.message}"
 
     def as_dict(self) -> Dict[str, object]:
-        return {
+        d: Dict[str, object] = {
             "rule": self.rule,
             "path": self.path,
             "line": self.line,
             "message": self.message,
         }
+        if self.suppressed:
+            d["suppressed"] = True
+            d["justification"] = self.justification
+        return d
 
 
 @dataclass
 class Rule:
     id: str
     description: str
-    fn: Callable[["FileContext"], Iterable[Finding]]
+    fn: Callable[..., Iterable[Finding]]
+    #: "file" rules see one FileContext; "project" rules see the whole
+    #: package as a ProjectContext (the vtflow interprocedural core)
+    scope: str = "file"
 
 
-def rule(id: str, description: str):
+def rule(id: str, description: str, scope: str = "file"):
     """Decorator registering a rule function in the global registry."""
 
     def deco(fn):
         if id in _REGISTRY:
             raise ValueError(f"duplicate vtlint rule id {id!r}")
-        _REGISTRY[id] = Rule(id, description, fn)
+        _REGISTRY[id] = Rule(id, description, fn, scope)
         return fn
 
     return deco
@@ -92,12 +108,16 @@ def _load_rule_modules() -> None:
         rules_concurrency,
         rules_delta,
         rules_device,
+        rules_digestreach,
+        rules_effectorder,
         rules_epsilon,
         rules_excepts,
         rules_hotpath,
         rules_io,
+        rules_latebind,
         rules_metrics,
         rules_parity,
+        rules_procisolation,
         rules_registry,
         rules_residue,
         rules_retry,
@@ -137,13 +157,44 @@ class FileContext:
         line = getattr(node_or_line, "lineno", node_or_line)
         return Finding(rule_id, self.relpath, int(line), message)
 
+    def suppression_note(self, rule_id: str, line: int) -> str:
+        """The text of the disable comment covering (rule_id, line) — the
+        human justification a ``--worklist`` report keeps attached."""
+        lines = self.source.splitlines()
+
+        def comment_of(ln: int) -> str:
+            if 1 <= ln <= len(lines) and "#" in lines[ln - 1]:
+                return lines[ln - 1][lines[ln - 1].index("#"):].strip()
+            return ""
+
+        if rule_id in self.line_disabled.get(line, ()):
+            # the disable may sit on any line of the logical statement;
+            # scan the lines that share this line's disable set
+            for ln, rules in sorted(self.line_disabled.items()):
+                if rule_id in rules and abs(ln - line) <= 50:
+                    note = comment_of(ln)
+                    if rule_id in note:
+                        return note
+            return comment_of(line)
+        if rule_id in self.file_disabled:
+            for i, text in enumerate(lines, 1):
+                m = _DISABLE_RE.search(text)
+                if m and rule_id in m.group(1):
+                    return comment_of(i)
+        return ""
+
 
 def _parse_suppressions(ctx: FileContext, known: Set[str]) -> None:
     """Populate file/line disable sets from ``# vtlint: disable=`` comments.
 
-    Comment-only lines disable file-wide; trailing comments disable that
-    line.  Comments are found with the tokenizer, not a regex over raw
-    lines, so a disable marker inside a string literal is inert.
+    Scoping follows LOGICAL lines: a disable comment lexically inside a
+    multi-line statement (trailing the code, or on its own continuation
+    line) disables the rules for every physical line the statement spans —
+    findings anchor at a statement's first line, so a trailing disable on
+    the closing-paren line still covers them.  A comment outside any
+    logical line disables file-wide.  Comments are found with the
+    tokenizer, not a regex over raw lines, so a disable marker inside a
+    string literal is inert.
     """
     import io
 
@@ -151,21 +202,37 @@ def _parse_suppressions(ctx: FileContext, known: Set[str]) -> None:
         tokens = list(tokenize.generate_tokens(io.StringIO(ctx.source).readline))
     except (tokenize.TokenError, IndentationError, SyntaxError):
         return
-    # lines that contain any non-comment, non-whitespace token
-    code_lines: Set[int] = set()
+    # logical-line intervals: first code-token line .. NEWLINE line
+    intervals: List[Tuple[int, int]] = []
+    start: Optional[int] = None
+    last_code_end = 0
     for tok in tokens:
         if tok.type in (
             tokenize.COMMENT,
             tokenize.NL,
-            tokenize.NEWLINE,
             tokenize.INDENT,
             tokenize.DEDENT,
             tokenize.ENCODING,
             tokenize.ENDMARKER,
         ):
             continue
-        for ln in range(tok.start[0], tok.end[0] + 1):
-            code_lines.add(ln)
+        if tok.type == tokenize.NEWLINE:
+            if start is not None:
+                intervals.append((start, max(tok.start[0], last_code_end)))
+                start = None
+            continue
+        if start is None:
+            start = tok.start[0]
+        last_code_end = max(last_code_end, tok.end[0])
+    if start is not None:  # unterminated final logical line
+        intervals.append((start, last_code_end))
+
+    def interval_of(line: int) -> Optional[Tuple[int, int]]:
+        for s, e in intervals:
+            if s <= line <= e:
+                return (s, e)
+        return None
+
     for tok in tokens:
         if tok.type != tokenize.COMMENT:
             continue
@@ -174,6 +241,7 @@ def _parse_suppressions(ctx: FileContext, known: Set[str]) -> None:
             continue
         names = [n.strip() for n in m.group(1).split(",") if n.strip()]
         line = tok.start[0]
+        span = interval_of(line)
         for name in names:
             if name not in known:
                 ctx.usage_findings.append(
@@ -185,8 +253,9 @@ def _parse_suppressions(ctx: FileContext, known: Set[str]) -> None:
                     )
                 )
                 continue
-            if line in code_lines:
-                ctx.line_disabled.setdefault(line, set()).add(name)
+            if span is not None:
+                for ln in range(span[0], span[1] + 1):
+                    ctx.line_disabled.setdefault(ln, set()).add(name)
             else:
                 ctx.file_disabled.add(name)
 
@@ -232,13 +301,18 @@ def run_paths(
     paths: Sequence[str],
     root: Optional[str] = None,
     select: Optional[Sequence[str]] = None,
+    worklist: bool = False,
+    stats: Optional[Dict[str, object]] = None,
 ) -> List[Finding]:
     """Analyze ``paths`` (files or directories) and return sorted findings.
 
     ``root`` anchors relative paths in findings (defaults to the common
     parent).  ``select`` limits the run to the given rule ids; unknown ids
     raise ValueError (a CI target selecting a typoed rule must fail loudly,
-    not pass vacuously).
+    not pass vacuously).  ``worklist`` keeps suppressed findings in the
+    output (marked ``suppressed`` with the justifying comment attached) —
+    the machine-checked inventory mode ``--worklist`` exposes.  Pass a
+    dict as ``stats`` to collect per-rule finding counts and wall time.
     """
     rules = all_rules()
     if select is not None:
@@ -254,21 +328,62 @@ def run_paths(
         if os.path.isfile(root):
             root = os.path.dirname(root)
     known_ids = set(all_rules())
+    file_rules = [r for r in rules.values() if r.scope == "file"]
+    project_rules = [r for r in rules.values() if r.scope == "project"]
+    rule_stats: Dict[str, Dict[str, float]] = {
+        r.id: {"findings": 0, "time_s": 0.0} for r in rules.values()
+    }
+    t_start = time.perf_counter()
     findings: List[Finding] = []
+    contexts: List[FileContext] = []
+
+    def emit(r: Rule, ctx: Optional[FileContext], f: Finding) -> None:
+        suppressed = ctx is not None and (
+            r.id in ctx.file_disabled or r.id in ctx.line_disabled.get(f.line, ())
+        )
+        if suppressed:
+            if not worklist:
+                return
+            f = _dc_replace(
+                f, suppressed=True,
+                justification=ctx.suppression_note(r.id, f.line),
+            )
+        rule_stats[r.id]["findings"] += 1
+        findings.append(f)
+
     for path in iter_python_files(paths):
         ctx = load_file(path, root)
         if ctx is None:
             continue
         _parse_suppressions(ctx, known_ids)
         findings.extend(ctx.usage_findings)
-        for r in rules.values():
-            if r.id in ctx.file_disabled:
-                continue
+        contexts.append(ctx)
+        for r in file_rules:
+            t0 = time.perf_counter()
             for f in r.fn(ctx):
-                if r.id in ctx.line_disabled.get(f.line, ()):
-                    continue
-                findings.append(f)
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+                emit(r, ctx, f)
+            rule_stats[r.id]["time_s"] += time.perf_counter() - t0
+
+    build_s = 0.0
+    if project_rules:
+        t0 = time.perf_counter()
+        pctx = ProjectContext(contexts)
+        build_s = time.perf_counter() - t0
+        by_rel = {c.relpath: c for c in contexts}
+        for r in project_rules:
+            t0 = time.perf_counter()
+            for f in r.fn(pctx):
+                emit(r, by_rel.get(f.path), f)
+            rule_stats[r.id]["time_s"] += time.perf_counter() - t0
+
+    # fully deterministic order: message breaks ties between two findings
+    # of one rule on one line, so --json output is diff-stable
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    if stats is not None:
+        stats["files"] = len(contexts)
+        stats["total_s"] = time.perf_counter() - t_start
+        stats["project_build_s"] = build_s
+        stats["rules"] = rule_stats
     return findings
 
 
@@ -401,3 +516,713 @@ def ctx_nodes_in_jit(ctx: "FileContext") -> Set[int]:
     if "nodes_in_jit" not in ctx.cache:
         ctx.cache["nodes_in_jit"] = nodes_in_jit(ctx.tree)
     return ctx.cache["nodes_in_jit"]  # type: ignore[return-value]
+
+
+# --- vtflow: the interprocedural effect core ---------------------------------
+#
+# A ProjectContext is the whole-package view the interprocedural rules
+# consume: a module/class-resolved call graph plus per-function *effect
+# summaries* computed to a fixpoint — the same propagation shape
+# rules_concurrency.py uses for lock acquisitions, hoisted here so any
+# rule can consume it.
+#
+# The effect lattice (ANALYSIS.md "vtflow interprocedural core"):
+#
+#   mutate   in-memory columnar/mirror store mutation (store verb call or
+#            a direct write into a digested container)
+#   digest   state-digest fold (any `_digest` touch)
+#   append   WAL append (`.wal.append(...)` / `_wal_append`)
+#   beacon   digest-beacon enqueue (`_maybe_beacon`/`stamp_beacon`/`log_beacon`)
+#   ship     replication ship (`repl.log_append` — the feed queue)
+#   ack      durability ack (`_commit_ack`, or a literal-2xx `_reply`)
+#   lock     lock acquisition (informational; the lock rules own this)
+#   global-write  mutation of a module-level mutable global
+#
+# Beyond the may-effect set, each summary carries the ORDER quadruple the
+# wal-effect-order rule composes across calls:
+#
+#   mutates        the function (transitively) mutates store state
+#   clears         on every non-raising path the function reaches a WAL
+#                  append — a caller's pending mutation is covered
+#   ends_unlogged  on some path the function returns with a mutation not
+#                  yet covered by an append
+#   leading_obs    (kind, line) of an observable effect (beacon/ship/ack)
+#                  the function can perform BEFORE any append — calling it
+#                  with a pending mutation composes an ordering violation
+#
+# Two structural guard exemptions keep the live tree honest without
+# suppressions: a branch whose test mentions `.wal` is a CONFIGURATION
+# guard (wal-less servers have no append obligation), joined
+# optimistically; and a beacon under a `repl is None` test is local-only
+# (it can never ship), so it is not an observable effect.
+#
+# Calls are atomic at the caller's granularity: a callee's internal
+# exception windows are the callee's own analysis obligation.  Exception
+# handlers inherit the maximum caller-level pending state of their try
+# body, which is how "no exception path may ack without the append" is
+# checked.
+#
+# Cross-function findings anchor at the line that COMPOSES the violation
+# (the call site in the caller for composed findings, the effect line for
+# in-function findings).  Suppression follows the anchor: a disable at
+# the caller's call-site line (or its file) suppresses the composed
+# finding; a disable inside the callee does not — the callee is innocent
+# alone, the composition is the bug.
+
+#: store verbs whose call on a store-ish receiver is an in-memory mutation
+MUTATE_VERBS = {
+    "create", "update", "update_cas", "patch", "delete",
+    "apply_segment_lazy", "bulk",
+}
+#: digest-beacon enqueue points
+BEACON_CALLS = {"_maybe_beacon", "stamp_beacon", "log_beacon"}
+#: observable (externally visible) effect kinds
+OBSERVABLE_EFFECTS = ("beacon", "ship", "ack")
+#: containers whose content the state digest covers
+DIGESTED_CONTAINERS = {"_objects", "_lazy_patch"}
+
+
+def classify_call(dotted: Optional[str]) -> Optional[str]:
+    """Effect kind of a call by its dotted spelling, or None."""
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    last, prefix = parts[-1], parts[:-1]
+    if last == "_wal_append":
+        return "append"
+    if last == "append" and any("wal" in p for p in prefix):
+        return "append"
+    if last in BEACON_CALLS:
+        return "beacon"
+    if last == "log_append":
+        return "ship"
+    if last == "_commit_ack":
+        return "ack"
+    if last in MUTATE_VERBS and any(
+        p in ("store", "_store") or p.endswith("store") for p in prefix
+    ):
+        return "mutate"
+    return None
+
+
+class FunctionSummary:
+    """Per-function effect summary (one fixpoint variable)."""
+
+    __slots__ = (
+        "fqn", "relpath", "qualname", "name", "cls", "node",
+        "effects", "mutates", "clears", "ends_unlogged", "leading_obs",
+        "violations", "calls",
+    )
+
+    def __init__(self, fqn: str, relpath: str, qualname: str,
+                 cls: Optional[str], node: ast.AST):
+        self.fqn = fqn
+        self.relpath = relpath
+        self.qualname = qualname
+        self.name = qualname.split(".")[-1]
+        self.cls = cls  # enclosing class name or None
+        self.node = node
+        self.effects: Set[str] = set()
+        self.mutates = False
+        self.clears = False
+        self.ends_unlogged = False
+        self.leading_obs: Optional[Tuple[str, int]] = None
+        #: (line, message) order violations found in THIS function
+        self.violations: List[Tuple[int, str]] = []
+        #: resolved call edges: (line, callee fqn)
+        self.calls: List[Tuple[int, str]] = []
+
+    def _key(self):
+        return (frozenset(self.effects), self.mutates, self.clears,
+                self.ends_unlogged, self.leading_obs)
+
+
+def _mentions_wal(test: ast.AST) -> bool:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) and "wal" in sub.attr:
+            return True
+        if isinstance(sub, ast.Name) and "wal" in sub.id:
+            return True
+    return False
+
+
+def _repl_none_guard(test: ast.AST) -> bool:
+    """True for tests of the shape ``<x>.repl is None`` (possibly inside
+    a BoolOp) — a beacon under it is local-only and can never ship."""
+    for sub in ast.walk(test):
+        if (
+            isinstance(sub, ast.Compare)
+            and len(sub.ops) == 1
+            and isinstance(sub.ops[0], ast.Is)
+            and isinstance(sub.comparators[0], ast.Constant)
+            and sub.comparators[0].value is None
+        ):
+            name = dotted_name(sub.left)
+            if name and name.split(".")[-1] == "repl":
+                return True
+    return False
+
+
+class _OState:
+    __slots__ = ("pending", "appended", "dead")
+
+    def __init__(self, pending=False, appended=False, dead=False):
+        self.pending = pending
+        self.appended = appended
+        self.dead = dead
+
+    def copy(self) -> "_OState":
+        return _OState(self.pending, self.appended, self.dead)
+
+    def join(self, other: "_OState", optimistic: bool = False) -> None:
+        if other.dead:
+            return
+        if self.dead:
+            self.pending, self.appended, self.dead = (
+                other.pending, other.appended, other.dead)
+            return
+        if optimistic:
+            self.pending = self.pending and other.pending
+            self.appended = self.appended or other.appended
+        else:
+            self.pending = self.pending or other.pending
+            self.appended = self.appended and other.appended
+
+
+class _OrderWalk:
+    """One pass over a function body with the current callee summaries:
+    computes the order quadruple and records violations."""
+
+    def __init__(self, summary: FunctionSummary, project: "ProjectContext"):
+        self.s = summary
+        self.p = project
+        self.effects: Set[str] = set()
+        self.mutates = False
+        self.clears = True
+        self.ends_unlogged = False
+        self.leading_obs: Optional[Tuple[str, int]] = None
+        self.violations: List[Tuple[int, str]] = []
+        self.calls: List[Tuple[int, str]] = []
+        #: one flag per enclosing try body: set when a statement boundary
+        #: inside it passed with a pending (un-appended) mutation — the
+        #: state an exception from a LATER statement would expose to the
+        #: handler
+        self._try_pending_flags: List[bool] = []
+        #: materialization folds values the staging path already logged
+        #: and digested — its container writes are representation changes,
+        #: not logical mutations (same structural exemption as
+        #: rules_audit)
+        self._mutate_exempt = (
+            summary.name.lstrip("_").startswith("materialize")
+        )
+
+    def run(self) -> None:
+        st = _OState()
+        self.visit_stmts(self.s.node.body, st, False)
+        self.end_path(st)
+
+    # -- path accounting ---------------------------------------------------
+
+    def end_path(self, st: _OState) -> None:
+        if st.dead:
+            return
+        self.ends_unlogged = self.ends_unlogged or st.pending
+        self.clears = self.clears and st.appended
+        st.dead = True
+
+    def event(self, kind: str, line: int, st: _OState, exempt: bool,
+              detail: str = "") -> None:
+        if st.dead:
+            return
+        if kind == "mutate" and self._mutate_exempt:
+            return
+        self.effects.add(kind)
+        if kind == "mutate":
+            self.mutates = True
+            st.pending = True
+        elif kind == "append":
+            st.pending = False
+            st.appended = True
+        elif kind in OBSERVABLE_EFFECTS:
+            if kind == "beacon" and exempt:
+                return
+            if st.pending:
+                self.violations.append((line, (
+                    f"{detail or kind} effect reaches the outside world "
+                    "before the WAL append covering the pending in-memory "
+                    "mutation — a crash here acks/ships state the log "
+                    "cannot replay (the PR-15 beacon-ordering bug class); "
+                    "move the effect after the append"
+                )))
+            elif not st.appended and self.leading_obs is None:
+                self.leading_obs = (kind, line)
+
+    def call_event(self, line: int, summaries: List[FunctionSummary],
+                   st: _OState, exempt: bool) -> None:
+        if st.dead or not summaries:
+            return
+        leading = next((s.leading_obs for s in summaries
+                        if s.leading_obs is not None), None)
+        clears = all(s.clears for s in summaries)
+        ends_unlogged = any(s.ends_unlogged for s in summaries)
+        names = "/".join(sorted({s.qualname for s in summaries}))
+        for s in summaries:
+            self.effects |= s.effects
+            self.calls.append((line, s.fqn))
+        if leading is not None and not (leading[0] == "beacon" and exempt):
+            if st.pending:
+                self.violations.append((line, (
+                    f"call into `{names}` performs a {leading[0]} effect "
+                    "before any WAL append while this caller holds an "
+                    "un-appended mutation — the composed path acks/ships "
+                    "ahead of the log (cross-function effect order); "
+                    "append first or hoist the effect past it"
+                )))
+            elif not st.appended and self.leading_obs is None:
+                self.leading_obs = (leading[0], line)
+        if clears and summaries:
+            st.pending = False
+            st.appended = True
+        if ends_unlogged:
+            self.mutates = True
+            st.pending = True
+
+    # -- expression walk (eval order, calls post-order) --------------------
+
+    def visit_expr(self, node: ast.AST, st: _OState, exempt: bool) -> None:
+        if node is None or st.dead:
+            return
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # closures run later; their effects are not this path's
+        if isinstance(node, ast.Attribute) and node.attr == "_digest":
+            self.effects.add("digest")
+        if isinstance(node, ast.Constant) and node.value == "_digest":
+            self.effects.add("digest")
+        if isinstance(node, ast.IfExp):
+            self.visit_expr(node.test, st, exempt)
+            ex_body = exempt or _repl_none_guard(node.test)
+            opt = _mentions_wal(node.test)
+            b, o = st.copy(), st.copy()
+            self.visit_expr(node.body, b, ex_body)
+            self.visit_expr(node.orelse, o, exempt)
+            b.join(o, optimistic=opt)
+            st.pending, st.appended, st.dead = b.pending, b.appended, b.dead
+            return
+        if isinstance(node, ast.Call):
+            for sub in ast.iter_child_nodes(node):
+                if sub is not node.func:
+                    self.visit_expr(sub, st, exempt)
+            # receiver expression itself may contain nested calls
+            if isinstance(node.func, ast.Attribute):
+                self.visit_expr(node.func.value, st, exempt)
+            dotted = dotted_name(node.func)
+            kind = classify_call(dotted)
+            if kind is None and dotted is None and isinstance(
+                    node.func, ast.Attribute):
+                kind = classify_call(node.func.attr)
+            if kind is None:
+                kind = self._reply_ack(node)
+            if kind is not None:
+                detail = f"`{dotted or '?'}` ({kind})"
+                self.event(kind, node.lineno, st, exempt, detail)
+                # still merge callee effect SETS for reachability rules
+                for s in self.p.resolve_call(self.s, node):
+                    self.effects |= s.effects
+                    self.calls.append((node.lineno, s.fqn))
+            else:
+                self.call_event(node.lineno,
+                                self.p.resolve_call(self.s, node),
+                                st, exempt)
+            return
+        for sub in ast.iter_child_nodes(node):
+            self.visit_expr(sub, st, exempt)
+
+    @staticmethod
+    def _reply_ack(node: ast.Call) -> Optional[str]:
+        """``self._reply(200, ...)`` / ``send_response(201)`` with a
+        literal success code is an ack effect; non-literal codes are
+        handled where the code is computed."""
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name in ("_reply", "send_response") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, int) \
+                    and arg.value < 400:
+                return "ack"
+        return None
+
+    # -- statement walk ----------------------------------------------------
+
+    def visit_stmts(self, body, st: _OState, exempt: bool) -> None:
+        last = len(body) - 1
+        for i, stmt in enumerate(body):
+            if st.dead:
+                return
+            self.visit_stmt(stmt, st, exempt)
+            # a boundary BETWEEN statements with a pending mutation is
+            # what an exception from a later statement exposes to the
+            # enclosing handler; a boundary after the LAST statement
+            # exposes nothing new (an exception from the statement itself
+            # means its mutation never happened — calls are atomic at
+            # this caller's granularity)
+            if i < last and st.pending and not st.dead \
+                    and self._try_pending_flags:
+                for j in range(len(self._try_pending_flags)):
+                    self._try_pending_flags[j] = True
+
+    def visit_stmt(self, node: ast.AST, st: _OState, exempt: bool) -> None:
+        if st.dead:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs are their own summaries
+        if isinstance(node, ast.If):
+            self.visit_expr(node.test, st, exempt)
+            opt = _mentions_wal(node.test)
+            ex_body = exempt or _repl_none_guard(node.test)
+            b, o = st.copy(), st.copy()
+            self.visit_stmts(node.body, b, ex_body)
+            self.visit_stmts(node.orelse, o, exempt)
+            b.join(o, optimistic=opt)
+            st.pending, st.appended, st.dead = b.pending, b.appended, b.dead
+            return
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(node, ast.While):
+                self.visit_expr(node.test, st, exempt)
+            else:
+                self.visit_expr(node.iter, st, exempt)
+            b = st.copy()
+            self.visit_stmts(node.body, b, exempt)
+            self.visit_stmts(node.orelse, b, exempt)
+            st.join(b)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ctx_name = dotted_name(item.context_expr)
+                if ctx_name and any(
+                    k in ctx_name.split(".")[-1]
+                    for k in ("lock", "_mu", "cond", "_cv")
+                ):
+                    self.effects.add("lock")
+                self.visit_expr(item.context_expr, st, exempt)
+            self.visit_stmts(node.body, st, exempt)
+            return
+        if isinstance(node, ast.Try):
+            self._try_pending_flags.append(False)
+            b = st.copy()
+            self.visit_stmts(node.body, b, exempt)
+            self.visit_stmts(node.orelse, b, exempt)
+            body_pending = self._try_pending_flags.pop()
+            joined = b
+            for handler in node.handlers:
+                h = st.copy()
+                h.pending = st.pending or body_pending
+                h.appended = st.appended
+                self.visit_stmts(handler.body, h, exempt)
+                joined.join(h)
+            self.visit_stmts(node.finalbody, joined, exempt)
+            st.pending, st.appended, st.dead = (
+                joined.pending, joined.appended, joined.dead)
+            return
+        if isinstance(node, ast.Return):
+            self.visit_expr(node.value, st, exempt)
+            self.end_path(st)
+            return
+        if isinstance(node, ast.Raise):
+            self.visit_expr(node.exc, st, exempt)
+            st.dead = True  # exceptional exit: the caller's handler owns it
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            # direct writes into digested containers are mutations
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            value = getattr(node, "value", None)
+            if value is not None:
+                self.visit_expr(value, st, exempt)
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Attribute) \
+                            and sub.attr == "_digest":
+                        self.effects.add("digest")
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    if isinstance(base, ast.Attribute) \
+                            and base.attr in DIGESTED_CONTAINERS:
+                        if isinstance(t, ast.Subscript):
+                            self.event("mutate", node.lineno, st, exempt,
+                                       f"write into `{base.attr}`")
+                        break
+                    base = base.value
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    if isinstance(base, ast.Attribute) \
+                            and base.attr in DIGESTED_CONTAINERS:
+                        self.event("mutate", node.lineno, st, exempt,
+                                   f"del from `{base.attr}`")
+                        break
+                    base = base.value
+            return
+        if isinstance(node, ast.Expr):
+            self.visit_expr(node.value, st, exempt)
+            return
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, ast.stmt):
+                self.visit_stmt(sub, st, exempt)
+            else:
+                self.visit_expr(sub, st, exempt)
+
+
+class ProjectContext:
+    """The whole-package view: every FileContext, a class-resolved call
+    graph, and per-function effect summaries computed to a fixpoint."""
+
+    MAX_ITERATIONS = 40
+
+    def __init__(self, contexts: Sequence[FileContext]):
+        self.contexts: Dict[str, FileContext] = {
+            c.relpath: c for c in contexts
+        }
+        #: class name -> fully-qualified "relpath::Class" (merged on dup)
+        self.classes: Dict[str, Set[str]] = {}
+        #: "relpath::Class" -> {method name -> fqn}
+        self.methods: Dict[str, Dict[str, str]] = {}
+        #: relpath -> {function name -> fqn} (module level)
+        self.module_fns: Dict[str, Dict[str, str]] = {}
+        #: relpath -> {imported name -> source module dotted path}
+        self.imports: Dict[str, Dict[str, str]] = {}
+        #: attr/bare name -> candidate class names ("store" -> {"Store"})
+        self.attr_types: Dict[str, Set[str]] = {}
+        #: fqn -> summary
+        self.summaries: Dict[str, FunctionSummary] = {}
+        #: fqn -> {param name -> class name} from annotations
+        self._param_types: Dict[str, Dict[str, str]] = {}
+        self._index()
+        self._infer_attr_types()
+        self._fixpoint()
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index(self) -> None:
+        for rel, ctx in self.contexts.items():
+            tree = ctx.tree
+            self.module_fns[rel] = {}
+            self.imports[rel] = {}
+            for node in tree.body if isinstance(tree, ast.Module) else []:
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    self._record_import(rel, node)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fqn = f"{rel}::{node.name}"
+                    self.module_fns[rel][node.name] = fqn
+                    self._add_summary(fqn, rel, node.name, None, node)
+            # classes anywhere in the module — the request-handler class
+            # defined inside StoreServer.__init__ is part of the seam
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                fqcn = f"{rel}::{node.name}"
+                self.classes.setdefault(node.name, set()).add(fqcn)
+                self.methods.setdefault(fqcn, {})
+                for item in node.body:  # direct methods only; a def
+                    # nested inside a method is a closure, not a method
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        qual = f"{node.name}.{item.name}"
+                        fqn = f"{rel}::{qual}"
+                        if item.name not in self.methods[fqcn]:
+                            self.methods[fqcn][item.name] = fqn
+                            self._add_summary(fqn, rel, qual,
+                                              node.name, item)
+
+    def _add_summary(self, fqn, rel, qual, cls, node) -> None:
+        s = FunctionSummary(fqn, rel, qual, cls, node)
+        self.summaries[fqn] = s
+        ptypes: Dict[str, str] = {}
+        args = node.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            ann = a.annotation
+            cname = None
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                cname = ann.value.strip("'\" ")
+            elif ann is not None:
+                cname = dotted_name(ann)
+            if cname:
+                # keep even names not yet indexed — forward refs resolve
+                # against the finished class map at query time
+                ptypes[a.arg] = cname.split(".")[-1].split("[")[0]
+        self._param_types[fqn] = ptypes
+
+    def _record_import(self, rel: str, node: ast.AST) -> None:
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                self.imports[rel][alias.asname or alias.name] = node.module
+
+    def _infer_attr_types(self) -> None:
+        """attr/name -> candidate classes, from `x.attr = Class(...)`,
+        `name = Class(...)`, `name = self` (handler-closure pattern), and
+        `self.attr = <annotated param>`."""
+        for rel, ctx in self.contexts.items():
+            cls_stack: List[Optional[str]] = []
+
+            def walk(node, cls, fn_fqn):
+                for sub in ast.iter_child_nodes(node):
+                    sub_cls, sub_fqn = cls, fn_fqn
+                    if isinstance(sub, ast.ClassDef):
+                        sub_cls = sub.name
+                    elif isinstance(sub, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        qual = f"{cls}.{sub.name}" if cls else sub.name
+                        sub_fqn = f"{rel}::{qual}"
+                    if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                        self._note_assign(sub, cls, sub_fqn)
+                    walk(sub, sub_cls, sub_fqn)
+
+            walk(ctx.tree, None, None)
+
+    def _note_assign(self, node: ast.Assign, cls: Optional[str],
+                     fn_fqn: Optional[str]) -> None:
+        tgt = node.targets[0]
+        name = None
+        if isinstance(tgt, ast.Attribute):
+            name = tgt.attr
+        elif isinstance(tgt, ast.Name):
+            name = tgt.id
+        if name is None:
+            return
+
+        def note(cname: Optional[str]):
+            if cname and cname in self.classes:
+                self.attr_types.setdefault(name, set()).add(cname)
+
+        # peel `a or b` — `self.store = store or Store()`
+        values = [node.value]
+        if isinstance(node.value, ast.BoolOp):
+            values = list(node.value.values)
+        for v in values:
+            if isinstance(v, ast.Call):
+                cname = dotted_name(v.func)
+                note(cname.split(".")[-1] if cname else None)
+            elif isinstance(v, ast.Name):
+                if v.id == "self" and cls is not None:
+                    note(cls)
+                elif fn_fqn is not None:
+                    note(self._param_types.get(fn_fqn, {}).get(v.id))
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(self, caller: FunctionSummary,
+                     node: ast.Call) -> List[FunctionSummary]:
+        """Candidate callee summaries for a call site (empty when the
+        callee is outside the project or unresolvable)."""
+        f = node.func
+        rel = caller.relpath
+        out: List[str] = []
+        if isinstance(f, ast.Name):
+            fqn = self.module_fns.get(rel, {}).get(f.id)
+            if fqn:
+                out.append(fqn)
+            elif f.id in self.imports.get(rel, {}):
+                out.extend(self._imported(rel, f.id))
+            elif f.id in self.classes:
+                for fqcn in self.classes[f.id]:
+                    init = self.methods.get(fqcn, {}).get("__init__")
+                    if init:
+                        out.append(init)
+        elif isinstance(f, ast.Attribute):
+            meth = f.attr
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and caller.cls is not None:
+                fqcn = f"{caller.relpath}::{caller.cls}"
+                fqn = self.methods.get(fqcn, {}).get(meth)
+                if fqn:
+                    out.append(fqn)
+                elif meth in self.module_fns.get(rel, {}):
+                    pass  # self.x never resolves to a module function
+            else:
+                tail = None
+                dn = dotted_name(base)
+                if dn is not None:
+                    tail = dn.split(".")[-1]
+                cands: Set[str] = set()
+                if tail is not None:
+                    ptype = self._param_types.get(caller.fqn, {}).get(tail)
+                    if ptype and ptype in self.classes:
+                        cands |= {c for c in self.classes[ptype]}
+                    for cname in self.attr_types.get(tail, ()):
+                        cands |= self.classes.get(cname, set())
+                for fqcn in cands:
+                    fqn = self.methods.get(fqcn, {}).get(meth)
+                    if fqn:
+                        out.append(fqn)
+        seen: Set[str] = set()
+        res = []
+        for fqn in out:
+            if fqn not in seen and fqn in self.summaries:
+                seen.add(fqn)
+                res.append(self.summaries[fqn])
+        return res
+
+    def _imported(self, rel: str, name: str) -> List[str]:
+        module = self.imports[rel][name]
+        suffix = module.replace(".", "/") + ".py"
+        for other_rel in self.contexts:
+            trimmed = other_rel[:-3] if other_rel.endswith(".py") else other_rel
+            if suffix.endswith(trimmed + ".py") or suffix == other_rel \
+                    or module.replace(".", "/").endswith(trimmed):
+                fqn = self.module_fns.get(other_rel, {}).get(name)
+                if fqn:
+                    return [fqn]
+        return []
+
+    # -- the summary fixpoint ----------------------------------------------
+
+    def _fixpoint(self) -> None:
+        for _ in range(self.MAX_ITERATIONS):
+            changed = False
+            for s in self.summaries.values():
+                walk = _OrderWalk(s, self)
+                walk.run()
+                key_before = s._key()
+                s.effects = walk.effects
+                s.mutates = walk.mutates
+                s.clears = walk.clears and "append" in walk.effects
+                s.ends_unlogged = walk.ends_unlogged
+                s.leading_obs = walk.leading_obs
+                s.violations = walk.violations
+                s.calls = walk.calls
+                if s._key() != key_before:
+                    changed = True
+            if not changed:
+                break
+
+    # -- graph queries for rules -------------------------------------------
+
+    def reachable_from(self, roots: Iterable[str]) -> Set[str]:
+        """fqns reachable from the given root fqns over resolved calls."""
+        seen: Set[str] = set()
+        frontier = [r for r in roots if r in self.summaries]
+        while frontier:
+            cur = frontier.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            for _line, callee in self.summaries[cur].calls:
+                if callee not in seen:
+                    frontier.append(callee)
+        return seen
+
+    def functions_in(self, relpath: str) -> List[FunctionSummary]:
+        return sorted(
+            (s for s in self.summaries.values() if s.relpath == relpath),
+            key=lambda s: s.node.lineno,
+        )
+
+    def finding(self, rule_id: str, summary: FunctionSummary, line: int,
+                message: str) -> Finding:
+        return Finding(rule_id, summary.relpath, int(line), message)

@@ -341,6 +341,87 @@ def _assigned_self_attrs(fn: ast.AST, locked_only: bool,
     return out
 
 
+class ClassLockContext:
+    """Per-class locking context shared by lock-guard and the
+    interprocedural proc-isolation rule: which methods run only during
+    construction, and which are "effectively locked" (every non-init
+    call site holds a lock — `_pump_log`-style called-locked helpers)."""
+
+    def __init__(self, cls: ast.ClassDef, methods, init_reach,
+                 locked_methods, defs, infos):
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = methods
+        self.init_reach: Set[str] = init_reach
+        self.locked_methods: Set[str] = locked_methods
+        self.defs = defs
+        self.infos = infos
+
+
+def class_lock_context(ctx: FileContext,
+                       cls: ast.ClassDef) -> Optional[ClassLockContext]:
+    """The locking context of one class, or None when the class owns no
+    lock (then there is no discipline to check)."""
+    defs, infos, _edges, _sites, _acq = _analyze_module(ctx)
+    if not defs:
+        return None
+    methods = {
+        item.name: item
+        for item in cls.body
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    if not methods:
+        return None
+    has_lock = any(d.key == f"self.{bare}" for bare, d in defs.items())
+    if not has_lock:
+        return None
+
+    # call sites within the class: method -> [(caller, held?)]
+    call_sites: Dict[str, List[Tuple[str, bool]]] = {}
+    for mname in methods:
+        qual = f"{cls.name}.{mname}"
+        info = infos.get(qual)
+        if info is None:
+            continue
+        for held, callee, _line in info.calls:
+            if callee in methods:
+                call_sites.setdefault(callee, []).append((mname, bool(held)))
+
+    # init-reachable methods (construction context, single-threaded)
+    init_reach: Set[str] = set(m for m in methods if m in _INIT_METHODS)
+    frontier = list(init_reach)
+    while frontier:
+        cur = frontier.pop()
+        info = infos.get(f"{cls.name}.{cur}")
+        if info is None:
+            continue
+        for _held, callee, _line in info.calls:
+            if callee in methods and callee not in init_reach:
+                # only counts if ALL its call sites are init-reachable
+                sites = call_sites.get(callee, [])
+                if sites and all(c in init_reach for c, _h in sites):
+                    init_reach.add(callee)
+                    frontier.append(callee)
+
+    # fixpoint: a method is "effectively locked" if it has >=1 call
+    # site and every non-init call site holds a lock or is itself
+    # effectively locked
+    locked_methods: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for mname in methods:
+            if mname in locked_methods or mname in init_reach:
+                continue
+            sites = [s for s in call_sites.get(mname, [])
+                     if s[0] not in init_reach]
+            if sites and all(h or c in locked_methods for c, h in sites):
+                locked_methods.add(mname)
+                changed = True
+
+    return ClassLockContext(cls, methods, init_reach, locked_methods,
+                            defs, infos)
+
+
 @rule(
     "lock-guard",
     "write to lock-guarded shared state outside the lock — attributes "
@@ -353,61 +434,12 @@ def check_lock_guard(ctx: FileContext) -> Iterable[Finding]:
 
     # per class: find methods, call sites, locked-effective methods
     for cls in (n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)):
-        methods = {
-            item.name: item
-            for item in cls.body
-            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
-        }
-        if not methods:
+        lc = class_lock_context(ctx, cls)
+        if lc is None:
             continue
-        has_lock = any(
-            d.key == f"self.{bare}" for bare, d in defs.items()
-        )
-        if not has_lock:
-            continue
-
-        # call sites within the class: method -> [(caller, held?)]
-        call_sites: Dict[str, List[Tuple[str, bool]]] = {}
-        for mname in methods:
-            qual = f"{cls.name}.{mname}"
-            info = infos.get(qual)
-            if info is None:
-                continue
-            for held, callee, _line in info.calls:
-                if callee in methods:
-                    call_sites.setdefault(callee, []).append((mname, bool(held)))
-
-        # init-reachable methods (construction context, single-threaded)
-        init_reach: Set[str] = set(m for m in methods if m in _INIT_METHODS)
-        frontier = list(init_reach)
-        while frontier:
-            cur = frontier.pop()
-            info = infos.get(f"{cls.name}.{cur}")
-            if info is None:
-                continue
-            for _held, callee, _line in info.calls:
-                if callee in methods and callee not in init_reach:
-                    # only counts if ALL its call sites are init-reachable
-                    sites = call_sites.get(callee, [])
-                    if sites and all(c in init_reach for c, _h in sites):
-                        init_reach.add(callee)
-                        frontier.append(callee)
-
-        # fixpoint: a method is "effectively locked" if it has >=1 call
-        # site and every non-init call site holds a lock or is itself
-        # effectively locked
-        locked_methods: Set[str] = set()
-        changed = True
-        while changed:
-            changed = False
-            for mname in methods:
-                if mname in locked_methods or mname in init_reach:
-                    continue
-                sites = [s for s in call_sites.get(mname, [])
-                         if s[0] not in init_reach]
-                if sites and all(h or c in locked_methods for c, h in sites):
-                    locked_methods.add(mname)
-                    changed = True
+        methods = lc.methods
+        init_reach = lc.init_reach
+        locked_methods = lc.locked_methods
 
         # guarded attrs: written under lock in any non-init context
         guarded: Set[str] = set()
@@ -440,3 +472,64 @@ def check_lock_guard(ctx: FileContext) -> Iterable[Finding]:
                         "in this class — take the lock or move the write to "
                         "construction",
                     )
+
+
+# --- lock-factory: daemon locks must be sanitizer-visible --------------------
+
+#: the sanitizer-scoped module set: daemon modules whose locks must be
+#: created through the locksan factories so `make sanitize` sees them.
+#: PR 16 extends the set to the elastic/admission/loadgen daemons — they
+#: are lock-free today, and this rule keeps any lock they GROW visible.
+_FACTORY_DIRS = {"store", "elastic", "admission", "loadgen"}
+_FACTORY_BASENAMES = {"apply.py", "daemons.py", "leader.py", "client.py"}
+
+_RAW_CTORS = {
+    "threading.Lock": "make_lock",
+    "threading.RLock": "make_rlock",
+    "Lock": "make_lock",
+    "RLock": "make_rlock",
+}
+
+
+def _factory_scoped(ctx: FileContext) -> bool:
+    parts = ctx.relpath.split("/")
+    return any(p in _FACTORY_DIRS for p in parts[:-1]) \
+        or parts[-1] in _FACTORY_BASENAMES
+
+
+@rule(
+    "lock-factory",
+    "raw threading.Lock/RLock/Condition constructed in a sanitizer-scoped "
+    "daemon module (store/, elastic/, admission/, loadgen/, apply.py, "
+    "daemons.py, leader.py, client.py) — the lock-order sanitizer "
+    "(`make sanitize`) only watches locks built through the locksan "
+    "factories (make_lock/make_rlock/make_condition), so a raw lock is "
+    "invisible to the runtime deadlock check; use the factory",
+)
+def check_lock_factory(ctx: FileContext) -> Iterable[Finding]:
+    if not _factory_scoped(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        ctor = dotted_name(node.func)
+        if ctor in _RAW_CTORS:
+            yield ctx.finding(
+                "lock-factory",
+                node,
+                f"raw `{ctor}()` in a sanitizer-scoped daemon module — "
+                "invisible to the lock-order sanitizer; use "
+                f"`{_RAW_CTORS[ctor]}(...)` from volcano_tpu.locksan "
+                "(names the lock and keeps `make sanitize` honest)",
+            )
+        elif ctor in ("threading.Condition", "Condition") and not node.args:
+            # Condition() with NO lock argument creates its own hidden
+            # RLock; Condition(existing_lock) wraps an already-visible
+            # lock and is fine
+            yield ctx.finding(
+                "lock-factory",
+                node,
+                "bare `Condition()` creates a hidden RLock the sanitizer "
+                "cannot see — pass an existing factory-made lock "
+                "(`Condition(self.lock)`) or use make_condition(...)",
+            )

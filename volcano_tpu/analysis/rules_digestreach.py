@@ -1,0 +1,109 @@
+"""digest-reachability: every verb-reachable mutation keeps the digest.
+
+The interprocedural upgrade of the per-file ``digest-maintenance`` rule
+(rules_audit.py).  That rule fences direct container mutation inside the
+store module set; this one walks the resolved call graph (the vtflow
+core) from the HTTP verbs — ``do_*`` handlers, the server store verbs,
+and the replica ``apply_record`` — and checks every *reachable* function
+in the whole package: if it directly mutates a digested container
+(``_objects`` / ``_lazy_patch``) its transitive effect set must include
+a ``_digest`` touch — its own, or one folded in from a callee it invokes
+(the maintenance hook may live one call away).
+
+Why reachability matters: a helper OUTSIDE store/store.py that a verb
+path calls — a migration shim, a compaction pass, a debug endpoint that
+"just fixes up" an object — mutates exactly the same audited state, and
+the per-file rule never sees it.  Conversely a function nobody can reach
+from a verb (dead scaffolding, test fixtures shipped in-package) is not
+a divergence risk and stays out of the report.
+
+Exemptions mirror rules_audit: ``materialize*`` functions fold values
+the staging path already digested (digest-neutral by design), and
+construction/recovery entry points rebuild the digest wholesale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from volcano_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    rule,
+)
+from volcano_tpu.analysis.rules_audit import (
+    _collect_aliases,
+    _container_root,
+    _is_exempt,
+    _MUTATOR_METHODS,
+    _own_nodes,
+)
+from volcano_tpu.analysis.rules_procisolation import (
+    _is_recovery,
+    _verb_roots,
+)
+
+
+def _direct_mutations(fn: ast.AST) -> Iterable[tuple]:
+    """(line, what) for direct digested-container mutations in ``fn`` —
+    the same detection rules_audit applies, minus the setattr heuristic
+    (object-field rewrites are the per-file rule's concern)."""
+    aliases = _collect_aliases(fn)
+    for node in _own_nodes(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for tgt in targets:
+                if isinstance(tgt, ast.Subscript):
+                    root = _container_root(tgt.value, aliases)
+                    if root is not None:
+                        yield (node.lineno, f"subscript write into `{root}`")
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    root = _container_root(tgt.value, aliases)
+                    if root is not None:
+                        yield (node.lineno, f"`del` from `{root}`")
+        elif isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATOR_METHODS
+            ):
+                root = _container_root(node.func.value, aliases)
+                if root is not None:
+                    yield (node.lineno, f"`.{node.func.attr}()` on `{root}`")
+
+
+@rule(
+    "digest-reachability",
+    "a function reachable from an HTTP verb (do_* handler, server store "
+    "verb, replica apply) directly mutates a digested container without "
+    "a `_digest` update anywhere in its transitive effect set — the "
+    "incremental state digest drifts on a live write path wherever the "
+    "helper happens to live (interprocedural upgrade of "
+    "digest-maintenance); fold the digest under the same lock hold",
+    scope="project",
+)
+def check_digest_reachability(pctx: ProjectContext) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    reachable: Set[str] = pctx.reachable_from(_verb_roots(pctx))
+    for fqn in sorted(reachable):
+        summary = pctx.summaries[fqn]
+        fn = summary.node
+        if _is_exempt(fn) or _is_recovery(summary.name):
+            continue
+        if "digest" in summary.effects:
+            continue  # its own body or a callee folds the digest
+        for line, what in _direct_mutations(fn):
+            findings.append(pctx.finding(
+                "digest-reachability", summary, line,
+                f"{what} in `{summary.qualname}` (reachable from an HTTP "
+                "verb) with no `_digest` touch in its transitive effects "
+                "— the maintained digest drifts from the stored objects "
+                "on a live write path; update the digest in the same "
+                "verb or in a helper this function calls",
+            ))
+    return findings

@@ -1,0 +1,82 @@
+"""wal-effect-order: mutation reaches the WAL before the world hears of it.
+
+The PR-15 bug class, made a permanent invariant: on every path from a
+store verb or a replica apply, the in-memory mutation must reach the WAL
+append **before** any observable effect — a digest beacon enqueue, a
+replication ship, or an HTTP durability ack.  A beacon shipped (or a 200
+acked) while the covering WAL record does not exist yet is a promise the
+log cannot replay after a crash: followers verify a digest the leader
+never durably had, clients retry a write the store already acked.
+
+The check is interprocedural (the vtflow core in ``core.py``): per-
+function effect summaries composed across resolved calls, so both
+in-function reorders (beacon stamped between the store verb and
+``_wal_append``) and composed ones (a verb path calling into a helper
+whose first observable effect precedes any append) are caught.  Two
+structural exemptions keep the live tree clean without suppressions:
+
+* a branch guarded on ``.wal`` is configuration, not ordering — a
+  wal-less server has no append obligation, so the join across that
+  branch is optimistic;
+* a beacon under a ``repl is None`` guard is local-only — it can never
+  ship, so it is not an observable effect (this is exactly the PR-15
+  *fix* shape, which must stay legal).
+
+Exception paths are covered by try-handler accounting: a handler
+inherits the maximum caller-level pending state of its try body, so "no
+exception path may ack without the append" falls out of the same walk.
+Calls are atomic at the caller's granularity — a callee's internal
+exception windows are the callee's own obligation (its summary is
+computed from its own body).
+
+Composed findings anchor at the CALL SITE in the caller — the line that
+composes the violation — and suppression follows the anchor: a disable
+at the callee's effect line does not suppress the caller-site finding.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from volcano_tpu.analysis.core import (
+    Finding,
+    ProjectContext,
+    rule,
+)
+
+#: the write-path seam: store verbs, replica apply, scheduler apply
+_SCOPED_BASENAMES = {
+    "server.py", "store.py", "replica.py", "partition.py", "apply.py",
+}
+_SCOPED_DIRS = {"store", "scheduler"}
+
+
+def _in_scope(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return parts[-1] in _SCOPED_BASENAMES and any(
+        p in _SCOPED_DIRS for p in parts[:-1]
+    )
+
+
+@rule(
+    "wal-effect-order",
+    "observable effect (beacon enqueue / replication ship / HTTP ack) "
+    "reachable before the WAL append covering a pending in-memory "
+    "mutation, on some path from a store verb or replica apply — a crash "
+    "in the window acks or ships state the log cannot replay (the PR-15 "
+    "beacon-ordering bug class); move the effect after `_wal_append`, or "
+    "guard it on `repl is None` if it is genuinely local-only",
+    scope="project",
+)
+def check_wal_effect_order(pctx: ProjectContext) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for rel in sorted(pctx.contexts):
+        if not _in_scope(rel):
+            continue
+        for summary in pctx.functions_in(rel):
+            for line, message in summary.violations:
+                out.append(pctx.finding(
+                    "wal-effect-order", summary, line,
+                    f"in `{summary.qualname}`: {message}",
+                ))
+    return out

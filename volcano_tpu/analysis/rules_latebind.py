@@ -1,0 +1,170 @@
+"""late-binding: chaos plans, membership, and config are read per-call.
+
+The PR-15 Replicator bug, made a permanent invariant: a component must
+not capture another component's *late-bound* state — the chaos plan, the
+replication membership, a leader URL, live config — into its own
+attributes or into closure defaults at construction time.  Construction
+happens once; the captured snapshot then silently diverges from the live
+value (chaos plans are swapped per test phase, membership changes on
+failover), and the component keeps acting on the world as it was.
+
+What fires (construction scope = ``__init__``-family methods and class
+bodies):
+
+* ``self.x = <expr>`` where ``<expr>`` reads ``<something>.<late-attr>``
+  through another object (``srv.chaos``, ``self.srv.peers``) — the
+  attribute freeze;
+* a nested ``def``/``lambda`` whose *default value* reads a late-bound
+  attribute (``def loop(plan=srv.chaos)``) — the closure-default freeze,
+  evaluated exactly once at definition time.
+
+What deliberately does NOT fire (the fix shapes):
+
+* storing the owning object itself (``self.srv = srv``) and reading
+  ``self.srv.chaos`` per call in method/closure *bodies* — nested-def
+  bodies run later, so reads there are late by construction;
+* a component constructing/owning its own plan (``self.chaos =
+  env_plan()``) — calls are ownership, not capture;
+* reading a *bare* ``self`` attribute (``self.role``) while
+  initializing — own state, not another component's.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from volcano_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    dotted_name,
+    rule,
+)
+
+#: attributes whose value is late-bound by contract: reading them through
+#: another object at construction time freezes a snapshot
+LATE_ATTRS = {
+    "chaos",        # chaos plan — swapped per test phase (env_plan)
+    "peers",        # replication membership — changes on failover
+    "members",      # ditto, scheduler-side naming
+    "leader_url",   # follower redirect target — changes on promotion
+    "config",       # live config objects
+    "cfg",
+}
+
+_INIT_METHODS = {
+    "__init__", "__setstate__", "__getstate__", "__new__", "__post_init__",
+}
+
+
+def _late_reads(expr: ast.AST) -> Iterable[ast.Attribute]:
+    """Attribute reads ``<base>.<late>`` where base is not bare ``self``
+    (``srv.chaos`` and ``self.srv.chaos`` both qualify; ``self.chaos``
+    does not), skipping nested-def bodies (those reads run per call)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # body runs later — late by construction
+        if isinstance(node, ast.Attribute) and node.attr in LATE_ATTRS:
+            base = node.value
+            if not (isinstance(base, ast.Name) and base.id == "self"):
+                yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _default_exprs(fn: ast.AST) -> Iterable[ast.AST]:
+    args = fn.args
+    for d in list(args.defaults) + [d for d in args.kw_defaults if d]:
+        yield d
+
+
+@rule(
+    "late-binding",
+    "late-bound state (chaos plan / membership / config) captured "
+    "through another object into an attribute or closure default at "
+    "construction time — the snapshot silently diverges from the live "
+    "value (the PR-15 Replicator `srv.chaos` bug class); store the owning "
+    "object and read the attribute per call instead",
+)
+def check_late_binding(ctx: FileContext) -> Iterable[Finding]:
+    findings: List[Finding] = []
+
+    def scan_construction_stmts(body: Iterable[ast.stmt], where: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a def nested in construction scope: its BODY is exempt
+                # (runs per call), but its default values are evaluated
+                # right now — a default freeze is still a freeze
+                for d in _default_exprs(stmt):
+                    for read in _late_reads(d):
+                        findings.append(ctx.finding(
+                            "late-binding", read,
+                            f"default value of `{stmt.name}` captures "
+                            f"`{dotted_name(read) or read.attr}` at "
+                            f"{where} — defaults evaluate once, freezing "
+                            "the live value; read it inside the body "
+                            "instead",
+                        ))
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                scan_construction_stmts(stmt.body, where)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                value = stmt.value
+                attr_target = any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in targets
+                )
+                if value is None or not attr_target:
+                    continue
+                for read in _late_reads(value):
+                    findings.append(ctx.finding(
+                        "late-binding", read,
+                        f"`{dotted_name(read) or read.attr}` captured "
+                        f"into an attribute at {where} — the snapshot "
+                        "diverges from the live value when the plan/"
+                        "membership changes; store the owning object and "
+                        "read per call",
+                    ))
+                continue
+            # compound statements: construction scope extends into their
+            # bodies (a capture under an `if` in __init__ is still a
+            # capture)
+            for attr in ("body", "orelse", "finalbody"):
+                sub_body = getattr(stmt, attr, None)
+                if sub_body:
+                    scan_construction_stmts(sub_body, where)
+            for h in getattr(stmt, "handlers", None) or []:
+                scan_construction_stmts(h.body, where)
+            # lambda defaults hiding in the statement's own expressions
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.stmt):
+                    continue  # handled by the recursion above
+                for leaf in ast.walk(sub):
+                    if isinstance(leaf, ast.Lambda):
+                        for d in _default_exprs(leaf):
+                            for read in _late_reads(d):
+                                findings.append(ctx.finding(
+                                    "late-binding", read,
+                                    f"lambda default captures "
+                                    f"`{dotted_name(read) or read.attr}` "
+                                    f"at {where}; read it in the body "
+                                    "instead",
+                                ))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and item.name in _INIT_METHODS:
+                    scan_construction_stmts(
+                        item.body,
+                        f"construction time (`{node.name}.{item.name}`)",
+                    )
+    return findings

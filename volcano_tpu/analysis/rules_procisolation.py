@@ -1,0 +1,364 @@
+"""proc-isolation: what survives today only because of the GIL.
+
+ROADMAP item 1 — multi-process shard servers behind one logical store —
+needs a machine-checked inventory of every place the current
+single-process implementation shares state in ways a process boundary
+breaks.  This rule produces that inventory for the shard-seam module set
+(``store/server.py``, ``store/partition.py``, ``store/replica.py``,
+``store/store.py``, ``scheduler/apply.py``), in three classes:
+
+1. **module-global mutation from a verb path** — a module-level mutable
+   (dict/list/set) written by a function reachable from an HTTP verb,
+   a store verb, or the replica apply.  In one process that is shared
+   state "for free"; across processes each worker silently gets its own
+   copy and the aggregate lies.
+
+2. **cross-shard object references** — a write that fans out across the
+   per-shard index space from one shard's apply path (``for s in
+   range(self.shards): self._shard_seq[s] = ...``).  In-process this is
+   a cheap broadcast; across processes it is a cross-shard write that
+   needs a protocol.
+
+3. **unlocked read-modify-write** — ``x.attr += 1`` on a shared
+   attribute of a lock-owning class, outside any ``with <lock>`` hold.
+   The GIL makes the single bytecode races merely unlikely; a
+   multi-process (or free-threaded) build makes them lost updates.
+
+Findings are designed to be consumed via ``--worklist`` (suppressed
+findings stay in the JSON output, marked, with the justifying comment
+attached) so the multi-process PR starts from a complete inventory, and
+every deferred item is ALSO listed in ROADMAP item 1's acceptance notes.
+
+Structural exemptions: ``__init__``-family and recovery/replay entry
+points (``_load*``, ``_recover*``, ``reset*``, ``_replay*``,
+``_absorb*``) are single-threaded by contract and exempt from the RMW
+check; thread-local state (an attribute chain through ``_tl``) is
+per-thread by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from volcano_tpu.analysis.core import (
+    Finding,
+    FunctionSummary,
+    MUTATE_VERBS,
+    ProjectContext,
+    dotted_name,
+    rule,
+)
+from volcano_tpu.analysis.rules_concurrency import class_lock_context
+
+_SEAM_SUFFIXES = (
+    "store/server.py",
+    "store/partition.py",
+    "store/replica.py",
+    "store/store.py",
+    "scheduler/apply.py",
+)
+
+_MUTATOR_METHODS = {
+    "append", "add", "pop", "clear", "update", "setdefault", "popitem",
+    "extend", "remove", "discard", "insert",
+}
+
+_INIT_METHODS = {
+    "__init__", "__setstate__", "__getstate__", "__new__", "__post_init__",
+}
+
+_RECOVERY_PREFIXES = ("_load", "_recover", "reset", "_replay", "_absorb")
+
+#: lock-ish context-manager name tails: `with self._mu:`, `with srv.lock:`
+_LOCKISH = ("lock", "_mu", "_cv", "cond")
+
+
+def _in_seam(relpath: str) -> bool:
+    return any(relpath.endswith(s) for s in _SEAM_SUFFIXES)
+
+
+def _is_recovery(name: str) -> bool:
+    return name in _INIT_METHODS or any(
+        name.startswith(p) for p in _RECOVERY_PREFIXES
+    )
+
+
+def _verb_roots(pctx: ProjectContext) -> List[str]:
+    """HTTP verbs, seam-class store verbs, and the replica apply."""
+    roots = []
+    for s in pctx.summaries.values():
+        if not _in_seam(s.relpath):
+            continue
+        if s.name.startswith("do_") and s.cls is not None:
+            roots.append(s.fqn)
+        elif s.cls is not None and s.name in MUTATE_VERBS:
+            roots.append(s.fqn)
+        elif s.name in ("apply_record", "apply"):
+            roots.append(s.fqn)
+    return roots
+
+
+def _module_globals(tree: ast.AST) -> Dict[str, int]:
+    """Module-level names bound to mutable literals/constructors."""
+    out: Dict[str, int] = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        value = node.value
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                                     ast.DictComp, ast.SetComp))
+        if isinstance(value, ast.Call):
+            ctor = dotted_name(value.func) or ""
+            mutable = ctor.split(".")[-1] in (
+                "dict", "list", "set", "defaultdict", "OrderedDict",
+                "Counter", "deque",
+            )
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and not t.id.isupper():
+                # SCREAMING_CASE module constants that are never written
+                # are config tables; they are caught below only if a
+                # verb path actually mutates them
+                out[t.id] = node.lineno
+            elif isinstance(t, ast.Name):
+                out[t.id] = node.lineno
+    return out
+
+
+def _lock_attrs(pctx: ProjectContext, rel: str) -> Set[str]:
+    """Attribute names assigned from lock factories/ctors in this file."""
+    ctx = pctx.contexts[rel]
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Attribute):
+            continue
+        val = node.value
+        calls = [val]
+        if isinstance(val, ast.ListComp):
+            calls = [val.elt]
+        for c in calls:
+            if isinstance(c, ast.Call):
+                ctor = (dotted_name(c.func) or "").split(".")[-1]
+                if ctor in ("make_lock", "make_rlock", "make_condition",
+                            "Lock", "RLock", "Condition", "Semaphore"):
+                    out.add(tgt.attr)
+    return out
+
+
+def _effectively_locked(pctx: ProjectContext, rel: str) -> Set[str]:
+    """Qualnames ("Class.method") that are construction-only or
+    called-locked per rules_concurrency's per-class fixpoint — an RMW
+    inside them holds the caller's lock even without a lexical `with`."""
+    ctx = pctx.contexts[rel]
+    memo = ctx.cache.get("procisolation_locked")
+    if memo is not None:
+        return memo
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        lc = class_lock_context(ctx, node)
+        if lc is None:
+            continue
+        for m in lc.init_reach | lc.locked_methods:
+            out.add(f"{node.name}.{m}")
+    ctx.cache["procisolation_locked"] = out
+    return out
+
+
+def _under_lock(fn: ast.AST, target: ast.AST) -> bool:
+    """True when ``target`` sits lexically inside a ``with`` whose
+    context expression names a lock-ish attribute."""
+
+    def contains(node: ast.AST) -> bool:
+        return any(sub is target for sub in ast.walk(node))
+
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            locked = False
+            for item in node.items:
+                name = dotted_name(item.context_expr)
+                tail = (name or "").split(".")[-1]
+                if any(k in tail for k in _LOCKISH) or (
+                    isinstance(item.context_expr, ast.Call)
+                    and any(k in (dotted_name(item.context_expr.func) or "")
+                            for k in _LOCKISH)
+                ):
+                    locked = True
+            if locked and contains(node):
+                return True
+        for sub in ast.iter_child_nodes(node):
+            if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or sub is fn:
+                stack.extend([sub])
+    return False
+
+
+def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _global_mutations(
+    fn: ast.AST, globals_: Dict[str, int],
+) -> Iterable[Tuple[int, str, str]]:
+    """(line, name, how) for mutations of module globals in ``fn``."""
+    declared = {
+        n for node in _own_nodes(fn) if isinstance(node, ast.Global)
+        for n in node.names
+    }
+    for node in _own_nodes(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in globals_:
+                    yield (node.lineno, t.value.id, "subscript write")
+                elif isinstance(t, ast.Name) and t.id in declared \
+                        and t.id in globals_:
+                    yield (node.lineno, t.id, "rebind via `global`")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in globals_:
+                    yield (node.lineno, t.value.id, "`del`")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATOR_METHODS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in globals_:
+            yield (node.lineno, node.func.value.id,
+                   f"`.{node.func.attr}()`")
+
+
+def _cross_shard_writes(fn: ast.AST) -> Iterable[Tuple[int, str]]:
+    """Writes fanning out across the per-shard index space: a subscript
+    write ``<x>._shard*[i] = ...`` where ``i`` is the variable of an
+    enclosing ``for i in range(...shard...)`` loop."""
+    for node in _own_nodes(fn):
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            continue
+        if not isinstance(node.target, ast.Name):
+            continue
+        loopvar = node.target.id
+        it = node.iter
+        spans_shards = False
+        if isinstance(it, ast.Call) \
+                and (dotted_name(it.func) or "") == "range":
+            for sub in ast.walk(it):
+                if isinstance(sub, ast.Attribute) and "shard" in sub.attr:
+                    spans_shards = True
+                if isinstance(sub, ast.Name) and "shard" in sub.id:
+                    spans_shards = True
+        if not spans_shards:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.slice, ast.Name) \
+                            and t.slice.id == loopvar:
+                        name = dotted_name(t.value) or "?"
+                        if "_shard" in name.split(".")[-1]:
+                            yield (sub.lineno, name)
+
+
+def _unlocked_rmw(
+    fn: ast.AST, lock_attrs: Set[str],
+) -> Iterable[Tuple[int, str]]:
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.AugAssign):
+            continue
+        t = node.target
+        if not isinstance(t, ast.Attribute):
+            continue
+        name = dotted_name(t) or t.attr
+        parts = name.split(".")
+        if "_tl" in parts:
+            continue  # thread-local by construction
+        if t.attr in lock_attrs:
+            continue
+        if not _under_lock(fn, node):
+            yield (node.lineno, name)
+
+
+@rule(
+    "proc-isolation",
+    "state in the shard-seam module set that survives only by GIL "
+    "atomicity or single-process memory sharing: a module-level mutable "
+    "global mutated from a verb path, a cross-shard fan-out write, or an "
+    "unlocked read-modify-write on a shared attribute — each one breaks "
+    "when the shards become processes (ROADMAP item 1); fix it now or "
+    "defer it with a justified suppression that `--worklist` keeps "
+    "visible",
+    scope="project",
+)
+def check_proc_isolation(pctx: ProjectContext) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    reachable = pctx.reachable_from(_verb_roots(pctx))
+    for rel in sorted(pctx.contexts):
+        if not _in_seam(rel):
+            continue
+        globals_ = _module_globals(pctx.contexts[rel].tree)
+        lock_attrs = _lock_attrs(pctx, rel)
+        for summary in pctx.functions_in(rel):
+            fn = summary.node
+            on_verb_path = summary.fqn in reachable
+            if globals_ and on_verb_path:
+                for line, gname, how in _global_mutations(fn, globals_):
+                    findings.append(pctx.finding(
+                        "proc-isolation", summary, line,
+                        f"{how} on module global `{gname}` from the verb "
+                        f"path `{summary.qualname}` — per-process copies "
+                        "diverge silently once shards are processes; move "
+                        "the state onto the store/server object or behind "
+                        "an explicit shared channel",
+                    ))
+            for line, name in _cross_shard_writes(fn):
+                findings.append(pctx.finding(
+                    "proc-isolation", summary, line,
+                    f"cross-shard fan-out write to `{name}` in "
+                    f"`{summary.qualname}` — one shard's apply path "
+                    "writes every shard's slot; across processes this "
+                    "needs a broadcast protocol, not a loop",
+                ))
+            if _is_recovery(summary.name):
+                continue  # single-threaded by contract
+            if not lock_attrs:
+                continue
+            if summary.qualname in _effectively_locked(pctx, rel):
+                continue  # construction-only or called-locked helper
+            for line, name in _unlocked_rmw(fn, lock_attrs):
+                findings.append(pctx.finding(
+                    "proc-isolation", summary, line,
+                    f"unlocked read-modify-write `{name} += ...` in "
+                    f"`{summary.qualname}` of a lock-owning class — only "
+                    "GIL atomicity makes this a non-race today; take the "
+                    "owning lock (or make the counter explicitly "
+                    "single-writer)",
+                ))
+    return findings

@@ -1,0 +1,140 @@
+"""Runtime effect-order sanitizer — the dynamic twin of wal-effect-order.
+
+``vtlint``'s interprocedural ``wal-effect-order`` rule proves the SOURCE
+orders in-memory mutation before WAL append before any observable effect
+(beacon ship, replication ship, durability ack); this module cross-checks
+the claim against real execution.  When ``VOLCANO_TPU_EFFECT_SANITIZER=1``
+(``make sanitize`` sets it for the daemons/replication suites; child
+daemon processes inherit it), the store/replica hot paths record the
+(mutate, append, beacon, ship, ack) sequence per handler thread and any
+observable effect reached while a mutation is still un-appended raises
+:class:`EffectOrderError` at the exact offending site — including windows
+the static rule accepts by its caller-granularity contract (a callee
+raising between its own mutate and append while the caller swallows the
+exception and acks anyway).
+
+When the env flag is off (the default), every hook is one dict lookup and
+a return: zero overhead, zero behavior change.
+
+Threading model: the sequence is thread-local.  HTTP handler threads
+serve one request at a time; the replicator pump is its own thread.  An
+injected crash (``chaos.InjectedCrash``, a ``SystemExit``) kills the
+thread, taking its pending state with it — exactly like the process
+death it simulates.  ``abandon()`` is for the OTHER failure shape: an
+``except Exception`` guard that swallows a failed request and keeps the
+thread alive for the next one (the 500-reply paths), where stale pending
+state would otherwise leak into an unrelated request.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Tuple
+
+ENV_FLAG = "VOLCANO_TPU_EFFECT_SANITIZER"
+
+
+class EffectOrderError(AssertionError):
+    """An observable effect ran before the WAL append covering a pending
+    in-memory mutation — the runtime analogue of wal-effect-order."""
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0", "false", "no")
+
+
+_tls = threading.local()
+
+
+def _seq() -> List[Tuple[str, str]]:
+    seq = getattr(_tls, "seq", None)
+    if seq is None:
+        seq = []
+        _tls.seq = seq
+    return seq
+
+
+def _pending() -> List[str]:
+    pend = getattr(_tls, "pending", None)
+    if pend is None:
+        pend = []
+        _tls.pending = pend
+    return pend
+
+
+def note_mutate(site: str = "") -> None:
+    """An in-memory store mutation the WAL must cover just happened.
+    Call ONLY when a WAL is configured — wal-less servers have no append
+    obligation (the static rule's configuration-guard exemption)."""
+    if not enabled():
+        return
+    _pending().append(site)
+    seq = _seq()
+    seq.append(("mutate", site))
+    del seq[:-16]
+
+
+def note_append(site: str = "") -> None:
+    """The WAL record covering every pending mutation was appended."""
+    if not enabled():
+        return
+    _pending().clear()
+    seq = _seq()
+    seq.append(("append", site))
+    del seq[:-16]
+
+
+def _observable(kind: str, site: str) -> None:
+    if not enabled():
+        return
+    pend = _pending()
+    seq = _seq()
+    seq.append((kind, site))
+    if pend:
+        trail = " -> ".join(f"{k}@{s or '?'}" for k, s in seq[-8:])
+        pend_sites = ", ".join(p or "?" for p in pend)
+        _reset()
+        raise EffectOrderError(
+            f"{kind} effect at {site or '?'} while mutation(s) at "
+            f"[{pend_sites}] are not yet WAL-appended — a crash here "
+            f"acks/ships state the log cannot replay (recent effects: "
+            f"{trail})"
+        )
+    del seq[:-16]  # bounded trace: keep the recent tail only
+
+
+def note_beacon(site: str = "") -> None:
+    """A digest beacon is being SHIPPED (replication feed).  Local-only
+    beacons (``repl is None``) are not observable and must not call
+    this."""
+    _observable("beacon", site)
+
+
+def note_ship(site: str = "") -> None:
+    """A record is entering the replication feed queue."""
+    _observable("ship", site)
+
+
+def note_ack(site: str = "") -> None:
+    """A durability ack (fsync + HTTP success) is being issued."""
+    _observable("ack", site)
+
+
+def _reset() -> None:
+    _pending().clear()
+    del _seq()[:]
+
+
+def abandon(site: str = "") -> None:
+    """The current request failed and will be answered with an error
+    (no ack): drop its pending state so the reused handler thread does
+    not leak it into the next request."""
+    if not enabled():
+        return
+    _reset()
+
+
+def pending_count() -> int:
+    """Test hook: number of un-appended mutations on this thread."""
+    return len(_pending())

@@ -51,7 +51,7 @@ import urllib.request
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from volcano_tpu import vtaudit
+from volcano_tpu import effectsan, vtaudit
 from volcano_tpu.backoff import Backoff
 from volcano_tpu.chaos import InjectedCrash, crash_point
 from volcano_tpu.leader import LeaderElector
@@ -230,6 +230,7 @@ class Replicator:
         right after ``wal.append`` returned ``ticket``).  The record is
         NOT yet shippable — ``on_commit`` advances the watermark once
         its shard's fsync covers the ticket."""
+        effectsan.note_ship("Replicator.log_append")
         from volcano_tpu.store.partition import wal_shard
 
         nshards = getattr(self.srv.wal, "nshards", 1)
@@ -244,6 +245,7 @@ class Replicator:
         the server lock).  Beacons consume a seq but are never WAL'd;
         without this, follower seq lines would drift one behind per
         beacon and every block row after it would misalign."""
+        effectsan.note_beacon("Replicator.log_beacon")
         rec = {"op": "beacon", "seq": int(seq), "rv": self.srv.store._rv,
                "digest": payload, "when": ts}
         with self._mu:
@@ -364,7 +366,8 @@ class Replicator:
 
     def _feed_snapshot(self) -> Dict[str, Any]:
         snap = self.srv.snapshot_payload()
-        self.snapshots_served += 1
+        with self._mu:  # counter is read by status() under the same lock
+            self.snapshots_served += 1
         out = {"snapshot": snap, "next": snap["seq"]}
         self._stamp_feed(out)
         return out
@@ -433,11 +436,13 @@ class Replicator:
             except ReplicationAckTimeout:
                 # a leader whose followers are all down cannot renew
                 # under sync ack; pace the retry, don't die
+                effectsan.abandon("Replicator._run")
                 self._stop.wait(bo.next())
             except _TRANSIENT:
                 # leader unreachable / malformed reply: pace with the
                 # decorrelated-jitter backoff, then let the election
                 # check decide whether to keep following or promote
+                effectsan.abandon("Replicator._run")
                 if self.role != "leader":
                     self._maybe_elect()
                 self._stop.wait(bo.next())
@@ -702,6 +707,8 @@ def apply_record(srv, repl: Replicator, rec: Dict[str, Any]) -> None:
             store.delete(kind, rec.get("key", ""))
         else:
             return  # unknown op from a newer leader: skip, stay aligned
+        if srv.wal is not None:
+            effectsan.note_mutate("replica.apply_record")
         srv._pump_log()
         if srv.wal is not None:
             srv._wal_append(dict(rec))

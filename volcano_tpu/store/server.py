@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
-from volcano_tpu import timeseries, trace, vtaudit, vtprof
+from volcano_tpu import effectsan, timeseries, trace, vtaudit, vtprof
 from volcano_tpu.chaos import ChaosPlanError, FaultPlan, env_plan, fire_crash
 from volcano_tpu.locksan import make_lock, make_rlock
 from volcano_tpu.store.codec import (
@@ -462,6 +462,7 @@ class StoreServer:
                         results = server.bulk(body.get("ops") or [])
                         code, payload = 200, {"results": results}
                     except Exception as e:  # noqa: BLE001 — wire boundary
+                        effectsan.abandon("Handler.500")
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
                 if len(parts) == 2 and parts[0] == "apis":
@@ -470,6 +471,7 @@ class StoreServer:
                         if code < 400:  # failed verbs wrote nothing
                             server._commit_ack()
                     except Exception as e:  # noqa: BLE001 — wire boundary
+                        effectsan.abandon("Handler.500")
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
                 return self._reply(404, {"error": "no route"})
@@ -495,6 +497,7 @@ class StoreServer:
                         if code < 400:
                             server._commit_ack()
                     except Exception as e:  # noqa: BLE001
+                        effectsan.abandon("Handler.500")
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
                 return self._reply(404, {"error": "no route"})
@@ -519,6 +522,7 @@ class StoreServer:
                         if code < 400:
                             server._commit_ack()
                     except Exception as e:  # noqa: BLE001
+                        effectsan.abandon("Handler.500")
                         code, payload = 500, {"error": repr(e)}
                     return self._reply(code, payload)
                 return self._reply(404, {"error": "no route"})
@@ -540,6 +544,8 @@ class StoreServer:
                     key = q.get("key", [""])[0]
                     with server.lock:
                         obj = server.store.delete(parts[1], key)
+                        if obj is not None and server.wal is not None:
+                            effectsan.note_mutate("Handler.do_DELETE")
                         server._pump_log()
                         if obj is not None and server.wal is not None:
                             server._wal_append({"op": "delete",
@@ -548,6 +554,7 @@ class StoreServer:
                     try:
                         server._commit_ack()
                     except Exception as e:  # noqa: BLE001 — wire boundary
+                        effectsan.abandon("Handler.500")
                         return self._reply(500, {"error": repr(e)})
                     return self._reply(200, {"deleted": obj is not None})
                 return self._reply(404, {"error": "no route"})
@@ -610,6 +617,7 @@ class StoreServer:
         rec["seq"] = self.seq
         rec["rv"] = self.store._rv
         ticket = self.wal.append(rec)
+        effectsan.note_append("StoreServer._wal_append")
         if self.repl is not None:
             # replication log entry (store/replica.py): shippable once
             # this shard's fsync watermark covers the ticket (followers
@@ -626,6 +634,7 @@ class StoreServer:
         metrics.register_wal_append()
 
     def _commit_ack(self, _repl_sync: bool = True) -> None:
+        effectsan.note_ack("StoreServer._commit_ack")
         """The durability barrier between a successful mutation and its
         2xx reply: group-commit fsync the WAL tail (ACK-after-append —
         the etcd contract), then any sync-persist snapshot flush.  The
@@ -662,6 +671,8 @@ class StoreServer:
             if self.store.get(kind, obj.meta.key) is not None:
                 return 409, {"error": f"{kind} {obj.meta.key} already exists"}
             self.store.create(kind, obj)
+            if self.wal is not None:
+                effectsan.note_mutate("StoreServer.create")
             if kind != "Job":  # admission may have mutated a Job
                 self._stage_enc_hint(kind, obj, data.get("object"))
             self._pump_log()
@@ -696,6 +707,8 @@ class StoreServer:
                 if not ok:
                     return 422, {"error": msg}
             self.store.update(kind, obj)
+            if self.wal is not None:
+                effectsan.note_mutate("StoreServer.update")
             self._stage_enc_hint(kind, obj, data.get("object"))
             self._pump_log()
             if self.wal is not None:
@@ -724,6 +737,8 @@ class StoreServer:
                 return 404, {"error": f"NotFound: {e}"}
             except PreconditionFailed as e:
                 return 409, {"error": repr(e)}
+            if self.wal is not None:
+                effectsan.note_mutate("StoreServer.patch")
             self._pump_log()
             if self.wal is not None:
                 rec = {"op": "patch", "kind": kind, "key": key,
@@ -793,11 +808,7 @@ class StoreServer:
                         results.append(self._apply_segment(op, _in_bulk=True))
                         continue
                     elif verb == "delete":
-                        deleted = self.store.delete(kind, op.get("key", ""))
-                        self._pump_log()
-                        if deleted is not None and self.wal is not None:
-                            self._wal_append({"op": "delete", "kind": kind,
-                                              "key": op.get("key", "")})
+                        self._bulk_delete(kind, op.get("key", ""))
                         ok, payload = True, {}
                     else:
                         ok, payload = False, {"error": f"unknown bulk op {verb!r}"}
@@ -806,6 +817,20 @@ class StoreServer:
                     results.append(repr(e))
         self._commit_ack()
         return results
+
+    def _bulk_delete(self, kind: str, key: str) -> None:
+        """One bulk delete op, mutation through WAL append in a single
+        call frame: the batch loop's per-op isolation swallows exceptions
+        and then acks the batch, so the mutate→append window must not
+        straddle statements of the loop body (wal-effect-order) — inlined
+        there, a `_pump_log` failure would leave the delete in memory,
+        unlogged, and acked."""
+        deleted = self.store.delete(kind, key)
+        if deleted is not None and self.wal is not None:
+            effectsan.note_mutate("StoreServer._bulk_delete")
+        self._pump_log()
+        if deleted is not None and self.wal is not None:
+            self._wal_append({"op": "delete", "kind": kind, "key": key})
 
     def _patch_col(self, op: Dict[str, Any]) -> List[Optional[str]]:
         """Expand one columnar patch op: shared kind/field-shape/when, a
@@ -831,6 +856,8 @@ class StoreServer:
                     for f, vals in cols.items():
                         fields[f] = col_dec[f](vals[i])
                     self.store.patch(kind, key, fields, when=when_dec)
+                    if self.wal is not None:
+                        effectsan.note_mutate("StoreServer._patch_col")
                     out.append(None)
                 except KeyError as e:
                     out.append(f"NotFound: {e}")
@@ -915,6 +942,8 @@ class StoreServer:
             if stamp is None:
                 stamp = time.time()
             res = self.store.apply_segment_lazy(seg, stamp=stamp)
+            if self.wal is not None:
+                effectsan.note_mutate("StoreServer._apply_segment")
             plan = self.chaos
             if plan is not None:
                 # seeded kill between store apply and log/WAL append: the
@@ -986,9 +1015,12 @@ class StoreServer:
             self._shard_seq[entry["shard"]] = self.seq
         else:
             # untagged (cross-shard) block: every shard's stream carries
-            # it, so every shard's newest-seq watermark advances
+            # it, so every shard's newest-seq watermark advances.  The
+            # fan-out is an in-process broadcast; the multi-process split
+            # (ROADMAP item 1 acceptance notes) replaces it with a
+            # watermark message on each shard's stream.
             for s in range(self.shards):
-                self._shard_seq[s] = self.seq
+                self._shard_seq[s] = self.seq  # vtlint: disable=proc-isolation
         self.log.append(entry)
 
     # -- digest beacons / audit surface (vtaudit) --------------------------
