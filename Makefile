@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta bench-repl loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
+.PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta bench-repl bench-procs loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings.
@@ -54,10 +54,15 @@ elastic:
 #      offending site (volcano_tpu/effectsan.py, static twin
 #      `wal-effect-order`), exercised under the replication + daemons
 #      suites where the windows actually open
+#   the procmesh leg re-runs the multi-process shard-store suite with
+#   the effect sanitizer armed (the env var rides into the spawned
+#   shard-server processes): every verb path, WAL append, and the
+#   500-abandon rule are checked ACROSS the router hop
 sanitize:
 	VOLCANO_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_daemons.py -q
 	VOLCANO_TPU_EFFECT_SANITIZER=1 $(PY) -m pytest \
 	  tests/test_replication.py tests/test_daemons.py -q
+	VOLCANO_TPU_EFFECT_SANITIZER=1 $(PY) -m pytest tests/test_procmesh.py -q
 
 # vtrace (volcano_tpu/trace.py + tests/test_trace.py): the span runtime,
 # flight recorder, cross-daemon propagation, the armed-vs-disarmed
@@ -167,6 +172,19 @@ bench-delta:
 bench-repl:
 	$(PY) -m pytest tests/test_replication.py -q -p no:cacheprovider
 	$(PY) bench.py --config 13
+
+# vtproc (store/procmesh/ + tests/test_procmesh.py): the multi-process
+# shard store — per-shard OS processes under a ShardSupervisor behind a
+# ShardRouter, one SeqBus seq/rv line.  The tier-1 suite proves merged-
+# /watch byte identity vs a single-process server, the SIGKILL-a-shard
+# storm (restart, zero acked loss, placement parity, `vtctl audit` 0),
+# router decomposition of cross-shard segments/columnar patches, and
+# procNN_s drain attribution; cfg9c (`--config 14`) measures the drain
+# critical path (slowest shard's ship wall) scaling 2 -> 4 shard
+# processes.  CPU containers: set VOLCANO_TPU_CFG9C_SCALE to shrink.
+bench-procs:
+	$(PY) -m pytest tests/test_procmesh.py -q -p no:cacheprovider
+	$(PY) bench.py --config 14
 
 # container images (reference Makefile:40-48 / installer/dockerfile/):
 # `image` = CPU-jax control plane, `image-tpu` = jax[tpu]+libtpu wheel

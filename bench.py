@@ -1031,11 +1031,15 @@ def _build_shard_e2e_store(n_nodes, n_tasks, tasks_per_job=20,
     return store
 
 
-def _cfg9_run(n_nodes, n_tasks, shards, mesh_setting, prof=True):
+def _cfg9_run(n_nodes, n_tasks, shards, mesh_setting, prof=True, procs=0):
     """One end-to-end cfg9 pass: partitioned apiserver in its own OS
     process, the store loaded over the wire, a mesh-conf'd Scheduler on
     a RemoteStore, one timed cycle + off-cycle drain.  Returns plain
-    measurement data (the server dies on return)."""
+    measurement data (the server dies on return).  ``procs > 0`` swaps
+    the single partitioned server for the procmesh deployment: that
+    many shard-server OS processes under a ShardSupervisor, fronted by
+    a ShardRouter — the client learns the shard map from ``/healthz``
+    and ships sub-segments straight to the shard processes."""
     import multiprocessing as mp
 
     from volcano_tpu import vtprof
@@ -1043,13 +1047,21 @@ def _cfg9_run(n_nodes, n_tasks, shards, mesh_setting, prof=True):
     from volcano_tpu.scheduler.scheduler import Scheduler
     from volcano_tpu.store.client import RemoteStore
 
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    srv_proc = ctx.Process(target=_apiserver_proc,
-                           args=(q, "", False, 0.25, shards), daemon=True)
-    srv_proc.start()
+    sup = router = srv_proc = None
+    if procs > 0:
+        from volcano_tpu.store.procmesh import ShardRouter, ShardSupervisor
+
+        sup = ShardSupervisor(procs).start()
+        router = ShardRouter(sup.shard_map, supervisor=sup).start()
+    else:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        srv_proc = ctx.Process(target=_apiserver_proc,
+                               args=(q, "", False, 0.25, shards),
+                               daemon=True)
+        srv_proc.start()
     try:
-        url = q.get(timeout=120)
+        url = router.url if router is not None else q.get(timeout=120)
         remote = RemoteStore(url)
         local = _build_shard_e2e_store(n_nodes, n_tasks)
         t0 = time.perf_counter()
@@ -1101,8 +1113,13 @@ def _cfg9_run(n_nodes, n_tasks, shards, mesh_setting, prof=True):
                              and sched.fast_cycle.mirror is not None),
         }
     finally:
-        srv_proc.terminate()
-        srv_proc.join(timeout=5)
+        if router is not None:
+            router.stop()
+        if sup is not None:
+            sup.stop()
+        if srv_proc is not None:
+            srv_proc.terminate()
+            srv_proc.join(timeout=5)
 
 
 def config9_shard(scale=None):
@@ -1125,7 +1142,7 @@ def config9_shard(scale=None):
     shard_attr = {
         k: round(v, 3)
         for k, v in sorted(run["drain_kinds"].items())
-        if k.startswith("shard")
+        if k.startswith(("shard", "proc"))
     }
     _print_json({
         "metric": "cfg9_mesh_sharded_1m_x_100k",
@@ -1180,12 +1197,85 @@ def config9_shard(scale=None):
             "drain_shards_s": {
                 k: round(v, 3)
                 for k, v in sorted(sharded["drain_kinds"].items())
-                if k.startswith("shard")
+                if k.startswith(("shard", "proc"))
             },
             "sharded_wire_s": round(
                 sharded["drain_kinds"].get("wire_s", 0.0), 3),
             "single_wire_s": round(
                 single["drain_kinds"].get("wire_s", 0.0), 3),
+            "device": str(jax.devices()[0]),
+        },
+    })
+
+
+def config9_procs(scale=None):
+    """cfg9c: the cfg9b drain comparison re-measured against the
+    MULTI-PROCESS shard store (store/procmesh): N shard-server OS
+    processes under a ShardSupervisor behind a ShardRouter, the applier
+    shipping sub-segments straight to the shard processes (drain
+    attribution under ``procNN_s`` keys).  Sweeps 1 -> 2 -> 4 shard
+    processes over the cfg7-shaped workload; the partitioning claim
+    across the process seam is the per-doubling drain scaling.
+    VOLCANO_TPU_CFG9C_SCALE shrinks for CPU containers/CI;
+    VOLCANO_TPU_CFG9C_PROCS caps the sweep (default 4)."""
+    import jax
+
+    if scale is None:
+        scale = float(os.environ.get("VOLCANO_TPU_CFG9C_SCALE", "1.0"))
+    max_procs = int(os.environ.get("VOLCANO_TPU_CFG9C_PROCS", "4"))
+    n_nodes = max(int(N_NODES * scale), 64)
+    n_tasks = max(int(N_TASKS * scale), 640)
+
+    # the headline is the drain CRITICAL PATH: sub-segments ship to the
+    # shard processes concurrently, so the cycle's drain completes when
+    # the SLOWEST shard's ship wall does — max(procNN_s).  (The post-
+    # publish wait the cfg9 headline uses reads 0 here: the async drain
+    # overlaps publish entirely at CI scales.)  The 1-process baseline
+    # is cfg9b's claim; this sweep doubles PROCESSES: 2 -> 4.
+    sweep = [2]
+    while sweep[-1] * 2 <= max_procs:
+        sweep.append(sweep[-1] * 2)
+    runs = {}
+    walls = {}
+    for nprocs in sweep:
+        run = _cfg9_run(n_nodes, n_tasks, 1, "off",
+                        prof=(nprocs == sweep[-1]), procs=nprocs)
+        shard_walls = [v for k, v in run["drain_kinds"].items()
+                       if k.startswith("proc")]
+        assert shard_walls, (
+            f"procmesh drain produced no procNN_s keys: "
+            f"{sorted(run['drain_kinds'])}")
+        runs[nprocs] = run
+        walls[nprocs] = max(shard_walls)
+    head = runs[sweep[-1]]
+    scaling = {
+        f"{sweep[i]}->{sweep[i + 1]}": round(
+            walls[sweep[i + 1]] / max(walls[sweep[i]], 1e-9), 3)
+        for i in range(len(sweep) - 1)
+    }
+    _print_json({
+        "metric": "cfg9c_procmesh_drain",
+        "value": round(walls[sweep[-1]], 4),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "n_tasks": n_tasks, "n_nodes": n_nodes, "scale": scale,
+            "shard_procs": sweep[-1],
+            "slowest_shard_ship_s": {n: round(w, 4)
+                                     for n, w in walls.items()},
+            "scaling_per_doubling": scaling,
+            "publish_s": round(head["publish"], 4),
+            "pods_bound": head["bound"],
+            "drain_shards_s": {
+                k: round(v, 3)
+                for k, v in sorted(head["drain_kinds"].items())
+                if k.startswith(("shard", "proc"))
+            },
+            "drain_wire_s": round(
+                head["drain_kinds"].get("wire_s", 0.0), 3),
+            "prof_attribution": head["coverage"],
+            "store_load_s": round(head["load_s"], 1),
+            "path": "fastpath" if head["fastpath"] else "object",
             "device": str(jax.devices()[0]),
         },
     })
@@ -1535,7 +1625,7 @@ def config11_repl(scale=None, readers=None, n_events=None, window_s=None,
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes,
            10: config8_open_loop, 11: config9_shard, 12: config10_delta,
-           13: config11_repl}
+           13: config11_repl, 14: config9_procs}
 
 
 # -- bench trajectory + continuous perf-regression gate (vtprof PR) -----------
@@ -1558,6 +1648,7 @@ GATED_METRICS = (
     "cfg9_mesh_sharded_1m_x_100k",
     "cfg10_delta_steady_state_micro_cycle",
     "cfg11_repl_fanout_watch_reads",
+    "cfg9c_procmesh_drain",
 )
 #: band slack over the best same-device trajectory reading: headline
 #: values breathe ±15% run-to-run on the tunnel (BASELINE.md), phases
@@ -1906,6 +1997,7 @@ CONFIG_METRIC = {
     11: "cfg9_mesh_sharded_1m_x_100k",
     12: "cfg10_delta_steady_state_micro_cycle",
     13: "cfg11_repl_fanout_watch_reads",
+    14: "cfg9c_procmesh_drain",
 }
 
 
@@ -1966,6 +2058,7 @@ def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
             # the window amortizes per-pass overhead — a cut-down run
             # would breach a band captured from the real configuration
             13: config11_repl,
+            14: config9_procs,
         }
     for n in configs:
         fn = runners.get(n)

@@ -76,6 +76,7 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
                   state: str = "", wal: bool = False, shards: int = 1,
                   replica_of: str = "", peers: str = "", repl_ack: str = "",
                   identity: str = "", lease_duration: float = 5.0,
+                  proc_shards: int = 0, proc_replicas: int = 1,
                   announce=print) -> None:
     """``state`` names a JSON file the server persists all objects to (the
     etcd analogue): a restarted apiserver resumes with every CRD, and
@@ -100,6 +101,12 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
     from volcano_tpu.store.server import StoreServer
 
     trace.set_component("apiserver")
+    if proc_shards > 0:
+        return _run_apiserver_procmesh(
+            port=port, host=host, default_queue=default_queue, state=state,
+            wal=wal, proc_shards=proc_shards, proc_replicas=proc_replicas,
+            repl_ack=repl_ack or "sync", announce=announce,
+        )
     peer_urls = [p.strip() for p in peers.split(",") if p.strip()]
     repl = None
     if replica_of or peer_urls or repl_ack:
@@ -138,6 +145,58 @@ def run_apiserver(port: int = 0, host: str = "127.0.0.1", default_queue: bool = 
         # still works; that is what the WAL recovers from).
         signal.signal(signal.SIGTERM, signal.SIG_IGN)
         srv.stop()
+
+
+def _run_apiserver_procmesh(port: int, host: str, default_queue: bool,
+                            state: str, wal: bool, proc_shards: int,
+                            proc_replicas: int, repl_ack: str,
+                            announce=print) -> None:
+    """``apiserver --proc-shards N``: each store shard in its OWN OS
+    process (store/procmesh), the router serving the apiserver port.
+    The supervisor owns the shared seq/rv line and restarts dead shard
+    members; the router is the single URL legacy clients keep using
+    (mesh-aware clients pick up the shard map from its ``/healthz``)."""
+    from volcano_tpu.api.objects import Metadata, Queue
+    from volcano_tpu.store.client import RemoteStore
+    from volcano_tpu.store.procmesh import ShardRouter, ShardSupervisor
+
+    if proc_replicas > 1 and not (wal and state):
+        raise SystemExit("per-shard replication requires --wal and --state: "
+                         "the feed ships fsynced WAL records")
+    if wal and not state:
+        raise SystemExit("--wal requires --state (the WAL checkpoints into "
+                         "the shard snapshots)")
+    sup = ShardSupervisor(
+        proc_shards, host=host, state=state or None,
+        wal=(state + ".wal") if wal else None,
+        replicas=proc_replicas, repl_ack=repl_ack,
+    ).start()
+    router = ShardRouter(sup.shard_map, supervisor=sup,
+                         host=host, port=port).start()
+    if default_queue:
+        # seed THROUGH the router so the record lands on its namespace
+        # shard with a WAL/watch entry like any client write
+        rs = RemoteStore(router.url)
+        if rs.get("Queue", "/default") is None:
+            try:
+                rs.create("Queue", Queue(meta=Metadata(name="default",
+                                                       namespace="")))
+            except KeyError:
+                pass  # raced another seeder (supervisor restart)
+    announce(f"apiserver (procmesh shards={proc_shards}) listening on "
+             f"{router.url}", flush=True)
+    install_sigterm_exit()
+    try:
+        # the router serves from its own thread; park here until SIGTERM
+        # (install_sigterm_exit turns it into SystemExit on this thread)
+        while True:
+            signal.pause()
+    finally:
+        # same graceful-shutdown shape as the in-process apiserver: a
+        # second SIGTERM must not abort the shard flushes mid-write
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        router.stop()
+        sup.stop()
 
 
 def run_controller(server: str, identity: str = "", leader_elect: bool = True,

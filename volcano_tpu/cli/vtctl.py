@@ -528,6 +528,30 @@ def cmd_replica_list(urls, out: Optional[io.TextIOBase] = None) -> str:
     return text
 
 
+def cmd_shard_list(server_url: str,
+                   out: Optional[io.TextIOBase] = None) -> str:
+    """One row per procmesh member (``/procmesh/shards``, served by the
+    router/supervisor): shard index, role, URL, pid, liveness, restart
+    count — the operator's view of a multi-process store."""
+    st = _fetch_debug(server_url, "/procmesh/shards")
+    buf = io.StringIO()
+    buf.write(f"shards={st.get('shards', '?')}  "
+              f"replicas={st.get('replicas', 1)}  "
+              f"seq={st.get('seq', '-')}  "
+              f"restarts={st.get('restarts', 0)}\n")
+    row = "%-7s%-10s%-28s%-9s%-7s%s\n"
+    buf.write(row % ("Shard", "Role", "URL", "Pid", "Alive", "Restarts"))
+    for m in st.get("members") or []:
+        buf.write(row % (m.get("shard", "?"), m.get("role", "?"),
+                         m.get("url", "?"), m.get("pid", "-"),
+                         {True: "yes", False: "NO"}.get(m.get("alive"), "?"),
+                         m.get("restarts", 0)))
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
 # -- vtaudit: state-digest audit (volcano_tpu/vtaudit.py) ---------------------
 
 
@@ -1047,6 +1071,16 @@ def main(argv=None) -> int:
                             "apply locks, per-shard WAL files with "
                             "independent group-commit fsync, "
                             "/watch?shard=i fan-out; 1 = unpartitioned")
+    api_p.add_argument("--proc-shards", type=int, default=0,
+                       help="deploy each shard as its OWN OS process "
+                            "behind a router on --port "
+                            "(store/procmesh): supervised shard "
+                            "servers on a shared seq/rv line, merged "
+                            "/watch, per-shard WAL dirs; 0 = in-process")
+    api_p.add_argument("--proc-replicas", type=int, default=1,
+                       help="replica group size per shard process "
+                            "(procmesh only): 2 = each shard leader "
+                            "gets a sync follower on its own WAL/state")
     api_p.add_argument("--replica-of", default="",
                        help="boot as a FOLLOWER of this leader URL "
                             "(store/replica.py): pull the synced WAL "
@@ -1078,6 +1112,14 @@ def main(argv=None) -> int:
     repl_list.add_argument("--peers", default="",
                            help="extra replica URLs to probe beside "
                                 "--server (comma list)")
+
+    # procmesh introspection: per-shard-process liveness/restart panel
+    shard_p = sub.add_parser("shard", parents=[common],
+                             help="inspect a multi-process shard store")
+    shard_sub = shard_p.add_subparsers(dest="cmd")
+    shard_sub.add_parser(
+        "list", parents=[common],
+        help="one row per shard process: role, url, pid, restarts")
 
     for comp in ("controller", "scheduler", "kubelet", "elastic"):
         p = sub.add_parser(comp, parents=[common], help=f"run the {comp} against --server")
@@ -1188,6 +1230,17 @@ def main(argv=None) -> int:
             return 1
         return 0
 
+    if args.group == "shard":
+        if not args.server:
+            print("error: --server is required", file=sys.stderr)
+            return 1
+        try:
+            cmd_shard_list(args.server, out=sys.stdout)
+        except Exception as e:  # surface as CLI error, not traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        return 0
+
     if args.group == "up":
         from volcano_tpu.cli import daemons
 
@@ -1220,7 +1273,9 @@ def main(argv=None) -> int:
                                       peers=args.peers,
                                       repl_ack=args.repl_ack,
                                       identity=args.identity,
-                                      lease_duration=args.lease_duration)
+                                      lease_duration=args.lease_duration,
+                                      proc_shards=args.proc_shards,
+                                      proc_replicas=args.proc_replicas)
             elif args.group == "controller":
                 daemons.run_controller(args.server, identity=args.identity,
                                        leader_elect=not args.no_leader_elect,

@@ -391,8 +391,20 @@ class AsyncApplier:
         if accrue_wire:
             stats["wire_s"] += max(0.0, total - sum(timings.values()))
         if shard is not None:
-            key = f"shard{int(shard):02d}_s"
+            key = f"{self._shard_key_prefix()}{int(shard):02d}_s"
             stats[key] = stats.get(key, 0.0) + total
+
+    def _shard_key_prefix(self) -> str:
+        """Per-shard drain-key family: ``shardNN_s`` against an
+        in-process partitioned bus, ``procNN_s`` when the shards are
+        separate OS processes (procmesh advertises a shard map) — the
+        bench reads the prefix to attribute a drain to the right
+        deployment shape."""
+        try:
+            pm = getattr(self.store, "proc_shard_map", None)
+        except Exception:  # noqa: BLE001 — outage: the ship reports it
+            return "shard"
+        return "proc" if pm else "shard"
 
     def _segment_shard_count(self) -> int:
         """The store's partitioned-bus shard count (1 = unpartitioned;

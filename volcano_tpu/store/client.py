@@ -121,6 +121,10 @@ class RemoteStore:
         #: partitioned-bus shard count advertised by /healthz, fetched
         #: lazily once (1 = unpartitioned, incl. pre-partition servers)
         self._segment_shards: Optional[int] = None
+        #: procmesh shard map (leader URL per shard) advertised by a
+        #: router's /healthz — lets this client ship each sub-segment
+        #: STRAIGHT to its shard's process, skipping the router hop
+        self._proc_map: Optional[List[str]] = None
         #: newest digest beacon seen on the watch stream (vtaudit): the
         #: seq-pinned checkpoint payload a mirror verifies against
         self.last_beacon: Optional[Dict[str, Any]] = None
@@ -160,9 +164,11 @@ class RemoteStore:
         urls += [u for u in (self.peers + [self.url]) if u not in urls]
         self.url = resolve_leader(urls, timeout=self.timeout)
         self._segment_shards = None
+        self._proc_map = None
 
     def _request_once(self, method: str, path: str,
-                      payload: Optional[dict] = None):
+                      payload: Optional[dict] = None,
+                      base: Optional[str] = None):
         data = json.dumps(payload).encode() if payload is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
         if trace.TRACER is not None:
@@ -189,7 +195,8 @@ class RemoteStore:
                         if rule.action == "delay":
                             time.sleep(rule.arg)
                 req = urllib.request.Request(
-                    self.url + path, data=data, method=method, headers=headers,
+                    (base or self.url) + path, data=data, method=method,
+                    headers=headers,
                 )
                 with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                     return resp.status, json.loads(resp.read() or b"{}")
@@ -395,7 +402,20 @@ class RemoteStore:
             if code != 200:
                 raise RemoteStoreError(self._err(code, body))
             self._segment_shards = max(1, int(body.get("shards", 1)))
+            pm = body.get("shard_map") or []
+            self._proc_map = ([str(u).rstrip("/") for u in pm]
+                              if len(pm) == self._segment_shards else None)
         return self._segment_shards
+
+    @property
+    def proc_shard_map(self) -> Optional[List[str]]:
+        """Leader URL per shard when this client points at a procmesh
+        router (``/healthz`` ``shard_map``); None against in-process
+        servers.  Cached with ``segment_shards`` and cleared together on
+        a refollow — a new endpoint may be a different topology."""
+        if self._segment_shards is None:
+            _ = self.segment_shards  # primes both caches
+        return self._proc_map
 
     def apply_segment(self, seg, shard: Optional[int] = None
                       ) -> Dict[str, Any]:
@@ -412,7 +432,28 @@ class RemoteStore:
         op = seg.to_wire()
         if shard is not None:
             op["shard"] = int(shard)
-        code, body = self._request("POST", "/bulk", {"ops": [op]})
+        code, body = None, None
+        if shard is not None:
+            pm = self.proc_shard_map
+            if pm and 0 <= int(shard) < len(pm):
+                # procmesh: ship straight to the shard's own process —
+                # the router hop buys nothing for an already-split
+                # sub-segment.  A dead/demoted shard endpoint falls back
+                # to the routed path ONLY when the direct attempt
+                # provably never went out (connection refused) or came
+                # back NotLeader — a cut mid-flight must surface, same
+                # no-blind-retry contract as ``bulk``.
+                try:
+                    code, body = self._request_once(
+                        "POST", "/bulk", {"ops": [op]}, base=pm[int(shard)])
+                except (OSError, http.client.HTTPException) as e:
+                    if not _never_sent(e):
+                        raise
+                    code = None
+                if code == 421:
+                    code = None
+        if code is None:
+            code, body = self._request("POST", "/bulk", {"ops": [op]})
         if code != 200:
             raise RemoteStoreError(self._err(code, body))
         res = (body.get("results") or [None])[0]

@@ -49,6 +49,16 @@ from volcano_tpu.locksan import make_lock
 _SHARD_DIR_FMT = "s{:02d}"
 
 
+def shard_wal_dir(wal_dir: str, shard: int) -> str:
+    """The WAL directory shard ``shard`` owns under a partitioned bus's
+    root (``<wal>/s00`` …).  ShardedWAL (in-process shards) and the
+    procmesh supervisor (per-shard OS processes) both build from this,
+    so the SAME directory layout serves either deployment — a mesh shard
+    process recovers exactly the segments its in-process predecessor
+    appended, and vice versa."""
+    return os.path.join(wal_dir, _SHARD_DIR_FMT.format(int(shard)))
+
+
 def shard_of(namespace: str, nshards: int) -> int:
     """The shard a namespace's decision stream lands on: crc32 of the
     namespace modulo the shard count — stable across processes and runs
@@ -170,7 +180,7 @@ class ShardedWAL:
         self.dir = dir_path
         self.nshards = nshards
         self.wals: List[WriteAheadLog] = [
-            WriteAheadLog(os.path.join(dir_path, _SHARD_DIR_FMT.format(s)))
+            WriteAheadLog(shard_wal_dir(dir_path, s))
             for s in range(nshards)
         ]
         # serializes floor bookkeeping across rotate/drop (each shard's

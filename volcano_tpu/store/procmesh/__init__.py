@@ -1,0 +1,38 @@
+"""procmesh — per-shard OS processes behind one logical store.
+
+ROADMAP item 1's final form.  The partitioned bus (store/partition.py)
+sharded the decision stream by namespace hash inside ONE process; this
+package deploys each shard as its own ``StoreServer(shards=1)`` process
+while keeping every cross-shard contract the in-process bus already
+proved:
+
+* ``seqbus.SeqBus`` — the shared seq/rv line (two counters in shared
+  memory) whose lock-coupled allocation gives every shard's watch reply
+  a sound completeness watermark;
+* ``supervisor.ShardSupervisor`` — spawns/monitors/restarts the shard
+  processes on stable ports, splits an in-process snapshot into
+  per-shard slices on first boot, and reuses the EXACT ShardedWAL
+  directory layout so the two deployment modes hand the store back and
+  forth; per-shard replica groups (vtrepl) ride along unchanged;
+* ``router.ShardRouter`` — one URL for legacy clients: merged ``/watch``
+  (byte-identical to the single-process stream), fan-out lists, routed
+  writes, and the vtaudit digest rollup that keeps ``vtctl audit``
+  working against a mesh.
+
+Mesh-aware clients skip the router: ``RemoteStore`` reads the shard map
+from ``/healthz`` and ships each namespace shard's traffic straight to
+its process.
+"""
+
+from volcano_tpu.store.procmesh.router import ShardRouter
+from volcano_tpu.store.procmesh.seqbus import SeqBus
+from volcano_tpu.store.procmesh.supervisor import (
+    ShardSupervisor, shard_state_path,
+)
+
+__all__ = [
+    "SeqBus",
+    "ShardRouter",
+    "ShardSupervisor",
+    "shard_state_path",
+]

@@ -1,0 +1,569 @@
+"""Shard router: one URL for an N-process store mesh.
+
+Mesh-aware clients don't need this tier — ``RemoteStore`` learns the
+shard map from ``/healthz`` and ships each sub-segment straight to its
+shard's process.  The router exists for everything else: legacy
+single-URL clients (vtctl, the mirror, curl), the merged ``/watch``
+stream, and the audit/debug surfaces that must present the mesh as ONE
+store.  It is deliberately stateless — every request is answered from
+the shards' current state, so a router restart loses nothing and two
+routers over one mesh agree by construction.
+
+The merged ``/watch`` is the part with teeth.  Each shard's reply
+carries the per-shard watermark ``next`` (the shared-line high-water
+mark taken under that shard's lock — seqbus.py's completeness
+invariant).  The router fans one poll to every shard in parallel and
+computes ``W = min(next_i)``: every event with ``seq <= W`` has been
+observed SOMEWHERE (its owner either returned it or returned a
+watermark above it), so emitting the union of returned events at or
+below W, sorted by seq, reproduces the single-process stream — events
+above W are dropped, not buffered (the client's next poll re-reads them
+from the shard logs; statelessness again).
+
+Cross-shard ordering needs no new machinery: seqs come off one shared
+line, the audit root is a modular sum of disjoint shard roots
+(``vtaudit.merge_digest_payloads``), and ``vtctl audit`` against a
+router walks the same three tiers it walks against one process.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, quote, urlparse
+
+from volcano_tpu import vtaudit
+from volcano_tpu.locksan import make_lock
+from volcano_tpu.store.partition import (
+    shard_of, shard_of_key, split_segment, wal_shard,
+)
+
+#: slack added to a forwarded long-poll's socket timeout so the shard's
+#: own deadline (the client's ``timeout`` param) always fires first
+_POLL_SLACK = 10.0
+
+
+def _merge_wal_stats(per: List[Dict[str, Any]]) -> Dict[str, Any]:
+    return {
+        "shards": len(per),
+        "records": sum(p.get("records", 0) for p in per),
+        "fsync_total": sum(p.get("fsync_total", 0) for p in per),
+        "fsync_s": round(sum(p.get("fsync_s", 0.0) for p in per), 4),
+        "replayed_records": sum(p.get("replayed_records", 0) for p in per),
+        "torn_tails": sum(p.get("torn_tails", 0) for p in per),
+        "per_shard": per,
+    }
+
+
+class ShardRouter:
+    """Thin stateless HTTP tier over ``shard_map`` (leader URL per
+    shard, mesh order).  ``supervisor`` (optional) serves
+    ``/procmesh/shards`` with live member status; without one the
+    route reports the static map."""
+
+    def __init__(self, shard_map: List[str], supervisor=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 timeout: float = 30.0):
+        self.shard_map = [u.rstrip("/") for u in shard_map]
+        self.nshards = len(self.shard_map)
+        self.supervisor = supervisor
+        self.timeout = timeout
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: D102 - quiet like StoreServer
+                pass
+
+            def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> Dict[str, Any]:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                try:
+                    router._get(self)
+                except Exception as e:  # noqa: BLE001 - wire boundary
+                    self._reply(500, {"error": repr(e)})
+
+            def do_POST(self):
+                try:
+                    router._post(self)
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": repr(e)})
+
+            def do_PUT(self):
+                try:
+                    router._forward_object_write(self, "PUT")
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": repr(e)})
+
+            def do_PATCH(self):
+                try:
+                    router._forward_key_write(self, "PATCH")
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": repr(e)})
+
+            def do_DELETE(self):
+                try:
+                    u = urlparse(self.path)
+                    if u.path == "/chaos":
+                        router._chaos_fan(self, "DELETE")
+                        return
+                    router._forward_key_write(self, "DELETE")
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": repr(e)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ShardRouter":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- shard http ----------------------------------------------------------
+
+    def _shard_req(self, shard: int, method: str, path: str,
+                   payload: Optional[dict] = None,
+                   timeout: Optional[float] = None
+                   ) -> Tuple[int, Dict[str, Any]]:
+        data = json.dumps(payload).encode() if payload is not None else None
+        headers = {"Content-Type": "application/json"} if data else {}
+        req = urllib.request.Request(
+            self.shard_map[shard] + path, data=data, method=method,
+            headers=headers,
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout
+            ) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except Exception:  # noqa: BLE001
+                body = {"error": str(e)}
+            return e.code, body
+
+    def _fan(self, method: str, path: str, payload: Optional[dict] = None,
+             timeout: Optional[float] = None
+             ) -> List[Tuple[int, Dict[str, Any]]]:
+        """One request to EVERY shard, in parallel (a serial fan would
+        stack shard long-polls end to end).  Transport failures become
+        599 entries — callers decide whether partial coverage is fatal."""
+        out: List[Any] = [None] * self.nshards
+
+        def one(i: int) -> None:
+            try:
+                out[i] = self._shard_req(i, method, path, payload, timeout)
+            except Exception as e:  # noqa: BLE001
+                out[i] = (599, {"error": repr(e)})
+
+        threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(self.nshards)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    @staticmethod
+    def _first_error(replies) -> Optional[Tuple[int, Dict[str, Any]]]:
+        for code, body in replies:
+            if code != 200:
+                return code, body
+        return None
+
+    # -- GET routes ----------------------------------------------------------
+
+    def _get(self, h) -> None:
+        u = urlparse(h.path)
+        q = parse_qs(u.query)
+        parts = [p for p in u.path.split("/") if p]
+        if u.path == "/healthz":
+            return self._healthz(h)
+        if u.path == "/watch":
+            return self._watch(h, q)
+        if u.path == "/debug/digest":
+            return self._digest(h, q)
+        if u.path in ("/debug/trace", "/debug/prof", "/debug/timeseries"):
+            # single-process forensics surfaces: shard 0's view (cross-
+            # shard rollups live on /debug/digest and /procmesh/shards)
+            code, body = self._shard_req(0, "GET", h.path)
+            return h._reply(code, body)
+        if u.path == "/procmesh/shards":
+            if self.supervisor is not None:
+                return h._reply(200, self.supervisor.status())
+            return h._reply(200, {
+                "shards": self.nshards,
+                "members": [
+                    {"shard": i, "replica": 0, "role": "leader", "url": url}
+                    for i, url in enumerate(self.shard_map)
+                ],
+            })
+        if u.path == "/chaos":
+            return self._chaos_fan(h, "GET")
+        if u.path in ("/repl/status", "/repl/feed"):
+            # the mesh replicates PER SHARD behind the supervisor; the
+            # router is not a feed source — same reply as an
+            # unreplicated server
+            return h._reply(404, {"error": "replication not armed"})
+        if len(parts) == 2 and parts[0] == "apis":
+            replies = self._fan("GET", h.path)
+            err = self._first_error(replies)
+            if err is not None:
+                return h._reply(*err)
+            items: List[Any] = []
+            for _, body in replies:
+                items.extend(body.get("items") or [])
+            # the watch-bootstrap floor: a follow-up watch from ``seq``
+            # must cover everything newer than EVERY shard's list read,
+            # so the merged stamp is the minimum (over-delivery side)
+            seq = min(int(body.get("seq", 0)) for _, body in replies)
+            return h._reply(200, {"items": items, "seq": seq})
+        if len(parts) == 3 and parts[0] == "apis" and parts[2] == "obj":
+            key = q.get("key", [""])[0]
+            s = shard_of_key(key, self.nshards)
+            code, body = self._shard_req(s, "GET", h.path)
+            return h._reply(code, body)
+        return h._reply(404, {"error": f"no route {u.path}"})
+
+    def _healthz(self, h) -> None:
+        replies = self._fan("GET", "/healthz")
+        err = self._first_error(replies)
+        if err is not None:
+            return h._reply(*err)
+        bodies = [b for _, b in replies]
+        payload: Dict[str, Any] = {
+            "ok": all(b.get("ok") for b in bodies),
+            # shard 0 anchors the mesh lineage id; per-member uids are a
+            # /procmesh/shards detail
+            "uid": bodies[0].get("uid"),
+            # the partitioned-bus contract: clients split segments N
+            # ways exactly as against an in-process shards=N server
+            "shards": self.nshards,
+            "proc_shards": self.nshards,
+            "shard_map": list(self.shard_map),
+            "hwm": max(int(b.get("hwm", 0)) for b in bodies),
+        }
+        digests = [b.get("digest") for b in bodies]
+        if all(d is not None for d in digests):
+            root = 0
+            per = []
+            for d in digests:
+                shard_entry = (d.get("shards") or [{}])[0]
+                r = int(str(shard_entry.get("digest",
+                                            d.get("root", "0"))), 16)
+                root = (root + r) & vtaudit._MASK
+                per.append({"digest": vtaudit.hexd(r),
+                            "seq": int(shard_entry.get("seq", 0))})
+            payload["digest"] = {
+                "root": vtaudit.hexd(root),
+                "seq": max(int(b.get("digest", {}).get("seq", 0))
+                           for b in bodies),
+                "shards": per,
+            }
+        wals = [b.get("wal") for b in bodies]
+        if all(w is not None for w in wals):
+            payload["wal"] = _merge_wal_stats(wals)
+        return h._reply(200, payload)
+
+    def _watch(self, h, q) -> None:
+        shard_q = q.get("shard", [None])[0]
+        timeout = float(q.get("timeout", ["0"])[0])
+        if shard_q is not None:
+            # per-shard fan-out consumer: verbatim passthrough (a
+            # shards=1 server serves its untagged entries to any
+            # shard-scoped watcher)
+            code, body = self._shard_req(
+                int(shard_q) % self.nshards, "GET", h.path,
+                timeout=timeout + _POLL_SLACK,
+            )
+            return h._reply(code, body)
+        replies = self._fan("GET", h.path, timeout=timeout + _POLL_SLACK)
+        err = self._first_error(replies)
+        if err is not None:
+            return h._reply(*err)
+        bodies = [b for _, b in replies]
+        # W = min per-shard watermark: complete at or below W by the
+        # seqbus invariant — each shard's ``next`` was read under its
+        # own lock, so a seq <= next_i owned by shard i was in its reply
+        w = min(int(b.get("next", 0)) for b in bodies)
+        epochs = [b["epoch"] for b in bodies if "epoch" in b]
+        if any(b.get("relist") for b in bodies):
+            payload: Dict[str, Any] = {
+                "events": None, "next": w, "relist": True}
+        else:
+            evs = [e for b in bodies for e in b["events"]
+                   if int(e.get("seq", 0)) <= w]
+            evs.sort(key=lambda e: int(e.get("seq", 0)))
+            payload = {"events": evs, "next": w}
+        if epochs:
+            # per-shard serving epochs collapse to their sum: ANY shard
+            # failover/resync moves the merged epoch, and the client's
+            # fence (epoch changed -> relist) fires exactly then
+            payload["epoch"] = sum(int(e) for e in epochs)
+        return h._reply(200, payload)
+
+    def _digest(self, h, q) -> None:
+        rec = (q.get("recompute") or [None])[0] not in (None, "", "0")
+        fwd = "/debug/digest" + ("?recompute=1" if rec else "")
+        kind = (q.get("kind") or [None])[0]
+        if kind is not None:
+            ns = (q.get("namespace") or [""])[0]
+            s = shard_of(ns, self.nshards)
+            sep = "&" if rec else "?"
+            code, body = self._shard_req(
+                s, "GET",
+                f"{fwd}{sep}kind={quote(kind, safe='')}"
+                f"&namespace={quote(ns, safe='')}")
+            return h._reply(code, body)
+        sh = (q.get("shard") or [None])[0]
+        if (q.get("detail") or [None])[0] == "buckets" or sh is not None:
+            sep = "&" if rec else "?"
+            if sh is not None:
+                # one shard's whole table IS that shard's bucket slice —
+                # the shard param must NOT forward (a shards=1 server
+                # would filter on shard_of(ns, 1) == sh: empty for sh>0)
+                code, body = self._shard_req(
+                    int(sh) % self.nshards, "GET", f"{fwd}{sep}detail=buckets")
+                return h._reply(code, body)
+            replies = self._fan("GET", f"{fwd}{sep}detail=buckets")
+            err = self._first_error(replies)
+            if err is not None:
+                return h._reply(*err)
+            buckets: Dict[str, str] = {}
+            for _, body in replies:
+                # namespace->shard is a partition: bucket keys are
+                # disjoint across shards, the union is the mesh table
+                buckets.update(body.get("buckets") or {})
+            return h._reply(200, {
+                "seq": max(int(b.get("seq", 0)) for _, b in replies),
+                "recompute": rec,
+                "buckets": buckets,
+            })
+        replies = self._fan("GET", fwd)
+        err = self._first_error(replies)
+        if err is not None:
+            return h._reply(*err)
+        bodies = [b for _, b in replies]
+        out: Dict[str, Any] = {
+            "enabled": all(b.get("enabled") for b in bodies),
+            "seq": max(int(b.get("seq", 0)) for b in bodies),
+            "recompute": rec,
+            # per-shard LOCAL seqs: the mesh skew surface (each shards=1
+            # member reports one-element shard_seq == its seq)
+            "shard_seq": [int(b.get("seq", 0)) for b in bodies],
+        }
+        if all(b.get("root") is not None for b in bodies):
+            out.update(vtaudit.merge_digest_payloads(bodies))
+        return h._reply(200, out)
+
+    # -- mutation routes ------------------------------------------------------
+
+    def _post(self, h) -> None:
+        u = urlparse(h.path)
+        parts = [p for p in u.path.split("/") if p]
+        if u.path == "/chaos":
+            return self._chaos_fan(h, "POST", h._body())
+        if u.path == "/bulk":
+            return self._bulk(h, h._body())
+        if len(parts) == 2 and parts[0] == "apis":
+            return self._forward_object_write(h, "POST")
+        return h._reply(404, {"error": "no route"})
+
+    def _forward_object_write(self, h, method: str) -> None:
+        """POST/PUT ``/apis/{kind}``: route by the object's namespace —
+        the same hash that placed every other record of that namespace
+        on its shard."""
+        body = h._body()
+        enc = body.get("object") or {}
+        meta = enc.get("meta") or {}
+        s = shard_of(str(meta.get("namespace") or ""), self.nshards)
+        code, reply = self._shard_req(s, method, h.path, body)
+        return h._reply(code, reply)
+
+    def _forward_key_write(self, h, method: str) -> None:
+        u = urlparse(h.path)
+        q = parse_qs(u.query)
+        key = q.get("key", [""])[0]
+        s = shard_of_key(key, self.nshards)
+        body = h._body() if method == "PATCH" else None
+        code, reply = self._shard_req(s, method, h.path, body)
+        return h._reply(code, reply)
+
+    def _chaos_fan(self, h, method: str, body: Optional[dict] = None) -> None:
+        """Chaos admin fans to every shard (one plan arms the whole
+        mesh); the reply carries each shard's status."""
+        replies = self._fan(method, "/chaos", body)
+        err = self._first_error(replies)
+        if err is not None:
+            return h._reply(*err)
+        return h._reply(200, {
+            "armed": any(b.get("armed") for _, b in replies),
+            "shards": [b for _, b in replies],
+        })
+
+    # -- /bulk: split, forward, reassemble ------------------------------------
+
+    def _bulk(self, h, body: Dict[str, Any]) -> None:
+        """Group a legacy client's mixed op list into per-shard
+        sub-bulks (per-shard ORDER preserved — that is the WAL/replay
+        order contract), forward them in parallel, and reassemble the
+        per-op results in the original order.  Ops that themselves span
+        shards (untagged segments, columnar patch runs over mixed
+        namespaces) split into per-shard sub-ops with their row/key
+        results remapped back."""
+        ops = body.get("ops") or []
+        n = self.nshards
+        shard_ops: Dict[int, List[dict]] = {}
+        slots: List[Tuple[str, Any]] = []
+
+        def push(s: int, op: dict) -> int:
+            lst = shard_ops.setdefault(s, [])
+            lst.append(op)
+            return len(lst) - 1
+
+        for op in ops:
+            verb = op.get("op")
+            if verb == "segment" and "shard" not in op:
+                parts = self._split_segment_op(op)
+                slots.append(("seg", [
+                    (s, push(s, sub), brows, erows)
+                    for s, sub, brows, erows in parts
+                ]))
+            elif verb == "patch_col":
+                keys = op.get("keys") or []
+                by_shard: Dict[int, List[int]] = {}
+                for j, key in enumerate(keys):
+                    by_shard.setdefault(shard_of_key(key, n), []).append(j)
+                if len(by_shard) <= 1:
+                    s = next(iter(by_shard), 0)
+                    slots.append(("one", (s, push(s, op))))
+                else:
+                    placed = []
+                    for s, rows in sorted(by_shard.items()):
+                        sub: Dict[str, Any] = {
+                            "op": "patch_col", "kind": op["kind"],
+                            "keys": [keys[j] for j in rows],
+                        }
+                        if op.get("columns"):
+                            sub["columns"] = {
+                                f: [col[j] for j in rows]
+                                for f, col in op["columns"].items()
+                            }
+                        if op.get("const"):
+                            sub["const"] = op["const"]
+                        if "when" in op:
+                            sub["when"] = op["when"]
+                        placed.append((s, push(s, sub), rows))
+                    slots.append(("pcol", (placed, len(keys))))
+            else:
+                s = wal_shard(op, n)
+                slots.append(("one", (s, push(s, op))))
+        fan_out: Dict[int, List[Any]] = {}
+        errors: List[Tuple[int, Dict[str, Any]]] = []
+        lock = make_lock("ShardRouter.bulk_fan")
+
+        def ship(s: int) -> None:
+            code, reply = self._shard_req(
+                s, "POST", "/bulk", {"ops": shard_ops[s]})
+            with lock:
+                if code != 200:
+                    errors.append((code, reply))
+                else:
+                    fan_out[s] = reply.get("results") or []
+
+        threads = [threading.Thread(target=ship, args=(s,), daemon=True)
+                   for s in shard_ops]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            return h._reply(*errors[0])
+        results: List[Any] = []
+        for tag, info in slots:
+            if tag == "one":
+                s, idx = info
+                results.append(fan_out[s][idx])
+            elif tag == "pcol":
+                placed, nkeys = info
+                out: List[Any] = [None] * nkeys
+                for s, idx, rows in placed:
+                    r = fan_out[s][idx]
+                    vals = r if isinstance(r, list) else [r] * len(rows)
+                    for j, v in zip(rows, vals):
+                        out[j] = v
+                results.append(out)
+            else:  # seg
+                merged: Dict[str, List[Any]] = {"binds": [], "evicts": []}
+                op_err: Optional[str] = None
+                for s, idx, brows, erows in info:
+                    r = fan_out[s][idx]
+                    if not isinstance(r, dict):
+                        op_err = str(r) if r else "segment op dropped"
+                        continue
+                    for row, err in r.get("binds") or []:
+                        merged["binds"].append([brows[int(row)], err])
+                    for row, err in r.get("evicts") or []:
+                        merged["evicts"].append([erows[int(row)], err])
+                if op_err is not None:
+                    results.append(op_err)
+                else:
+                    merged["binds"].sort(key=lambda t: t[0])
+                    merged["evicts"].sort(key=lambda t: t[0])
+                    results.append(merged)
+        return h._reply(200, {"results": results})
+
+    def _split_segment_op(self, op: Dict[str, Any]):
+        """An UNTAGGED segment (a pre-split client that believes the
+        store is one shard) re-splits here by namespace hash — the same
+        ``split_segment`` the mesh-aware applier runs client-side.  Row
+        maps (sub-row -> original row) come from the split's order
+        guarantee: relative order within a shard is preserved."""
+        from volcano_tpu.store.segment import DecisionSegment
+
+        seg = DecisionSegment.from_wire(op)
+        subs = split_segment(seg, self.nshards)
+        bind_rows: Dict[int, List[int]] = {}
+        for j, key in enumerate(seg.bind_keys):
+            bind_rows.setdefault(
+                shard_of_key(key, self.nshards), []).append(j)
+        evict_rows: Dict[int, List[int]] = {}
+        for j, key in enumerate(seg.evict_keys):
+            evict_rows.setdefault(
+                shard_of_key(key, self.nshards), []).append(j)
+        out = []
+        for s, sub in subs:
+            wire = sub.to_wire()
+            wire["shard"] = s
+            out.append((s, wire, bind_rows.get(s, []), evict_rows.get(s, [])))
+        return out

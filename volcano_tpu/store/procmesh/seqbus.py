@@ -1,0 +1,88 @@
+"""SeqBus — the mesh's shared seq/rv line, one counter pair for N
+shard processes.
+
+The partitioned bus (PR 11) kept global ordering trivially: every shard
+lived in one process and seq/rv assignment happened under one server
+lock.  Splitting shards into their own OS processes removes that lock,
+but the ordering contract survives because it never needed the lock —
+it needs ONE monotone allocation line.  SeqBus is that line: two 64-bit
+counters (log seq, store rv) in shared memory, advanced under a single
+cross-process mutex.
+
+The completeness invariant routers and merged watches build on:
+
+* A shard server allocates (``alloc_seq``) and appends the covered log
+  entry while holding ITS OWN server lock (server.py ``_alloc_seq``),
+  so per shard, allocation and append are atomic.
+* Therefore, when anyone observes the counter at S (``peek_seq``),
+  every seq <= S is either (a) already appended on the shard that owns
+  it, or (b) owned by a shard currently inside that atomic section —
+  and reading a shard's stream UNDER its lock (any watch request) can
+  never miss a seq <= the peek taken inside that same lock hold.  That
+  peek is the watermark a shard stamps on its watch/feed replies.
+
+Crash/restart: the counters only move forward.  A restarted shard CASes
+the line up to whatever its recovery produced (``advance_to``) — if the
+line already ran ahead (siblings kept allocating), its recovered tail
+simply sits below the current mark, exactly like a shard that has been
+idle.  The supervisor owns the shared memory, so shard deaths never
+take the line with them.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+from typing import Tuple
+
+_SEQ, _RV = 0, 1
+
+
+class SeqBus:
+    """Cross-process seq/rv allocator.  Picklable only via
+    ``multiprocessing.Process`` argument inheritance (the shared array
+    travels as an OS handle) — exactly how the supervisor hands it to
+    shard processes."""
+
+    def __init__(self, ctx=None):
+        ctx = ctx or multiprocessing.get_context("spawn")
+        # one synchronized array = one mutex guarding both counters
+        self._line = ctx.Array("q", [0, 0])
+
+    # -- allocation (shard servers, under their own server lock) -----------
+
+    def alloc_seq(self, n: int) -> int:
+        """Consume ``n`` seqs; returns the LAST of the block (the caller
+        derives ``last - n + 1 .. last``).  ``n == 0`` reads the line."""
+        with self._line.get_lock():
+            self._line[_SEQ] += int(n)
+            return self._line[_SEQ]
+
+    def alloc_rv(self, n: int) -> int:
+        """Consume ``n`` resource versions; returns the LAST one."""
+        with self._line.get_lock():
+            self._line[_RV] += int(n)
+            return self._line[_RV]
+
+    # -- observation --------------------------------------------------------
+
+    def peek_seq(self) -> int:
+        with self._line.get_lock():
+            return self._line[_SEQ]
+
+    def snapshot(self) -> Tuple[int, int]:
+        """(seq, rv) — one consistent read of both counters."""
+        with self._line.get_lock():
+            return self._line[_SEQ], self._line[_RV]
+
+    # -- recovery ------------------------------------------------------------
+
+    def advance_to(self, seq: int, rv: int) -> None:
+        """CAS the line forward to at least (seq, rv) — a recovering
+        shard rejoining the mesh.  Never moves backward: siblings may
+        have consumed past the recovered tail while this shard was
+        down."""
+        with self._line.get_lock():
+            if int(seq) > self._line[_SEQ]:
+                self._line[_SEQ] = int(seq)
+            if int(rv) > self._line[_RV]:
+                self._line[_RV] = int(rv)

@@ -1,0 +1,401 @@
+"""Shard supervisor: one OS process per store shard, one logical bus.
+
+The partitioned server (PR 11) proved the decision stream shards
+cleanly by namespace hash; vtflow's interprocedural pass (PR 17) fenced
+the last cross-shard writes behind an explicit watermark protocol.
+This module takes the final step: each shard becomes its OWN
+``StoreServer(shards=1)`` process, reusing its existing per-shard WAL
+directory (``partition.shard_wal_dir`` — the exact layout ShardedWAL
+appends), its own state snapshot slice, and the vtrepl feed machinery
+unchanged (a shard leader is just a replica group of size >= 1).
+
+The supervisor owns the pieces the shards must share:
+
+* the ``SeqBus`` — the cross-process seq/rv line (seqbus.py), created
+  here so shard deaths never take the counters with them;
+* stable ports — allocated up front, so a restarted shard rebinds the
+  SAME endpoint and the router/shard map stays valid across crashes;
+* the monitor thread — respawns any dead member on the same config
+  (same state file, same WAL dir, same port); recovery replays the
+  shard's WAL tail and CASes the line forward (``advance_to``), so a
+  SIGKILLed shard rejoins with zero acked loss while its siblings keep
+  allocating.
+
+Replication composes per shard: ``replicas >= 2`` gives every shard a
+sync follower (its own state/WAL paths, suffixed ``.rN`` so they never
+match ``leftover_shard_dirs``'s cross-mode absorb scan).  The lease is
+long (10 s) relative to a supervisor restart (~1 s) by design: the
+supervisor IS the failover authority for mesh shards — the follower
+exists for durability and read scale, promotion is the fallback for a
+supervisor that is itself gone.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import queue
+import signal
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from volcano_tpu.locksan import make_lock
+from volcano_tpu.store.partition import shard_of, shard_wal_dir
+from volcano_tpu.store.procmesh.seqbus import SeqBus
+
+
+def shard_state_path(state: str, shard: int, replica: int = 0) -> str:
+    """The snapshot file one mesh member owns (``<state>.s01``,
+    follower ``<state>.s01.r1``) — beside the in-process snapshot, never
+    colliding with it."""
+    p = f"{state}.s{int(shard):02d}"
+    return p if replica == 0 else f"{p}.r{int(replica)}"
+
+
+def _member_wal_dir(wal_root: str, shard: int, replica: int = 0) -> str:
+    """Leader shards own the exact ShardedWAL directory (``<wal>/s01``)
+    so in-process and procmesh deployments recover each other's acked
+    tails; follower dirs carry an ``.rN`` suffix that the cross-mode
+    absorb scan (``leftover_shard_dirs``: ``s\\d\\d`` exactly) ignores."""
+    d = shard_wal_dir(wal_root, shard)
+    return d if replica == 0 else f"{d}.r{int(replica)}"
+
+
+def _shard_main(cfg: Dict[str, Any], bus, ready_q) -> None:
+    """Child-process entry (module-level: spawn pickles the reference).
+    One ``StoreServer(shards=1)`` — leaders allocate on the shared bus,
+    followers mirror their leader's stamps via the feed exactly as in
+    single-process replication.  Mirrors ``daemons.run_apiserver``'s
+    shutdown shape: SIGTERM -> SystemExit on the serving thread, final
+    flush with the signal masked (SIGKILL is what the WAL recovers
+    from)."""
+    import sys
+
+    from volcano_tpu import trace
+    from volcano_tpu.store.server import StoreServer
+
+    name = f"shard{cfg['shard']:02d}"
+    if cfg["replica"]:
+        name += f".r{cfg['replica']}"
+    trace.set_component(name)
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+    srv = StoreServer(
+        host=cfg["host"],
+        port=cfg["port"],
+        state_path=cfg["state"],
+        save_interval=cfg["save_interval"],
+        wal=cfg["wal"],
+        shards=1,
+        repl=cfg["repl"],
+        seq_bus=bus if cfg["replica"] == 0 else None,
+        proc_shard=(cfg["shard"], cfg["nshards"]),
+    )
+    try:
+        ready_q.put({"shard": cfg["shard"], "replica": cfg["replica"],
+                     "port": srv.port, "pid": os.getpid()})
+    except (OSError, ValueError):
+        pass  # supervisor gone/queue closed: serve anyway, health probes rule
+    try:
+        srv.serve_forever()
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        srv.stop()
+
+
+class _Member:
+    """One supervised process: shard leader (``replica == 0``) or
+    follower.  The config dict is immutable across restarts — that is
+    the restart contract (same paths, same port, same role)."""
+
+    __slots__ = ("cfg", "proc", "restarts")
+
+    def __init__(self, cfg: Dict[str, Any]):
+        self.cfg = cfg
+        self.proc = None
+        self.restarts = 0
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.cfg['host']}:{self.cfg['port']}"
+
+
+class ShardSupervisor:
+    """Spawn/monitor/restart N shard-server processes behind one
+    logical store.  ``state``/``wal`` are the SAME roots the in-process
+    ``shards=N`` server uses — ``start()`` splits an in-process
+    snapshot into per-shard slices on first boot, and each shard's WAL
+    directory is the one ShardedWAL already appends, so the two
+    deployment modes hand the store back and forth."""
+
+    def __init__(self, nshards: int, host: str = "127.0.0.1",
+                 state: Optional[str] = None, wal: Optional[str] = None,
+                 save_interval: float = 0.25, replicas: int = 1,
+                 repl_ack: str = "sync", lease_duration: float = 10.0,
+                 restart: bool = True, ready_timeout: float = 60.0):
+        if nshards < 1:
+            raise ValueError("procmesh needs >= 1 shard")
+        self.nshards = int(nshards)
+        self.host = host
+        self.state = state or None
+        self.wal = wal or None
+        self.save_interval = save_interval
+        self.replicas = max(1, int(replicas))
+        self.repl_ack = repl_ack
+        self.lease_duration = lease_duration
+        self.restart = restart
+        self.ready_timeout = ready_timeout
+        if self.replicas > 1 and not (self.state and self.wal):
+            raise ValueError("per-shard replication requires state and wal "
+                             "roots: the feed ships fsynced WAL records")
+        if self.wal and not self.state:
+            raise ValueError("wal requires state (the WAL checkpoints into "
+                             "the shard snapshots)")
+        self._ctx = multiprocessing.get_context("spawn")
+        self.bus = SeqBus(self._ctx)
+        self._ready_q = self._ctx.Queue()
+        #: members in spawn order: shard-major, leader before followers
+        self.members: List[_Member] = []
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._mu = make_lock("ShardSupervisor.members")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ShardSupervisor":
+        ports = self._alloc_ports(self.nshards * self.replicas)
+        self._seed_shard_states()
+        for s in range(self.nshards):
+            group = [
+                f"http://{self.host}:{ports[s * self.replicas + r]}"
+                for r in range(self.replicas)
+            ]
+            for r in range(self.replicas):
+                repl = None
+                if self.replicas > 1:
+                    repl = {
+                        "identity": group[r],
+                        "peers": list(group),
+                        "leader": None if r == 0 else group[0],
+                        "ack": self.repl_ack,
+                        "lease_duration": self.lease_duration,
+                        # one lease object per shard GROUP, shard-
+                        # qualified: each group's lease lives in its own
+                        # shard store, and the merged /apis/Lease list
+                        # must keep them distinct keys or the wire
+                        # digest diverges from the shard-root rollup
+                        "lease_name": f"vt-store-s{s:02d}",
+                    }
+                self.members.append(_Member({
+                    "shard": s,
+                    "replica": r,
+                    "nshards": self.nshards,
+                    "host": self.host,
+                    "port": ports[s * self.replicas + r],
+                    "state": (shard_state_path(self.state, s, r)
+                              if self.state else None),
+                    "wal": (_member_wal_dir(self.wal, s, r)
+                            if self.wal else None),
+                    "save_interval": self.save_interval,
+                    "repl": repl,
+                }))
+        for m in self.members:
+            self._spawn(m)
+        self._await_ready(len(self.members))
+        self._wait_members_healthy()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="procmesh-monitor", daemon=True,
+        )
+        self._monitor.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._mu:
+            procs = [m.proc for m in self.members if m.proc is not None]
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        deadline = time.monotonic() + 10.0
+        for p in procs:
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=5.0)
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        self._ready_q.close()
+
+    # -- shard map / status --------------------------------------------------
+
+    @property
+    def shard_map(self) -> List[str]:
+        """Leader URL per shard, mesh order — the routing table clients
+        and the router fetch (ports are stable across restarts, so this
+        list is valid for the supervisor's whole life)."""
+        return [m.url for m in self.members if m.cfg["replica"] == 0]
+
+    def status(self) -> Dict[str, Any]:
+        with self._mu:
+            members = [{
+                "shard": m.cfg["shard"],
+                "replica": m.cfg["replica"],
+                "role": "leader" if m.cfg["replica"] == 0 else "follower",
+                "url": m.url,
+                "pid": m.proc.pid if m.proc is not None else None,
+                "alive": bool(m.proc is not None and m.proc.is_alive()),
+                "restarts": m.restarts,
+            } for m in self.members]
+        seq, rv = self.bus.snapshot()
+        return {
+            "shards": self.nshards,
+            "replicas": self.replicas,
+            "seq": seq,
+            "rv": rv,
+            "restarts": sum(m["restarts"] for m in members),
+            "members": members,
+        }
+
+    # -- crash harness -------------------------------------------------------
+
+    def kill_shard(self, shard: int, replica: int = 0) -> int:
+        """SIGKILL one member (the chaos/crash-storm hook) and return
+        the killed pid.  The monitor respawns it on the same config; the
+        acked-loss contract is the WAL's."""
+        m = self._member(shard, replica)
+        if m.proc is None or not m.proc.is_alive():
+            raise RuntimeError(f"shard {shard} replica {replica} not running")
+        pid = m.proc.pid
+        os.kill(pid, signal.SIGKILL)
+        return pid
+
+    def _member(self, shard: int, replica: int = 0) -> _Member:
+        for m in self.members:
+            if m.cfg["shard"] == shard and m.cfg["replica"] == replica:
+                return m
+        raise KeyError(f"no member for shard {shard} replica {replica}")
+
+    # -- internals -----------------------------------------------------------
+
+    def _spawn(self, m: _Member) -> None:
+        p = self._ctx.Process(
+            target=_shard_main,
+            args=(m.cfg, self.bus, self._ready_q),
+            name=f"vt-shard{m.cfg['shard']:02d}-r{m.cfg['replica']}",
+            daemon=True,
+        )
+        p.start()
+        m.proc = p
+
+    def _await_ready(self, n: int) -> None:
+        deadline = time.monotonic() + self.ready_timeout
+        got = 0
+        while got < n:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise RuntimeError(
+                    f"procmesh: {got}/{n} shard processes ready after "
+                    f"{self.ready_timeout:.0f}s")
+            try:
+                self._ready_q.get(timeout=min(1.0, budget))
+                got += 1
+            except queue.Empty:
+                continue  # loop re-budgets against the deadline
+
+    def _wait_members_healthy(self) -> None:
+        from volcano_tpu.store.client import wait_healthy
+
+        for m in self.members:
+            # followers answer /healthz too (reads are local); a member
+            # that never comes up fails the whole start
+            if not wait_healthy(m.url, timeout=self.ready_timeout):
+                raise RuntimeError(f"procmesh: {m.url} never became healthy")
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(0.2):
+            # drain restart-time ready messages so the queue never fills
+            try:
+                while True:
+                    self._ready_q.get_nowait()
+            except queue.Empty:
+                pass  # drained
+            with self._mu:
+                dead = [m for m in self.members
+                        if m.proc is not None and not m.proc.is_alive()]
+            for m in dead:
+                if self._stop.is_set() or not self.restart:
+                    break
+                m.proc.join(timeout=1.0)
+                m.restarts += 1
+                # same config, same port, same paths: recovery replays
+                # the shard's WAL tail and advance_to() rejoins the line
+                self._spawn(m)
+
+    def _alloc_ports(self, n: int) -> List[int]:
+        """Reserve n distinct free ports up front.  Sockets are held
+        open until ALL are allocated (so the OS cannot hand the same
+        port twice), then released just before the children bind —
+        the standard pre-bind race, narrow enough for a test harness
+        and irrelevant for production (explicit ports)."""
+        socks = []
+        try:
+            for _ in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind((self.host, 0))
+                socks.append(s)
+            return [s.getsockname()[1] for s in socks]
+        finally:
+            for s in socks:
+                s.close()
+
+    def _seed_shard_states(self) -> None:
+        """First boot on an in-process snapshot: split ``<state>`` into
+        per-shard slices by namespace hash.  Each slice keeps the global
+        seq/rv stamps (the line is shared; a shard's local counters may
+        sit below it, exactly like an idle shard) and takes the matching
+        per-shard ``wal_floor`` when the in-process life stamped a floor
+        list.  Never overwrites an existing shard snapshot — those are
+        newer than the in-process file by construction."""
+        if not self.state or not os.path.exists(self.state):
+            return
+        targets = [shard_state_path(self.state, s)
+                   for s in range(self.nshards)]
+        if all(os.path.exists(t) for t in targets):
+            return
+        with open(self.state) as f:
+            data = json.load(f)
+        kinds = data.get("kinds", {})
+        per_kinds: List[Dict[str, List[Any]]] = [
+            {} for _ in range(self.nshards)
+        ]
+        for kind, items in kinds.items():
+            for enc in items:
+                meta = enc.get("meta") or {}
+                s = shard_of(str(meta.get("namespace") or ""), self.nshards)
+                per_kinds[s].setdefault(kind, []).append(enc)
+        floor_raw = data.get("wal_floor")
+        floors = floor_raw if isinstance(floor_raw, list) else None
+        for s, target in enumerate(targets):
+            if os.path.exists(target):
+                continue
+            payload: Dict[str, Any] = {
+                "seq": int(data.get("seq", 0)),
+                "rv": int(data.get("rv", 0)),
+                # distinct lineage uid per shard: two servers must never
+                # claim the same store uid to mirrors/checkpoints
+                "store_uid": f"{data.get('store_uid', '')}.s{s:02d}",
+                "kinds": per_kinds[s],
+            }
+            if floors is not None and s < len(floors):
+                payload["wal_floor"] = int(floors[s])
+            if data.get("repl_epoch"):
+                payload["repl_epoch"] = int(data["repl_epoch"])
+            tmp = f"{target}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, target)

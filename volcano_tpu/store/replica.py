@@ -158,7 +158,8 @@ class Replicator:
                  leader_url: Optional[str] = None,
                  ack: str = "async",
                  lease_duration: float = 5.0,
-                 ack_timeout: float = 10.0):
+                 ack_timeout: float = 10.0,
+                 lease_name: Optional[str] = None):
         if srv.wal is None:
             raise ValueError("replication requires --wal (the WAL is the "
                              "replication log)")
@@ -175,6 +176,12 @@ class Replicator:
         self.ack = ack
         self.ack_timeout = ack_timeout
         self.lease_duration = lease_duration
+        #: lease object name: one lease per replica GROUP.  A procmesh
+        #: shard group must qualify it (vt-store-sNN) — every shard
+        #: leader maintains its lease in its OWN shard store, and a
+        #: shared name would make the merged list collapse N distinct
+        #: leases onto one key while the shard-root rollup sums all N
+        self.lease_name = lease_name or LEASE_NAME
         # epoch: one per leadership.  A booting leader bumps past the
         # snapshot's persisted epoch so followers of the previous life
         # (whose applied beacons may exceed the recovered WAL) resync.
@@ -214,12 +221,16 @@ class Replicator:
 
         self._clock = _promo_clock
         self._elector = LeaderElector(
-            _ServerStore(srv), LEASE_NAME, identity=self.identity,
+            _ServerStore(srv), self.lease_name, identity=self.identity,
             lease_duration=lease_duration, clock=self._clock,
         )
         self._last_feed_ok = time.time()
         self._caught_up_at = time.time()
         self._last_leader_seq = 0  # newest leader seq seen on the feed
+        #: newest global-seq watermark the leader stamped on the feed —
+        #: on a procmesh shard follower this tracks the MESH line, which
+        #: runs ahead of the shard-local seq (sibling shards consume it)
+        self._leader_hwm = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -363,6 +374,12 @@ class Replicator:
         out["epoch"] = self.epoch
         out["leader"] = self.leader_url
         out["uid"] = self.srv.store.uid
+        # per-shard watermark message (store/procmesh): on a procmesh
+        # shard leader the feed stream carries the mesh's global-seq
+        # high-water mark alongside the local tail, so followers (and
+        # anything reading /repl/status) can tell replication lag from
+        # sibling-shard seq gaps.  Dense leaders stamp hwm == seq.
+        out["hwm"] = self.srv._seq_hwm()
 
     def _feed_snapshot(self) -> Dict[str, Any]:
         snap = self.srv.snapshot_payload()
@@ -397,6 +414,7 @@ class Replicator:
             "shipped_total": self.shipped_total,
             "promotions": self.promotions,
             "uid": self.srv.store.uid,
+            "leader_hwm": self._leader_hwm,
         }
 
     # -- follower half: pump / replay / election ---------------------------
@@ -451,7 +469,7 @@ class Replicator:
         """Renew the replicated lease; demote if a higher epoch exists
         (a partitioned ex-leader rejoining after a promotion)."""
         self._elector.try_acquire()
-        lease = self._elector.store.get("Lease", f"/{LEASE_NAME}")
+        lease = self._elector.store.get("Lease", f"/{self.lease_name}")
         if lease is not None and lease.holder != self.identity:
             # someone took the lease over: follow them
             self._demote(lease.holder)
@@ -511,6 +529,9 @@ class Replicator:
             # next round's epoch mismatch fetches the snapshot
             self.epoch = resp_epoch
         self._observe_lag(int(body.get("seq", self.applied)))
+        hwm = int(body.get("hwm", 0))
+        if hwm > self._leader_hwm:
+            self._leader_hwm = hwm
         return bool(records)
 
     def lag_seconds(self) -> float:
@@ -563,7 +584,7 @@ class Replicator:
 
     def _should_elect(self) -> bool:
         with self.srv.lock:
-            lease = self.srv.store.get("Lease", f"/{LEASE_NAME}")
+            lease = self.srv.store.get("Lease", f"/{self.lease_name}")
         now = self._clock()
         if lease is not None:
             return now - lease.renewed_at > lease.duration

@@ -90,6 +90,8 @@ class StoreServer:
         wal=None,
         shards: int = 1,
         repl: Optional[Dict[str, Any]] = None,
+        seq_bus=None,
+        proc_shard: Optional[tuple] = None,
     ):
         self.store = store or Store()
         self.admission = admission
@@ -121,6 +123,24 @@ class StoreServer:
         #: rows) — the relist horizon is ``seq - _log_rows``
         self._log_rows = 0
         self.seq = 0
+        # procmesh (store/procmesh): this server is ONE shard of a
+        # multi-process mesh.  ``seq_bus`` is the shared cross-process
+        # seq/rv allocator (None = local dense counters, byte-for-byte
+        # the historical server); ``proc_shard`` is ``(index, count)``
+        # within the mesh, advertised on /healthz for routers/clients.
+        # With a bus armed, local seqs GAP (siblings consume the line
+        # too), so the relist horizon tracks an explicit _log_floor and
+        # watch replies stamp the global high-water mark (_seq_hwm).
+        self._seq_bus = seq_bus
+        self.proc_shard = (
+            (int(proc_shard[0]), int(proc_shard[1]))
+            if proc_shard is not None else None
+        )
+        self._gapped = seq_bus is not None or proc_shard is not None
+        #: seq watermark at/below the newest TRIMMED (or never-buffered)
+        #: log row — the relist horizon for gapped seq lines; dense
+        #: servers keep using ``seq - _log_rows`` (identical value)
+        self._log_floor = 0
         #: newest seq that touched each shard (untagged/cross-shard
         #: entries advance every shard) — the /healthz skew surface
         self._shard_seq = [0] * self.shards
@@ -224,6 +244,18 @@ class StoreServer:
             if not self._sync_persist:
                 self._saver = threading.Thread(target=self._saver_loop, daemon=True)
                 self._saver.start()
+        # nothing recovered is buffered in the log: the relist horizon
+        # starts at the recovered seq (0 on a fresh boot)
+        self._log_floor = self.seq
+        if seq_bus is not None:
+            # join the mesh's shared seq/rv line: CAS the counters up to
+            # what recovery produced (a restarted shard rejoins a line
+            # its siblings kept advancing — max, never a reset), then arm
+            # the store's rv allocator.  Armed strictly AFTER recovery so
+            # replay never burns shared rvs for records that already own
+            # their stamps.
+            seq_bus.advance_to(self.seq, self.store._rv)
+            self.store._rv_alloc = seq_bus.alloc_rv
         self._queues = {kind: self.store.watch(kind) for kind in KIND_CLASSES}
 
         server = self
@@ -265,6 +297,7 @@ class StoreServer:
                     with server.lock:
                         del server.log[:]
                         server._log_rows = 0
+                        server._log_floor = server.seq
                     return False
                 return self._fault_reply(rule)
 
@@ -341,6 +374,13 @@ class StoreServer:
                 if u.path == "/healthz":
                     payload = {"ok": True, "uid": server.store.uid,
                                "shards": server.shards}
+                    if server.proc_shard is not None:
+                        # one shard of a multi-process mesh: advertise
+                        # position so routers/supervisors can verify the
+                        # map, and the shared-line hwm for skew reads
+                        payload["proc_shard"] = server.proc_shard[0]
+                        payload["proc_shards"] = server.proc_shard[1]
+                        payload["hwm"] = server._seq_hwm()
                     if server.repl is not None:
                         # replicated servers advertise role/epoch so
                         # wait_healthy(require_leader=True) can resolve
@@ -572,6 +612,7 @@ class StoreServer:
                 leader_url=repl.get("leader"),
                 ack=repl.get("ack", "async"),
                 lease_duration=float(repl.get("lease_duration", 5.0)),
+                lease_name=repl.get("lease_name"),
             )
         self._thread: Optional[threading.Thread] = None
 
@@ -1005,23 +1046,71 @@ class StoreServer:
         cross-shard segment) leaves the entry untagged — served to every
         shard-scoped watcher."""
         n = len(blk)
-        blk.seq0 = self.seq + 1
-        self.seq += n
+        seq = self._alloc_seq(n)
+        blk.seq0 = seq - n + 1
         self._log_rows += n
-        entry = {"seq": self.seq, "n": n, "kind": blk.kind,
+        entry = {"seq": seq, "n": n, "kind": blk.kind,
                  "block": blk, "start": 0}
         if self.shards > 1 and shard is not None:
             entry["shard"] = int(shard) % self.shards
-            self._shard_seq[entry["shard"]] = self.seq
+            self._note_watermark(entry["shard"], seq)
         else:
             # untagged (cross-shard) block: every shard's stream carries
-            # it, so every shard's newest-seq watermark advances.  The
-            # fan-out is an in-process broadcast; the multi-process split
-            # (ROADMAP item 1 acceptance notes) replaces it with a
-            # watermark message on each shard's stream.
-            for s in range(self.shards):
-                self._shard_seq[s] = self.seq  # vtlint: disable=proc-isolation
+            # it, so each stream receives a watermark record — "your
+            # stream is complete through seq".  The record set is the
+            # broadcast protocol itself: a procmesh shard process hosts
+            # exactly ONE stream (the set degenerates to its own mark;
+            # siblings' marks live in the router's aggregation, fed by
+            # the hwm stamp on each shard's watch/feed replies), while
+            # the in-process bus hosts all of them and delivers locally.
+            for mark in self._watermark_records(seq):
+                self._note_watermark(mark["shard"], mark["seq"])
         self.log.append(entry)
+
+    def _watermark_records(self, seq: int):
+        """Per-shard watermark records broadcast by an untagged
+        (cross-shard) log entry: one message per shard stream THIS
+        process hosts, each meaning "shard's stream is complete through
+        ``seq``"."""
+        return [{"shard": s, "seq": seq} for s in range(self.shards)]
+
+    def _note_watermark(self, shard: int, seq: int) -> None:
+        """Process one per-shard watermark record (monotone max — a
+        record may be re-delivered or arrive late).  The /healthz skew
+        surface and digest_debug read the resulting marks."""
+        marks = self._shard_seq
+        s = int(shard) % len(marks)
+        if seq > marks[s]:
+            marks[s] = seq
+
+    def _alloc_seq(self, n: int) -> int:
+        """Consume ``n`` log sequence numbers and return the LAST one.
+        Callers hold ``self.lock``, so allocation and the log append it
+        covers are atomic per shard process: once a procmesh sibling
+        observes the shared counter at S, every seq <= S owned by THIS
+        shard is already appended here — the invariant that makes
+        ``_seq_hwm``-stamped watch replies a sound completeness
+        watermark."""
+        bus = self._seq_bus
+        if bus is not None:
+            self.seq = bus.alloc_seq(n)
+        else:
+            self.seq += n
+        return self.seq
+
+    def _seq_hwm(self) -> int:
+        """The global-seq high-water mark this server can stamp on a
+        watch reply as "my stream is complete through here".  Dense
+        servers: the local tail.  Procmesh shards: the shared counter's
+        current value — seqs between the local tail and the counter
+        belong to sibling shards (see ``_alloc_seq``)."""
+        hwm = self.seq
+        bus = self._seq_bus
+        if bus is not None:
+            peek = bus.peek_seq()
+            if peek > hwm:
+                hwm = peek
+        return hwm
 
     # -- digest beacons / audit surface (vtaudit) --------------------------
 
@@ -1059,7 +1148,7 @@ class StoreServer:
         payload = self.store.digest_payload(self.shards)
         if payload is None:
             return False
-        self.seq += 1
+        self._alloc_seq(1)
         self._log_rows += 1
         ts = time.time()
         self.log.append(vtaudit.beacon_entry(self.seq, payload, ts))
@@ -1155,6 +1244,7 @@ class StoreServer:
             if n <= overflow:
                 overflow -= n
                 self._log_rows -= n
+                self._log_floor = e["seq"]
                 k += 1
             else:
                 e2 = dict(e)
@@ -1162,6 +1252,9 @@ class StoreServer:
                 e2["start"] = e.get("start", 0) + overflow
                 log[k] = e2
                 self._log_rows -= overflow
+                # block rows are seq-dense ending at e["seq"]: the newest
+                # trimmed row is first_row + overflow - 1
+                self._log_floor = e["seq"] - n + overflow
                 overflow = 0
         if k:
             del log[:k]
@@ -1585,7 +1678,9 @@ class StoreServer:
         floored checkpoint so stale WAL segments never replay over the
         adopted state."""
         with self.lock:
+            rv_alloc = self.store._rv_alloc
             self.store = Store()
+            self.store._rv_alloc = rv_alloc
             self._queues = {}
             self.log = []
             self._log_rows = 0
@@ -1600,6 +1695,9 @@ class StoreServer:
             # state file: the next flush must persist every kind
             self._dirty_kinds.update(snap.get("kinds", {}))
             self._shard_seq = [self.seq] * self.shards
+            self._log_floor = self.seq
+            if self._seq_bus is not None:
+                self._seq_bus.advance_to(self.seq, self.store._rv)
             self._beacon_seq = self.seq
             self._beacon_mono = time.monotonic()
             self._queues = {
@@ -1812,7 +1910,7 @@ class StoreServer:
             while q:
                 ev = q.popleft()
                 self._dirty_kinds.add(kind)
-                self.seq += 1
+                self._alloc_seq(1)
                 self._log_rows += 1
                 enc_obj, enc_old = self._encode_event_obj(kind, ev)
                 entry = {
@@ -1829,7 +1927,7 @@ class StoreServer:
                         ev.obj.meta.key, self.shards
                     )
                 self.log.append(entry)
-                self._shard_seq[entry.get("shard", 0)] = self.seq
+                self._note_watermark(entry.get("shard", 0), self.seq)
                 moved = True
         # with replication armed, beacons must NOT stamp here: _pump_log
         # runs between a verb's store mutation and its _wal_append, so a
@@ -1861,11 +1959,17 @@ class StoreServer:
             # watcher that drained a burst gets its seq-pinned checkpoint
             # without waiting for the next mutation to pump the log
             self._maybe_beacon()
-            if since < self.seq - self._log_rows or since > self.seq:
+            # gapped seq lines (procmesh shards) track the trim horizon
+            # explicitly; dense servers keep the arithmetic horizon
+            # (identical value, zero bookkeeping risk on the hot path)
+            floor = (self._log_floor if self._gapped
+                     else self.seq - self._log_rows)
+            if since < floor or since > self._seq_hwm():
                 # fell off the buffer — or the client's cursor is from
                 # before a server restart: tell it to relist
                 return self._watch_payload(
-                    {"events": None, "next": self.seq, "relist": True})
+                    {"events": None, "next": self._seq_hwm(),
+                     "relist": True})
             while True:
                 log = self.log
                 # entries' seq fields (a block entry carries its LAST
@@ -1908,12 +2012,17 @@ class StoreServer:
                     start = e["start"]
                     evs.extend(blk.wire_rows(start + skip, start + n))
                 if evs or timeout <= 0:
+                    # ``next`` is the completeness watermark: dense
+                    # servers stamp the local tail; procmesh shards stamp
+                    # the global hwm — the per-shard watermark message
+                    # that lets a merged cursor advance past seqs owned
+                    # by sibling shards
                     return self._watch_payload(
-                        {"events": evs, "next": self.seq})
+                        {"events": evs, "next": self._seq_hwm()})
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return self._watch_payload(
-                        {"events": [], "next": self.seq})
+                        {"events": [], "next": self._seq_hwm()})
                 self.cond.wait(remaining)
 
     def _watch_payload(self, payload: Dict[str, Any]) -> Dict[str, Any]:

@@ -102,6 +102,14 @@ class Store:
         self._lazy_patch: Dict[str, Dict[str, Any]] = defaultdict(dict)
         self._lazy_create: Dict[str, Dict[str, Any]] = defaultdict(dict)
         self._rv = 0
+        # procmesh (store/procmesh): when this store is one shard of a
+        # multi-process mesh, resource versions come from a shared
+        # cross-process allocator so every shard draws from ONE rv line.
+        # Values gap locally but stay globally unique and per-object
+        # monotone (each object lives on exactly one shard), so CAS and
+        # epoch-cache semantics are unchanged.  None = local dense
+        # counter, byte-for-byte the historical behavior.
+        self._rv_alloc = None
         # (ev_token, ev_start) of recently applied decision segments: the
         # reserved-uid block identifies a segment, so a RESUBMIT (the
         # applier re-ships the same segment after a cut reply / a crash
@@ -130,6 +138,9 @@ class Store:
         self.materialize_all()
         state = self.__dict__.copy()
         del state["_mu"]
+        # the rv allocator is a handle into another process's shared
+        # counter — never meaningful in a pickle
+        state["_rv_alloc"] = None
         return state
 
     def __setstate__(self, state):
@@ -141,6 +152,7 @@ class Store:
         self.__dict__.setdefault("_lazy_patch", defaultdict(dict))
         self.__dict__.setdefault("_lazy_create", defaultdict(dict))
         self.__dict__.setdefault("_applied_segments", OrderedDict())
+        self.__dict__.setdefault("_rv_alloc", None)
         from volcano_tpu import vtaudit
 
         if not vtaudit.enabled():
@@ -161,6 +173,19 @@ class Store:
     @property
     def resource_version(self) -> int:
         """Monotonic global version; bumps on every create/update."""
+        return self._rv
+
+    def _advance_rv(self, n: int = 1) -> int:
+        """Consume ``n`` resource versions and return the LAST one (the
+        caller derives its block as ``last - n + 1 .. last``).  With a
+        procmesh allocator armed the block comes from the mesh's shared
+        rv line; otherwise the local dense counter — identical values,
+        identical object stamps."""
+        alloc = self._rv_alloc
+        if alloc is not None:
+            self._rv = int(alloc(n))
+        else:
+            self._rv += n
         return self._rv
 
     # -- lazy segment overlay -------------------------------------------------
@@ -220,8 +245,7 @@ class Store:
             lc = self._lazy_create.get(kind)
             if key in self._objects[kind] or (lc and key in lc):
                 raise KeyError(f"{kind} {key} already exists")
-            self._rv += 1
-            obj.meta.resource_version = self._rv
+            obj.meta.resource_version = self._advance_rv()
             if not obj.meta.creation_timestamp:
                 import time
 
@@ -245,8 +269,7 @@ class Store:
             # unconditionally each cycle and rely on this for quiescence
             if old is not None and old == obj:
                 return obj
-            self._rv += 1
-            obj.meta.resource_version = self._rv
+            obj.meta.resource_version = self._advance_rv()
             self._objects[kind][key] = obj
             dg = self._digest
             if dg is not None:
@@ -333,8 +356,7 @@ class Store:
                     # pre-setattr value: the digest delta's old leaf
                     trips.append((k, getattr(parent, leaf), v))
                 setattr(parent, leaf, v)
-            self._rv += 1
-            obj.meta.resource_version = self._rv
+            obj.meta.resource_version = self._advance_rv()
             if trips is not None:
                 dg.apply_fields(kind, key, trips, obj=obj)
             # copy-on-write shadow: path hops are shallow-copied, so
@@ -522,8 +544,7 @@ class Store:
                 continue  # no-op write: Event only, no patch row
             changed.append(i)
             old_vals.append(cur)
-        rv0 = self._rv + 1
-        self._rv += len(changed)
+        rv0 = self._advance_rv(len(changed)) - len(changed) + 1
         dg = self._digest
         for j, i in enumerate(changed):
             key = keys[i]
@@ -605,8 +626,8 @@ class Store:
 
             # Event rows: rv block after every patch, the bulk-then-bulk
             # order of the per-object path
-            rv_ev0 = self._rv + 1
-            self._rv += len(ev_b) + len(ev_e)
+            n_ev = len(ev_b) + len(ev_e)
+            rv_ev0 = self._advance_rv(n_ev) - n_ev + 1
             n_b = len(seg.bind_keys)
             ebind = segmod.EventLogBlock(
                 segmod.BIND_REASON, seg.ev_token,

@@ -338,6 +338,31 @@ def table_from_objects(items: Iterable[Tuple[str, Any]]) -> DigestTable:
     return t
 
 
+def merge_digest_payloads(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Roll N per-shard ``payload()`` dicts (each from a ``shards=1``
+    server owning a disjoint namespace slice) into the one payload a
+    single server covering the union would report.  Sound because every
+    rollup is a MODULAR SUM of disjoint bucket sums: the mesh root is
+    the sum of the shard roots, per-kind digests add the same way, and
+    the shard list is just the roots in mesh order.  The procmesh
+    router's ``/debug/digest`` aggregation — ``vtctl audit`` pointed at
+    a router sees the same shape it sees against one process."""
+    root = 0
+    shard_roots: List[str] = []
+    kinds: Dict[str, int] = {}
+    for p in payloads:
+        r = int(str(p.get("root", "0")), 16)
+        root = (root + r) & _MASK
+        shard_roots.append(hexd(r))
+        for k, v in (p.get("kinds") or {}).items():
+            kinds[k] = (kinds.get(k, 0) + int(str(v), 16)) & _MASK
+    return {
+        "root": hexd(root),
+        "shards": shard_roots,
+        "kinds": {k: hexd(d) for k, d in sorted(kinds.items())},
+    }
+
+
 # -- comparison / localization ------------------------------------------------
 
 
