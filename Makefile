@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta bench-repl bench-procs loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
+.PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta bench-repl bench-procs fleet loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings.
@@ -185,6 +185,23 @@ bench-repl:
 bench-procs:
 	$(PY) -m pytest tests/test_procmesh.py -q -p no:cacheprovider
 	$(PY) bench.py --config 14
+
+# vtfleet (volcano_tpu/vtfleet.py + tests/test_vtfleet.py): the
+# cross-process observability plane — fleet trace reassembly (per-proc
+# /debug/trace rings clock-aligned onto one timeline), federated
+# /metrics with proc= labels + exact bucket-wise histogram rollups,
+# `vtctl top/trace/profile/describe --fleet`, router ?proc= passthrough,
+# and the supervisor's crash-forensics incident bundles (the SIGKILL
+# storm in tests/test_procmesh.py asserts bundle contents + restart
+# counters).  cfg9d (`--check --configs 15`) gates the armed-vs-
+# disarmed procmesh drain ratio at an absolute 1.05x band so fleet
+# harvesting can never tax the drain path.  CPU containers: set
+# VOLCANO_TPU_CFG9C_SCALE to shrink.
+fleet:
+	$(PY) -m pytest tests/test_vtfleet.py -q -p no:cacheprovider
+	$(PY) -m pytest tests/test_procmesh.py -q -p no:cacheprovider \
+	  -k "storm or fleet or collector"
+	$(PY) bench.py --check --configs 15
 
 # container images (reference Makefile:40-48 / installer/dockerfile/):
 # `image` = CPU-jax control plane, `image-tpu` = jax[tpu]+libtpu wheel

@@ -1281,6 +1281,78 @@ def config9_procs(scale=None):
     })
 
 
+def config9_fleet(scale=None):
+    """cfg9d: the vtfleet arming-overhead gate.  The cfg9c procmesh
+    drain measured twice over the SAME workload — fully disarmed, then
+    with the whole observability plane armed (child trace/timeseries
+    rings via env, the parent FleetCollector harvesting every member on
+    each supervisor monitor tick) — and reported as a ratio.  The
+    fleet plane's contract is that harvesting rides debug endpoints on
+    server threads the drain path never waits on, so armed/disarmed
+    must hold ≤1.05x (`bench.py --check --configs 15`); the bit-for-bit
+    placement identity half of that claim lives in the procmesh storm
+    test."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from volcano_tpu import timeseries, trace, vtfleet
+
+    if scale is None:
+        scale = float(os.environ.get("VOLCANO_TPU_CFG9C_SCALE", "1.0"))
+    n_nodes = max(int(N_NODES * scale), 64)
+    n_tasks = max(int(N_TASKS * scale), 640)
+    procs = 2
+
+    def wall(run):
+        shard_walls = [v for k, v in run["drain_kinds"].items()
+                       if k.startswith("proc")]
+        assert shard_walls, sorted(run["drain_kinds"])
+        return max(shard_walls)
+
+    base = _cfg9_run(n_nodes, n_tasks, 1, "off", prof=False, procs=procs)
+    incident_dir = tempfile.mkdtemp(prefix="vtfleet-bench-")
+    saved = {k: os.environ.get(k) for k in
+             ("VOLCANO_TPU_TRACE", "VOLCANO_TPU_TIMESERIES")}
+    try:
+        # children inherit the env at spawn; the parent arms in-process
+        os.environ["VOLCANO_TPU_TRACE"] = "1"
+        os.environ["VOLCANO_TPU_TIMESERIES"] = "1"
+        trace.arm()
+        timeseries.arm()
+        vtfleet.arm(incident_dir=incident_dir)
+        armed = _cfg9_run(n_nodes, n_tasks, 1, "off", prof=False,
+                          procs=procs)
+    finally:
+        vtfleet.disarm()
+        timeseries.disarm()
+        trace.disarm()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(incident_dir, ignore_errors=True)
+    assert armed["bound"] == base["bound"], (armed["bound"], base["bound"])
+    base_w, armed_w = wall(base), wall(armed)
+    _print_json({
+        "metric": "cfg9d_fleet_armed_vs_disarmed_drain",
+        "value": round(armed_w, 4),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "n_tasks": n_tasks, "n_nodes": n_nodes, "scale": scale,
+            "shard_procs": procs,
+            "ratio": round(armed_w / max(base_w, 1e-9), 3),
+            "disarmed_s": round(base_w, 4),
+            "armed_s": round(armed_w, 4),
+            "pods_bound": armed["bound"],
+            "device": str(jax.devices()[0]),
+        },
+    })
+
+
 # -- cfg10: vtdelta steady-state trickle (scheduler/delta/) -------------------
 #
 # ROADMAP item 2's measurement: the event-driven incremental core under
@@ -1625,7 +1697,7 @@ def config11_repl(scale=None, readers=None, n_events=None, window_s=None,
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes,
            10: config8_open_loop, 11: config9_shard, 12: config10_delta,
-           13: config11_repl, 14: config9_procs}
+           13: config11_repl, 14: config9_procs, 15: config9_fleet}
 
 
 # -- bench trajectory + continuous perf-regression gate (vtprof PR) -----------
@@ -1998,6 +2070,7 @@ CONFIG_METRIC = {
     12: "cfg10_delta_steady_state_micro_cycle",
     13: "cfg11_repl_fanout_watch_reads",
     14: "cfg9c_procmesh_drain",
+    15: "cfg9d_fleet_armed_vs_disarmed_drain",
 }
 
 
@@ -2026,6 +2099,13 @@ def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
         # "missing" — and don't burn a capture there is no band for
         # (e.g. cfg5 on the CPU container: the only cfg5 trajectory
         # readings are v5e)
+        # cfg9d's band is absolute, not trajectory-derived — a ratio is
+        # device-invariant, so the fleet-overhead gate works on any
+        # machine with no history (set BEFORE the wanted filter: the
+        # ratio IS this config's headline metric)
+        if 15 in configs:
+            bands.setdefault("cfg9d_fleet_armed_vs_disarmed_drain",
+                             {"max_ratio": 1.05, "min_delta_s": 0.25})
         wanted = {CONFIG_METRIC.get(n) for n in configs}
         bands = {m: b for m, b in bands.items() if m in wanted}
         skipped = [n for n in configs if CONFIG_METRIC.get(n) not in bands]
@@ -2059,6 +2139,7 @@ def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
             # would breach a band captured from the real configuration
             13: config11_repl,
             14: config9_procs,
+            15: config9_fleet,
         }
     for n in configs:
         fn = runners.get(n)
@@ -2139,7 +2220,9 @@ def main():
                          "(5,7,8,11; default 5,7,8 — configs without a "
                          "same-device band are skipped; 11 = cfg9 "
                          "mesh+partitioned-store, scaled by "
-                         "VOLCANO_TPU_CFG9_SCALE)")
+                         "VOLCANO_TPU_CFG9_SCALE; 15 = cfg9d vtfleet "
+                         "armed-vs-disarmed drain overhead, absolute "
+                         "1.05x ratio band)")
     ap.add_argument("--bands", default="",
                     help="--check: explicit band JSON file instead of "
                          "the trajectory-derived defaults")

@@ -451,3 +451,92 @@ def test_vtctl_audit_remote_retries_when_state_moved_mid_walk(monkeypatch):
     # operator knows the verdict is unconfirmed
     monkeypatch.setattr(vtctl, "_audit_remote_pass", lambda url: moved)
     assert "state moved during audit" in vtctl.cmd_audit_remote("http://x")
+
+
+# -- vtctl --fleet (vtfleet cross-process observability) -----------------------
+
+
+def test_vtctl_fleet_local_mode_disarmed_hints_and_armed_render(capsys):
+    """Without --server the fleet commands harvest the in-process rings:
+    disarmed planes produce actionable arming hints at rc 0; armed ones
+    render the same report shapes a live mesh produces."""
+    from volcano_tpu import timeseries, trace, vtprof
+    from volcano_tpu.cli.vtctl import main
+
+    trace.disarm()
+    timeseries.disarm()
+    vtprof.disarm()
+    try:
+        assert main(["trace", "last", "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "proc local" in out and "(disarmed)" in out
+        assert "VOLCANO_TPU_TRACE=1" in out
+        assert main(["top", "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet: 1 proc(s) harvested" in out
+        assert "VOLCANO_TPU_TIMESERIES=1" in out
+        assert main(["profile", "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "VOLCANO_TPU_PROF=1" in out
+        assert "no cross-process drain attribution" in out
+
+        # armed: the local rings feed the same merge/render path
+        trace.arm()
+        with trace.span("unit.fleet.local"):
+            pass
+        rec = timeseries.arm()
+        rec.record("cycle", dur_s=0.01, binds=1)
+        assert main(["trace", "last", "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "unit.fleet.local" in out
+        assert "proc local" in out and "spans=1" in out
+        assert main(["top", "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "VOLCANO_TPU_TIMESERIES=1" not in out
+    finally:
+        trace.disarm()
+        timeseries.disarm()
+        vtprof.disarm()
+
+
+def test_vtctl_fleet_remote_plain_store_and_dead_daemon_degradation(
+        capsys, traced):
+    """--fleet against a plain (non-mesh) StoreServer falls back to one
+    'store' proc; a dead --daemon degrades to an UNREACHABLE line at
+    rc 0 (a partial harvest is a report, not an error); a malformed
+    --daemon flag is a CLI error."""
+    from volcano_tpu.cli.vtctl import main
+    from volcano_tpu.store.server import StoreServer
+
+    srv = StoreServer().start()
+    try:
+        assert main(["--server", srv.url, "cluster", "init",
+                     "--nodes", "1"]) == 0
+        assert main(["--server", srv.url, "job", "run", "--name", "fl1",
+                     "--replicas", "1", "--min", "1"]) == 0
+        capsys.readouterr()
+        # the store server shares this process, so its ring carries the
+        # traced writes; the harvest names the front proc "store"
+        assert main(["trace", "last", "--server", srv.url, "--fleet",
+                     "--daemon", "ghost=http://127.0.0.1:1"]) == 0
+        out = capsys.readouterr().out
+        assert "proc store" in out
+        assert "proc ghost" in out and "UNREACHABLE" in out
+        assert "vtctl.job.run" in out
+        assert main(["top", "--server", srv.url, "--fleet"]) == 0
+        assert "fleet: 1 proc(s) harvested" in capsys.readouterr().out
+        assert main(["profile", "--server", srv.url, "--fleet",
+                     "--daemon", "ghost=http://127.0.0.1:1"]) == 0
+        out = capsys.readouterr().out
+        assert "proc ghost" in out and "UNREACHABLE" in out
+        # malformed --daemon: error, not a traceback
+        assert main(["trace", "last", "--server", srv.url, "--fleet",
+                     "--daemon", "nourl"]) == 1
+        assert "bad --daemon entry" in capsys.readouterr().err
+        # describe job --fleet appends the gang's fleet trace
+        assert main(["--server", srv.url, "describe", "job", "--name",
+                     "fl1", "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "Fleet trace:" in out and "proc store" in out
+    finally:
+        srv.stop()

@@ -294,8 +294,10 @@ def test_debug_timeseries_endpoint_on_both_servers_and_chaos_exempt():
             srv.url + "/debug/timeseries", timeout=10
         ) as r:
             payload = json.load(r)
+        # "now" is the serving process's clock stamp (vtfleet offset
+        # estimation) — present even disarmed
         assert payload == {"armed": False, "pid": payload["pid"],
-                           "samples": []}
+                           "now": payload["now"], "samples": []}
     finally:
         srv.stop()
         ms.stop()

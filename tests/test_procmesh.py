@@ -202,15 +202,23 @@ def _stable_digest_pair(url):
 # -- the SIGKILL storm (PR-7 gate composed across processes) ------------------
 
 
-def _mesh_storm(tmp_path, kill):
+def _mesh_storm(tmp_path, kill, fleet_dir=None):
     """One storm against a 2-shard mesh with per-shard replica groups
     (WAL + sync-ack replication armed): control plane over the router,
     three gangs submitted sequentially; with ``kill`` each shard leader
-    is SIGKILLed once mid-drain (right after an ACKed submit).  Returns
-    the final placements for parity against the fault-free run."""
+    is SIGKILLed once mid-drain (right after an ACKed submit).  With
+    ``fleet_dir`` the vtfleet collector is armed: the supervisor caches
+    member rings each monitor tick and each SIGKILL must leave an
+    incident bundle holding the dying process's final flight-recorder
+    ring.  Returns the final placements for parity against the
+    fault-free run."""
+    from volcano_tpu import vtfleet
+
     root = tmp_path / ("kill" if kill else "clean")
     root.mkdir()
     state = str(root / "state.json")
+    if fleet_dir is not None:
+        vtfleet.arm(incident_dir=fleet_dir)
     sup, router = _mesh(NPROC, state=state, wal=state + ".wal",
                         replicas=2)
     cp = ControlPlane(router.url)
@@ -237,6 +245,39 @@ def _mesh_storm(tmp_path, kill):
             st = sup.status()
             assert sum(m["restarts"] for m in st["members"]) >= NPROC
             assert all(m["alive"] for m in st["members"])
+        if kill and fleet_dir is not None:
+            # crash-forensics acceptance: the respawn counter on the
+            # router's MERGED /metrics equals the supervisor's own
+            # count, and each SIGKILLed leader left an incident bundle
+            # with its final trace ring and profile
+            mt = urllib.request.urlopen(
+                router.url + "/metrics", timeout=10).read().decode()
+            rows = [line for line in mt.splitlines()
+                    if line.startswith("volcano_proc_restarts_total{")
+                    and 'proc="fleet"' not in line]
+            assert sum(int(float(line.rsplit(" ", 1)[1]))
+                       for line in rows) == st["restarts"], (rows, st)
+            bundles = sorted(os.listdir(fleet_dir))
+            for name in (f"shard{i:02d}" for i in range(NPROC)):
+                mine = [b for b in bundles
+                        if b.startswith(f"incident-{name}-")
+                        and not b.endswith(".tmp")]
+                assert mine, (name, bundles)
+                d = os.path.join(fleet_dir, mine[-1])
+                assert {"meta.json", "trace.json", "prof.json",
+                        "timeseries.json", "digest.json"} <= set(
+                            os.listdir(d))
+                with open(os.path.join(d, "trace.json")) as f:
+                    tr = json.load(f)
+                # the final ring: harvested while the process lived,
+                # kept across its death (children armed via env)
+                assert tr and tr.get("armed") and tr.get("spans"), tr
+                with open(os.path.join(d, "prof.json")) as f:
+                    assert json.load(f) is not None
+                with open(os.path.join(d, "meta.json")) as f:
+                    meta = json.load(f)
+                assert meta["proc"] == name and meta["reason"] \
+                    == "proc-exit" and meta["pid"]
         # maintained digest through the router converges to a full
         # recompute — the cross-shard rollup is honest after the storm
         maint, truth = _stable_digest_pair(router.url)
@@ -246,6 +287,10 @@ def _mesh_storm(tmp_path, kill):
         assert vtctl.main(["audit", "--server", router.url]) == 0
         return _placements(client)
     finally:
+        from volcano_tpu import vtfleet
+
+        if fleet_dir is not None:
+            vtfleet.disarm()
         cp.shutdown()
         router.stop()
         sup.stop()
@@ -266,8 +311,14 @@ def test_mesh_kill_shard_storm_matches_fault_free(tmp_path, monkeypatch):
         return conf
 
     monkeypatch.setattr(soak, "full_conf", delta_conf)
+    # clean run fully disarmed; kill run with fleet forensics armed and
+    # child tracing on (the env rides into the spawned shard processes,
+    # so the incident bundles capture real span rings) — placements must
+    # STILL match bit-for-bit: observability never steers a decision
     clean = _mesh_storm(tmp_path, kill=False)
-    stormy = _mesh_storm(tmp_path, kill=True)
+    monkeypatch.setenv("VOLCANO_TPU_TRACE", "1")
+    stormy = _mesh_storm(tmp_path, kill=True,
+                         fleet_dir=str(tmp_path / "incidents"))
     assert stormy == clean
     assert clean, "storm placed nothing — the parity check is vacuous"
 

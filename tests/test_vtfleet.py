@@ -1,0 +1,482 @@
+"""vtfleet: the cross-process observability plane.
+
+The gate for the fleet PR:
+
+  * histogram federation is EXACT — merging K per-proc expositions
+    bucket-wise produces byte-for-byte the histogram the union of the
+    observations would have produced (the PR-8 fixed bucket universe is
+    closed under merge), and the quantile error bound (one sub-bucket
+    width, 9/SUBBUCKETS relative) survives the merge;
+  * the merged /metrics exposition is conformant (HELP/TYPE once per
+    family, monotone cumulative buckets, +Inf == count, every series
+    proc-labelled) and byte-stable across harvest orders;
+  * clock alignment follows the NTP midpoint rule: a proc's spans shift
+    onto the harvester's clock by the harvest-RTT offset estimate, so a
+    skewed remote interleaves correctly;
+  * the ShardRouter passes ``?proc=`` through to every member debug
+    surface (and its own) — regression per endpoint;
+  * crash forensics: the FleetCollector's cached last-harvest snapshot
+    becomes an atomic per-incident bundle directory for a process that
+    is already dead;
+  * the acceptance timeline: one gang trace id, submitted through the
+    router over a 2-shard x 2-replica mesh, reconstructs from a single
+    ``vtctl trace last --fleet`` an ordered timeline spanning
+    vtctl -> router -> shard process -> replica, with the scheduler
+    cycle linked in;
+  * disarmed supervisor/router cycles construct ZERO collector objects
+    (spied) — the arming discipline's cost contract.
+"""
+
+import json
+import math
+import os
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from volcano_tpu import timeseries, trace, vtfleet, vtprof
+from volcano_tpu.api.objects import Metadata, Node, Queue
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.cli import vtctl
+from volcano_tpu.scheduler import metrics
+from volcano_tpu.scheduler.metrics_server import MetricsServer
+from volcano_tpu.store.client import RemoteStore
+
+from tests.test_chaos_soak import ControlPlane, _mk_job, _submit, _wait_running
+from tests.test_procmesh import NPROC, _mesh
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    metrics.reset()
+    yield
+    metrics.reset()
+    trace.disarm()
+    timeseries.disarm()
+    vtprof.disarm()
+    vtfleet.disarm()
+
+
+def _get(url, timeout=10):
+    return urllib.request.urlopen(url, timeout=timeout).read()
+
+
+def _get_json(url, timeout=10):
+    return json.loads(_get(url, timeout=timeout) or b"{}")
+
+
+# -- histogram federation: exact merge + surviving quantile bound -------------
+
+_FAM = "volcano_unit_merge_latency_seconds"
+
+
+def _exposition_for(values):
+    """One process's exposition containing exactly these observations."""
+    metrics.reset()
+    for v in values:
+        metrics.observe(_FAM, v)
+    text = metrics.expose_text()
+    metrics.reset()
+    return text
+
+
+def _bucket_quantile(hist, q):
+    """Quantile estimate off cumulative buckets: the upper edge of the
+    bucket the q-th observation falls in (what dashboards compute)."""
+    target = q * hist["count"]
+    for le, cum in hist["buckets"]:
+        if cum >= target and le != "+Inf":
+            return float(le)
+    return float("inf")
+
+
+def test_histogram_merge_is_exactly_the_union():
+    rng = random.Random(7)
+    vals = [rng.lognormvariate(0.0, 2.0) for _ in range(600)]
+    chunks = [vals[0::3], vals[1::3], vals[2::3]]
+    texts = {f"p{i}": _exposition_for(c) for i, c in enumerate(chunks)}
+    union = vtfleet.parse_exposition(_exposition_for(vals))
+    merged = vtfleet.parse_exposition(vtfleet.merge_metrics(texts))
+    fleet = merged[_FAM]["hist"][(("proc", "fleet"),)]
+    truth = union[_FAM]["hist"][()]
+    # bucket-for-bucket identical to the union-fed histogram: the fixed
+    # log-linear universe makes the merge closed (see vtfleet docstring)
+    assert fleet["buckets"] == truth["buckets"]
+    assert fleet["count"] == truth["count"] == len(vals)
+    assert float(fleet["sum"]) == pytest.approx(float(truth["sum"]),
+                                                rel=1e-9)
+    # ...and each proc's own series rides along, proc-labelled
+    for i, c in enumerate(chunks):
+        per = merged[_FAM]["hist"][(("proc", f"p{i}"),)]
+        assert per["count"] == len(c)
+
+
+def test_histogram_quantile_bound_survives_merge():
+    rng = random.Random(11)
+    vals = sorted(rng.lognormvariate(0.0, 2.0) for _ in range(900))
+    chunks = [vals[0::3], vals[1::3], vals[2::3]]
+    texts = {f"p{i}": _exposition_for(c) for i, c in enumerate(chunks)}
+    merged = vtfleet.parse_exposition(vtfleet.merge_metrics(texts))
+    fleet = merged[_FAM]["hist"][(("proc", "fleet"),)]
+    bound = 9.0 / metrics.SUBBUCKETS  # one sub-bucket width, relative
+    for q in (0.5, 0.9, 0.99):
+        est = _bucket_quantile(fleet, q)
+        # the bucket rule (first cum >= q*n) selects the bucket holding
+        # the ceil(q*n)-th smallest observation
+        true = vals[max(math.ceil(q * len(vals)) - 1, 0)]
+        # the estimate is the bucket's upper edge: never below the true
+        # sample, never more than one bucket width above it
+        assert est >= true * (1.0 - 1e-9), (q, est, true)
+        assert (est - true) / true <= bound + 1e-6, (q, est, true)
+
+
+# -- merged exposition: conformance + byte stability --------------------------
+
+
+def _three_proc_expositions():
+    texts = {}
+    for i, name in enumerate(("shard00", "shard01", "router")):
+        metrics.reset()
+        metrics.inc("volcano_unit_ops_total", float(i + 1), queue="q1")
+        metrics.inc("volcano_unit_ops_total", 1.0, queue="q2")
+        metrics.set_gauge("volcano_unit_depth", float(10 * i))
+        for v in (0.001 * (i + 1), 0.5, 2.0 ** i):
+            metrics.observe(_FAM, v)
+        texts[name] = metrics.expose_text()
+    metrics.reset()
+    return texts
+
+
+def test_merged_exposition_is_conformant():
+    merged = vtfleet.merge_metrics(_three_proc_expositions())
+    lines = merged.splitlines()
+    helps = [ln for ln in lines if ln.startswith("# HELP ")]
+    types = [ln for ln in lines if ln.startswith("# TYPE ")]
+    fams = [ln.split(" ", 3)[2] for ln in types]
+    # HELP/TYPE exactly once per family
+    assert len(set(fams)) == len(fams)
+    assert len(helps) == len(types) == len(fams)
+    # every sample line carries a proc= label
+    for ln in lines:
+        if not ln or ln.startswith("#"):
+            continue
+        assert 'proc="' in ln, ln
+    fam = vtfleet.parse_exposition(merged)[_FAM]
+    assert fam["type"] == "histogram"
+    assert set(dict(k)["proc"] for k in fam["hist"]) == {
+        "shard00", "shard01", "router", "fleet"}
+    for key, h in fam["hist"].items():
+        cums = [c for _, c in sorted(h["buckets"],
+                                     key=lambda b: vtfleet._le_key(b[0]))]
+        assert cums == sorted(cums), key  # monotone cumulative
+        assert cums[-1] == h["count"], key  # +Inf == count
+    # the counter federates with per-proc provenance, labels preserved
+    ops = vtfleet.parse_exposition(merged)["volcano_unit_ops_total"]
+    got = {(dict(labels)["proc"], dict(labels)["queue"]): float(v)
+           for labels, v in ops["scalar"]}
+    assert got[("shard00", "q1")] == 1.0
+    assert got[("router", "q1")] == 3.0
+    assert got[("shard01", "q2")] == 1.0
+
+
+def test_merged_exposition_is_byte_stable_across_harvest_orders():
+    texts = _three_proc_expositions()
+    a = vtfleet.merge_metrics(dict(sorted(texts.items())))
+    b = vtfleet.merge_metrics(dict(sorted(texts.items(), reverse=True)))
+    assert a == b
+    # absent procs (a dead member's None exposition) merge as if never
+    # harvested, not as an error
+    c = vtfleet.merge_metrics(dict(texts, ghost=None))
+    assert c == a
+
+
+# -- clock alignment ----------------------------------------------------------
+
+
+def _span(tid, sid, name, start, parent="", proc_extra=()):
+    return dict({"trace": tid, "span": sid, "parent": parent,
+                 "name": name, "start": start, "dur": 0.001,
+                 "attrs": {}, "links": [], "component": ""}, **dict(proc_extra))
+
+
+def test_merge_trace_aligns_skewed_remote_clock():
+    snap = {
+        "procs": {
+            "a": {"offset": 5.0, "trace": {
+                "armed": True, "pid": 11,
+                "spans": [_span("t1", "s1", "remote.op", 105.0)]}},
+            "b": {"offset": 0.0, "trace": {
+                "armed": True, "pid": 22,
+                "spans": [_span("t1", "s2", "local.op", 100.5)]}},
+        },
+        "unreachable": ["ghost"],
+    }
+    merged = vtfleet.merge_trace(snap)
+    assert merged["armed"]
+    # a's clock runs 5s fast: its span lands at 100.0 on the harvester's
+    # clock and therefore sorts BEFORE b's 100.5 despite the raw stamps
+    assert [(s["proc"], s["start"]) for s in merged["spans"]] == [
+        ("a", 100.0), ("b", 100.5)]
+    assert merged["procs"]["a"]["offset_s"] == 5.0
+    assert merged["procs"]["b"]["spans"] == 1
+    assert merged["unreachable"] == ["ghost"]
+
+
+class _SkewedHandler(BaseHTTPRequestHandler):
+    """A proc whose wall clock runs SKEW seconds fast, with one wedged
+    surface (/debug/prof 500s) to exercise harvest degradation."""
+
+    SKEW = 7.5
+
+    def do_GET(self):  # noqa: N802 - http.server contract
+        if self.path.startswith("/debug/prof"):
+            self.send_error(500)
+            return
+        if self.path.startswith("/metrics"):
+            body = b""
+            self.send_response(200)
+        else:
+            body = json.dumps({"armed": False, "pid": os.getpid(),
+                               "now": time.time() + self.SKEW,
+                               "spans": []}).encode()
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+def test_harvest_proc_estimates_midpoint_offset_and_degrades():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _SkewedHandler)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        snap = vtfleet.harvest_proc("skewed", url)
+        # NTP midpoint rule: offset ~= the injected skew (loopback RTT
+        # is the only error term)
+        assert snap["offset"] == pytest.approx(_SkewedHandler.SKEW,
+                                               abs=0.5)
+        assert snap["trace"] is not None
+        assert snap["prof"] is None  # wedged surface degraded, not fatal
+        assert snap["metrics"] == ""
+        # a dead proc raises on the FIRST surface -> unreachable
+        srv.shutdown()
+        srv.server_close()
+        with pytest.raises(Exception):
+            vtfleet.harvest_proc("skewed", url, timeout=0.5)
+    finally:
+        srv.server_close()
+
+
+# -- crash forensics: the incident bundle -------------------------------------
+
+
+def test_incident_bundle_from_last_harvested_snapshot(tmp_path):
+    trace.arm()
+    with trace.span("unit.incident"):
+        pass
+    srv = MetricsServer(port=0).start()
+    url = f"http://127.0.0.1:{srv.port}"
+    col = vtfleet.FleetCollector(incident_dir=str(tmp_path))
+    try:
+        col.harvest_member("m0", url)
+        snap = col.last("m0")
+        assert snap and snap["trace"]["armed"]
+    finally:
+        srv.stop()
+    # the member is dead now: a failed refresh KEEPS the last snapshot
+    col.harvest_member("m0", url)
+    assert col.last("m0") is snap
+    bundle = col.incident("m0", {"pid": 123, "reason": "unit"})
+    assert bundle and os.path.basename(bundle) == "incident-m0-123-0001"
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    assert set(os.listdir(bundle)) == {
+        "meta.json", "trace.json", "prof.json", "timeseries.json",
+        "digest.json"}
+    with open(os.path.join(bundle, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta == {"pid": 123, "reason": "unit", "proc": "m0"}
+    with open(os.path.join(bundle, "trace.json")) as f:
+        ring = json.load(f)
+    assert ring["armed"]
+    assert "unit.incident" in {s["name"] for s in ring["spans"]}
+    # a member that was never harvested still yields a bundle — with a
+    # null ring, because forensics must not mask the failure
+    ghost = col.incident("ghost", {"pid": 0})
+    assert ghost and os.path.basename(ghost) == "incident-ghost-0-0002"
+    with open(os.path.join(ghost, "trace.json")) as f:
+        assert json.load(f) is None
+
+
+# -- router ?proc= passthrough: regression per endpoint -----------------------
+
+
+def test_router_proc_passthrough_every_debug_endpoint():
+    sup, router = _mesh(NPROC)
+    try:
+        member_pids = {m["shard"]: m["pid"]
+                       for m in sup.status()["members"]}
+        for path in ("/debug/trace", "/debug/timeseries", "/debug/prof"):
+            mine = _get_json(f"{router.url}{path}?proc=router")
+            p0 = _get_json(f"{router.url}{path}?proc=0")
+            p1 = _get_json(f"{router.url}{path}?proc=1")
+            # router answers from the ROUTER's process, shard selectors
+            # from each member's own process
+            assert mine["pid"] == os.getpid(), path
+            assert p0["pid"] == member_pids[0], path
+            assert p1["pid"] == member_pids[1], path
+        # digest carries no pid: the passthrough must match the shard's
+        # own surface instead of the router's cross-shard rollup
+        direct = _get_json(sup.shard_map[0] + "/debug/digest")
+        via = _get_json(router.url + "/debug/digest?proc=0")
+        assert {k: v for k, v in via.items() if k != "now"} \
+            == {k: v for k, v in direct.items() if k != "now"}
+        # /metrics?proc=N is the RAW single-proc exposition (no proc=
+        # labels) — the federated merge only runs unselected
+        raw = _get(router.url + "/metrics?proc=0").decode()
+        assert 'proc="' not in raw
+        # unknown selectors 404 on every surface
+        for path in vtfleet.DEBUG_PATHS + ("/metrics",):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"{router.url}{path}?proc=9")
+            assert e.value.code == 404, path
+    finally:
+        router.stop()
+        sup.stop()
+
+
+# -- the acceptance timeline --------------------------------------------------
+
+
+def test_fleet_trace_reassembles_gang_timeline(tmp_path, monkeypatch,
+                                               capsys):
+    """One trace id, submitted through the router over a 2-shard x
+    2-replica mesh, reconstructs an ordered cross-process timeline from
+    a single ``vtctl trace last --fleet``: vtctl root -> router ->
+    shard leader -> replica, with the scheduler cycle linked in."""
+    # children arm via env, parent in-process; big rings — the control
+    # plane's cycle machinery churns spans fast enough to evict the one
+    # submit trace from the default ring before the harvest lands
+    monkeypatch.setenv("VOLCANO_TPU_TRACE", '{"ring": 65536}')
+    trace.arm(trace.Tracer(ring=65536))
+    sched_srv = MetricsServer(port=0).start()
+    sched_url = f"http://127.0.0.1:{sched_srv.port}"
+    state = str(tmp_path / "state.json")
+    sup, router = _mesh(2, state=state, wal=state + ".wal", replicas=2)
+    cp = ControlPlane(router.url)
+    try:
+        client = RemoteStore(router.url)
+        client.create("Queue", Queue(meta=Metadata(name="default",
+                                                   namespace="")))
+        client.create("Node", Node(
+            meta=Metadata(name="n0", namespace=""),
+            allocatable=Resource.from_resource_list(
+                {"cpu": "4", "memory": "8Gi", "pods": 110})))
+        cp.start(schedulers=1, controllers=1)
+        job = _mk_job("fj0", 2)
+        with trace.span("vtctl.job.run", job="soak/fj0"):
+            trace.stamp(job.meta)
+            _submit(client, job)
+        tid = trace.gang_trace(job.meta)
+        assert tid
+        _wait_running(client, "soak/fj0")
+
+        deadline = time.monotonic() + 30.0
+        while True:
+            snap = vtfleet.harvest(router.url,
+                                   daemons=[("sched", sched_url)])
+            merged = vtfleet.merge_trace(snap)
+            sel = trace.spans_for_trace(merged["spans"], tid)
+            procs = {s["proc"] for s in sel}
+            names = {s["name"] for s in sel}
+            leaders = {p for p in procs
+                       if p.startswith("shard") and ".r" not in p}
+            replicas = {p for p in procs if ".r" in p}
+            if leaders and replicas and {
+                    "vtctl.job.run", "router.post", "store.POST",
+                    "replica.apply", "scheduler.cycle"} <= names:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError((sorted(procs), sorted(names)))
+            time.sleep(0.2)
+
+        # structural order: the vtctl root parents the router request,
+        # which parents the shard leader's store request.  (In this
+        # harness the router thread shares the parent process, so its
+        # spans surface under BOTH the "router" and "sched" harvest
+        # targets and the dedup attributes each to one of them — the
+        # parent/child chain is attribution-independent.)
+        root = next(s for s in sel if s["name"] == "vtctl.job.run")
+        rpost = min((s for s in sel if s["name"] == "router.post"),
+                    key=lambda s: s["start"])
+        spost = min((s for s in sel if s["name"] == "store.POST"),
+                    key=lambda s: s["start"])
+        rapply = min((s for s in sel if s["name"] == "replica.apply"),
+                     key=lambda s: s["start"])
+        assert rpost["parent"] == root["span"]
+        assert rpost["proc"] in ("router", "sched")
+        assert spost["parent"] == rpost["span"]
+        assert spost["proc"] in leaders
+        assert rapply["proc"] in replicas
+        # temporal order on the ALIGNED clock, with midpoint-estimate
+        # slack on every cross-snapshot edge
+        assert rpost["start"] >= root["start"] - 0.05
+        assert spost["start"] >= rpost["start"] - 0.05
+        assert rapply["start"] >= spost["start"] - 0.05
+        # the scheduler cycle serving the gang links the trace id
+        cyc = next(s for s in sel if s["name"] == "scheduler.cycle")
+        assert tid in cyc["links"]
+
+        # ...and the single CLI invocation renders all of it
+        rc = vtctl.main(["trace", "last", "--server", router.url,
+                         "--fleet", "--daemon", f"sched={sched_url}",
+                         "--trace", tid])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert f"trace {tid}" in out
+        for proc in ("router", sorted(leaders)[0], rapply["proc"],
+                     "sched"):
+            assert f"proc {proc} " in out, (proc, out)
+        for name in ("vtctl.job.run", "router.post", "store.POST",
+                     "replica.apply"):
+            assert name in out, (name, out)
+    finally:
+        cp.shutdown()
+        router.stop()
+        sup.stop()
+        sched_srv.stop()
+
+
+# -- the arming discipline's cost contract ------------------------------------
+
+
+def test_disarmed_cycles_construct_zero_collector_objects(monkeypatch):
+    assert vtfleet.COLLECTOR is None  # disarmed default
+    made = []
+    orig = vtfleet.FleetCollector.__init__
+
+    def spy(self, *a, **k):
+        made.append((a, k))
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(vtfleet.FleetCollector, "__init__", spy)
+    sup, router = _mesh(1)
+    try:
+        rs = RemoteStore(router.url)
+        rs.create("Queue", Queue(meta=Metadata(name="default",
+                                               namespace="")))
+        time.sleep(0.5)  # several supervisor monitor ticks
+        # the federated /metrics merge runs collector-free too
+        assert b"volcano_" in _get(router.url + "/metrics")
+    finally:
+        router.stop()
+        sup.stop()
+    assert made == []

@@ -1146,6 +1146,51 @@ def test_metric_discipline_suppressions_carry_justification():
     assert cache.count("vtlint: disable=metric-discipline") == 1
 
 
+def test_metric_discipline_help_coverage_fires_in_vtfleet(tmp_path):
+    # a family recorded by vtfleet.py must be HELP'd in the _HELP table
+    # of scheduler/metrics.py — it lands on the router's FEDERATED
+    # /metrics, where a missing description becomes a placeholder on
+    # every dashboard
+    findings = _lint(tmp_path, "vtfleet.py", """
+        from volcano_tpu.scheduler import metrics
+
+        def record():
+            metrics.inc("volcano_fleet_made_up_series_total")
+    """, select=["metric-discipline"])
+    assert _rules_of(findings) == ["metric-discipline"]
+    assert "_HELP" in findings[0].message
+
+
+def test_metric_discipline_help_coverage_near_misses_stay_quiet(tmp_path):
+    # HELP'd families recorded from vtfleet.py pass; the same un-HELP'd
+    # family recorded OUTSIDE the scoped module set stays quiet (the
+    # sub-check fences the federated exposition, not the whole package)
+    assert _lint(tmp_path, "vtfleet.py", """
+        from volcano_tpu.scheduler import metrics
+
+        def record():
+            metrics.inc("volcano_fleet_harvests_total")
+            metrics.inc("volcano_proc_restarts_total", shard="00")
+    """, select=["metric-discipline"]) == []
+    assert _lint(tmp_path, "other.py", """
+        from volcano_tpu.scheduler import metrics
+
+        def record():
+            metrics.inc("volcano_fleet_made_up_series_total")
+    """, select=["metric-discipline"]) == []
+
+
+def test_metric_discipline_help_table_covers_fleet_families():
+    """The live vtfleet/supervisor families are all registered: the rule
+    passing on the real tree means the table kept up."""
+    from volcano_tpu.scheduler.metrics import _HELP
+
+    for fam in ("volcano_fleet_harvests_total",
+                "volcano_fleet_harvest_errors_total",
+                "volcano_proc_restarts_total", "volcano_proc_up"):
+        assert fam in _HELP, fam
+
+
 # --- suppression contract ---------------------------------------------------
 
 

@@ -27,11 +27,21 @@ Scope: the whole package — metric calls are recognized by shape
 ``metrics.register_*`` / ``metrics.set_gauge``, or the bare helpers
 inside a module that defines them) with a ``volcano``-prefixed literal
 name where naming is checked.
+
+4. **HELP coverage** (scoped to the fleet-observability modules) — a
+   series recorded by vtfleet.py lands on the FEDERATED exposition the
+   ShardRouter serves, where a missing ``# HELP`` line is filled with a
+   placeholder the operator's dashboards then display; every literal
+   family name those modules record must be registered in the ``_HELP``
+   table of scheduler/metrics.py.  Scoped because the general package
+   rule would fire on every reference-parity family that predates the
+   table.
 """
 
 from __future__ import annotations
 
 import ast
+import os
 from typing import Iterable, Optional
 
 from volcano_tpu.analysis.core import (
@@ -43,6 +53,42 @@ from volcano_tpu.analysis.core import (
 
 _UNIT_SUFFIXES = ("_seconds", "_milliseconds", "_microseconds")
 _DURATION_MARKERS = ("latency", "duration")
+
+#: modules whose recorded families must be HELP'd in scheduler/metrics.py
+#: (they feed the router's merged /metrics, where an un-HELP'd family
+#: gets a placeholder description on every operator dashboard)
+_HELP_SCOPED = ("vtfleet.py",)
+
+_HELP_CACHE: list = []  # [frozenset] once parsed; [None] on parse failure
+
+
+def _help_names() -> Optional[frozenset]:
+    """The literal keys of scheduler/metrics.py's ``_HELP`` table, read
+    by AST (importing the package from a lint pass would execute it).
+    Returns None — sub-check skipped — when the file cannot be parsed."""
+    if _HELP_CACHE:
+        return _HELP_CACHE[0]
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "scheduler", "metrics.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        names = None
+        for node in ast.walk(tree):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target]
+                       if isinstance(node, ast.AnnAssign) else [])
+            if any(isinstance(t, ast.Name) and t.id == "_HELP"
+                   for t in targets) and isinstance(node.value, ast.Dict):
+                names = frozenset(
+                    k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                )
+        _HELP_CACHE.append(names)
+    except (OSError, SyntaxError, ValueError):
+        _HELP_CACHE.append(None)
+    return _HELP_CACHE[0]
 
 
 def _metric_call(call: ast.Call) -> Optional[str]:
@@ -133,3 +179,15 @@ def check_metric_discipline(ctx: FileContext) -> Iterable[Finding]:
                 f"duration histogram {name!r} must carry a unit suffix "
                 "(_seconds/_milliseconds/_microseconds)",
             )
+        if ctx.basename in _HELP_SCOPED:
+            helped = _help_names()
+            if helped is not None and name not in helped:
+                yield ctx.finding(
+                    "metric-discipline",
+                    node,
+                    f"family {name!r} recorded by {ctx.basename} is "
+                    "missing from the _HELP table in "
+                    "scheduler/metrics.py: it lands on the router's "
+                    "federated /metrics with a placeholder HELP line "
+                    "(register a description beside the other families)",
+                )

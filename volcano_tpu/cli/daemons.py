@@ -185,6 +185,14 @@ def _run_apiserver_procmesh(port: int, host: str, default_queue: bool,
                 pass  # raced another seeder (supervisor restart)
     announce(f"apiserver (procmesh shards={proc_shards}) listening on "
              f"{router.url}", flush=True)
+    from volcano_tpu import vtfleet
+
+    if vtfleet.COLLECTOR is not None:
+        # fleet forensics armed (VOLCANO_TPU_FLEET): the supervisor's
+        # monitor loop caches member rings and writes an incident bundle
+        # when a shard process dies
+        announce("fleet collector armed: incident bundles in "
+                 f"{vtfleet.COLLECTOR.incident_dir or '.'}", flush=True)
     install_sigterm_exit()
     try:
         # the router serves from its own thread; park here until SIGTERM

@@ -478,6 +478,148 @@ def cmd_profile(payload, out: Optional[io.TextIOBase] = None) -> str:
     return text
 
 
+# -- vtfleet: cross-process observability plane (volcano_tpu/vtfleet.py) ------
+
+
+def _parse_daemon_flags(entries) -> list:
+    """``--daemon name=url`` flags -> ``[(name, url)]``, order kept."""
+    out = []
+    for entry in entries or []:
+        name, sep, url = entry.partition("=")
+        if not sep or not name.strip() or not url.strip():
+            raise ValueError(f"bad --daemon entry {entry!r}, "
+                             "want name=http://host:port")
+        out.append((name.strip(), url.strip().rstrip("/")))
+    return out
+
+
+def _fleet_snapshot(args) -> dict:
+    """One harvest round for a ``--fleet`` command: the --server front
+    (router or plain store) plus any --daemon sidecars; without --server
+    the in-process rings are harvested, so embedders and tests get the
+    same report shape a live mesh produces."""
+    from volcano_tpu import vtfleet
+
+    daemons = _parse_daemon_flags(getattr(args, "daemon", None))
+    if getattr(args, "server", ""):
+        return vtfleet.harvest(args.server, daemons=daemons)
+    return vtfleet.harvest(None, daemons=daemons, include_local=True)
+
+
+def _fleet_proc_lines(merged: dict, counted: str) -> str:
+    """The provenance header every fleet report opens with: one line per
+    harvested proc (pid / ring depth / clock offset), one UNREACHABLE
+    line per proc the harvest could not reach — a dead shard must be
+    VISIBLE in the report, not an error that hides the live ones."""
+    buf = io.StringIO()
+    for name in sorted(merged.get("procs") or {}):
+        m = merged["procs"][name]
+        buf.write(f"proc {name:<12} pid={m.get('pid')} "
+                  f"{counted}={m.get(counted, 0)} "
+                  f"offset={m.get('offset_s', 0.0):+.3f}s"
+                  + ("" if m.get("armed") else "  (disarmed)") + "\n")
+    for name in merged.get("unreachable") or []:
+        buf.write(f"proc {name:<12} UNREACHABLE (harvest degraded)\n")
+    return buf.getvalue()
+
+
+def cmd_trace_fleet(snap, trace_id: str = "",
+                    out: Optional[io.TextIOBase] = None) -> str:
+    """One gang's timeline across every harvested process: spans merge
+    onto the harvester's clock (vtfleet.merge_trace) and render as the
+    usual span tree — router span, shard apply, replica apply and
+    scheduler cycle interleave in true order."""
+    from volcano_tpu import vtfleet
+
+    merged = vtfleet.merge_trace(snap)
+    buf = io.StringIO()
+    buf.write(_fleet_proc_lines(merged, "spans"))
+    records = merged["spans"]
+    if not records:
+        buf.write("no spans recorded in any harvested proc (arm tracing "
+                  "with VOLCANO_TPU_TRACE=1)\n")
+    else:
+        for r in records:
+            # spans from a proc that never set a component label still
+            # need cross-process attribution in the tree
+            if not r.get("component"):
+                r["component"] = r.get("proc", "")
+        buf.write(trace.render_tree(
+            records, trace_id or trace.latest_trace(records)))
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def cmd_top_fleet(snap, out: Optional[io.TextIOBase] = None,
+                  n: int = 12) -> str:
+    """The fleet dashboard: per-shard apply/fsync/lag table with the
+    straggler verdict (vtfleet.top_fleet_text), then the merged
+    time-series ring through the usual ``vtctl top`` renderer."""
+    from volcano_tpu import vtfleet
+
+    buf = io.StringIO()
+    buf.write(vtfleet.top_fleet_text(snap))
+    merged = vtfleet.merge_timeseries(snap)
+    if merged["samples"]:
+        buf.write("\n")
+        cmd_top(merged["samples"], out=buf, n=n)
+    else:
+        buf.write("no time-series samples in any harvested proc (arm the "
+                  "recorder with VOLCANO_TPU_TIMESERIES=1)\n")
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def cmd_profile_fleet(snap, out: Optional[io.TextIOBase] = None) -> str:
+    """The fleet profile: the first harvested proc with cycle samples
+    renders the usual critical-path report, then the cross-process drain
+    attribution joins the applier's per-shard walls with each shard's
+    server-side fsync time (vtfleet.critical_path_text)."""
+    from volcano_tpu import vtfleet, vtprof
+
+    merged = vtfleet.merge_prof(snap)
+    buf = io.StringIO()
+    best = None
+    for name in sorted(merged["procs"]):
+        if (merged["procs"][name] or {}).get("cycles"):
+            best = name
+            break
+    if best is None:
+        buf.write("no profile samples in any harvested proc (arm the "
+                  "profiler with VOLCANO_TPU_PROF=1)\n")
+    else:
+        buf.write(f"profile from proc {best}:\n")
+        buf.write(vtprof.report_text(merged["procs"][best]))
+    for name in merged.get("unreachable") or []:
+        buf.write(f"proc {name:<12} UNREACHABLE (harvest degraded)\n")
+    buf.write(vtfleet.critical_path_text(snap))
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
+def cmd_describe_job_fleet(store, args,
+                           out: Optional[io.TextIOBase] = None) -> str:
+    """``describe job --fleet``: the ordinary describe body, then the
+    gang's cross-process span timeline (the trace id stamped on the job
+    annotation, reassembled from every reachable proc)."""
+    buf = io.StringIO()
+    cmd_describe_job(store, args.namespace, args.name, out=buf)
+    job = store.get("Job", f"{args.namespace}/{args.name}")
+    tid = trace.gang_trace(job.meta) if job is not None else ""
+    buf.write("Fleet trace:\n")
+    cmd_trace_fleet(_fleet_snapshot(args), trace_id=tid, out=buf)
+    text = buf.getvalue()
+    if out is not None:
+        out.write(text)
+    return text
+
+
 def _fetch_debug(server_url: str, path: str):
     """GET one /debug/* admin payload from a remote daemon."""
     import json as _json
@@ -840,7 +982,9 @@ def _main_remote(args) -> int:
         elif args.group == "pool":
             cmd_pool_list(store, out=sys.stdout)
         elif args.group == "describe":
-            if args.cmd == "job":
+            if args.cmd == "job" and getattr(args, "fleet", False):
+                cmd_describe_job_fleet(store, args, out=sys.stdout)
+            elif args.cmd == "job":
                 cmd_describe_job(store, args.namespace, args.name,
                                  out=sys.stdout)
             else:
@@ -969,10 +1113,25 @@ def main(argv=None) -> int:
     desc_p = sub.add_parser("describe",
                             help="why-focused object detail (job|pod)")
     desc_sub = desc_p.add_subparsers(dest="cmd", required=True)
+    # shared --fleet/--daemon surface (vtfleet): harvest the whole
+    # process fleet behind --server (router topology discovery) plus any
+    # --daemon sidecars, and render ONE merged report
+    def add_fleet_flags(p):
+        p.add_argument("--fleet", action="store_true",
+                       help="harvest every proc behind --server (plus "
+                            "--daemon sidecars) and render one merged "
+                            "cross-process report")
+        p.add_argument("--daemon", action="append", default=[],
+                       metavar="NAME=URL",
+                       help="extra daemon admin endpoint to harvest "
+                            "(repeatable), e.g. sched=http://127.0.0.1:8080")
+
     for what in ("job", "pod"):
         p = desc_sub.add_parser(what, parents=[common])
         p.add_argument("--name", "-n", required=True)
         p.add_argument("--namespace", "-N", default="default")
+        if what == "job":
+            add_fleet_flags(p)
     ev_p = sub.add_parser("events", parents=[common],
                           help="cluster event stream")
     ev_p.add_argument("--namespace", "-N", default="")
@@ -982,6 +1141,7 @@ def main(argv=None) -> int:
     last_p = tr_sub.add_parser("last", parents=[common])
     last_p.add_argument("--trace", "-t", default="",
                         help="trace id (default: most recent)")
+    add_fleet_flags(last_p)
     tr_sub.add_parser("dump", parents=[common])
 
     # vtload: the per-cycle time-series dashboard (timeseries.py)
@@ -994,6 +1154,7 @@ def main(argv=None) -> int:
                        help="refresh every N seconds (0 = render once)")
     top_p.add_argument("--count", type=int, default=0,
                        help="refresh iterations with --watch (0 = forever)")
+    add_fleet_flags(top_p)
 
     # vtprof: the critical-path profile report (vtprof.py)
     prof_p = sub.add_parser("profile", parents=[common],
@@ -1001,6 +1162,7 @@ def main(argv=None) -> int:
                                  "the /debug/prof ring")
     prof_p.add_argument("--json", action="store_true",
                         help="raw payload instead of the text report")
+    add_fleet_flags(prof_p)
 
     # vtaudit: the state-digest auditor (vtaudit.py)
     audit_p = sub.add_parser("audit", parents=[common],
@@ -1156,12 +1318,19 @@ def main(argv=None) -> int:
             return (timeseries.RECORDER.samples()
                     if timeseries.RECORDER is not None else [])
 
+        def render_once():
+            if args.fleet:
+                cmd_top_fleet(_fleet_snapshot(args), out=sys.stdout,
+                              n=args.n)
+            else:
+                cmd_top(samples_once(), out=sys.stdout, n=args.n)
+
         import time as _time
 
         i = 0
         try:
             while True:
-                cmd_top(samples_once(), out=sys.stdout, n=args.n)
+                render_once()
                 i += 1
                 if args.watch <= 0 or (args.count and i >= args.count):
                     break
@@ -1174,9 +1343,18 @@ def main(argv=None) -> int:
         return 0
 
     if args.group == "profile":
-        from volcano_tpu import vtprof
+        from volcano_tpu import vtfleet, vtprof
 
         try:
+            if args.fleet:
+                snap = _fleet_snapshot(args)
+                if args.json:
+                    import json as _json
+
+                    print(_json.dumps(vtfleet.merge_prof(snap)))
+                else:
+                    cmd_profile_fleet(snap, out=sys.stdout)
+                return 0
             if args.server:
                 payload = _fetch_debug_prof(args.server)
             else:
@@ -1187,6 +1365,18 @@ def main(argv=None) -> int:
                 print(_json.dumps(payload))
             else:
                 cmd_profile(payload, out=sys.stdout)
+        except Exception as e:  # surface as CLI error, not traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.group == "trace" and getattr(args, "fleet", False):
+        # `trace last --fleet`: one harvest round, one merged timeline —
+        # works remote (--server router/store) and local (in-process
+        # rings) alike, so it sits before the remote/local split
+        try:
+            cmd_trace_fleet(_fleet_snapshot(args), trace_id=args.trace,
+                            out=sys.stdout)
         except Exception as e:  # surface as CLI error, not traceback
             print(f"error: {e}", file=sys.stderr)
             return 1
@@ -1335,7 +1525,9 @@ def main(argv=None) -> int:
         elif args.group == "pool":
             cmd_pool_list(cluster.store, out=sys.stdout)
         elif args.group == "describe":
-            if args.cmd == "job":
+            if args.cmd == "job" and getattr(args, "fleet", False):
+                cmd_describe_job_fleet(cluster.store, args, out=sys.stdout)
+            elif args.cmd == "job":
                 cmd_describe_job(cluster.store, args.namespace, args.name,
                                  out=sys.stdout)
             else:

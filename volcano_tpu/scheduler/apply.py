@@ -21,7 +21,7 @@ import threading
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
-from volcano_tpu import events
+from volcano_tpu import events, vtprof
 from volcano_tpu.locksan import make_condition
 
 #: cap on the event-aggregation index (pod keys churn in a long-lived
@@ -393,6 +393,12 @@ class AsyncApplier:
         if shard is not None:
             key = f"{self._shard_key_prefix()}{int(shard):02d}_s"
             stats[key] = stats.get(key, 0.0) + total
+        prof = vtprof.PROFILER
+        if prof is not None:
+            # ship the cumulative walls with the profile so the fleet
+            # critical-path report can join them with shard-side
+            # apply/fsync sections across the process seam
+            prof.note_drain(stats)
 
     def _shard_key_prefix(self) -> str:
         """Per-shard drain-key family: ``shardNN_s`` against an

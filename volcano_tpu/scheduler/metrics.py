@@ -478,6 +478,23 @@ def register_delta_shed(n: int = 1) -> None:
     inc("volcano_delta_shed_gangs_total", float(n))
 
 
+# -- vtfleet process-supervision series (store/procmesh, vtfleet.py) ----------
+
+def register_proc_restart(shard: int, replica: int = 0) -> None:
+    """Supervisor respawns of one mesh member — the crash-forensics
+    counter the SIGKILL-storm acceptance reconciles against the
+    supervisor's own restart count."""
+    inc("volcano_proc_restarts_total",
+        shard=f"{int(shard):02d}", replica=str(int(replica)))
+
+
+def update_proc_up(shard: int, up: bool, replica: int = 0) -> None:
+    """Liveness gauge per supervised mesh member (1 while the child
+    process is alive, 0 between its death and the respawn)."""
+    set_gauge("volcano_proc_up", 1.0 if up else 0.0,
+              shard=f"{int(shard):02d}", replica=str(int(replica)))
+
+
 # -- elastic autoscaler series (volcano_tpu/elastic/) -------------------------
 
 def update_pool_size(pool: str, size: int) -> None:
@@ -557,6 +574,14 @@ _HELP: Dict[str, str] = {
         "Full snapshot builds under delta mode, by trigger reason",
     "volcano_delta_shed_gangs_total":
         "Gangs shed to the Backlogged condition by admission control",
+    "volcano_proc_restarts_total":
+        "Supervisor respawns of a mesh shard process, by shard/replica",
+    "volcano_proc_up":
+        "Liveness of a supervised mesh member (1 alive, 0 dead)",
+    "volcano_fleet_harvests_total":
+        "Fleet observability harvest rounds completed",
+    "volcano_fleet_harvest_errors_total":
+        "Procs unreachable during fleet harvest rounds",
     _DROPPED_SERIES:
         "Observations dropped by the per-metric label-cardinality cap",
 }

@@ -13,14 +13,22 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from volcano_tpu import timeseries, trace, vtaudit, vtprof
+from volcano_tpu import timeseries, trace, vtaudit, vtfleet, vtprof
 from volcano_tpu.scheduler import metrics
 
 
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (stdlib API)
-        if self.path == "/metrics":
-            body = metrics.expose_text().encode()
+        if self.path.startswith("/metrics"):
+            if vtfleet.COLLECTOR is None:
+                body = metrics.expose_text().encode()
+            else:
+                # local-mode federation: same proc= label scheme as the
+                # ShardRouter's merged /metrics, so a single-process
+                # deployment scrapes into the same dashboards
+                name = trace.component() or "local"
+                body = vtfleet.merge_metrics(
+                    {name: metrics.expose_text()}).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
         elif self.path.startswith("/debug/trace"):

@@ -36,7 +36,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, quote, urlparse
 
-from volcano_tpu import vtaudit
+from volcano_tpu import timeseries, trace, vtaudit, vtfleet, vtprof
 from volcano_tpu.locksan import make_lock
 from volcano_tpu.store.partition import (
     shard_of, shard_of_key, split_segment, wal_shard,
@@ -88,41 +88,49 @@ class ShardRouter:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_raw(self, code: int, body: bytes,
+                           ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _body(self) -> Dict[str, Any]:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n) or b"{}")
 
             def do_GET(self):
                 try:
-                    router._get(self)
+                    router._handle(self, "get", router._get)
                 except Exception as e:  # noqa: BLE001 - wire boundary
                     self._reply(500, {"error": repr(e)})
 
             def do_POST(self):
                 try:
-                    router._post(self)
+                    router._handle(self, "post", router._post)
                 except Exception as e:  # noqa: BLE001
                     self._reply(500, {"error": repr(e)})
 
             def do_PUT(self):
                 try:
-                    router._forward_object_write(self, "PUT")
+                    router._handle(
+                        self, "put",
+                        lambda h: router._forward_object_write(h, "PUT"))
                 except Exception as e:  # noqa: BLE001
                     self._reply(500, {"error": repr(e)})
 
             def do_PATCH(self):
                 try:
-                    router._forward_key_write(self, "PATCH")
+                    router._handle(
+                        self, "patch",
+                        lambda h: router._forward_key_write(h, "PATCH"))
                 except Exception as e:  # noqa: BLE001
                     self._reply(500, {"error": repr(e)})
 
             def do_DELETE(self):
                 try:
-                    u = urlparse(self.path)
-                    if u.path == "/chaos":
-                        router._chaos_fan(self, "DELETE")
-                        return
-                    router._forward_key_write(self, "DELETE")
+                    router._handle(self, "delete", router._delete)
                 except Exception as e:  # noqa: BLE001
                     self._reply(500, {"error": repr(e)})
 
@@ -148,6 +156,36 @@ class ShardRouter:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    # -- request tracing -----------------------------------------------------
+
+    def _handle(self, h, verb: str, fn) -> None:
+        """Continue a client's ``X-Volcano-Trace`` context around one
+        routed request, exactly like the store server's ``_traced``:
+        disarmed or uncontexted costs one attribute check, and the
+        admin/forensics surfaces are never traced (reading the flight
+        recorder must not write to it)."""
+        if trace.TRACER is None:
+            return fn(h)
+        path = h.path
+        if path.startswith("/chaos") or path.startswith("/debug/") \
+                or path.startswith("/metrics") \
+                or path.startswith("/procmesh"):
+            return fn(h)
+        header = h.headers.get(trace.HEADER, "")
+        if not header:
+            return fn(h)
+        trace.set_component("router")
+        with trace.request_context(
+            header, f"router.{verb}", path=path.split("?", 1)[0],
+        ):
+            return fn(h)
+
+    def _delete(self, h) -> None:
+        u = urlparse(h.path)
+        if u.path == "/chaos":
+            return self._chaos_fan(h, "DELETE")
+        return self._forward_key_write(h, "DELETE")
+
     # -- shard http ----------------------------------------------------------
 
     def _shard_req(self, shard: int, method: str, path: str,
@@ -156,6 +194,13 @@ class ShardRouter:
                    ) -> Tuple[int, Dict[str, Any]]:
         data = json.dumps(payload).encode() if payload is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
+        if trace.TRACER is not None:
+            # forward the routed request's ambient context so the shard
+            # process's store.* span parents under the router span — the
+            # router -> shard leg of the fleet timeline
+            tid, sid = trace.current()
+            if tid:
+                headers[trace.HEADER] = trace.format_header(tid, sid)
         req = urllib.request.Request(
             self.shard_map[shard] + path, data=data, method=method,
             headers=headers,
@@ -211,13 +256,22 @@ class ShardRouter:
             return self._healthz(h)
         if u.path == "/watch":
             return self._watch(h, q)
+        if u.path in ("/debug/trace", "/debug/prof", "/debug/timeseries",
+                      "/debug/digest", "/metrics"):
+            proc = (q.get("proc") or [None])[0]
+            if proc is not None:
+                # exact-match passthrough: one front URL reaches ANY
+                # process in the mesh (leaders, followers via "N.rM",
+                # the router's own process via "router")
+                return self._proc_passthrough(h, u.path, q, proc)
         if u.path == "/debug/digest":
             return self._digest(h, q)
         if u.path in ("/debug/trace", "/debug/prof", "/debug/timeseries"):
-            # single-process forensics surfaces: shard 0's view (cross-
-            # shard rollups live on /debug/digest and /procmesh/shards)
-            code, body = self._shard_req(0, "GET", h.path)
-            return h._reply(code, body)
+            # fleet-merged forensics: every member's ring plus the
+            # router's own, clock-aligned, with per-proc provenance
+            return self._debug_fleet(h, u.path)
+        if u.path == "/metrics":
+            return self._metrics_fleet(h)
         if u.path == "/procmesh/shards":
             if self.supervisor is not None:
                 return h._reply(200, self.supervisor.status())
@@ -385,6 +439,148 @@ class ShardRouter:
         if all(b.get("root") is not None for b in bodies):
             out.update(vtaudit.merge_digest_payloads(bodies))
         return h._reply(200, out)
+
+    # -- fleet observability surfaces ----------------------------------------
+
+    _LOCAL_PROCS = ("router", "self")
+
+    def _local_debug(self, path: str) -> Dict[str, Any]:
+        """The router's OWN process view of one debug surface."""
+        if path == "/debug/trace":
+            return trace.debug_payload()
+        if path == "/debug/timeseries":
+            return timeseries.debug_payload()
+        if path == "/debug/prof":
+            return vtprof.debug_payload()
+        return vtaudit.debug_payload()
+
+    def _proc_url(self, proc: str) -> str:
+        """Resolve a ``proc=`` selector (``N`` leader / ``N.rM``
+        follower) to a member URL; raises ``KeyError`` for an unknown
+        member."""
+        stem, _, rep = proc.partition(".r")
+        shard = int(stem)
+        replica = int(rep) if rep else 0
+        if self.supervisor is not None:
+            for m in self.supervisor.status()["members"]:
+                if m["shard"] == shard and m["replica"] == replica:
+                    return m["url"]
+            raise KeyError(proc)
+        if replica == 0 and 0 <= shard < self.nshards:
+            return self.shard_map[shard]
+        raise KeyError(proc)
+
+    def _proc_passthrough(self, h, path: str, q, proc: str) -> None:
+        rest = "&".join(f"{k}={quote(v, safe='')}"
+                        for k, vs in sorted(q.items()) if k != "proc"
+                        for v in vs)
+        if proc in self._LOCAL_PROCS:
+            from volcano_tpu.scheduler import metrics as _metrics
+
+            if path == "/metrics":
+                return h._reply_raw(200, _metrics.expose_text().encode(),
+                                    "text/plain; version=0.0.4")
+            return h._reply(200, self._local_debug(path))
+        try:
+            url = self._proc_url(proc)
+        except (KeyError, ValueError):
+            return h._reply(404, {"error": f"no proc {proc}"})
+        fwd = path + (f"?{rest}" if rest else "")
+        try:
+            with urllib.request.urlopen(
+                url + fwd, timeout=self.timeout
+            ) as resp:
+                return h._reply_raw(
+                    resp.status, resp.read(),
+                    resp.headers.get("Content-Type", "application/json"))
+        except urllib.error.HTTPError as e:
+            return h._reply_raw(
+                e.code, e.read() or b"{}",
+                e.headers.get("Content-Type", "application/json"))
+
+    def _fleet_snapshot(self) -> Dict[str, Any]:
+        """One harvest round over the mesh: every member's surfaces in
+        parallel plus the router's own process, vtfleet-shaped.  A dead
+        member degrades to an ``unreachable`` entry."""
+        mesh: Optional[Dict[str, Any]] = None
+        if self.supervisor is not None:
+            mesh = self.supervisor.status()
+            targets = [
+                (vtfleet.member_name(m["shard"], m["replica"]), m["url"])
+                for m in mesh["members"]
+            ]
+        else:
+            targets = [(vtfleet.member_name(i), url)
+                       for i, url in enumerate(self.shard_map)]
+        procs: Dict[str, Any] = {}
+        unreachable: List[str] = []
+        mu = make_lock("ShardRouter.fleet_harvest")
+
+        def one(name: str, url: str) -> None:
+            try:
+                snap = vtfleet.harvest_proc(name, url, timeout=self.timeout)
+            except Exception:  # noqa: BLE001 - partial harvest reports
+                with mu:
+                    unreachable.append(name)
+                return
+            with mu:
+                procs[name] = snap
+
+        threads = [threading.Thread(target=one, args=t, daemon=True)
+                   for t in targets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        procs["router"] = vtfleet.local_proc("router")
+        return {"procs": procs, "unreachable": sorted(unreachable),
+                "mesh": mesh}
+
+    def _debug_fleet(self, h, path: str) -> None:
+        snap = self._fleet_snapshot()
+        if path == "/debug/trace":
+            return h._reply(200, vtfleet.merge_trace(snap))
+        if path == "/debug/timeseries":
+            return h._reply(200, vtfleet.merge_timeseries(snap))
+        return h._reply(200, vtfleet.merge_prof(snap))
+
+    def _metrics_fleet(self, h) -> None:
+        """Federated ``/metrics``: each member's exposition under its
+        ``proc=`` label plus the router's own, histogram families
+        rolled up bucket-wise under ``proc="fleet"``."""
+        from volcano_tpu.scheduler import metrics as _metrics
+
+        texts: Dict[str, Optional[str]] = {}
+        mu = make_lock("ShardRouter.metrics_fan")
+        if self.supervisor is not None:
+            targets = [
+                (vtfleet.member_name(m["shard"], m["replica"]), m["url"])
+                for m in self.supervisor.status()["members"]
+            ]
+        else:
+            targets = [(vtfleet.member_name(i), url)
+                       for i, url in enumerate(self.shard_map)]
+
+        def one(name: str, url: str) -> None:
+            try:
+                with urllib.request.urlopen(
+                    url + "/metrics", timeout=self.timeout
+                ) as resp:
+                    body = resp.read().decode("utf-8", "replace")
+            except Exception:  # noqa: BLE001 - dead member: skip series
+                return
+            with mu:
+                texts[name] = body
+
+        threads = [threading.Thread(target=one, args=t, daemon=True)
+                   for t in targets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        texts["router"] = _metrics.expose_text()
+        body = vtfleet.merge_metrics(texts).encode()
+        return h._reply_raw(200, body, "text/plain; version=0.0.4")
 
     # -- mutation routes ------------------------------------------------------
 

@@ -42,7 +42,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from volcano_tpu import timeseries, vtfleet
 from volcano_tpu.locksan import make_lock
+from volcano_tpu.scheduler import metrics
 from volcano_tpu.store.partition import shard_of, shard_wal_dir
 from volcano_tpu.store.procmesh.seqbus import SeqBus
 
@@ -205,6 +207,12 @@ class ShardSupervisor:
             self._spawn(m)
         self._await_ready(len(self.members))
         self._wait_members_healthy()
+        col = vtfleet.COLLECTOR
+        if col is not None:
+            # armed: cache a BASELINE snapshot of every member before
+            # the monitor takes over — a member killed within the first
+            # tick must still yield an incident bundle with a real ring
+            self._harvest_round(col)
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="procmesh-monitor", daemon=True,
         )
@@ -288,6 +296,15 @@ class ShardSupervisor:
         )
         p.start()
         m.proc = p
+        # structural lifecycle events: every spawn/respawn lands in the
+        # supervisor's time-series ring (vtctl top renders them) and
+        # flips the liveness gauge; the metrics registry records
+        # unconditionally, timeseries.record is a free no-op disarmed
+        timeseries.record(
+            "proc", event="respawn" if m.restarts else "spawn",
+            shard=m.cfg["shard"], replica=m.cfg["replica"], pid=p.pid)
+        metrics.update_proc_up(m.cfg["shard"], True,
+                               replica=m.cfg["replica"])
 
     def _await_ready(self, n: int) -> None:
         deadline = time.monotonic() + self.ready_timeout
@@ -313,6 +330,16 @@ class ShardSupervisor:
             if not wait_healthy(m.url, timeout=self.ready_timeout):
                 raise RuntimeError(f"procmesh: {m.url} never became healthy")
 
+    def _harvest_round(self, col) -> None:
+        """One fleet-collector refresh pass over the live members."""
+        with self._mu:
+            live = [(vtfleet.member_name(m.cfg["shard"],
+                                         m.cfg["replica"]), m.url)
+                    for m in self.members
+                    if m.proc is not None and m.proc.is_alive()]
+        for name, url in live:
+            col.harvest_member(name, url)
+
     def _monitor_loop(self) -> None:
         while not self._stop.wait(0.2):
             # drain restart-time ready messages so the queue never fills
@@ -321,14 +348,37 @@ class ShardSupervisor:
                     self._ready_q.get_nowait()
             except queue.Empty:
                 pass  # drained
+            col = vtfleet.COLLECTOR
+            if col is not None:
+                # armed-only periodic harvest: cache every live member's
+                # forensics surfaces so a member that dies THIS tick
+                # still yields an incident bundle with its final rings
+                self._harvest_round(col)
             with self._mu:
                 dead = [m for m in self.members
                         if m.proc is not None and not m.proc.is_alive()]
             for m in dead:
                 if self._stop.is_set() or not self.restart:
                     break
+                dead_pid = m.proc.pid
                 m.proc.join(timeout=1.0)
                 m.restarts += 1
+                shard, replica = m.cfg["shard"], m.cfg["replica"]
+                timeseries.record("proc", event="exit", shard=shard,
+                                  replica=replica, pid=dead_pid,
+                                  exitcode=m.proc.exitcode)
+                metrics.update_proc_up(shard, False, replica=replica)
+                metrics.register_proc_restart(shard, replica=replica)
+                if col is not None:
+                    # crash forensics BEFORE the respawn reuses the port:
+                    # the bundle is the member's last harvested snapshot
+                    # (its "final" trace ring/profile — the process is
+                    # already gone)
+                    col.incident(
+                        vtfleet.member_name(shard, replica),
+                        {"pid": dead_pid, "shard": shard,
+                         "replica": replica, "exitcode": m.proc.exitcode,
+                         "restarts": m.restarts, "reason": "proc-exit"})
                 # same config, same port, same paths: recovery replays
                 # the shard's WAL tail and advance_to() rejoins the line
                 self._spawn(m)

@@ -51,7 +51,7 @@ import urllib.request
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from volcano_tpu import effectsan, vtaudit
+from volcano_tpu import effectsan, trace, vtaudit
 from volcano_tpu.backoff import Backoff
 from volcano_tpu.chaos import InjectedCrash, crash_point
 from volcano_tpu.leader import LeaderElector
@@ -657,6 +657,22 @@ class Replicator:
 # -- follower replay (the live-path mirror) --------------------------------
 
 
+def _apply_object_record(store, kind: str, op: str, obj) -> None:
+    """Converge the store on one shipped create/update, crossed-lineage
+    fallback included (a snapshot already holding a later life of the
+    key replays the record's object either way)."""
+    try:
+        if op == "create":
+            store.create(kind, obj)
+        else:
+            store.update(kind, obj)
+    except KeyError:
+        if op == "create":
+            store.update(kind, obj)
+        else:
+            store.create(kind, obj)
+
+
 def apply_record(srv, repl: Replicator, rec: Dict[str, Any]) -> None:
     """Replay one shipped record through the LIVE verb paths — unlike
     crash recovery's ``_replay_record``, this produces watch events, so
@@ -682,18 +698,18 @@ def apply_record(srv, repl: Replicator, rec: Dict[str, Any]) -> None:
             enc = rec["object"]
             obj = decode_object(kind, enc)
             rv = obj.meta.resource_version
-            try:
-                if op == "create":
-                    store.create(kind, obj)
-                else:
-                    store.update(kind, obj)
-            except KeyError:
-                # crossed lineage (snapshot already held a later life of
-                # the key): converge on the record's object either way
-                if op == "create":
-                    store.update(kind, obj)
-                else:
-                    store.create(kind, obj)
+            tid = "" if trace.TRACER is None else trace.gang_trace(obj.meta)
+            if tid:
+                # the replica leg of a gang's fleet timeline: join the
+                # object's own trace so `vtctl trace last --fleet` shows
+                # leader append -> follower apply in order (untraced
+                # records open no span — the feed must not churn the
+                # ring out from under the gang spans)
+                with trace.span("replica.apply", trace_id=tid, op=op,
+                                kind=kind, key=obj.meta.key):
+                    _apply_object_record(store, kind, op, obj)
+            else:
+                _apply_object_record(store, kind, op, obj)
             obj.meta.resource_version = rv
             shadow = store._shadow[kind].get(obj.meta.key)
             if shadow is not None:

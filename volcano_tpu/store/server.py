@@ -59,7 +59,8 @@ def _traced(verb: str):
             if trace.TRACER is None:
                 return fn(self)
             path = self.path
-            if path.startswith("/chaos") or path.startswith("/debug/"):
+            if path.startswith("/chaos") or path.startswith("/debug/") \
+                    or path.startswith("/metrics"):
                 return fn(self)
             header = self.headers.get(trace.HEADER, "")
             if not header:
@@ -274,6 +275,15 @@ class StoreServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(self, code: int, body: bytes,
+                            ctype: str = "text/plain; version=0.0.4"
+                            ) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _body(self) -> Dict[str, Any]:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n) or b"{}")
@@ -357,6 +367,14 @@ class StoreServer:
                     # vtaudit state digests (vtctl audit): chaos-exempt —
                     # auditing a diverged store must work mid-storm
                     return self._reply(200, server.digest_debug(q))
+                if u.path == "/metrics":
+                    # Prometheus exposition of THIS process's series —
+                    # the vtfleet federation harvests each shard process
+                    # here; chaos-exempt like the /debug surfaces
+                    from volcano_tpu.scheduler import metrics
+
+                    return self._reply_text(
+                        200, metrics.expose_text().encode())
                 if u.path == "/repl/status":
                     # chaos-exempt: the election protocol probes peers
                     # through this mid-storm — a faulted probe would read

@@ -65,6 +65,8 @@ class Recorder:
         return {
             "armed": True,
             "pid": os.getpid(),
+            # serving-side clock stamp for vtfleet's offset estimate
+            "now": time.time(),
             "ring": self.ring_size,
             "samples": self.samples(),
         }
@@ -117,5 +119,6 @@ def debug_payload() -> Dict[str, Any]:
     """The ``/debug/timeseries`` response body (store + metrics servers)."""
     rec = RECORDER
     if rec is None:
-        return {"armed": False, "pid": os.getpid(), "samples": []}
+        return {"armed": False, "pid": os.getpid(), "now": time.time(),
+                "samples": []}
     return rec.payload()

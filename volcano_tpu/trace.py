@@ -440,9 +440,14 @@ def debug_payload() -> Dict[str, Any]:
     """The ``/debug/trace`` response body (store server + MetricsServer)."""
     tr = TRACER
     if tr is None:
-        return {"armed": False, "pid": os.getpid(), "spans": []}
+        return {"armed": False, "pid": os.getpid(), "now": time.time(),
+                "spans": []}
     out = tr.dump()
     out["armed"] = True
+    # the serving process's wall clock at response build: the vtfleet
+    # harvester estimates this proc's clock offset from it (midpoint of
+    # the harvest round-trip) to align spans onto one fleet timeline
+    out["now"] = time.time()
     return out
 
 

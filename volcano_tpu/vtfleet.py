@@ -1,0 +1,735 @@
+"""vtfleet: the cross-process observability plane.
+
+PR 18 turned the store into a fleet — a supervisor, N shard processes,
+optional per-shard replica groups, a router, plus scheduler/controller
+daemons — but every forensics layer (vtrace rings, vtprof segments,
+timeseries, metrics, digests) is strictly per-process.  This module is
+the federation tier over those surfaces:
+
+* **Topology discovery** — :func:`discover` asks one front URL for
+  ``/procmesh/shards``; a ShardRouter answers with the supervisor's
+  live member list (leaders and followers, stable URLs), anything else
+  is treated as a single-process store.  Configured daemons (scheduler,
+  controller metrics servers) join the harvest as extra named procs.
+* **Harvest + clock alignment** — :func:`harvest` fans one round over
+  every proc's ``/debug/trace|timeseries|prof|digest`` and ``/metrics``
+  (all chaos-exempt).  Each debug payload carries ``now`` (the serving
+  process's ``time.time()`` at response build); the per-proc clock
+  offset is estimated on the harvest round-trip as
+  ``offset = now_remote - (t0 + t1) / 2`` (the NTP midpoint rule: the
+  remote stamp is assumed to land mid-flight), and every remote
+  timestamp is mapped onto the harvester's clock as ``t - offset``.
+  Unreachable procs degrade to an ``unreachable`` entry — a partial
+  harvest is a report, not an error.
+* **Merges** — :func:`merge_trace` / :func:`merge_timeseries` tag every
+  span/sample with its ``proc`` and sort by aligned time, so one gang's
+  trace id reconstructs a single end-to-end timeline spanning
+  router -> shard process -> replica -> scheduler.
+  :func:`merge_metrics` federates Prometheus expositions: every
+  harvested series gains a ``proc=`` label, and histogram families
+  additionally get a ``proc="fleet"`` bucket-wise-merged rollup.
+* **Crash forensics** — the armed :class:`FleetCollector` caches each
+  member's last-harvested snapshot so the ShardSupervisor can write an
+  atomic per-incident bundle directory for a process that is already
+  dead (:meth:`FleetCollector.incident`, the ``crash_dump`` pattern
+  fleet-scoped).
+
+Why the PR-8 histogram scheme is closed under merge: every process
+buckets observations into the SAME fixed log-linear universe
+(``metrics._bucket_index``: SUBBUCKETS per decade over [1e-9, 1e9],
+plus underflow/overflow sentinels) — bucket boundaries are a pure
+function of the index, never of the data.  A histogram is a sparse
+``index -> count`` map plus exact ``sum``/``count``, so merging K
+per-proc histograms is bucket-wise counter addition, which is
+associative and commutative and yields EXACTLY the histogram the union
+of the observations would have produced.  The relative quantile error
+bound (one sub-bucket width, ~1/SUBBUCKETS) depends only on the bucket
+geometry, so it survives any merge.  The text exposition preserves
+this: cumulative bucket lines decode back to per-bucket counts
+(adjacent differences), merge by ``le``, and re-cumulate
+(:func:`merge_histogram_series`).
+
+Arming follows the chaos/trace discipline: **disarmed is the default
+and costs one module attribute check per site** (``COLLECTOR is
+None``); ``VOLCANO_TPU_FLEET=1`` (or ``{"dir": "/incident/root"}``)
+arms at boot, tests arm in-process via :func:`arm`.  Disarmed
+supervisor cycles construct zero collector objects.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from volcano_tpu import timeseries, trace, vtaudit, vtprof
+from volcano_tpu.locksan import make_lock
+from volcano_tpu.scheduler import metrics
+
+ENV_VAR = "VOLCANO_TPU_FLEET"
+
+#: the debug surfaces one harvest visits per process (chaos-exempt on
+#: both the store server and the MetricsServer)
+DEBUG_PATHS = ("/debug/trace", "/debug/timeseries", "/debug/prof",
+               "/debug/digest")
+
+
+# -- harvest ------------------------------------------------------------------
+
+
+def _http(url: str, timeout: float) -> Tuple[bytes, float, float]:
+    """One GET with wall-clock stamps around it — the round trip the
+    clock-offset estimate rides on."""
+    t0 = time.time()
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        raw = resp.read()
+    t1 = time.time()
+    return raw, t0, t1
+
+
+def harvest_proc(name: str, base_url: str, timeout: float = 2.0,
+                 query: str = "") -> Dict[str, Any]:
+    """Harvest one process's debug surfaces + metrics.  Returns the
+    per-proc snapshot ``{"name", "url", "offset", "trace",
+    "timeseries", "prof", "digest", "metrics"}``.  A transport failure
+    on the FIRST surface raises (the proc is unreachable); later
+    surfaces degrade to ``None`` so one wedged endpoint cannot void a
+    whole harvest."""
+    base = base_url.rstrip("/")
+    suffix = f"?{query}" if query else ""
+    out: Dict[str, Any] = {"name": name, "url": base, "offset": 0.0}
+    offset: Optional[float] = None
+    first = True
+    for path in DEBUG_PATHS:
+        key = path.rsplit("/", 1)[-1]
+        try:
+            raw, t0, t1 = _http(base + path + suffix, timeout)
+            payload = json.loads(raw or b"{}")
+        except Exception:  # noqa: BLE001 - wire boundary
+            if first:
+                raise
+            out[key] = None
+            first = False
+            continue
+        first = False
+        now = payload.get("now")
+        if offset is None and isinstance(now, (int, float)):
+            # NTP midpoint rule: the remote stamped "now" mid-request
+            offset = float(now) - (t0 + t1) / 2.0
+        out[key] = payload
+    try:
+        raw, _, _ = _http(base + "/metrics" + suffix, timeout)
+        out["metrics"] = raw.decode("utf-8", "replace")
+    except Exception:  # noqa: BLE001 - wire boundary
+        out["metrics"] = None
+    out["offset"] = float(offset or 0.0)
+    return out
+
+
+def local_proc(name: str = "local") -> Dict[str, Any]:
+    """This process's own surfaces as one harvest entry (offset 0 by
+    definition: the harvester's clock is the reference)."""
+    return {
+        "name": name,
+        "url": "",
+        "offset": 0.0,
+        "trace": trace.debug_payload(),
+        "timeseries": timeseries.debug_payload(),
+        "prof": vtprof.debug_payload(),
+        "digest": vtaudit.debug_payload(),
+        "metrics": metrics.expose_text(),
+    }
+
+
+def member_name(shard: int, replica: int = 0) -> str:
+    """Canonical proc name for one mesh member (mirrors the component
+    name ``_shard_main`` installs in the child)."""
+    name = f"shard{int(shard):02d}"
+    return name if not replica else f"{name}.r{int(replica)}"
+
+
+def discover(front_url: str, timeout: float = 2.0
+             ) -> Tuple[List[Dict[str, str]], Optional[Dict[str, Any]]]:
+    """Process topology behind one front URL: the procmesh member list
+    (leaders and followers, plus the router's own process reached via
+    ``?proc=router`` passthrough) when the front is a ShardRouter, else
+    the front itself as one ``store`` proc.  Returns ``(targets,
+    mesh_status)`` where ``mesh_status`` is ``/procmesh/shards`` (None
+    off-mesh)."""
+    front = front_url.rstrip("/")
+    status: Optional[Dict[str, Any]] = None
+    try:
+        raw, _, _ = _http(front + "/procmesh/shards", timeout)
+        status = json.loads(raw or b"{}")
+    except Exception:  # noqa: BLE001 - not a router: single-process store
+        status = None
+    members = (status or {}).get("members") or []
+    if not members:
+        return [{"name": "store", "url": front, "query": ""}], None
+    targets = [{"name": "router", "url": front, "query": "proc=router"}]
+    for m in members:
+        targets.append({
+            "name": member_name(m.get("shard", 0), m.get("replica", 0)),
+            "url": m["url"],
+            "query": "",
+        })
+    return targets, status
+
+
+def harvest(front_url: Optional[str] = None,
+            daemons: Iterable[Tuple[str, str]] = (),
+            include_local: bool = False, local_name: str = "local",
+            timeout: float = 2.0) -> Dict[str, Any]:
+    """One fleet harvest round: discover the topology, then fetch every
+    proc in parallel.  Returns ``{"procs": {name: snap}, "unreachable":
+    [names], "mesh": status_or_None}``."""
+    targets: List[Dict[str, str]] = []
+    mesh = None
+    if front_url:
+        targets, mesh = discover(front_url, timeout)
+    for name, url in daemons:
+        targets.append({"name": name, "url": url, "query": ""})
+    procs: Dict[str, Any] = {}
+    unreachable: List[str] = []
+    mu = make_lock("vtfleet.harvest")
+
+    def one(t: Dict[str, str]) -> None:
+        try:
+            snap = harvest_proc(t["name"], t["url"], timeout=timeout,
+                                query=t.get("query", ""))
+        except Exception:  # noqa: BLE001 - partial harvest is a report
+            with mu:
+                unreachable.append(t["name"])
+            return
+        with mu:
+            procs[t["name"]] = snap
+
+    threads = [threading.Thread(target=one, args=(t,), daemon=True)
+               for t in targets]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    if include_local:
+        procs[local_name] = local_proc(local_name)
+    metrics.inc("volcano_fleet_harvests_total")
+    if unreachable:
+        metrics.inc("volcano_fleet_harvest_errors_total",
+                    float(len(unreachable)))
+    return {"procs": procs, "unreachable": sorted(unreachable),
+            "mesh": mesh}
+
+
+# -- trace / timeseries / prof merges -----------------------------------------
+
+
+def merge_trace(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """All harvested spans on ONE clock: each span gains ``proc`` and
+    its ``start`` shifts by the proc's estimated offset; the merged list
+    sorts by aligned start, so ``spans_for_trace`` / ``render_tree``
+    reconstruct a cross-process timeline unchanged."""
+    spans: List[Dict[str, Any]] = []
+    procs_meta: Dict[str, Any] = {}
+    armed = False
+    for name in sorted(snap.get("procs") or {}):
+        p = snap["procs"][name]
+        tp = p.get("trace") or {}
+        armed = armed or bool(tp.get("armed"))
+        rows = tp.get("spans") or []
+        procs_meta[name] = {
+            "pid": tp.get("pid"),
+            "armed": bool(tp.get("armed")),
+            "spans": len(rows),
+            "offset_s": round(float(p.get("offset", 0.0)), 6),
+        }
+        for r in rows:
+            rr = dict(r)
+            rr["start"] = float(r.get("start", 0.0)) - p.get("offset", 0.0)
+            rr["proc"] = name
+            spans.append(rr)
+    spans.sort(key=lambda r: (r.get("start", 0.0), r.get("span", "")))
+    return {"armed": armed, "spans": spans, "procs": procs_meta,
+            "unreachable": list(snap.get("unreachable") or [])}
+
+
+def merge_timeseries(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-proc sample rings interleaved on the harvester's clock, each
+    sample tagged with its ``proc``."""
+    samples: List[Dict[str, Any]] = []
+    procs_meta: Dict[str, Any] = {}
+    armed = False
+    for name in sorted(snap.get("procs") or {}):
+        p = snap["procs"][name]
+        tp = p.get("timeseries") or {}
+        armed = armed or bool(tp.get("armed"))
+        rows = tp.get("samples") or []
+        procs_meta[name] = {
+            "pid": tp.get("pid"),
+            "armed": bool(tp.get("armed")),
+            "samples": len(rows),
+            "offset_s": round(float(p.get("offset", 0.0)), 6),
+        }
+        for r in rows:
+            rr = dict(r)
+            rr["ts"] = float(r.get("ts", 0.0)) - p.get("offset", 0.0)
+            rr["proc"] = name
+            samples.append(rr)
+    samples.sort(key=lambda r: (r.get("ts", 0.0), r.get("proc", "")))
+    return {"armed": armed, "samples": samples, "procs": procs_meta,
+            "unreachable": list(snap.get("unreachable") or [])}
+
+
+def merge_prof(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-proc vtprof payloads with provenance — cycle rings are
+    per-process by construction (a scheduler's critical path does not
+    concatenate with a shard's), so the merge keeps them keyed by proc
+    and the fleet report joins them via the drain walls instead."""
+    procs: Dict[str, Any] = {}
+    armed = False
+    for name in sorted(snap.get("procs") or {}):
+        tp = snap["procs"][name].get("prof") or {}
+        armed = armed or bool(tp.get("armed"))
+        procs[name] = tp
+    return {"armed": armed, "procs": procs,
+            "unreachable": list(snap.get("unreachable") or [])}
+
+
+# -- Prometheus exposition: parse / merge -------------------------------------
+
+
+def _parse_labels(s: str) -> Tuple[Tuple[str, str], ...]:
+    """``k="v",k2="v2"`` -> ((k, v), ...).  Escapes inside values are
+    kept verbatim so re-emission is byte-faithful."""
+    out: List[Tuple[str, str]] = []
+    i, n = 0, len(s)
+    while i < n:
+        j = s.index("=", i)
+        k = s[i:j]
+        j += 2  # skip ="
+        buf: List[str] = []
+        while j < n:
+            c = s[j]
+            if c == "\\":
+                buf.append(s[j:j + 2])
+                j += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            j += 1
+        out.append((k, "".join(buf)))
+        j += 1  # closing quote
+        if j < n and s[j] == ",":
+            j += 1
+        i = j
+    return tuple(out)
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+
+
+def _split_sample(line: str) -> Tuple[str, Tuple[Tuple[str, str], ...], str]:
+    """One exposition sample line -> (metric_name, labels, value_str)."""
+    if "{" in line:
+        name, rest = line.split("{", 1)
+        labels_raw, value = rest.rsplit("}", 1)
+        return name, _parse_labels(labels_raw), value.strip()
+    name, value = line.split(None, 1)
+    return name, (), value.strip()
+
+
+def parse_exposition(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse one Prometheus text exposition into families::
+
+        {family: {"type": t, "help": h,
+                  "scalar": [(labels, value_str)],
+                  "hist": {base_labels: {"buckets": [(le_str, cum)],
+                                         "sum": value_str,
+                                         "count": int}}}}
+    """
+    fams: Dict[str, Dict[str, Any]] = {}
+
+    def fam(name: str) -> Dict[str, Any]:
+        return fams.setdefault(name, {
+            "type": "untyped", "help": None, "scalar": [], "hist": {}})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.split(" ", 2)
+            name, _, help_text = rest.partition(" ")
+            fam(name)["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.split(" ", 2)
+            name, _, mtype = rest.partition(" ")
+            fam(name)["type"] = mtype.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        name, labels, value = _split_sample(line)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    fams.get(name[: -len(suffix)], {}).get("type") \
+                    == "histogram":
+                base = name[: -len(suffix)]
+                break
+        f = fam(base)
+        if f["type"] == "histogram" and base != name:
+            key = tuple(kv for kv in labels if kv[0] != "le")
+            h = f["hist"].setdefault(
+                key, {"buckets": [], "sum": "0", "count": 0})
+            if name.endswith("_bucket"):
+                le = dict(labels).get("le", "+Inf")
+                h["buckets"].append((le, int(float(value))))
+            elif name.endswith("_sum"):
+                h["sum"] = value
+            else:
+                h["count"] = int(float(value))
+        else:
+            f["scalar"].append((labels, value))
+    return fams
+
+
+def _le_key(le: str) -> float:
+    return math.inf if le == "+Inf" else float(le)
+
+
+def merge_histogram_series(series: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Bucket-wise merge of K cumulative-bucket exports of the SAME
+    log-linear bucket universe: decode each to per-bucket deltas
+    (adjacent differences), add by ``le``, re-cumulate.  Exact — see
+    the module docstring's closure argument."""
+    deltas: Dict[str, int] = {}
+    total_sum = 0.0
+    total_count = 0
+    for s in series:
+        prev = 0
+        for le, cum in sorted(s.get("buckets") or [],
+                              key=lambda b: _le_key(b[0])):
+            deltas[le] = deltas.get(le, 0) + (cum - prev)
+            prev = cum
+        total_sum += float(s.get("sum", 0.0))
+        total_count += int(s.get("count", 0))
+    buckets: List[Tuple[str, int]] = []
+    cum = 0
+    for le in sorted((k for k in deltas if k != "+Inf"), key=_le_key):
+        cum += deltas[le]
+        buckets.append((le, cum))
+    buckets.append(("+Inf", total_count))
+    return {"buckets": buckets, "sum": metrics._num(total_sum),
+            "count": total_count}
+
+
+def merge_metrics(texts: Dict[str, Optional[str]],
+                  fleet_rollup: bool = True) -> str:
+    """Federate per-proc expositions into one: every series gains a
+    ``proc=`` label; histogram families additionally emit a
+    ``proc="fleet"`` bucket-wise-merged rollup.  Output is byte-stable
+    across harvest orders: families alphabetical, series by full label
+    tuple, and the rollup sums procs in sorted-name order."""
+    parsed = {name: parse_exposition(t)
+              for name, t in sorted(texts.items()) if t}
+    fam_names: List[str] = sorted({f for p in parsed.values() for f in p})
+    lines: List[str] = []
+    for fname in fam_names:
+        mtype, help_text = "untyped", None
+        for proc in sorted(parsed):
+            f = parsed[proc].get(fname)
+            if f is None:
+                continue
+            if mtype == "untyped":
+                mtype = f["type"]
+            if help_text is None:
+                help_text = f["help"]
+        lines.append(f"# HELP {fname} "
+                     f"{help_text or f'volcano-tpu {mtype} {fname}'}")
+        lines.append(f"# TYPE {fname} {mtype}")
+        if mtype == "histogram":
+            rows: List[Tuple[Tuple[Tuple[str, str], ...],
+                             Dict[str, Any]]] = []
+            by_base: Dict[Tuple[Tuple[str, str], ...],
+                          List[Dict[str, Any]]] = {}
+            for proc in sorted(parsed):
+                f = parsed[proc].get(fname)
+                if f is None:
+                    continue
+                for base, h in f["hist"].items():
+                    rows.append((tuple(sorted(
+                        base + (("proc", proc),))), h))
+                    by_base.setdefault(base, []).append(h)
+            if fleet_rollup:
+                for base in by_base:
+                    rows.append((tuple(sorted(
+                        base + (("proc", "fleet"),))),
+                        merge_histogram_series(by_base[base])))
+            for labels, h in sorted(rows, key=lambda r: r[0]):
+                for le, cum in sorted(h["buckets"],
+                                      key=lambda b: _le_key(b[0])):
+                    lines.append(
+                        f"{fname}_bucket"
+                        f"{_fmt_labels(labels + (('le', le),))} {cum}")
+                lines.append(f"{fname}_sum{_fmt_labels(labels)} {h['sum']}")
+                lines.append(
+                    f"{fname}_count{_fmt_labels(labels)} {h['count']}")
+        else:
+            scalars: List[Tuple[Tuple[Tuple[str, str], ...], str]] = []
+            for proc in sorted(parsed):
+                f = parsed[proc].get(fname)
+                if f is None:
+                    continue
+                for labels, value in f["scalar"]:
+                    scalars.append((tuple(sorted(
+                        labels + (("proc", proc),))), value))
+            for labels, value in sorted(scalars, key=lambda r: r[0]):
+                lines.append(f"{fname}{_fmt_labels(labels)} {value}")
+    return "\n".join(lines) + "\n"
+
+
+# -- fleet readouts -----------------------------------------------------------
+
+
+def _scalar_total(fams: Dict[str, Any], name: str) -> float:
+    f = fams.get(name)
+    if not f:
+        return 0.0
+    return sum(float(v) for _, v in f["scalar"])
+
+
+def _hist_sum(fams: Dict[str, Any], name: str) -> float:
+    f = fams.get(name)
+    if not f:
+        return 0.0
+    return sum(float(h.get("sum", 0.0)) for h in f["hist"].values())
+
+
+def shard_rows(snap: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-shard apply/fsync/lag from the harvested member metrics (the
+    metrics registry is unconditional, so these survive every disarmed
+    configuration): one row per shard, leader WAL counters plus the
+    worst follower lag."""
+    per: Dict[int, Dict[str, Any]] = {}
+    mesh = snap.get("mesh") or {}
+    alive: Dict[str, Any] = {}
+    restarts: Dict[str, int] = {}
+    for m in mesh.get("members") or []:
+        nm = member_name(m.get("shard", 0), m.get("replica", 0))
+        alive[nm] = m.get("alive")
+        restarts[nm] = int(m.get("restarts", 0))
+    for name, p in (snap.get("procs") or {}).items():
+        if not name.startswith("shard"):
+            continue
+        stem = name[len("shard"):]
+        shard_s, _, rep_s = stem.partition(".r")
+        try:
+            shard = int(shard_s)
+        except ValueError:
+            continue
+        replica = int(rep_s) if rep_s else 0
+        fams = parse_exposition(p.get("metrics") or "")
+        row = per.setdefault(shard, {
+            "shard": shard, "procs": 0, "apply": 0.0, "fsync": 0.0,
+            "fsync_s": 0.0, "lag_s": 0.0, "restarts": 0, "down": 0})
+        row["procs"] += 1
+        if alive.get(name) is False:
+            row["down"] += 1
+        row["restarts"] += restarts.get(name, 0)
+        if replica == 0:
+            row["apply"] += _scalar_total(
+                fams, "volcano_store_wal_appended_records_total")
+            row["fsync"] += _scalar_total(
+                fams, "volcano_store_wal_fsync_total")
+            row["fsync_s"] += _hist_sum(
+                fams, "volcano_store_wal_fsync_seconds")
+        else:
+            row["lag_s"] = max(
+                row["lag_s"],
+                _scalar_total(fams, "volcano_repl_lag_seconds"))
+    return [per[s] for s in sorted(per)]
+
+
+def top_fleet_text(snap: Dict[str, Any]) -> str:
+    """The ``vtctl top --fleet`` header block: per-shard apply/fsync/lag
+    columns plus the straggler line and harvest degradation notes."""
+    procs = snap.get("procs") or {}
+    mesh = snap.get("mesh") or {}
+    out: List[str] = []
+    head = f"fleet: {len(procs)} proc(s) harvested"
+    if mesh:
+        head += (f", {mesh.get('shards', '?')} shard(s) x "
+                 f"{mesh.get('replicas', '?')} replica(s), "
+                 f"restarts {mesh.get('restarts', 0)}")
+    out.append(head)
+    for name in snap.get("unreachable") or []:
+        out.append(f"  unreachable: {name} (harvest degraded)")
+    rows = shard_rows(snap)
+    if not rows:
+        return "\n".join(out) + "\n"
+    fmt = "%-8s%-8s%-10s%-12s%-9s%-11s%s"
+    out.append(fmt % ("Shard", "Procs", "Restarts", "Apply",
+                      "Fsync", "Fsync(s)", "Lag(s)"))
+    for r in rows:
+        procs_cell = str(r["procs"])
+        if r["down"]:
+            procs_cell += f"(-{r['down']})"
+        out.append(fmt % (
+            f"{r['shard']:02d}", procs_cell, r["restarts"],
+            int(r["apply"]), int(r["fsync"]),
+            f"{r['fsync_s']:.3f}", f"{r['lag_s']:.3f}"))
+    busy = max(rows, key=lambda r: (r["fsync_s"], r["apply"]))
+    if busy["fsync_s"] > 0 or busy["apply"] > 0:
+        out.append(
+            f"straggler: shard{busy['shard']:02d} "
+            f"(fsync {busy['fsync_s']:.3f}s, "
+            f"{int(busy['apply'])} applied records)")
+    return "\n".join(out) + "\n"
+
+
+def critical_path_text(snap: Dict[str, Any]) -> str:
+    """The fleet half of ``vtctl profile --fleet``: join the applier's
+    client-side per-shard drain walls (``procNN_s``, shipped in the
+    vtprof payload) with each shard's server-side fsync seconds — which
+    shard bounds the drain, and how much of its wall is the ACK barrier
+    vs apply+wire."""
+    drain: Dict[str, Any] = {}
+    drain_proc = ""
+    for name in sorted(snap.get("procs") or {}):
+        d = (snap["procs"][name].get("prof") or {}).get("drain") or {}
+        if any(k.startswith("proc") and k.endswith("_s") for k in d):
+            drain, drain_proc = d, name
+            break
+    walls = {int(k[len("proc"):-len("_s")]): float(v)
+             for k, v in drain.items()
+             if k.startswith("proc") and k.endswith("_s")
+             and k[len("proc"):-len("_s")].isdigit()}
+    if not walls:
+        return ("no cross-process drain attribution (procNN_s walls "
+                "need an armed profiler on a procmesh applier)\n")
+    fsync_by_shard = {r["shard"]: r["fsync_s"] for r in shard_rows(snap)}
+    out = [f"fleet critical path (drain walls from {drain_proc}):"]
+    for shard in sorted(walls):
+        wall = walls[shard]
+        fsync_s = min(fsync_by_shard.get(shard, 0.0), wall)
+        rest = max(wall - fsync_s, 0.0)
+        share = (fsync_s / wall * 100.0) if wall > 0 else 0.0
+        out.append(
+            f"  shard{shard:02d}  wall {wall:.4f}s  "
+            f"fsync {fsync_s:.4f}s ({share:.0f}%)  "
+            f"apply+wire {rest:.4f}s")
+    bound = max(walls, key=lambda s: walls[s])
+    fsync_s = min(fsync_by_shard.get(bound, 0.0), walls[bound])
+    seg = "fsync" if fsync_s > walls[bound] - fsync_s else "apply+wire"
+    out.append(f"  bound by shard{bound:02d} ({walls[bound]:.4f}s), "
+               f"largest segment inside: {seg}")
+    if "wire_s" in drain:
+        out.append(f"  wire_s {float(drain['wire_s']):.4f}s")
+    return "\n".join(out) + "\n"
+
+
+# -- crash forensics ----------------------------------------------------------
+
+
+class FleetCollector:
+    """The armed fleet-observability singleton.  Caches each member's
+    last-harvested snapshot (the supervisor's monitor loop refreshes it
+    every tick) so an incident bundle can be written for a process that
+    is ALREADY dead — the "final ring" is the last snapshot harvested
+    before death."""
+
+    def __init__(self, incident_dir: str = "", timeout: float = 0.5):
+        self.incident_dir = incident_dir or "."
+        self.timeout = timeout
+        self._mu = make_lock("FleetCollector._mu")
+        self._last: Dict[str, Dict[str, Any]] = {}
+        self._incidents = 0
+
+    def harvest_member(self, name: str, url: str) -> None:
+        """Refresh one member's cached snapshot; a dead or slow member
+        keeps its previous snapshot (that is the whole point)."""
+        try:
+            snap = harvest_proc(name, url, timeout=self.timeout)
+        except Exception:  # noqa: BLE001 - keep the last good snapshot
+            return
+        with self._mu:
+            self._last[name] = snap
+
+    def last(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._mu:
+            return self._last.get(name)
+
+    def incident(self, name: str, meta: Dict[str, Any]) -> Optional[str]:
+        """Write the per-incident bundle directory for a dead member
+        from its last harvested snapshot.  Atomic (staged ``.tmp`` dir +
+        rename) and non-raising: forensics must not mask the failure or
+        stall the respawn."""
+        with self._mu:
+            snap = self._last.get(name) or {}
+            self._incidents += 1
+            n = self._incidents
+        try:
+            os.makedirs(self.incident_dir, exist_ok=True)
+            final = os.path.join(
+                self.incident_dir,
+                f"incident-{name}-{meta.get('pid') or 0}-{n:04d}")
+            tmp = f"{final}.{os.getpid()}.tmp"
+            os.makedirs(tmp, exist_ok=True)
+            files = {
+                "meta.json": dict(meta, proc=name),
+                "trace.json": snap.get("trace"),
+                "prof.json": snap.get("prof"),
+                "timeseries.json": snap.get("timeseries"),
+                "digest.json": snap.get("digest"),
+            }
+            for fname, payload in files.items():
+                with open(os.path.join(tmp, fname), "w",
+                          encoding="utf-8") as f:
+                    json.dump(payload, f)
+            os.rename(tmp, final)
+            return final
+        except OSError:
+            return None
+
+
+def _collector_from_env(raw: str) -> Optional[FleetCollector]:
+    raw = (raw or "").strip()
+    if not raw or raw in ("0", "off", "none"):
+        return None
+    if raw.startswith("{"):
+        try:
+            cfg = json.loads(raw)
+        except ValueError:
+            cfg = {}
+        return FleetCollector(incident_dir=str(cfg.get("dir", "")),
+                              timeout=float(cfg.get("timeout", 0.5)))
+    return FleetCollector()
+
+
+#: the process collector; None = disarmed, and every integration site
+#: (supervisor monitor loop, MetricsServer) is a single
+#: ``vtfleet.COLLECTOR is None`` attribute check — disarmed runs
+#: construct zero collector objects
+COLLECTOR: Optional[FleetCollector] = _collector_from_env(
+    os.environ.get(ENV_VAR, ""))
+
+
+def arm(collector: Optional[FleetCollector] = None,
+        incident_dir: str = "") -> FleetCollector:
+    """Arm fleet observability in-process (tests, embedders)."""
+    global COLLECTOR
+    COLLECTOR = collector or FleetCollector(incident_dir=incident_dir)
+    return COLLECTOR
+
+
+def disarm() -> None:
+    global COLLECTOR
+    COLLECTOR = None

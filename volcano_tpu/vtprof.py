@@ -157,6 +157,10 @@ class Profiler:
         #: current-cycle accumulator; None outside a cycle (prewarm
         #: threads still record — into totals only)
         self._cur: Optional[Dict[str, Any]] = None
+        #: latest async-applier drain attribution (apply.py settles it
+        #: after every segment ship) — the ``procNN_s`` walls the fleet
+        #: critical-path report joins with shard-side fsync sections
+        self.drain: Dict[str, float] = {}
 
     # -- dispatch / fetch instrumentation (called from the hot sites) ---------
 
@@ -422,18 +426,29 @@ class Profiler:
         with self._mu:
             return list(self.anomalies)
 
+    def note_drain(self, stats: Dict[str, float]) -> None:
+        """Snapshot the applier's cumulative drain attribution (the
+        ``procNN_s``/``shardNN_s``/``wire_s`` walls) into the payload so
+        ``vtctl profile --fleet`` can join client walls with shard-side
+        apply/fsync sections across the process seam."""
+        snap = dict(stats)
+        with self._mu:
+            self.drain = snap
+
     def payload(self) -> Dict[str, Any]:
         """The ``/debug/prof`` response body / report input."""
         with self._mu:
             return {
                 "armed": True,
                 "pid": os.getpid(),
+                "now": time.time(),
                 "ring": self.ring_size,
                 "steady": self.steady,
                 "compiles_total": self.compiles_total,
                 "cycles": list(self.cycles),
                 "totals": {k: dict(v) for k, v in self.totals.items()},
                 "anomalies": list(self.anomalies),
+                "drain": dict(self.drain),
             }
 
     def summary(self) -> Dict[str, Any]:
@@ -623,6 +638,6 @@ def debug_payload() -> Dict[str, Any]:
     """The ``/debug/prof`` response body (store + metrics servers)."""
     prof = PROFILER
     if prof is None:
-        return {"armed": False, "pid": os.getpid(), "cycles": [],
-                "totals": {}, "anomalies": []}
+        return {"armed": False, "pid": os.getpid(), "now": time.time(),
+                "cycles": [], "totals": {}, "anomalies": [], "drain": {}}
     return prof.payload()
