@@ -4,7 +4,7 @@
 PY ?= python
 DOCKER ?= docker
 
-.PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta bench-repl bench-procs fleet loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
+.PHONY: test e2e parity bench bench-residue bench-wire bench-shard bench-delta bench-repl bench-procs bench-multihost fleet loadtest native examples install clean images image image-tpu lint sanitize chaos crash-soak elastic trace profile perfgate audit
 
 # vtlint: the project-native static analyzer (see ANALYSIS.md); `test`
 # runs it as a preamble so tier-1 runs can't pass with lint findings.
@@ -185,6 +185,24 @@ bench-repl:
 bench-procs:
 	$(PY) -m pytest tests/test_procmesh.py -q -p no:cacheprovider
 	$(PY) bench.py --config 14
+
+# vtmesh (parallel/multihost.py + tests/test_multihost.py): the
+# multi-controller mesh solve — one process per host over one logical
+# device mesh, per-host snapshot shards in, owned output slices out.
+# The tier-1 suite proves --mesh-hosts 1 bit-for-bit parity with the
+# sharded path, the 2-host lockstep merge, the 2-process coordinator
+# cycle (clean shutdown) and the coordinator-death fallback; the
+# sub-second sweep here shows the per-host critical path at CI scale,
+# then cfg9e (`--check --configs 16`) gates ≤0.7x per host doubling +
+# ≥0.95 vtprof attribution at bench scale and cfg9f (`--configs 17`)
+# runs the env-scaled 10M x 1M stretch shape
+# (VOLCANO_TPU_CFG9E_SCALE / VOLCANO_TPU_CFG9F_SCALE shrink further).
+bench-multihost:
+	$(PY) -m pytest tests/test_multihost.py -q -p no:cacheprovider
+	env JAX_PLATFORMS=cpu \
+	  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PY) -m volcano_tpu.parallel.multihost --sweep 1,2,4 \
+	  --nodes 512 --tasks 2048 --jobs 128 --reps 3 --prof
 
 # vtfleet (volcano_tpu/vtfleet.py + tests/test_vtfleet.py): the
 # cross-process observability plane — fleet trace reassembly (per-proc

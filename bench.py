@@ -1276,6 +1276,39 @@ def config9_procs(scale=None):
             "prof_attribution": head["coverage"],
             "store_load_s": round(head["load_s"], 1),
             "path": "fastpath" if head["fastpath"] else "object",
+            "publish_build_s": round(
+                head["phases"].get("publish_build", 0.0), 4),
+            "publish_split_s": round(
+                head["drain_kinds"].get("split_s", 0.0), 4),
+            "publish_ship_s": round(
+                head["phases"].get("publish_ship", 0.0), 4),
+            "device": str(jax.devices()[0]),
+        },
+    })
+
+    # cfg9c_publish: the publish wall from the same head run with its
+    # internal attribution.  BENCH_r12 showed publish at 6.575 s against
+    # a 0.15 s drain critical path — the drain stopped being the story;
+    # build (decision->segment), split (segment->per-shard sub-segments)
+    # and ship (wire fan-out) say where the publish wall actually goes.
+    _print_json({
+        "metric": "cfg9c_publish",
+        "value": round(head["publish"], 4),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "n_tasks": n_tasks, "n_nodes": n_nodes, "scale": scale,
+            "shard_procs": sweep[-1],
+            "phases_s": {
+                "publish_build": round(
+                    head["phases"].get("publish_build", 0.0), 4),
+                "publish_split": round(
+                    head["drain_kinds"].get("split_s", 0.0), 4),
+                "publish_ship": round(
+                    head["phases"].get("publish_ship", 0.0), 4),
+            },
+            "drain_critical_path_s": round(walls[sweep[-1]], 4),
+            "pods_bound": head["bound"],
             "device": str(jax.devices()[0]),
         },
     })
@@ -1349,6 +1382,115 @@ def config9_fleet(scale=None):
             "armed_s": round(armed_w, 4),
             "pods_bound": armed["bound"],
             "device": str(jax.devices()[0]),
+        },
+    })
+
+
+def _multihost_sweep(hosts, n_nodes, n_tasks, n_jobs, reps, timeout=570):
+    """Run the multi-controller lockstep host sweep in a FRESH
+    subprocess and parse its one-line JSON payload.  A subprocess, not
+    in-process: the bench process's jax is already initialized by the
+    earlier configs without the forced 8-device CPU topology the host
+    mesh needs (`--xla_force_host_platform_device_count`), and jax
+    device topology cannot change after init."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, "-m", "volcano_tpu.parallel.multihost",
+           "--sweep", ",".join(str(h) for h in hosts),
+           "--nodes", str(n_nodes), "--tasks", str(n_tasks),
+           "--jobs", str(n_jobs), "--reps", str(reps), "--prof"]
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=timeout, check=False)
+    lines = [ln for ln in out.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"multihost sweep rc={out.returncode}: "
+            f"{(out.stderr or out.stdout)[-400:]}")
+    return json.loads(lines[-1])
+
+
+def config9_multihost(scale=None):
+    """cfg9e: the multi-controller mesh solve — the lockstep host sweep
+    at 1 -> 2 -> 4 simulated hosts over one 8-device CPU mesh.  Each
+    host builds ONLY its snapshot shard, dispatches only its mesh row,
+    and fetches ONLY its owned output slice; the headline is the
+    per-host critical path (build+dispatch+fetch) at the top host
+    count, the claim is the per-doubling scaling of that path
+    (`--check`: ≤0.7x per doubling, vtprof attribution ≥0.95, and
+    bitwise cross-host-count output parity).  VOLCANO_TPU_CFG9E_SCALE
+    shrinks for CPU containers/CI."""
+    if scale is None:
+        scale = float(os.environ.get("VOLCANO_TPU_CFG9E_SCALE", "1.0"))
+    n_nodes = max(int(4096 * scale) // 8 * 8, 64)
+    # tasks stay a multiple of the job count (sim gangs divide evenly)
+    # — 256 jobs, and 256 is a multiple of 8 so the host/device blocking
+    # stays even too
+    n_tasks = max(int(65536 * scale) // 256 * 256, 1024)
+    hosts = [1, 2, 4]
+    run = _multihost_sweep(hosts, n_nodes, n_tasks, n_jobs=256, reps=5)
+    top = str(hosts[-1])
+    _print_json({
+        "metric": "cfg9e_multihost_solve",
+        "value": round(run["sweep"][top]["critical_path_s"], 6),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "n_tasks": n_tasks, "n_nodes": n_nodes, "scale": scale,
+            "hosts": hosts[-1],
+            "critical_path_s": {
+                h: run["sweep"][str(h)]["critical_path_s"] for h in hosts
+            },
+            "scaling_per_doubling": run["scaling_per_doubling"],
+            "parity": run["parity"],
+            "prof_attribution": run["prof_attribution"],
+            "per_host": run["sweep"][top]["per_host"],
+            "solve_wait_s": run["sweep"][top]["solve_wait_s"],
+            "binds": run["binds"],
+            "n_devices": run["n_devices"],
+            "device": run["device"],
+        },
+    })
+
+
+def config9_stretch(scale=None):
+    """cfg9f: the 10M-task x 1M-node stretch shape through the same
+    multi-controller sweep, env-scaled (VOLCANO_TPU_CFG9F_SCALE,
+    default 0.01 -> 100k x 10k on CPU containers; 1.0 is the full
+    deployment shape on a real pod).  Hosts 1 -> 2 only — the stretch
+    claim is that the owned-slice path keeps scaling when the planes
+    stop fitting comfortably per host, not a 4-way ladder."""
+    if scale is None:
+        scale = float(os.environ.get("VOLCANO_TPU_CFG9F_SCALE", "0.01"))
+    n_nodes = max(int(1_000_000 * scale) // 8 * 8, 64)
+    n_tasks = max(int(10_000_000 * scale) // 512 * 512, 1024)
+    hosts = [1, 2]
+    run = _multihost_sweep(hosts, n_nodes, n_tasks, n_jobs=512, reps=2)
+    top = str(hosts[-1])
+    _print_json({
+        "metric": "cfg9f_stretch_10m_x_1m",
+        "value": round(run["sweep"][top]["critical_path_s"], 6),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "n_tasks": n_tasks, "n_nodes": n_nodes, "scale": scale,
+            "hosts": hosts[-1],
+            "critical_path_s": {
+                h: run["sweep"][str(h)]["critical_path_s"] for h in hosts
+            },
+            "scaling_per_doubling": run["scaling_per_doubling"],
+            "parity": run["parity"],
+            "prof_attribution": run["prof_attribution"],
+            "binds": run["binds"],
+            "n_devices": run["n_devices"],
+            "device": run["device"],
         },
     })
 
@@ -1697,7 +1839,8 @@ def config11_repl(scale=None, readers=None, n_events=None, window_s=None,
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config5_dynamic, 9: config5_volumes,
            10: config8_open_loop, 11: config9_shard, 12: config10_delta,
-           13: config11_repl, 14: config9_procs, 15: config9_fleet}
+           13: config11_repl, 14: config9_procs, 15: config9_fleet,
+           16: config9_multihost, 17: config9_stretch}
 
 
 # -- bench trajectory + continuous perf-regression gate (vtprof PR) -----------
@@ -1721,6 +1864,9 @@ GATED_METRICS = (
     "cfg10_delta_steady_state_micro_cycle",
     "cfg11_repl_fanout_watch_reads",
     "cfg9c_procmesh_drain",
+    "cfg9c_publish",
+    "cfg9e_multihost_solve",
+    "cfg9f_stretch_10m_x_1m",
 )
 #: band slack over the best same-device trajectory reading: headline
 #: values breathe ±15% run-to-run on the tunnel (BASELINE.md), phases
@@ -1730,6 +1876,25 @@ PHASE_SLACK = 2.5
 PHASE_FLOOR_S = 0.05
 
 
+def _synthesize_payloads(payload):
+    """Yield ``payload`` plus any first-class metrics older captures
+    only carried inside ``extra``: r12-era cfg9c lines report the
+    publish wall as ``extra.publish_s`` — surfacing it as a
+    ``cfg9c_publish`` payload lets the publish-attribution band derive
+    from history.  A real cfg9c_publish line in the same round (printed
+    after the drain line) overrides the synthetic one on merge."""
+    yield payload
+    extra = payload.get("extra") or {}
+    if payload.get("metric") == "cfg9c_procmesh_drain" \
+            and extra.get("publish_s") is not None:
+        yield {
+            "metric": "cfg9c_publish",
+            "value": extra["publish_s"],
+            "unit": "s",
+            "extra": {"device": extra.get("device")},
+        }
+
+
 def _payloads_from_doc(doc):
     """Every metric payload a BENCH_r0*.json driver capture carries:
     the bare payload form (r08), the ``parsed*`` fields, and every JSON
@@ -1737,12 +1902,12 @@ def _payloads_from_doc(doc):
     if not isinstance(doc, dict):
         return
     if "metric" in doc and "value" in doc:
-        yield doc
+        yield from _synthesize_payloads(doc)
         return
     for key in sorted(doc):
         if key.startswith("parsed") and isinstance(doc[key], dict) \
                 and "metric" in doc[key]:
-            yield doc[key]
+            yield from _synthesize_payloads(doc[key])
     for line in str(doc.get("tail", "")).splitlines():
         line = line.strip()
         if not line.startswith("{"):
@@ -1753,7 +1918,7 @@ def _payloads_from_doc(doc):
             continue
         if isinstance(payload, dict) and "metric" in payload \
                 and "value" in payload:
-            yield payload
+            yield from _synthesize_payloads(payload)
 
 
 def load_bench_rounds(directory="."):
@@ -1964,6 +2129,28 @@ def check_results(results, bands):
                 breaches.append(
                     f"ratio {ratio:.3f} > band {band['max_ratio']:.3f} "
                     f"(delta {delta:.3f}s)")
+        if band.get("max_scaling_per_doubling") is not None:
+            scaling = extra.get("scaling_per_doubling")
+            if not scaling:
+                ok = False
+                lines.append(
+                    f"FAIL {metric}: no scaling_per_doubling in capture")
+                continue
+            # noise floor: per-doubling ratios over a sub-millisecond
+            # critical path are scheduler jitter, not a scaling claim
+            if p["value"] > band.get("min_base_s", 0.0):
+                for leg, ratio in sorted(scaling.items()):
+                    if ratio > band["max_scaling_per_doubling"]:
+                        breaches.append(
+                            f"scaling {leg} {ratio:.3f} > band "
+                            f"{band['max_scaling_per_doubling']:.3f}")
+            if extra.get("parity") is False:
+                breaches.append("cross-host output parity violated")
+        if band.get("min_coverage") is not None:
+            cov = extra.get("prof_attribution")
+            if cov is None or cov < band["min_coverage"]:
+                breaches.append(
+                    f"attribution {cov} < floor {band['min_coverage']}")
         if breaches:
             ok = False
             lines.append(f"FAIL {metric}: " + "; ".join(breaches))
@@ -1974,6 +2161,16 @@ def check_results(results, bands):
                 cap_txt = f"{cap:.4f}" if cap is not None else "—"
                 lines.append(
                     f"  phase {phase:<12} {got:.4f}s / band {cap_txt}s{mark}")
+        elif band.get("max_s") is None \
+                and band.get("max_scaling_per_doubling") is not None:
+            legs = ", ".join(
+                f"{leg} {r:.3f}"
+                for leg, r in sorted(
+                    (extra.get("scaling_per_doubling") or {}).items()))
+            lines.append(
+                f"ok   {metric}: scaling [{legs}] <= "
+                f"{band['max_scaling_per_doubling']:.3f}/doubling, "
+                f"attribution {extra.get('prof_attribution')}")
         elif band.get("max_s") is None and band.get("max_ratio") is not None:
             if extra["ratio"] > band["max_ratio"]:
                 lines.append(
@@ -2071,6 +2268,8 @@ CONFIG_METRIC = {
     13: "cfg11_repl_fanout_watch_reads",
     14: "cfg9c_procmesh_drain",
     15: "cfg9d_fleet_armed_vs_disarmed_drain",
+    16: "cfg9e_multihost_solve",
+    17: "cfg9f_stretch_10m_x_1m",
 }
 
 
@@ -2106,8 +2305,28 @@ def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
         if 15 in configs:
             bands.setdefault("cfg9d_fleet_armed_vs_disarmed_drain",
                              {"max_ratio": 1.05, "min_delta_s": 0.25})
+        # cfg9e/cfg9f gate on per-doubling SCALING of the per-host
+        # critical path plus the attribution floor — both ratios, both
+        # device-invariant, so the bands are absolute like cfg9d's (set
+        # BEFORE the wanted filter: they ARE these configs' headline
+        # metrics).  min_base_s keeps sub-ms paths from gating on
+        # scheduler jitter.
+        if 16 in configs:
+            bands.setdefault("cfg9e_multihost_solve",
+                             {"max_scaling_per_doubling": 0.7,
+                              "min_coverage": 0.95, "min_base_s": 0.002})
+        if 17 in configs:
+            bands.setdefault("cfg9f_stretch_10m_x_1m",
+                             {"max_scaling_per_doubling": 0.9,
+                              "min_coverage": 0.95, "min_base_s": 0.002})
+        # cfg9c captures the publish-attribution line alongside its
+        # drain headline — keep its trajectory band through the
+        # one-metric-per-config filter below
+        publish_band = bands.get("cfg9c_publish")
         wanted = {CONFIG_METRIC.get(n) for n in configs}
         bands = {m: b for m, b in bands.items() if m in wanted}
+        if 14 in configs and publish_band is not None:
+            bands["cfg9c_publish"] = publish_band
         skipped = [n for n in configs if CONFIG_METRIC.get(n) not in bands]
         if skipped:
             print(f"perfgate: skipping config(s) {skipped} — no band "
@@ -2140,6 +2359,8 @@ def cmd_check(configs=(5,), bands_path=None, smoke=False, directory="."):
             13: config11_repl,
             14: config9_procs,
             15: config9_fleet,
+            16: config9_multihost,
+            17: config9_stretch,
         }
     for n in configs:
         fn = runners.get(n)
@@ -2222,7 +2443,10 @@ def main():
                          "mesh+partitioned-store, scaled by "
                          "VOLCANO_TPU_CFG9_SCALE; 15 = cfg9d vtfleet "
                          "armed-vs-disarmed drain overhead, absolute "
-                         "1.05x ratio band)")
+                         "1.05x ratio band; 16 = cfg9e multi-controller "
+                         "mesh solve, absolute 0.7x-per-host-doubling + "
+                         "0.95 attribution band; 17 = cfg9f 10Mx1M "
+                         "stretch shape, VOLCANO_TPU_CFG9F_SCALE)")
     ap.add_argument("--bands", default="",
                     help="--check: explicit band JSON file instead of "
                          "the trajectory-derived defaults")

@@ -1,0 +1,165 @@
+"""Multi-controller process-mode smoke: 2 local mesh hosts, one clean
+cycle, clean shutdown — and the coordinator-death fallback contract.
+
+The in-process parity legs (``--mesh-hosts 1`` bit-for-bit vs the
+sharded path, 2-host lockstep merge) live in tests/test_parallel.py;
+this file drives the actual OS-process seam the deployment uses: a
+coordinator spawning one worker process per extra host, the rendezvous
+dir, and the degrade-don't-wedge rules.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+TASKS, NODES, JOBS = 256, 64, 16
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return env
+
+
+def _run(extra, timeout=300):
+    cmd = [sys.executable, "-m", "volcano_tpu.parallel.multihost",
+           "--nodes", str(NODES), "--tasks", str(TASKS),
+           "--jobs", str(JOBS), "--seed", "3"] + extra
+    return subprocess.run(cmd, env=_env(), capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _payload(proc):
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, (proc.returncode, proc.stdout, proc.stderr[-800:])
+    return json.loads(lines[-1])
+
+
+def test_two_host_coordinator_runs_one_clean_cycle(tmp_path):
+    """`--mesh-hosts 2`: the coordinator spawns one worker process,
+    both run the lockstep cycle, the worker ships its owned slices
+    through the rendezvous dir, the coordinator verifies them against
+    its merged outputs, and everything exits 0 — one clean cycle, clean
+    shutdown, nothing degraded."""
+    proc = _run(["--mesh-hosts", "2", "--outdir", str(tmp_path)])
+    assert proc.returncode == 0, proc.stderr[-800:]
+    summary = _payload(proc)
+    assert summary["ok"] is True
+    assert summary["hosts"] == 2
+    assert summary["degraded"] is False, summary
+    assert [w["ok"] for w in summary["workers"]] == [True]
+    assert summary["workers"][0]["rc"] == 0
+    assert summary["binds"] > 0
+    assert len(summary["per_host"]) == 2
+    # the worker's shipped slice really is the owned half, not a stub
+    shipped = np.load(tmp_path / "host01.npz")
+    assert shipped["task_node"].shape[0] == TASKS // 2
+    assert shipped["idle"].shape[0] == NODES // 2
+
+
+def test_worker_degrades_to_full_cycle_when_coordinator_dies(tmp_path):
+    """A worker whose coordinator is dead must not wedge waiting on the
+    rendezvous: it degrades to a FULL single-host cycle, ships full
+    planes, flags ``fallback``, and exits cleanly."""
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait(timeout=30)
+    proc = _run(["--mesh-hosts", "2", "--host-id", "1",
+                 "--outdir", str(tmp_path),
+                 "--coordinator-pid", str(dead.pid)])
+    assert proc.returncode == 0, proc.stderr[-800:]
+    payload = _payload(proc)
+    assert payload["fallback"] is True
+    shipped = np.load(tmp_path / "host01.npz")
+    # full planes, not the host-1 slice: the degraded cycle can carry
+    # the whole cluster on its own
+    assert shipped["task_node"].shape[0] == TASKS
+    assert shipped["idle"].shape[0] == NODES
+    assert (shipped["task_kind"] == 1).sum() > 0
+
+
+def test_mesh_hosts_conf_validation():
+    """meshHosts/meshHostId parse and validate at load; the storm-action
+    and backend guards trip at Scheduler construction."""
+    import jax
+    from volcano_tpu.scheduler.conf import load_conf
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    conf = load_conf("backend: tpu\nmeshHosts: 2\nmeshHostId: 1\n")
+    assert conf.mesh_hosts == 2 and conf.mesh_host_id == 1
+    with pytest.raises(ValueError):
+        load_conf("meshHosts: 0\n")
+    with pytest.raises(ValueError):
+        load_conf("meshHosts: 2\nmeshHostId: 2\n")
+
+    from volcano_tpu.scheduler.scheduler import Scheduler
+    from helpers import build_node, make_store
+
+    store = make_store(nodes=[build_node("n0")])
+    with pytest.raises(ValueError, match="backend"):
+        Scheduler(store, conf=load_conf(
+            "backend: native\nmeshHosts: 2\n"))
+    with pytest.raises(ValueError, match="preempt"):
+        Scheduler(store, conf=load_conf(
+            "backend: tpu\nmeshHosts: 2\n"
+            "actions: allocate,preempt\n"))
+
+
+def test_deployed_coordinator_worker_publish_split():
+    """The deployed seam: a coordinator-conf'd scheduler and a
+    worker-conf'd scheduler (each over its own copy of the same store)
+    publish DISJOINT bind sets whose union equals the single-host run —
+    each host binds only its owned express block, nothing is double-
+    published at the host seam."""
+    import jax
+    from volcano_tpu.scheduler.conf import load_conf
+    from volcano_tpu.scheduler.scheduler import Scheduler
+    from helpers import build_node, build_pod, build_podgroup, make_store
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+    def run(mesh_lines):
+        conf = load_conf(
+            "backend: tpu\nsolveMode: batch\nexactTopK: true\n"
+            + mesh_lines
+        )
+        store = make_store(
+            nodes=[build_node(f"n{i}", cpu="4") for i in range(16)],
+            podgroups=[build_podgroup(f"pg{j}", min_member=2)
+                       for j in range(4)],
+            pods=[build_pod(f"p{j}-{i}", group=f"pg{j}", cpu="1")
+                  for j in range(4) for i in range(2)],
+        )
+        sched = Scheduler(store, conf=conf)
+        sched.run_once()
+        return dict(sched.cache.bind_log)
+
+    single = run("")
+    coord = run("meshHosts: 2\nmeshHostId: 0\n")
+    worker = run("meshHosts: 2\nmeshHostId: 1\n")
+    assert set(coord) | set(worker) == set(single)
+    assert not set(coord) & set(worker)
+    for name in coord:
+        assert coord[name] == single[name], name
+    for name in worker:
+        assert worker[name] == single[name], name
+    assert coord and worker
+
+
+def test_degenerate_single_host_cli(tmp_path):
+    """`--mesh-hosts 1` is one full in-process cycle — the deployed
+    single-host shape, no subprocesses, no rendezvous."""
+    proc = _run(["--mesh-hosts", "1"])
+    assert proc.returncode == 0, proc.stderr[-800:]
+    payload = _payload(proc)
+    assert payload["ok"] is True
+    assert payload["hosts"] == 1
+    assert payload["binds"] > 0
+    assert not list(tmp_path.iterdir())

@@ -272,3 +272,75 @@ def test_shard_smoke_two_device_mesh_placement_parity():
     _, binds1 = run("mesh: off\n")
     assert binds2 == binds1
     assert len(binds2) == 6
+
+
+# --- multi-controller mesh (PR 20: parallel/multihost) -----------------------
+
+_NAMES = [
+    "task_node", "task_kind", "task_seq", "ready", "job_alloc",
+    "queue_alloc", "idle", "releasing", "used", "dropped", "rounds",
+]
+
+
+def test_multihost_degenerate_single_host_bitwise_parity():
+    """``--mesh-hosts 1`` is the deployed mesh path, not a sibling: the
+    degenerate single-host lockstep cycle reproduces the existing
+    sharded-cycle outputs BIT-FOR-BIT — placements and the chained
+    node state (idle/releasing/used fed back into a second cycle)."""
+    from volcano_tpu.parallel import make_sharded_cycle, run_lockstep
+
+    args = build_sim_args(n_nodes=512, n_tasks=2048, n_jobs=128,
+                          n_queues=2, seed=11)
+    mesh = make_mesh(8)
+
+    def sharded(a):
+        fn, dev_args = make_sharded_cycle(
+            args=a, mesh=mesh, m_chunk=32, p_chunk=8, exact_topk=True
+        )
+        return _outputs(fn(dev_args))
+
+    ref = sharded(args)
+    got = run_lockstep(args, 1, m_chunk=32, p_chunk=8,
+                       exact_topk=True)["outputs"]
+    for name, r, g in zip(_NAMES, ref, got):
+        np.testing.assert_array_equal(np.asarray(g), r,
+                                      err_msg=f"{name}@1host")
+
+    # chained state: the next cycle must agree bit-for-bit too — a
+    # placement-only parity would hide a drifting node plane
+    chained = dict(args)
+    for name in ("idle", "releasing", "used"):
+        chained[name] = np.asarray(got[_NAMES.index(name)])
+    ref2 = sharded(chained)
+    got2 = run_lockstep(chained, 1, m_chunk=32, p_chunk=8,
+                        exact_topk=True)["outputs"]
+    for name, r, g in zip(_NAMES, ref2, got2):
+        np.testing.assert_array_equal(np.asarray(g), r,
+                                      err_msg=f"{name}@1host-chained")
+
+
+def test_multihost_two_host_lockstep_merges_to_single_host():
+    """Two simulated hosts in lockstep over the same logical mesh:
+    every host fetches only its owned output slice, and the MERGED
+    slices equal the single-host run bit-for-bit — same binds, same
+    node planes, nothing double- or un-fetched at the host seam."""
+    from volcano_tpu.parallel import host_bounds, run_lockstep
+
+    args = build_sim_args(n_nodes=512, n_tasks=2048, n_jobs=128,
+                          n_queues=2, seed=11)
+    one = run_lockstep(args, 1, m_chunk=32, p_chunk=8,
+                       exact_topk=True)["outputs"]
+    two = run_lockstep(args, 2, m_chunk=32, p_chunk=8,
+                       exact_topk=True)["outputs"]
+    for name, r, g in zip(_NAMES, one, two):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r),
+                                      err_msg=f"{name}@2host")
+    # the bind set specifically (the decision the cluster acts on)
+    kind1, kind2 = np.asarray(one[1]), np.asarray(two[1])
+    node1, node2 = np.asarray(one[0]), np.asarray(two[0])
+    np.testing.assert_array_equal(kind2 == 1, kind1 == 1)
+    np.testing.assert_array_equal(node2[kind2 == 1], node1[kind1 == 1])
+    assert (kind1 == 1).sum() > 0
+    # and the ownership split is a real partition of the task axis
+    bounds = host_bounds(kind1.shape[0], 2)
+    assert bounds[0][1] == bounds[1][0] and bounds[1][1] == kind1.shape[0]

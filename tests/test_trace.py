@@ -159,7 +159,7 @@ def test_armed_run_is_placement_neutral_and_phase_set_unchanged():
 
     known_phases = {"drain", "snapshot", "enqueue", "reclaim", "solve",
                     "backfill", "dyn_solve", "preempt", "publish",
-                    "subcycle"}
+                    "publish_build", "publish_ship", "subcycle"}
 
     def run(arm):
         if arm:

@@ -1473,6 +1473,57 @@ def test_shard_spec_complete_real_module_is_total():
     assert not missing, f"undeclared cycle args: {sorted(missing)}"
 
 
+def test_shard_spec_complete_fires_in_multihost_module(tmp_path):
+    # PR 20: the multi-controller module carries the same contract —
+    # a cycle arg with no host-axis spec and no replicated declaration
+    # fires exactly like in sharded.py
+    findings = _lint(tmp_path, "parallel/multihost.py", """
+        _SPECS = {"idle": None}
+        _REPLICATED = frozenset({"eps"})
+
+        def _cycle(args, w):
+            return args["idle"] + args["eps"] + args["task_extra"]
+    """, select=["shard-spec-complete"])
+    assert _rules_of(findings) == ["shard-spec-complete"]
+    assert "task_extra" in findings[0].message
+
+
+def test_shard_spec_complete_multihost_near_miss_stays_quiet(tmp_path):
+    # fully declared multihost cycle: quiet
+    assert _lint(tmp_path, "parallel/multihost.py", """
+        _SPECS = {"idle": ("hosts", None), "task_req": ("hosts",)}
+        _REPLICATED = frozenset({"eps"})
+
+        def _cycle(args, w):
+            return args["idle"] + args["task_req"] + args["eps"]
+    """, select=["shard-spec-complete"]) == []
+    # a multihost-NAMED module elsewhere in the tree is still scoped by
+    # basename — but args reads outside a cycle fn stay out of scope
+    assert _lint(tmp_path, "parallel/multihost.py", """
+        _SPECS = {"idle": None}
+
+        def owned_output_slices(args):
+            return args["anything"]
+    """, select=["shard-spec-complete"]) == []
+
+
+def test_shard_spec_complete_real_multihost_module_is_total():
+    """The real multihost.py declares a host-axis placement for every
+    cycle arg, and the linter finds nothing to say about it."""
+    from volcano_tpu.analysis import run_paths
+    from volcano_tpu.parallel import multihost
+
+    from volcano_tpu.scheduler.simargs import build_sim_args
+
+    args = build_sim_args(8, 16, 4, 2, seed=0)
+    declared = set(multihost._SPECS) | set(multihost._REPLICATED)
+    missing = set(args) - declared
+    assert not missing, f"undeclared multihost cycle args: {sorted(missing)}"
+    findings = [f for f in run_paths([multihost.__file__])
+                if f.rule == "shard-spec-complete"]
+    assert findings == [], [f.message for f in findings]
+
+
 # --- rule: digest-maintenance (PR 13: vtaudit state-digest auditor) ----------
 
 

@@ -11,10 +11,11 @@ mesh exists for (node-plane memory and bandwidth dividing by shard count)
 silently stops applying to that array.
 
 This rule makes the placement decision explicit and total: in the module
-set (``sharded.py``) every string key read from the cycle-argument dict
-(``args["name"]`` inside the jitted cycle body ``_cycle``) must appear in
-the ``_SPECS`` PartitionSpec table OR in the explicit ``_REPLICATED``
-set.  A name in neither is a finding — add it to ``_SPECS`` with its node
+set (``sharded.py`` and the multi-controller ``multihost.py``, whose
+host-axis ``_SPECS`` extends the same contract) every string key read
+from the cycle-argument dict (``args["name"]`` inside the jitted cycle
+body ``_cycle``) must appear in the ``_SPECS`` PartitionSpec table OR in
+the explicit ``_REPLICATED`` set.  A name in neither is a finding — add it to ``_SPECS`` with its node
 axis, or to ``_REPLICATED`` with the reason it replicates (a conscious
 placement, reviewable in the diff, instead of a silent default).
 
@@ -31,7 +32,7 @@ from typing import Iterable, Optional, Set
 
 from volcano_tpu.analysis.core import FileContext, Finding, rule
 
-_SCOPED_BASENAMES = {"sharded.py"}
+_SCOPED_BASENAMES = {"sharded.py", "multihost.py"}
 
 #: cycle-body function names whose ``args[...]`` reads are checked
 _CYCLE_FNS = {"_cycle", "cycle", "sharded_cycle"}

@@ -274,7 +274,8 @@ def run_controller(server: str, identity: str = "", leader_elect: bool = True,
 def run_scheduler(server: str, conf_path: str = "", identity: str = "",
                   leader_elect: bool = True, period: float = 1.0,
                   metrics_port: int = 8080, announce=print,
-                  peers: str = "") -> None:
+                  peers: str = "", mesh_hosts: int = 0,
+                  mesh_host_id: int = -1) -> None:
     """schedule-period defaults to the reference's 1s and /metrics to :8080,
     as the reference binary (options.go:28,63; server.go:86-89). Pass
     metrics_port<0 to disable the endpoint, 0 for a free port."""
@@ -304,6 +305,28 @@ def run_scheduler(server: str, conf_path: str = "", identity: str = "",
                      "TPU path)", flush=True)
             conf.backend = "native"
             conf.fast_path = "off"
+    # multi-controller launch: flag > env > conf.  One scheduler process
+    # per mesh host; host 0 is the coordinator (publishes decisions),
+    # the rest solve their shard and ship owned slices only.
+    if mesh_hosts <= 0:
+        mesh_hosts = int(os.environ.get("VOLCANO_TPU_MESH_HOSTS", "0"))
+    if mesh_host_id < 0:
+        mesh_host_id = int(os.environ.get("VOLCANO_TPU_MESH_HOST_ID", "-1"))
+    if mesh_hosts > 0:
+        conf.mesh_hosts = mesh_hosts
+    if mesh_host_id >= 0:
+        conf.mesh_host_id = mesh_host_id
+    if conf.mesh_hosts > 1:
+        if not (0 <= conf.mesh_host_id < conf.mesh_hosts):
+            raise SystemExit(
+                f"--mesh-host-id {conf.mesh_host_id} out of range for "
+                f"--mesh-hosts {conf.mesh_hosts}")
+        # every host must run every cycle in lockstep — leader election
+        # would silence all but one host; identity stays unique per host
+        # so a lease from a previous single-host deployment can expire
+        leader_elect = False
+        identity = (identity or f"scheduler-{os.getpid()}") \
+            + f"-host{conf.mesh_host_id}"
     if conf.apply_mode is None:
         # deployed default: async batched decision application — a cycle's
         # binds are one bulk round trip off the critical path (a conf file

@@ -438,6 +438,19 @@ def cmd_top(samples, out: Optional[io.TextIOBase] = None, n: int = 12,
                     f"{k}x{v}" for k, v in sorted(reasons.items())
                 )
             buf.write(line + "\n")
+        # multi-controller panel: the newest cycle sample carrying
+        # per-host solve walls (meshHosts > 1 deployments / lockstep sim)
+        mh = next((s.get("mesh_hosts") for s in reversed(cycles)
+                   if s.get("mesh_hosts")), None)
+        if mh:
+            buf.write("mesh hosts (build/dispatch/fetch, cumulative):\n")
+            for h, hrow in sorted(mh.items(), key=lambda kv: kv[0]):
+                path = sum(hrow.values())
+                buf.write(
+                    f"  host {h:<4} path={path * 1e3:.1f}ms "
+                    + " ".join(f"{k.removesuffix('_s')}={v * 1e3:.1f}ms"
+                               for k, v in sorted(hrow.items()))
+                    + "\n")
         if stores:
             s = stores[-1]
             line = (f"store: seq={s.get('log_seq')} "
@@ -1299,6 +1312,14 @@ def main(argv=None) -> int:
             p.add_argument("--conf", default="", help="scheduler-conf YAML path")
             p.add_argument("--metrics-port", type=int, default=8080,
                            help="/metrics port (0 = free port, <0 = disabled)")
+            p.add_argument("--mesh-hosts", type=int, default=0,
+                           help="multi-controller launch: total mesh "
+                                "hosts (one scheduler process per host; "
+                                "0 = conf/VOLCANO_TPU_MESH_HOSTS)")
+            p.add_argument("--mesh-host-id", type=int, default=-1,
+                           help="this process's host id, 0-based "
+                                "(0 = coordinator; -1 = conf/"
+                                "VOLCANO_TPU_MESH_HOST_ID)")
         if comp == "elastic":
             p.add_argument("--metrics-port", type=int, default=8081,
                            help="/metrics port (0 = free port, <0 = disabled)")
@@ -1478,7 +1499,9 @@ def main(argv=None) -> int:
                                       leader_elect=not args.no_leader_elect,
                                       period=args.period,
                                       metrics_port=args.metrics_port,
-                                      peers=args.peers)
+                                      peers=args.peers,
+                                      mesh_hosts=args.mesh_hosts,
+                                      mesh_host_id=args.mesh_host_id)
             elif args.group == "elastic":
                 daemons.run_elastic(args.server, identity=args.identity,
                                     leader_elect=not args.no_leader_elect,

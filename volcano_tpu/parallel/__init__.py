@@ -7,6 +7,12 @@ inserts the collectives (all-gather for the global node argmax/top-k,
 psum-style scatter reductions) over ICI. See parallel/sharded.py.
 """
 
+from volcano_tpu.parallel.multihost import (
+    host_bounds,
+    make_host_mesh,
+    make_multihost_cycle,
+    run_lockstep,
+)
 from volcano_tpu.parallel.sharded import (
     cycle_shardings,
     make_mesh,
@@ -16,7 +22,11 @@ from volcano_tpu.parallel.sharded import (
 
 __all__ = [
     "cycle_shardings",
+    "host_bounds",
+    "make_host_mesh",
     "make_mesh",
+    "make_multihost_cycle",
     "make_sharded_cycle",
     "run_cycle_reference",
+    "run_lockstep",
 ]

@@ -1,0 +1,721 @@
+"""Multi-controller device-mesh solve: one process per host, each host
+feeding and fetching ONLY its shard of the task/node planes.
+
+Design (the pjit-on-pods recipe: the jitted cycle is one SPMD program
+over the GLOBAL logical mesh; every controller process runs the same
+program and owns the slice of inputs/outputs its devices hold):
+
+  * 2-D host mesh ``(hosts, nodes)`` — ``Mesh(devices.reshape(H, D/H))``.
+    Node-shaped planes shard over the COMBINED ``("hosts", "nodes")``
+    axis pair, which splits the node dimension into the same ``D`` blocks
+    as the single-controller 1-D ``("nodes",)`` mesh — so the degenerate
+    ``--mesh-hosts 1`` run is the existing sharded path, bit-for-bit
+    under ``exact_topk`` (tests/test_parallel.py gates it).
+  * task planes (``task_req``/``task_job``/``task_class``/``task_valid``)
+    move OUT of the replicated set and shard over ``"hosts"``: each host
+    builds and dispatches only its 1/H task block; the all-gather XLA
+    inserts is value-exact, so solve outputs are unchanged.
+  * job/queue planes and the packed claim/port bitset words stay
+    replicated (small next to the task/node planes; word-packed node
+    axes do not split on a host boundary).
+  * outputs: each host fetches ONLY the slice it owns through the
+    per-host ``vtprof.fetch_outputs`` boundary — task-axis outputs by
+    task block, node-axis outputs by node block; the coordinator (host
+    0) additionally fetches the replicated job/queue/scalar outputs.
+    The per-host critical path is build + dispatch + owned-slice fetch;
+    the device compute between dispatch and fetch is the SAME global
+    program regardless of host count (cfg9 gates it) and is reported
+    separately as ``solve_wait_s``.
+
+CPU simulation (how CI gates this without a pod): a single process sees
+all virtual devices, so ``run_lockstep`` executes the one global cycle
+and measures each host's critical-path components individually — host
+``h``'s build wall is slicing ITS plane shard out of the snapshot
+(snapshot_build.host_plane_shard), its dispatch wall is the device puts
+for ITS mesh row plus the shared jit call, its fetch wall is ITS owned
+output slices.  Other hosts' puts are the simulation standing in for
+work those processes would do concurrently, never charged to ``h``.
+
+Process mode (``python -m volcano_tpu.parallel.multihost --mesh-hosts N``)
+runs one OS process per host in lockstep over identically-seeded args:
+the coordinator spawns workers, every process runs the SPMD cycle,
+workers ship their owned slices back through the rendezvous directory,
+and the coordinator verifies the merged slices against its own full
+outputs.  Failure contract: a coordinator death mid-cycle degrades each
+worker to a FULL single-host cycle (``"fallback": true`` in its result)
+rather than wedging on the rendezvous; a worker death degrades the
+coordinator to its own full outputs (``"degraded": true``).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from volcano_tpu import vtprof
+
+#: the jitted cycle's output tuple, in order (parallel/sharded.py's
+#: cycle returns the same tuple — the kernels define it)
+OUTPUT_NAMES = (
+    "task_node", "task_kind", "task_seq", "ready", "job_alloc",
+    "queue_alloc", "idle", "releasing", "used", "dropped", "rounds",
+)
+#: output indices per owning axis: task-axis and node-axis outputs are
+#: fetched as owned slices per host; the rest (job/queue planes +
+#: scalars) replicate and only the coordinator fetches them
+_TASK_OUT = (0, 1, 2)
+_NODE_OUT = (6, 7, 8)
+_GLOBAL_OUT = (3, 4, 5, 9, 10)
+
+# PartitionSpec construction is deferred so importing this module never
+# initializes jax (daemons import the CLI layer eagerly); the literal
+# tables below are what the vtlint shard-spec-complete rule reads.
+
+#: argument name -> axis-spec tuple over the ("hosts", "nodes") mesh.
+#: Node planes split over BOTH axes combined — the same D-way node
+#: blocking as the 1-D sharded mesh; task planes split over hosts only.
+_SPECS = {
+    "idle": (("hosts", "nodes"), None),
+    "releasing": (("hosts", "nodes"), None),
+    "used": (("hosts", "nodes"), None),
+    "node_alloc": (("hosts", "nodes"), None),
+    "node_max_tasks": (("hosts", "nodes"),),
+    "task_count": (("hosts", "nodes"),),
+    "node_valid": (("hosts", "nodes"),),
+    "class_mask": (None, ("hosts", "nodes")),
+    "class_score": (None, ("hosts", "nodes")),
+    "node_ports_w": (("hosts", "nodes"), None),
+    "node_selcnt": (("hosts", "nodes"), None),
+    # task planes: host-sharded (the multi-controller point — each host
+    # builds/dispatches only its 1/H task block; XLA's all-gather is
+    # value-exact so outputs match the replicated layout bit-for-bit
+    # under exact_topk)
+    "task_req": ("hosts", None),
+    "task_job": ("hosts",),
+    "task_class": ("hosts",),
+    "task_valid": ("hosts",),
+}
+
+#: cycle arguments that REPLICATE across every host's devices, listed
+#: explicitly so the shard-spec-complete vtlint rule can prove every
+#: array entering the jitted multihost cycle has a declared placement.
+#: job/queue planes are small and every host needs the full job ranking
+#: each round; the claim/port bitset words keep task-major rows whose
+#: node axis is PACKED into u32 words — words do not split on a host
+#: boundary, and volume waves are residue-scale, so replication is
+#: bytes, not a bandwidth term.
+_REPLICATED = frozenset({
+    "job_queue", "job_min", "job_prio", "job_ready_init",
+    "job_alloc_init", "job_schedulable", "job_start", "job_ntasks",
+    "queue_weight", "queue_request", "queue_alloc_init",
+    "queue_participates",
+    "total", "eps",
+    "task_volmask_w", "task_claims", "claim_group", "group_cap",
+    "group_global",
+    "task_ports_w", "task_aff_w", "task_anti_w", "task_self_w",
+})
+
+
+def host_bounds(n_rows: int, n_hosts: int) -> List[Tuple[int, int]]:
+    """Per-host ``[lo, hi)`` block bounds over an ``n_rows`` axis —
+    XLA's ceil-block convention (shard ``h`` owns rows
+    ``[h*ceil, (h+1)*ceil)`` clipped to ``n_rows``), so owned output
+    slices line up with what the host's devices actually hold."""
+    n_hosts = max(int(n_hosts), 1)
+    q = -(-int(n_rows) // n_hosts)
+    return [(min(h * q, n_rows), min((h + 1) * q, n_rows))
+            for h in range(n_hosts)]
+
+
+def make_host_mesh(n_hosts: int, n_devices: Optional[int] = None):
+    """2-D ``(hosts, nodes)`` mesh: ``n_hosts`` rows of equal device
+    count over the first ``n_devices`` devices (all by default)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if len(devs) % n_hosts:
+        raise ValueError(
+            f"{len(devs)} devices not divisible by {n_hosts} hosts"
+        )
+    per = len(devs) // n_hosts
+    return Mesh(np.asarray(devs).reshape(n_hosts, per), ("hosts", "nodes"))
+
+
+def _spec_of(name: str):
+    from jax.sharding import PartitionSpec as P
+
+    axes = _SPECS.get(name)
+    return P() if axes is None else P(*axes)
+
+
+def cycle_shardings(mesh, args: Dict[str, object]) -> Dict[str, object]:
+    """NamedSharding per cycle argument over the host mesh; names in
+    neither table replicate (the vtlint rule fences drift)."""
+    from jax.sharding import NamedSharding
+
+    return {k: NamedSharding(mesh, _spec_of(k)) for k in args}
+
+
+def _cycle(args, w_least, w_balanced, job_key_order, use_gang_ready,
+           use_proportion, m_chunk, p_chunk, exact_topk=False):
+    """One full decision cycle over the host mesh: proportion water-fill
+    + batched allocate — the sharded cycle body, re-declared here so the
+    ``args[...]`` reads check against THIS module's host-axis
+    ``_SPECS``/``_REPLICATED`` tables (shard-spec-complete)."""
+    from volcano_tpu.scheduler.kernels import allocate_solve_batch, water_fill
+
+    deserved = water_fill(
+        args["queue_weight"], args["queue_request"], args["total"],
+        args["eps"], args["queue_participates"],
+    )
+    return allocate_solve_batch(
+        args["idle"], args["releasing"], args["used"], args["node_alloc"],
+        args["node_max_tasks"], args["task_count"], args["node_valid"],
+        args["task_req"], args["task_job"], args["task_class"],
+        args["task_valid"],
+        args["job_queue"], args["job_min"], args["job_prio"],
+        args["job_ready_init"], args["job_alloc_init"],
+        args["job_schedulable"],
+        args["job_start"], args["job_ntasks"],
+        args["queue_alloc_init"], deserved,
+        args["class_mask"], args["class_score"],
+        args["total"], args["eps"],
+        w_least, w_balanced,
+        job_key_order=job_key_order,
+        use_gang_ready=use_gang_ready,
+        use_proportion=use_proportion,
+        m_chunk=m_chunk,
+        p_chunk=p_chunk,
+        exact_topk=exact_topk,
+    )
+
+
+#: output name -> axis-spec tuple (out_shardings): task outputs land
+#: host-blocked, node outputs land device-blocked, the rest replicate —
+#: so each host's owned fetch reads ONLY its local device shards (no
+#: cross-host transfer), exactly the multi-controller contract
+_OUT_AXES = {
+    "task_node": ("hosts",),
+    "task_kind": ("hosts",),
+    "task_seq": ("hosts",),
+    "idle": (("hosts", "nodes"), None),
+    "releasing": (("hosts", "nodes"), None),
+    "used": (("hosts", "nodes"), None),
+}
+
+
+def _jit_cycle(mesh, shardings, w_least, w_balanced, **static_kw):
+    """The jitted multihost cycle with committed input shardings AND
+    explicit output shardings (task outputs host-blocked, node outputs
+    device-blocked — each host fetches from its own devices only);
+    registered in the vtprof compile registry as ``multihost_cycle`` so
+    the recompile sentinel and ``vtctl profile`` see this path too."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out_sh = tuple(
+        NamedSharding(mesh, P(*_OUT_AXES.get(name, ())))
+        for name in OUTPUT_NAMES
+    )
+    fn = jax.jit(
+        functools.partial(_cycle, **static_kw),
+        in_shardings=(shardings, None, None),
+        out_shardings=out_sh,
+    )
+    vtprof.register_jit("multihost_cycle", fn)
+    return lambda a: fn(a, jnp.float32(w_least), jnp.float32(w_balanced))
+
+
+def make_multihost_cycle(
+    mesh,
+    args: Dict[str, object],
+    w_least: float = 1.0,
+    w_balanced: float = 1.0,
+    job_key_order=("priority", "gang", "drf"),
+    use_gang_ready: bool = True,
+    use_proportion: bool = True,
+    m_chunk: int = 512,
+    p_chunk: int = 16,
+    exact_topk: bool = False,
+):
+    """Return (jitted_fn, device_args): the cycle compiled with host-axis
+    shardings, host args placed accordingly — the make_sharded_cycle
+    shape, for the degenerate-parity tests and embedders that do their
+    own dispatch timing (run_lockstep is the measured path)."""
+    import jax
+
+    n_devs = mesh.devices.size
+    n_rows = np.shape(args["idle"])[0]
+    if n_rows % n_devs:
+        raise ValueError(
+            f"node bucket {n_rows} not divisible by mesh size {n_devs}"
+        )
+    shardings = cycle_shardings(mesh, args)
+    device_args = {
+        k: jax.device_put(np.asarray(v), shardings[k])
+        for k, v in args.items()
+    }
+    call = _jit_cycle(
+        mesh, shardings, w_least, w_balanced,
+        job_key_order=job_key_order,
+        use_gang_ready=use_gang_ready,
+        use_proportion=use_proportion,
+        m_chunk=m_chunk,
+        p_chunk=p_chunk,
+        exact_topk=exact_topk,
+    )
+    return call, device_args
+
+
+def _host_shard_pieces(arr, devset):
+    """ONE host's distinct data pieces of a sharded jax array, ordered
+    by axis offset: the single-device shards resident on the host's
+    devices, with replicated copies deduped to one.  Reading shards
+    directly (instead of device-slicing the global array) is both the
+    faithful multi-controller mechanic — a real host can only see its
+    addressable shards — and the fast path: no slice program launches,
+    just host copies of owned bytes."""
+    by_idx = {}
+    for s in arr.addressable_shards:
+        if s.device not in devset:
+            continue
+        key = tuple((sl.start or 0) for sl in s.index)
+        by_idx.setdefault(key, s.data)
+    return [by_idx[k] for k in sorted(by_idx)]
+
+
+def owned_output_slices(out, host: int, n_hosts: int,
+                        kernel: str = "multihost_cycle",
+                        phase: str = "fetch") -> Dict[str, np.ndarray]:
+    """Fetch ONE host's owned slice of the cycle output tuple through
+    the per-host vtprof.fetch_outputs boundary: task-axis outputs by
+    task block, node-axis outputs by node block — read straight off the
+    host's addressable device shards (the jit's ``_OUT_AXES`` output
+    shardings put each block exactly there); the coordinator (host 0)
+    also fetches the replicated job/queue/scalar outputs."""
+    devset = set(out[_NODE_OUT[0]].sharding.mesh.devices[host].flat)
+    picks = [(OUTPUT_NAMES[i], _host_shard_pieces(out[i], devset))
+             for i in _TASK_OUT + _NODE_OUT]
+    if host == 0:
+        picks += [(OUTPUT_NAMES[i], _host_shard_pieces(out[i], devset)[:1])
+                  for i in _GLOBAL_OUT]
+    flat = tuple(p for _, ps in picks for p in ps)
+    arrs = vtprof.fetch_outputs(flat, kernel=kernel, phase=phase, host=host)
+    res: Dict[str, np.ndarray] = {}
+    k = 0
+    for name, ps in picks:
+        got = arrs[k:k + len(ps)]
+        k += len(ps)
+        res[name] = got[0] if len(got) == 1 else np.concatenate(got)
+    return res
+
+
+def merge_output_slices(per_host: List[Dict[str, np.ndarray]]):
+    """Reassemble the full output tuple from every host's owned slices
+    (the lockstep merge — also the proof that the owned slices cover
+    the whole output plane exactly once)."""
+    merged = {}
+    for i in _TASK_OUT + _NODE_OUT:
+        name = OUTPUT_NAMES[i]
+        merged[name] = np.concatenate([ph[name] for ph in per_host])
+    for i in _GLOBAL_OUT:
+        name = OUTPUT_NAMES[i]
+        merged[name] = per_host[0][name]
+    return tuple(merged[n] for n in OUTPUT_NAMES)
+
+
+def run_lockstep(
+    args: Dict[str, object],
+    n_hosts: int,
+    *,
+    reps: int = 1,
+    w_least: float = 1.0,
+    w_balanced: float = 1.0,
+    job_key_order=("priority", "gang", "drf"),
+    use_gang_ready: bool = True,
+    use_proportion: bool = True,
+    m_chunk: int = 512,
+    p_chunk: int = 16,
+    exact_topk: bool = True,
+    mesh=None,
+):
+    """One global multihost cycle with each host's critical path
+    measured individually (CPU lockstep simulation — module docstring).
+
+    Returns ``{"outputs": 11-tuple, "per_host": [{build_s, dispatch_s,
+    fetch_s, path_s}], "critical_path_s", "solve_wait_s", "n_hosts"}``
+    — walls are the best of ``reps`` timed repetitions (one untimed
+    warmup rep absorbs the XLA compile)."""
+    import jax
+
+    from volcano_tpu.scheduler.fastpath.snapshot_build import (
+        host_plane_shard,
+    )
+
+    if mesh is None:
+        mesh = make_host_mesh(n_hosts)
+    H = int(mesh.devices.shape[0])
+    shardings = cycle_shardings(mesh, args)
+    call = _jit_cycle(
+        mesh, shardings, w_least, w_balanced,
+        job_key_order=job_key_order,
+        use_gang_ready=use_gang_ready,
+        use_proportion=use_proportion,
+        m_chunk=m_chunk,
+        p_chunk=p_chunk,
+        exact_topk=exact_topk,
+    )
+    host_devs = [list(mesh.devices[h].flat) for h in range(H)]
+    amaps = {}
+    for name, v in args.items():
+        arr = np.asarray(v)
+        sh = shardings[name]
+        amaps[name] = (arr, sh, sh.addressable_devices_indices_map(arr.shape))
+
+    best = None
+    for rep in range(max(int(reps), 1) + 1):
+        warmup = rep == 0
+        prof = None if warmup else vtprof.PROFILER
+        if prof is not None:
+            prof.begin_cycle()
+        build_s = [0.0] * H
+        disp_s = [0.0] * H
+        fetch_s = [0.0] * H
+        # per-host snapshot-shard build: host h materializes ONLY its
+        # slice of the task/node planes
+        for h in range(H):
+            t0 = time.perf_counter()
+            host_plane_shard(args, h, H)
+            build_s[h] = time.perf_counter() - t0
+        # per-host device dispatch: host h puts the shards for ITS mesh
+        # row's devices (other rows' puts are sim scaffolding for the
+        # processes that would run concurrently — timed under THEIR host)
+        pieces: Dict[str, Dict] = {name: {} for name in amaps}
+        for h in range(H):
+            t0 = time.perf_counter()
+            for name, (arr, sh, dmap) in amaps.items():
+                store = pieces[name]
+                for dev in host_devs[h]:
+                    store[dev] = jax.device_put(arr[dmap[dev]], dev)
+            disp_s[h] = time.perf_counter() - t0
+        device_args = {
+            name: jax.make_array_from_single_device_arrays(
+                arr.shape, sh, [pieces[name][d] for d in dmap]
+            )
+            for name, (arr, sh, dmap) in amaps.items()
+        }
+        # the SPMD cycle: every host calls the same jitted program —
+        # the (async) call wall charges to each host
+        t0 = time.perf_counter()
+        out = call(device_args)
+        call_s = time.perf_counter() - t0
+        for h in range(H):
+            disp_s[h] += call_s
+        # device compute barrier: identical global program at every
+        # host count (cfg9's claim) — reported, not host-attributed
+        t0 = time.perf_counter()
+        jax.block_until_ready(out)
+        wait_s = time.perf_counter() - t0
+        slices = []
+        for h in range(H):
+            t0 = time.perf_counter()
+            slices.append(owned_output_slices(out, h, H))
+            fetch_s[h] = time.perf_counter() - t0
+        merged = merge_output_slices(slices)
+        path = [build_s[h] + disp_s[h] + fetch_s[h] for h in range(H)]
+        crit = int(np.argmax(path))
+        if prof is not None:
+            for h in range(H):
+                prof.note_mesh_host(
+                    h, build_s=build_s[h], dispatch_s=disp_s[h],
+                    fetch_s=fetch_s[h],
+                )
+            prof.end_cycle(
+                path[crit],
+                {"build": build_s[crit], "dispatch": disp_s[crit],
+                 "fetch": fetch_s[crit]},
+                "multihost",
+            )
+        if warmup:
+            continue
+        rec = {
+            "outputs": merged,
+            "per_host": [
+                {"build_s": build_s[h], "dispatch_s": disp_s[h],
+                 "fetch_s": fetch_s[h], "path_s": path[h]}
+                for h in range(H)
+            ],
+            "critical_path_s": max(path),
+            "solve_wait_s": wait_s,
+            "n_hosts": H,
+        }
+        if best is None or rec["critical_path_s"] < best["critical_path_s"]:
+            best = rec
+    return best
+
+
+# -- process mode: one OS process per host --------------------------------
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+def _result_paths(outdir: str, host: int) -> Tuple[str, str]:
+    return (os.path.join(outdir, f"host{host:02d}.json"),
+            os.path.join(outdir, f"host{host:02d}.npz"))
+
+
+def _sim_args(ns):
+    from volcano_tpu.scheduler.simargs import build_sim_args
+
+    return build_sim_args(
+        n_nodes=ns.nodes, n_tasks=ns.tasks, n_jobs=ns.jobs,
+        n_queues=2, seed=ns.seed,
+    )
+
+
+def _worker(ns) -> int:
+    """One mesh-host worker: run the lockstep cycle, ship the owned
+    slices through the rendezvous dir.  If the coordinator dies at any
+    checkpoint, degrade to a FULL single-host cycle (``fallback``) and
+    exit cleanly — the degrade-not-wedge contract."""
+    host = ns.host_id
+    coord = ns.coordinator_pid or os.getppid()
+    os.makedirs(ns.outdir, exist_ok=True)
+    json_path, npz_path = _result_paths(ns.outdir, host)
+    args = _sim_args(ns)
+
+    fallback = not _pid_alive(coord)
+    res = None
+    if not fallback:
+        res = run_lockstep(args, ns.mesh_hosts, reps=ns.reps,
+                           exact_topk=True)
+        # mid-cycle coordinator death: the rendezvous has no reader —
+        # this host's owned slices alone cannot bind the cluster
+        fallback = not _pid_alive(coord)
+    if fallback:
+        res = run_lockstep(args, 1, reps=1, exact_topk=True)
+    outs = res["outputs"]
+    if fallback:
+        own = {n: np.asarray(outs[i]) for i, n in enumerate(OUTPUT_NAMES)}
+    else:
+        T = outs[0].shape[0]
+        N = outs[6].shape[0]
+        tlo, thi = host_bounds(T, ns.mesh_hosts)[host]
+        nlo, nhi = host_bounds(N, ns.mesh_hosts)[host]
+        own = {OUTPUT_NAMES[i]: outs[i][tlo:thi] for i in _TASK_OUT}
+        own.update({OUTPUT_NAMES[i]: outs[i][nlo:nhi] for i in _NODE_OUT})
+    np.savez(npz_path + ".tmp.npz", **own)
+    os.replace(npz_path + ".tmp.npz", npz_path)
+    payload = {
+        "host": host, "fallback": fallback,
+        "per_host": res["per_host"],
+        "critical_path_s": res["critical_path_s"],
+    }
+    with open(json_path + ".tmp", "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(json_path + ".tmp", json_path)
+    if not ns.quiet:
+        print(json.dumps(payload))
+    return 0
+
+
+def _coordinator(ns) -> int:
+    """Spawn one worker process per non-coordinator host, run host 0's
+    cycle, verify every worker's owned slices against the merged
+    outputs.  A dead/late worker degrades the run to the coordinator's
+    own full outputs (``degraded``) instead of wedging."""
+    import subprocess
+    import tempfile
+
+    H = ns.mesh_hosts
+    outdir = ns.outdir or tempfile.mkdtemp(prefix="vtmesh-")
+    os.makedirs(outdir, exist_ok=True)
+    procs = []
+    base = [sys.executable, "-m", "volcano_tpu.parallel.multihost",
+            "--mesh-hosts", str(H),
+            "--nodes", str(ns.nodes), "--tasks", str(ns.tasks),
+            "--jobs", str(ns.jobs), "--seed", str(ns.seed),
+            "--reps", str(ns.reps), "--outdir", outdir,
+            "--coordinator-pid", str(os.getpid()), "--quiet"]
+    for h in range(1, H):
+        procs.append(subprocess.Popen(base + ["--host-id", str(h)]))
+    args = _sim_args(ns)
+    res = run_lockstep(args, H, reps=ns.reps, exact_topk=True)
+    outs = res["outputs"]
+    degraded = False
+    workers = []
+    for h, p in zip(range(1, H), procs):
+        row = {"host": h, "rc": None, "ok": False, "fallback": None}
+        try:
+            row["rc"] = p.wait(timeout=ns.timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10)
+            row["rc"] = -9
+            degraded = True
+            workers.append(row)
+            continue
+        json_path, npz_path = _result_paths(outdir, h)
+        try:
+            with open(json_path, encoding="utf-8") as f:
+                wres = json.load(f)
+            shipped = np.load(npz_path)
+            row["fallback"] = bool(wres.get("fallback"))
+            T = outs[0].shape[0]
+            N = outs[6].shape[0]
+            tlo, thi = host_bounds(T, H)[h]
+            nlo, nhi = host_bounds(N, H)[h]
+            ok = all(
+                np.array_equal(shipped[OUTPUT_NAMES[i]],
+                               outs[i][tlo:thi]) for i in _TASK_OUT
+            ) and all(
+                np.array_equal(shipped[OUTPUT_NAMES[i]],
+                               outs[i][nlo:nhi]) for i in _NODE_OUT
+            )
+            row["ok"] = ok and row["rc"] == 0 and not row["fallback"]
+            if not row["ok"]:
+                degraded = True
+        except (OSError, ValueError, KeyError):
+            degraded = True
+        workers.append(row)
+    # degraded = the coordinator's own full outputs carry the cycle
+    # (every host computed the identical SPMD program); the summary
+    # says so instead of pretending the fleet fetched its slices
+    summary = {
+        # degraded still reports ok: the cycle completed on the
+        # coordinator's full outputs (degrade, don't wedge) — the
+        # ``degraded`` flag is what a supervisor alarms on
+        "ok": degraded or all(w["ok"] for w in workers),
+        "hosts": H,
+        "degraded": degraded,
+        "workers": workers,
+        "per_host": res["per_host"],
+        "critical_path_s": res["critical_path_s"],
+        "solve_wait_s": res["solve_wait_s"],
+        "binds": int((np.asarray(outs[1]) == 1).sum()),
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+def _run_sweep(ns) -> int:
+    """In-process host sweep (cfg9e/cfg9f capture): run the lockstep
+    cycle at each host count, report per-host critical paths, the
+    per-doubling scaling ratios, merged-output parity across host
+    counts, and the vtprof attribution coverage."""
+    import jax
+
+    hosts = [int(x) for x in str(ns.sweep).split(",") if x.strip()]
+    args = _sim_args(ns)
+    profiler = vtprof.arm() if ns.prof else None
+    sweep = {}
+    ref = None
+    parity = True
+    try:
+        for H in hosts:
+            res = run_lockstep(args, H, reps=ns.reps, exact_topk=True)
+            sweep[str(H)] = {
+                "critical_path_s": round(res["critical_path_s"], 6),
+                "solve_wait_s": round(res["solve_wait_s"], 6),
+                "per_host": [
+                    {k: round(v, 6) for k, v in row.items()}
+                    for row in res["per_host"]
+                ],
+            }
+            if ref is None:
+                ref = res["outputs"]
+            else:
+                parity = parity and all(
+                    np.array_equal(np.asarray(a), np.asarray(b))
+                    for a, b in zip(ref, res["outputs"])
+                )
+        coverage = None
+        if profiler is not None:
+            coverage = round(
+                vtprof.attribution(profiler.payload())["coverage"], 4
+            )
+    finally:
+        if profiler is not None:
+            vtprof.disarm()
+    scaling = {
+        f"{hosts[i]}->{hosts[i + 1]}": round(
+            sweep[str(hosts[i + 1])]["critical_path_s"]
+            / max(sweep[str(hosts[i])]["critical_path_s"], 1e-9), 3)
+        for i in range(len(hosts) - 1)
+    }
+    payload = {
+        "sweep": sweep,
+        "scaling_per_doubling": scaling,
+        "parity": parity,
+        "prof_attribution": coverage,
+        "binds": int((np.asarray(ref[1]) == 1).sum()),
+        "n_nodes": ns.nodes, "n_tasks": ns.tasks, "n_jobs": ns.jobs,
+        "n_devices": len(jax.devices()),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(payload))
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m volcano_tpu.parallel.multihost",
+        description="multi-controller mesh solve runner "
+                    "(CPU-simulable: one process per host)",
+    )
+    ap.add_argument("--mesh-hosts", type=int,
+                    default=int(os.environ.get("VOLCANO_TPU_MESH_HOSTS",
+                                               "1")))
+    ap.add_argument("--host-id", type=int, default=None,
+                    help="worker mode (spawned by the coordinator)")
+    ap.add_argument("--sweep", default="",
+                    help="in-process host sweep, e.g. 1,2,4 (cfg9e)")
+    ap.add_argument("--nodes", type=int, default=512)
+    ap.add_argument("--tasks", type=int, default=2048)
+    ap.add_argument("--jobs", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--reps", type=int, default=1)
+    ap.add_argument("--prof", action="store_true",
+                    help="arm vtprof for the run (sweep mode)")
+    ap.add_argument("--outdir", default="",
+                    help="rendezvous dir for worker results")
+    ap.add_argument("--coordinator-pid", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--quiet", action="store_true")
+    ns = ap.parse_args(argv)
+    if ns.sweep:
+        return _run_sweep(ns)
+    if ns.host_id is not None:
+        return _worker(ns)
+    if ns.mesh_hosts > 1:
+        return _coordinator(ns)
+    # degenerate single host: one full cycle, the deployed-path shape
+    res = run_lockstep(_sim_args(ns), 1, reps=ns.reps, exact_topk=True)
+    print(json.dumps({
+        "ok": True, "hosts": 1,
+        "critical_path_s": round(res["critical_path_s"], 6),
+        "binds": int((np.asarray(res["outputs"][1]) == 1).sum()),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

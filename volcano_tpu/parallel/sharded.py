@@ -279,11 +279,15 @@ def make_sharded_cycle(
     )
 
 
-def fetch_outputs(out, kernel: str = "sharded_cycle", phase: str = "solve"):
+def fetch_outputs(out, kernel: str = "sharded_cycle", phase: str = "solve",
+                  host=None):
     """THE sanctioned device→host fetch boundary for a sharded cycle's
     output tuple: disarmed it is exactly ``np.asarray`` per output (the
     device-sync-discipline contract); armed, each output's block-until-
     ready wait splits from its host copy and attributes to ``kernel`` —
     so the mesh path's wall-clock lands in named vtprof segments instead
-    of vanishing into the caller's host time."""
-    return tuple(vtprof.fetch(o, kernel=kernel, phase=phase) for o in out)
+    of vanishing into the caller's host time.  ``host`` forwards to the
+    per-mesh-host rollup (vtprof.fetch_outputs): the multi-controller
+    path passes its host id so owned-slice fetch walls attribute per
+    host."""
+    return vtprof.fetch_outputs(out, kernel=kernel, phase=phase, host=host)

@@ -67,6 +67,9 @@ class AsyncApplier:
             # HTTP round trip) = client total minus the server-measured
             # apply sections; ~0 on the in-process transport
             "wire_s": 0.0,
+            # publish attribution (cfg9c): namespace-shard split wall and
+            # the concurrent fan-out wall of the sharded segment ship
+            "split_s": 0.0, "ship_s": 0.0,
         }
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="volcano-applier"
@@ -436,8 +439,16 @@ class AsyncApplier:
         from concurrent.futures import ThreadPoolExecutor
 
         from volcano_tpu.store.partition import split_segment
+        import time as _time
 
+        t_split = _time.perf_counter()
         subs = split_segment(ship, nshards)
+        # publish attribution (cfg9c follow-up): the namespace-shard
+        # split is its own wall so a split-dominated drain localizes
+        self.drain_stats["split_s"] = (
+            self.drain_stats.get("split_s", 0.0)
+            + _time.perf_counter() - t_split
+        )
         if not subs:
             return True
 
@@ -453,8 +464,6 @@ class AsyncApplier:
             except Exception as e:  # noqa: BLE001 — per-shard isolation
                 return shard, sub, None, _t.perf_counter() - t0, e
 
-        import time as _time
-
         t_fan = _time.perf_counter()
         if len(subs) == 1:
             outcomes = [ship_one(*subs[0])]
@@ -465,6 +474,12 @@ class AsyncApplier:
             ) as ex:
                 outcomes = list(ex.map(lambda t: ship_one(*t), subs))
         fan_wall = _time.perf_counter() - t_fan
+        # ship = the concurrent fan-out wall (encode + transport + the
+        # serialized server applies); split_s + ship_s ≈ the applier's
+        # share of the publish critical path
+        self.drain_stats["ship_s"] = (
+            self.drain_stats.get("ship_s", 0.0) + fan_wall
+        )
         any_ok = False
         server_s = 0.0
         for shard, sub, res, total, err in outcomes:

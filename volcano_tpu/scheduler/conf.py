@@ -72,6 +72,14 @@ class SchedulerConf:
     # stays single-device — scalar while-loop steps gain nothing from
     # SPMD — so mesh implies the batched variants wherever they exist.
     mesh: str = "off"
+    # multi-controller launch (parallel/multihost.py): total mesh-host
+    # count and THIS process's host id.  1/0 = single-controller (the
+    # bit-for-bit degenerate mode).  With mesh_hosts > 1 each host
+    # builds/dispatches only its shard of the task/node planes and
+    # publishes only the binds for its owned task block; host 0 (the
+    # coordinator) additionally owns job status and enqueue ops.
+    mesh_hosts: int = 1
+    mesh_host_id: int = 0
     # persisted mirror checkpoint path: a restarted scheduler restores
     # the watch mirror's row tables and delta-reconciles by per-object
     # resource version instead of re-ingesting the whole cluster — the
@@ -186,6 +194,18 @@ def load_conf(text: str) -> SchedulerConf:
                 f"mesh must be 'off', 'auto' or a device count, got {mesh!r}"
             )
         conf.mesh = mesh
+    if "meshHosts" in data:
+        conf.mesh_hosts = int(data["meshHosts"])
+        if conf.mesh_hosts < 1:
+            raise ValueError(
+                f"meshHosts must be >= 1, got {conf.mesh_hosts}"
+            )
+    if "meshHostId" in data:
+        conf.mesh_host_id = int(data["meshHostId"])
+    if not (0 <= conf.mesh_host_id < conf.mesh_hosts):
+        raise ValueError(
+            f"meshHostId {conf.mesh_host_id} outside [0, {conf.mesh_hosts})"
+        )
     if "mirrorCheckpoint" in data:
         raw = data["mirrorCheckpoint"]
         conf.mirror_checkpoint = str(raw) if raw else None

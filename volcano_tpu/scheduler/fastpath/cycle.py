@@ -86,6 +86,15 @@ class FastCycle:
             get_plugin_arg(probe.nodeorder_args, "nodeaffinity.weight", 1.0)
             if probe.enabled.get("nodeorder") else 0.0
         )
+        # multi-controller launch (conf meshHosts/meshHostId, parallel/
+        # multihost.py): every host runs the SAME global solve; host h
+        # publishes ONLY the binds in its owned task block, and host 0
+        # (the coordinator) additionally owns statuses, enqueue ops,
+        # backfill placements and any object sub-cycle — single-writer
+        # for everything that is not block-partitioned.
+        self.mesh_hosts = max(int(getattr(self.conf, "mesh_hosts", 1)), 1)
+        self.mesh_host_id = int(getattr(self.conf, "mesh_host_id", 0))
+        self.is_coordinator = self.mesh_host_id == 0
         self.mirror: Optional[ArrayMirror] = None
         self.restored_from_checkpoint = False
         # wall-clock seconds per phase of the LAST try_run (drain /
@@ -295,6 +304,11 @@ class FastCycle:
                 mesh=self.sched.mesh,
             )
             backend._snapshot = snap
+            if self.mesh_hosts > 1:
+                # owned-slice fetch boundary: tensor_actions fetches only
+                # this host's task block and attributes the walls per host
+                backend.mesh_host = self.mesh_host_id
+                backend.mesh_hosts = self.mesh_hosts
             task_node, task_kind, task_seq, ready = jax_allocate_solve(
                 backend, snap
             )
@@ -308,6 +322,13 @@ class FastCycle:
             ready = snap.job_ready_init.copy()
         metrics.update_action_duration("allocate", t0)
         ph["solve"] = time.perf_counter() - t0
+        if self.mesh_hosts > 1 and vtprof.PROFILER is not None:
+            # per-host solve critical path, build leg: this host's
+            # snapshot-shard build wall (dispatch/fetch legs are noted
+            # inside tensor_actions at the owned-slice boundary)
+            vtprof.PROFILER.note_mesh_host(
+                self.mesh_host_id, build_s=ph.get("snapshot", 0.0)
+            )
         if vtprof.PROFILER is not None:
             vtprof.PROFILER.note_bytes(
                 "solve_out",
@@ -433,7 +454,30 @@ class FastCycle:
                 metrics.update_action_duration("preempt", t0)
                 ph["preempt"] = time.perf_counter() - t0
 
-        run_sub = residue or obj_preempt
+        if self.mesh_hosts > 1 and not self.is_coordinator:
+            # owned-slice publish: the solve's owned-slice fetch already
+            # zero-filled task_kind outside this host's express block
+            # (tensor_actions host_bounds), so the fleet's merged binds
+            # cover the express rows exactly once — each host ships only
+            # its sub-segment (the PR 18 procmesh drain fans it to the
+            # aligned store shard).  Dyn-extension rows and backfill
+            # placements are NOT block-partitioned: coordinator-owned,
+            # like statuses/enqueue ops.
+            T_express = snap.task_req.shape[0]
+            if task_kind.shape[0] > T_express:
+                task_kind = task_kind.copy()
+                task_kind[T_express:] = 0
+            be_rows = np.zeros(0, np.int64)
+            be_nodes = np.zeros(0, np.int32)
+            # conservative gang gate on workers: a mixed gang made ready
+            # only by coordinator-owned backfill placements gates closed
+            # here this cycle and self-heals next cycle once the bound
+            # tasks land in job_ready_init (degrade, don't double-write)
+            be_per_job = np.zeros_like(be_per_job)
+        # a worker never runs the object sub-cycle: residue/preempt
+        # fallbacks degrade to a full cycle on the coordinator (degrade,
+        # don't double-write — mirror state reconciles through the watch)
+        run_sub = (residue or obj_preempt) and self.is_coordinator
         if run_sub:
             # the sub-cycle's close_session reads STORE phases: admissions
             # must land first
@@ -449,8 +493,9 @@ class FastCycle:
                 # the object sub-cycle's close_session owns this cycle's
                 # PodGroup statuses (it sees the complete state incl. residue
                 # placements and preempt pipelines); writing them twice could
-                # land out of order through the async applier
-                write_status=not run_sub,
+                # land out of order through the async applier.  Mesh-host
+                # workers never write statuses — coordinator-owned.
+                write_status=not run_sub and self.is_coordinator,
                 evicts=evicts,
                 ready_status=ready_status,
                 pe_rows_solve=pe_rows_solve,
@@ -458,7 +503,7 @@ class FastCycle:
                 task_req_solve=task_req_solve,
             )
         finally:
-            if not run_sub and enq_ops:
+            if not run_sub and enq_ops and self.is_coordinator:
                 # no store-phase reader this cycle: the conditional
                 # patches ride the async applier (a Precondition miss
                 # stays the benign skip; real failures hit err_log and
@@ -791,7 +836,9 @@ class FastCycle:
         ]
 
     def _ship_enqueue_ops(self, ops: List[dict]) -> None:
-        if not ops:
+        if not ops or not self.is_coordinator:
+            # enqueue admissions are coordinator-owned (mesh-host workers
+            # compute them for solve-input parity but never write them)
             return
         try:
             results = self.store.bulk(ops)

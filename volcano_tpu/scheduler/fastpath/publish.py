@@ -45,6 +45,9 @@ def publish_and_close(fc, m, snap, aux, task_node, task_kind, ready,
     joining), so the caller passes the solve-time arrays."""
     from volcano_tpu.api.objects import PodGroupCondition, PodGroupStatus
 
+    import time as _time
+
+    t_build0 = _time.perf_counter()
     n_jobs = aux["n_jobs"]
     J = snap.job_min_available.shape[0]
     jm = snap.job_min_available
@@ -61,6 +64,17 @@ def publish_and_close(fc, m, snap, aux, task_node, task_kind, ready,
     if express.size:
         express_per_job += np.bincount(
             task_job_solve[express], minlength=J
+        )
+    if getattr(fc, "mesh_hosts", 1) > 1:
+        # multi-controller: the owned-slice fetch zero-filled task_kind
+        # outside this host's block, so the bincount above only counts
+        # owned binds.  Per-job EXPRESS counts for the status math come
+        # from the global ready deltas instead — ``ready`` starts at
+        # job_ready_init and increments once per placed task, and every
+        # host fetched the full (tiny) [J] plane.
+        express_per_job = np.maximum(
+            ready.astype(np.int64)
+            - snap.job_ready_init.astype(np.int64), 0
         )
     ready_final = ready.astype(np.int64) + be_per_job
     if fc.gang_on:
@@ -245,6 +259,13 @@ def publish_and_close(fc, m, snap, aux, task_node, task_kind, ready,
         metrics.update_unschedule_job_count(n_unsched_jobs)
 
     # -- ship -----------------------------------------------------------
+    # publish-phase attribution (cfg9c follow-up): build = everything
+    # above this line (bind columns, status fingerprints, fit errors);
+    # ship = segment encode + handoff below.  The applier-side fan-out
+    # split lands in drain_stats (split_s/ship_s) — together the three
+    # walls decompose the publish critical path BENCH_r12 surfaced.
+    t_ship0 = _time.perf_counter()
+    fc.phases["publish_build"] = t_ship0 - t_build0
     binds: List[Tuple[str, str]] = []
     shipped = False
     if fc.columnar_on and fc.cache.applier is not None:
@@ -284,6 +305,7 @@ def publish_and_close(fc, m, snap, aux, task_node, task_kind, ready,
                             "status", op.get("key", op["kind"]),
                             RuntimeError(err),
                         )
+    fc.phases["publish_ship"] = _time.perf_counter() - t_ship0
     return binds
 
 def volume_bind_filter(fc, m, prows, nidx, names):

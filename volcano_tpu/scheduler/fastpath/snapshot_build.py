@@ -827,3 +827,46 @@ def build_fast_snapshot(
         "vol_solve_s": vol_solve_s,
     }
     return snap, aux
+
+
+# -- multi-controller host shards -----------------------------------------
+
+
+def host_plane_shard(args, host: int, n_hosts: int):
+    """ONE host's shard of the cycle-arg planes: task planes by task
+    block, node planes by node block, replicated planes whole — the
+    per-host snapshot-build unit of the multi-controller solve
+    (parallel/multihost.py).  In a real multi-process deployment each
+    controller's snapshot build produces exactly this dict; the CPU
+    lockstep simulation times this call per host as that host's
+    ``build_s``.  Slices materialize (``ascontiguousarray``) so the
+    build wall includes the copy a per-host build actually pays."""
+    from volcano_tpu.parallel.multihost import (
+        _REPLICATED,
+        _SPECS,
+        host_bounds,
+    )
+
+    out = {}
+    n_nodes = np.shape(args["idle"])[0]
+    n_tasks = np.shape(args["task_req"])[0]
+    nlo, nhi = host_bounds(n_nodes, n_hosts)[host]
+    tlo, thi = host_bounds(n_tasks, n_hosts)[host]
+    for name, v in args.items():
+        arr = np.asarray(v)
+        axes = _SPECS.get(name)
+        if axes is None:
+            if name not in _REPLICATED:
+                raise KeyError(
+                    f"cycle arg {name!r} has no declared multihost "
+                    "placement (_SPECS/_REPLICATED)"
+                )
+            out[name] = arr
+            continue
+        if axes[0] == "hosts":            # task plane, host-blocked
+            out[name] = np.ascontiguousarray(arr[tlo:thi])
+        elif axes[0] is None:             # [C, N]: node axis second
+            out[name] = np.ascontiguousarray(arr[:, nlo:nhi])
+        else:                             # node plane, axis 0
+            out[name] = np.ascontiguousarray(arr[nlo:nhi])
+    return out

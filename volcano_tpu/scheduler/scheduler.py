@@ -116,6 +116,21 @@ class Scheduler:
             from volcano_tpu.parallel.sharded import resolve_mesh
 
             self.mesh = resolve_mesh(self.conf.mesh)
+        # multi-controller launch (parallel/multihost.py): this process
+        # solves/publishes only its owned task block.  Contention storms
+        # (preempt/reclaim) mutate victim state across the whole task
+        # plane — outside any one host's owned block — so they are
+        # incompatible with a sharded publish and rejected up front.
+        if self.conf.mesh_hosts > 1:
+            if self.conf.backend != "tpu":
+                raise ValueError("meshHosts > 1 requires backend: tpu")
+            storm = {"preempt", "reclaim"} & set(self.conf.actions)
+            if storm:
+                raise ValueError(
+                    f"meshHosts > 1 forbids actions {sorted(storm)}: "
+                    "contention storms write victim state outside the "
+                    "host's owned task block"
+                )
         # background prewarm thread (see prewarm); joinable by callers
         # that want full determinism before the first timed cycle
         self.prewarm_background = None
@@ -601,6 +616,19 @@ class Scheduler:
                     self._record_cycle(start, "fast")
                 self._audit_tick()
                 return
+        if (
+            self.fast_cycle is not None
+            and self.fast_cycle.mesh_hosts > 1
+            and not self.fast_cycle.is_coordinator
+        ):
+            # mesh-host worker with an inexpressible cycle: the object
+            # path writes the WHOLE cluster — single-writer work the
+            # coordinator degrades to (a full single-host cycle).  The
+            # worker skips; its mirror reconciles through the watch.
+            if vtprof.PROFILER is not None:
+                vtprof.PROFILER.end_cycle(
+                    time.perf_counter() - start, {}, "mesh-worker-skip")
+            return
         if self.fast_cycle is not None and self.cache.applier is not None:
             # whole-cycle object fallback: previous fast cycles' async
             # decisions (binds, status patches, conditional enqueue
@@ -696,6 +724,13 @@ class Scheduler:
             fields["device_s"] = round(
                 seg.get("dispatch", 0.0) + seg.get("wait", 0.0), 6)
             fields["transfer_s"] = seg.get("transfer", 0.0)
+        if prof is not None and prof.hosts:
+            # multi-controller runs: cumulative per-host solve walls
+            # (build/dispatch/fetch) — vtctl top's mesh-hosts panel
+            fields["mesh_hosts"] = {
+                h: {k: round(v, 6) for k, v in row.items()}
+                for h, row in prof.hosts.items()
+            }
         timeseries.record("cycle", **fields)
 
     def _open_object_session(self):

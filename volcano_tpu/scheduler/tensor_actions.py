@@ -519,7 +519,12 @@ def jax_allocate_solve(backend, snap, n_pending=None):
         use_proportion=backend.proportion_queue_order,
         **extra,
     ))
+    host = getattr(backend, "mesh_host", None)
     prof = vtprof.PROFILER
+    if host is not None:
+        import time as _time
+
+        t_disp = _time.perf_counter()
     tok = prof.dispatch_begin(packed) if prof is not None else None
     out = packed(
         devn(snap.node_idle, "idle"),
@@ -553,6 +558,40 @@ def jax_allocate_solve(backend, snap, n_pending=None):
     kname = _solve_kernel_name(solve)
     if tok is not None:
         prof.dispatch_end(tok, kname, phase="solve")
+    T = snap.task_req.shape[0]
+    J = snap.job_queue.shape[0]
+    if host is not None:
+        # multi-controller owned-slice fetch (parallel/multihost.py):
+        # this host copies back ONLY its task block of the placement
+        # planes, plus the tiny per-job ready counts every host needs
+        # for gang gating; non-owned rows zero-fill (task_kind 0 rows
+        # are never read downstream — cycle/publish treat them as not
+        # this host's to publish).  Walls attribute per host through
+        # the fetch_outputs boundary + note_mesh_host.
+        from volcano_tpu.parallel.multihost import host_bounds
+
+        if prof is not None:
+            prof.note_mesh_host(
+                host, dispatch_s=_time.perf_counter() - t_disp
+            )
+        lo, hi = host_bounds(T, int(backend.mesh_hosts))[int(host)]
+        with trace.span("device.allocate_solve", batch=use_batch,
+                        mesh_host=int(host)) as sp:
+            owned = vtprof.fetch_outputs(
+                (out[lo:hi], out[T + lo:T + hi],
+                 out[2 * T + lo:2 * T + hi], out[3 * T:3 * T + J]),
+                kernel=kname, phase="solve", host=host, span=sp,
+            )
+
+        def _full_plane(vals):
+            buf = np.zeros(T, np.int32)
+            buf[lo:hi] = vals
+            return buf
+
+        return (
+            _full_plane(owned[0]), _full_plane(owned[1]),
+            _full_plane(owned[2]), np.asarray(owned[3]),
+        )
     # device phase timed at the ONE block-until-ready boundary — never
     # inside the jit body (the vtlint trace-span-discipline contract);
     # vtprof.fetch IS that boundary: disarmed it is exactly np.asarray
@@ -560,8 +599,6 @@ def jax_allocate_solve(backend, snap, n_pending=None):
     # device-wait from transfer and annotates the span
     with trace.span("device.allocate_solve", batch=use_batch) as sp:
         flat = vtprof.fetch(out, kernel=kname, phase="solve", span=sp)
-    T = snap.task_req.shape[0]
-    J = snap.job_queue.shape[0]
     return (
         flat[:T], flat[T:2 * T], flat[2 * T:3 * T], flat[3 * T:3 * T + J],
     )

@@ -161,6 +161,14 @@ class Profiler:
         #: after every segment ship) — the ``procNN_s`` walls the fleet
         #: critical-path report joins with shard-side fsync sections
         self.drain: Dict[str, float] = {}
+        #: per-mesh-host critical-path walls (multi-controller solve):
+        #: host id -> cumulative {build_s, dispatch_s, fetch_s} from the
+        #: per-host snapshot-shard build / device dispatch / owned-slice
+        #: fetch boundaries (parallel/multihost.py, tensor_actions) —
+        #: distinct from ``host_notes`` (per-cycle host SUB-segments):
+        #: these are the cross-cycle per-host rollup the fleet solve row
+        #: reads
+        self.hosts: Dict[str, Dict[str, float]] = {}
 
     # -- dispatch / fetch instrumentation (called from the hot sites) ---------
 
@@ -208,6 +216,18 @@ class Profiler:
                 cur["host_notes"][name] = (
                     cur["host_notes"].get(name, 0.0) + seconds
                 )
+
+    def note_mesh_host(self, host, **walls: float) -> None:
+        """Accumulate one mesh host's solve critical-path walls
+        (``build_s``/``dispatch_s``/``fetch_s`` — per-host snapshot-shard
+        build, device dispatch, owned-slice fetch).  Unlike
+        :meth:`note_host` this rolls up ACROSS cycles (the payload's
+        ``hosts`` table): the fleet solve row reads cumulative per-host
+        walls, not one cycle's sub-segments."""
+        with self._mu:
+            row = self.hosts.setdefault(str(host), {})
+            for k, v in walls.items():
+                row[k] = row.get(k, 0.0) + float(v)
 
     def count(self, name: str, n: int = 1) -> None:
         with self._mu:
@@ -449,6 +469,10 @@ class Profiler:
                 "totals": {k: dict(v) for k, v in self.totals.items()},
                 "anomalies": list(self.anomalies),
                 "drain": dict(self.drain),
+                "hosts": {
+                    h: {k: round(v, 6) for k, v in row.items()}
+                    for h, row in self.hosts.items()
+                },
             }
 
     def summary(self) -> Dict[str, Any]:
@@ -541,6 +565,16 @@ def report_text(payload: Dict[str, Any], width: int = 28) -> str:
             f"{k}={v / (1 << 20):.1f}MiB"
             for k, v in sorted(last["bytes"].items())
         ))
+    hosts = payload.get("hosts") or {}
+    if hosts:
+        lines.append("mesh hosts (solve critical path, cumulative):")
+        for h, row in sorted(hosts.items(), key=lambda kv: kv[0]):
+            path = sum(row.values())
+            lines.append(
+                f"  host {h:<4} path={path:.4f}s "
+                + " ".join(f"{k.removesuffix('_s')}={v:.4f}s"
+                           for k, v in sorted(row.items()))
+            )
     anomalies = payload.get("anomalies") or []
     if anomalies:
         lines.append(f"anomalies: {len(anomalies)}")
@@ -616,6 +650,28 @@ def fetch(out: Any, kernel: str, phase: str = "", span: Any = None):
     return arr
 
 
+def fetch_outputs(outs, kernel: str, phase: str = "solve",
+                  host=None, span: Any = None):
+    """THE sanctioned per-host fetch boundary for a solve-output tuple:
+    disarmed it is exactly ``np.asarray`` per output; armed, each
+    output's device-wait splits from its transfer and attributes to
+    ``kernel``/``phase``, and — when ``host`` is given — the whole
+    boundary's wall rolls up under that mesh host's ``fetch_s`` so the
+    multi-controller solve's owned-slice fetches stay attributed per
+    host (`vtctl profile --fleet`'s solve row)."""
+    import numpy as np
+
+    prof = PROFILER
+    if prof is None:
+        return tuple(np.asarray(o) for o in outs)
+    t0 = time.perf_counter()
+    arrs = tuple(fetch(o, kernel=kernel, phase=phase, span=span)
+                 for o in outs)
+    if host is not None:
+        prof.note_mesh_host(host, fetch_s=time.perf_counter() - t0)
+    return arrs
+
+
 def device_get(tree: Any, kernel: str, phase: str = ""):
     """The sanctioned whole-pass fetch (``jax.device_get`` shape) used by
     the contention kernels: disarmed it is exactly
@@ -639,5 +695,6 @@ def debug_payload() -> Dict[str, Any]:
     prof = PROFILER
     if prof is None:
         return {"armed": False, "pid": os.getpid(), "now": time.time(),
-                "cycles": [], "totals": {}, "anomalies": [], "drain": {}}
+                "cycles": [], "totals": {}, "anomalies": [], "drain": {},
+                "hosts": {}}
     return prof.payload()
